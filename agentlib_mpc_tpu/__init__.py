@@ -1,0 +1,32 @@
+"""agentlib_mpc_tpu — a TPU-native multi-agent MPC framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of RWTH-EBC/AgentLib-MPC
+(reference mounted at /root/reference): declarative dynamic models with
+constraints and composable objectives, OCP transcription (direct collocation
+and multiple shooting), a jit-compiled interior-point NLP solver, central /
+MINLP / MHE controllers, distributed MPC via consensus- and exchange-ADMM
+(fused on-device collectives and broker-based), ML-surrogate (ANN/GPR/linreg
+NARX) dynamics inside the optimizer, and an agent runtime with simulated and
+real-time clocks.
+
+Design principles (TPU-first, not a port):
+- models are pure jax-traceable functions, not symbolic graphs
+  (reference: CasADi MX, agentlib_mpc/models/casadi_model.py)
+- the NLP is solved by a jit-compiled primal-dual interior-point loop
+  (reference: IPOPT via casadi nlpsol, data_structures/casadi_utils.py:117-300)
+- N structure-identical agents are one vmapped batch; ADMM consensus is a
+  mesh collective (reference: per-agent threads + message broker,
+  modules/dmpc/admm/admm.py)
+- all shapes static; control flow is lax.while_loop / lax.scan.
+"""
+
+__version__ = "0.1.0"
+
+from agentlib_mpc_tpu.models.variables import (
+    Var,
+    state,
+    control_input,
+    parameter,
+    output,
+)
+from agentlib_mpc_tpu.models.model import Model, ModelEquations

@@ -1,0 +1,15 @@
+from agentlib_mpc_tpu.models.variables import (
+    Var,
+    state,
+    control_input,
+    parameter,
+    output,
+)
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import (
+    Objective,
+    SubObjective,
+    ChangePenaltyObjective,
+    ConditionalObjective,
+    CombinedObjective,
+)

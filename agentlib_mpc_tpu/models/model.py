@@ -1,0 +1,324 @@
+"""Declarative dynamic models as pure JAX functions.
+
+Re-design of the reference's ``CasadiModel``
+(``agentlib_mpc/models/casadi_model.py:277-584``): there, a user subclasses
+the model, declares typed variables in a pydantic config, and assembles
+symbolic CasADi equations once in ``setup_system``. Here the same declarative
+surface exists — variable lists as class attributes, a ``setup`` method that
+writes ODEs / output equations / constraints / objective — but ``setup`` is a
+*pure function re-executed inside every JAX trace* with the current stage
+values bound to an attribute namespace. No symbolic graph is stored; XLA sees
+ordinary jnp arithmetic, which it can fuse, differentiate and vmap.
+
+Semantics preserved from the reference:
+- states with no ODE assigned are stage-wise free variables (slacks /
+  algebraics) in the OCP (``casadi_model.py:469-500``)
+- outputs carry explicit algebraic equations (``CasadiOutput.alg``,
+  ``casadi_model.py:242-274``)
+- constraints are (lb, expr, ub) triples whose bounds may be expressions
+  (``casadi_model.py:458-467``)
+- the objective may be a composable `Objective` or a bare scalar
+  (legacy wrap: ``casadi_model.py:332-344``)
+- name shadowing between variable groups is rejected
+  (``casadi_model.py:353-372,574-583``)
+- ``simulate_step`` sub-steps dt like ``CasadiModel.do_step``
+  (``casadi_model.py:383-400``), with an RK4 scan replacing CVODES.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.models.objective import Objective, _as_objective
+from agentlib_mpc_tpu.models.variables import Var
+
+
+class ModelEquations:
+    """Container the user's ``setup`` fills in.
+
+    ``odes``: state name → dx/dt expression
+    ``outputs``: output name → algebraic expression
+    ``constraints``: list of (lb, expr, ub); bounds may be traced values
+    ``objective``: `Objective` | scalar | None (stage cost integrand)
+    """
+
+    def __init__(self):
+        self.odes: dict[str, jnp.ndarray] = {}
+        self.outputs: dict[str, jnp.ndarray] = {}
+        self.constraints: list[tuple] = []
+        self.objective = None
+
+    def ode(self, name: str, expr) -> None:
+        self.odes[name] = expr
+
+    def alg(self, name: str, expr) -> None:
+        self.outputs[name] = expr
+
+    def constraint(self, lb, expr, ub) -> None:
+        self.constraints.append((lb, expr, ub))
+
+
+class VarNS:
+    """Attribute namespace binding variable names to current (traced) values.
+
+    Plays the role of the reference's operator-overloaded CasadiVariable
+    attributes (``casadi_model.py:36-152``): inside ``setup`` the user writes
+    ``v.T_in - v.T`` and gets ordinary jnp arithmetic.
+    """
+
+    def __init__(self, values: dict[str, jnp.ndarray],
+                 du: dict[str, jnp.ndarray] | None = None,
+                 t: jnp.ndarray | float = 0.0):
+        object.__setattr__(self, "_values", values)
+        object.__setattr__(self, "_du", du or {})
+        object.__setattr__(self, "t", t)
+
+    def __getattr__(self, name: str):
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(
+                f"model has no variable {name!r}; declared: "
+                f"{sorted(object.__getattribute__(self, '_values'))}"
+            ) from None
+
+    def __setattr__(self, name, value):
+        raise AttributeError("VarNS is read-only; write equations via ModelEquations")
+
+    def __getitem__(self, name: str):
+        return self._values[name]
+
+    def du(self, name: str):
+        """Control move u_k − u_{k−1} for change penalties (zero outside the
+        optimizer — e.g. during plant simulation)."""
+        return self._du.get(name, jnp.asarray(0.0))
+
+
+def _names(vars_: Iterable[Var]) -> list[str]:
+    return [v.name for v in vars_]
+
+
+class Model:
+    """Base class for declarative models.
+
+    Subclass and set the class attributes ``inputs``, ``states``,
+    ``parameters``, ``outputs`` (lists of `Var`), then implement
+    ``setup(self, v) -> ModelEquations``.
+    """
+
+    inputs: Sequence[Var] = ()
+    states: Sequence[Var] = ()
+    parameters: Sequence[Var] = ()
+    outputs: Sequence[Var] = ()
+    dt: float = 1.0  # native sampling time (ML models override; sim substep)
+
+    def __init__(self, overrides: dict[str, float] | None = None, dt: float | None = None):
+        # instantiate per-object copies so overrides don't leak across instances
+        self.inputs = [Var.from_dict(v.as_dict()) if isinstance(v, Var) else Var.from_dict(v, "input")
+                       for v in type(self).inputs]
+        self.states = [Var.from_dict(v.as_dict()) if isinstance(v, Var) else Var.from_dict(v, "state")
+                       for v in type(self).states]
+        self.parameters = [Var.from_dict(v.as_dict()) if isinstance(v, Var) else Var.from_dict(v, "parameter")
+                           for v in type(self).parameters]
+        self.outputs = [Var.from_dict(v.as_dict()) if isinstance(v, Var) else Var.from_dict(v, "output")
+                        for v in type(self).outputs]
+        if dt is not None:
+            self.dt = dt
+        if overrides:
+            self._apply_overrides(overrides)
+        self._check_shadowing()
+        self.input_names = _names(self.inputs)
+        self.state_names = _names(self.states)
+        self.parameter_names = _names(self.parameters)
+        self.output_names = _names(self.outputs)
+        self._probe()
+
+    # -- declaration handling -------------------------------------------------
+
+    def _apply_overrides(self, overrides: dict[str, float]) -> None:
+        groups = (self.inputs, self.states, self.parameters, self.outputs)
+        byname = {v.name: (g, i) for g in groups for i, v in enumerate(g)}
+        for name, val in overrides.items():
+            if name not in byname:
+                raise KeyError(f"override for unknown variable {name!r}")
+            g, i = byname[name]
+            if isinstance(val, dict):
+                g[i] = Var.from_dict({**g[i].as_dict(), **val}, g[i].role)
+            else:
+                g[i] = g[i].replace(value=float(val))
+
+    def _check_shadowing(self) -> None:
+        seen: set[str] = set()
+        for v in (*self.inputs, *self.states, *self.parameters, *self.outputs):
+            if v.name in seen:
+                raise ValueError(f"duplicate variable name {v.name!r} across groups")
+            seen.add(v.name)
+
+    def _probe(self) -> None:
+        """Run setup once on defaults to learn the equation structure:
+        which states are differential vs. free, constraint count, term names."""
+        ns = self._make_ns(
+            {v.name: jnp.asarray(float(v.value)) for v in
+             (*self.inputs, *self.states, *self.parameters, *self.outputs)})
+        eq = self.setup(ns)
+        unknown = set(eq.odes) - set(self.state_names)
+        if unknown:
+            raise ValueError(f"ODE assigned to undeclared states: {sorted(unknown)}")
+        unknown = set(eq.outputs) - set(self.output_names)
+        if unknown:
+            raise ValueError(f"alg equation for undeclared outputs: {sorted(unknown)}")
+        self.diff_state_names = [n for n in self.state_names if n in eq.odes]
+        self.free_state_names = [n for n in self.state_names if n not in eq.odes]
+        self.n_diff = len(self.diff_state_names)
+        self.n_free = len(self.free_state_names)
+        self.n_constraints = len(eq.constraints)
+        obj = eq.objective
+        self.objective_term_names = (
+            list(_as_objective(obj).term_values().keys()) if obj is not None else [])
+
+    def setup(self, v: VarNS) -> ModelEquations:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- traced evaluation ----------------------------------------------------
+
+    def _make_ns(self, values, du=None, t=0.0) -> VarNS:
+        return VarNS(values, du=du, t=t)
+
+    def _bind(self, x_diff, z_free, u, p, t, du=None) -> tuple[ModelEquations, VarNS]:
+        values: dict[str, jnp.ndarray] = {}
+        for i, n in enumerate(self.diff_state_names):
+            values[n] = x_diff[i]
+        for i, n in enumerate(self.free_state_names):
+            values[n] = z_free[i]
+        for i, n in enumerate(self.input_names):
+            values[n] = u[i]
+        for i, n in enumerate(self.parameter_names):
+            values[n] = p[i]
+        # outputs start at placeholder defaults; a second setup pass rebinds
+        # them to their computed algebraic expressions so constraints and
+        # objectives may reference outputs by name (the reference gets this
+        # for free from the shared symbolic graph, casadi_model.py:242-274)
+        for v in self.outputs:
+            values[v.name] = jnp.asarray(float(v.value))
+        du_map = None
+        if du is not None:
+            du_map = {n: du[i] for i, n in enumerate(self.input_names)}
+        ns = self._make_ns(values, du=du_map, t=t)
+        eq = self.setup(ns)
+        if eq.outputs:
+            values = dict(values)
+            for name, expr in eq.outputs.items():
+                values[name] = jnp.asarray(expr)
+            ns = self._make_ns(values, du=du_map, t=t)
+            eq = self.setup(ns)
+        return eq, ns
+
+    def ode(self, x_diff, z_free, u, p, t=0.0):
+        """dx/dt of the differential states. Shapes: (n_diff,), (n_free,),
+        (n_inputs,), (n_params,) → (n_diff,)."""
+        eq, _ = self._bind(x_diff, z_free, u, p, t)
+        if not self.diff_state_names:
+            return jnp.zeros((0,))
+        return jnp.stack([jnp.asarray(eq.odes[n]) for n in self.diff_state_names])
+
+    def output(self, x_diff, z_free, u, p, t=0.0):
+        """(n_outputs,) algebraic outputs."""
+        eq, _ = self._bind(x_diff, z_free, u, p, t)
+        outs = []
+        for v in self.outputs:
+            if v.name in eq.outputs:
+                outs.append(jnp.asarray(eq.outputs[v.name]))
+            else:
+                outs.append(jnp.asarray(float(v.value)))
+        return jnp.stack(outs) if outs else jnp.zeros((0,))
+
+    def constraint_residuals(self, x_diff, z_free, u, p, t=0.0):
+        """All model constraints as one-sided residuals h ≥ 0.
+
+        Each (lb, expr, ub) triple contributes ``expr − lb`` and/or
+        ``ub − expr``; statically infinite bounds are dropped. Bounds that are
+        traced expressions (e.g. a comfort band that is itself a model input,
+        as in the reference one-room example) are kept as nonlinear residuals.
+        """
+        eq, _ = self._bind(x_diff, z_free, u, p, t)
+        res = []
+        for lb, expr, ub in eq.constraints:
+            expr = jnp.asarray(expr)
+            if not (isinstance(lb, (int, float)) and math.isinf(lb)):
+                res.append(expr - lb)
+            if not (isinstance(ub, (int, float)) and math.isinf(ub)):
+                res.append(ub - expr)
+        return jnp.stack(res) if res else jnp.zeros((0,))
+
+    def stage_cost(self, x_diff, z_free, u, p, t=0.0, du=None):
+        """Objective integrand at one stage → scalar."""
+        if du is None:
+            du = jnp.zeros((len(self.input_names),))
+        eq, _ = self._bind(x_diff, z_free, u, p, t, du=du)
+        if eq.objective is None:
+            return jnp.asarray(0.0)
+        return jnp.asarray(_as_objective(eq.objective).value())
+
+    def stage_cost_terms(self, x_diff, z_free, u, p, t=0.0, du=None):
+        """name → weighted per-term stage cost (for stats, reference
+        ``casadi_backend.py:309-323``)."""
+        if du is None:
+            du = jnp.zeros((len(self.input_names),))
+        eq, _ = self._bind(x_diff, z_free, u, p, t, du=du)
+        if eq.objective is None:
+            return {}
+        return {k: jnp.asarray(v) for k, v in
+                _as_objective(eq.objective).term_values().items()}
+
+    # -- simulation (plant stand-in; replaces CVODES do_step) -----------------
+
+    def simulate_step(self, x_diff, u, p, dt: float, substeps: int = 10,
+                      method: str = "rk4"):
+        """Integrate the ODE over one sample with fixed sub-steps
+        (reference ``CasadiModel.do_step``, ``casadi_model.py:383-400``).
+
+        `method` selects the stepper from ops.integrators ("euler", "rk4",
+        "implicit_midpoint" for stiff plants — the CVODES stand-ins). Free
+        (slack) states are held at zero during simulation. Returns
+        (x_next, outputs).
+        """
+        from agentlib_mpc_tpu.ops.integrators import integrate
+
+        z = jnp.zeros((self.n_free,))
+
+        def f(x, t):
+            return self.ode(x, z, u, p, t)
+
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        x_next = integrate(f, jnp.asarray(x_diff, dtype=dtype), 0.0, dt,
+                           substeps=substeps, method=method)
+        y = self.output(x_next, z, u, p, dt)
+        return x_next, y
+
+    # -- convenience ----------------------------------------------------------
+
+    def default_vector(self, group: str) -> jnp.ndarray:
+        vars_ = {"inputs": self.inputs, "parameters": self.parameters,
+                 "outputs": self.outputs}.get(group)
+        if group == "diff_states":
+            byname = {v.name: v for v in self.states}
+            vars_ = [byname[n] for n in self.diff_state_names]
+        elif group == "free_states":
+            byname = {v.name: v for v in self.states}
+            vars_ = [byname[n] for n in self.free_state_names]
+        if vars_ is None:
+            raise KeyError(group)
+        return jnp.array([float(v.value) for v in vars_])
+
+    def get_var(self, name: str) -> Var:
+        for v in (*self.inputs, *self.states, *self.parameters, *self.outputs):
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+    def input_index(self, name: str) -> int:
+        return self.input_names.index(name)

@@ -1,0 +1,177 @@
+"""Composable objective algebra.
+
+Re-design of the reference's objective system
+(``agentlib_mpc/data_structures/objective.py``: SubObjective :74-134,
+ChangePenaltyObjective :239-294, CombinedObjective :297-453,
+ConditionalObjective :456-621, CompositeWeight :10-71) for JAX tracing.
+
+Key difference from the reference: there, objective terms wrap *symbolic
+CasADi expressions* built once; here, ``Model.setup`` is re-executed inside
+every trace, so a term simply holds the *traced scalar value* of its
+expression at the current stage, plus metadata (name, weight). Because
+weights can themselves be model parameters in the reference, a weight here
+is whatever value you pass — a Python float or a traced parameter value from
+the namespace; both compose identically.
+
+Per-term bookkeeping is preserved: every term has a ``name`` and
+``term_values()`` so the transcription can record per-term stage costs,
+matching the reference's post-hoc per-term objective evaluation
+(``casadi_backend.py:309-323``, ``objective.py:342-395``).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+
+Scalar = Union[float, jnp.ndarray]
+
+
+class Objective:
+    """Base class: supports ``+`` and ``*`` composition like the reference
+    (``objective.py:110-134``)."""
+
+    name: str = "objective"
+
+    def value(self) -> Scalar:
+        raise NotImplementedError
+
+    def term_values(self) -> dict[str, Scalar]:
+        """name → weighted term value at the current stage."""
+        return {self.name: self.value()}
+
+    def __add__(self, other):
+        return CombinedObjective(self, _as_objective(other))
+
+    def __radd__(self, other):
+        if other == 0:  # support sum([...])
+            return self
+        return CombinedObjective(_as_objective(other), self)
+
+    def __mul__(self, factor):
+        return _Scaled(self, factor)
+
+    __rmul__ = __mul__
+
+
+class _Wrapped(Objective):
+    """A bare scalar expression used as an objective (reference wraps legacy
+    scalar objectives the same way, ``casadi_model.py:332-344``)."""
+
+    def __init__(self, expr: Scalar, name: str = "objective"):
+        self.expr = expr
+        self.name = name
+
+    def value(self) -> Scalar:
+        return jnp.asarray(self.expr)
+
+
+class _Scaled(Objective):
+    def __init__(self, inner: Objective, factor: Scalar):
+        self.inner = inner
+        self.factor = factor
+        self.name = inner.name
+
+    def value(self) -> Scalar:
+        return self.inner.value() * self.factor
+
+    def term_values(self) -> dict[str, Scalar]:
+        return {k: v * self.factor for k, v in self.inner.term_values().items()}
+
+
+def _as_objective(x) -> Objective:
+    if isinstance(x, Objective):
+        return x
+    return _Wrapped(x)
+
+
+class SubObjective(Objective):
+    """``weight * sum(expressions)`` — reference ``objective.py:74-134``.
+
+    ``expressions`` may be a single traced scalar or a list; ``weight`` a
+    float or a traced parameter value (parameter weights supported like the
+    reference's CompositeWeight, ``objective.py:10-71``).
+    """
+
+    def __init__(self, expressions, weight: Scalar = 1.0, name: str = "sub_objective"):
+        if not isinstance(expressions, (list, tuple)):
+            expressions = [expressions]
+        self.expressions = list(expressions)
+        self.weight = weight
+        self.name = name
+
+    def value(self) -> Scalar:
+        total = jnp.asarray(0.0)
+        for e in self.expressions:
+            total = total + jnp.asarray(e)
+        return self.weight * total
+
+
+class ChangePenaltyObjective(Objective):
+    """Penalty on control moves Δu (reference ``objective.py:239-294``).
+
+    ``du`` must come from the namespace's ``v.du("<control>")`` which the
+    transcription wires to u_k − u_{k−1} (with u_{−1} = the live previous
+    control, reference FullSystem ``casadi_/full.py:18-33``).
+    """
+
+    def __init__(self, du: Scalar, weight: Scalar = 1.0,
+                 name: str = "change_penalty", quadratic: bool = True):
+        self.du = du
+        self.weight = weight
+        self.name = name
+        self.quadratic = quadratic
+
+    def value(self) -> Scalar:
+        du = jnp.asarray(self.du)
+        penalty = du * du if self.quadratic else jnp.abs(du)
+        return self.weight * penalty
+
+
+class ConditionalObjective(Objective):
+    """Objective switched by a traced boolean condition (reference
+    ``objective.py:456-621`` uses ``ca.if_else``; here ``jnp.where``)."""
+
+    def __init__(self, condition, if_true: Objective, if_false: Objective,
+                 name: str = "conditional"):
+        self.condition = condition
+        self.if_true = _as_objective(if_true)
+        self.if_false = _as_objective(if_false)
+        self.name = name
+
+    def value(self) -> Scalar:
+        return jnp.where(self.condition, self.if_true.value(),
+                         self.if_false.value())
+
+
+class CombinedObjective(Objective):
+    """Sum of terms with optional normalization (reference
+    ``objective.py:297-453``)."""
+
+    def __init__(self, *terms, normalization: Scalar = 1.0, name: str = "combined"):
+        self.terms: list[Objective] = [_as_objective(t) for t in terms]
+        self.normalization = normalization
+        self.name = name
+
+    def value(self) -> Scalar:
+        total = jnp.asarray(0.0)
+        for t in self.terms:
+            total = total + t.value()
+        return total / self.normalization
+
+    def term_values(self) -> dict[str, Scalar]:
+        out: dict[str, Scalar] = {}
+        for i, t in enumerate(self.terms):
+            for k, v in t.term_values().items():
+                key = k if k not in out else f"{k}_{i}"
+                out[key] = v / self.normalization
+        return out
+
+    def __add__(self, other):
+        other = _as_objective(other)
+        if isinstance(other, CombinedObjective) and \
+                other.normalization == self.normalization:
+            return CombinedObjective(*self.terms, *other.terms,
+                                     normalization=self.normalization)
+        return CombinedObjective(self, other)

@@ -1,0 +1,7 @@
+from agentlib_mpc_tpu.ops.collocation import collocation_matrices
+from agentlib_mpc_tpu.ops.transcription import (
+    OCPParams,
+    TranscribedOCP,
+    transcribe,
+)
+from agentlib_mpc_tpu.ops.solver import NLPFunctions, SolverOptions, solve_nlp

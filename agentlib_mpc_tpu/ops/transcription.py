@@ -1,0 +1,417 @@
+"""OCP → NLP transcription: direct collocation and multiple shooting.
+
+TPU-native re-design of the reference's discretization layer
+(``agentlib_mpc/optimization_backends/casadi_/core/discretization.py`` and
+``casadi_/basic.py``): there, an imperative builder loop appends CasADi MX
+symbols, constraints and parameters one grid point at a time and a mapping
+Function splices per-solve values in. Here the whole transcription is a pure
+function of a *decision pytree* with static shapes — XLA sees one fused
+vectorized graph over the horizon; no symbol bookkeeping exists at runtime.
+
+Layout of the decision pytree ``w``:
+    ``x``  (N+1, n_x)        differential states at interval boundaries
+    ``xc`` (N, d, n_x)       interior collocation states   [collocation only]
+    ``z``  (N, d, n_z)/(N, n_z) stage-wise free states (slacks/algebraics)
+    ``u``  (N, n_u)          piecewise-constant controls
+
+Per-solve data (initial state, disturbance trajectories, parameters,
+time-varying bounds, previous control for Δu penalties) ride in `OCPParams`
+— the analogue of the reference's per-solve parameter sampling
+(``casadi_backend.py:141-253``).
+
+Equalities: initial condition, collocation defects + continuity (reference
+math at ``basic.py:251-342``) or shooting defects (``basic.py:395-476``).
+Inequalities: model constraint residuals (h ≥ 0) at the collocation points /
+shooting nodes. Objective: quadrature-weighted stage cost (collocation) or
+dt-weighted (shooting), with Δu wired from the control sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from agentlib_mpc_tpu.models.model import Model
+from agentlib_mpc_tpu.ops.collocation import collocation_matrices
+from agentlib_mpc_tpu.ops.integrators import integrate
+from agentlib_mpc_tpu.ops.solver import NLPFunctions
+
+# value used in place of +-inf bounds (interior-point needs finite boxes;
+# gradients of the barrier at this distance underflow harmlessly)
+BIG = 1.0e6
+
+
+class OCPParams(NamedTuple):
+    """Per-solve data for a transcribed OCP. All leaves are arrays so the
+    whole tuple can be donated/vmapped."""
+
+    x0: jnp.ndarray        # (n_x,) current differential state
+    u_prev: jnp.ndarray    # (n_u,) last applied control (Δu penalty)
+    d_traj: jnp.ndarray    # (N, n_d) exogenous inputs per interval
+    p: jnp.ndarray         # (n_p,) model parameters
+    x_lb: jnp.ndarray      # (N+1, n_x) state bounds over the horizon
+    x_ub: jnp.ndarray
+    u_lb: jnp.ndarray      # (N, n_u) control bounds over the horizon
+    u_ub: jnp.ndarray
+    z_lb: jnp.ndarray      # (n_z,) free-state bounds
+    z_ub: jnp.ndarray
+    t0: jnp.ndarray        # () solve start time (for time-dependent costs)
+
+
+@dataclasses.dataclass(frozen=True)
+class TranscribedOCP:
+    """A transcribed optimal control problem, ready for `solve_nlp`."""
+
+    model: Model
+    control_names: tuple[str, ...]
+    exo_names: tuple[str, ...]
+    N: int
+    dt: float
+    method: str
+    n_w: int
+    n_g: int
+    n_h: int
+    nlp: NLPFunctions
+    unflatten: Callable[[jnp.ndarray], dict]
+    flatten: Callable[[dict], jnp.ndarray]
+    bounds: Callable[[OCPParams], tuple[jnp.ndarray, jnp.ndarray]]
+    initial_guess: Callable[[OCPParams], jnp.ndarray]
+    shift_guess: Callable[[jnp.ndarray, OCPParams], jnp.ndarray]
+    trajectories: Callable[[jnp.ndarray, OCPParams], dict]
+    default_params: Callable[..., OCPParams]
+
+    @property
+    def state_grid(self):
+        return jnp.arange(self.N + 1) * self.dt
+
+    @property
+    def control_grid(self):
+        return jnp.arange(self.N) * self.dt
+
+
+def _input_splicer(model: Model, control_names: Sequence[str]):
+    """Return (exo_names, splice) where splice(u_ctrl, d_exo) rebuilds the
+    full model input vector in declaration order (the job of the reference's
+    variable-group mapping Functions, ``core/VariableGroup.py:39-137``)."""
+    control_names = list(control_names)
+    for c in control_names:
+        if c not in model.input_names:
+            raise ValueError(f"control {c!r} is not a model input")
+    exo_names = [n for n in model.input_names if n not in control_names]
+    ctrl_idx = jnp.array([model.input_names.index(n) for n in control_names],
+                         dtype=jnp.int32)
+    exo_idx = jnp.array([model.input_names.index(n) for n in exo_names],
+                        dtype=jnp.int32)
+    n_in = len(model.input_names)
+
+    def splice(u_ctrl, d_exo):
+        full = jnp.zeros((n_in,), dtype=u_ctrl.dtype)
+        if len(control_names):
+            full = full.at[ctrl_idx].set(u_ctrl)
+        if len(exo_names):
+            full = full.at[exo_idx].set(d_exo)
+        return full
+
+    def splice_du(du_ctrl):
+        full = jnp.zeros((n_in,), dtype=du_ctrl.dtype)
+        if len(control_names):
+            full = full.at[ctrl_idx].set(du_ctrl)
+        return full
+
+    return exo_names, splice, splice_du
+
+
+def _finite(arr, default):
+    return jnp.where(jnp.isfinite(arr), arr, default)
+
+
+def transcribe(
+    model: Model,
+    control_names: Sequence[str],
+    N: int,
+    dt: float,
+    method: str = "collocation",
+    collocation_degree: int = 3,
+    collocation_method: str = "radau",
+    integrator: str = "rk4",
+    integrator_substeps: int = 3,
+) -> TranscribedOCP:
+    """Transcribe `model` over an N-interval horizon with step `dt`."""
+    if method not in ("collocation", "multiple_shooting"):
+        raise ValueError(f"unknown transcription method {method!r}")
+    exo_names, splice, splice_du = _input_splicer(model, control_names)
+    n_x = model.n_diff
+    n_z = model.n_free
+    n_u = len(control_names)
+    n_d = len(exo_names)
+    is_colloc = method == "collocation"
+    d = collocation_degree if is_colloc else 1
+
+    template = {
+        "x": jnp.zeros((N + 1, n_x)),
+        "u": jnp.zeros((N, n_u)),
+    }
+    if is_colloc:
+        template["xc"] = jnp.zeros((N, d, n_x))
+        template["z"] = jnp.zeros((N, d, n_z))
+    else:
+        template["z"] = jnp.zeros((N, n_z))
+    w_flat0, unflatten = ravel_pytree(template)
+    n_w = w_flat0.size
+
+    if is_colloc:
+        taus, C_np, D_np, B_np = collocation_matrices(d, collocation_method)
+        C = jnp.asarray(C_np)
+        D = jnp.asarray(D_np)
+        B = jnp.asarray(B_np)
+        taus_j = jnp.asarray(taus)
+
+    def _du_seq(u, u_prev):
+        return u - jnp.concatenate([u_prev[None, :], u[:-1]], axis=0)
+
+    # ---- equality constraints ------------------------------------------------
+    def g_fn(w_flat, theta: OCPParams):
+        w = unflatten(w_flat)
+        x, u = w["x"], w["u"]
+        parts = [x[0] - theta.x0]
+        if is_colloc:
+            xc = w["xc"]
+
+            def interval(i):
+                # X: (d+1, n_x) states at tau grid incl. boundary
+                X = jnp.concatenate([x[i][None, :], xc[i]], axis=0)
+                u_full = splice(u[i], theta.d_traj[i])
+
+                def fdot(j):
+                    t_ij = theta.t0 + (i + taus_j[j + 1]) * dt
+                    return model.ode(xc[i, j], w["z"][i, j], u_full, theta.p, t_ij)
+
+                fs = jax.vmap(fdot)(jnp.arange(d))  # (d, n_x)
+                # defect at each collocation point k=1..d:
+                # sum_j C[j,k] X_j = dt * f(X_k)
+                xdot_poly = jnp.einsum("jk,jn->kn", C[:, 1:], X)  # (d, n_x)
+                defects = xdot_poly - dt * fs
+                cont = x[i + 1] - D @ X
+                return defects.reshape(-1), cont
+
+            defects, conts = jax.vmap(interval)(jnp.arange(N))
+            parts.append(defects.reshape(-1))
+            parts.append(conts.reshape(-1))
+        else:
+            def interval(i):
+                u_full = splice(u[i], theta.d_traj[i])
+
+                def f(xx, t):
+                    return model.ode(xx, w["z"][i], u_full, theta.p, t)
+
+                x_end = integrate(f, x[i], theta.t0 + i * dt, dt,
+                                  substeps=integrator_substeps, method=integrator)
+                return x[i + 1] - x_end
+
+            defects = jax.vmap(interval)(jnp.arange(N))
+            parts.append(defects.reshape(-1))
+        return jnp.concatenate(parts) if parts else jnp.zeros((0,))
+
+    # ---- inequality constraints (h >= 0) ------------------------------------
+    def h_fn(w_flat, theta: OCPParams):
+        w = unflatten(w_flat)
+        u = w["u"]
+        if model.n_constraints == 0:
+            return jnp.zeros((0,))
+        if is_colloc:
+            xc, z = w["xc"], w["z"]
+
+            def point(i, j):
+                u_full = splice(u[i], theta.d_traj[i])
+                t_ij = theta.t0 + (i + taus_j[j + 1]) * dt
+                return model.constraint_residuals(xc[i, j], z[i, j], u_full,
+                                                  theta.p, t_ij)
+
+            res = jax.vmap(lambda i: jax.vmap(lambda j: point(i, j))(
+                jnp.arange(d)))(jnp.arange(N))
+            return res.reshape(-1)
+        x, z = w["x"], w["z"]
+
+        def node(i):
+            u_full = splice(u[i], theta.d_traj[i])
+            return model.constraint_residuals(x[i], z[i], u_full, theta.p,
+                                              theta.t0 + i * dt)
+
+        res = jax.vmap(node)(jnp.arange(N))
+        return res.reshape(-1)
+
+    # ---- objective -----------------------------------------------------------
+    def f_fn(w_flat, theta: OCPParams):
+        w = unflatten(w_flat)
+        x, u = w["x"], w["u"]
+        du = _du_seq(u, theta.u_prev)
+        if is_colloc:
+            xc, z = w["xc"], w["z"]
+
+            def interval(i):
+                u_full = splice(u[i], theta.d_traj[i])
+                du_full = splice_du(du[i])
+
+                def point(j):
+                    # j = 0 is the boundary point (weight B[0]); interior
+                    # points use the collocation states
+                    xx = jnp.where(j == 0, x[i], xc[i, jnp.maximum(j - 1, 0)])
+                    zz = z[i, jnp.maximum(j - 1, 0)]
+                    t_ij = theta.t0 + (i + taus_j[j]) * dt
+                    return model.stage_cost(xx, zz, u_full, theta.p, t_ij,
+                                            du=du_full)
+
+                q = jax.vmap(point)(jnp.arange(d + 1))
+                return dt * jnp.sum(B * q)
+
+            return jnp.sum(jax.vmap(interval)(jnp.arange(N)))
+        z = w["z"]
+
+        def node(i):
+            u_full = splice(u[i], theta.d_traj[i])
+            du_full = splice_du(du[i])
+            return model.stage_cost(x[i], z[i], u_full, theta.p,
+                                    theta.t0 + i * dt, du=du_full)
+
+        return dt * jnp.sum(jax.vmap(node)(jnp.arange(N)))
+
+    # static sizes (probe once with zeros)
+    theta0 = _default_params(model, control_names, exo_names, N, dt)
+    n_g = int(g_fn(w_flat0, theta0).shape[0])
+    n_h = int(h_fn(w_flat0, theta0).shape[0])
+
+    # ---- bounds --------------------------------------------------------------
+    def bounds_fn(theta: OCPParams):
+        x_lb = _finite(theta.x_lb, -BIG)
+        x_ub = _finite(theta.x_ub, BIG)
+        u_lb = _finite(theta.u_lb, -BIG)
+        u_ub = _finite(theta.u_ub, BIG)
+        z_lb = _finite(theta.z_lb, -BIG)
+        z_ub = _finite(theta.z_ub, BIG)
+        lb = {"x": x_lb, "u": u_lb}
+        ub = {"x": x_ub, "u": u_ub}
+        if is_colloc:
+            # interior states inherit the bounds of their interval's end point
+            lb["xc"] = jnp.broadcast_to(x_lb[1:, None, :], (N, d, n_x))
+            ub["xc"] = jnp.broadcast_to(x_ub[1:, None, :], (N, d, n_x))
+            lb["z"] = jnp.broadcast_to(z_lb, (N, d, n_z))
+            ub["z"] = jnp.broadcast_to(z_ub, (N, d, n_z))
+        else:
+            lb["z"] = jnp.broadcast_to(z_lb, (N, n_z))
+            ub["z"] = jnp.broadcast_to(z_ub, (N, n_z))
+        lb_flat, _ = ravel_pytree({k: lb[k] for k in template})
+        ub_flat, _ = ravel_pytree({k: ub[k] for k in template})
+        return lb_flat, ub_flat
+
+    # ---- initial guess / warm start -----------------------------------------
+    def initial_guess_fn(theta: OCPParams):
+        x_guess = jnp.broadcast_to(theta.x0, (N + 1, n_x))
+        u_mid = jnp.clip(jnp.zeros((N, n_u)), _finite(theta.u_lb, -BIG),
+                         _finite(theta.u_ub, BIG))
+        u_guess = jnp.broadcast_to(theta.u_prev, (N, n_u))
+        u_guess = jnp.where(jnp.isfinite(u_guess), u_guess, u_mid)
+        guess = {"x": x_guess, "u": u_guess}
+        if is_colloc:
+            guess["xc"] = jnp.broadcast_to(theta.x0, (N, d, n_x))
+            guess["z"] = jnp.zeros((N, d, n_z))
+        else:
+            guess["z"] = jnp.zeros((N, n_z))
+        flat, _ = ravel_pytree({k: guess[k] for k in template})
+        return flat
+
+    def shift_guess_fn(w_flat, theta: OCPParams):
+        """Shift the previous optimum one interval forward, repeating the
+        last stage (reference ``_determine_initial_guess``,
+        ``discretization.py:212-245``), and pin the new initial state."""
+        w = unflatten(w_flat)
+        x = jnp.concatenate([w["x"][1:], w["x"][-1:]], axis=0).at[0].set(theta.x0)
+        u = jnp.concatenate([w["u"][1:], w["u"][-1:]], axis=0)
+        out = {"x": x, "u": u}
+        if is_colloc:
+            out["xc"] = jnp.concatenate([w["xc"][1:], w["xc"][-1:]], axis=0)
+        out["z"] = jnp.concatenate([w["z"][1:], w["z"][-1:]], axis=0)
+        flat, _ = ravel_pytree({k: out[k] for k in template})
+        return flat
+
+    # ---- result extraction ---------------------------------------------------
+    def trajectories_fn(w_flat, theta: OCPParams):
+        w = unflatten(w_flat)
+        x, u = w["x"], w["u"]
+        z_stage = w["z"][:, -1, :] if is_colloc else w["z"]
+
+        def node_out(i):
+            u_full = splice(u[jnp.minimum(i, N - 1)],
+                            theta.d_traj[jnp.minimum(i, N - 1)])
+            zz = z_stage[jnp.minimum(i, N - 1)]
+            return model.output(x[i], zz, u_full, theta.p, theta.t0 + i * dt)
+
+        y = jax.vmap(node_out)(jnp.arange(N + 1))
+        return {
+            "time_state": theta.t0 + jnp.arange(N + 1) * dt,
+            "time_control": theta.t0 + jnp.arange(N) * dt,
+            "x": x,
+            "u": u,
+            "z": z_stage,
+            "y": y,
+            "objective": f_fn(w_flat, theta),
+        }
+
+    def default_params(**kw) -> OCPParams:
+        return _default_params(model, control_names, exo_names, N, dt, **kw)
+
+    return TranscribedOCP(
+        model=model,
+        control_names=tuple(control_names),
+        exo_names=tuple(exo_names),
+        N=N,
+        dt=dt,
+        method=method,
+        n_w=n_w,
+        n_g=n_g,
+        n_h=n_h,
+        nlp=NLPFunctions(f=f_fn, g=g_fn, h=h_fn),
+        unflatten=unflatten,
+        flatten=lambda w: ravel_pytree({k: w[k] for k in template})[0],
+        bounds=bounds_fn,
+        initial_guess=initial_guess_fn,
+        shift_guess=shift_guess_fn,
+        trajectories=trajectories_fn,
+        default_params=default_params,
+    )
+
+
+def _default_params(model: Model, control_names, exo_names, N, dt,
+                    **overrides) -> OCPParams:
+    """OCPParams from model defaults; keyword overrides replace leaves."""
+    byname = {v.name: v for v in
+              (*model.inputs, *model.states, *model.parameters)}
+    n_u = len(control_names)
+    x0 = jnp.array([byname[n].value for n in model.diff_state_names])
+    u_prev = jnp.array([byname[n].value for n in control_names]) \
+        if n_u else jnp.zeros((0,))
+    d_traj = jnp.broadcast_to(
+        jnp.array([byname[n].value for n in exo_names]),
+        (N, len(exo_names))) if exo_names else jnp.zeros((N, 0))
+    p = model.default_vector("parameters")
+    x_lb = jnp.broadcast_to(
+        jnp.array([byname[n].lb for n in model.diff_state_names]),
+        (N + 1, model.n_diff))
+    x_ub = jnp.broadcast_to(
+        jnp.array([byname[n].ub for n in model.diff_state_names]),
+        (N + 1, model.n_diff))
+    u_lb = jnp.broadcast_to(
+        jnp.array([byname[n].lb for n in control_names]), (N, n_u)) \
+        if n_u else jnp.zeros((N, 0))
+    u_ub = jnp.broadcast_to(
+        jnp.array([byname[n].ub for n in control_names]), (N, n_u)) \
+        if n_u else jnp.zeros((N, 0))
+    z_lb = jnp.array([byname[n].lb for n in model.free_state_names])
+    z_ub = jnp.array([byname[n].ub for n in model.free_state_names])
+    theta = OCPParams(x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
+                      x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub,
+                      z_lb=z_lb, z_ub=z_ub, t0=jnp.asarray(0.0))
+    return theta._replace(**{k: jnp.asarray(v) for k, v in overrides.items()})

@@ -1,0 +1,177 @@
+"""Single-zone cooling MPC — the minimum end-to-end slice.
+
+Native re-build of the reference's flagship example
+(``examples/one_room_mpc/physical/simple_mpc.py``): a one-state zone model
+with soft comfort constraint, collocation transcription, and a closed loop
+of plant simulation + MPC solve every 300 s. The reference runs CasADi +
+IPOPT per step; here the whole controller step (warm-started interior-point
+solve) is one jitted XLA computation and the plant integrator another.
+
+Run:  python examples/one_room_mpc.py
+Prints the same closed-loop metrics as the reference example
+(``simple_mpc.py:254-264``): absolute integral comfort error (K·h) and
+cooling energy (kWh).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import SubObjective
+from agentlib_mpc_tpu.models.variables import (
+    control_input,
+    output,
+    parameter,
+    state,
+)
+from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+
+UB_COMFORT = 295.15  # K, soft upper comfort bound
+
+
+class OneRoom(Model):
+    """Air-volume zone: dT/dt = cp·mDot/C·(T_in − T) + load/C, slacked
+    comfort constraint T + s ≤ T_upper, cost r·mDot + s·slack²."""
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m³/s"),
+        control_input("load", 150.0, unit="W"),
+        control_input("T_in", 290.15, unit="K"),
+        control_input("T_upper", 294.15, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        parameter("s_T", 1.0),
+        parameter("r_mDot", 1.0),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
+            + SubObjective(v.T_slack**2, weight=v.s_T, name="temp_slack")
+        )
+        return eq
+
+
+def run_example(until: float = 7200.0, time_step: float = 300.0,
+                prediction_horizon: int = 15, t_sample: float = 10.0,
+                verbose: bool = True):
+    """Closed loop: plant at `t_sample` resolution, MPC every `time_step`."""
+    model = OneRoom(overrides={"s_T": 0.001, "r_mDot": 0.01})
+    ocp = transcribe(model, ["mDot"], N=prediction_horizon, dt=time_step,
+                     method="collocation", collocation_degree=2,
+                     collocation_method="legendre")
+    # tol reachable in f64; the stall-acceptance criteria cover the f32
+    # (TPU) precision floor
+    opts = SolverOptions(tol=1e-6, max_iter=60)
+
+    @jax.jit
+    def mpc_step(x0, u_prev, w_guess, y_guess, z_guess, mu0):
+        theta = ocp.default_params(
+            x0=x0, u_prev=u_prev,
+            d_traj=jnp.broadcast_to(
+                jnp.array([150.0, 290.15, UB_COMFORT]),
+                (prediction_horizon, 3)),
+        )
+        lb, ub = ocp.bounds(theta)
+        res = solve_nlp(ocp.nlp, w_guess, theta, lb, ub, opts,
+                        y0=y_guess, z0=z_guess, mu0=mu0)
+        traj = ocp.trajectories(res.w, theta)
+        u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+        next_guess = ocp.shift_guess(res.w, theta)
+        return u0, next_guess, res.y, res.z, res.stats, traj
+
+    plant_substeps = round(time_step / t_sample)
+    if abs(plant_substeps * t_sample - time_step) > 1e-9:
+        raise ValueError(
+            f"t_sample={t_sample} must divide time_step={time_step}")
+
+    @jax.jit
+    def plant_roll(x, u_ctrl):
+        u_full = model.default_vector("inputs")
+        u_full = u_full.at[model.input_index("mDot")].set(u_ctrl[0])
+
+        def sub(xx, _):
+            xn, y = model.simulate_step(xx, u_full,
+                                        model.default_vector("parameters"),
+                                        dt=t_sample, substeps=2)
+            return xn, y[0]
+
+        x_next, temps = jax.lax.scan(sub, x, jnp.arange(plant_substeps))
+        return x_next, temps
+
+    n_steps = int(until / time_step)
+    x = jnp.array([298.16])
+    u_prev = jnp.array([0.02])
+    theta0 = ocp.default_params(x0=x, u_prev=u_prev)
+    w_guess = ocp.initial_guess(theta0)
+    # cold duals for the first solve; thereafter warm-start primal AND dual
+    # with a small barrier (the payoff of a persistent jitted solver state)
+    y_guess = jnp.zeros((ocp.n_g,))
+    # strong-typed like the solver's returned duals, so feeding results back
+    # in at step 1 doesn't retrace (weak→strong aval mismatch)
+    z_guess = jnp.full((ocp.n_h,), 0.1).astype(y_guess.dtype)
+
+    temps_all, mdot_all, solve_times, stats_rows = [], [], [], []
+    for k in range(n_steps):
+        t0 = time.perf_counter()
+        mu0 = jnp.asarray(0.1 if k == 0 else 1e-2)
+        u0, w_guess, y_guess, z_guess, stats, traj = mpc_step(
+            x, u_prev, w_guess, y_guess, z_guess, mu0)
+        u0.block_until_ready()
+        solve_times.append(time.perf_counter() - t0)
+        x, temps = plant_roll(x, u0)
+        temps_all.append(temps)
+        mdot_all.append(jnp.full((plant_substeps,), u0[0]))
+        u_prev = u0
+        stats_rows.append(stats)
+        if verbose and k % 4 == 0:
+            print(f"t={k*time_step:6.0f}s  T={float(x[0]):.2f}K  "
+                  f"mDot={float(u0[0]):.4f}  iters={int(stats.iterations)}  "
+                  f"ok={bool(stats.success)}  "
+                  f"solve={solve_times[-1]*1e3:.1f}ms")
+
+    temps = jnp.concatenate(temps_all)
+    mdots = jnp.concatenate(mdot_all)
+    # closed-loop metrics as printed by the reference (simple_mpc.py:254-264)
+    aie_kh = float(jnp.sum(jnp.abs(temps - UB_COMFORT)) * t_sample / 3600.0)
+    energy_kwh = float(jnp.sum(mdots * (temps - 290.15)) * t_sample / 3600.0)
+    meta = {
+        "aie_kh": aie_kh,
+        "energy_kwh": energy_kwh,
+        "mean_solve_ms": 1e3 * sum(solve_times[1:]) / max(len(solve_times) - 1, 1),
+        "first_solve_ms": 1e3 * solve_times[0],
+        "all_success": all(bool(s.success) for s in stats_rows),
+        "final_T": float(x[0]),
+        "temps": temps,
+        "mdots": mdots,
+    }
+    if verbose:
+        print(f"Absolute integral error: {aie_kh:.3f} Kh.")
+        print(f"Cooling energy used: {energy_kwh:.3f} kWh.")
+        print(f"Mean solve time (warm): {meta['mean_solve_ms']:.1f} ms "
+              f"(first incl. compile: {meta['first_solve_ms']:.0f} ms)")
+    return meta
+
+
+if __name__ == "__main__":
+    run_example()

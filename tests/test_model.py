@@ -1,0 +1,188 @@
+"""Tests for the declarative model layer (variables, equations, objective)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import (
+    ChangePenaltyObjective,
+    CombinedObjective,
+    ConditionalObjective,
+    SubObjective,
+)
+from agentlib_mpc_tpu.models.variables import (
+    control_input,
+    output,
+    parameter,
+    state,
+)
+
+
+class OneRoom(Model):
+    """Single-zone cooling model with the same physics as the reference
+    example (examples/one_room_mpc/physical/simple_mpc.py:95-138)."""
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05),
+        control_input("load", 150.0),
+        control_input("T_in", 290.15),
+        control_input("T_upper", 294.15),
+    ]
+    states = [state("T", 293.15), state("T_slack", 0.0)]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        parameter("s_T", 1.0),
+        parameter("r_mDot", 1.0),
+    ]
+    outputs = [output("T_out")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
+            + SubObjective(v.T_slack**2, weight=v.s_T, name="temp_slack")
+        )
+        return eq
+
+
+@pytest.fixture(scope="module")
+def model():
+    return OneRoom(overrides={"s_T": 0.001, "r_mDot": 0.01})
+
+
+def test_structure(model):
+    assert model.diff_state_names == ["T"]
+    assert model.free_state_names == ["T_slack"]
+    assert model.n_constraints == 1
+    assert model.objective_term_names == ["control_costs", "temp_slack"]
+
+
+def test_overrides(model):
+    assert model.get_var("s_T").value == 0.001
+    # class defaults untouched
+    assert OneRoom().get_var("s_T").value == 1.0
+
+
+def test_ode_value(model):
+    x = jnp.array([298.16])
+    z = jnp.array([0.0])
+    u = model.default_vector("inputs")
+    p = model.default_vector("parameters")
+    dT = model.ode(x, z, u, p)
+    expected = 1000.0 * 0.0225 / 1e5 * (290.15 - 298.16) + 150.0 / 1e5
+    np.testing.assert_allclose(dT, [expected], rtol=1e-6)
+
+
+def test_constraint_residuals_two_sided(model):
+    x = jnp.array([298.16])
+    z = jnp.array([0.0])
+    u = model.default_vector("inputs")
+    p = model.default_vector("parameters")
+    res = model.constraint_residuals(x, z, u, p)
+    # (expr - lb, ub - expr) with expr = T + slack = 298.16, ub = 294.15
+    np.testing.assert_allclose(res, [298.16, 294.15 - 298.16], rtol=1e-6)
+
+
+def test_output_rebinding():
+    """Constraints referencing an *output* must see its algebraic value,
+    not the declared default (two-pass bind)."""
+
+    class M(Model):
+        inputs = [control_input("u", 1.0)]
+        states = [state("x", 2.0)]
+        outputs = [output("y", value=-99.0)]
+
+        def setup(self, v):
+            eq = ModelEquations()
+            eq.ode("x", -v.x + v.u)
+            eq.alg("y", 3.0 * v.x)
+            eq.constraint(0.0, v.y, 10.0)  # references the output
+            return eq
+
+    m = M()
+    res = m.constraint_residuals(jnp.array([2.0]), jnp.zeros(0),
+                                 jnp.array([1.0]), jnp.zeros(0))
+    np.testing.assert_allclose(res, [6.0, 4.0], rtol=1e-6)
+
+
+def test_simulation_cools_with_flow(model):
+    u = model.default_vector("inputs")
+    u = u.at[model.input_index("mDot")].set(0.05)
+    p = model.default_vector("parameters")
+    x0 = jnp.array([300.0])
+    x1, y = model.simulate_step(x0, u, p, dt=600.0)
+    assert float(x1[0]) < 300.0  # inflow at 290 K cools the zone
+    np.testing.assert_allclose(y, x1, rtol=1e-6)
+
+
+def test_simulation_matches_analytic(model):
+    """Linear single-state ODE has a closed form; RK4 must track it."""
+    u = model.default_vector("inputs")
+    p = model.default_vector("parameters")
+    mdot, load, t_in = 0.0225, 150.0, 290.15
+    k = 1000.0 * mdot / 1e5
+    x0 = 298.16
+    dt = 300.0
+    x_inf = t_in + load / (1000.0 * mdot)
+    expected = x_inf + (x0 - x_inf) * np.exp(-k * dt)
+    x1, _ = model.simulate_step(jnp.array([x0]), u, p, dt=dt, substeps=20)
+    np.testing.assert_allclose(x1[0], expected, rtol=1e-8)
+
+
+def test_duplicate_names_rejected():
+    class Bad(Model):
+        inputs = [control_input("a")]
+        states = [state("a")]
+
+        def setup(self, v):
+            return ModelEquations()
+
+    with pytest.raises(ValueError, match="duplicate"):
+        Bad()
+
+
+def test_unknown_override_rejected(model):
+    with pytest.raises(KeyError):
+        OneRoom(overrides={"nope": 1.0})
+
+
+def test_objective_algebra():
+    a = SubObjective(2.0, weight=3.0, name="a")  # 6
+    b = SubObjective([1.0, 2.0], weight=0.5, name="b")  # 1.5
+    combined = a + b
+    np.testing.assert_allclose(combined.value(), 7.5)
+    np.testing.assert_allclose((combined * 2.0).value(), 15.0)
+    terms = combined.term_values()
+    np.testing.assert_allclose(terms["a"], 6.0)
+    np.testing.assert_allclose(terms["b"], 1.5)
+    norm = CombinedObjective(a, b, normalization=3.0)
+    np.testing.assert_allclose(norm.value(), 2.5)
+
+
+def test_change_penalty_and_conditional():
+    cp = ChangePenaltyObjective(du=2.0, weight=0.5)
+    np.testing.assert_allclose(cp.value(), 2.0)
+    cond = ConditionalObjective(jnp.asarray(True), SubObjective(5.0),
+                                SubObjective(1.0))
+    np.testing.assert_allclose(cond.value(), 5.0)
+    cond2 = ConditionalObjective(jnp.asarray(False), SubObjective(5.0),
+                                 SubObjective(1.0))
+    np.testing.assert_allclose(cond2.value(), 1.0)
+
+
+def test_model_is_jit_and_grad_safe(model):
+    x = jnp.array([298.16])
+    z = jnp.array([0.0])
+    u = model.default_vector("inputs")
+    p = model.default_vector("parameters")
+    jitted = jax.jit(lambda xx: model.ode(xx, z, u, p))
+    np.testing.assert_allclose(jitted(x), model.ode(x, z, u, p))
+    grad = jax.grad(lambda uu: model.stage_cost(x, z, uu, p))(u)
+    # d(cost)/d(mDot) = r_mDot (fixture override 0.01)
+    assert float(grad[model.input_index("mDot")]) == pytest.approx(0.01)

@@ -1,0 +1,173 @@
+"""Tests for collocation matrices and OCP transcription.
+
+Covers the same ground as the reference's backend-construction tests
+(tests/test_casadi_backend.py: shapes, grids, system setup) plus direct
+verification of the collocation math and a full OCP solve on a problem with
+a known analytic solution (double integrator).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import SubObjective
+from agentlib_mpc_tpu.models.variables import control_input, parameter, state
+from agentlib_mpc_tpu.ops.collocation import collocation_matrices, collocation_points
+from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+
+
+class DoubleIntegrator(Model):
+    inputs = [control_input("u", 0.0, lb=-2.0, ub=2.0)]
+    states = [state("pos", 0.0), state("vel", 0.0)]
+    parameters = [parameter("r", 0.01)]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("pos", v.vel)
+        eq.ode("vel", v.u)
+        eq.objective = SubObjective(
+            (v.pos - 1.0) ** 2 + 0.1 * v.vel**2 + v.r * v.u**2, name="track")
+        return eq
+
+
+# ---- collocation matrices ----------------------------------------------------
+
+
+@pytest.mark.parametrize("method", ["radau", "legendre"])
+@pytest.mark.parametrize("degree", [1, 2, 3, 4])
+def test_quadrature_weights_integrate_polynomials(degree, method):
+    """B must integrate polynomials up to the scheme's degree exactly."""
+    taus, C, D, B = collocation_matrices(degree, method)
+    for k in range(degree + 1):
+        exact = 1.0 / (k + 1)
+        approx = float(np.sum(B * taus**k))
+        np.testing.assert_allclose(approx, exact, rtol=1e-10)
+
+
+@pytest.mark.parametrize("method", ["radau", "legendre"])
+@pytest.mark.parametrize("degree", [1, 2, 3])
+def test_derivative_matrix_differentiates_polynomials(degree, method):
+    taus, C, D, B = collocation_matrices(degree, method)
+    for k in range(degree + 1):
+        vals = taus**k
+        deriv_exact = k * taus ** max(k - 1, 0) if k > 0 else np.zeros_like(taus)
+        for col in range(1, degree + 1):
+            approx = float(np.sum(C[:, col] * vals))
+            np.testing.assert_allclose(approx, deriv_exact[col], atol=1e-9)
+
+
+def test_continuity_vector_extrapolates(capsys):
+    taus, C, D, B = collocation_matrices(3, "radau")
+    # D evaluates the interpolating polynomial at tau=1
+    for k in range(4):
+        np.testing.assert_allclose(float(np.sum(D * taus**k)), 1.0, atol=1e-9)
+
+
+def test_radau_includes_endpoint():
+    pts = collocation_points(3, "radau")
+    np.testing.assert_allclose(pts[-1], 1.0, atol=1e-12)
+
+
+def test_radau_iia_node_values():
+    """Pin the canonical Radau IIA nodes (not their left-Radau mirror)."""
+    np.testing.assert_allclose(collocation_points(1, "radau"), [1.0],
+                               atol=1e-12)
+    np.testing.assert_allclose(collocation_points(2, "radau"),
+                               [1.0 / 3.0, 1.0], atol=1e-12)
+    np.testing.assert_allclose(
+        collocation_points(3, "radau"),
+        [(4 - np.sqrt(6)) / 10, (4 + np.sqrt(6)) / 10, 1.0], atol=1e-9)
+
+
+# ---- transcription shapes ----------------------------------------------------
+
+
+@pytest.mark.parametrize("method", ["collocation", "multiple_shooting"])
+def test_sizes_and_bounds(method):
+    m = DoubleIntegrator()
+    ocp = transcribe(m, ["u"], N=5, dt=0.2, method=method,
+                     collocation_degree=2)
+    assert ocp.n_w > 0
+    theta = ocp.default_params()
+    lb, ub = ocp.bounds(theta)
+    assert lb.shape == (ocp.n_w,) and ub.shape == (ocp.n_w,)
+    w0 = ocp.initial_guess(theta)
+    assert w0.shape == (ocp.n_w,)
+    assert ocp.nlp.g(w0, theta).shape == (ocp.n_g,)
+    assert ocp.nlp.h(w0, theta).shape == (ocp.n_h,)
+    # control bounds from the Var declaration survive into the NLP bounds
+    w = ocp.unflatten(lb)
+    np.testing.assert_allclose(w["u"], -2.0 * np.ones((5, 1)))
+
+
+def test_collocation_equality_count():
+    m = DoubleIntegrator()
+    N, d, nx = 4, 3, 2
+    ocp = transcribe(m, ["u"], N=N, dt=0.1, collocation_degree=d)
+    # initial condition + defects (N*d*nx) + continuity (N*nx)
+    assert ocp.n_g == nx + N * d * nx + N * nx
+
+
+@pytest.mark.parametrize("method", ["collocation", "multiple_shooting"])
+def test_dynamics_feasibility_is_satisfiable(method):
+    """g(w)=0 must hold when w is filled from an exact simulation of the
+    dynamics under zero control (pos stays, vel stays)."""
+    m = DoubleIntegrator()
+    ocp = transcribe(m, ["u"], N=3, dt=0.1, method=method)
+    theta = ocp.default_params(x0=jnp.array([1.0, 0.0]))
+    w = ocp.unflatten(ocp.initial_guess(theta))
+    # constant state [1, 0], u = 0 is an exact trajectory
+    w["x"] = jnp.tile(jnp.array([1.0, 0.0]), (ocp.N + 1, 1))
+    w["u"] = jnp.zeros_like(w["u"])
+    if "xc" in w:
+        w["xc"] = jnp.tile(jnp.array([1.0, 0.0]), (ocp.N, w["xc"].shape[1], 1))
+    g = ocp.nlp.g(ocp.flatten(w), theta)
+    np.testing.assert_allclose(g, np.zeros_like(g), atol=1e-10)
+
+
+# ---- end-to-end OCP solves ---------------------------------------------------
+
+
+@pytest.mark.parametrize("method", ["collocation", "multiple_shooting"])
+def test_double_integrator_reaches_target(method):
+    m = DoubleIntegrator()
+    ocp = transcribe(m, ["u"], N=20, dt=0.25, method=method,
+                     collocation_degree=2)
+    theta = ocp.default_params(x0=jnp.array([0.0, 0.0]))
+    lb, ub = ocp.bounds(theta)
+    res = solve_nlp(ocp.nlp, ocp.initial_guess(theta), theta, lb, ub,
+                    SolverOptions(tol=1e-7, max_iter=150))
+    assert res.stats.success
+    traj = ocp.trajectories(res.w, theta)
+    # position must approach the target 1.0 by the end of the horizon
+    assert abs(float(traj["x"][-1, 0]) - 1.0) < 0.05
+    # control bound respected
+    assert float(jnp.max(jnp.abs(traj["u"]))) <= 2.0 + 1e-6
+
+
+def test_shift_guess_pins_new_state():
+    m = DoubleIntegrator()
+    ocp = transcribe(m, ["u"], N=4, dt=0.1)
+    theta = ocp.default_params(x0=jnp.array([0.5, 0.5]))
+    w = ocp.initial_guess(ocp.default_params())
+    shifted = ocp.unflatten(ocp.shift_guess(w, theta))
+    np.testing.assert_allclose(shifted["x"][0], [0.5, 0.5])
+
+
+def test_solve_is_vmappable():
+    """Batch of OCPs with different initial states — one compiled solve."""
+    m = DoubleIntegrator()
+    ocp = transcribe(m, ["u"], N=10, dt=0.25, collocation_degree=2)
+    x0s = jnp.array([[0.0, 0.0], [0.5, -0.5], [-0.3, 0.2]])
+
+    def solve_one(x0):
+        theta = ocp.default_params(x0=x0)
+        lb, ub = ocp.bounds(theta)
+        return solve_nlp(ocp.nlp, ocp.initial_guess(theta), theta, lb, ub,
+                         SolverOptions(tol=1e-6, max_iter=120))
+
+    res = jax.vmap(solve_one)(x0s)
+    assert bool(jnp.all(res.stats.success))
