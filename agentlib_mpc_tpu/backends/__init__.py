@@ -1,0 +1,18 @@
+"""Optimization backends (L3): transcribe + solve OCPs for the modules.
+
+Registry pattern mirroring the reference's
+``optimization_backends/__init__.py:23-64`` string→class table, minus the
+import indirection. The reference ships casadi/casadi_admm/casadi_ml/...;
+the JAX backend family covers the same matrix (aliases for the reference's
+type strings are registered so its configs keep working).
+"""
+
+from agentlib_mpc_tpu.backends.backend import (
+    OptimizationBackend,
+    VariableReference,
+    backend_types,
+    create_backend,
+    load_model,
+    register_backend,
+)
+from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend

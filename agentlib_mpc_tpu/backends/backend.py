@@ -1,0 +1,128 @@
+"""Backend ABC, variable reference, registry, and model loading.
+
+Counterpart of the reference's ``optimization_backends/backend.py``
+(BackendConfig :26-79, OptimizationBackend :82-218): a backend is
+constructed from the module's ``optimization_backend`` config dict, is
+handed a `VariableReference` describing which module variables play which
+OCP role, compiles the problem once (``setup_optimization``), and then
+serves repeated ``solve(now, variables)`` calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import logging
+from typing import Any, Optional, Type
+
+from agentlib_mpc_tpu.models.model import Model
+
+logger = logging.getLogger(__name__)
+
+backend_types: dict[str, Type["OptimizationBackend"]] = {}
+
+
+def register_backend(*names: str):
+    def deco(cls):
+        for n in names:
+            backend_types[n] = cls
+        return cls
+    return deco
+
+
+def create_backend(config: dict) -> "OptimizationBackend":
+    type_key = config.get("type", "jax")
+    if isinstance(type_key, dict):
+        spec = importlib.util.spec_from_file_location("_custom_backend",
+                                                      type_key["file"])
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cls = getattr(mod, type_key["class_name"])
+    else:
+        if type_key not in backend_types:
+            raise KeyError(f"unknown backend type {type_key!r}; known: "
+                           f"{sorted(backend_types)}")
+        cls = backend_types[type_key]
+    return cls(config)
+
+
+@dataclasses.dataclass
+class VariableReference:
+    """Names of the module variables in each OCP role (reference
+    ``data_structures/mpc_datamodels.py`` VariableReference)."""
+
+    states: list[str] = dataclasses.field(default_factory=list)
+    controls: list[str] = dataclasses.field(default_factory=list)
+    inputs: list[str] = dataclasses.field(default_factory=list)
+    parameters: list[str] = dataclasses.field(default_factory=list)
+    outputs: list[str] = dataclasses.field(default_factory=list)
+    binary_controls: list[str] = dataclasses.field(default_factory=list)
+
+    def all_names(self) -> list[str]:
+        return [*self.states, *self.controls, *self.inputs,
+                *self.parameters, *self.outputs, *self.binary_controls]
+
+
+def load_model(model_cfg: dict | Model, dt: float | None = None) -> Model:
+    """Instantiate the model named by a config dict.
+
+    Accepts: a Model instance; {"class": ModelClass, ...}; or the
+    reference-style custom injection {"type": {"file": ..., "class_name":
+    ...}, <group overrides>} (``casadi_backend.py`` model loading via
+    agentlib custom_injection).
+    Overrides: any "states"/"inputs"/"parameters"/"outputs" lists of
+    {"name", "value"} entries set initial/default values.
+    """
+    if isinstance(model_cfg, Model):
+        return model_cfg
+    model_cfg = dict(model_cfg)
+    cls = model_cfg.get("class")
+    if cls is None:
+        type_key = model_cfg.get("type")
+        if isinstance(type_key, dict):
+            spec = importlib.util.spec_from_file_location(
+                "_custom_model", type_key["file"])
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            cls = getattr(mod, type_key["class_name"])
+        else:
+            raise KeyError(
+                "model config needs 'class' or {'type': {'file', "
+                "'class_name'}}")
+    overrides: dict[str, float] = {}
+    for group in ("states", "inputs", "parameters", "outputs"):
+        for entry in model_cfg.get(group, []):
+            if "value" in entry:
+                overrides[entry["name"]] = entry["value"]
+    return cls(overrides=overrides or None, dt=dt)
+
+
+class OptimizationBackend:
+    """Abstract backend. Subclasses implement setup_optimization/solve."""
+
+    def __init__(self, config: dict):
+        self.config = dict(config)
+        self.var_ref: Optional[VariableReference] = None
+        self.model: Optional[Model] = None
+        self.stats_history: list[dict] = []
+        self.logger = logger
+
+    def register_logger(self, lg: logging.Logger) -> None:
+        """Reference contract: the owning module injects its logger
+        (``optimization_backends/backend.py:102-104``)."""
+        self.logger = lg
+
+    def setup_optimization(self, var_ref: VariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        raise NotImplementedError
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        """variables: name → current value (scalar or trajectory).
+        Returns a result dict with at least 'u0' (first controls, by name),
+        'traj' (full trajectories), 'stats'."""
+        raise NotImplementedError
+
+    def get_lags_per_variable(self) -> dict[str, int]:
+        """name → number of past samples the backend needs (NARX models;
+        reference ``casadi_ml.py:388-397``). Default: none."""
+        return {}

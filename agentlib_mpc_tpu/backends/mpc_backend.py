@@ -1,0 +1,184 @@
+"""Central-MPC backend: one jitted transcribe+solve pipeline.
+
+The counterpart of the reference's CasADi backend core
+(``optimization_backends/casadi_/core/casadi_backend.py``: setup :108-131,
+solve :133-139, per-solve input sampling :141-253) and its basic/full
+system variants (``casadi_/basic.py``, ``casadi_/full.py`` — the Δu change
+penalty arrives here via the model's ``v.du``). Where the reference drives
+a C++ IPOPT process per solve, this backend compiles the whole step — input
+splicing, warm start, interior-point solve, trajectory extraction, shift —
+into a single XLA computation held hot across the closed loop.
+
+Accepts the reference's config keys (``discretization_options``,
+``solver``, ``results_file``/``save_results``) with native equivalents.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.backends.backend import (
+    OptimizationBackend,
+    VariableReference,
+    load_model,
+    register_backend,
+)
+from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.utils.sampling import InterpolationMethods, sample
+
+
+def solver_options_from_config(cfg: dict) -> SolverOptions:
+    """Translate a reference-style solver config into SolverOptions.
+    Unknown keys (e.g. the reference's ipopt-specific options) are ignored
+    so existing configs keep working."""
+    cfg = dict(cfg or {})
+    cfg.pop("name", None)  # reference: solver name (ipopt/fatrop/...)
+    cfg.pop("options", None)
+    known = SolverOptions._fields
+    return SolverOptions(**{k: v for k, v in cfg.items() if k in known})
+
+
+@register_backend("jax", "jax_full", "casadi", "casadi_basic")
+class JAXBackend(OptimizationBackend):
+    """Central MPC: states/controls/inputs/params against one model."""
+
+    def setup_optimization(self, var_ref: VariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        self.var_ref = var_ref
+        self.time_step = float(time_step)
+        self.N = int(prediction_horizon)
+        self.model = load_model(self.config["model"])
+        disc = dict(self.config.get("discretization_options", {}))
+        method = disc.get("method", "collocation")
+        if method == "multiple_shooting":
+            trans_kwargs = dict(
+                method="multiple_shooting",
+                integrator=disc.get("integrator", "rk4"),
+                integrator_substeps=int(disc.get("integrator_substeps", 3)),
+            )
+        else:
+            trans_kwargs = dict(
+                method="collocation",
+                collocation_degree=int(disc.get("collocation_order", 3)),
+                collocation_method=disc.get("collocation_method", "radau"),
+            )
+        self.ocp = transcribe(self.model, var_ref.controls, N=self.N,
+                              dt=self.time_step, **trans_kwargs)
+        self.solver_options = solver_options_from_config(
+            self.config.get("solver"))
+        self._exo_names = list(self.ocp.exo_names)
+        self._build_step_fn()
+        self._reset_warm_start()
+
+    # -- compiled pipeline ----------------------------------------------------
+
+    def _build_step_fn(self) -> None:
+        ocp = self.ocp
+        opts = self.solver_options
+
+        @jax.jit
+        def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                 w_guess, y_guess, z_guess, mu0, t0):
+            theta = ocp.default_params(
+                x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
+                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
+            lb, ub = ocp.bounds(theta)
+            res = solve_nlp(ocp.nlp, w_guess, theta, lb, ub, opts,
+                            y0=y_guess, z0=z_guess, mu0=mu0)
+            traj = ocp.trajectories(res.w, theta)
+            u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+            w_next = ocp.shift_guess(res.w, theta)
+            return u0, traj, w_next, res.y, res.z, res.stats
+
+        self._step = step
+
+    def _reset_warm_start(self) -> None:
+        theta0 = self.ocp.default_params()
+        self._w_guess = self.ocp.initial_guess(theta0)
+        self._y_guess = jnp.zeros((self.ocp.n_g,))
+        self._z_guess = jnp.full((self.ocp.n_h,), 0.1).astype(
+            self._w_guess.dtype)
+        self._cold = True
+
+    # -- per-solve input assembly (host side) ---------------------------------
+
+    def _collect(self, now: float, variables: dict[str, Any]):
+        model = self.model
+        vr = self.var_ref
+        N = self.N
+        grid_u = np.arange(N) * self.time_step
+
+        def val_of(name, default):
+            v = variables.get(name)
+            return default if v is None else v
+
+        x0 = np.array([
+            float(np.asarray(val_of(n, model.get_var(n).value)).reshape(-1)[0])
+            for n in model.diff_state_names])
+        u_prev = np.array([
+            float(np.asarray(val_of(n, model.get_var(n).value)).reshape(-1)[0])
+            for n in vr.controls]) if vr.controls else np.zeros(0)
+
+        d_traj = np.zeros((N, len(self._exo_names)))
+        for j, name in enumerate(self._exo_names):
+            d_traj[:, j] = sample(val_of(name, model.get_var(name).value),
+                                  grid_u, current=now)
+
+        p = np.array([float(val_of(n, model.get_var(n).value))
+                      for n in model.parameter_names])
+
+        def bound_traj(names, grid, kind):
+            out = np.zeros((len(grid), len(names)))
+            for j, n in enumerate(names):
+                b = variables.get(f"{n}__{kind}")
+                if b is None:
+                    b = getattr(model.get_var(n), kind)
+                out[:, j] = sample(b, grid, current=now)
+            return out
+
+        grid_x = np.arange(N + 1) * self.time_step
+        x_lb = bound_traj(model.diff_state_names, grid_x, "lb")
+        x_ub = bound_traj(model.diff_state_names, grid_x, "ub")
+        u_lb = bound_traj(vr.controls, grid_u, "lb")
+        u_ub = bound_traj(vr.controls, grid_u, "ub")
+        return x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub = \
+            self._collect(now, variables)
+        mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
+                          dtype=self._w_guess.dtype)
+        t_start = _time.perf_counter()
+        u0, traj, w_next, y_next, z_next, stats = self._step(
+            x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+            self._w_guess, self._y_guess, self._z_guess, mu0,
+            jnp.asarray(float(now)))
+        u0.block_until_ready()
+        wall = _time.perf_counter() - t_start
+        self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
+        self._cold = False
+
+        stats_row = {
+            "time": float(now),
+            "iterations": int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+        }
+        self.stats_history.append(stats_row)
+        if not stats_row["success"]:
+            self.logger.warning("solve at t=%s did not converge (kkt=%.2e)",
+                                now, stats_row["kkt_error"])
+        return {
+            "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+            "stats": stats_row,
+        }

@@ -209,7 +209,12 @@ class Model:
             du_map = {n: du[i] for i, n in enumerate(self.input_names)}
         ns = self._make_ns(values, du=du_map, t=t)
         eq = self.setup(ns)
-        if eq.outputs:
+        # one extra pass per declared output resolves chains of
+        # output-to-output references (A=f(x), B=g(A), C=h(B), ...); XLA
+        # dedupes the repeated tracing
+        for _ in range(len(self.outputs)):
+            if not eq.outputs:
+                break
             values = dict(values)
             for name, expr in eq.outputs.items():
                 values[name] = jnp.asarray(expr)

@@ -1,0 +1,9 @@
+"""Agent modules (L4): control logic on top of the runtime and backends.
+
+Registry mirrors the reference's MODULE_TYPES
+(``agentlib_mpc/modules/__init__.py:21-79``). Importing this package
+registers all module types.
+"""
+
+from agentlib_mpc_tpu.modules.mpc import BaseMPC, MPC
+from agentlib_mpc_tpu.modules.simulator import Simulator

@@ -1,0 +1,180 @@
+"""Central MPC module.
+
+Re-design of the reference's BaseMPC/MPC
+(``modules/mpc/mpc.py``: config :31-107, backend creation :110-143,
+do_step :322-340, set_actuation :342-357, process :273-276,
+re_init_optimization :297-302; lag handling in ``mpc_full.py``): the module
+owns an optimization backend, wakes every ``time_step``, collects live
+variable values from its store, calls ``backend.solve``, actuates the first
+control (clipped to bounds) and optionally publishes the full predicted
+trajectories.
+
+Results are recorded per step as (time, horizon-grid) rows, matching the
+reference's MultiIndex CSV layout (``discretization.py:398-484``), with a
+separate per-solve stats table (``casadi_backend.py:295-307``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu.backends.backend import VariableReference, create_backend
+from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+
+logger = logging.getLogger(__name__)
+
+
+@register_module("mpc", "mpc_basic")
+class BaseMPC(BaseModule):
+    """Periodic control loop: collect vars → solve OCP → actuate u[0]."""
+
+    variable_groups = ("inputs", "outputs", "states", "parameters",
+                      "controls", "binary_controls")
+    #: controls are actuation commands other agents (the plant) consume
+    shared_groups = ("outputs", "controls")
+
+    def __init__(self, config: dict, agent):
+        super().__init__(config, agent)
+        self.time_step = float(config.get("time_step", 60.0))
+        self.prediction_horizon = int(config.get("prediction_horizon", 10))
+        self.backend = create_backend(config["optimization_backend"])
+        self.backend.register_logger(self.logger)
+        self._history_rows: list[dict] = []
+        self._setup_backend()
+
+    def _setup_backend(self) -> None:
+        self.var_ref = VariableReference(
+            states=self._groups.get("states", []),
+            controls=self._groups.get("controls", []),
+            inputs=self._groups.get("inputs", []),
+            parameters=self._groups.get("parameters", []),
+            outputs=self._groups.get("outputs", []),
+            binary_controls=self._groups.get("binary_controls", []),
+        )
+        # load the model once, validate, and hand the instance to the
+        # backend (load_model passes instances through)
+        from agentlib_mpc_tpu.backends.backend import load_model
+
+        model = load_model(self.backend.config["model"])
+        self._assert_config_matches_model(model)
+        self.backend.config["model"] = model
+        self.backend.setup_optimization(
+            self.var_ref, self.time_step, self.prediction_horizon)
+
+    def _assert_config_matches_model(self, model) -> None:
+        """Validate module variables against the model, like the reference's
+        config validation (``mpc.py:200-271``)."""
+        errors = []
+        for name in (*self.var_ref.controls, *self.var_ref.inputs):
+            if name not in model.input_names:
+                errors.append(f"{name!r} is not a model input")
+        for name in self.var_ref.states:
+            if name not in model.state_names:
+                errors.append(f"{name!r} is not a model state")
+        for name in self.var_ref.parameters:
+            if name not in model.parameter_names:
+                errors.append(f"{name!r} is not a model parameter")
+        for name in self.var_ref.outputs:
+            if name not in model.output_names:
+                errors.append(f"{name!r} is not a model output")
+        if errors:
+            raise ValueError(
+                f"MPC config does not match model: {'; '.join(errors)}")
+
+    # -- control loop ---------------------------------------------------------
+
+    def process(self):
+        while True:
+            self.do_step()
+            yield self.time_step
+
+    def do_step(self) -> None:
+        variables = self.collect_variables_for_optimization()
+        result = self.backend.solve(self.env.now, variables)
+        self.set_actuation(result)
+        self._record(result)
+
+    def collect_variables_for_optimization(self) -> dict:
+        """Current value of every referenced variable, plus per-variable
+        bound channels (``name__lb``/``name__ub``) from the declarations."""
+        out = {}
+        for name in self.var_ref.all_names():
+            var = self.vars[name]
+            out[name] = var.value
+            out[f"{name}__lb"] = var.lb
+            out[f"{name}__ub"] = var.ub
+        return out
+
+    def set_actuation(self, result: dict) -> None:
+        """Publish the first control of the optimal sequence (clipped —
+        reference ``set_actuation``, ``mpc.py:342-357``)."""
+        for name, value in result["u0"].items():
+            var = self.vars[name]
+            self.set(name, float(np.clip(value, var.lb, var.ub)))
+
+    def _record(self, result: dict) -> None:
+        traj = result["traj"]
+        self._history_rows.append({
+            "time": float(self.env.now),
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+        })
+
+    # -- results --------------------------------------------------------------
+
+    def results(self):
+        """MultiIndex (time, grid-offset) DataFrame with ('variable', name)
+        columns — the reference's results layout
+        (``discretization.py:398-484``, loaded by ``utils/analysis.py``)."""
+        import pandas as pd
+
+        if not self._history_rows:
+            return None
+        model = self.backend.model
+        frames = []
+        for row in self._history_rows:
+            traj = row["traj"]
+            grid = np.asarray(traj["time_state"]) - row["time"]
+            data = {}
+            for i, n in enumerate(model.diff_state_names):
+                data[("variable", n)] = np.asarray(traj["x"])[:, i]
+            for i, n in enumerate(self.var_ref.controls):
+                u = np.asarray(traj["u"])[:, i]
+                data[("variable", n)] = np.append(u, np.nan)
+            for i, n in enumerate(model.output_names):
+                data[("variable", n)] = np.asarray(traj["y"])[:, i]
+            for i, n in enumerate(model.free_state_names):
+                z = np.asarray(traj["z"])[:, i]
+                data[("variable", n)] = np.append(z, np.nan)
+            df = pd.DataFrame(data)
+            df.index = pd.MultiIndex.from_product(
+                [[row["time"]], grid], names=["time", "grid"])
+            frames.append(df)
+        out = pd.concat(frames)
+        out.columns = pd.MultiIndex.from_tuples(out.columns)
+        return out
+
+    def solver_stats(self):
+        import pandas as pd
+
+        if not self.backend.stats_history:
+            return None
+        return pd.DataFrame(self.backend.stats_history).set_index("time")
+
+    def cleanup_results(self) -> None:
+        self._history_rows.clear()
+        self.backend.stats_history.clear()
+
+    def re_init_optimization(self) -> None:
+        """Rebuild the backend (reference ``re_init_optimization``,
+        ``mpc.py:297-302``) — e.g. after a runtime horizon change."""
+        self._setup_backend()
+
+
+@register_module("mpc_full")
+class MPC(BaseMPC):
+    """Alias of the full MPC (the reference's ``mpc`` type adds NARX lag
+    history on top of BaseMPC; lag collection lives in the ML backend
+    here — see backends/ml_backend)."""

@@ -1,0 +1,17 @@
+"""Agent runtime — the framework's replacement for the AgentLib core (L0).
+
+The reference is a *plugin* for the external `agentlib` package (Agent,
+BaseModule, DataBroker, simpy Environment, communicators, MAS runners —
+SURVEY.md §1 L0). This package re-implements that substrate natively and
+minimally: typed agent variables with alias/source addressing, a
+callback-driven data broker with an in-process broadcast bus, a
+discrete-event / real-time clock, module lifecycle, and a LocalMAS runner
+whose JSON-shaped configs mirror the reference's agent configs.
+"""
+
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+from agentlib_mpc_tpu.runtime.environment import Environment
+from agentlib_mpc_tpu.runtime.broker import DataBroker, BroadcastBus
+from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+from agentlib_mpc_tpu.runtime.agent import Agent
+from agentlib_mpc_tpu.runtime.mas import LocalMAS

@@ -1,0 +1,49 @@
+"""Agent: a named bundle of modules sharing a data broker.
+
+Replaces agentlib's Agent (``modules/mpc/mpc.py:9``): holds the per-agent
+DataBroker, instantiates modules from config dicts, and wires their
+processes into the environment.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from agentlib_mpc_tpu.runtime.broker import DataBroker
+from agentlib_mpc_tpu.runtime.environment import Environment
+from agentlib_mpc_tpu.runtime.module import BaseModule, create_module
+
+logger = logging.getLogger(__name__)
+
+
+class Agent:
+    def __init__(self, config: dict, env: Environment):
+        self.id = config["id"]
+        self.env = env
+        self.config = config
+        self.data_broker = DataBroker(self.id)
+        self.modules: dict[str, BaseModule] = {}
+        for mod_cfg in config.get("modules", []):
+            # communicator entries of the reference configs ("local",
+            # "local_broadcast", ...) are subsumed by the LocalMAS bus; accept
+            # and skip them for config compatibility
+            if mod_cfg.get("type") in ("local", "local_broadcast",
+                                       "multiprocessing_broadcast", "mqtt"):
+                continue
+            module = create_module(mod_cfg, self)
+            if module.id in self.modules:
+                raise ValueError(
+                    f"duplicate module_id {module.id!r} in agent {self.id}")
+            self.modules[module.id] = module
+
+    def start(self) -> None:
+        for module in self.modules.values():
+            module.register_callbacks()
+        for module in self.modules.values():
+            gen = module.process()
+            if gen is not None:
+                self.env.process(gen)
+
+    def get_module(self, module_id: str) -> BaseModule:
+        return self.modules[module_id]

@@ -1,0 +1,69 @@
+"""Data broker: callback pub/sub for agent variables.
+
+Replaces agentlib's DataBroker + communicator modules (the reference's
+distributed communication backend, SURVEY.md §2.9): modules register
+callbacks on (alias, source) and send AgentVariables
+(``modules/mpc/mpc.py:281-284``, ``modules/dmpc/admm/admm.py:605-610``);
+``local_broadcast`` communicators forward shared variables between agents.
+
+Here every agent owns a `DataBroker`; a process-wide `BroadcastBus` links
+brokers in one LocalMAS (the in-process fast path). The same broker API is
+the seam for cross-process/MQTT interop communicators later — exactly the
+reference's layering (fast path vs interop path).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Callable, Optional
+
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+logger = logging.getLogger(__name__)
+
+Callback = Callable[[AgentVariable], None]
+
+
+class DataBroker:
+    """Per-agent variable router."""
+
+    def __init__(self, agent_id: str):
+        self.agent_id = agent_id
+        self._subs: list[tuple[str, Source, Callback]] = []
+        self._bus: Optional["BroadcastBus"] = None
+
+    def register_callback(self, alias: str, source, callback: Callback) -> None:
+        self._subs.append((alias, Source.coerce(source), callback))
+
+    def deregister_callback(self, alias: str, source, callback: Callback) -> None:
+        key = (alias, Source.coerce(source), callback)
+        self._subs = [s for s in self._subs if s != key]
+
+    def send_variable(self, var: AgentVariable, from_external: bool = False) -> None:
+        """Deliver to local subscribers; forward shared vars to the bus."""
+        for alias, source, cb in list(self._subs):
+            if alias == var.alias and source.matches(var.source):
+                cb(var)
+        if var.shared and not from_external and self._bus is not None:
+            self._bus.broadcast(self.agent_id, var)
+
+    def attach_bus(self, bus: "BroadcastBus") -> None:
+        self._bus = bus
+
+
+class BroadcastBus:
+    """In-process broadcast linking all agents of a LocalMAS — the
+    replacement for the reference's `local_broadcast` communicator."""
+
+    def __init__(self):
+        self._brokers: dict[str, DataBroker] = {}
+
+    def join(self, broker: DataBroker) -> None:
+        self._brokers[broker.agent_id] = broker
+        broker.attach_bus(self)
+
+    def broadcast(self, from_agent: str, var: AgentVariable) -> None:
+        for agent_id, broker in self._brokers.items():
+            if agent_id != from_agent:
+                broker.send_variable(var, from_external=True)

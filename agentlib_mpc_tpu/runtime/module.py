@@ -1,0 +1,159 @@
+"""Module base class and registry.
+
+Replaces agentlib's BaseModule/BaseModuleConfig contract that every
+reference module builds on (``modules/mpc/mpc.py:9-14``): a module is
+instantiated from a JSON-shaped config dict, owns a typed variable store,
+receives variable updates through broker callbacks, and contributes a
+``process()`` generator to the environment.
+
+Config shape (compatible with the reference's agent configs):
+    {"module_id": "myMPC", "type": "mpc", <scalar options...>,
+     "inputs": [{...var...}], "outputs": [...], ...}
+
+Module classes declare which config keys are variable groups
+(``variable_groups``) and which groups are broadcast by default
+(``shared_groups``). String type keys resolve through MODULE_TYPES —
+the reference's registry pattern (``modules/__init__.py:21-79``) without
+the import indirection.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Iterable, Optional, Type
+
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+logger = logging.getLogger(__name__)
+
+MODULE_TYPES: dict[str, Type["BaseModule"]] = {}
+
+
+def register_module(*names: str):
+    def deco(cls):
+        for n in names:
+            MODULE_TYPES[n] = cls
+        cls.type_names = names
+        return cls
+    return deco
+
+
+def create_module(config: dict, agent) -> "BaseModule":
+    type_key = config.get("type")
+    if isinstance(type_key, dict):
+        # custom injection: {"file": path, "class_name": X} — the reference's
+        # custom_injection hook (modules/mpc/mpc.py:120-122)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_custom_module",
+                                                      type_key["file"])
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cls = getattr(mod, type_key["class_name"])
+    else:
+        if type_key not in MODULE_TYPES:
+            raise KeyError(
+                f"unknown module type {type_key!r}; known: "
+                f"{sorted(MODULE_TYPES)}")
+        cls = MODULE_TYPES[type_key]
+    return cls(config, agent)
+
+
+class BaseModule:
+    """Base for all agent modules."""
+
+    #: config keys parsed as lists of AgentVariables
+    variable_groups: tuple[str, ...] = ("inputs", "outputs", "states",
+                                        "parameters")
+    #: groups whose variables default to shared=True (broadcast)
+    shared_groups: tuple[str, ...] = ("outputs",)
+    type_names: tuple[str, ...] = ()
+
+    def __init__(self, config: dict, agent):
+        self.config = dict(config)
+        self.agent = agent
+        self.id = config.get("module_id", type(self).__name__)
+        self.env = agent.env
+        self.logger = logging.getLogger(
+            f"{type(self).__name__}[{agent.id}/{self.id}]")
+        self.vars: dict[str, AgentVariable] = {}
+        for group in self.variable_groups:
+            for cfg in config.get(group, []):
+                var = AgentVariable.from_config(cfg)
+                # group default shared=True applies only when the config
+                # did not set the flag explicitly (dict without "shared");
+                # an AgentVariable instance always carries its own choice
+                explicit = isinstance(cfg, AgentVariable) or (
+                    isinstance(cfg, dict) and "shared" in cfg)
+                if group in self.shared_groups and not explicit:
+                    var.shared = True
+                self._declare(var, group)
+        self._groups: dict[str, list[str]] = {
+            g: [AgentVariable.from_config(c).name for c in config.get(g, [])]
+            for g in self.variable_groups}
+
+    # -- variable store -------------------------------------------------------
+
+    def _declare(self, var: AgentVariable, group: str) -> None:
+        if var.name in self.vars:
+            raise ValueError(
+                f"duplicate variable {var.name!r} in module {self.id}")
+        self.vars[var.name] = var
+
+    def variables_in_group(self, group: str) -> list[AgentVariable]:
+        return [self.vars[n] for n in self._groups.get(group, [])]
+
+    def get(self, name: str) -> AgentVariable:
+        return self.vars[name]
+
+    def get_value(self, name: str):
+        return self.vars[name].value
+
+    def set(self, name: str, value) -> None:
+        """Update a variable and publish it to the broker (the reference's
+        ``self.set(...)`` → data_broker.send_variable path)."""
+        var = self.vars[name]
+        var.value = value
+        var.timestamp = self.env.now
+        out = var.copy(source=Source(agent_id=self.agent.id,
+                                     module_id=self.id))
+        self.agent.data_broker.send_variable(out)
+
+    def send(self, var: AgentVariable) -> None:
+        """Publish an ad-hoc variable (not necessarily declared)."""
+        out = var.copy(source=Source(agent_id=self.agent.id,
+                                     module_id=self.id))
+        out.timestamp = self.env.now
+        self.agent.data_broker.send_variable(out)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def register_callbacks(self) -> None:
+        """Subscribe to updates for declared variables that reference an
+        external source or alias. Default: every variable whose config gave
+        an explicit source, or whose alias differs from its name, is
+        listened for; received values update the local store."""
+        for var in self.vars.values():
+            explicit_source = var.source.agent_id is not None \
+                or var.source.module_id is not None
+            if explicit_source or var.alias != var.name or not var.shared:
+                self.agent.data_broker.register_callback(
+                    var.alias, var.source, self._make_update_callback(var.name))
+
+    def _make_update_callback(self, name: str):
+        def _cb(incoming: AgentVariable):
+            local = self.vars[name]
+            local.value = incoming.value
+            local.timestamp = incoming.timestamp
+        return _cb
+
+    def process(self):
+        """Override: generator yielding delays (seconds). Default: inert."""
+        return None
+
+    def cleanup_results(self) -> None:
+        pass
+
+    def results(self):
+        """Override: return a pandas DataFrame of recorded results."""
+        return None

@@ -1,0 +1,180 @@
+"""Integration: the one-room cooling MPC as a two-agent MAS.
+
+This is the reference's flagship closed-loop wiring
+(``examples/one_room_mpc/physical/simple_mpc.py``: AGENT_MPC + AGENT_SIM on
+a LocalMASAgency) rebuilt on the native runtime: MPC agent solves and
+broadcasts ``mDot``; simulator agent integrates the plant and broadcasts
+its temperature back under alias ``T``.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from examples.one_room_mpc import OneRoom
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+
+UB = 295.15
+
+AGENT_MPC = {
+    "id": "myMPCAgent",
+    "modules": [
+        {"module_id": "Ag1Com", "type": "local_broadcast"},
+        {
+            "module_id": "myMPC",
+            "type": "mpc",
+            "optimization_backend": {
+                "type": "jax",
+                "model": {"class": OneRoom},
+                "discretization_options": {
+                    "collocation_order": 2,
+                    "collocation_method": "legendre",
+                },
+                "solver": {"max_iter": 60},
+            },
+            "time_step": 300,
+            "prediction_horizon": 15,
+            "parameters": [
+                {"name": "s_T", "value": 0.001},
+                {"name": "r_mDot", "value": 0.01},
+            ],
+            "inputs": [
+                {"name": "T_in", "value": 290.15},
+                {"name": "load", "value": 150},
+                {"name": "T_upper", "value": UB},
+            ],
+            "controls": [{"name": "mDot", "value": 0.02, "ub": 0.05, "lb": 0}],
+            "outputs": [{"name": "T_out"}],
+            "states": [
+                {"name": "T", "value": 298.16, "ub": 303.15, "lb": 288.15,
+                 "alias": "T", "source": "SimAgent"},
+            ],
+        },
+    ],
+}
+
+AGENT_SIM = {
+    "id": "SimAgent",
+    "modules": [
+        {"module_id": "Ag1Com", "type": "local_broadcast"},
+        {
+            "module_id": "room",
+            "type": "simulator",
+            "model": {"class": OneRoom,
+                      "states": [{"name": "T", "value": 298.16}]},
+            "t_sample": 10,
+            "outputs": [{"name": "T_out", "value": 298, "alias": "T"}],
+            "inputs": [{"name": "mDot", "value": 0.02, "alias": "mDot"}],
+        },
+    ],
+}
+
+
+@pytest.fixture(scope="module")
+def results():
+    mas = LocalMAS([AGENT_MPC, AGENT_SIM], env={"rt": False})
+    mas.run(until=3600)
+    res = mas.get_results()
+    res["_mas"] = mas
+    return res
+
+
+def test_results_shape(results):
+    mpc_df = results["myMPCAgent"]["myMPC"]
+    assert mpc_df.index.names == ["time", "grid"]
+    assert ("variable", "T") in mpc_df.columns
+    assert ("variable", "mDot") in mpc_df.columns
+    sim_df = results["SimAgent"]["room"]
+    assert "T_out" in sim_df.columns and "mDot" in sim_df.columns
+
+
+def test_room_cools_toward_band(results):
+    sim_df = results["SimAgent"]["room"]
+    assert sim_df["T_out"].iloc[-1] < 296.2
+    assert sim_df["T_out"].iloc[-1] < sim_df["T_out"].iloc[0]
+
+
+def test_actuation_crosses_agents(results):
+    """The mDot the simulator integrates must be the MPC's command."""
+    sim_df = results["SimAgent"]["room"]
+    assert sim_df["mDot"].std() > 0  # changed over time
+    assert sim_df["mDot"].max() <= 0.05 + 1e-9
+
+
+def test_solver_stats_recorded(results):
+    mas = results["_mas"]
+    stats = mas.agents["myMPCAgent"].get_module("myMPC").solver_stats()
+    assert stats is not None
+    assert bool(stats["success"].all())
+    assert (stats["iterations"] < 60).all()
+
+
+def test_mpc_sees_simulated_state(results):
+    """The MPC's recorded x trajectory must track the simulator (not its
+    stale initial value)."""
+    mpc_df = results["myMPCAgent"]["myMPC"]
+    t_last = mpc_df.index.get_level_values("time").max()
+    x0_last = mpc_df.loc[t_last][("variable", "T")].iloc[0]
+    sim_df = results["SimAgent"]["room"]
+    sim_at = sim_df["T_out"][sim_df.index <= t_last].iloc[-1]
+    assert abs(x0_last - sim_at) < 0.2
+
+
+def test_simulator_parameter_override_via_module_config():
+    """Module-level parameter values must reach the integrator (review
+    regression: defaults were always used)."""
+    from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+    def make(C):
+        return {"id": "s", "modules": [{
+            "module_id": "room", "type": "simulator",
+            "model": {"class": OneRoom,
+                      "states": [{"name": "T", "value": 298.16}]},
+            "t_sample": 100,
+            "parameters": [{"name": "C", "value": C}],
+            "outputs": [{"name": "T_out"}],
+            "inputs": [{"name": "mDot", "value": 0.05}],
+        }]}
+
+    res = {}
+    for C in (1e5, 2e4):
+        mas = LocalMAS([make(C)])
+        mas.run(until=600)
+        res[C] = mas.get_results()["s"]["room"]["T_out"].iloc[-1]
+    # smaller capacity → faster cooling → lower final temperature
+    assert res[2e4] < res[1e5] - 0.1
+
+
+def test_simulator_timestamps_match_state_validity():
+    """Measurements are published at t+dt, the time the integrated state is
+    valid (review regression: published at t with the t+dt state)."""
+    from agentlib_mpc_tpu.runtime.mas import LocalMAS
+    from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+
+    received = []
+
+    @register_module("_test_listener")
+    class Listener(BaseModule):
+        def register_callbacks(self):
+            self.agent.data_broker.register_callback(
+                "T", None, lambda v: received.append((v.timestamp, v.value)))
+
+    mas = LocalMAS([
+        {"id": "s", "modules": [{
+            "module_id": "room", "type": "simulator",
+            "model": {"class": OneRoom,
+                      "states": [{"name": "T", "value": 298.16}]},
+            "t_sample": 50,
+            "outputs": [{"name": "T_out", "alias": "T"}],
+            "inputs": [{"name": "mDot", "value": 0.02}]}]},
+        {"id": "l", "modules": [{"module_id": "x", "type": "_test_listener"}]},
+    ])
+    mas.run(until=200)
+    assert received, "listener got no measurements"
+    times = [t for t, _ in received]
+    assert times[0] == 50.0 and times == sorted(times)

@@ -186,3 +186,29 @@ def test_model_is_jit_and_grad_safe(model):
     grad = jax.grad(lambda uu: model.stage_cost(x, z, uu, p))(u)
     # d(cost)/d(mDot) = r_mDot (fixture override 0.01)
     assert float(grad[model.input_index("mDot")]) == pytest.approx(0.01)
+
+
+def test_chained_output_references_resolve():
+    """Outputs referencing other outputs must see final values (review
+    regression: one-pass rebinding truncated chains)."""
+
+    class Chained(Model):
+        inputs = [control_input("u", 1.0)]
+        states = [state("x", 1.0)]
+        outputs = [output("A"), output("B"), output("C")]
+
+        def setup(self, v):
+            eq = ModelEquations()
+            eq.ode("x", -v.x)
+            eq.alg("A", 2.0 * v.x)
+            eq.alg("B", v.A + 1.0)
+            eq.alg("C", v.B * 3.0)
+            eq.constraint(0.0, v.B, 10.0)
+            return eq
+
+    m = Chained()
+    y = m.output(jnp.array([1.0]), jnp.zeros(0), jnp.array([1.0]), jnp.zeros(0))
+    np.testing.assert_allclose(y, [2.0, 3.0, 9.0])
+    res = m.constraint_residuals(jnp.array([1.0]), jnp.zeros(0),
+                                 jnp.array([1.0]), jnp.zeros(0))
+    np.testing.assert_allclose(res, [3.0, 7.0])

@@ -1,0 +1,168 @@
+"""Unit tests for the agent runtime (environment, broker, modules)."""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.runtime.broker import BroadcastBus, DataBroker
+from agentlib_mpc_tpu.runtime.environment import Environment
+from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+
+def test_environment_runs_processes_in_time_order():
+    env = Environment()
+    log = []
+
+    def proc(name, dt):
+        while True:
+            log.append((env.now, name))
+            yield dt
+
+    env.process(proc("a", 10.0))
+    env.process(proc("b", 15.0))
+    env.run(until=30.0)
+    # ties resolve FIFO by scheduling order: b's t=30 event was enqueued at
+    # t=15, before a's (enqueued at t=20)
+    assert log == [(0.0, "a"), (0.0, "b"), (10.0, "a"), (15.0, "b"),
+                   (20.0, "a"), (30.0, "b"), (30.0, "a")]
+
+
+def test_environment_call_at():
+    env = Environment()
+    hits = []
+    env.call_at(5.0, lambda: hits.append(env.now))
+    env.call_in(7.0, lambda: hits.append(env.now))
+    env.run(until=10.0)
+    assert hits == [5.0, 7.0]
+
+
+def test_broker_alias_and_source_matching():
+    broker = DataBroker("agent1")
+    got = []
+    broker.register_callback("T", Source(agent_id="sim"), got.append)
+    # wrong alias: ignored
+    broker.send_variable(AgentVariable(name="x", alias="other",
+                                       source=Source("sim")))
+    # wrong source: ignored
+    broker.send_variable(AgentVariable(name="T", alias="T",
+                                       source=Source("other")))
+    # match
+    broker.send_variable(AgentVariable(name="T", alias="T", value=5.0,
+                                       source=Source("sim")))
+    assert len(got) == 1 and got[0].value == 5.0
+
+
+def test_bus_broadcast_crosses_agents_only_when_shared():
+    bus = BroadcastBus()
+    b1, b2 = DataBroker("a1"), DataBroker("a2")
+    bus.join(b1)
+    bus.join(b2)
+    got = []
+    b2.register_callback("T", None, got.append)
+    b1.send_variable(AgentVariable(name="T", value=1.0, shared=False,
+                                   source=Source("a1")))
+    assert got == []
+    b1.send_variable(AgentVariable(name="T", value=2.0, shared=True,
+                                   source=Source("a1")))
+    assert len(got) == 1 and got[0].value == 2.0
+
+
+@register_module("_test_counter")
+class CounterModule(BaseModule):
+    def __init__(self, config, agent):
+        super().__init__(config, agent)
+        self.count = 0
+
+    def process(self):
+        while True:
+            self.count += 1
+            yield self.config.get("dt", 1.0)
+
+
+def test_local_mas_runs_modules():
+    mas = LocalMAS([
+        {"id": "a1", "modules": [
+            {"module_id": "c1", "type": "_test_counter", "dt": 10.0}]},
+    ])
+    mas.run(until=100.0)
+    assert mas.agents["a1"].get_module("c1").count == 11  # t=0..100
+
+
+def test_module_variable_store_and_sharing():
+    @register_module("_test_sender")
+    class Sender(BaseModule):
+        variable_groups = ("outputs",)
+        shared_groups = ("outputs",)
+
+        def process(self):
+            self.set("y", 42.0)
+            return
+            yield
+
+    @register_module("_test_receiver")
+    class Receiver(BaseModule):
+        variable_groups = ("inputs",)
+
+    mas = LocalMAS([
+        {"id": "s", "modules": [
+            {"module_id": "m", "type": "_test_sender",
+             "outputs": [{"name": "y", "alias": "meas"}]}]},
+        {"id": "r", "modules": [
+            {"module_id": "m", "type": "_test_receiver",
+             "inputs": [{"name": "y_in", "alias": "meas", "source": "s"}]}]},
+    ])
+    mas.run(until=1.0)
+    assert mas.agents["r"].get_module("m").get_value("y_in") == 42.0
+
+
+def test_communicator_entries_are_accepted_and_skipped():
+    mas = LocalMAS([
+        {"id": "a", "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "c", "type": "_test_counter"}]},
+    ])
+    assert list(mas.agents["a"].modules) == ["c"]
+
+
+def test_duplicate_agent_ids_rejected():
+    with pytest.raises(ValueError, match="duplicate agent"):
+        LocalMAS([{"id": "a", "modules": []}, {"id": "a", "modules": []}])
+
+
+def test_environment_stop_freezes_clock():
+    env = Environment()
+
+    def stopper():
+        yield 10.0
+        env.stop()
+
+    env.process(stopper())
+    env.run(until=3600.0)
+    assert env.now == 10.0  # not forced to `until`
+
+
+def test_local_mas_second_run_continues_without_restart():
+    mas = LocalMAS([
+        {"id": "a1", "modules": [
+            {"module_id": "c1", "type": "_test_counter", "dt": 10.0}]},
+    ])
+    mas.run(until=50.0)
+    counter = mas.agents["a1"].get_module("c1")
+    assert counter.count == 6
+    mas.run(until=100.0)
+    assert counter.count == 11  # continuation, no double-registration
+
+
+def test_explicit_shared_false_instance_respected():
+    from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+
+    @register_module("_test_shared_probe")
+    class Probe(BaseModule):
+        variable_groups = ("outputs",)
+        shared_groups = ("outputs",)
+
+    mas = LocalMAS([{"id": "a", "modules": [
+        {"module_id": "m", "type": "_test_shared_probe",
+         "outputs": [AgentVariable(name="private_y", shared=False)]}]}])
+    assert not mas.agents["a"].get_module("m").vars["private_y"].shared
