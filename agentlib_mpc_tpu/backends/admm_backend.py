@@ -1,0 +1,297 @@
+"""ADMM backend: augmented local OCP for distributed MPC.
+
+Counterpart of the reference's ``casadi_admm`` backend
+(``optimization_backends/casadi_/admm.py``): the local OCP gains, per
+coupling variable, the augmented-Lagrangian terms
+``lam * x_local + rho/2 (global - x_local)^2`` as stage objectives
+(``admm.py:90-116``), with the global mean / multiplier / penalty arriving
+as per-solve parameters under the reference's wire names
+(``admm_coupling_mean_<name>``, ``admm_lambda_<name>``,
+``admm_exchange_mean_<name>``, ``admm_exchange_lambda_<name>``,
+``penalty_factor`` — ``data_structures/admm_datatypes.py:16-23``).
+
+Coupling variables may be model *inputs* (optimized directly: they join
+the control vector, like the room's ``mDot_0``) or model *outputs*
+(functions of the state trajectory, like the cooler's ``mDot_out`` —
+``examples/admm/models/ca_cooler_model.py``). Both kinds are penalized on
+the control grid (N points; the reference's ``coupling_grid``,
+``optimization_backends/backend.py:223-231``).
+
+The whole augmented solve stays one jitted XLA computation; means and
+multipliers are traced arguments, so ADMM iterations never recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.backends.backend import (
+    VariableReference,
+    load_model,
+    register_backend,
+)
+from agentlib_mpc_tpu.backends.mpc_backend import (
+    JAXBackend,
+    solver_options_from_config,
+)
+from agentlib_mpc_tpu.ops.admm import consensus_penalty, exchange_penalty
+from agentlib_mpc_tpu.ops.solver import NLPFunctions, solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.utils.sampling import sample
+
+# reference wire-name prefixes (admm_datatypes.py:16-23)
+ADMM_PREFIX = "admm"
+MULTIPLIER_PREFIX = "admm_lambda"
+LOCAL_PREFIX = "admm_coupling"
+MEAN_PREFIX = "admm_coupling_mean"
+EXCHANGE_MULTIPLIER_PREFIX = "admm_exchange_lambda"
+EXCHANGE_LOCAL_PREFIX = "admm_exchange"
+EXCHANGE_MEAN_PREFIX = "admm_exchange_mean"
+
+
+@dataclasses.dataclass
+class ADMMVariableReference(VariableReference):
+    """VariableReference plus coupling/exchange variable names
+    (reference ``admm_datatypes.py:80-109``)."""
+
+    couplings: list[str] = dataclasses.field(default_factory=list)
+    exchange: list[str] = dataclasses.field(default_factory=list)
+
+    def all_names(self) -> list[str]:
+        return super().all_names() + [*self.couplings, *self.exchange]
+
+
+@register_backend("jax_admm", "casadi_admm")
+class ADMMBackend(JAXBackend):
+    """Local augmented OCP for one ADMM participant."""
+
+    def setup_optimization(self, var_ref: ADMMVariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        self.var_ref = var_ref
+        self.time_step = float(time_step)
+        self.N = int(prediction_horizon)
+        self.model = load_model(self.config["model"])
+
+        couplings = list(getattr(var_ref, "couplings", []))
+        exchange = list(getattr(var_ref, "exchange", []))
+        self.coupling_names = couplings
+        self.exchange_names = exchange
+
+        # split couplings into optimized inputs vs output expressions
+        def classify(name):
+            if name in self.model.input_names:
+                return "input"
+            if name in self.model.output_names:
+                return "output"
+            raise ValueError(
+                f"coupling {name!r} is neither a model input nor output")
+
+        self._coup_kinds = {n: classify(n) for n in (*couplings, *exchange)}
+        input_coups = [n for n in (*couplings, *exchange)
+                       if self._coup_kinds[n] == "input"]
+        opt_controls = [*var_ref.controls, *input_coups]
+
+        disc = dict(self.config.get("discretization_options", {}))
+        method = disc.get("method", "collocation")
+        if method == "multiple_shooting":
+            trans_kwargs = dict(
+                method="multiple_shooting",
+                integrator=disc.get("integrator", "rk4"),
+                integrator_substeps=int(disc.get("integrator_substeps", 3)))
+        else:
+            trans_kwargs = dict(
+                method="collocation",
+                collocation_degree=int(disc.get("collocation_order", 3)),
+                collocation_method=disc.get("collocation_method", "radau"))
+        self.ocp = transcribe(self.model, opt_controls, N=self.N,
+                              dt=self.time_step, **trans_kwargs)
+        self.solver_options = solver_options_from_config(
+            self.config.get("solver"))
+        self._exo_names = list(self.ocp.exo_names)
+        # the module-facing var_ref keeps real controls; the internal
+        # collection path needs the extended control list
+        self._collect_ref = dataclasses.replace(
+            VariableReference(
+                states=var_ref.states, controls=opt_controls,
+                inputs=var_ref.inputs, parameters=var_ref.parameters,
+                outputs=var_ref.outputs))
+        self._build_admm_step_fn()
+        self._reset_warm_start()
+
+    @property
+    def coupling_grid(self) -> np.ndarray:
+        """Grid the coupling trajectories live on (reference
+        ``ADMMBackend.coupling_grid``, ``backend.py:223-231``)."""
+        return np.arange(self.N) * self.time_step
+
+    # -- compiled pipeline ----------------------------------------------------
+
+    def _coupling_extractors(self):
+        """Per coupling name, a traced fn (w_flat, ocp_theta) -> (N,) on the
+        control grid."""
+        ocp = self.ocp
+        model = self.model
+        N = self.N
+
+        def make(name):
+            if self._coup_kinds[name] == "input":
+                col = ocp.control_names.index(name)
+
+                def extract(w_flat, theta, col=col):
+                    return ocp.unflatten(w_flat)["u"][:, col]
+            else:
+                out_idx = model.output_names.index(name)
+
+                def extract(w_flat, theta, out_idx=out_idx):
+                    w = ocp.unflatten(w_flat)
+                    x, u = w["x"], w["u"]
+                    z = w["z"][:, -1, :] if ocp.method == "collocation" \
+                        else w["z"]
+                    d_traj = theta.d_traj
+
+                    def node(i):
+                        # rebuild the full model input vector like the
+                        # transcription's splicer
+                        u_full = jnp.zeros((len(model.input_names),))
+                        for j, n in enumerate(ocp.control_names):
+                            u_full = u_full.at[
+                                model.input_names.index(n)].set(u[i, j])
+                        for j, n in enumerate(ocp.exo_names):
+                            u_full = u_full.at[
+                                model.input_names.index(n)].set(d_traj[i, j])
+                        y = model.output(x[i], z[i], u_full, theta.p,
+                                         theta.t0 + i * ocp.dt)
+                        return y[out_idx]
+
+                    return jax.vmap(node)(jnp.arange(N))
+            return extract
+
+        return {n: make(n) for n in (*self.coupling_names,
+                                     *self.exchange_names)}
+
+    def _build_admm_step_fn(self) -> None:
+        ocp = self.ocp
+        opts = self.solver_options
+        extractors = self._coupling_extractors()
+        coup_names = list(self.coupling_names)
+        ex_names = list(self.exchange_names)
+        dt = ocp.dt
+
+        def f_aug(w_flat, theta):
+            ocp_theta, means, lams, ex_diffs, ex_lams, rho = theta
+            val = ocp.nlp.f(w_flat, ocp_theta)
+            for k, name in enumerate(coup_names):
+                x_loc = extractors[name](w_flat, ocp_theta)
+                val = val + dt * consensus_penalty(x_loc, means[k], lams[k],
+                                                   rho)
+            for k, name in enumerate(ex_names):
+                x_loc = extractors[name](w_flat, ocp_theta)
+                val = val + dt * exchange_penalty(x_loc, ex_diffs[k],
+                                                  ex_lams[k], rho)
+            return val
+
+        nlp = NLPFunctions(
+            f=f_aug,
+            g=lambda w, th: ocp.nlp.g(w, th[0]),
+            h=lambda w, th: ocp.nlp.h(w, th[0]))
+
+        @jax.jit
+        def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                 means, lams, ex_diffs, ex_lams, rho,
+                 w_guess, y_guess, z_guess, mu0, t0):
+            theta = ocp.default_params(
+                x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
+                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
+            lb, ub = ocp.bounds(theta)
+            full_theta = (theta, means, lams, ex_diffs, ex_lams, rho)
+            res = solve_nlp(nlp, w_guess, full_theta, lb, ub, opts,
+                            y0=y_guess, z0=z_guess, mu0=mu0)
+            traj = ocp.trajectories(res.w, theta)
+            u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+            coup_trajs = {n: extractors[n](res.w, theta)
+                          for n in (*coup_names, *ex_names)}
+            w_next = ocp.shift_guess(res.w, theta)
+            return u0, traj, coup_trajs, w_next, res.y, res.z, res.stats
+
+        self._step_admm = step
+
+    # -- solve ----------------------------------------------------------------
+
+    def _admm_params(self, now: float, variables: dict[str, Any]):
+        grid = self.coupling_grid
+
+        def traj_of(key, default=0.0):
+            v = variables.get(key)
+            if v is None:
+                v = default
+            return sample(v, grid, current=now)
+
+        means = np.stack([traj_of(f"{MEAN_PREFIX}_{n}")
+                          for n in self.coupling_names]) \
+            if self.coupling_names else np.zeros((0, self.N))
+        lams = np.stack([traj_of(f"{MULTIPLIER_PREFIX}_{n}")
+                         for n in self.coupling_names]) \
+            if self.coupling_names else np.zeros((0, self.N))
+        ex_diffs = np.stack([traj_of(f"{EXCHANGE_MEAN_PREFIX}_{n}")
+                             for n in self.exchange_names]) \
+            if self.exchange_names else np.zeros((0, self.N))
+        ex_lams = np.stack([traj_of(f"{EXCHANGE_MULTIPLIER_PREFIX}_{n}")
+                            for n in self.exchange_names]) \
+            if self.exchange_names else np.zeros((0, self.N))
+        rho = float(variables.get("penalty_factor", 10.0))
+        return means, lams, ex_diffs, ex_lams, rho
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        saved_ref = self.var_ref
+        self.var_ref = self._collect_ref
+        try:
+            x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub = \
+                self._collect(now, variables)
+        finally:
+            self.var_ref = saved_ref
+        means, lams, ex_diffs, ex_lams, rho = self._admm_params(now, variables)
+        mu0 = jnp.asarray(
+            self.solver_options.mu_init if self._cold else 1e-2,
+            dtype=self._w_guess.dtype)
+        t_start = _time.perf_counter()
+        u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
+            self._step_admm(
+                x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                jnp.asarray(means), jnp.asarray(lams),
+                jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
+                jnp.asarray(rho),
+                self._w_guess, self._y_guess, self._z_guess, mu0,
+                jnp.asarray(float(now)))
+        u0.block_until_ready()
+        wall = _time.perf_counter() - t_start
+        self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
+        self._cold = False
+
+        stats_row = {
+            "time": float(now),
+            "iterations": int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+        }
+        self.stats_history.append(stats_row)
+        if not stats_row["success"]:
+            self.logger.warning(
+                "admm solve at t=%s did not converge (kkt=%.2e)",
+                now, stats_row["kkt_error"])
+        controls = list(self.ocp.control_names)
+        return {
+            "u0": {n: float(u0[i]) for i, n in enumerate(controls)
+                   if n in saved_ref.controls},
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+            "couplings": {n: np.asarray(v) for n, v in coup_trajs.items()},
+            "stats": stats_row,
+        }

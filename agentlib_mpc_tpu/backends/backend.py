@@ -22,6 +22,19 @@ logger = logging.getLogger(__name__)
 backend_types: dict[str, Type["OptimizationBackend"]] = {}
 
 
+def load_custom_class(file: str, class_name: str):
+    """Load a class from a file path — the reference's ``custom_injection``
+    hook (``modules/mpc/mpc.py:120-122``). Shared by module, backend and
+    model loading."""
+    spec = importlib.util.spec_from_file_location(
+        f"_custom_{class_name}", file)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {class_name!r} from {file!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return getattr(mod, class_name)
+
+
 def register_backend(*names: str):
     def deco(cls):
         for n in names:
@@ -33,11 +46,7 @@ def register_backend(*names: str):
 def create_backend(config: dict) -> "OptimizationBackend":
     type_key = config.get("type", "jax")
     if isinstance(type_key, dict):
-        spec = importlib.util.spec_from_file_location("_custom_backend",
-                                                      type_key["file"])
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        cls = getattr(mod, type_key["class_name"])
+        cls = load_custom_class(type_key["file"], type_key["class_name"])
     else:
         if type_key not in backend_types:
             raise KeyError(f"unknown backend type {type_key!r}; known: "
@@ -80,11 +89,7 @@ def load_model(model_cfg: dict | Model, dt: float | None = None) -> Model:
     if cls is None:
         type_key = model_cfg.get("type")
         if isinstance(type_key, dict):
-            spec = importlib.util.spec_from_file_location(
-                "_custom_model", type_key["file"])
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            cls = getattr(mod, type_key["class_name"])
+            cls = load_custom_class(type_key["file"], type_key["class_name"])
         else:
             raise KeyError(
                 "model config needs 'class' or {'type': {'file', "

@@ -50,6 +50,10 @@ class JAXBackend(OptimizationBackend):
 
     def setup_optimization(self, var_ref: VariableReference,
                            time_step: float, prediction_horizon: int) -> None:
+        if var_ref.binary_controls:
+            raise NotImplementedError(
+                "this backend ignores binary_controls; use the MINLP "
+                "backend (type 'jax_minlp') for mixed-integer problems")
         self.var_ref = var_ref
         self.time_step = float(time_step)
         self.N = int(prediction_horizon)

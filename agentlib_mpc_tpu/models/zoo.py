@@ -1,0 +1,160 @@
+"""Built-in example models ("model zoo").
+
+Native re-designs of the dynamics used across the reference's example
+families (``examples/one_room_mpc/physical/simple_mpc.py:27-138``,
+``examples/admm/models/{ca_room_model,ca_cooler_model}.py``): single-zone
+cooling, the cooled-room / cooler pair coupled through an air mass flow
+(the consensus-ADMM benchmark topology), and a synthetic N-zone building
+for scale-out benchmarks. The physics is the standard 1R1C air-volume
+energy balance:
+
+    dT/dt = cp * mDot / C * (T_in - T) + load / C
+
+All models are plain :class:`~agentlib_mpc_tpu.models.model.Model`
+subclasses — pure JAX, jit/vmap/grad-safe.
+"""
+
+from __future__ import annotations
+
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import SubObjective
+from agentlib_mpc_tpu.models.variables import (
+    control_input,
+    output,
+    parameter,
+    state,
+)
+
+
+class OneRoom(Model):
+    """Flagship single-zone cooling model (central MPC).
+
+    Air-volume zone with soft comfort constraint ``T + s <= T_upper`` and
+    cost ``r_mDot * mDot + s_T * s**2`` — the reference's one-room example
+    (``examples/one_room_mpc/physical/simple_mpc.py:27-138``).
+    """
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description="cooling air mass flow (control)"),
+        control_input("load", 150.0, unit="W", description="heat load"),
+        control_input("T_in", 290.15, unit="K",
+                      description="inflow air temperature"),
+        control_input("T_upper", 294.15, unit="K",
+                      description="soft upper comfort bound"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K",
+              description="zone temperature"),
+        state("T_slack", 0.0, unit="K", description="comfort slack"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0, unit="J/kg*K"),
+        parameter("C", 100000.0, unit="J/K"),
+        parameter("s_T", 1.0, description="slack weight"),
+        parameter("r_mDot", 1.0, description="air flow cost weight"),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
+            + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
+        )
+        return eq
+
+
+class CooledRoom(Model):
+    """Room half of the ADMM pair: ``mDot`` is a *coupling* input the room
+    optimizes locally but must agree on with the cooler (reference
+    ``examples/admm/models/ca_room_model.py``). The room pays only for
+    comfort (slack), not for the air it requests.
+    """
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description="air mass flow into the zone (coupling)"),
+        control_input("load", 150.0, unit="W"),
+        control_input("T_in", 290.15, unit="K"),
+        control_input("T_upper", 294.15, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        parameter("s_T", 1.0),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = SubObjective(v.T_slack ** 2, weight=v.s_T,
+                                    name="temp_slack")
+        return eq
+
+
+class Cooler(Model):
+    """Cooler half of the ADMM pair: purely static, supplies ``mDot`` at
+    cost ``r_mDot * mDot`` (reference ``ca_cooler_model.py``)."""
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description="air mass flow out of the cooler"),
+    ]
+    parameters = [parameter("r_mDot", 1.0)]
+    outputs = [output("mDot_out", 0.0225, unit="m^3/s")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.alg("mDot_out", v.mDot)
+        eq.objective = SubObjective(v.mDot, weight=v.r_mDot,
+                                    name="control_costs")
+        return eq
+
+
+class ZoneWithSupply(Model):
+    """Synthetic scale-out zone: a cooled room that also pays for its air
+    request — the per-zone subproblem of the N-zone exchange-ADMM benchmark
+    (BASELINE.json "synthetic 256-zone building"). Zones differ only in
+    their ``load``/``C`` parameters, so N of them vmap into one batch.
+    """
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description="air mass flow (exchange coupling)"),
+        control_input("load", 150.0, unit="W"),
+        control_input("T_in", 290.15, unit="K"),
+        control_input("T_upper", 294.15, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        parameter("s_T", 1.0),
+        parameter("r_mDot", 0.01),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
+            + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
+        )
+        return eq

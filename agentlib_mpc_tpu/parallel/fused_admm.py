@@ -1,0 +1,449 @@
+"""Fused single-program ADMM: the TPU-native distributed-MPC fast path.
+
+The reference runs one ADMM iteration as a network round: the coordinator
+broadcasts means/multipliers, every agent process solves its local NLP with
+CasADi+IPOPT, replies its coupling trajectories, and the coordinator updates
+means, multipliers and residuals in numpy
+(``modules/dmpc/admm/admm_coordinator.py:259-321,323-479``). Here the entire
+iteration *loop* is one XLA computation: vmapped interior-point solves over
+all agents of each structure group, coupling gathers as array
+concatenations, consensus/exchange updates from :mod:`ops.admm`, and a
+``lax.while_loop`` with the Boyd relative-tolerance exit — warm starts, the
+adaptive penalty and per-iteration residual tracking included.
+
+Heterogeneous fleets (e.g. N rooms + 1 cooler) are handled as *structure
+groups*: agents sharing a model/OCP shape batch under ``vmap``; the Python
+loop over groups unrolls into the jit. Coupling variables are referenced by
+a global alias; each group maps the alias to one of its control inputs —
+the analogue of the reference's AgentVariable alias matching on the broker
+(``data_structures/admm_datatypes.py:26-77``).
+
+On a multi-chip mesh, shard each group's agent axis with
+``jax.sharding.NamedSharding(mesh, P("agents"))`` (see
+``FusedADMM.shard_args``); the coupling means then lower to all-reduces
+over ICI — the reference's broker traffic becomes one collective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.ops.admm import (
+    AdmmResiduals,
+    combine_residuals,
+    consensus_penalty,
+    converged,
+    exchange_penalty,
+    vary_penalty,
+)
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    SolverOptions,
+    solve_nlp,
+)
+from agentlib_mpc_tpu.ops.transcription import OCPParams, TranscribedOCP
+
+
+def stack_params(thetas: Sequence[OCPParams]) -> OCPParams:
+    """Stack per-agent OCPParams into one batched pytree (agent axis 0)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *thetas)
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentGroup:
+    """A set of structure-identical agents (one OCP shape, batched params).
+
+    ``couplings``/``exchanges`` map a global coupling alias to the name of
+    the control input of this group's model that carries it. Groups not
+    participating in a coupling simply omit the alias.
+    """
+
+    name: str
+    ocp: TranscribedOCP
+    n_agents: int
+    couplings: dict[str, str] = dataclasses.field(default_factory=dict)
+    exchanges: dict[str, str] = dataclasses.field(default_factory=dict)
+    solver_options: SolverOptions = SolverOptions()
+
+    def control_index(self, var_name: str) -> int:
+        return self.ocp.control_names.index(var_name)
+
+
+class FusedADMMOptions(NamedTuple):
+    max_iterations: int = 20
+    rho: float = 10.0
+    #: Boyd relative-tolerance exit (admm_coordinator.py:409-430)
+    abs_tol: float = 1e-3
+    rel_tol: float = 1e-2
+    use_relative_tolerances: bool = True
+    primal_tol: float = 1e-3
+    dual_tol: float = 1e-3
+    #: residual-balancing adaptive penalty (admm_coordinator.py:467-479);
+    #: threshold <= 1 disables
+    penalty_change_threshold: float = -1.0
+    penalty_change_factor: float = 2.0
+
+
+class FusedState(NamedTuple):
+    """Carried between control steps (the warm-start memory)."""
+
+    zbar: dict            # alias -> (T,) consensus means
+    lam: dict             # alias -> tuple per group: (n_i, T) multipliers
+    ex_mean: dict         # alias -> (T,) exchange means
+    ex_diff: dict         # alias -> tuple per group: (n_i, T) diffs
+    ex_lam: dict          # alias -> (T,) shared exchange multiplier
+    rho: jnp.ndarray
+    w: tuple              # per group: (n_i, n_w) primal warm starts
+
+
+class IterationStats(NamedTuple):
+    iterations: jnp.ndarray          # () actual iterations run
+    primal_residuals: jnp.ndarray    # (max_iter,) padded with NaN
+    dual_residuals: jnp.ndarray
+    penalty: jnp.ndarray             # (max_iter,)
+    converged: jnp.ndarray           # () bool
+
+
+class FusedADMM:
+    """Compiled ADMM round over structure groups. Build once per problem
+    structure; call :meth:`step` once per control step."""
+
+    def __init__(self, groups: Sequence[AgentGroup],
+                 options: FusedADMMOptions = FusedADMMOptions()):
+        self.groups = tuple(groups)
+        self.options = options
+        self._aliases = sorted(
+            {a for g in self.groups for a in g.couplings})
+        self._ex_aliases = sorted(
+            {a for g in self.groups for a in g.exchanges})
+        # horizon of each coupling trajectory: the shared control grid
+        horizons = {g.ocp.N for g in self.groups}
+        if len(horizons) != 1:
+            raise ValueError(
+                f"all groups must share one horizon, got {horizons}")
+        self.T = horizons.pop()
+        for alias in (*self._aliases, *self._ex_aliases):
+            if not any(alias in g.couplings or alias in g.exchanges
+                       for g in self.groups):
+                raise ValueError(f"coupling {alias!r} has no participants")
+        self._step = jax.jit(self._build_step())
+
+    # -- state ----------------------------------------------------------------
+
+    def init_state(self, theta_batches: Sequence[OCPParams]) -> FusedState:
+        """Fresh global state: means from the default control values, zero
+        multipliers (the reference seeds means from initial guesses during
+        registration, ``admm_coordinator.py:528-654``)."""
+        zbar, lam = {}, {}
+        ex_mean, ex_diff, ex_lam = {}, {}, {}
+        for alias in self._aliases:
+            zbar[alias] = jnp.zeros((self.T,))
+            lam[alias] = tuple(
+                jnp.zeros((g.n_agents, self.T)) for g in self.groups
+                if alias in g.couplings)
+        for alias in self._ex_aliases:
+            ex_mean[alias] = jnp.zeros((self.T,))
+            ex_lam[alias] = jnp.zeros((self.T,))
+            ex_diff[alias] = tuple(
+                jnp.zeros((g.n_agents, self.T)) for g in self.groups
+                if alias in g.exchanges)
+        w = tuple(
+            jax.vmap(g.ocp.initial_guess)(theta)
+            for g, theta in zip(self.groups, theta_batches))
+        return FusedState(zbar=zbar, lam=lam, ex_mean=ex_mean,
+                          ex_diff=ex_diff, ex_lam=ex_lam,
+                          rho=jnp.asarray(self.options.rho), w=w)
+
+    def shift_state(self, state: FusedState) -> FusedState:
+        """Shift-by-one warm start between control steps
+        (``_shift_coupling_variables``, ``admm_coordinator.py:332-337``)."""
+        sh = lambda a: admm_ops.shift_one(a, self.T)
+        return state._replace(
+            zbar={k: sh(v) for k, v in state.zbar.items()},
+            lam={k: tuple(sh(x) for x in v) for k, v in state.lam.items()},
+            ex_mean={k: sh(v) for k, v in state.ex_mean.items()},
+            ex_diff={k: tuple(sh(x) for x in v)
+                     for k, v in state.ex_diff.items()},
+            ex_lam={k: sh(v) for k, v in state.ex_lam.items()},
+        )
+
+    # -- the fused iteration loop ---------------------------------------------
+
+    def _group_participations(self, alias, kind):
+        """(group_index, control_index, slot) for every group in coupling
+        `alias`; slot is the position in the state's per-group tuples."""
+        out = []
+        slot = 0
+        for gi, g in enumerate(self.groups):
+            mapping = g.couplings if kind == "consensus" else g.exchanges
+            if alias in mapping:
+                out.append((gi, g.control_index(mapping[alias]), slot))
+                slot += 1
+        return out
+
+    def _build_step(self):
+        groups = self.groups
+        opts = self.options
+        aliases = self._aliases
+        ex_aliases = self._ex_aliases
+        n_groups = len(groups)
+
+        # per group: which (alias, kind, u-column) augment its objective
+        aug_map = []
+        for g in groups:
+            entries = [(a, "consensus", g.control_index(n))
+                       for a, n in sorted(g.couplings.items())]
+            entries += [(a, "exchange", g.control_index(n))
+                        for a, n in sorted(g.exchanges.items())]
+            aug_map.append(tuple(entries))
+
+        def make_group_nlp(gi):
+            ocp = groups[gi].ocp
+            entries = aug_map[gi]
+
+            def f_aug(w_flat, theta):
+                # the reference adds the admm terms as *stage* objectives,
+                # so they are integrated (dt-weighted) like the base cost
+                # (casadi_/admm.py:90-116); weight by dt here for the same
+                # rho semantics
+                ocp_theta, aug = theta
+                val = ocp.nlp.f(w_flat, ocp_theta)
+                u = ocp.unflatten(w_flat)["u"]
+                for k, (alias, kind, col) in enumerate(entries):
+                    zbar_or_diff, lam, rho = aug[k]
+                    x_loc = u[:, col]
+                    if kind == "consensus":
+                        val = val + ocp.dt * consensus_penalty(
+                            x_loc, zbar_or_diff, lam, rho)
+                    else:
+                        val = val + ocp.dt * exchange_penalty(
+                            x_loc, zbar_or_diff, lam, rho)
+                return val
+
+            return NLPFunctions(
+                f=f_aug,
+                g=lambda w, th: ocp.nlp.g(w, th[0]),
+                h=lambda w, th: ocp.nlp.h(w, th[0]),
+            )
+
+        group_nlps = [make_group_nlp(gi) for gi in range(n_groups)]
+
+        def local_solves(gi, state: FusedState, theta_batch):
+            """vmapped augmented solves of one group. Returns (w_batch,
+            u_batch) with u on the control grid."""
+            g = groups[gi]
+            entries = aug_map[gi]
+
+            def aug_for_agent(agent_slices):
+                # agent_slices: per entry (global, lam_slice)
+                return tuple(
+                    (glob, lam_a, state.rho)
+                    for (glob, lam_a) in agent_slices)
+
+            # build per-agent augmentation pytrees (batched on axis 0)
+            slices = []
+            for alias, kind, _col in entries:
+                if kind == "consensus":
+                    slot = [s for gj, _c, s in
+                            self._group_participations(alias, "consensus")
+                            if gj == gi][0]
+                    glob = state.zbar[alias]          # (T,) replicated
+                    lam = state.lam[alias][slot]      # (n_i, T)
+                else:
+                    slot = [s for gj, _c, s in
+                            self._group_participations(alias, "exchange")
+                            if gj == gi][0]
+                    # exchange: target is the agent's own previous diff,
+                    # multiplier is shared (admm.py:102-116)
+                    glob = state.ex_diff[alias][slot]  # (n_i, T) per agent
+                    lam = jnp.broadcast_to(state.ex_lam[alias],
+                                           (g.n_agents, self.T))
+                slices.append((glob, lam, kind))
+
+            def one_agent(w_guess, ocp_theta, *per_entry):
+                aug = tuple((glob, lam, state.rho)
+                            for (glob, lam) in per_entry)
+                lb, ub = g.ocp.bounds(ocp_theta)
+                res = solve_nlp(group_nlps[gi], w_guess, (ocp_theta, aug),
+                                lb, ub, g.solver_options)
+                u = g.ocp.unflatten(res.w)["u"]
+                return res.w, u, res.stats.success
+
+            in_axes = [0, 0]
+            vargs = []
+            for glob, lam, kind in slices:
+                if kind == "consensus":
+                    in_axes.append((None, 0))
+                else:
+                    in_axes.append((0, 0))
+                vargs.append((glob, lam))
+            w_b, u_b, ok_b = jax.vmap(
+                one_agent, in_axes=tuple(in_axes))(
+                state.w[gi], theta_batch, *vargs)
+            return w_b, u_b, ok_b
+
+        def step_fn(state: FusedState, theta_batches: tuple):
+            max_it = opts.max_iterations
+
+            def iteration(carry):
+                state, it, _res, prim_hist, dual_hist, rho_hist, done = carry
+
+                u_groups = []
+                w_new = []
+                ok_all = jnp.asarray(True)
+                for gi in range(n_groups):
+                    w_b, u_b, ok_b = local_solves(gi, state,
+                                                  theta_batches[gi])
+                    w_new.append(w_b)
+                    u_groups.append(u_b)
+                    ok_all = ok_all & jnp.all(ok_b)
+
+                residuals = []
+                zbar_new = dict(state.zbar)
+                lam_new = dict(state.lam)
+                for alias in aliases:
+                    parts = self._group_participations(alias, "consensus")
+                    locals_ = jnp.concatenate(
+                        [u_groups[gi][:, :, col] for gi, col, _ in parts],
+                        axis=0)
+                    lam_stack = jnp.concatenate(
+                        [state.lam[alias][slot] for _, _, slot in parts],
+                        axis=0)
+                    cstate = admm_ops.ConsensusState(
+                        zbar=state.zbar[alias], lam=lam_stack,
+                        rho=state.rho)
+                    cnew, res = admm_ops.consensus_update(locals_, cstate)
+                    residuals.append(res)
+                    zbar_new[alias] = cnew.zbar
+                    offs = 0
+                    pieces = []
+                    for gi, _col, _slot in parts:
+                        n_i = groups[gi].n_agents
+                        pieces.append(cnew.lam[offs:offs + n_i])
+                        offs += n_i
+                    lam_new[alias] = tuple(pieces)
+
+                ex_mean_new = dict(state.ex_mean)
+                ex_diff_new = dict(state.ex_diff)
+                ex_lam_new = dict(state.ex_lam)
+                for alias in ex_aliases:
+                    parts = self._group_participations(alias, "exchange")
+                    locals_ = jnp.concatenate(
+                        [u_groups[gi][:, :, col] for gi, col, _ in parts],
+                        axis=0)
+                    diff_stack = jnp.concatenate(
+                        [state.ex_diff[alias][slot] for _, _, slot in parts],
+                        axis=0)
+                    estate = admm_ops.ExchangeState(
+                        mean=state.ex_mean[alias], diff=diff_stack,
+                        lam=state.ex_lam[alias], rho=state.rho)
+                    enew, res = admm_ops.exchange_update(locals_, estate)
+                    residuals.append(res)
+                    ex_mean_new[alias] = enew.mean
+                    ex_lam_new[alias] = enew.lam
+                    offs = 0
+                    pieces = []
+                    for gi, _col, _slot in parts:
+                        n_i = groups[gi].n_agents
+                        pieces.append(enew.diff[offs:offs + n_i])
+                        offs += n_i
+                    ex_diff_new[alias] = tuple(pieces)
+
+                res_all = combine_residuals(*residuals) if residuals else \
+                    AdmmResiduals(*([jnp.asarray(0.0)] * 6))
+                rho_next = vary_penalty(
+                    state.rho, res_all,
+                    threshold=opts.penalty_change_threshold,
+                    factor=opts.penalty_change_factor)
+                is_conv = converged(
+                    res_all, abs_tol=opts.abs_tol, rel_tol=opts.rel_tol,
+                    use_relative=opts.use_relative_tolerances,
+                    primal_tol=opts.primal_tol, dual_tol=opts.dual_tol)
+
+                prim_hist = prim_hist.at[it].set(res_all.primal)
+                dual_hist = dual_hist.at[it].set(res_all.dual)
+                rho_hist = rho_hist.at[it].set(state.rho)
+
+                state = state._replace(
+                    zbar=zbar_new, lam=lam_new, ex_mean=ex_mean_new,
+                    ex_diff=ex_diff_new, ex_lam=ex_lam_new,
+                    rho=rho_next, w=tuple(w_new))
+                return (state, it + 1, res_all, prim_hist, dual_hist,
+                        rho_hist, is_conv)
+
+            def cond(carry):
+                _state, it, _res, _p, _d, _r, done = carry
+                return (~done) & (it < max_it)
+
+            nan_hist = jnp.full((max_it,), jnp.nan)
+            init_res = AdmmResiduals(*([jnp.asarray(jnp.inf)] * 2),
+                                     *([jnp.asarray(0.0)] * 4))
+            carry = (state, jnp.asarray(0), init_res, nan_hist,
+                     jnp.full((max_it,), jnp.nan),
+                     jnp.full((max_it,), jnp.nan), jnp.asarray(False))
+            state, it, res, prim_hist, dual_hist, rho_hist, done = \
+                jax.lax.while_loop(cond, iteration, carry)
+
+            stats = IterationStats(
+                iterations=it, primal_residuals=prim_hist,
+                dual_residuals=dual_hist, penalty=rho_hist, converged=done)
+            trajs = tuple(
+                jax.vmap(lambda w, th, g=g: g.ocp.trajectories(w, th))(
+                    state.w[gi], theta_batches[gi])
+                for gi, g in enumerate(groups))
+            return state, trajs, stats
+
+        return step_fn
+
+    # -- public API -----------------------------------------------------------
+
+    def step(self, state: FusedState, theta_batches: Sequence[OCPParams]):
+        """Run one full ADMM round (≤ max_iterations, early exit on the
+        relative-tolerance criterion). Returns (new_state, per-group
+        trajectory pytrees, IterationStats)."""
+        return self._step(state, tuple(theta_batches))
+
+    def shard_args(self, mesh, state: FusedState,
+                   theta_batches: Sequence[OCPParams]):
+        """Place agent-batched leaves on `mesh` sharded over its first axis
+        (agents); replicated leaves (means, shared multipliers, rho) go
+        everywhere. Groups whose size does not divide the mesh stay
+        replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        n_dev = mesh.shape[axis]
+        repl = NamedSharding(mesh, P())
+
+        def shard_group(gi, leaf):
+            if groups_divisible[gi]:
+                return jax.device_put(
+                    leaf, NamedSharding(mesh, P(axis)))
+            return jax.device_put(leaf, repl)
+
+        groups_divisible = [g.n_agents % n_dev == 0 for g in self.groups]
+        w = tuple(shard_group(gi, state.w[gi])
+                  for gi in range(len(self.groups)))
+        lam = {a: tuple(
+            shard_group(gi, piece) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "consensus"), pieces))
+            for a, pieces in state.lam.items()}
+        ex_diff = {a: tuple(
+            shard_group(gi, piece) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "exchange"), pieces))
+            for a, pieces in state.ex_diff.items()}
+        state = state._replace(
+            w=w, lam=lam, ex_diff=ex_diff,
+            zbar=jax.device_put(state.zbar, repl),
+            ex_mean=jax.device_put(state.ex_mean, repl),
+            ex_lam=jax.device_put(state.ex_lam, repl),
+            rho=jax.device_put(state.rho, repl))
+        thetas = tuple(
+            jax.tree.map(lambda leaf, gi=gi: shard_group(gi, leaf), theta)
+            for gi, theta in enumerate(theta_batches))
+        return state, thetas

@@ -43,13 +43,9 @@ def create_module(config: dict, agent) -> "BaseModule":
     if isinstance(type_key, dict):
         # custom injection: {"file": path, "class_name": X} — the reference's
         # custom_injection hook (modules/mpc/mpc.py:120-122)
-        import importlib.util
+        from agentlib_mpc_tpu.backends.backend import load_custom_class
 
-        spec = importlib.util.spec_from_file_location("_custom_module",
-                                                      type_key["file"])
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        cls = getattr(mod, type_key["class_name"])
+        cls = load_custom_class(type_key["file"], type_key["class_name"])
     else:
         if type_key not in MODULE_TYPES:
             raise KeyError(
@@ -77,7 +73,9 @@ class BaseModule:
         self.logger = logging.getLogger(
             f"{type(self).__name__}[{agent.id}/{self.id}]")
         self.vars: dict[str, AgentVariable] = {}
+        self._groups: dict[str, list[str]] = {}
         for group in self.variable_groups:
+            names = []
             for cfg in config.get(group, []):
                 var = AgentVariable.from_config(cfg)
                 # group default shared=True applies only when the config
@@ -88,9 +86,8 @@ class BaseModule:
                 if group in self.shared_groups and not explicit:
                     var.shared = True
                 self._declare(var, group)
-        self._groups: dict[str, list[str]] = {
-            g: [AgentVariable.from_config(c).name for c in config.get(g, [])]
-            for g in self.variable_groups}
+                names.append(var.name)
+            self._groups[group] = names
 
     # -- variable store -------------------------------------------------------
 

@@ -43,8 +43,11 @@ def sample(
         value = (np.asarray(value.index, dtype=float),
                  np.asarray(value.values, dtype=float))
     if isinstance(value, dict):
-        times = np.array(sorted(value), dtype=float)
-        value = (times, np.array([value[t] for t in sorted(value)], dtype=float))
+        # keys may be strings (JSON round-trip of a pandas Series): sort
+        # numerically, not lexicographically
+        keys = sorted(value, key=float)
+        value = (np.array([float(k) for k in keys]),
+                 np.array([value[k] for k in keys], dtype=float))
     if np.isscalar(value) or (isinstance(value, np.ndarray) and value.ndim == 0):
         return np.full(grid.shape, float(value))
     if isinstance(value, (list, np.ndarray)):

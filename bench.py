@@ -1,0 +1,158 @@
+"""Benchmark: 256-zone consensus-ADMM MPC, wall-clock per control step.
+
+The BASELINE.json north-star metric: "ADMM-MPC wall-clock per control step;
+agents/sec scaling 4->256 zones". One control step = `ADMM_ITERS` fused
+consensus-ADMM iterations, each iteration = vmapped per-zone interior-point
+NLP solves + consensus mean + scaled-dual update, all inside one jitted XLA
+computation (the TPU-native replacement for the reference's coordinator
+round driving 256 IPOPT processes, ``admm_coordinator.py:259-321``).
+
+The reference itself cannot run here (CasADi/IPOPT not installed, zero
+egress) and publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+measured speedup of the default platform (TPU under the driver) over the
+same workload forced onto host CPU — a conservative stand-in: the CPU run
+uses the same fused XLA path, which is already far faster than 256
+sequential CasADi+IPOPT processes.
+
+Prints ONE JSON line:
+    {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
+     "vs_baseline": <cpu_ms / this_ms>}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_AGENTS = 256
+HORIZON = 10
+ADMM_ITERS = 10
+DT = 300.0
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.models.zoo import ZoneWithSupply
+    from agentlib_mpc_tpu.ops.solver import (
+        NLPFunctions,
+        SolverOptions,
+        solve_nlp,
+    )
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    model = ZoneWithSupply()
+    ocp = transcribe(model, ["mDot"], N=HORIZON, dt=DT,
+                     method="collocation", collocation_degree=2)
+    opts = SolverOptions(tol=1e-4, max_iter=15)
+
+    def f_aug(w, theta):
+        ocp_theta, zbar, lam, rho = theta
+        u = ocp.unflatten(w)["u"]
+        return ocp.nlp.f(w, ocp_theta) + \
+            0.5 * rho * jnp.sum((u - zbar + lam) ** 2)
+
+    nlp = NLPFunctions(f=f_aug, g=lambda w, th: ocp.nlp.g(w, th[0]),
+                       h=lambda w, th: ocp.nlp.h(w, th[0]))
+
+    def local_solve(x0, load, w_guess, zbar, lam, rho):
+        theta = ocp.default_params(
+            x0=x0, d_traj=jnp.broadcast_to(
+                jnp.array([load, 290.15, 294.15]), (HORIZON, 3)))
+        lb, ub = ocp.bounds(theta)
+        res = solve_nlp(nlp, w_guess, (theta, zbar, lam, rho), lb, ub, opts)
+        return res.w, ocp.unflatten(res.w)["u"]
+
+    v_solve = jax.vmap(local_solve, in_axes=(0, 0, 0, None, 0, None))
+
+    def control_step(x0s, loads, w_guesses, zbar, lams, rho):
+        def admm_iter(_, carry):
+            w_gs, zbar, lams = carry
+            w_new, u_locals = v_solve(x0s, loads, w_gs, zbar, lams, rho)
+            zbar_new = jnp.mean(u_locals, axis=0)
+            lams_new = lams + (u_locals - zbar_new)
+            return (w_new, zbar_new, lams_new)
+
+        w_gs, zbar, lams = jax.lax.fori_loop(
+            0, ADMM_ITERS, admm_iter, (w_guesses, zbar, lams))
+        return w_gs, zbar, lams
+
+    theta0 = ocp.default_params()
+    x0s = jnp.linspace(294.0, 300.0, N_AGENTS).reshape(N_AGENTS, 1)
+    loads = jnp.linspace(80.0, 250.0, N_AGENTS)
+    w_guesses = jnp.broadcast_to(ocp.initial_guess(theta0),
+                                 (N_AGENTS, ocp.n_w))
+    zbar = jnp.full((HORIZON, 1), 0.02)
+    lams = jnp.zeros((N_AGENTS, HORIZON, 1))
+    rho = jnp.asarray(20.0)
+    args = (x0s, loads, w_guesses, zbar, lams, rho)
+    return jax.jit(control_step), args
+
+
+def measure() -> dict:
+    import jax
+
+    step, args = build_step()
+    t0 = time.perf_counter()
+    out = step(*args)
+    jax.block_until_ready(out)
+    compile_ms = 1e3 * (time.perf_counter() - t0)
+    # steady state: warm-started repeat (the closed-loop regime)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = step(args[0], args[1], out[0], out[1], out[2], args[5])
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    step_ms = 1e3 * min(times)
+    return {
+        "step_ms": step_ms,
+        "compile_ms": compile_ms,
+        "agents_per_sec": N_AGENTS * ADMM_ITERS / (step_ms / 1e3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    if "--probe" in sys.argv:
+        # subprocess mode: force host CPU *in-process* (setting JAX_PLATFORMS
+        # at launch can hang under the axon sitecustomize, which imports jax
+        # at interpreter startup), then print the measurement
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(measure()))
+        return
+
+    res = measure()
+    print(f"[bench] platform={res['platform']} "
+          f"step={res['step_ms']:.1f}ms compile={res['compile_ms']:.0f}ms "
+          f"agents/s={res['agents_per_sec']:.0f}", file=sys.stderr)
+
+    vs_baseline = 0.0
+    try:
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, text=True, timeout=1200,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        cpu = json.loads(probe.stdout.strip().splitlines()[-1])
+        print(f"[bench] cpu baseline step={cpu['step_ms']:.1f}ms",
+              file=sys.stderr)
+        vs_baseline = cpu["step_ms"] / res["step_ms"]
+    except Exception as exc:  # noqa: BLE001 - baseline is best-effort
+        print(f"[bench] cpu baseline unavailable: {exc}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "admm256_step_ms",
+        "value": round(res["step_ms"], 2),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,52 +24,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import jax
 import jax.numpy as jnp
 
-from agentlib_mpc_tpu.models.model import Model, ModelEquations
-from agentlib_mpc_tpu.models.objective import SubObjective
-from agentlib_mpc_tpu.models.variables import (
-    control_input,
-    output,
-    parameter,
-    state,
-)
+from agentlib_mpc_tpu.models.zoo import OneRoom
 from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
 from agentlib_mpc_tpu.ops.transcription import transcribe
 
 UB_COMFORT = 295.15  # K, soft upper comfort bound
-
-
-class OneRoom(Model):
-    """Air-volume zone: dT/dt = cp·mDot/C·(T_in − T) + load/C, slacked
-    comfort constraint T + s ≤ T_upper, cost r·mDot + s·slack²."""
-
-    inputs = [
-        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m³/s"),
-        control_input("load", 150.0, unit="W"),
-        control_input("T_in", 290.15, unit="K"),
-        control_input("T_upper", 294.15, unit="K"),
-    ]
-    states = [
-        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
-        state("T_slack", 0.0, unit="K"),
-    ]
-    parameters = [
-        parameter("cp", 1000.0),
-        parameter("C", 100000.0),
-        parameter("s_T", 1.0),
-        parameter("r_mDot", 1.0),
-    ]
-    outputs = [output("T_out", unit="K")]
-
-    def setup(self, v):
-        eq = ModelEquations()
-        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
-        eq.alg("T_out", v.T)
-        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
-        eq.objective = (
-            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
-            + SubObjective(v.T_slack**2, weight=v.s_T, name="temp_slack")
-        )
-        return eq
 
 
 def run_example(until: float = 7200.0, time_step: float = 300.0,

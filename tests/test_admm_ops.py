@@ -1,0 +1,219 @@
+"""Unit tests for the pure ADMM math (ops/admm.py).
+
+The reference has no direct unit tests for its consensus/residual/penalty
+updates (SURVEY.md §4 gap) — these test the extracted pure functions
+against hand-computed values mirroring the reference semantics
+(``data_structures/admm_datatypes.py:221-331``,
+``modules/dmpc/admm/admm_coordinator.py:354-479``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.ops.admm import (
+    AdmmResiduals,
+    ConsensusState,
+    ExchangeState,
+    combine_residuals,
+    consensus_penalty,
+    consensus_update,
+    converged,
+    exchange_penalty,
+    exchange_update,
+    shift_one,
+    vary_penalty,
+)
+
+
+def make_consensus(n_agents=3, t=4, rho=2.0):
+    return ConsensusState(
+        zbar=jnp.zeros((t,)),
+        lam=jnp.zeros((n_agents, t)),
+        rho=jnp.asarray(rho),
+    )
+
+
+class TestConsensusUpdate:
+    def test_mean_and_multipliers(self):
+        locals_ = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        state = ConsensusState(zbar=jnp.zeros(2), lam=jnp.zeros((2, 2)),
+                               rho=jnp.asarray(2.0))
+        new, res = consensus_update(locals_, state)
+        np.testing.assert_allclose(new.zbar, [2.0, 3.0])
+        # lam_i = lam_i - rho * (zbar - x_i): agent 0 sits below the mean
+        # (zbar - x = +1) so its multiplier moves to -2
+        np.testing.assert_allclose(new.lam, [[-2.0, -2.0], [2.0, 2.0]])
+        # primal residual: stack of (zbar - x_i)
+        np.testing.assert_allclose(float(res.primal), np.sqrt(4 * 1.0))
+        # dual: rho * (zbar_new - zbar_old)
+        np.testing.assert_allclose(float(res.dual),
+                                   2.0 * np.sqrt(2.0 ** 2 + 3.0 ** 2))
+
+    def test_masked_agents_excluded(self):
+        locals_ = jnp.array([[1.0], [3.0], [100.0]])
+        state = ConsensusState(zbar=jnp.zeros(1), lam=jnp.zeros((3, 1)),
+                               rho=jnp.asarray(1.0))
+        active = jnp.array([True, True, False])
+        new, res = consensus_update(locals_, state, active=active)
+        np.testing.assert_allclose(new.zbar, [2.0])
+        # inactive agent's multiplier untouched
+        np.testing.assert_allclose(new.lam[2], [0.0])
+        # and contributes nothing to the primal residual
+        np.testing.assert_allclose(float(res.primal), np.sqrt(2.0))
+
+    def test_multi_coupling_axis(self):
+        # (n_agents, K, T) stacking works unchanged
+        locals_ = jnp.arange(12.0).reshape(2, 2, 3)
+        state = ConsensusState(zbar=jnp.zeros((2, 3)),
+                               lam=jnp.zeros((2, 2, 3)), rho=jnp.asarray(1.0))
+        new, _ = consensus_update(locals_, state)
+        np.testing.assert_allclose(new.zbar, locals_.mean(axis=0))
+
+    def test_fixed_point(self):
+        # agents already agree: zero residuals, multipliers unchanged
+        locals_ = jnp.broadcast_to(jnp.array([1.0, 2.0]), (3, 2))
+        lam = jnp.array([[0.5, -0.5]] * 3)
+        state = ConsensusState(zbar=jnp.array([1.0, 2.0]), lam=lam,
+                               rho=jnp.asarray(5.0))
+        new, res = consensus_update(locals_, state)
+        np.testing.assert_allclose(new.lam, lam)
+        assert float(res.primal) == 0.0 and float(res.dual) == 0.0
+
+
+class TestExchangeUpdate:
+    def test_known_values(self):
+        locals_ = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+        state = ExchangeState(mean=jnp.zeros(2), diff=jnp.zeros((2, 2)),
+                              lam=jnp.zeros(2), rho=jnp.asarray(3.0))
+        new, res = exchange_update(locals_, state)
+        np.testing.assert_allclose(new.mean, [1.0, 1.0])
+        np.testing.assert_allclose(new.diff, [[1.0, -1.0], [-1.0, 1.0]])
+        # shared multiplier: lam + rho * mean
+        np.testing.assert_allclose(new.lam, [3.0, 3.0])
+        # primal residual is the resource imbalance |mean|
+        np.testing.assert_allclose(float(res.primal), np.sqrt(2.0))
+
+    def test_balanced_exchange_zero_primal(self):
+        locals_ = jnp.array([[1.0], [-1.0]])
+        state = ExchangeState(mean=jnp.zeros(1), diff=jnp.zeros((2, 1)),
+                              lam=jnp.asarray([0.7]), rho=jnp.asarray(2.0))
+        new, res = exchange_update(locals_, state)
+        assert float(res.primal) == 0.0
+        np.testing.assert_allclose(new.lam, [0.7])
+
+
+class TestConvergence:
+    def test_relative_criterion(self):
+        res = AdmmResiduals(
+            primal=jnp.asarray(0.01), dual=jnp.asarray(0.01),
+            scale_primal=jnp.asarray(10.0), scale_dual=jnp.asarray(10.0),
+            n_primal=jnp.asarray(4.0), n_dual=jnp.asarray(4.0))
+        # eps = 2*1e-3 + 1e-2*10 = 0.102 > 0.01 -> converged
+        assert bool(converged(res, abs_tol=1e-3, rel_tol=1e-2))
+        # tighten rel_tol so the scaled part vanishes
+        assert not bool(converged(res, abs_tol=1e-3, rel_tol=1e-5))
+
+    def test_absolute_criterion(self):
+        res = AdmmResiduals(
+            primal=jnp.asarray(0.5), dual=jnp.asarray(2.0),
+            scale_primal=jnp.asarray(1.0), scale_dual=jnp.asarray(1.0),
+            n_primal=jnp.asarray(1.0), n_dual=jnp.asarray(1.0))
+        assert bool(converged(res, use_relative=False, primal_tol=1.0,
+                              dual_tol=3.0))
+        assert not bool(converged(res, use_relative=False, primal_tol=0.1,
+                                  dual_tol=3.0))
+
+    def test_combine(self):
+        r1 = AdmmResiduals(*(jnp.asarray(v) for v in (3.0, 0.0, 1.0, 0.0, 2.0, 2.0)))
+        r2 = AdmmResiduals(*(jnp.asarray(v) for v in (4.0, 1.0, 0.0, 2.0, 3.0, 1.0)))
+        c = combine_residuals(r1, r2)
+        np.testing.assert_allclose(float(c.primal), 5.0)  # sqrt(9+16)
+        np.testing.assert_allclose(float(c.n_primal), 5.0)
+
+
+class TestVaryPenalty:
+    def residuals(self, p, d):
+        z = jnp.asarray(0.0)
+        return AdmmResiduals(jnp.asarray(p), jnp.asarray(d), z, z, z, z)
+
+    def test_grow_shrink_hold(self):
+        rho = jnp.asarray(1.0)
+        assert float(vary_penalty(rho, self.residuals(100.0, 1.0))) == 2.0
+        assert float(vary_penalty(rho, self.residuals(1.0, 100.0))) == 0.5
+        assert float(vary_penalty(rho, self.residuals(1.0, 1.0))) == 1.0
+
+    def test_disabled_below_one(self):
+        rho = jnp.asarray(1.0)
+        out = vary_penalty(rho, self.residuals(100.0, 1.0), threshold=0.5)
+        assert float(out) == 1.0
+
+
+class TestShift:
+    def test_shift_one_interval(self):
+        traj = jnp.arange(8.0)  # horizon 4, 2 points per interval
+        out = shift_one(traj, horizon=4)
+        np.testing.assert_allclose(out, [2, 3, 4, 5, 6, 7, 6, 7])
+
+    def test_shift_batched(self):
+        traj = jnp.arange(8.0).reshape(2, 4)
+        out = shift_one(traj, horizon=4)
+        np.testing.assert_allclose(out[0], [1, 2, 3, 3])
+
+
+class TestPenaltyTerms:
+    def test_consensus_penalty_value(self):
+        x = jnp.array([1.0, 2.0])
+        zbar = jnp.array([2.0, 2.0])
+        lam = jnp.array([0.5, -0.5])
+        val = consensus_penalty(x, zbar, lam, rho=2.0)
+        # lam.x = 0.5 - 1.0 = -0.5 ; rho/2 * (1 + 0) = 1.0
+        np.testing.assert_allclose(float(val), 0.5)
+
+    def test_exchange_penalty_value(self):
+        x = jnp.array([1.0])
+        diff = jnp.array([3.0])
+        lam = jnp.array([2.0])
+        val = exchange_penalty(x, diff, lam, rho=1.0)
+        np.testing.assert_allclose(float(val), 2.0 + 0.5 * 4.0)
+
+
+class TestQuadraticConsensusADMM:
+    """End-to-end on analytic subproblems: agents i minimize (x - a_i)^2
+    with a consensus coupling; the fixed point is x_i = z̄ = mean(a)."""
+
+    def test_converges_to_mean(self):
+        a = jnp.array([[1.0], [2.0], [6.0]])
+        rho = 4.0
+        state = ConsensusState(zbar=jnp.zeros((1,)), lam=jnp.zeros((3, 1)),
+                               rho=jnp.asarray(rho))
+
+        def local_argmin(a_i, lam_i, zbar):
+            # argmin (x-a)^2 + lam*x + rho/2 (zbar - x)^2
+            return (2 * a_i - lam_i + rho * zbar) / (2 + rho)
+
+        res = None
+        for _ in range(60):
+            locals_ = jnp.stack([
+                local_argmin(a[i], state.lam[i], state.zbar)
+                for i in range(3)])
+            state, res = consensus_update(locals_, state)
+        np.testing.assert_allclose(np.asarray(state.zbar), [3.0], atol=1e-4)
+        assert bool(converged(res, abs_tol=1e-5, rel_tol=1e-6))
+
+    def test_adaptive_penalty_speeds_up(self):
+        a = jnp.array([[0.0], [10.0]])
+        state = ConsensusState(zbar=jnp.zeros((1,)), lam=jnp.zeros((2, 1)),
+                               rho=jnp.asarray(0.01))  # bad initial rho
+
+        def local_argmin(a_i, lam_i, zbar, rho):
+            return (2 * a_i - lam_i + rho * zbar) / (2 + rho)
+
+        for _ in range(40):
+            locals_ = jnp.stack([
+                local_argmin(a[i], state.lam[i], state.zbar, state.rho)
+                for i in range(2)])
+            state, res = consensus_update(locals_, state)
+            state = state._replace(rho=vary_penalty(state.rho, res))
+        assert float(state.rho) > 0.01  # grew towards balance
+        np.testing.assert_allclose(np.asarray(state.zbar), [5.0], atol=1e-3)

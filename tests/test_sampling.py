@@ -1,0 +1,68 @@
+"""Unit tests for trajectory sampling (utils/sampling.py), mirroring the
+reference's exact-expected-vector style (``tests/test_mpc.py:20-120``)."""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.utils.sampling import (
+    InterpolationMethods,
+    interpolate_to_previous,
+    sample,
+)
+
+
+class TestSample:
+    def test_scalar_holds(self):
+        np.testing.assert_allclose(sample(3.5, [0, 10, 20]), [3.5, 3.5, 3.5])
+
+    def test_list_on_grid_passthrough(self):
+        np.testing.assert_allclose(sample([1.0, 2.0, 3.0], [0, 10, 20]),
+                                   [1, 2, 3])
+
+    def test_list_wrong_length_raises(self):
+        with pytest.raises(ValueError):
+            sample([1.0, 2.0], [0, 10, 20])
+
+    def test_pair_linear_interpolation(self):
+        traj = ([0.0, 100.0], [0.0, 10.0])
+        np.testing.assert_allclose(sample(traj, [0, 50, 100]), [0, 5, 10])
+
+    def test_current_time_offset(self):
+        traj = ([0.0, 100.0], [0.0, 10.0])
+        np.testing.assert_allclose(sample(traj, [0, 50], current=50.0),
+                                   [5.0, 10.0])
+
+    def test_edge_extrapolation_holds_boundary(self):
+        traj = ([10.0, 20.0], [1.0, 2.0])
+        np.testing.assert_allclose(sample(traj, [0, 15, 40]), [1.0, 1.5, 2.0])
+
+    def test_dict_numeric_keys(self):
+        np.testing.assert_allclose(
+            sample({0.0: 0.0, 900.0: 9.0, 1800.0: 18.0}, [0, 450, 900]),
+            [0.0, 4.5, 9.0])
+
+    def test_dict_string_keys_sorted_numerically(self):
+        # JSON round-trip of a pandas Series gives string keys; '1800' sorts
+        # before '900' lexicographically — must sort by float value
+        val = {"0": 0.0, "900": 9.0, "1800": 18.0}
+        np.testing.assert_allclose(
+            sample(val, [0, 450, 900, 1350, 1800]),
+            [0.0, 4.5, 9.0, 13.5, 18.0])
+
+    def test_previous_interpolation(self):
+        traj = ([0.0, 10.0, 20.0], [1.0, 2.0, 3.0])
+        out = sample(traj, [5.0, 10.0, 15.0],
+                     method=InterpolationMethods.previous)
+        np.testing.assert_allclose(out, [1.0, 2.0, 2.0])
+
+    def test_series_like(self):
+        pd = pytest.importorskip("pandas")
+        s = pd.Series([0.0, 10.0], index=[0.0, 100.0])
+        np.testing.assert_allclose(sample(s, [0, 50]), [0.0, 5.0])
+
+
+class TestInterpolateToPrevious:
+    def test_zero_order_hold(self):
+        out = interpolate_to_previous([0.0, 4.0, 5.0, 11.0],
+                                      [0.0, 5.0, 10.0], [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(out, [1.0, 1.0, 2.0, 3.0])
