@@ -16,3 +16,5 @@ from agentlib_mpc_tpu.backends.backend import (
     register_backend,
 )
 from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend
+from agentlib_mpc_tpu.backends.admm_backend import ADMMBackend
+from agentlib_mpc_tpu.backends.mhe_backend import MHEBackend

@@ -123,6 +123,8 @@ class ADMMBackend(JAXBackend):
                 outputs=var_ref.outputs))
         self._build_admm_step_fn()
         self._reset_warm_start()
+        if self.config.get("precompile"):
+            self._precompile()
 
     @property
     def coupling_grid(self) -> np.ndarray:

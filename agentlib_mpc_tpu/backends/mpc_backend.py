@@ -79,6 +79,17 @@ class JAXBackend(OptimizationBackend):
         self._exo_names = list(self.ocp.exo_names)
         self._build_step_fn()
         self._reset_warm_start()
+        if self.config.get("precompile"):
+            self._precompile()
+
+    def _precompile(self) -> None:
+        """Trigger XLA compilation at setup with default inputs so the first
+        real-time control step meets its wall-clock budget (the reference
+        pays this cost to CasADi codegen/DLL compilation instead,
+        ``casadi_utils.py:313-369``; here it is one throwaway solve)."""
+        self.solve(0.0, {})
+        self.stats_history.clear()
+        self._reset_warm_start()
 
     # -- compiled pipeline ----------------------------------------------------
 

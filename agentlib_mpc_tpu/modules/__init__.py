@@ -7,3 +7,9 @@ registers all module types.
 
 from agentlib_mpc_tpu.modules.mpc import BaseMPC, MPC
 from agentlib_mpc_tpu.modules.simulator import Simulator
+from agentlib_mpc_tpu.modules.admm import LocalADMM, RealtimeADMM
+from agentlib_mpc_tpu.modules.coordinator import (
+    ADMMCoordinator,
+    CoordinatedADMM,
+)
+from agentlib_mpc_tpu.modules.estimation import MHE

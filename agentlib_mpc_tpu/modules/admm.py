@@ -1,0 +1,581 @@
+"""Decentralized ADMM modules (peer-to-peer distributed MPC).
+
+Re-design of the reference's fully decentralized consensus/exchange-ADMM
+(``modules/dmpc/admm/admm.py``): each agent owns an augmented local OCP
+(`ADMMBackend`), broadcasts its coupling trajectories over the broker,
+registers whoever else broadcasts on the same coupling alias, averages the
+received trajectories, and updates its multipliers — iterating until a
+wall-clock/iteration budget is exhausted. Two execution modes, mirroring the
+reference:
+
+- ``admm_local`` (`LocalADMM`): the whole algorithm as one cooperative
+  generator with tiny sync yields — deterministic fast simulation, the mode
+  most reference examples/tests use (``admm.py:873-937``).
+- ``admm`` (`RealtimeADMM`): wall-clock mode — a daemon thread performs the
+  ADMM round each time a periodic event fires, with a real registration
+  window and blocking receive timeouts (``admm.py:143-321``).
+
+Protocol compatibility: coupling trajectories travel under the reference's
+wire aliases (``admm_coupling_<alias>`` / ``admm_exchange_<alias>``,
+``data_structures/admm_datatypes.py:16-23,112-120``), so a mixed deployment
+against reference agents speaks the same naming scheme.
+
+The numerics (mean, multiplier update, penalties) are the tested pure
+functions in ``ops/admm.py``; this module is only host-side protocol. The
+per-iteration local solve is the jitted augmented OCP — it never recompiles
+across iterations because means/multipliers are traced arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time as _time
+from enum import Enum, auto
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu.backends.admm_backend import (
+    ADMMVariableReference,
+    EXCHANGE_LOCAL_PREFIX,
+    EXCHANGE_MEAN_PREFIX,
+    EXCHANGE_MULTIPLIER_PREFIX,
+    ADMM_PREFIX,
+    LOCAL_PREFIX,
+    MEAN_PREFIX,
+    MULTIPLIER_PREFIX,
+)
+from agentlib_mpc_tpu.modules.mpc import BaseMPC
+from agentlib_mpc_tpu.runtime.module import register_module
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+
+@dataclasses.dataclass(frozen=True)
+class CouplingEntry:
+    """Naming conventions for the aux quantities of one consensus coupling
+    (reference ``admm_datatypes.py:26-50``)."""
+
+    name: str
+
+    @property
+    def local(self) -> str:
+        return f"{LOCAL_PREFIX}_{self.name}"
+
+    @property
+    def mean(self) -> str:
+        return f"{MEAN_PREFIX}_{self.name}"
+
+    @property
+    def multiplier(self) -> str:
+        return f"{MULTIPLIER_PREFIX}_{self.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeEntry:
+    """Naming conventions for one exchange coupling
+    (reference ``admm_datatypes.py:53-77``)."""
+
+    name: str
+
+    @property
+    def local(self) -> str:
+        return f"{EXCHANGE_LOCAL_PREFIX}_{self.name}"
+
+    @property
+    def mean_diff(self) -> str:
+        return f"{EXCHANGE_MEAN_PREFIX}_{self.name}"
+
+    @property
+    def multiplier(self) -> str:
+        return f"{EXCHANGE_MULTIPLIER_PREFIX}_{self.name}"
+
+
+def coupling_alias(alias: str) -> str:
+    """Wire alias for consensus coupling broadcasts
+    (``admm_datatypes.py:112-115``)."""
+    return f"{LOCAL_PREFIX}_{alias}"
+
+
+def exchange_alias(alias: str) -> str:
+    """Wire alias for exchange coupling broadcasts
+    (``admm_datatypes.py:118-120``)."""
+    return f"{EXCHANGE_LOCAL_PREFIX}_{alias}"
+
+
+class ParticipantStatus(Enum):
+    not_participating = auto()
+    available = auto()
+    confirmed = auto()
+    not_available = auto()
+
+
+class ModuleStatus(Enum):
+    syncing = auto()
+    at_registration = auto()
+    optimizing = auto()
+    waiting_for_other_agents = auto()
+    updating = auto()
+    sleeping = auto()
+
+
+_ITERATING = (ModuleStatus.optimizing, ModuleStatus.waiting_for_other_agents,
+              ModuleStatus.updating)
+
+
+class ADMMParticipation:
+    """Per-(coupling, source) inbox + registration status
+    (reference ``admm.py:47-65``). Bounded queue: a flooding sender is
+    reported instead of exhausting memory."""
+
+    def __init__(self, variable: AgentVariable):
+        self.variable = variable
+        self.status = ParticipantStatus.not_participating
+        self.received: queue.Queue = queue.Queue(maxsize=5)
+
+    def empty_memory(self) -> None:
+        while True:
+            try:
+                self.received.get_nowait()
+            except queue.Empty:
+                break
+
+    def de_register(self) -> None:
+        self.status = ParticipantStatus.not_participating
+        self.empty_memory()
+
+
+class ADMMModule(BaseMPC):
+    """Shared machinery of both decentralized ADMM variants."""
+
+    variable_groups = ("inputs", "outputs", "states", "parameters",
+                       "controls", "couplings", "exchange")
+    shared_groups = ("outputs", "controls", "couplings", "exchange")
+
+    def __init__(self, config: dict, agent):
+        self.penalty_factor = float(config.get("penalty_factor", 10.0))
+        self.max_iterations = int(config.get("max_iterations", 20))
+        self.iteration_timeout = float(config.get("iteration_timeout", 20.0))
+        self.registration_period = float(
+            config.get("registration_period", 2.0))
+        self._status = ModuleStatus.syncing
+        self._registered_participants: Dict[
+            str, Dict[Source, ADMMParticipation]] = {}
+        self._admm_values: Dict[str, np.ndarray] = {}
+        self._iter_rows: List[dict] = []
+        super().__init__(config, agent)
+
+    # -- setup ---------------------------------------------------------------
+
+    def _declare(self, var: AgentVariable, group: str) -> None:
+        if var.name.startswith(ADMM_PREFIX):
+            # reserved namespace (reference config guard, admm.py:95-108)
+            raise ValueError(
+                f"variable {var.name!r}: names starting with "
+                f"{ADMM_PREFIX!r} are reserved for the ADMM protocol")
+        super()._declare(var, group)
+
+    def _setup_backend(self) -> None:
+        from agentlib_mpc_tpu.backends.backend import load_model
+
+        self.couplings = [CouplingEntry(n)
+                          for n in self._groups.get("couplings", [])]
+        self.exchange = [ExchangeEntry(n)
+                         for n in self._groups.get("exchange", [])]
+        if not (self.couplings or self.exchange):
+            raise ValueError(
+                "ADMM module needs at least one coupling or exchange "
+                "variable")
+        self.var_ref = ADMMVariableReference(
+            states=self._groups.get("states", []),
+            controls=self._groups.get("controls", []),
+            inputs=self._groups.get("inputs", []),
+            parameters=self._groups.get("parameters", []),
+            outputs=self._groups.get("outputs", []),
+            couplings=[c.name for c in self.couplings],
+            exchange=[e.name for e in self.exchange],
+        )
+        model = load_model(self.backend.config["model"])
+        self.backend.config["model"] = model
+        self.backend.setup_optimization(
+            self.var_ref, self.time_step, self.prediction_horizon)
+        self._init_admm_state()
+
+    def _init_admm_state(self) -> None:
+        """Create the aux trajectories and subscribe to the coupling wire
+        aliases (reference ``_create_couplings``, ``admm.py:683-814``)."""
+        n = len(self.backend.coupling_grid)
+        for entry in self.cons_and_exchange:
+            var = self.vars[entry.name]
+            init = var.value if var.value is not None else 0.0
+            self._admm_values[entry.local] = np.full(n, float(init))
+            self._admm_values[entry.multiplier] = np.zeros(n)
+            mean_key = entry.mean if isinstance(entry, CouplingEntry) \
+                else entry.mean_diff
+            self._admm_values[mean_key] = np.full(n, float(init)) \
+                if isinstance(entry, CouplingEntry) else np.zeros(n)
+            wire = self._wire_alias(entry)
+            self._registered_participants.setdefault(wire, {})
+            self.agent.data_broker.register_callback(
+                wire, None, self.participant_callback)
+
+    def _wire_alias(self, entry) -> str:
+        var = self.vars[entry.name]
+        if isinstance(entry, CouplingEntry):
+            return coupling_alias(var.alias)
+        return exchange_alias(var.alias)
+
+    @property
+    def cons_and_exchange(self):
+        return [*self.couplings, *self.exchange]
+
+    # -- participant bookkeeping ---------------------------------------------
+
+    def participant_callback(self, variable: AgentVariable) -> None:
+        """Route a received coupling broadcast into the sender's inbox
+        (reference ``participant_callback``/``receive_participant``,
+        ``admm.py:440-501``)."""
+        if variable.source.agent_id == self.agent.id:
+            return
+        inboxes = self._registered_participants[variable.alias]
+        if variable.source not in inboxes:
+            self.logger.info("initially registered %s from %s",
+                             variable.alias, variable.source)
+            inboxes[variable.source] = ADMMParticipation(variable)
+        neighbor = inboxes[variable.source]
+        if self._status == ModuleStatus.at_registration:
+            neighbor.empty_memory()
+            neighbor.status = ParticipantStatus.not_available
+            neighbor.variable = variable
+        elif self._status in _ITERATING:
+            try:
+                neighbor.received.put_nowait(variable)
+                neighbor.status = ParticipantStatus.available
+            except queue.Full:
+                self.logger.error(
+                    "participant %s floods coupling %s; dropping message",
+                    variable.source, variable.alias)
+            neighbor.variable = variable
+
+    def all_participations(self) -> Iterable[ADMMParticipation]:
+        for per_coupling in self._registered_participants.values():
+            yield from per_coupling.values()
+
+    def reset_participants_ready(self) -> None:
+        for p in self.all_participations():
+            p.status = (ParticipantStatus.available if p.received.qsize()
+                        else ParticipantStatus.not_available)
+
+    def deregister_all_participants(self) -> None:
+        for p in self.all_participations():
+            p.de_register()
+
+    def _receive_variables(self, start_wall: float, block: bool) -> None:
+        """Collect one fresh trajectory per registered participant; slow
+        ones are de-registered for the rest of the round
+        (reference ``_receive_variables``, ``admm.py:298-321``)."""
+        for participant in self.all_participations():
+            if participant.status == ParticipantStatus.not_participating:
+                continue
+            remaining = max(
+                self.iteration_timeout - (_time.time() - start_wall), 0.0)
+            try:
+                if block:
+                    var = participant.received.get(timeout=remaining)
+                else:
+                    var = participant.received.get_nowait()
+                participant.variable = var
+                participant.status = ParticipantStatus.confirmed
+            except queue.Empty:
+                participant.de_register()
+                self.logger.info(
+                    "de-registered %s from %s (too slow)",
+                    participant.variable.source, participant.variable.alias)
+
+    def participant_values(self, wire: str) -> List[np.ndarray]:
+        values = []
+        for p in self._registered_participants[wire].values():
+            if p.status == ParticipantStatus.confirmed:
+                values.append(np.asarray(p.variable.value, dtype=float))
+        return values
+
+    # -- ADMM updates (host-side protocol around ops/admm math) ---------------
+
+    def _shift(self, arr: np.ndarray) -> np.ndarray:
+        """Shift one control interval forward, repeating the tail
+        (reference ``_shift``, ``admm.py:328-342``)."""
+        from agentlib_mpc_tpu.utils.sampling import shift_time_series
+
+        return shift_time_series(arr, self.prediction_horizon)
+
+    def _shift_and_send_couplings(self) -> None:
+        """Warm-start broadcast that doubles as registration
+        (``_shift_and_send_coupling_outputs``, ``admm.py:356-375``)."""
+        for entry in self.cons_and_exchange:
+            local = self._shift(self._admm_values[entry.local])
+            self._admm_values[entry.local] = local
+            self.send_coupling_variable(entry, local)
+
+    def _shift_multipliers(self) -> None:
+        for entry in self.cons_and_exchange:
+            self._admm_values[entry.multiplier] = self._shift(
+                self._admm_values[entry.multiplier])
+
+    def send_coupling_variable(self, entry, value: np.ndarray) -> None:
+        self.send(AgentVariable(
+            name=entry.local, value=list(np.asarray(value, dtype=float)),
+            alias=self._wire_alias(entry), shared=True, type="list"))
+
+    def send_coupling_values(self, result: dict) -> None:
+        """Broadcast the freshly optimized local coupling trajectories
+        (``send_coupling_values``, ``admm.py:513-526``)."""
+        for entry in self.cons_and_exchange:
+            traj = np.asarray(result["couplings"][entry.name], dtype=float)
+            self._admm_values[entry.local] = traj
+            self.send_coupling_variable(entry, traj)
+
+    def _set_mean_coupling_values(self) -> None:
+        """Average own + received trajectories; exchange couplings store
+        the deviation x − mean (``_set_mean_coupling_values``,
+        ``admm.py:528-570``)."""
+        for entry in self.couplings:
+            own = self._admm_values[entry.local]
+            values = self.participant_values(self._wire_alias(entry))
+            values.append(own)
+            self._admm_values[entry.mean] = np.mean(
+                np.stack(values), axis=0)
+        for entry in self.exchange:
+            own = self._admm_values[entry.local]
+            values = self.participant_values(self._wire_alias(entry))
+            values.append(own)
+            mean = np.mean(np.stack(values), axis=0)
+            self._admm_values[entry.mean_diff] = own - mean
+
+    def update_lambda(self) -> None:
+        """Scaled-dual update λ ← λ − ρ(z̄ − x) / λ ← λ − ρ(diff − x)
+        (``update_lambda``, ``admm.py:612-655``)."""
+        rho = self.penalty_factor
+        for entry in self.couplings:
+            lam = self._admm_values[entry.multiplier]
+            x = self._admm_values[entry.local]
+            zbar = self._admm_values[entry.mean]
+            self._admm_values[entry.multiplier] = lam - rho * (zbar - x)
+        for entry in self.exchange:
+            lam = self._admm_values[entry.multiplier]
+            x = self._admm_values[entry.local]
+            diff = self._admm_values[entry.mean_diff]
+            self._admm_values[entry.multiplier] = lam - rho * (diff - x)
+
+    # -- optimization ---------------------------------------------------------
+
+    def collect_variables_for_optimization(self) -> dict:
+        out = super().collect_variables_for_optimization()
+        out["penalty_factor"] = self.penalty_factor
+        return out
+
+    def _solve_local(self, opt_inputs: dict, start_time: float) -> dict:
+        opt_inputs = dict(opt_inputs)
+        for entry in self.cons_and_exchange:
+            opt_inputs[entry.multiplier] = self._admm_values[entry.multiplier]
+            if isinstance(entry, CouplingEntry):
+                opt_inputs[entry.mean] = self._admm_values[entry.mean]
+            else:
+                opt_inputs[entry.mean_diff] = self._admm_values[entry.mean_diff]
+        return self.backend.solve(start_time, opt_inputs)
+
+    def _check_termination(self, admm_iter: int, start_time: float,
+                           start_wall: float) -> bool:
+        """Wall-clock budget ∨ iteration cap (``_check_termination``,
+        ``admm.py:263-296``). In fast simulation the clock does not advance
+        inside a round, so the iteration cap governs."""
+        budget = self.time_step - self.registration_period
+        elapsed = (_time.time() - start_wall) if self.env.rt \
+            else (self.env.now - start_time)
+        if elapsed > budget:
+            self.logger.warning(
+                "ADMM exceeded the sampling-time budget of %ss; "
+                "terminating control step", budget)
+            return True
+        if admm_iter >= self.max_iterations:
+            self.logger.info("ADMM reached max_iterations=%s",
+                             self.max_iterations)
+            return True
+        return False
+
+    # -- results --------------------------------------------------------------
+
+    def _record_iteration(self, result: dict, admm_iter: int) -> None:
+        self._iter_rows.append({
+            "time": float(self.env.now),
+            "iteration": admm_iter,
+            "couplings": {k: np.asarray(v)
+                          for k, v in result["couplings"].items()},
+            "stats": result["stats"],
+        })
+
+    def admm_results(self):
+        """(time, iteration, grid) MultiIndex coupling trajectories — the
+        reference's iteration-buffered ADMM results layout
+        (``casadi_/admm.py:364-424``)."""
+        import pandas as pd
+
+        if not self._iter_rows:
+            return None
+        grid = np.asarray(self.backend.coupling_grid, dtype=float)
+        frames = []
+        for row in self._iter_rows:
+            data = {("variable", name): traj
+                    for name, traj in row["couplings"].items()}
+            df = pd.DataFrame(data)
+            df.index = pd.MultiIndex.from_product(
+                [[row["time"]], [row["iteration"]], grid],
+                names=["time", "iteration", "grid"])
+            frames.append(df)
+        out = pd.concat(frames)
+        out.columns = pd.MultiIndex.from_tuples(out.columns)
+        return out
+
+    def results(self):
+        """dict with 'admm' (per-iteration couplings) and 'mpc' (per-step
+        trajectories) DataFrames."""
+        out = {}
+        admm = self.admm_results()
+        if admm is not None:
+            out["admm"] = admm
+        mpc = super().results()
+        if mpc is not None:
+            out["mpc"] = mpc
+        return out or None
+
+    def cleanup_results(self) -> None:
+        super().cleanup_results()
+        self._iter_rows.clear()
+
+
+@register_module("admm_local", "local_admm")
+class LocalADMM(ADMMModule):
+    """Cooperative fast-simulation variant: the whole ADMM round is one
+    generator; sync yields keep all agents in lock-step
+    (reference ``LocalADMM.process``, ``admm.py:873-937``)."""
+
+    def __init__(self, config: dict, agent):
+        self.sync_delay = float(config.get("sync_delay", 1e-3))
+        super().__init__(config, agent)
+
+    def process(self):
+        while True:
+            start_round = self.env.now
+            self._status = ModuleStatus.at_registration
+            yield self.sync_delay
+            self._shift_and_send_couplings()
+            self._shift_multipliers()
+            yield self.sync_delay
+            self._status = ModuleStatus.optimizing
+            yield self.sync_delay
+
+            self._set_mean_coupling_values()
+            opt_inputs = self.collect_variables_for_optimization()
+            start_iterations = self.env.now
+            start_wall = _time.time()
+            admm_iter = 0
+            result = None
+            while True:
+                self._status = ModuleStatus.optimizing
+                result = self._solve_local(opt_inputs, start_iterations)
+                yield self.sync_delay
+                self.send_coupling_values(result)
+                yield self.sync_delay
+                self._status = ModuleStatus.waiting_for_other_agents
+                self._receive_variables(start_wall, block=False)
+                yield self.sync_delay
+                self._status = ModuleStatus.updating
+                self._set_mean_coupling_values()
+                self.update_lambda()
+                self.reset_participants_ready()
+                self._record_iteration(result, admm_iter)
+                yield self.sync_delay
+                admm_iter += 1
+                if self._check_termination(admm_iter, start_iterations,
+                                           start_wall):
+                    break
+
+            self.deregister_all_participants()
+            self.set_actuation(result)
+            self._record(result)
+            self._status = ModuleStatus.sleeping
+            spent = self.env.now - start_round
+            yield max(self.time_step - spent, 0.0)
+
+
+@register_module("admm")
+class RealtimeADMM(ADMMModule):
+    """Wall-clock variant: a daemon thread runs the ADMM round whenever the
+    periodic event fires; registration is a real time window and receives
+    block with timeouts (reference ``ADMM``, ``admm.py:143-321``)."""
+
+    def __init__(self, config: dict, agent):
+        self.start_step = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        super().__init__(config, agent)
+
+    def process(self):
+        self._thread = threading.Thread(
+            target=self._admm_loop, daemon=True,
+            name=f"admm_loop_{self.agent.id}")
+        self._thread.start()
+        self._status = ModuleStatus.syncing
+        # sync to a multiple of the time step (reference ``_sync_start``)
+        if self.env.rt:
+            yield self.time_step - (_time.time() % self.time_step)
+        while True:
+            if self.start_step.is_set():
+                self.logger.error(
+                    "previous ADMM round still running; skipping trigger")
+            else:
+                self.start_step.set()
+            yield self.time_step
+
+    def _admm_loop(self) -> None:
+        while True:
+            self.start_step.wait()
+            self.start_step.clear()
+            try:
+                self.admm_step()
+            except Exception:  # pragma: no cover - diagnostic path
+                self.logger.exception("ADMM round failed")
+            self._status = ModuleStatus.sleeping
+
+    def admm_step(self) -> None:
+        self._status = ModuleStatus.at_registration
+        self._shift_and_send_couplings()
+        self._shift_multipliers()
+        _time.sleep(self.registration_period)
+        self._status = ModuleStatus.updating
+
+        self._set_mean_coupling_values()
+        opt_inputs = self.collect_variables_for_optimization()
+        start_iterations = self.env.now
+        start_wall = _time.time()
+        admm_iter = 0
+        result = None
+        while True:
+            iter_wall = _time.time()
+            self._status = ModuleStatus.optimizing
+            result = self._solve_local(opt_inputs, start_iterations)
+            self.send_coupling_values(result)
+            self._status = ModuleStatus.waiting_for_other_agents
+            self._receive_variables(iter_wall, block=True)
+            self._status = ModuleStatus.updating
+            self._set_mean_coupling_values()
+            self.update_lambda()
+            self.reset_participants_ready()
+            self._record_iteration(result, admm_iter)
+            admm_iter += 1
+            if self._check_termination(admm_iter, start_iterations,
+                                       start_wall):
+                break
+
+        self.deregister_all_participants()
+        self.set_actuation(result)
+        self._record(result)

@@ -79,6 +79,16 @@ def sample(
     return np.interp(target, times, vals)
 
 
+def shift_time_series(arr: np.ndarray, horizon: int) -> np.ndarray:
+    """Shift a trajectory one control interval forward, repeating the tail —
+    the between-steps warm start both ADMM modes use (reference
+    ``shift_values_by_one``, ``admm_datatypes.py:275-282``; jit twin:
+    ``ops/admm.shift_one``). ``arr`` has ``k·horizon`` samples."""
+    arr = np.asarray(arr)
+    k = max(len(arr) // max(horizon, 1), 1)
+    return np.concatenate([arr[k:], arr[-k:]])
+
+
 def interpolate_to_previous(target, times, vals) -> np.ndarray:
     """Zero-order hold (reference ``interpolate_to_previous``,
     ``utils/sampling.py:183-202``)."""

@@ -1,0 +1,147 @@
+"""Decentralized broker-based ADMM: the cooled-room / cooler pair.
+
+Mirrors the reference's local ADMM integration example
+(``examples/admm/admm_example_local.py`` with ``configs/cooled_room.json``,
+``cooler.json``, ``simulator.json``): the room optimizes the air flow it
+*receives* (coupling on its input ``mDot``), the cooler optimizes the air
+flow it *supplies* (coupling on its output ``mDot_out``, actuating its
+control ``mDot``), both broadcast trajectories under the shared wire alias
+and must agree; the simulator integrates the room plant with the cooler's
+actuated flow. Closed-loop assertion: the room cools down (the reference's
+``testing=True`` assertion, ``admm_example_local.py:99-101``).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from agentlib_mpc_tpu.models.zoo import CooledRoom, Cooler
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+
+UB = 295.15
+TIME_STEP = 300.0
+
+ROOM = {
+    "id": "CooledRoom",
+    "modules": [
+        {"module_id": "com", "type": "local_broadcast"},
+        {
+            "module_id": "admm_module",
+            "type": "admm_local",
+            "optimization_backend": {
+                "type": "jax_admm",
+                "model": {"class": CooledRoom},
+                "discretization_options": {
+                    "collocation_order": 2,
+                    "collocation_method": "legendre",
+                },
+                "solver": {"max_iter": 40},
+            },
+            "time_step": TIME_STEP,
+            "prediction_horizon": 8,
+            "max_iterations": 6,
+            "penalty_factor": 10.0,
+            "parameters": [{"name": "s_T", "value": 1.0}],
+            "inputs": [
+                {"name": "load", "value": 150},
+                {"name": "T_in", "value": 290.15},
+                {"name": "T_upper", "value": UB},
+            ],
+            "controls": [],
+            "states": [
+                {"name": "T", "value": 298.16, "ub": 303.15, "lb": 288.15,
+                 "alias": "T", "source": "Simulation"},
+            ],
+            "couplings": [
+                {"name": "mDot", "alias": "mDotCoolAir", "value": 0.02,
+                 "ub": 0.05, "lb": 0.0},
+            ],
+        },
+    ],
+}
+
+COOLER = {
+    "id": "Cooler",
+    "modules": [
+        {"module_id": "com", "type": "local_broadcast"},
+        {
+            "module_id": "admm_module",
+            "type": "admm_local",
+            "optimization_backend": {
+                "type": "jax_admm",
+                "model": {"class": Cooler},
+                "discretization_options": {
+                    "collocation_order": 2,
+                    "collocation_method": "legendre",
+                },
+                "solver": {"max_iter": 40},
+            },
+            "time_step": TIME_STEP,
+            "prediction_horizon": 8,
+            "max_iterations": 6,
+            "penalty_factor": 10.0,
+            "parameters": [{"name": "r_mDot", "value": 1.0}],
+            "controls": [
+                {"name": "mDot", "value": 0.02, "ub": 0.05, "lb": 0.0},
+            ],
+            "couplings": [
+                {"name": "mDot_out", "alias": "mDotCoolAir", "value": 0.02},
+            ],
+        },
+    ],
+}
+
+SIM = {
+    "id": "Simulation",
+    "modules": [
+        {"module_id": "com", "type": "local_broadcast"},
+        {
+            "module_id": "simulator",
+            "type": "simulator",
+            "model": {"class": CooledRoom,
+                      "states": [{"name": "T", "value": 298.16}]},
+            "t_sample": 60,
+            "outputs": [{"name": "T_out", "value": 298.16, "alias": "T"}],
+            "inputs": [{"name": "mDot", "value": 0.02, "alias": "mDot"}],
+        },
+    ],
+}
+
+
+@pytest.fixture(scope="module")
+def results():
+    mas = LocalMAS([ROOM, COOLER, SIM], env={"rt": False})
+    mas.run(until=1800)
+    return mas.get_results()
+
+
+def test_room_cools_down(results):
+    sim = results["Simulation"]["simulator"]
+    temps = sim[("variable", "T")] if ("variable", "T") in sim else sim["T"]
+    temps = np.asarray(temps, dtype=float)
+    assert temps[0] > temps[-1], "room should cool towards the comfort band"
+    assert temps[-1] < 297.0
+
+
+def test_couplings_agree(results):
+    """After the last full round, room and cooler trajectories must be
+    close (consensus)."""
+    room = results["CooledRoom"]["admm_module"]["admm"]
+    cooler = results["Cooler"]["admm_module"]["admm"]
+    t_last = room.index.get_level_values("time").max()
+    it_last = room.loc[t_last].index.get_level_values("iteration").max()
+    r = room.loc[(t_last, it_last)][("variable", "mDot")].to_numpy()
+    c = cooler.loc[(t_last, it_last)][("variable", "mDot_out")].to_numpy()
+    assert np.max(np.abs(r - c)) < 5e-3
+
+
+def test_iteration_results_shape(results):
+    room = results["CooledRoom"]["admm_module"]["admm"]
+    assert room.index.names == ["time", "iteration", "grid"]
+    n_iters = room.index.get_level_values("iteration").nunique()
+    assert n_iters >= 2
