@@ -139,8 +139,14 @@ def transcribe(
     collocation_method: str = "radau",
     integrator: str = "rk4",
     integrator_substeps: int = 3,
+    fix_initial_state: bool = True,
 ) -> TranscribedOCP:
-    """Transcribe `model` over an N-interval horizon with step `dt`."""
+    """Transcribe `model` over an N-interval horizon with step `dt`.
+
+    ``fix_initial_state=False`` drops the ``x[0] = x0`` pin — the estimation
+    (MHE) configuration, where the whole state trajectory is free and the
+    measurement-tracking cost anchors it (reference MHE backend,
+    ``casadi_/mhe.py:34-123``)."""
     if method not in ("collocation", "multiple_shooting"):
         raise ValueError(f"unknown transcription method {method!r}")
     exo_names, splice, splice_du = _input_splicer(model, control_names)
@@ -177,7 +183,7 @@ def transcribe(
     def g_fn(w_flat, theta: OCPParams):
         w = unflatten(w_flat)
         x, u = w["x"], w["u"]
-        parts = [x[0] - theta.x0]
+        parts = [x[0] - theta.x0] if fix_initial_state else []
         if is_colloc:
             xc = w["xc"]
 
