@@ -18,3 +18,4 @@ from agentlib_mpc_tpu.backends.backend import (
 from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend
 from agentlib_mpc_tpu.backends.admm_backend import ADMMBackend
 from agentlib_mpc_tpu.backends.mhe_backend import MHEBackend
+from agentlib_mpc_tpu.backends.minlp_backend import CIABackend, MINLPBackend

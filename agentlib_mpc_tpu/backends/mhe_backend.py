@@ -141,19 +141,11 @@ class MHEBackend(OptimizationBackend):
         self.tracked_states = tracked
         self.model = make_mhe_model(base, var_ref.estimated_parameters,
                                     tracked)
-        disc = dict(self.config.get("discretization_options", {}))
-        method = disc.get("method", "collocation")
-        if method == "multiple_shooting":
-            kwargs = dict(method="multiple_shooting",
-                          integrator=disc.get("integrator", "rk4"),
-                          integrator_substeps=int(
-                              disc.get("integrator_substeps", 3)))
-        else:
-            kwargs = dict(method="collocation",
-                          collocation_degree=int(
-                              disc.get("collocation_order", 3)),
-                          collocation_method=disc.get(
-                              "collocation_method", "radau"))
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            transcription_kwargs_from_config
+
+        kwargs = transcription_kwargs_from_config(
+            self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, var_ref.estimated_inputs,
                               N=self.N, dt=self.time_step,
                               fix_initial_state=False, **kwargs)
@@ -207,11 +199,19 @@ class MHEBackend(OptimizationBackend):
             return default if v is None else v
 
         # backwards-sampled exogenous trajectories: known inputs, measured
-        # states (from history), weights (scalars)
+        # states (from history), weights (scalars). Each interval carries
+        # the sample at its END point ((i+1)·dt past t0), so the newest
+        # measurement — the one taken at `now` — enters the final interval's
+        # tracking cost and anchors the published estimate x(now); with the
+        # default Radau collocation the dominant quadrature points sit at
+        # interval ends, where that alignment is exact (the reference
+        # samples its measurement grid through `now` the same way,
+        # ``casadi_/mhe.py:414-542``).
+        grid_d = (np.arange(N) + 1) * self.time_step
         d_traj = np.zeros((N, len(self._exo_names)))
         for j, name in enumerate(self._exo_names):
             d_traj[:, j] = sample(val_of(name, model.get_var(name).value),
-                                  grid_u, current=t0)
+                                  grid_d, current=t0)
 
         p = np.array([float(val_of(n, model.get_var(n).value))
                       for n in model.parameter_names])

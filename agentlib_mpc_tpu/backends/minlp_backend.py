@@ -1,0 +1,246 @@
+"""Mixed-integer MPC backends: relaxed NLP + rounding / CIA + fixed re-solve.
+
+Counterparts of the reference's MINLP backends:
+- ``jax_minlp`` ↔ ``casadi_minlp`` (``optimization_backends/casadi_/
+  minlp.py:16-199``): there, binary controls are flagged ``discrete`` and a
+  Bonmin/Gurobi branch-and-bound solves the true MINLP. Here the schedule
+  is obtained by rounding the relaxed optimum and re-solving with the
+  binaries fixed.
+- ``jax_cia`` ↔ ``casadi_cia`` (``casadi_/minlp_cia.py:75-171``): the
+  3-phase combinatorial-integer-approximation scheme — relaxed NLP →
+  branch-and-bound CIA (native C++, ``ops/cia.py`` replacing pycombina) →
+  NLP with the binary schedule fixed (the reference pins binaries via
+  bounds, ``constrain_binary_inputs``, ``minlp_cia.py:152-171``).
+
+Two compiled programs, not one with degenerate bounds: the relaxed phase
+transcribes binaries as ordinary [0,1] controls; the fixed phase is a
+*separate* transcription in which the binaries are exogenous inputs — the
+schedule rides the ``d_traj`` parameter, so the log-barrier never sees a
+(near-)zero-width box. Both programs compile once at setup and stay hot
+across the closed loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.backends.backend import (
+    VariableReference,
+    register_backend,
+)
+from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend
+from agentlib_mpc_tpu.ops.cia import cia_objective, solve_cia, sum_up_rounding
+from agentlib_mpc_tpu.ops.solver import solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+
+
+@register_backend("jax_minlp", "casadi_minlp")
+class MINLPBackend(JAXBackend):
+    """Relaxed solve + binary schedule + fixed solve.
+
+    Config additions:
+        binary_method: "rounding" (default) | "sur" | "cia"
+        cia_options: {"max_switches": int | [int...], "sos1": bool,
+                      "max_nodes": int}
+    """
+
+    default_binary_method = "rounding"
+
+    def setup_optimization(self, var_ref: VariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        self.binary_names = list(var_ref.binary_controls)
+        if not self.binary_names:
+            raise ValueError(
+                "MINLP backend configured without binary_controls; use the "
+                "'jax' backend for purely continuous problems")
+        merged = dataclasses.replace(
+            var_ref,
+            controls=list(var_ref.controls) + self.binary_names,
+            binary_controls=[],
+        )
+        super().setup_optimization(merged, time_step, prediction_horizon)
+        self._bin_idx = np.array(
+            [merged.controls.index(n) for n in self.binary_names])
+        self._cont_names = list(var_ref.controls)
+        self._method = self.config.get(
+            "binary_method", self.default_binary_method)
+        self._cia_options = dict(self.config.get("cia_options", {}))
+        self._build_fixed_program(var_ref)
+
+    def _build_fixed_program(self, var_ref: VariableReference) -> None:
+        """Second transcription: binaries as exogenous inputs."""
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            transcription_kwargs_from_config
+
+        kw = transcription_kwargs_from_config(
+            self.config.get("discretization_options"))
+        self.ocp_fixed = transcribe(self.model, self._cont_names, N=self.N,
+                                    dt=self.time_step, **kw)
+        # schedule-tracking phase: binaries are data, so what matters is
+        # feasibility + complementarity; the f32 stationarity floor scales
+        # with the (large) comfort-slack gradient when the fixed schedule
+        # forces a violation, so the stall-acceptance dual tolerance is wide
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            solver_options_from_config
+
+        fixed_solver_cfg = {"dual_inf_tol": 100.0, "compl_inf_tol": 1e-2,
+                            **dict(self.config.get("solver", {}) or {}),
+                            **dict(self.config.get("fixed_solver", {}) or {})}
+        self._fixed_options = solver_options_from_config(fixed_solver_cfg)
+        # exo vector of the fixed program = binaries ∪ relaxed program's exo;
+        # map both into its declaration order
+        fixed_exo = list(self.ocp_fixed.exo_names)
+        self._fixed_bin_cols = np.array(
+            [fixed_exo.index(n) for n in self.binary_names])
+        self._fixed_exo_cols = np.array(
+            [fixed_exo.index(n) for n in self._exo_names], dtype=int) \
+            if self._exo_names else np.zeros(0, dtype=int)
+        self._cont_idx = np.array(
+            [self.var_ref.controls.index(n) for n in self._cont_names],
+            dtype=int)
+        ocp = self.ocp_fixed
+        opts = self._fixed_options
+
+        @jax.jit
+        def step_fixed(x0, u_prev_c, d_traj_fixed, p, x_lb, x_ub,
+                       u_lb_c, u_ub_c, mu0, t0):
+            theta = ocp.default_params(
+                x0=x0, u_prev=u_prev_c, d_traj=d_traj_fixed, p=p,
+                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb_c, u_ub=u_ub_c, t0=t0)
+            lb, ub = ocp.bounds(theta)
+            # fresh guess every solve: the schedule changes step to step, and
+            # empirically the program's own guess (x ≡ x0) converges in a few
+            # iterations where a rebased relaxed optimum stalls in f32
+            res = solve_nlp(ocp.nlp, ocp.initial_guess(theta), theta, lb, ub,
+                            opts, mu0=mu0)
+            traj = ocp.trajectories(res.w, theta)
+            u0_c = (jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+                    if len(self._cont_names) else jnp.zeros((0,)))
+            return u0_c, traj, res.stats
+
+        self._step_fixed = step_fixed
+
+    # -- binary scheduling (host side, between the two device solves) ---------
+
+    def _binary_schedule(self, b_rel: np.ndarray) -> tuple[np.ndarray, float]:
+        dt = np.full(len(b_rel), self.time_step)
+        if self._method == "rounding":
+            B = np.round(np.clip(b_rel, 0.0, 1.0))
+            return B, cia_objective(b_rel, B, dt)
+        if self._method == "sur":
+            B = sum_up_rounding(b_rel, dt,
+                                sos1=bool(self._cia_options.get("sos1")))
+            return B, cia_objective(b_rel, B, dt)
+        if self._method == "cia":
+            ms = self._cia_options.get("max_switches")
+            if isinstance(ms, int):
+                ms = [ms] * len(self.binary_names)
+            return solve_cia(
+                b_rel, self.time_step, max_switches=ms,
+                sos1=bool(self._cia_options.get("sos1")),
+                max_nodes=int(self._cia_options.get("max_nodes", 2_000_000)))
+        raise ValueError(f"unknown binary_method {self._method!r}")
+
+    # -- three-phase solve ----------------------------------------------------
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub = \
+            self._collect(now, variables)
+        bi = self._bin_idx
+        # relaxed box = externally supplied bound trajectories intersected
+        # with [0,1] — a published ``on__ub = 0`` (lock-out) must carry
+        # through to the schedule (reference pins binaries via bounds,
+        # ``minlp_cia.py:152-171``)
+        u_lb = u_lb.copy()
+        u_ub = u_ub.copy()
+        u_lb[:, bi] = np.clip(u_lb[:, bi], 0.0, 1.0)
+        u_ub[:, bi] = np.clip(u_ub[:, bi], 0.0, 1.0)
+        dtype = self._w_guess.dtype
+        mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
+                          dtype=dtype)
+        t_now = jnp.asarray(float(now))
+        t_start = _time.perf_counter()
+
+        # phase 1: relaxed NLP
+        _, traj_rel, w_next, y_next, z_next, stats_rel = self._step(
+            x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+            self._w_guess, self._y_guess, self._z_guess, mu0, t_now)
+        b_rel = np.asarray(traj_rel["u"])[:, bi]
+
+        # phase 2: combinatorial approximation on host, clamped to the
+        # binary values the bound trajectories actually admit (an interval
+        # with ub < 1 cannot switch on; lb > 0 cannot switch off)
+        B, eta = self._binary_schedule(b_rel)
+        eps = 1e-9
+        b_min = (u_lb[:, bi] > eps).astype(float)
+        b_max = (u_ub[:, bi] >= 1.0 - eps).astype(float)
+        B = np.clip(B, b_min, b_max)
+
+        # phase 3: binaries enter as exogenous data of the fixed program
+        ci = self._cont_idx
+        n_fixed_exo = len(self.ocp_fixed.exo_names)
+        d_fixed = np.zeros((self.N, n_fixed_exo))
+        d_fixed[:, self._fixed_bin_cols] = B
+        if len(self._fixed_exo_cols):
+            d_fixed[:, self._fixed_exo_cols] = d_traj
+        u0_c, traj, stats = self._step_fixed(
+            x0, u_prev[ci] if len(ci) else np.zeros(0), d_fixed, p,
+            x_lb, x_ub, u_lb[:, ci], u_ub[:, ci],
+            jnp.asarray(self.solver_options.mu_init, dtype=dtype), t_now)
+        jax.block_until_ready(traj)
+        wall = _time.perf_counter() - t_start
+
+        # warm-start bookkeeping rides the relaxed program; a non-finite
+        # relaxed result must not poison the next step (reset instead)
+        if bool(jnp.all(jnp.isfinite(w_next))):
+            self._w_guess, self._y_guess, self._z_guess = \
+                w_next, y_next, z_next
+            self._cold = False
+        else:
+            self.logger.warning("relaxed solve at t=%s produced non-finite "
+                                "iterates; resetting warm start", now)
+            self._reset_warm_start()
+
+        # assemble the actuation vector in merged-control order
+        u0 = np.zeros(len(self.var_ref.controls))
+        if len(ci):
+            u0[ci] = np.asarray(u0_c)
+        u0[bi] = B[0]
+        stats_row = {
+            "time": float(now),
+            "iterations": int(stats_rel.iterations) + int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+            "cia_objective": float(eta),
+            "relaxed_objective": float(stats_rel.objective),
+            "relaxed_success": bool(stats_rel.success),
+        }
+        self.stats_history.append(stats_row)
+        if not stats_row["success"]:
+            self.logger.warning(
+                "MINLP solve at t=%s did not converge (kkt=%.2e)",
+                now, stats_row["kkt_error"])
+        return {
+            "u0": {n: float(u0[i])
+                   for i, n in enumerate(self.var_ref.controls)},
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+            "traj_relaxed": {k: np.asarray(v) for k, v in traj_rel.items()},
+            "binary_schedule": B,
+            "stats": stats_row,
+        }
+
+
+@register_backend("jax_cia", "casadi_cia")
+class CIABackend(MINLPBackend):
+    """MINLP backend defaulting to the branch-and-bound CIA schedule."""
+
+    default_binary_method = "cia"

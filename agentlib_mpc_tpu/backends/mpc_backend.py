@@ -33,6 +33,23 @@ from agentlib_mpc_tpu.ops.transcription import transcribe
 from agentlib_mpc_tpu.utils.sampling import InterpolationMethods, sample
 
 
+def transcription_kwargs_from_config(disc: dict) -> dict:
+    """Translate reference-style ``discretization_options`` into `transcribe`
+    keyword arguments (shared by the MPC, MHE and MINLP backends)."""
+    disc = dict(disc or {})
+    if disc.get("method", "collocation") == "multiple_shooting":
+        return dict(
+            method="multiple_shooting",
+            integrator=disc.get("integrator", "rk4"),
+            integrator_substeps=int(disc.get("integrator_substeps", 3)),
+        )
+    return dict(
+        method="collocation",
+        collocation_degree=int(disc.get("collocation_order", 3)),
+        collocation_method=disc.get("collocation_method", "radau"),
+    )
+
+
 def solver_options_from_config(cfg: dict) -> SolverOptions:
     """Translate a reference-style solver config into SolverOptions.
     Unknown keys (e.g. the reference's ipopt-specific options) are ignored
@@ -58,20 +75,8 @@ class JAXBackend(OptimizationBackend):
         self.time_step = float(time_step)
         self.N = int(prediction_horizon)
         self.model = load_model(self.config["model"])
-        disc = dict(self.config.get("discretization_options", {}))
-        method = disc.get("method", "collocation")
-        if method == "multiple_shooting":
-            trans_kwargs = dict(
-                method="multiple_shooting",
-                integrator=disc.get("integrator", "rk4"),
-                integrator_substeps=int(disc.get("integrator_substeps", 3)),
-            )
-        else:
-            trans_kwargs = dict(
-                method="collocation",
-                collocation_degree=int(disc.get("collocation_order", 3)),
-                collocation_method=disc.get("collocation_method", "radau"),
-            )
+        trans_kwargs = transcription_kwargs_from_config(
+            self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, var_ref.controls, N=self.N,
                               dt=self.time_step, **trans_kwargs)
         self.solver_options = solver_options_from_config(

@@ -158,3 +158,42 @@ class ZoneWithSupply(Model):
             + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
         )
         return eq
+
+
+class SwitchedRoom(Model):
+    """Single zone with an on/off chiller — the mixed-integer benchmark
+    (reference ``examples/one_room_mpc/mixed_integer``: a binary cooling
+    stage enters the energy balance; the MPC must schedule it). The binary
+    control ``on`` is declared as an ordinary [0,1] input; the MINLP/CIA
+    backends enforce integrality (``backends/minlp_backend.py``).
+    """
+
+    inputs = [
+        control_input("on", 0.0, lb=0.0, ub=1.0,
+                      description="chiller stage on/off (binary control)"),
+        control_input("load", 180.0, unit="W", description="heat load"),
+        control_input("T_upper", 295.15, unit="K",
+                      description="soft upper comfort bound"),
+    ]
+    states = [
+        state("T", 294.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("C", 100000.0, unit="J/K"),
+        parameter("Q_cool", 500.0, unit="W", description="chiller capacity"),
+        parameter("s_T", 10.0, description="comfort slack weight"),
+        parameter("r_on", 0.01, description="chiller run cost"),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", (v.load - v.on * v.Q_cool) / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.on, weight=v.r_on, name="chiller_costs")
+            + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
+        )
+        return eq

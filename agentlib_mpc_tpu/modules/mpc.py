@@ -178,3 +178,40 @@ class MPC(BaseMPC):
     """Alias of the full MPC (the reference's ``mpc`` type adds NARX lag
     history on top of BaseMPC; lag collection lives in the ML backend
     here — see backends/ml_backend)."""
+
+
+@register_module("minlp_mpc")
+class MINLPMPC(BaseMPC):
+    """Mixed-integer MPC: adds the ``binary_controls`` variable group and
+    actuates the scheduled binaries alongside the continuous controls
+    (reference ``modules/mpc/minlp_mpc.py:17-86``). Requires a MINLP-family
+    backend (``jax_minlp`` / ``jax_cia``)."""
+
+    def _assert_config_matches_model(self, model) -> None:
+        super()._assert_config_matches_model(model)
+        errors = []
+        for name in self.var_ref.binary_controls:
+            if name not in model.input_names:
+                errors.append(f"binary control {name!r} is not a model input")
+            else:
+                var = model.get_var(name)
+                if not (var.lb >= 0.0 and var.ub <= 1.0):
+                    errors.append(
+                        f"binary control {name!r} must be bounded in [0, 1]")
+        if not self.var_ref.binary_controls:
+            errors.append("minlp_mpc requires a non-empty binary_controls "
+                          "group")
+        if errors:
+            raise ValueError(
+                f"MINLP MPC config does not match model: {'; '.join(errors)}")
+
+    def set_actuation(self, result: dict) -> None:
+        """Continuous controls clip to bounds; binaries actuate exactly
+        (reference ``MINLPMPC.set_actuation``, ``minlp_mpc.py:79-86``)."""
+        binaries = set(self.var_ref.binary_controls)
+        for name, value in result["u0"].items():
+            if name in binaries:
+                self.set(name, float(round(value)))
+            else:
+                var = self.vars[name]
+                self.set(name, float(np.clip(value, var.lb, var.ub)))

@@ -1,0 +1,70 @@
+"""Native (C++) components, loaded through ctypes.
+
+The reference outsources its combinatorial heavy lifting to third-party
+C++ binaries (pycombina's branch-and-bound, SURVEY.md §2.8). This package
+holds the framework's own native sources, compiled on demand with the
+system toolchain into a per-version shared library next to the sources.
+Every native entry point has a pure-Python fallback at its call site, so a
+missing compiler degrades performance, never capability.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import tempfile
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_DIR = Path(__file__).parent
+_LIB_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+
+def _so_path(name: str) -> Path:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return _DIR / f"_{name}{suffix}"
+
+
+def _compile(name: str) -> Path | None:
+    src = _DIR / f"{name}.cpp"
+    out = _so_path(name)
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           str(src), "-o", str(out)]
+    try:
+        # build into a temp file then rename: concurrent test workers must
+        # never dlopen a half-written .so
+        with tempfile.NamedTemporaryFile(
+                dir=_DIR, suffix=".so.tmp", delete=False) as tmp:
+            tmp_path = Path(tmp.name)
+        cmd[-1] = str(tmp_path)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp_path, out)
+        return out
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.warning("native build of %s failed (%s); using the Python "
+                       "fallback", name, exc)
+        try:
+            tmp_path.unlink(missing_ok=True)
+        except (OSError, NameError):
+            pass
+        return None
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Compile (if needed) and dlopen native/<name>.cpp. None on failure."""
+    if name not in _LIB_CACHE:
+        path = _compile(name)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(str(path))
+            except OSError as exc:  # pragma: no cover - load after build
+                logger.warning("cannot load %s: %s", path, exc)
+        _LIB_CACHE[name] = lib
+    return _LIB_CACHE[name]
