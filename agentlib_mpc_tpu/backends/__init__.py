@@ -19,3 +19,4 @@ from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend
 from agentlib_mpc_tpu.backends.admm_backend import ADMMBackend
 from agentlib_mpc_tpu.backends.mhe_backend import MHEBackend
 from agentlib_mpc_tpu.backends.minlp_backend import CIABackend, MINLPBackend
+from agentlib_mpc_tpu.backends.ml_backend import MLADMMBackend, MLBackend

@@ -97,18 +97,11 @@ class ADMMBackend(JAXBackend):
                        if self._coup_kinds[n] == "input"]
         opt_controls = [*var_ref.controls, *input_coups]
 
-        disc = dict(self.config.get("discretization_options", {}))
-        method = disc.get("method", "collocation")
-        if method == "multiple_shooting":
-            trans_kwargs = dict(
-                method="multiple_shooting",
-                integrator=disc.get("integrator", "rk4"),
-                integrator_substeps=int(disc.get("integrator_substeps", 3)))
-        else:
-            trans_kwargs = dict(
-                method="collocation",
-                collocation_degree=int(disc.get("collocation_order", 3)),
-                collocation_method=disc.get("collocation_method", "radau"))
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            transcription_kwargs_from_config
+
+        trans_kwargs = transcription_kwargs_from_config(
+            self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, opt_controls, N=self.N,
                               dt=self.time_step, **trans_kwargs)
         self.solver_options = solver_options_from_config(

@@ -198,20 +198,25 @@ class MHEBackend(OptimizationBackend):
             v = variables.get(name)
             return default if v is None else v
 
-        # backwards-sampled exogenous trajectories: known inputs, measured
-        # states (from history), weights (scalars). Each interval carries
-        # the sample at its END point ((i+1)·dt past t0), so the newest
-        # measurement — the one taken at `now` — enters the final interval's
-        # tracking cost and anchors the published estimate x(now); with the
-        # default Radau collocation the dominant quadrature points sit at
-        # interval ends, where that alignment is exact (the reference
-        # samples its measurement grid through `now` the same way,
-        # ``casadi_/mhe.py:414-542``).
-        grid_d = (np.arange(N) + 1) * self.time_step
+        # backwards-sampled exogenous trajectories. Two grids:
+        # - measured states and weights sample at interval END points
+        #   ((i+1)·dt past t0): the newest measurement — taken at `now` —
+        #   then enters the final interval's tracking cost and anchors the
+        #   published estimate x(now); with the default Radau collocation
+        #   the dominant quadrature points sit at interval ends, where that
+        #   alignment is exact (the reference samples its measurement grid
+        #   through `now` likewise, ``casadi_/mhe.py:414-542``).
+        # - known applied inputs sample at interval STARTS: the broker
+        #   holds a published value until the next publish (ZOH), so the
+        #   value at t_i is what drove the plant over [t_i, t_i+dt].
+        grid_end = (np.arange(N) + 1) * self.time_step
         d_traj = np.zeros((N, len(self._exo_names)))
         for j, name in enumerate(self._exo_names):
+            is_meas = name.startswith(MEASURED_PREFIX) \
+                or name.startswith(WEIGHT_PREFIX)
             d_traj[:, j] = sample(val_of(name, model.get_var(name).value),
-                                  grid_d, current=t0)
+                                  grid_end if is_meas else grid_u,
+                                  current=t0)
 
         p = np.array([float(val_of(n, model.get_var(n).value))
                       for n in model.parameter_names])

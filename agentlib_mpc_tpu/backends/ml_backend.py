@@ -1,0 +1,399 @@
+"""Data-driven (ML-surrogate) MPC backends.
+
+Counterparts of the reference's ML backends:
+- ``jax_ml`` ↔ ``casadi_ml``/``casadi_nn`` (``optimization_backends/
+  casadi_/casadi_ml.py``: NARX shooting :111-373, lag collection contract
+  ``get_lags_per_variable`` :388-397): the OCP evolves through the trained
+  surrogate's discrete step instead of an integrator; past values of lagged
+  variables arrive per solve and pad the pre-horizon window.
+- ``jax_admm_ml`` ↔ ``casadi_admm_ml`` (``casadi_/casadi_admm_ml.py``):
+  the same NARX OCP with consensus/exchange augmented-Lagrangian coupling
+  terms for distributed MPC.
+
+Hot-swap: a retrained serialized model becomes new predictor parameters in
+the params tuple — the compiled solve stays valid when shapes match
+(reference rebuilds its CasADi graph instead, ``casadi_ml_model.py:205-231``).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.backends.admm_backend import (
+    ADMMVariableReference,
+    EXCHANGE_MEAN_PREFIX,
+    EXCHANGE_MULTIPLIER_PREFIX,
+    MEAN_PREFIX,
+    MULTIPLIER_PREFIX,
+)
+from agentlib_mpc_tpu.backends.backend import (
+    OptimizationBackend,
+    VariableReference,
+    load_model,
+    register_backend,
+)
+from agentlib_mpc_tpu.backends.mpc_backend import solver_options_from_config
+from agentlib_mpc_tpu.models.ml_model import MLModel
+from agentlib_mpc_tpu.ml.serialized import load_serialized_model
+from agentlib_mpc_tpu.ops.admm import consensus_penalty, exchange_penalty
+from agentlib_mpc_tpu.ops.ml_transcription import transcribe_ml
+from agentlib_mpc_tpu.ops.solver import NLPFunctions, solve_nlp
+from agentlib_mpc_tpu.utils.sampling import sample
+
+
+def load_ml_model(model_cfg, dt=None) -> MLModel:
+    """Like `load_model` but wires ``ml_model_sources`` into the MLModel
+    constructor (reference model config key, ``casadi_ml_model.py:61-122``)."""
+    if isinstance(model_cfg, MLModel):
+        return model_cfg
+    model_cfg = dict(model_cfg)
+    sources = model_cfg.pop("ml_model_sources", None)
+    model = load_model(model_cfg, dt=dt)
+    if not isinstance(model, MLModel):
+        raise TypeError(
+            f"ML backend requires an MLModel subclass, got "
+            f"{type(model).__name__}")
+    if sources:
+        model.register_ml_models(
+            *[load_serialized_model(s) for s in sources])
+    return model
+
+
+@register_backend("jax_ml", "casadi_ml", "casadi_nn")
+class MLBackend(OptimizationBackend):
+    """NARX multiple shooting over the unified ML predict step."""
+
+    def setup_optimization(self, var_ref: VariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        self.var_ref = var_ref
+        self.time_step = float(time_step)
+        self.N = int(prediction_horizon)
+        self.model = load_ml_model(self.config["model"], dt=self.time_step)
+        self.ocp = transcribe_ml(self.model, var_ref.controls, N=self.N,
+                                 dt=self.time_step)
+        self.solver_options = solver_options_from_config(
+            self.config.get("solver"))
+        self._exo_names = list(self.ocp.exo_names)
+        self._build_step_fn()
+        self._reset_warm_start()
+        if self.config.get("precompile"):
+            self.solve(0.0, {})
+            self.stats_history.clear()
+            self._reset_warm_start()
+
+    def get_lags_per_variable(self) -> dict[str, int]:
+        return self.model.get_lags_per_variable()
+
+    def update_ml_models(self, *serialized) -> None:
+        """Hot-swap retrained surrogates. Same lag structure → parameters
+        swap into the compiled pipeline; changed lags/columns → the NARX
+        transcription's history windows are laid out differently, so the
+        OCP is re-transcribed and recompiled (silently keeping the old
+        layout would time-shift every window)."""
+        lags_before = dict(self.model.ml_lags)
+        self.model.update_ml_models(
+            *[load_serialized_model(s) for s in serialized])
+        if self.model.ml_lags != lags_before:
+            self.logger.info(
+                "hot-swapped model changed lag structure %s -> %s; "
+                "re-transcribing", lags_before, self.model.ml_lags)
+            self.ocp = transcribe_ml(self.model, self.var_ref.controls,
+                                     N=self.N, dt=self.time_step)
+            self._exo_names = list(self.ocp.exo_names)
+            self._build_step_fn()
+            self._reset_warm_start()
+
+    # -- compiled pipeline ----------------------------------------------------
+
+    def _build_step_fn(self) -> None:
+        ocp = self.ocp
+        opts = self.solver_options
+
+        @jax.jit
+        def step(x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                 ml_params, w_guess, y_guess, z_guess, mu0, t0):
+            theta = ocp.default_params(
+                x0=x0, u_prev=u_prev, past=past, d_traj=d_traj, p=p,
+                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0,
+                ml_params=ml_params)
+            lb, ub = ocp.bounds(theta)
+            res = solve_nlp(ocp.nlp, w_guess, theta, lb, ub, opts,
+                            y0=y_guess, z0=z_guess, mu0=mu0)
+            traj = ocp.trajectories(res.w, theta)
+            u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+            w_next = ocp.shift_guess(res.w, theta)
+            return u0, traj, w_next, res.y, res.z, res.stats
+
+        self._step = step
+
+    def _reset_warm_start(self) -> None:
+        theta0 = self.ocp.default_params()
+        self._w_guess = self.ocp.initial_guess(theta0)
+        self._y_guess = jnp.zeros((self.ocp.n_g,))
+        self._z_guess = jnp.full((self.ocp.n_h,), 0.1).astype(
+            self._w_guess.dtype)
+        self._cold = True
+
+    # -- per-solve input assembly ---------------------------------------------
+
+    def _collect(self, now: float, variables: dict[str, Any]):
+        model = self.model
+        vr = self.var_ref
+        N = self.N
+        dt = self.time_step
+        grid_u = np.arange(N) * dt
+
+        def val_of(name, default):
+            v = variables.get(name)
+            return default if v is None else v
+
+        def now_value(name):
+            """Newest scalar from a value that may be a history series."""
+            v = val_of(name, model.get_var(name).value)
+            if np.isscalar(v) or (isinstance(v, np.ndarray) and v.ndim == 0):
+                return float(v)
+            return float(sample(v, [0.0], current=now)[0])
+
+        x0 = np.array([now_value(n) for n in self.ocp.dyn_names])
+        u_prev = np.array([now_value(n) for n in vr.controls]) \
+            if vr.controls else np.zeros(0)
+
+        # pre-horizon lag windows: values at now−dt, now−2dt, … — history
+        # series (pd.Series / (times, values)) interpolate; scalars broadcast
+        # (reference pre-horizon grid, casadi_ml.py:121-154)
+        past = {}
+        for name in model.history_names:
+            L = max(model.ml_lags.get(name, 1), 1)
+            if L <= 1:
+                past[name] = jnp.zeros((0,))
+                continue
+            grid_past = -np.arange(1, L) * dt
+            v = val_of(name, model.get_var(name).value)
+            past[name] = jnp.asarray(sample(v, grid_past, current=now))
+
+        d_traj = np.zeros((N, len(self._exo_names)))
+        for j, name in enumerate(self._exo_names):
+            d_traj[:, j] = sample(val_of(name, model.get_var(name).value),
+                                  grid_u, current=now)
+        p = np.array([now_value(n) for n in model.parameter_names])
+
+        def bound_traj(names, grid, kind):
+            out = np.zeros((len(grid), len(names)))
+            for j, n in enumerate(names):
+                b = variables.get(f"{n}__{kind}")
+                if b is None:
+                    b = getattr(model.get_var(n), kind)
+                out[:, j] = sample(b, grid, current=now)
+            return out
+
+        grid_x = np.arange(N + 1) * dt
+        x_lb = bound_traj(self.ocp.dyn_names, grid_x, "lb")
+        x_ub = bound_traj(self.ocp.dyn_names, grid_x, "ub")
+        u_lb = bound_traj(vr.controls, grid_u, "lb")
+        u_ub = bound_traj(vr.controls, grid_u, "ub")
+        return x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub = \
+            self._collect(now, variables)
+        mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
+                          dtype=self._w_guess.dtype)
+        t_start = _time.perf_counter()
+        u0, traj, w_next, y_next, z_next, stats = self._step(
+            x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+            self.model.ml_params,
+            self._w_guess, self._y_guess, self._z_guess, mu0,
+            jnp.asarray(float(now)))
+        u0.block_until_ready()
+        wall = _time.perf_counter() - t_start
+        self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
+        self._cold = False
+
+        stats_row = {
+            "time": float(now),
+            "iterations": int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+        }
+        self.stats_history.append(stats_row)
+        if not stats_row["success"]:
+            self.logger.warning("ML solve at t=%s did not converge "
+                                "(kkt=%.2e)", now, stats_row["kkt_error"])
+        return {
+            "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+            "stats": stats_row,
+        }
+
+
+@register_backend("jax_admm_ml", "casadi_admm_ml")
+class MLADMMBackend(MLBackend):
+    """NARX OCP + augmented-Lagrangian coupling terms (reference
+    ``CasadiADMMNNSystem``, ``casadi_/casadi_admm_ml.py:35-120``)."""
+
+    def setup_optimization(self, var_ref: ADMMVariableReference,
+                           time_step: float, prediction_horizon: int) -> None:
+        couplings = list(getattr(var_ref, "couplings", []))
+        exchange = list(getattr(var_ref, "exchange", []))
+        self.coupling_names = couplings
+        self.exchange_names = exchange
+        self._module_controls = list(var_ref.controls)
+
+        model = load_ml_model(self.config["model"], dt=time_step)
+        input_coups = [n for n in (*couplings, *exchange)
+                       if n in model.input_names
+                       and n not in var_ref.controls]
+        merged = ADMMVariableReference(
+            states=var_ref.states,
+            controls=[*var_ref.controls, *input_coups],
+            inputs=[n for n in var_ref.inputs if n not in input_coups],
+            parameters=var_ref.parameters,
+            outputs=var_ref.outputs,
+            couplings=couplings,
+            exchange=exchange,
+        )
+        self.config = dict(self.config)
+        self.config["model"] = model
+        super().setup_optimization(merged, time_step, prediction_horizon)
+
+    @property
+    def coupling_grid(self) -> np.ndarray:
+        return np.arange(self.N) * self.time_step
+
+    def _coupling_extractor(self, name):
+        ocp = self.ocp
+        model = self.model
+        N = self.N
+        if name in ocp.control_names:
+            col = ocp.control_names.index(name)
+            return lambda w_flat, theta: ocp.unflatten(w_flat)["u"][:, col]
+        if name in model.output_names:
+            out_idx = model.output_names.index(name)
+
+            def extract(w_flat, theta):
+                traj = ocp.trajectories(w_flat, theta)
+                return traj["y"][:N, out_idx]
+
+            return extract
+        raise ValueError(
+            f"coupling {name!r} is neither an optimized input nor an output")
+
+    def _build_step_fn(self) -> None:
+        ocp = self.ocp
+        opts = self.solver_options
+        extractors = {n: self._coupling_extractor(n)
+                      for n in (*self.coupling_names, *self.exchange_names)}
+        coup_names = list(self.coupling_names)
+        ex_names = list(self.exchange_names)
+        dt = ocp.dt
+
+        def f_aug(w_flat, theta):
+            ocp_theta, means, lams, ex_diffs, ex_lams, rho = theta
+            val = ocp.nlp.f(w_flat, ocp_theta)
+            for k, name in enumerate(coup_names):
+                x_loc = extractors[name](w_flat, ocp_theta)
+                val = val + dt * consensus_penalty(x_loc, means[k], lams[k],
+                                                   rho)
+            for k, name in enumerate(ex_names):
+                x_loc = extractors[name](w_flat, ocp_theta)
+                val = val + dt * exchange_penalty(x_loc, ex_diffs[k],
+                                                  ex_lams[k], rho)
+            return val
+
+        nlp = NLPFunctions(
+            f=f_aug,
+            g=lambda w, th: ocp.nlp.g(w, th[0]),
+            h=lambda w, th: ocp.nlp.h(w, th[0]))
+
+        @jax.jit
+        def step(x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                 ml_params, means, lams, ex_diffs, ex_lams, rho,
+                 w_guess, y_guess, z_guess, mu0, t0):
+            theta = ocp.default_params(
+                x0=x0, u_prev=u_prev, past=past, d_traj=d_traj, p=p,
+                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0,
+                ml_params=ml_params)
+            lb, ub = ocp.bounds(theta)
+            full_theta = (theta, means, lams, ex_diffs, ex_lams, rho)
+            res = solve_nlp(nlp, w_guess, full_theta, lb, ub, opts,
+                            y0=y_guess, z0=z_guess, mu0=mu0)
+            traj = ocp.trajectories(res.w, theta)
+            u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+            coup_trajs = {n: extractors[n](res.w, theta)
+                          for n in (*coup_names, *ex_names)}
+            w_next = ocp.shift_guess(res.w, theta)
+            return u0, traj, coup_trajs, w_next, res.y, res.z, res.stats
+
+        self._step_admm = step
+
+    def solve(self, now: float, variables: dict[str, Any]) -> dict:
+        x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub = \
+            self._collect(now, variables)
+        grid = self.coupling_grid
+
+        def traj_of(key):
+            v = variables.get(key)
+            if v is None:
+                v = 0.0
+            return sample(v, grid, current=now)
+
+        means = np.stack([traj_of(f"{MEAN_PREFIX}_{n}")
+                          for n in self.coupling_names]) \
+            if self.coupling_names else np.zeros((0, self.N))
+        lams = np.stack([traj_of(f"{MULTIPLIER_PREFIX}_{n}")
+                         for n in self.coupling_names]) \
+            if self.coupling_names else np.zeros((0, self.N))
+        ex_diffs = np.stack([traj_of(f"{EXCHANGE_MEAN_PREFIX}_{n}")
+                             for n in self.exchange_names]) \
+            if self.exchange_names else np.zeros((0, self.N))
+        ex_lams = np.stack([traj_of(f"{EXCHANGE_MULTIPLIER_PREFIX}_{n}")
+                            for n in self.exchange_names]) \
+            if self.exchange_names else np.zeros((0, self.N))
+        rho = float(variables.get("penalty_factor", 10.0))
+
+        mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
+                          dtype=self._w_guess.dtype)
+        t_start = _time.perf_counter()
+        u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
+            self._step_admm(
+                x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                self.model.ml_params,
+                jnp.asarray(means), jnp.asarray(lams),
+                jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
+                jnp.asarray(rho),
+                self._w_guess, self._y_guess, self._z_guess, mu0,
+                jnp.asarray(float(now)))
+        u0.block_until_ready()
+        wall = _time.perf_counter() - t_start
+        self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
+        self._cold = False
+
+        stats_row = {
+            "time": float(now),
+            "iterations": int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+        }
+        self.stats_history.append(stats_row)
+        if not stats_row["success"]:
+            self.logger.warning("admm-ml solve at t=%s did not converge "
+                                "(kkt=%.2e)", now, stats_row["kkt_error"])
+        controls = list(self.ocp.control_names)
+        return {
+            "u0": {n: float(u0[i]) for i, n in enumerate(controls)
+                   if n in self._module_controls},
+            "traj": {k: np.asarray(v) for k, v in traj.items()},
+            "couplings": {n: np.asarray(v) for n, v in coup_trajs.items()},
+            "stats": stats_row,
+        }

@@ -1,0 +1,21 @@
+"""ML surrogates: serialized exchange format, JAX predictors, NARX models.
+
+TPU-native counterpart of the reference's data-driven MPC stack
+(``agentlib_mpc/models/serialized_ml_model.py``, ``casadi_predictor.py``,
+``casadi_ml_model.py``): trained ANN/GPR/linear-regression surrogates are
+serialized to a JSON exchange format, evaluated as pure JAX functions (so
+they sit *inside* the jitted OCP), and composed into hybrid NARX models
+with white-box dynamics.
+"""
+
+from agentlib_mpc_tpu.ml.serialized import (
+    Feature,
+    OutputFeature,
+    SerializedANN,
+    SerializedGPR,
+    SerializedLinReg,
+    SerializedMLModel,
+    column_order,
+    load_serialized_model,
+)
+from agentlib_mpc_tpu.ml.predictors import make_predictor

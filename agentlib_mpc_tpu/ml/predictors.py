@@ -1,0 +1,140 @@
+"""Pure-JAX evaluation of serialized ML models.
+
+Counterpart of the reference's ``models/casadi_predictor.py`` (CasadiANN
+:197-536, CasadiGPR :113-189, CasadiLinReg :87-110): there, each trained
+model is re-implemented *symbolically in CasADi* so it can sit inside an
+NLP. Here each becomes a pure function ``apply(params, x) -> y`` — jit,
+grad and vmap safe, so the same evaluator serves the plant simulator, the
+NARX transcription inside the OCP (where `jax.grad` differentiates through
+it for the KKT system), and batched training-data sweeps.
+
+The params pytree is an explicit argument: hot-swapping a retrained model
+(§3.5 trainer → controller loop) replaces leaves of identical shape, so
+nothing recompiles — the reference instead rebuilds its CasADi graph on
+every swap (``casadi_ml_model.py:205-231``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.ml.serialized import (
+    SerializedANN,
+    SerializedGPR,
+    SerializedLinReg,
+    SerializedMLModel,
+)
+
+_ACT = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softplus": jax.nn.softplus,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+}
+
+# one function table governs trainer + predictor; the declarative name list
+# in serialized.py must match it exactly
+from agentlib_mpc_tpu.ml.serialized import ACTIVATIONS as _DECLARED  # noqa: E402
+
+assert set(_ACT) == set(_DECLARED), (
+    "activation registries diverged: predictors._ACT vs "
+    "serialized.ACTIVATIONS")
+
+
+class Predictor(NamedTuple):
+    """apply(params, x: (n_in,)) → (n_out,); params is a pytree whose
+    leaves may be swapped (same shapes) without recompiling."""
+
+    apply: Callable[[Any, jnp.ndarray], jnp.ndarray]
+    params: Any
+    n_inputs: int
+    n_outputs: int
+    input_columns: tuple[str, ...]
+    output_names: tuple[str, ...]
+
+
+def _ann_predictor(m: SerializedANN) -> Predictor:
+    params = {
+        "W": [jnp.asarray(np.asarray(w, dtype=float)) for w in m.weights],
+        "b": [jnp.asarray(np.asarray(b, dtype=float)) for b in m.biases],
+    }
+    acts = tuple(m.activations)
+
+    def apply(p, x):
+        h = x
+        for W, b, a in zip(p["W"], p["b"], acts):
+            h = _ACT[a](h @ W + b)
+        return jnp.atleast_1d(h)
+
+    n_out = int(np.asarray(m.biases[-1]).size) if m.biases else 0
+    return Predictor(apply, params, m.n_inputs, n_out,
+                     tuple(m.input_columns), tuple(m.output_names))
+
+
+def _gpr_predictor(m: SerializedGPR) -> Predictor:
+    x_train = np.asarray(m.x_train, dtype=float)
+    d = x_train.shape[1] if x_train.ndim == 2 else 1
+    ls = np.broadcast_to(np.asarray(m.length_scale, dtype=float), (d,))
+    params = {
+        "x_train": jnp.asarray(x_train),
+        "alpha": jnp.asarray(np.asarray(m.alpha, dtype=float)),
+        "constant_value": jnp.asarray(float(m.constant_value)),
+        "length_scale": jnp.asarray(ls),
+        "mean": jnp.asarray(np.asarray(
+            m.mean if m.mean is not None else np.zeros(d), dtype=float)),
+        "std": jnp.asarray(np.asarray(
+            m.std if m.std is not None else np.ones(d), dtype=float)),
+        "scale": jnp.asarray(float(m.scale)),
+    }
+    normalize = bool(m.normalize)
+
+    def apply(p, x):
+        if normalize:
+            x = (x - p["mean"]) / p["std"]
+        # k(x, X) = cv * exp(-0.5 * sum_j ((x_j - X_ij)/l_j)^2); the White
+        # term has zero cross-covariance, so the posterior mean is k @ alpha
+        diff = (x[None, :] - p["x_train"]) / p["length_scale"][None, :]
+        k = p["constant_value"] * jnp.exp(-0.5 * jnp.sum(diff * diff,
+                                                         axis=1))
+        return jnp.atleast_1d(k @ p["alpha"] * p["scale"])
+
+    return Predictor(apply, params, m.n_inputs, len(m.output),
+                     tuple(m.input_columns), tuple(m.output_names))
+
+
+def _linreg_predictor(m: SerializedLinReg) -> Predictor:
+    coef = np.atleast_2d(np.asarray(m.coef, dtype=float))  # (n_out, n_in)
+    params = {
+        "coef": jnp.asarray(coef),
+        "intercept": jnp.atleast_1d(
+            jnp.asarray(np.asarray(m.intercept, dtype=float))),
+    }
+
+    def apply(p, x):
+        return p["coef"] @ x + p["intercept"]
+
+    return Predictor(apply, params, m.n_inputs, coef.shape[0],
+                     tuple(m.input_columns), tuple(m.output_names))
+
+
+_MAKERS = {
+    SerializedANN: _ann_predictor,
+    SerializedGPR: _gpr_predictor,
+    SerializedLinReg: _linreg_predictor,
+}
+
+
+def make_predictor(m: SerializedMLModel) -> Predictor:
+    """Build the JAX evaluator for a serialized model (registry mirroring
+    the reference's ``casadi_predictor.py:742-747``)."""
+    for cls, maker in _MAKERS.items():
+        if isinstance(m, cls):
+            return maker(m)
+    raise TypeError(f"no predictor for {type(m).__name__}")

@@ -1,0 +1,301 @@
+"""ML training pipeline: resample → lag shift → split → fit → serialize.
+
+Counterpart of the reference's trainer machinery
+(``modules/ml_model_training/ml_model_trainer.py``: resample :390-437,
+lag-shifted feature construction :498-542, difference targets :544-555,
+shuffled train/val/test split :557-582, ANN/GPR/LinReg fitting :617-767).
+The pipeline stages are pure functions over pandas frames (directly
+unit-testable — the reference only covers them through examples); the ANN
+trainer is native JAX/optax (the reference's keras dependency does not
+exist on this stack), GPR uses sklearn's exact fit and LinReg a least
+squares solve, all serialized to the exchange format of
+:mod:`agentlib_mpc_tpu.ml.serialized`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from agentlib_mpc_tpu.ml.serialized import (
+    Feature,
+    OutputFeature,
+    SerializedANN,
+    SerializedGPR,
+    SerializedLinReg,
+    SerializedMLModel,
+    name_with_lag,
+)
+
+
+# -- data pipeline (pure) -----------------------------------------------------
+
+def resample(df, dt: float, method: str = "linear"):
+    """Resample a time-indexed DataFrame onto a uniform dt grid
+    (reference ``resample``, ``ml_model_trainer.py:390-437``).
+
+    ``method="previous"`` (zero-order hold) matches broker semantics — a
+    published value holds until the next publish — and avoids the
+    coefficient bias linear interpolation introduces for piecewise-constant
+    excitation signals."""
+    import pandas as pd
+
+    from agentlib_mpc_tpu.utils.sampling import interpolate_to_previous
+
+    df = df.sort_index()
+    t0, t1 = float(df.index[0]), float(df.index[-1])
+    n = int(np.floor((t1 - t0) / dt))
+    grid = t0 + np.arange(n + 1) * dt
+    out = {}
+    for col in df.columns:
+        s = df[col].dropna()
+        times = s.index.to_numpy(dtype=float)
+        vals = s.to_numpy(dtype=float)
+        if method == "previous":
+            out[col] = interpolate_to_previous(grid, times, vals)
+        else:
+            out[col] = np.interp(grid, times, vals)
+    return pd.DataFrame(out, index=grid)
+
+
+def create_lagged_features(df, inputs: dict[str, Feature],
+                           outputs: dict[str, OutputFeature]):
+    """Build (X, y): X columns in `column_order` layout; y per output —
+    next-step value (absolute) or increment (difference). Row t uses values
+    at t, t−dt, …; the target is at t+dt (reference
+    ``create_inputs_and_outputs``, ``ml_model_trainer.py:498-542``)."""
+    import pandas as pd
+
+    max_lag = max([f.lag for f in inputs.values()]
+                  + [f.lag for f in outputs.values() if f.recursive] + [1])
+    n = len(df)
+    rows = range(max_lag - 1, n - 1)
+    X = {}
+    for name, feat in inputs.items():
+        for i in range(feat.lag):
+            X[name_with_lag(name, i)] = \
+                df[name].to_numpy(dtype=float)[max_lag - 1 - i:n - 1 - i]
+    for name, feat in outputs.items():
+        if feat.recursive:
+            for i in range(feat.lag):
+                X[name_with_lag(name, i)] = \
+                    df[name].to_numpy(dtype=float)[max_lag - 1 - i:n - 1 - i]
+    y = {}
+    for name, feat in outputs.items():
+        nxt = df[name].to_numpy(dtype=float)[max_lag:n]
+        if feat.output_type == "difference":
+            cur = df[name].to_numpy(dtype=float)[max_lag - 1:n - 1]
+            y[name] = nxt - cur
+        else:
+            y[name] = nxt
+    idx = df.index.to_numpy(dtype=float)[list(rows)]
+    return (pd.DataFrame(X, index=idx), pd.DataFrame(y, index=idx))
+
+
+@dataclasses.dataclass
+class TrainingData:
+    """Shuffled split (reference ``TrainingData``,
+    ``ml_model_datatypes.py:56-115``)."""
+
+    training_inputs: "Any"
+    training_outputs: "Any"
+    validation_inputs: "Any"
+    validation_outputs: "Any"
+    test_inputs: "Any"
+    test_outputs: "Any"
+
+
+def train_val_test_split(X, y, shares: Sequence[float] = (0.7, 0.15, 0.15),
+                         seed: int = 0) -> TrainingData:
+    """Shuffled split by shares summing to 1 (reference ``divide_in_tvt``,
+    ``ml_model_trainer.py:557-582``)."""
+    if abs(sum(shares) - 1.0) > 1e-9:
+        raise ValueError(f"shares must sum to 1, got {shares}")
+    n = len(X)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_tr = int(round(shares[0] * n))
+    n_val = int(round(shares[1] * n))
+    i_tr, i_val, i_te = (perm[:n_tr], perm[n_tr:n_tr + n_val],
+                         perm[n_tr + n_val:])
+    return TrainingData(
+        X.iloc[i_tr], y.iloc[i_tr],
+        X.iloc[i_val], y.iloc[i_val],
+        X.iloc[i_te], y.iloc[i_te])
+
+
+# -- trainers -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class ANNTrainerCore:
+    """JAX/optax MLP trainer (replaces the reference's keras Sequential
+    builder + fit, ``ml_model_trainer.py:617-667``). Standardization of
+    inputs and targets is folded into the first/last layer weights, so the
+    serialized network consumes raw feature vectors."""
+
+    hidden: Sequence[int] = (32, 32)
+    activation: str = "tanh"
+    epochs: int = 400
+    learning_rate: float = 1e-2
+    batch_size: int = 64
+    early_stopping_patience: int = 50
+    seed: int = 0
+
+    def fit(self, X: np.ndarray, y: np.ndarray,
+            X_val: Optional[np.ndarray] = None,
+            y_val: Optional[np.ndarray] = None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X = np.asarray(X, dtype=float)
+        y = np.atleast_2d(np.asarray(y, dtype=float).T).T
+        x_mean, x_std = X.mean(axis=0), X.std(axis=0) + 1e-9
+        y_mean, y_std = y.mean(axis=0), y.std(axis=0) + 1e-9
+        Xn = (X - x_mean) / x_std
+        yn = (y - y_mean) / y_std
+
+        sizes = [X.shape[1], *self.hidden, y.shape[1]]
+        rng = np.random.default_rng(self.seed)
+        params = []
+        for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+            lim = np.sqrt(6.0 / (fan_in + fan_out))
+            params.append({
+                "W": jnp.asarray(rng.uniform(-lim, lim, (fan_in, fan_out))),
+                "b": jnp.zeros((fan_out,)),
+            })
+        from agentlib_mpc_tpu.ml.predictors import _ACT as act_fns
+
+        acts = [self.activation] * len(self.hidden) + ["linear"]
+
+        def forward(ps, xb):
+            h = xb
+            for layer, a in zip(ps, acts):
+                h = act_fns[a](h @ layer["W"] + layer["b"])
+            return h
+
+        def loss(ps, xb, yb):
+            return jnp.mean((forward(ps, xb) - yb) ** 2)
+
+        opt = optax.adam(self.learning_rate)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def train_step(ps, st, xb, yb):
+            g = jax.grad(loss)(ps, xb, yb)
+            updates, st = opt.update(g, st)
+            return optax.apply_updates(ps, updates), st
+
+        val = None
+        if X_val is not None and len(X_val):
+            Xv = (np.asarray(X_val, dtype=float) - x_mean) / x_std
+            yv = (np.atleast_2d(np.asarray(y_val, dtype=float).T).T
+                  - y_mean) / y_std
+            val = (jnp.asarray(Xv), jnp.asarray(yv))
+
+        n = len(Xn)
+        bs = min(self.batch_size, n)
+        best_val, best_params, patience = np.inf, params, 0
+        Xj, yj = jnp.asarray(Xn), jnp.asarray(yn)
+        for epoch in range(self.epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - bs + 1, bs):
+                idx = perm[start:start + bs]
+                params, opt_state = train_step(params, opt_state,
+                                               Xj[idx], yj[idx])
+            if val is not None:
+                v = float(loss(params, *val))
+                if v < best_val - 1e-7:
+                    best_val, best_params, patience = v, params, 0
+                else:
+                    patience += 1
+                    if patience >= self.early_stopping_patience:
+                        break
+        if val is not None:
+            params = best_params
+
+        # fold standardization into the serialized weights:
+        #   first layer consumes raw x: W1' = diag(1/x_std) W1,
+        #   b1' = b1 − (x_mean/x_std) W1; last layer emits raw y.
+        weights = [np.asarray(l["W"]) for l in params]
+        biases = [np.asarray(l["b"]) for l in params]
+        weights[0] = weights[0] / x_std[:, None]
+        biases[0] = biases[0] - (x_mean / x_std) @ np.asarray(params[0]["W"])
+        weights[-1] = weights[-1] * y_std[None, :]
+        biases[-1] = biases[-1] * y_std + y_mean
+        return weights, biases, acts
+
+
+def fit_ann(X, y, X_val=None, y_val=None, dt: float = 1.0,
+            inputs: dict[str, Feature] = None,
+            output: dict[str, OutputFeature] = None,
+            trainer: Optional[ANNTrainerCore] = None,
+            trainer_config: Optional[dict] = None) -> SerializedANN:
+    trainer = trainer or ANNTrainerCore()
+    weights, biases, acts = trainer.fit(
+        np.asarray(X, dtype=float), np.asarray(y, dtype=float),
+        None if X_val is None else np.asarray(X_val, dtype=float),
+        None if y_val is None else np.asarray(y_val, dtype=float))
+    return SerializedANN(
+        dt=dt, inputs=inputs, output=output, trainer_config=trainer_config,
+        weights=[w.tolist() for w in weights],
+        biases=[b.tolist() for b in biases],
+        activations=acts)
+
+
+def fit_gpr(X, y, dt: float = 1.0, inputs=None, output=None,
+            normalize: bool = True, scale: Optional[float] = None,
+            n_restarts_optimizer: int = 0,
+            trainer_config: Optional[dict] = None) -> SerializedGPR:
+    """Exact GPR with the reference's kernel — ConstantKernel × RBF + White
+    (``GPRTrainer.build_ml_model``, ``ml_model_trainer.py:673-735``)."""
+    from sklearn.gaussian_process import GaussianProcessRegressor
+    from sklearn.gaussian_process.kernels import (
+        RBF,
+        ConstantKernel,
+        WhiteKernel,
+    )
+
+    if output is not None and len(output) != 1:
+        raise ValueError(
+            f"GPR supports exactly one output, got {list(output)} "
+            f"(train one GPR per output, like the reference's per-output "
+            f"serialized models)")
+    X = np.asarray(X, dtype=float)
+    y2 = np.asarray(y, dtype=float).reshape(len(X), -1)
+    if y2.shape[1] != 1:
+        raise ValueError(f"GPR target must be one column, got {y2.shape[1]}")
+    y = y2[:, 0]
+    mean = X.mean(axis=0) if normalize else None
+    std = (X.std(axis=0) + 1e-9) if normalize else None
+    Xn = (X - mean) / std if normalize else X
+    if scale is None:
+        scale = float(max(np.max(np.abs(y)), 1e-9))
+    kernel = ConstantKernel() * RBF(length_scale=np.ones(X.shape[1])) \
+        + WhiteKernel(noise_level=1e-3)
+    gpr = GaussianProcessRegressor(
+        kernel=kernel, n_restarts_optimizer=n_restarts_optimizer,
+        random_state=0).fit(Xn, y / scale)
+    return SerializedGPR.from_sklearn(
+        gpr, dt=dt, inputs=inputs, output=output, normalize=normalize,
+        mean=None if mean is None else mean.tolist(),
+        std=None if std is None else std.tolist(),
+        scale=scale, trainer_config=trainer_config)
+
+
+def fit_linreg(X, y, dt: float = 1.0, inputs=None, output=None,
+               trainer_config: Optional[dict] = None) -> SerializedLinReg:
+    """Least-squares affine fit (``LinRegTrainer``,
+    ``ml_model_trainer.py:744-767``)."""
+    X = np.asarray(X, dtype=float)
+    y = np.asarray(y, dtype=float).reshape(len(X), -1)
+    A = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+    theta, *_ = np.linalg.lstsq(A, y, rcond=None)
+    coef = theta[:-1].T          # (n_out, n_in)
+    intercept = theta[-1]        # (n_out,)
+    return SerializedLinReg(dt=dt, inputs=inputs, output=output,
+                            trainer_config=trainer_config,
+                            coef=coef.tolist(),
+                            intercept=intercept.tolist())

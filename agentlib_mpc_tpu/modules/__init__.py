@@ -13,3 +13,12 @@ from agentlib_mpc_tpu.modules.coordinator import (
     CoordinatedADMM,
 )
 from agentlib_mpc_tpu.modules.estimation import MHE
+from agentlib_mpc_tpu.modules.ml_trainer import (
+    ANNTrainer,
+    GPRTrainer,
+    LinRegTrainer,
+    MLModelTrainer,
+)
+from agentlib_mpc_tpu.modules.ml_simulator import MLSimulator
+from agentlib_mpc_tpu.modules.data_source import DataSource
+from agentlib_mpc_tpu.modules.setpoint_generator import SetPointGenerator

@@ -23,7 +23,7 @@ from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
 logger = logging.getLogger(__name__)
 
 
-@register_module("simulator", "ml_simulator")
+@register_module("simulator")
 class Simulator(BaseModule):
     variable_groups = ("inputs", "outputs", "states", "parameters")
     shared_groups = ("outputs",)

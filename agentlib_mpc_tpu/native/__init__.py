@@ -11,6 +11,7 @@ missing compiler degrades performance, never capability.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -24,15 +25,18 @@ _DIR = Path(__file__).parent
 _LIB_CACHE: dict[str, ctypes.CDLL | None] = {}
 
 
-def _so_path(name: str) -> Path:
+def _so_path(name: str, src: Path) -> Path:
+    # the source hash is part of the filename: a changed .cpp can never be
+    # satisfied by a stale binary (mtime comparisons break across clones)
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:12]
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return _DIR / f"_{name}{suffix}"
+    return _DIR / f"_{name}-{digest}{suffix}"
 
 
 def _compile(name: str) -> Path | None:
     src = _DIR / f"{name}.cpp"
-    out = _so_path(name)
-    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+    out = _so_path(name, src)
+    if out.exists():
         return out
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
            str(src), "-o", str(out)]

@@ -1,0 +1,344 @@
+"""NARX (ML-surrogate) OCP transcription: discrete shooting over the
+unified predict step with a pre-horizon lag window.
+
+Counterpart of the reference's ML backend discretization
+(``optimization_backends/casadi_/casadi_ml.py``: pre-horizon grid of fixed
+past states/controls :121-154, lag plumbing into the stage function
+:235-341, ``MultipleShooting_ML`` :111-373). There, CasADi MX symbols for
+every lag are wired stage by stage; here each history variable becomes one
+padded sequence — ``L−1`` fixed past values from `MLOCPParams.past`
+followed by the horizon's decision/exogenous values — and every stage's
+flat NARX input vector is a static gather out of it. XLA sees N identical
+fused predict steps.
+
+The trained parameters ride the params tuple (``ml_params``), so the
+trainer → controller hot-swap (§3.5) re-solves with new weights without
+recompiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from agentlib_mpc_tpu.models.ml_model import MLModel
+from agentlib_mpc_tpu.ops.solver import NLPFunctions
+
+BIG = 1.0e6
+
+
+class MLOCPParams(NamedTuple):
+    """Per-solve data of a NARX OCP. ``past[name]`` holds the L−1 values
+    before t0 (index 0 = t0−dt, newest first); ``ml_params`` the predictor
+    pytrees keyed like ``MLModel.ml_params``."""
+
+    x0: jnp.ndarray              # (n_dyn,) current dynamic-state values
+    u_prev: jnp.ndarray          # (n_u,)
+    past: dict[str, jnp.ndarray]
+    d_traj: jnp.ndarray          # (N, n_d)
+    p: jnp.ndarray               # (n_p,)
+    x_lb: jnp.ndarray            # (N+1, n_dyn)
+    x_ub: jnp.ndarray
+    u_lb: jnp.ndarray            # (N, n_u)
+    u_ub: jnp.ndarray
+    z_lb: jnp.ndarray            # (n_slack,)
+    z_ub: jnp.ndarray
+    t0: jnp.ndarray
+    ml_params: dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TranscribedMLOCP:
+    """NARX OCP ready for `solve_nlp` (mirror of
+    :class:`~agentlib_mpc_tpu.ops.transcription.TranscribedOCP`)."""
+
+    model: MLModel
+    control_names: tuple[str, ...]
+    exo_names: tuple[str, ...]
+    dyn_names: tuple[str, ...]
+    slack_names: tuple[str, ...]
+    N: int
+    dt: float
+    method: str
+    n_w: int
+    n_g: int
+    n_h: int
+    nlp: NLPFunctions
+    unflatten: Callable
+    flatten: Callable
+    bounds: Callable
+    initial_guess: Callable
+    shift_guess: Callable
+    trajectories: Callable
+    default_params: Callable
+
+    @property
+    def state_grid(self):
+        return jnp.arange(self.N + 1) * self.dt
+
+    @property
+    def control_grid(self):
+        return jnp.arange(self.N) * self.dt
+
+
+def transcribe_ml(model: MLModel, control_names: Sequence[str],
+                  N: int, dt: float) -> TranscribedMLOCP:
+    """Discrete multiple shooting over ``model.ml_step``."""
+    control_names = list(control_names)
+    for c in control_names:
+        if c not in model.input_names:
+            raise ValueError(f"control {c!r} is not a model input")
+    if abs(float(model.dt) - float(dt)) > 1e-9:
+        raise ValueError(
+            f"NARX model dt={model.dt} must equal the MPC time step {dt} "
+            f"(the reference re-samples instead of integrating, "
+            f"casadi_ml.py:111-154)")
+    exo_names = [n for n in model.input_names if n not in control_names]
+    dyn_names = [*model.narx_state_names, *model.wb_state_names]
+    slack_names = [n for n in model.free_state_names
+                   if n not in model.narx_state_names]
+    n_dyn = len(dyn_names)
+    n_u = len(control_names)
+    n_slack = len(slack_names)
+    lags = {n: max(model.ml_lags.get(n, 1), 1) for n in model.history_names}
+
+    template = {
+        "x": jnp.zeros((N + 1, n_dyn)),
+        "u": jnp.zeros((N, n_u)),
+        "z": jnp.zeros((N, n_slack)),
+    }
+    w_flat0, unflatten = ravel_pytree(template)
+    n_w = w_flat0.size
+
+    def _sequences(w: dict, theta: MLOCPParams) -> dict[str, jnp.ndarray]:
+        """Per history variable the padded time series
+        [v(−L+1) … v(−1), v(0) … v(N−1)], oldest first."""
+        x, u, z = w["x"], w["u"], w["z"]
+        seqs = {}
+        for name in model.history_names:
+            L = lags[name]
+            past = theta.past[name][::-1] if L > 1 \
+                else jnp.zeros((0,), dtype=x.dtype)
+            if name in dyn_names:
+                cur = x[:N, dyn_names.index(name)]
+            elif name in control_names:
+                cur = u[:, control_names.index(name)]
+            elif name in exo_names:
+                cur = theta.d_traj[:, exo_names.index(name)]
+            elif name in slack_names:
+                cur = z[:, slack_names.index(name)]
+            else:  # pragma: no cover - guarded in MLModel validation
+                raise ValueError(f"history variable {name!r} unplaceable")
+            seqs[name] = jnp.concatenate([past, cur])
+        return seqs
+
+    def _hist_at(seqs, name, k):
+        """(L,) window at step k, newest first."""
+        L = lags[name]
+        # seq index of v(k - i) is (k - i) + (L - 1)
+        idx = k + (L - 1) - jnp.arange(L)
+        return seqs[name][idx]
+
+    def _windows(seqs, k):
+        return {name: _hist_at(seqs, name, k) for name in model.history_names}
+
+    def _bind_vectors(w, theta, k):
+        """(x_diff, z_free, u_full) in the *declarative* model layout at
+        node k, for cost/constraint/output evaluation."""
+        x, u, z = w["x"], w["u"], w["z"]
+        kc = jnp.minimum(k, N - 1)
+        x_diff = jnp.stack(
+            [x[k, dyn_names.index(n)] for n in model.diff_state_names]) \
+            if model.diff_state_names else jnp.zeros((0,))
+        z_parts = []
+        for n in model.free_state_names:
+            if n in model.narx_state_names:
+                z_parts.append(x[k, dyn_names.index(n)])
+            else:
+                z_parts.append(z[kc, slack_names.index(n)])
+        z_free = jnp.stack(z_parts) if z_parts else jnp.zeros((0,))
+        u_full = jnp.zeros((len(model.input_names),))
+        for j, n in enumerate(control_names):
+            u_full = u_full.at[model.input_names.index(n)].set(u[kc, j])
+        for j, n in enumerate(exo_names):
+            u_full = u_full.at[model.input_names.index(n)].set(
+                theta.d_traj[kc, j])
+        return x_diff, z_free, u_full
+
+    # ---- equalities: initial pin + shooting defects -------------------------
+    def g_fn(w_flat, theta: MLOCPParams):
+        w = unflatten(w_flat)
+        x = w["x"]
+        seqs = _sequences(w, theta)
+        parts = [x[0] - theta.x0]
+
+        def defect(k):
+            hist = _windows(seqs, k)
+            nxt, _ = model.ml_step(hist, theta.p, ml_params=theta.ml_params,
+                                   t=theta.t0 + k * dt)
+            pred = jnp.stack([nxt[n] for n in dyn_names])
+            return x[k + 1] - pred
+
+        defects = jax.vmap(defect)(jnp.arange(N))
+        parts.append(defects.reshape(-1))
+        return jnp.concatenate(parts)
+
+    # ---- inequalities -------------------------------------------------------
+    def h_fn(w_flat, theta: MLOCPParams):
+        if model.n_constraints == 0:
+            return jnp.zeros((0,))
+        w = unflatten(w_flat)
+
+        def node(k):
+            x_diff, z_free, u_full = _bind_vectors(w, theta, k)
+            return model.constraint_residuals(x_diff, z_free, u_full,
+                                              theta.p, theta.t0 + k * dt)
+
+        res = jax.vmap(node)(jnp.arange(1, N + 1))
+        return res.reshape(-1)
+
+    # ---- objective ----------------------------------------------------------
+    def f_fn(w_flat, theta: MLOCPParams):
+        w = unflatten(w_flat)
+        u = w["u"]
+        du = u - jnp.concatenate([theta.u_prev[None, :], u[:-1]], axis=0)
+
+        def node(k):
+            x_diff, z_free, u_full = _bind_vectors(w, theta, k)
+            du_full = jnp.zeros((len(model.input_names),))
+            for j, n in enumerate(control_names):
+                du_full = du_full.at[model.input_names.index(n)].set(du[k, j])
+            return model.stage_cost(x_diff, z_free, u_full, theta.p,
+                                    theta.t0 + k * dt, du=du_full)
+
+        return dt * jnp.sum(jax.vmap(node)(jnp.arange(N)))
+
+    theta0 = _default_ml_params(model, control_names, exo_names, dyn_names,
+                                slack_names, lags, N)
+    n_g = int(g_fn(w_flat0, theta0).shape[0])
+    n_h = int(h_fn(w_flat0, theta0).shape[0])
+
+    def _finite(arr, default):
+        return jnp.where(jnp.isfinite(arr), arr, default)
+
+    def bounds_fn(theta: MLOCPParams):
+        lb = {"x": _finite(theta.x_lb, -BIG), "u": _finite(theta.u_lb, -BIG),
+              "z": jnp.broadcast_to(_finite(theta.z_lb, -BIG), (N, n_slack))}
+        ub = {"x": _finite(theta.x_ub, BIG), "u": _finite(theta.u_ub, BIG),
+              "z": jnp.broadcast_to(_finite(theta.z_ub, BIG), (N, n_slack))}
+        lb_flat, _ = ravel_pytree({k: lb[k] for k in template})
+        ub_flat, _ = ravel_pytree({k: ub[k] for k in template})
+        return lb_flat, ub_flat
+
+    def initial_guess_fn(theta: MLOCPParams):
+        guess = {
+            "x": jnp.broadcast_to(theta.x0, (N + 1, n_dyn)),
+            "u": jnp.broadcast_to(
+                jnp.where(jnp.isfinite(theta.u_prev), theta.u_prev, 0.0),
+                (N, n_u)),
+            "z": jnp.zeros((N, n_slack)),
+        }
+        flat, _ = ravel_pytree({k: guess[k] for k in template})
+        return flat
+
+    def shift_guess_fn(w_flat, theta: MLOCPParams):
+        w = unflatten(w_flat)
+        x = jnp.concatenate([w["x"][1:], w["x"][-1:]], axis=0) \
+            .at[0].set(theta.x0)
+        u = jnp.concatenate([w["u"][1:], w["u"][-1:]], axis=0)
+        z = jnp.concatenate([w["z"][1:], w["z"][-1:]], axis=0)
+        flat, _ = ravel_pytree({"x": x, "u": u, "z": z})
+        return flat
+
+    def trajectories_fn(w_flat, theta: MLOCPParams):
+        w = unflatten(w_flat)
+
+        def node_out(k):
+            x_diff, z_free, u_full = _bind_vectors(w, theta, k)
+            return model.output(x_diff, z_free, u_full, theta.p,
+                                theta.t0 + k * dt)
+
+        y = jax.vmap(node_out)(jnp.arange(N + 1))
+        return {
+            "time_state": theta.t0 + jnp.arange(N + 1) * dt,
+            "time_control": theta.t0 + jnp.arange(N) * dt,
+            "x": w["x"],
+            "u": w["u"],
+            "z": w["z"],
+            "y": y,
+            "objective": f_fn(w_flat, theta),
+        }
+
+    def default_params(**kw) -> MLOCPParams:
+        return _default_ml_params(model, control_names, exo_names, dyn_names,
+                                  slack_names, lags, N, **kw)
+
+    return TranscribedMLOCP(
+        model=model,
+        control_names=tuple(control_names),
+        exo_names=tuple(exo_names),
+        dyn_names=tuple(dyn_names),
+        slack_names=tuple(slack_names),
+        N=N,
+        dt=float(dt),
+        method="narx_shooting",
+        n_w=n_w,
+        n_g=n_g,
+        n_h=n_h,
+        nlp=NLPFunctions(f=f_fn, g=g_fn, h=h_fn),
+        unflatten=unflatten,
+        flatten=lambda w: ravel_pytree({k: w[k] for k in template})[0],
+        bounds=bounds_fn,
+        initial_guess=initial_guess_fn,
+        shift_guess=shift_guess_fn,
+        trajectories=trajectories_fn,
+        default_params=default_params,
+    )
+
+
+def _default_ml_params(model: MLModel, control_names, exo_names, dyn_names,
+                       slack_names, lags, N, **overrides) -> MLOCPParams:
+    byname = {v.name: v for v in
+              (*model.inputs, *model.states, *model.parameters)}
+    n_u = len(control_names)
+    n_dyn = len(dyn_names)
+    x0 = jnp.array([byname[n].value for n in dyn_names]) \
+        if dyn_names else jnp.zeros((0,))
+    u_prev = jnp.array([byname[n].value for n in control_names]) \
+        if n_u else jnp.zeros((0,))
+    past = {n: jnp.full((lags[n] - 1,), float(byname[n].value))
+            if lags[n] > 1 else jnp.zeros((0,))
+            for n in model.history_names}
+    d_traj = jnp.broadcast_to(
+        jnp.array([byname[n].value for n in exo_names]),
+        (N, len(exo_names))) if exo_names else jnp.zeros((N, 0))
+    p = model.default_vector("parameters")
+    x_lb = jnp.broadcast_to(jnp.array([byname[n].lb for n in dyn_names]),
+                            (N + 1, n_dyn)) if dyn_names \
+        else jnp.zeros((N + 1, 0))
+    x_ub = jnp.broadcast_to(jnp.array([byname[n].ub for n in dyn_names]),
+                            (N + 1, n_dyn)) if dyn_names \
+        else jnp.zeros((N + 1, 0))
+    u_lb = jnp.broadcast_to(jnp.array([byname[n].lb for n in control_names]),
+                            (N, n_u)) if n_u else jnp.zeros((N, 0))
+    u_ub = jnp.broadcast_to(jnp.array([byname[n].ub for n in control_names]),
+                            (N, n_u)) if n_u else jnp.zeros((N, 0))
+    z_lb = jnp.array([byname[n].lb for n in slack_names]) \
+        if slack_names else jnp.zeros((0,))
+    z_ub = jnp.array([byname[n].ub for n in slack_names]) \
+        if slack_names else jnp.zeros((0,))
+    theta = MLOCPParams(x0=x0, u_prev=u_prev, past=past, d_traj=d_traj, p=p,
+                        x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub,
+                        z_lb=z_lb, z_ub=z_ub, t0=jnp.asarray(0.0),
+                        ml_params=model.ml_params)
+    updates = {}
+    for k, v in overrides.items():
+        if k in ("past", "ml_params"):
+            updates[k] = v
+        else:
+            updates[k] = jnp.asarray(v)
+    return theta._replace(**updates)

@@ -1,0 +1,236 @@
+"""ML training pipeline: pure-stage unit tests + the full §3.5 loop
+(excite → record → retrain → broadcast → hot-swap) as a MAS run.
+
+The reference covers its trainer only through examples; the pipeline
+stages here are tested directly (SURVEY.md §4 lesson).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+from agentlib_mpc_tpu.ml import Feature, OutputFeature
+from agentlib_mpc_tpu.ml.serialized import SerializedLinReg
+from agentlib_mpc_tpu.ml.training import (
+    ANNTrainerCore,
+    create_lagged_features,
+    fit_ann,
+    fit_gpr,
+    fit_linreg,
+    resample,
+    train_val_test_split,
+)
+from agentlib_mpc_tpu.ml.predictors import make_predictor
+from agentlib_mpc_tpu.models.ml_model import MLModel
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.variables import (
+    control_input,
+    output,
+    parameter,
+    state,
+)
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+DT = 60.0
+C = 50000.0
+LOAD = 200.0
+
+
+class TestPipeline:
+    def test_resample_uniform(self):
+        df = pd.DataFrame({"a": [0.0, 2.0, 4.0]}, index=[0.0, 2.0, 4.0])
+        out = resample(df, 1.0)
+        np.testing.assert_allclose(out.index, [0, 1, 2, 3, 4])
+        np.testing.assert_allclose(out["a"], [0, 1, 2, 3, 4])
+
+    def test_lagged_features_layout(self):
+        df = pd.DataFrame({"u": [10.0, 11, 12, 13],
+                           "x": [0.0, 1, 2, 3]}, index=[0.0, 1, 2, 3])
+        X, y = create_lagged_features(
+            df, {"u": Feature(name="u", lag=2)},
+            {"x": OutputFeature(name="x", output_type="difference",
+                                recursive=True)})
+        assert list(X.columns) == ["u", "u_1", "x"]
+        # first valid row: t=1 (needs u at t and t−1); target x(2)−x(1)
+        np.testing.assert_allclose(X.iloc[0], [11, 10, 1])
+        np.testing.assert_allclose(y.iloc[0], [1.0])
+        assert len(X) == 2
+
+    def test_split_shares(self):
+        X = pd.DataFrame({"a": np.arange(100.0)})
+        y = pd.DataFrame({"b": np.arange(100.0)})
+        data = train_val_test_split(X, y, (0.6, 0.2, 0.2), seed=1)
+        assert len(data.training_inputs) == 60
+        assert len(data.validation_inputs) == 20
+        assert len(data.test_inputs) == 20
+        # disjoint cover
+        all_idx = np.concatenate([data.training_inputs.index,
+                                  data.validation_inputs.index,
+                                  data.test_inputs.index])
+        assert len(np.unique(all_idx)) == 100
+
+    def test_bad_shares_rejected(self):
+        X = pd.DataFrame({"a": [1.0]})
+        with pytest.raises(ValueError, match="sum to 1"):
+            train_val_test_split(X, X, (0.5, 0.2, 0.2))
+
+
+class TestFitters:
+    def test_linreg_recovers_exact_law(self):
+        rng = np.random.default_rng(0)
+        Q = rng.uniform(0, 500, 50)
+        X = pd.DataFrame({"Q": Q, "x": rng.uniform(290, 300, 50)})
+        y = pd.DataFrame({"x": DT / C * (LOAD - Q)})
+        m = fit_linreg(X, y, dt=DT,
+                       inputs={"Q": Feature(name="Q")},
+                       output={"x": OutputFeature(
+                           name="x", output_type="difference")})
+        coef = np.asarray(m.coef)[0]
+        assert coef[0] == pytest.approx(-DT / C, rel=1e-6)
+        assert coef[1] == pytest.approx(0.0, abs=1e-9)
+        assert np.asarray(m.intercept)[0] == pytest.approx(DT / C * LOAD,
+                                                           rel=1e-6)
+
+    def test_ann_learns_nonlinear_map(self):
+        rng = np.random.default_rng(1)
+        X = rng.uniform(-1, 1, size=(400, 2))
+        y = np.sin(2 * X[:, 0]) * X[:, 1]
+        core = ANNTrainerCore(hidden=(24, 24), epochs=300,
+                              learning_rate=3e-3, seed=0)
+        m = fit_ann(X, y, dt=1.0,
+                    inputs={"a": Feature(name="a"), "b": Feature(name="b")},
+                    output={"y": OutputFeature(name="y",
+                                               output_type="absolute",
+                                               recursive=False)},
+                    trainer=core)
+        pred = make_predictor(m)
+        Xq = rng.uniform(-1, 1, size=(50, 2))
+        got = np.array([float(pred.apply(pred.params, x)[0]) for x in Xq])
+        want = np.sin(2 * Xq[:, 0]) * Xq[:, 1]
+        assert np.sqrt(np.mean((got - want) ** 2)) < 0.1
+
+    def test_gpr_learns_smooth_map(self):
+        rng = np.random.default_rng(2)
+        X = rng.uniform(-2, 2, size=(60, 1))
+        y = np.sin(X[:, 0])
+        m = fit_gpr(X, y, dt=1.0,
+                    inputs={"a": Feature(name="a")},
+                    output={"y": OutputFeature(name="y",
+                                               output_type="absolute",
+                                               recursive=False)},
+                    n_restarts_optimizer=1)
+        pred = make_predictor(m)
+        Xq = np.linspace(-1.5, 1.5, 20)[:, None]
+        got = np.array([float(pred.apply(pred.params, x)[0]) for x in Xq])
+        np.testing.assert_allclose(got, np.sin(Xq[:, 0]), atol=0.05)
+
+
+# -- the full train→broadcast→hot-swap loop (§3.5) ---------------------------
+
+class LinearPlant(Model):
+    inputs = [control_input("Q", 0.0, lb=0.0, ub=500.0)]
+    states = [state("T", 295.15, lb=280.0, ub=320.0)]
+    parameters = [parameter("C", C), parameter("load", LOAD)]
+    outputs = [output("T_out")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", (v.load - v.Q) / v.C)
+        eq.alg("T_out", v.T)
+        return eq
+
+
+def _seed_surrogate():
+    """Deliberately wrong initial surrogate (to be hot-swapped)."""
+    return SerializedLinReg(
+        dt=DT,
+        inputs={"Q": Feature(name="Q", lag=1)},
+        output={"T": OutputFeature(name="T", output_type="difference",
+                                   recursive=True)},
+        coef=[[0.0, 0.0]], intercept=[0.0])
+
+
+class NarxPlant(MLModel):
+    inputs = [control_input("Q", 0.0, lb=0.0, ub=500.0)]
+    states = [state("T", 295.15)]
+    parameters = []
+    dt = DT
+    ml_model_sources = [_seed_surrogate()]
+
+
+@pytest.fixture(scope="module")
+def training_loop_results():
+    prbs_times = np.arange(0, 7200, 300.0)
+    rng = np.random.default_rng(3)
+    prbs = rng.uniform(0.0, 500.0, size=len(prbs_times))
+
+    mas = LocalMAS([
+        {
+            "id": "Source",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "excite", "type": "data_source",
+                 "t_sample": 300,
+                 "data": {"Q": dict(zip(prbs_times, prbs))}},
+            ],
+        },
+        {
+            "id": "Plant",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "room", "type": "simulator",
+                 "model": {"class": LinearPlant},
+                 "t_sample": DT,
+                 "inputs": [{"name": "Q", "alias": "Q"}],
+                 "states": [],
+                 "outputs": [{"name": "T_out", "alias": "T"}]},
+            ],
+        },
+        {
+            "id": "Trainer",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "learn", "type": "linreg_trainer",
+                 "step_size": DT,
+                 "retrain_delay": 3600,
+                 "inputs": [{"name": "Q", "alias": "Q"}],
+                 "outputs": [{"name": "T", "alias": "T"}]},
+            ],
+        },
+        {
+            "id": "Twin",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "twin", "type": "ml_simulator",
+                 "model": {"class": NarxPlant},
+                 "t_sample": DT,
+                 "inputs": [{"name": "Q", "alias": "Q"}],
+                 "states": [{"name": "T", "value": 295.15, "shared": False}],
+                 "outputs": []},
+            ],
+        },
+    ], env={"rt": False})
+    # plant must publish its state so trainer can record it: wire T_out
+    plant = mas.agents["Plant"].get_module("room")
+    twin = mas.agents["Twin"].get_module("twin")
+    mas.run(until=7200)
+    return mas, plant, twin
+
+
+class TestTrainingLoop:
+    def test_trainer_recovers_dynamics(self, training_loop_results):
+        mas, plant, twin = training_loop_results
+        trainer = mas.agents["Trainer"].get_module("learn")
+        assert trainer._retrains >= 1
+        # the hot-swapped twin surrogate must match the true discrete law
+        key = "T"
+        params = twin.model.ml_params[twin.model._model_of_output[key]]
+        coef = np.asarray(params["coef"])[0]
+        assert coef[0] == pytest.approx(-DT / C, rel=0.05)
+
+    def test_twin_received_hot_swap(self, training_loop_results):
+        mas, plant, twin = training_loop_results
+        m = twin.model.serialized[twin.model._model_of_output["T"]]
+        assert m.trainer_config is not None  # came from the trainer
+        assert m.trainer_config["type"] == "linreg_trainer"
