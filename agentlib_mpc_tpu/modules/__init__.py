@@ -22,3 +22,10 @@ from agentlib_mpc_tpu.modules.ml_trainer import (
 from agentlib_mpc_tpu.modules.ml_simulator import MLSimulator
 from agentlib_mpc_tpu.modules.data_source import DataSource
 from agentlib_mpc_tpu.modules.setpoint_generator import SetPointGenerator
+from agentlib_mpc_tpu.modules.deactivate_mpc import (
+    MPCOnOff,
+    SkipMPCInIntervals,
+    SkippableMixin,
+)
+from agentlib_mpc_tpu.modules.pid import PID, FallbackPID
+from agentlib_mpc_tpu.modules.input_prediction import InputPredictor

@@ -24,13 +24,14 @@ from agentlib_mpc_tpu.backends.mhe_backend import (
     MHEVariableReference,
     WEIGHT_PREFIX,
 )
+from agentlib_mpc_tpu.modules.deactivate_mpc import SkippableMixin
 from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
 
 MAX_HISTORY = 10_000
 
 
 @register_module("mhe")
-class MHE(BaseModule):
+class MHE(SkippableMixin, BaseModule):
     """Moving horizon estimator."""
 
     variable_groups = ("states", "known_inputs", "estimated_inputs",
@@ -52,6 +53,7 @@ class MHE(BaseModule):
         self.backend = create_backend(config["optimization_backend"])
         self.backend.register_logger(self.logger)
         self._setup_backend()
+        self.init_skippable()
 
     def _setup_backend(self) -> None:
         states = self._groups.get("states", [])
@@ -111,6 +113,8 @@ class MHE(BaseModule):
             yield self.time_step
 
     def do_step(self) -> None:
+        if self.check_if_should_be_skipped():
+            return
         variables = self.collect_variables_for_optimization()
         result = self.backend.solve(self.env.now, variables)
         self._set_estimation(result)

@@ -55,6 +55,12 @@ class MLSimulator(BaseModule):
             return hist_next, nxt, outs
 
         self._sim_step = sim_step
+        # compile at construction (real-time schedules must not pause on
+        # the first step); hot-swaps with matching shapes hit the jit cache
+        out = sim_step(self.hist,
+                       jnp.asarray(model.default_vector("parameters")),
+                       model.ml_params)
+        jax.block_until_ready(out)
 
     def register_callbacks(self) -> None:
         super().register_callbacks()

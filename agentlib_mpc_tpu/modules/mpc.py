@@ -22,19 +22,21 @@ from typing import Optional
 import numpy as np
 
 from agentlib_mpc_tpu.backends.backend import VariableReference, create_backend
+from agentlib_mpc_tpu.modules.deactivate_mpc import SkippableMixin
 from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
 
 logger = logging.getLogger(__name__)
 
 
 @register_module("mpc", "mpc_basic")
-class BaseMPC(BaseModule):
+class BaseMPC(SkippableMixin, BaseModule):
     """Periodic control loop: collect vars → solve OCP → actuate u[0]."""
 
     variable_groups = ("inputs", "outputs", "states", "parameters",
                       "controls", "binary_controls")
-    #: controls are actuation commands other agents (the plant) consume
-    shared_groups = ("outputs", "controls")
+    #: controls (incl. binary schedules) are actuation commands other
+    #: agents (the plant) consume
+    shared_groups = ("outputs", "controls", "binary_controls")
 
     def __init__(self, config: dict, agent):
         super().__init__(config, agent)
@@ -44,6 +46,7 @@ class BaseMPC(BaseModule):
         self.backend.register_logger(self.logger)
         self._history_rows: list[dict] = []
         self._setup_backend()
+        self.init_skippable()
 
     def _setup_backend(self) -> None:
         self.var_ref = VariableReference(
@@ -92,6 +95,8 @@ class BaseMPC(BaseModule):
             yield self.time_step
 
     def do_step(self) -> None:
+        if self.check_if_should_be_skipped():
+            return
         variables = self.collect_variables_for_optimization()
         result = self.backend.solve(self.env.now, variables)
         self.set_actuation(result)

@@ -55,6 +55,12 @@ class Simulator(BaseModule):
                                        substeps=substeps, method=method)
 
         self._sim_step = sim_step
+        # compile now, not at the first control step: in real-time mode a
+        # first-step jit pause would let the schedule slip behind wall time
+        x, y = sim_step(jnp.asarray(self._x),
+                        jnp.asarray(model.default_vector("inputs")),
+                        jnp.asarray(model.default_vector("parameters")))
+        jax.block_until_ready((x, y))
 
     def process(self):
         while True:

@@ -1,0 +1,75 @@
+"""MQTT communicator: cross-machine interop path.
+
+Counterpart of the reference's MQTT communicator (SURVEY.md §2.9: topics
+``/agentlib/<agent_id>``, ``docs/source/tutorials/ADMM.md:69-97``). The
+paho-mqtt dependency is optional (not in this image); the class raises a
+clear error at construction when it is missing, and everything else in the
+framework runs without it — the same gating the reference applies to its
+optional communicators.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from agentlib_mpc_tpu.runtime.wire import var_from_wire, var_to_wire
+
+logger = logging.getLogger(__name__)
+
+TOPIC_PREFIX = "/agentlib_mpc_tpu"
+
+
+class MqttBus:
+    """BroadcastBus-compatible bridge publishing shared variables to
+    ``<prefix>/<agent_id>`` and subscribing to ``<prefix>/#``."""
+
+    def __init__(self, agent_id: str, broker_host: str = "localhost",
+                 broker_port: int = 1883, prefix: str = TOPIC_PREFIX,
+                 username: Optional[str] = None,
+                 password: Optional[str] = None):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as exc:  # pragma: no cover - optional dep
+            raise ImportError(
+                "the MQTT communicator needs paho-mqtt (`pip install "
+                "paho-mqtt`); it is an optional extra of this framework"
+            ) from exc
+        self.agent_id = agent_id
+        self.prefix = prefix.rstrip("/")
+        self._broker = None
+        try:  # paho-mqtt >= 2.0 requires an explicit callback API version
+            self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION1)
+        except AttributeError:  # paho-mqtt 1.x
+            self._client = mqtt.Client()
+        if username:
+            self._client.username_pw_set(username, password)
+        self._client.on_message = self._on_message
+        self._client.connect(broker_host, broker_port)
+        self._client.subscribe(f"{self.prefix}/#")
+        self._client.loop_start()
+
+    def attach(self, data_broker) -> None:
+        self._broker = data_broker
+        data_broker.attach_bus(self)
+
+    # BroadcastBus seam -------------------------------------------------------
+    def broadcast(self, from_agent: str, var) -> None:
+        self._client.publish(f"{self.prefix}/{from_agent}",
+                             var_to_wire(var))
+
+    def _on_message(self, client, userdata, msg) -> None:
+        if msg.topic == f"{self.prefix}/{self.agent_id}":
+            return  # own echo
+        if self._broker is None:
+            return
+        try:
+            var = var_from_wire(msg.payload)
+        except (ValueError, KeyError) as exc:
+            logger.warning("dropping malformed MQTT payload: %s", exc)
+            return
+        self._broker.send_variable(var, from_external=True)
+
+    def close(self) -> None:
+        self._client.loop_stop()
+        self._client.disconnect()

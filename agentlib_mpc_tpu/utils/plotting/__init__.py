@@ -1,0 +1,26 @@
+"""Plotting & visualization.
+
+Counterpart of the reference's ``utils/plotting/`` family (corporate
+style ``basic.py:27-58``, prediction-fade MPC plots ``mpc.py``, ADMM
+residual plots ``admm_residuals.py``, NLP sparsity spy
+``discretization_structure.py``, ML fit evaluation ``ml_model_test.py``,
+Dash dashboards ``interactive.py``/``mpc_dashboard.py``/
+``admm_dashboard.py``). Matplotlib backends are imported lazily; the
+interactive dashboard degrades with a clear message when dash/plotly are
+not installed (they are optional extras here, like the reference's).
+"""
+
+from agentlib_mpc_tpu.utils.plotting.basic import (
+    COLORS,
+    Style,
+    make_fig,
+    make_grid,
+)
+from agentlib_mpc_tpu.utils.plotting.mpc import plot_mpc, plot_mpc_plan
+from agentlib_mpc_tpu.utils.plotting.admm import (
+    plot_admm_consensus,
+    plot_admm_residuals,
+)
+from agentlib_mpc_tpu.utils.plotting.structure import spy_nlp
+from agentlib_mpc_tpu.utils.plotting.ml import evaluate_ml_fit
+from agentlib_mpc_tpu.utils.plotting.interactive import show_dashboard

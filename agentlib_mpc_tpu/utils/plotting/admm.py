@@ -1,0 +1,55 @@
+"""ADMM diagnostics plots (reference ``utils/plotting/admm_residuals.py``
+and ``admm_consensus_shades.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu.utils.analysis import admm_at_time_step
+from agentlib_mpc_tpu.utils.plotting.basic import COLORS, Style, make_fig
+
+
+def plot_admm_residuals(stats, ax=None, rho: bool = True,
+                        style: Optional[Style] = None):
+    """stats: coordinator per-iteration DataFrame with columns
+    primal_residual / dual_residual (and penalty) — semilog residual decay
+    (reference ``admm_residuals.py:11-60``). Accepts a flat frame (one
+    step) or one indexed (time, iteration)."""
+    if ax is None:
+        _, axes = make_fig(style)
+        ax = axes[0, 0]
+    idx = np.arange(len(stats))
+    ax.semilogy(idx, np.abs(stats["primal_residual"].to_numpy(dtype=float)),
+                color=COLORS["blue"], label="primal residual")
+    ax.semilogy(idx, np.abs(stats["dual_residual"].to_numpy(dtype=float)),
+                color=COLORS["red"], label="dual residual")
+    if rho and "penalty" in stats:
+        ax.semilogy(idx, stats["penalty"].to_numpy(dtype=float),
+                    color=COLORS["grey"], linestyle="--", label="rho")
+    ax.set_xlabel("ADMM iteration")
+    ax.set_ylabel("residual")
+    ax.legend()
+    return ax
+
+
+def plot_admm_consensus(data, variable: str, time_step: float, ax=None,
+                        color: Optional[str] = None):
+    """Iteration shades of one coupling trajectory converging at one
+    control step (reference ``admm_consensus_shades.py``)."""
+    if ax is None:
+        _, axes = make_fig()
+        ax = axes[0, 0]
+    color = color or COLORS["green"]
+    sl = admm_at_time_step(data, time_step)
+    iters = np.unique(np.asarray(sl.index.get_level_values(0), dtype=float))
+    for i, it in enumerate(iters):
+        series = admm_at_time_step(data, time_step, variable, iteration=it)
+        alpha = 0.15 + 0.85 * (i + 1) / len(iters)
+        ax.plot(series.index, series.to_numpy(dtype=float), color=color,
+                alpha=alpha,
+                label=f"iter {int(it)}" if it == iters[-1] else None)
+    ax.set_xlabel("time / s")
+    ax.set_ylabel(variable)
+    return ax

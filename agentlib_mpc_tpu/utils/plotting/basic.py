@@ -1,0 +1,58 @@
+"""Shared plot style (reference ``utils/plotting/basic.py:27-58``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+#: palette in the spirit of the reference's EBC colors
+COLORS = {
+    "blue": "#00549f",
+    "light_blue": "#8ebae5",
+    "red": "#cc071e",
+    "green": "#57ab27",
+    "orange": "#f6a800",
+    "grey": "#646567",
+    "black": "#000000",
+}
+
+
+@dataclasses.dataclass
+class Style:
+    color_cycle: tuple = tuple(COLORS.values())
+    grid: bool = True
+    figsize: tuple = (8.0, 4.5)
+    dpi: int = 120
+    font_size: int = 10
+
+
+def _use_agg():
+    import matplotlib
+
+    if matplotlib.get_backend().lower() not in ("agg",):
+        try:  # headless environments
+            matplotlib.use("Agg", force=False)
+        except Exception:  # pragma: no cover
+            pass
+
+
+def make_fig(style: Optional[Style] = None, rows: int = 1, cols: int = 1):
+    """(fig, axes) with the shared style applied (reference ``make_fig``)."""
+    _use_agg()
+    import matplotlib.pyplot as plt
+    from cycler import cycler
+
+    style = style or Style()
+    fig, axes = plt.subplots(rows, cols, figsize=style.figsize,
+                             dpi=style.dpi, squeeze=False)
+    for ax in axes.ravel():
+        ax.set_prop_cycle(cycler(color=list(style.color_cycle)))
+        if style.grid:
+            ax.grid(True, alpha=0.3)
+        ax.tick_params(labelsize=style.font_size)
+    return fig, axes
+
+
+def make_grid(ax):
+    ax.grid(True, alpha=0.3)
+    return ax

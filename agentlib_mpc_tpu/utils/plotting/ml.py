@@ -1,0 +1,44 @@
+"""ML fit evaluation (reference ``utils/plotting/ml_model_test.py:56+``):
+one-step prediction scatter + error metrics of a serialized model against
+held-out data."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from agentlib_mpc_tpu.ml.predictors import make_predictor
+from agentlib_mpc_tpu.ml.serialized import SerializedMLModel
+from agentlib_mpc_tpu.utils.plotting.basic import COLORS, make_fig
+
+
+def evaluate_ml_fit(serialized: SerializedMLModel, X, y,
+                    ax=None, plot: bool = True) -> dict:
+    """Returns {"rmse", "mae", "r2"} per output; optionally draws the
+    predicted-vs-true scatter."""
+    pred = make_predictor(serialized)
+    X = np.asarray(X, dtype=float)
+    y = np.asarray(y, dtype=float).reshape(len(X), -1)
+    got = np.stack([np.asarray(pred.apply(pred.params, x)) for x in X])
+    metrics = {}
+    for j, name in enumerate(serialized.output):
+        err = got[:, j] - y[:, j]
+        ss_res = float(np.sum(err ** 2))
+        ss_tot = float(np.sum((y[:, j] - y[:, j].mean()) ** 2)) or 1e-30
+        metrics[name] = {
+            "rmse": float(np.sqrt(np.mean(err ** 2))),
+            "mae": float(np.mean(np.abs(err))),
+            "r2": 1.0 - ss_res / ss_tot,
+        }
+    if plot:
+        if ax is None:
+            _, axes = make_fig()
+            ax = axes[0, 0]
+        for j, name in enumerate(serialized.output):
+            ax.scatter(y[:, j], got[:, j], s=8, alpha=0.6,
+                       label=f"{name} (r2={metrics[name]['r2']:.3f})")
+        lims = [min(y.min(), got.min()), max(y.max(), got.max())]
+        ax.plot(lims, lims, color=COLORS["grey"], linewidth=0.8)
+        ax.set_xlabel("true")
+        ax.set_ylabel("predicted")
+        ax.legend()
+    return metrics

@@ -1,0 +1,60 @@
+"""MPC result plots with prediction fade (reference
+``utils/plotting/mpc.py:48+``): every solve's predicted trajectory is
+drawn with opacity growing toward the most recent solve, the realized
+closed-loop signal on top."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu.utils.analysis import (
+    first_vals_at_trajectory_index,
+    mpc_at_time_step,
+)
+from agentlib_mpc_tpu.utils.plotting.basic import COLORS, Style, make_fig
+
+
+def plot_mpc(data, variable: str, ax=None, plot_actual_values: bool = True,
+             plot_predictions: bool = True, color: Optional[str] = None,
+             style: Optional[Style] = None):
+    """data: (time, grid)-MultiIndex results (module ``results()`` or
+    ``analysis.load_mpc``). Returns the axis."""
+    if ax is None:
+        _, axes = make_fig(style)
+        ax = axes[0, 0]
+    color = color or COLORS["blue"]
+    times = np.unique(np.asarray(data.index.get_level_values(0),
+                                 dtype=float))
+    if plot_predictions:
+        n = len(times)
+        for i, t in enumerate(times):
+            series = mpc_at_time_step(data, t, variable)
+            alpha = 0.1 + 0.5 * (i + 1) / n
+            ax.plot(series.index, series.to_numpy(dtype=float),
+                    color=color, alpha=alpha, linewidth=0.8)
+    if plot_actual_values:
+        cols = data.columns
+        key = ("variable", variable) if getattr(cols, "nlevels", 1) == 2 \
+            else variable
+        actual = first_vals_at_trajectory_index(data[key])
+        ax.plot(actual.index, actual.to_numpy(dtype=float), color=color,
+                linewidth=1.8, label=variable)
+    ax.set_xlabel("time / s")
+    ax.set_ylabel(variable)
+    return ax
+
+
+def plot_mpc_plan(data, variable: str, time_step: Optional[float] = None,
+                  ax=None, color: Optional[str] = None):
+    """A single solve's plan (reference per-step plan plot)."""
+    if ax is None:
+        _, axes = make_fig()
+        ax = axes[0, 0]
+    series = mpc_at_time_step(data, time_step, variable)
+    ax.step(series.index, series.to_numpy(dtype=float), where="post",
+            color=color or COLORS["red"], label=f"{variable} plan")
+    ax.set_xlabel("time / s")
+    ax.set_ylabel(variable)
+    return ax

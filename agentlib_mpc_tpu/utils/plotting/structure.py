@@ -1,0 +1,34 @@
+"""NLP structure visualization (reference
+``utils/plotting/discretization_structure.py:11-35``: a spy plot of the
+CasADi NLP's constraint jacobian). Here the jacobian comes from
+``jax.jacfwd`` over the transcribed OCP's constraint functions."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from agentlib_mpc_tpu.utils.plotting.basic import make_fig
+
+
+def nlp_jacobian_pattern(ocp, theta=None, tol: float = 1e-12) -> np.ndarray:
+    """Boolean sparsity pattern of d[g; h]/dw at the default point."""
+    theta = theta if theta is not None else ocp.default_params()
+    w0 = ocp.initial_guess(theta)
+    Jg = jax.jacfwd(lambda w: ocp.nlp.g(w, theta))(w0)
+    Jh = jax.jacfwd(lambda w: ocp.nlp.h(w, theta))(w0)
+    J = np.concatenate([np.asarray(Jg).reshape(-1, w0.size),
+                        np.asarray(Jh).reshape(-1, w0.size)], axis=0)
+    return np.abs(J) > tol
+
+
+def spy_nlp(ocp, ax=None, theta=None):
+    """Spy plot of the transcription's constraint jacobian."""
+    if ax is None:
+        _, axes = make_fig()
+        ax = axes[0, 0]
+    pattern = nlp_jacobian_pattern(ocp, theta)
+    ax.spy(pattern, markersize=1)
+    ax.set_xlabel(f"decision variables ({pattern.shape[1]})")
+    ax.set_ylabel(f"constraints ({pattern.shape[0]})")
+    return ax

@@ -1,0 +1,157 @@
+"""Analysis utils + plotting: CSV round trips, slicing, index conversion,
+figure rendering (Agg), NLP sparsity, ML fit metrics.
+
+Mirrors the reference's analysis surface (``utils/analysis.py``) against
+synthetic results in the exact on-disk layout, so both stacks' analyses
+interoperate.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+from agentlib_mpc_tpu.utils import analysis
+from agentlib_mpc_tpu.utils.plotting import (
+    evaluate_ml_fit,
+    plot_admm_residuals,
+    plot_mpc,
+    plot_mpc_plan,
+    show_dashboard,
+    spy_nlp,
+)
+
+
+def _mpc_frame():
+    """Two solves at t=0 and t=300, horizon grid 0/100/200."""
+    frames = []
+    for t in (0.0, 300.0):
+        df = pd.DataFrame({
+            ("variable", "T"): [295.0 + t / 100, 294.0, 293.0],
+            ("variable", "mDot"): [0.01, 0.02, np.nan],
+        })
+        df.index = pd.MultiIndex.from_product(
+            [[t], [0.0, 100.0, 200.0]], names=["time", "grid"])
+        frames.append(df)
+    out = pd.concat(frames)
+    out.columns = pd.MultiIndex.from_tuples(out.columns)
+    return out
+
+
+def _admm_frame():
+    frames = []
+    for t in (0.0, 300.0):
+        for it in (0, 1, 2):
+            df = pd.DataFrame({"mDot": [0.01 * (it + 1)] * 3})
+            df.index = pd.MultiIndex.from_product(
+                [[t], [it], [0.0, 100.0, 200.0]],
+                names=["time", "iteration", "grid"])
+            frames.append(df)
+    return pd.concat(frames)
+
+
+class TestAnalysis:
+    def test_mpc_roundtrip(self, tmp_path):
+        df = _mpc_frame()
+        path = tmp_path / "mpc.csv"
+        analysis.save_mpc(df, path)
+        back = analysis.load_mpc(path)
+        assert back.index.names == ["time", "grid"]
+        np.testing.assert_allclose(
+            back[("variable", "T")].to_numpy(dtype=float),
+            df[("variable", "T")].to_numpy(dtype=float))
+
+    def test_at_time_step_offsets(self):
+        df = _mpc_frame()
+        series = analysis.mpc_at_time_step(df, 300.0, "T")
+        np.testing.assert_allclose(series.index, [300.0, 400.0, 500.0])
+        assert series.iloc[0] == pytest.approx(298.0)
+        # nearest-match semantics
+        series2 = analysis.mpc_at_time_step(df, 290.0, "T")
+        np.testing.assert_allclose(series2.index, [300.0, 400.0, 500.0])
+
+    def test_admm_slicing(self):
+        df = _admm_frame()
+        final = analysis.admm_at_time_step(df, 0.0, "mDot", iteration=2)
+        np.testing.assert_allclose(final.to_numpy(dtype=float), 0.03)
+        assert analysis.get_number_of_iterations(df) == {0.0: 3, 300.0: 3}
+
+    def test_convert_index(self):
+        df = _mpc_frame()
+        hours = analysis.convert_index(df, to_unit="hours", level="time")
+        times = np.unique(hours.index.get_level_values(0))
+        np.testing.assert_allclose(times, [0.0, 300.0 / 3600.0])
+
+    def test_first_vals(self):
+        df = _mpc_frame()
+        closed_loop = analysis.first_vals_at_trajectory_index(
+            df[("variable", "T")])
+        np.testing.assert_allclose(closed_loop.to_numpy(dtype=float),
+                                   [295.0, 298.0])
+
+    def test_save_results_tree(self, tmp_path):
+        results = {"agentA": {"mpc": _mpc_frame(),
+                              "sim": pd.DataFrame({"T": [1.0, 2.0]},
+                                                  index=[0.0, 60.0])}}
+        written = analysis.save_results(results, tmp_path)
+        assert set(written) == {"agentA_mpc", "agentA_sim"}
+        assert analysis.load_sim(written["agentA_sim"])["T"].iloc[1] == 2.0
+
+
+class TestPlotting:
+    def test_plot_mpc_renders(self):
+        ax = plot_mpc(_mpc_frame(), "T")
+        assert len(ax.lines) >= 3  # 2 faded predictions + actual
+
+    def test_plot_plan(self):
+        ax = plot_mpc_plan(_mpc_frame(), "mDot", 0.0)
+        assert ax.get_ylabel() == "mDot"
+
+    def test_residual_plot(self):
+        stats = pd.DataFrame({
+            "primal_residual": [1.0, 0.1, 0.01],
+            "dual_residual": [0.5, 0.2, 0.05],
+            "penalty": [10.0, 10.0, 20.0]})
+        ax = plot_admm_residuals(stats)
+        assert len(ax.lines) == 3
+
+    def test_static_dashboard(self, tmp_path):
+        fig = show_dashboard({"agentA": {"mpc": _mpc_frame()}},
+                             save_path=str(tmp_path / "dash.png"))
+        assert (tmp_path / "dash.png").exists()
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    def test_spy_nlp_banded(self):
+        from agentlib_mpc_tpu.models.zoo import OneRoom
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+        from agentlib_mpc_tpu.utils.plotting.structure import \
+            nlp_jacobian_pattern
+
+        ocp = transcribe(OneRoom(), ["mDot"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        pattern = nlp_jacobian_pattern(ocp)
+        assert pattern.shape == (ocp.n_g + ocp.n_h, ocp.n_w)
+        # shooting structure is sparse: well under half the entries active
+        assert 0 < pattern.mean() < 0.5
+        ax = spy_nlp(ocp)
+        assert ax.get_xlabel().startswith("decision")
+
+    def test_ml_fit_metrics(self):
+        from agentlib_mpc_tpu.ml import Feature, OutputFeature, \
+            SerializedLinReg
+
+        m = SerializedLinReg(
+            dt=1.0, inputs={"a": Feature(name="a")},
+            output={"y": OutputFeature(name="y", output_type="absolute",
+                                       recursive=False)},
+            coef=[[2.0]], intercept=[1.0])
+        X = np.linspace(0, 1, 20)[:, None]
+        y = 2.0 * X[:, 0] + 1.0
+        metrics = evaluate_ml_fit(m, X, y, plot=False)
+        assert metrics["y"]["rmse"] == pytest.approx(0.0, abs=1e-9)
+        assert metrics["y"]["r2"] == pytest.approx(1.0)

@@ -1,0 +1,225 @@
+"""Aux control modules: PID, fallback hand-over, MPC deactivation,
+set-point generator, input prediction, time utils.
+
+Covers the reference's deactivation suite (``deactivate_mpc.py``,
+``fallback_pid.py``, ``skippable_mixin.py``) and excitation/prediction
+modules with direct unit tests plus a MAS hand-over scenario.
+"""
+
+import numpy as np
+import pytest
+
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.variables import control_input, output, parameter, state
+from agentlib_mpc_tpu.modules.deactivate_mpc import MPC_FLAG_ACTIVE
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+from agentlib_mpc_tpu.utils.sampling import sample
+from agentlib_mpc_tpu.utils.time_utils import (
+    convert_time,
+    is_time_in_intervals,
+)
+
+
+class TestTimeUtils:
+    def test_convert(self):
+        assert convert_time(2, "hours", "seconds") == 7200
+        assert convert_time(86400, "seconds", "days") == 1
+
+    def test_intervals(self):
+        assert is_time_in_intervals(5, [(0, 10)])
+        assert not is_time_in_intervals(11, [(0, 10)])
+        assert is_time_in_intervals(15, [(0, 10), (12, 20)])
+
+
+class _Host:
+    """Minimal agent stand-in for module unit tests."""
+
+    class _Env:
+        now = 0.0
+
+    class _Broker:
+        def register_callback(self, *a, **k):
+            pass
+
+        def send_variable(self, v):
+            pass
+
+    def __init__(self):
+        self.id = "host"
+        self.env = self._Env()
+        self.data_broker = self._Broker()
+
+
+class TestPIDUnit:
+    def _pid(self, **cfg):
+        from agentlib_mpc_tpu.modules.pid import PID
+
+        base = {"module_id": "pid",
+                "input": {"name": "y"},
+                "output": {"name": "u"},
+                "setpoint": 10.0, "Kp": 2.0}
+        base.update(cfg)
+        return PID(base, _Host())
+
+    def test_proportional(self):
+        pid = self._pid()
+        assert pid.do_step(8.0, 0.0) is None  # first sample arms timing
+        assert pid.do_step(8.0, 1.0) == pytest.approx(4.0)  # Kp*e = 2*2
+
+    def test_integral_accumulates(self):
+        pid = self._pid(Ti=10.0)
+        pid.do_step(8.0, 0.0)
+        u1 = pid.do_step(8.0, 1.0)
+        u2 = pid.do_step(8.0, 2.0)
+        assert u2 > u1  # integral grows with persistent error
+
+    def test_saturation_and_antiwindup(self):
+        pid = self._pid(Ti=1.0, ub=1.0)
+        pid.do_step(0.0, 0.0)
+        for k in range(1, 20):
+            u = pid.do_step(0.0, float(k))
+        assert u == 1.0
+        windup = pid.integral
+        # error flips sign: output must unwind immediately, not after
+        # discharging a huge integral
+        assert windup < 50.0
+        u = pid.do_step(20.0, 21.0)
+        assert u < 1.0
+
+    def test_reverse_acting(self):
+        pid = self._pid(reverse_acting=True)
+        pid.do_step(12.0, 0.0)
+        assert pid.do_step(12.0, 1.0) == pytest.approx(4.0)  # −(10−12)·2
+
+
+class TestSetPointGenerator:
+    def test_bands(self):
+        from agentlib_mpc_tpu.modules.setpoint_generator import \
+            SetPointGenerator
+
+        gen = SetPointGenerator({"module_id": "sp", "interval": 3600,
+                                 "day_start": 8, "day_end": 16}, _Host())
+        assert gen.band_at(10 * 3600.0) == (gen.day_lb, gen.day_ub)
+        assert gen.band_at(20 * 3600.0) == (gen.night_lb, gen.night_ub)
+        # day 5 = weekend → night band even at noon
+        assert gen.band_at((5 * 24 + 12) * 3600.0) == (gen.night_lb,
+                                                       gen.night_ub)
+
+
+class TestInputPredictor:
+    def test_prediction_series_sampleable(self):
+        from agentlib_mpc_tpu.modules.input_prediction import InputPredictor
+
+        table = {"T_amb": {float(t): 280.0 + t / 100.0
+                           for t in range(0, 7200, 600)}}
+        mod = InputPredictor({"module_id": "weather", "data": table,
+                              "t_sample": 600, "prediction_horizon": 1800,
+                              "prediction_sample": 600}, _Host())
+        preds = mod.get_prediction_at_time(1200.0)
+        times, vals = preds["T_amb"]
+        assert len(times) == 4
+        assert vals[0] == pytest.approx(292.0)
+        # an MPC backend samples the forecast onto its own grid
+        onto = sample((times, vals), [0.0, 600.0], current=1200.0)
+        np.testing.assert_allclose(onto, [292.0, 298.0])
+
+
+# -- MAS hand-over scenario ---------------------------------------------------
+
+class OneRoomFast(Model):
+    inputs = [
+        control_input("mDot", 0.02, lb=0.0, ub=0.05),
+        control_input("load", 150.0),
+        control_input("T_in", 290.15),
+        control_input("T_upper", 295.15),
+    ]
+    states = [state("T", 295.15, lb=288.15, ub=303.15),
+              state("T_slack", 0.0)]
+    parameters = [parameter("cp", 1000.0), parameter("C", 100000.0),
+                  parameter("s_T", 0.01), parameter("r_mDot", 0.1)]
+    outputs = [output("T_out")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        from agentlib_mpc_tpu.models.objective import SubObjective
+
+        eq.objective = (SubObjective(v.mDot, weight=v.r_mDot, name="c")
+                        + SubObjective(v.T_slack ** 2, weight=v.s_T,
+                                       name="s"))
+        return eq
+
+
+@pytest.fixture(scope="module")
+def handover_results():
+    mpc_agent = {
+        "id": "Controller",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "mpc", "type": "mpc",
+             "enable_deactivation": True,
+             "optimization_backend": {
+                 "type": "jax",
+                 "model": {"class": OneRoomFast},
+                 "discretization_options": {"method": "multiple_shooting"},
+                 "solver": {"max_iter": 40}},
+             "time_step": 300, "prediction_horizon": 6,
+             "inputs": [{"name": "T_in"}, {"name": "load"},
+                        {"name": "T_upper"}],
+             "controls": [{"name": "mDot", "value": 0.02,
+                           "lb": 0, "ub": 0.05}],
+             "states": [{"name": "T", "value": 297.15, "alias": "T",
+                         "source": "Plant"}],
+             "outputs": [{"name": "T_out", "shared": False}],
+             "parameters": []},
+            # deactivate the MPC between 1500 s and 3000 s
+            {"module_id": "onoff", "type": "skip_mpc_intervals",
+             "t_sample": 300, "intervals": [[1500, 3000]]},
+            {"module_id": "fallback", "type": "fallback_pid",
+             "input": {"name": "T", "alias": "T", "source": "Plant"},
+             "output": {"name": "mDot", "alias": "mDot"},
+             "setpoint": 295.15, "Kp": 0.01, "Ti": 600.0,
+             "lb": 0.0, "ub": 0.05, "reverse_acting": True},
+        ],
+    }
+    plant_agent = {
+        "id": "Plant",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "room", "type": "simulator",
+             "model": {"class": OneRoomFast,
+                       "states": [{"name": "T", "value": 297.15}]},
+             "t_sample": 60,
+             "inputs": [{"name": "mDot", "alias": "mDot"}],
+             "outputs": [{"name": "T_out", "alias": "T"}]},
+        ],
+    }
+    mas = LocalMAS([mpc_agent, plant_agent], env={"rt": False})
+    mas.run(until=4500)
+    return mas
+
+
+class TestHandover:
+    def test_mpc_skips_in_interval(self, handover_results):
+        mpc = handover_results.agents["Controller"].get_module("mpc")
+        stats = mpc.solver_stats()
+        times = stats.index.to_numpy()
+        assert not np.any((times >= 1800) & (times < 3000)), \
+            "MPC must not solve while deactivated"
+        assert np.any(times >= 3000), "MPC must resume after the interval"
+        assert np.any(times < 1500)
+
+    def test_flag_broadcast(self, handover_results):
+        onoff = handover_results.agents["Controller"].get_module("onoff")
+        assert MPC_FLAG_ACTIVE in onoff.vars
+
+    def test_plant_controlled_throughout(self, handover_results):
+        sim = handover_results.agents["Plant"].get_module("room")
+        df = sim.results()
+        # fallback PID keeps cooling during the MPC outage
+        outage = df[(df.index > 2000) & (df.index < 3000)]
+        assert outage["mDot"].max() > 0.0
+        assert df["T_out"].iloc[-1] < 296.5

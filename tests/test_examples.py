@@ -1,0 +1,33 @@
+"""Examples as integration tests — the reference's test backbone
+(``tests/test_examples.py:74-243``): run each example's ``run_example``
+for a bounded sim time with ``testing=True`` so the example's own
+closed-loop assertions execute.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def test_admm_cooled_room_example():
+    from examples.admm_cooled_room import run_example
+
+    results = run_example(until=1800, testing=True, verbose=False)
+    assert "CooledRoom" in results and "Cooler" in results
+
+
+def test_minlp_switched_room_example():
+    from examples.minlp_switched_room import run_example
+
+    results = run_example(until=4500, testing=True, verbose=False)
+    assert "Plant" in results
+
+
+def test_ml_mpc_example():
+    from examples.ml_mpc_one_room import run_example
+
+    out = run_example(until=4500, testing=True, verbose=False, epochs=200)
+    assert len(out["temps"]) == 15
