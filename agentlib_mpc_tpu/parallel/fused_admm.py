@@ -69,6 +69,11 @@ class AgentGroup:
     couplings: dict[str, str] = dataclasses.field(default_factory=dict)
     exchanges: dict[str, str] = dataclasses.field(default_factory=dict)
     solver_options: SolverOptions = SolverOptions()
+    #: inner budget for warm ADMM iterations (primal+dual+barrier all
+    #: warm-started, so a short budget suffices; wall time of a vmapped
+    #: while_loop is the slowest lane's count). None -> solver_options
+    #: with max_iter capped at 6.
+    warm_solver_options: "SolverOptions | None" = None
 
     def control_index(self, var_name: str) -> int:
         return self.ocp.control_names.index(var_name)
@@ -99,6 +104,8 @@ class FusedState(NamedTuple):
     ex_lam: dict          # alias -> (T,) shared exchange multiplier
     rho: jnp.ndarray
     w: tuple              # per group: (n_i, n_w) primal warm starts
+    y: tuple              # per group: (n_i, n_g) equality-dual warm starts
+    z: tuple              # per group: (n_i, n_h) inequality-dual warm starts
 
 
 class IterationStats(NamedTuple):
@@ -107,6 +114,9 @@ class IterationStats(NamedTuple):
     dual_residuals: jnp.ndarray
     penalty: jnp.ndarray             # (max_iter,)
     converged: jnp.ndarray           # () bool
+    #: every inner interior-point solve of every iteration reached an
+    #: acceptable point (False flags inexact-budget exhaustion)
+    local_solves_ok: jnp.ndarray     # () bool
 
 
 class FusedADMM:
@@ -155,9 +165,12 @@ class FusedADMM:
         w = tuple(
             jax.vmap(g.ocp.initial_guess)(theta)
             for g, theta in zip(self.groups, theta_batches))
+        y = tuple(jnp.zeros((g.n_agents, g.ocp.n_g)) for g in self.groups)
+        z = tuple(jnp.full((g.n_agents, g.ocp.n_h), 0.1)
+                  for g in self.groups)
         return FusedState(zbar=zbar, lam=lam, ex_mean=ex_mean,
                           ex_diff=ex_diff, ex_lam=ex_lam,
-                          rho=jnp.asarray(self.options.rho), w=w)
+                          rho=jnp.asarray(self.options.rho), w=w, y=y, z=z)
 
     def shift_state(self, state: FusedState) -> FusedState:
         """Shift-by-one warm start between control steps
@@ -233,9 +246,15 @@ class FusedADMM:
 
         group_nlps = [make_group_nlp(gi) for gi in range(n_groups)]
 
-        def local_solves(gi, state: FusedState, theta_batch):
+        warm_opts = [
+            g.warm_solver_options
+            or g.solver_options._replace(
+                max_iter=min(g.solver_options.max_iter, 6))
+            for g in groups]
+
+        def local_solves(gi, state: FusedState, theta_batch, opts, mu0):
             """vmapped augmented solves of one group. Returns (w_batch,
-            u_batch) with u on the control grid."""
+            y_batch, z_batch, u_batch) with u on the control grid."""
             g = groups[gi]
             entries = aug_map[gi]
 
@@ -265,16 +284,18 @@ class FusedADMM:
                                            (g.n_agents, self.T))
                 slices.append((glob, lam, kind))
 
-            def one_agent(w_guess, ocp_theta, *per_entry):
+            def one_agent(w_guess, y_guess, z_guess, ocp_theta,
+                          *per_entry):
                 aug = tuple((glob, lam, state.rho)
                             for (glob, lam) in per_entry)
                 lb, ub = g.ocp.bounds(ocp_theta)
                 res = solve_nlp(group_nlps[gi], w_guess, (ocp_theta, aug),
-                                lb, ub, g.solver_options)
+                                lb, ub, opts, y0=y_guess, z0=z_guess,
+                                mu0=mu0)
                 u = g.ocp.unflatten(res.w)["u"]
-                return res.w, u, res.stats.success
+                return res.w, res.y, res.z, u, res.stats.success
 
-            in_axes = [0, 0]
+            in_axes = [0, 0, 0, 0]
             vargs = []
             for glob, lam, kind in slices:
                 if kind == "consensus":
@@ -282,24 +303,37 @@ class FusedADMM:
                 else:
                     in_axes.append((0, 0))
                 vargs.append((glob, lam))
-            w_b, u_b, ok_b = jax.vmap(
+            w_b, y_b, z_b, u_b, ok_b = jax.vmap(
                 one_agent, in_axes=tuple(in_axes))(
-                state.w[gi], theta_batch, *vargs)
-            return w_b, u_b, ok_b
+                state.w[gi], state.y[gi], state.z[gi], theta_batch, *vargs)
+            return w_b, y_b, z_b, u_b, ok_b
 
         def step_fn(state: FusedState, theta_batches: tuple):
             max_it = opts.max_iterations
 
-            def iteration(carry):
-                state, it, _res, prim_hist, dual_hist, rho_hist, done = carry
+            def make_iteration(cold: bool):
+              def iteration(carry):
+                (state, it, _res, prim_hist, dual_hist, rho_hist, done,
+                 ok_hist) = carry
 
                 u_groups = []
-                w_new = []
+                w_new, y_new, z_new = [], [], []
                 ok_all = jnp.asarray(True)
                 for gi in range(n_groups):
-                    w_b, u_b, ok_b = local_solves(gi, state,
-                                                  theta_batches[gi])
+                    solver_opts = (groups[gi].solver_options if cold
+                                   else warm_opts[gi])
+                    # warm iterations restart the barrier small; an
+                    # explicitly supplied warm_solver_options wins
+                    mu0 = jnp.asarray(
+                        groups[gi].solver_options.mu_init if cold
+                        else (groups[gi].warm_solver_options.mu_init
+                              if groups[gi].warm_solver_options is not None
+                              else 1e-2))
+                    w_b, y_b, z_b, u_b, ok_b = local_solves(
+                        gi, state, theta_batches[gi], solver_opts, mu0)
                     w_new.append(w_b)
+                    y_new.append(y_b)
+                    z_new.append(z_b)
                     u_groups.append(u_b)
                     ok_all = ok_all & jnp.all(ok_b)
 
@@ -372,12 +406,15 @@ class FusedADMM:
                 state = state._replace(
                     zbar=zbar_new, lam=lam_new, ex_mean=ex_mean_new,
                     ex_diff=ex_diff_new, ex_lam=ex_lam_new,
-                    rho=rho_next, w=tuple(w_new))
+                    rho=rho_next, w=tuple(w_new), y=tuple(y_new),
+                    z=tuple(z_new))
                 return (state, it + 1, res_all, prim_hist, dual_hist,
-                        rho_hist, is_conv)
+                        rho_hist, is_conv, ok_hist & ok_all)
+
+              return iteration
 
             def cond(carry):
-                _state, it, _res, _p, _d, _r, done = carry
+                _state, it, _res, _p, _d, _r, done, _ok = carry
                 return (~done) & (it < max_it)
 
             nan_hist = jnp.full((max_it,), jnp.nan)
@@ -385,13 +422,20 @@ class FusedADMM:
                                      *([jnp.asarray(0.0)] * 4))
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
-                     jnp.full((max_it,), jnp.nan), jnp.asarray(False))
-            state, it, res, prim_hist, dual_hist, rho_hist, done = \
-                jax.lax.while_loop(cond, iteration, carry)
+                     jnp.full((max_it,), jnp.nan), jnp.asarray(False),
+                     jnp.asarray(True))
+            # two-phase inexact ADMM: iteration 0 runs the full (cold)
+            # interior-point budget, the while_loop continues with the
+            # short warm budget — primal, duals and barrier all carry over
+            carry = make_iteration(cold=True)(carry)
+            (state, it, res, prim_hist, dual_hist, rho_hist, done,
+             ok_hist) = jax.lax.while_loop(
+                cond, make_iteration(cold=False), carry)
 
             stats = IterationStats(
                 iterations=it, primal_residuals=prim_hist,
-                dual_residuals=dual_hist, penalty=rho_hist, converged=done)
+                dual_residuals=dual_hist, penalty=rho_hist, converged=done,
+                local_solves_ok=ok_hist)
             trajs = tuple(
                 jax.vmap(lambda w, th, g=g: g.ocp.trajectories(w, th))(
                     state.w[gi], theta_batches[gi])
@@ -429,6 +473,10 @@ class FusedADMM:
         groups_divisible = [g.n_agents % n_dev == 0 for g in self.groups]
         w = tuple(shard_group(gi, state.w[gi])
                   for gi in range(len(self.groups)))
+        y = tuple(shard_group(gi, state.y[gi])
+                  for gi in range(len(self.groups)))
+        z = tuple(shard_group(gi, state.z[gi])
+                  for gi in range(len(self.groups)))
         lam = {a: tuple(
             shard_group(gi, piece) for (gi, _c, _s), piece in zip(
                 self._group_participations(a, "consensus"), pieces))
@@ -438,7 +486,7 @@ class FusedADMM:
                 self._group_participations(a, "exchange"), pieces))
             for a, pieces in state.ex_diff.items()}
         state = state._replace(
-            w=w, lam=lam, ex_diff=ex_diff,
+            w=w, y=y, z=z, lam=lam, ex_diff=ex_diff,
             zbar=jax.device_put(state.zbar, repl),
             ex_mean=jax.device_put(state.ex_mean, repl),
             ex_lam=jax.device_put(state.ex_lam, repl),

@@ -1,0 +1,134 @@
+"""physXAI bridge + GPR data reduction.
+
+Mirrors the reference's physXAI plugin tests
+(``tests/test_physXAI_plugin/``: config translation, model creation,
+predictor equivalence) against synthetic artifacts, plus the Nystroem
+reducer contract (``data_reduction.py:33-52``).
+"""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.ml.data_reduction import NystroemReducer
+from agentlib_mpc_tpu.ml.physxai import (
+    convert_physxai_model,
+    parse_physxai_features,
+)
+from agentlib_mpc_tpu.ml.predictors import make_predictor
+
+
+def _preprocessing():
+    return {
+        "time_step": 900,
+        "shift": 1,
+        "inputs": ["T_amb", "Q", "Q_lag1", "T", "T_lag1"],
+        "output": ["Change(T)"],
+    }
+
+
+class TestConfigTranslation:
+    def test_lags_and_output_type(self):
+        dt, inputs, output = parse_physxai_features(_preprocessing())
+        assert dt == 900.0
+        assert inputs["T_amb"].lag == 1
+        assert inputs["Q"].lag == 2
+        assert "T" not in inputs  # recursive output, not a plain input
+        feat = output["T"]
+        assert feat.lag == 2
+        assert feat.output_type == "difference"
+        assert feat.recursive
+
+    def test_absolute_output(self):
+        cfg = {**_preprocessing(), "output": ["y"],
+               "inputs": ["T_amb", "Q"]}
+        _, inputs, output = parse_physxai_features(cfg)
+        assert output["y"].output_type == "absolute"
+        assert not output["y"].recursive
+
+    def test_shift_must_be_one(self):
+        with pytest.raises(ValueError, match="shift"):
+            parse_physxai_features({**_preprocessing(), "shift": 2})
+
+    def test_non_consecutive_lags_rejected(self):
+        cfg = {**_preprocessing(), "inputs": ["Q", "Q_lag2"]}
+        with pytest.raises(ValueError, match="consecutive"):
+            parse_physxai_features(cfg)
+
+
+class TestModelConversion:
+    def test_linreg_artifact_roundtrip(self, tmp_path):
+        from sklearn.linear_model import LinearRegression
+
+        rng = np.random.default_rng(0)
+        # feature layout follows our column_order: inputs (T_amb, Q x2),
+        # then recursive output T x2
+        X = rng.normal(size=(50, 5))
+        y = X @ np.array([0.1, -0.4, -0.2, 0.9, 0.05]) + 0.3
+        lr = LinearRegression().fit(X, y)
+        import joblib
+
+        path = tmp_path / "linreg.joblib"
+        joblib.dump(lr, path)
+        m = convert_physxai_model(_preprocessing(), path, "LinReg")
+        assert m.dt == 900.0
+        pred = make_predictor(m)
+        for x in rng.normal(size=(5, 5)):
+            np.testing.assert_allclose(
+                float(pred.apply(pred.params, x)[0]),
+                lr.predict(x[None, :])[0], rtol=1e-6)
+
+    def test_ann_artifact(self):
+        rng = np.random.default_rng(1)
+        artifact = {
+            "weights": [rng.normal(size=(5, 8)), rng.normal(size=(8, 1))],
+            "biases": [rng.normal(size=8), rng.normal(size=1)],
+            "activations": ["tanh", "linear"],
+        }
+        m = convert_physxai_model(_preprocessing(), artifact, "ANN")
+        pred = make_predictor(m)
+        out = pred.apply(pred.params, np.zeros(5))
+        assert out.shape == (1,)
+
+    def test_generate_requires_physxai(self):
+        from agentlib_mpc_tpu.ml.physxai import generate_physxai_models
+
+        with pytest.raises(ImportError, match="physXAI"):
+            generate_physxai_models(["train.py"], ".", "data.csv", "run1")
+
+
+class TestNystroem:
+    def test_reduces_to_m_points(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 2))
+        y = X[:, 0] + X[:, 1]
+        Xm, ym = NystroemReducer(n_components=40).reduce(X, y)
+        assert len(Xm) <= 40
+        assert len(Xm) == len(ym)
+        # inducing points are actual samples with matching targets
+        for xr, yr in zip(Xm[:5], ym[:5]):
+            i = int(np.argmin(np.sum((X - xr) ** 2, axis=1)))
+            assert yr[0] == pytest.approx(y[i])
+
+    def test_small_set_passthrough(self):
+        X = np.ones((5, 2))
+        y = np.ones(5)
+        Xm, ym = NystroemReducer(n_components=10).reduce(X, y)
+        assert len(Xm) == 5
+
+    def test_reduced_gpr_still_accurate(self):
+        from agentlib_mpc_tpu.ml import Feature, OutputFeature
+        from agentlib_mpc_tpu.ml.training import fit_gpr
+
+        rng = np.random.default_rng(3)
+        X = rng.uniform(-2, 2, size=(400, 1))
+        y = np.sin(X[:, 0])
+        Xm, ym = NystroemReducer(n_components=60, seed=0).reduce(X, y)
+        m = fit_gpr(Xm, ym, dt=1.0,
+                    inputs={"a": Feature(name="a")},
+                    output={"y": OutputFeature(name="y",
+                                               output_type="absolute",
+                                               recursive=False)})
+        pred = make_predictor(m)
+        Xq = np.linspace(-1.5, 1.5, 20)[:, None]
+        got = np.array([float(pred.apply(pred.params, x)[0]) for x in Xq])
+        np.testing.assert_allclose(got, np.sin(Xq[:, 0]), atol=0.1)
