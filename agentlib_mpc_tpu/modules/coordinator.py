@@ -737,6 +737,7 @@ class CoordinatedADMM(ADMMModule):
         if variable.value:
             self._start_optimization_at = self.env.now
             self._opt_inputs = self.collect_variables_for_optimization()
+            self._iter_in_step = 0
             self._broadcast(START_ITERATION_A2C, True)
         else:
             if self._result_obtained and self._result is not None:
@@ -767,8 +768,10 @@ class CoordinatedADMM(ADMMModule):
                 opt_inputs[entry.mean_diff] = np.asarray(
                     msg.mean_diff_trajectory[alias], dtype=float)
         opt_inputs["penalty_factor"] = float(msg.penalty_parameter)
+        opt_inputs["admm_iteration"] = getattr(self, "_iter_in_step", 0)
         self._result = self.backend.solve(
             self._start_optimization_at, opt_inputs)
+        self._iter_in_step = getattr(self, "_iter_in_step", 0) + 1
         self._result_obtained = True
         self._record_iteration(self._result, len(self._iter_rows))
 
