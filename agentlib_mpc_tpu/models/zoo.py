@@ -160,6 +160,105 @@ class ZoneWithSupply(Model):
         return eq
 
 
+class AirHandlingUnit(Model):
+    """Central air-handling unit serving four zones — the supplier half of
+    the 4-room coordinated-ADMM benchmark (reference
+    ``examples/4_Room_ADMM_Coordinator/models/rlt_model.py``): four air
+    mass flows, one shared capacity constraint ``sum(mDot_i) <= mDot_max``,
+    flow production cost. Each ``mDot_out_i`` couples to room ``i``'s
+    requested flow via consensus-ADMM.
+    """
+
+    inputs = [
+        control_input(f"mDot_{i}", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description=f"air mass flow to zone {i}")
+        for i in range(1, 5)
+    ]
+    parameters = [
+        parameter("mDot_max", 0.075, unit="m^3/s",
+                  description="total AHU capacity"),
+        parameter("r_mDot", 1.0, description="flow production cost weight"),
+    ]
+    outputs = [output(f"mDot_out_{i}", 0.0225, unit="m^3/s")
+               for i in range(1, 5)]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        total = v.mDot_1 + v.mDot_2 + v.mDot_3 + v.mDot_4
+        for i in range(1, 5):
+            eq.alg(f"mDot_out_{i}", getattr(v, f"mDot_{i}"))
+        eq.constraint(0.0, total, v.mDot_max)
+        eq.objective = SubObjective(total, weight=v.r_mDot,
+                                    name="flow_costs")
+        return eq
+
+
+class ExchangeRoom(Model):
+    """Zone for the exchange-ADMM benchmark (reference
+    ``examples/exchange_admm/models/room_model.py``): the room optimizes
+    its own air request ``mDot`` (actuated per-room) and mirrors it into
+    the exchange variable ``mDot_out = mDot``; the exchange mean-zero
+    condition across all zones + the supplier balances total consumption
+    against supply.
+    """
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s",
+                      description="air mass flow into the zone"),
+        control_input("load", 150.0, unit="W"),
+        control_input("T_in", 290.15, unit="K"),
+        control_input("T_upper", 294.15, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        parameter("s_T", 1.0),
+    ]
+    outputs = [
+        output("T_out", unit="K"),
+        output("mDot_out", 0.0225, unit="m^3/s",
+               description="net flow (positive = consumption)"),
+    ]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.alg("mDot_out", v.mDot)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = SubObjective(v.T_slack ** 2, weight=v.s_T,
+                                    name="temp_slack")
+        return eq
+
+
+class AirSupplier(Model):
+    """Supplier half of the exchange-ADMM benchmark (reference
+    ``examples/exchange_admm/models/rlt_model.py``): produces air flow at
+    cost; its *negative* net flow ``mDot_net = -mDot`` enters the exchange
+    coupling so that the exchange mean-zero condition enforces
+    supply = total zone consumption.
+    """
+
+    inputs = [
+        control_input("mDot", 0.05, lb=0.0, ub=0.2, unit="m^3/s",
+                      description="total air mass flow produced"),
+    ]
+    parameters = [parameter("r_mDot", 1.0)]
+    outputs = [output("mDot_net", -0.05, unit="m^3/s",
+                      description="net flow (negative = supply)")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.alg("mDot_net", -v.mDot)
+        eq.objective = SubObjective(v.mDot, weight=v.r_mDot,
+                                    name="flow_costs")
+        return eq
+
+
 class SwitchedRoom(Model):
     """Single zone with an on/off chiller — the mixed-integer benchmark
     (reference ``examples/one_room_mpc/mixed_integer``: a binary cooling

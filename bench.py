@@ -37,6 +37,10 @@ def build_step():
     import jax
     import jax.numpy as jnp
 
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+
     from agentlib_mpc_tpu.models.zoo import ZoneWithSupply
     from agentlib_mpc_tpu.ops.solver import (
         NLPFunctions,

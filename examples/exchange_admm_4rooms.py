@@ -1,0 +1,161 @@
+"""4-zone decentralized exchange-ADMM: rooms and a supplier balance air flow.
+
+Native re-design of the reference's exchange-ADMM benchmark
+(``examples/exchange_admm/admm_4rooms_main.py``): four zones each request
+air (``mDot_out = +mDot``) and one supplier produces it
+(``mDot_net = -mDot``); all five agents exchange on one shared alias, and
+the exchange-ADMM mean-zero condition enforces supply = total consumption
+without any coordinator (fully decentralized, peer-to-peer broadcasts).
+
+This is one of the four BASELINE.md benchmark configs. Run directly for a
+report, or call ``run_example`` (examples-as-tests, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+from agentlib_mpc_tpu.models.zoo import AirSupplier, ExchangeRoom
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+N_ROOMS = 4
+TIME_STEP = 300.0
+HORIZON = 8
+UB = 295.15
+START_TEMP = 298.16
+LOADS = (80.0, 110.0, 140.0, 170.0)
+EXCHANGE_ALIAS = "air_balance"
+
+
+def _backend(model_cls):
+    return {
+        "type": "jax_admm",
+        "model": {"class": model_cls},
+        "discretization_options": {"collocation_order": 2,
+                                   "collocation_method": "legendre"},
+        "solver": {"max_iter": 60},
+    }
+
+
+def agent_configs(max_iterations: int = 12, penalty_factor: float = 50.0):
+    rooms = []
+    sims = []
+    for i in range(1, N_ROOMS + 1):
+        rooms.append({
+            "id": f"Room_{i}",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "admm", "type": "admm_local",
+                 "optimization_backend": _backend(ExchangeRoom),
+                 "time_step": TIME_STEP,
+                 "prediction_horizon": HORIZON,
+                 "max_iterations": max_iterations,
+                 "penalty_factor": penalty_factor,
+                 "parameters": [{"name": "s_T", "value": 1.0}],
+                 "inputs": [
+                     {"name": "load", "value": LOADS[i - 1]},
+                     {"name": "T_in", "value": 290.15},
+                     {"name": "T_upper", "value": UB},
+                 ],
+                 "states": [
+                     {"name": "T", "value": START_TEMP, "ub": 303.15,
+                      "lb": 288.15, "alias": f"T_{i}",
+                      "source": f"Simulation_{i}"},
+                 ],
+                 "controls": [
+                     {"name": "mDot", "value": 0.02, "ub": 0.05,
+                      "lb": 0.0, "alias": f"mDot_{i}"},
+                 ],
+                 "exchange": [
+                     {"name": "mDot_out", "alias": EXCHANGE_ALIAS,
+                      "value": 0.02, "ub": 0.05, "lb": 0.0},
+                 ]},
+            ],
+        })
+        sims.append({
+            "id": f"Simulation_{i}",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {"module_id": "simulator", "type": "simulator",
+                 "model": {"class": ExchangeRoom,
+                           "states": [{"name": "T", "value": START_TEMP}],
+                           "inputs": [{"name": "load",
+                                       "value": LOADS[i - 1]}]},
+                 "t_sample": 60,
+                 "outputs": [{"name": "T_out", "value": START_TEMP,
+                              "alias": f"T_{i}"}],
+                 "inputs": [{"name": "mDot", "value": 0.02,
+                             "alias": f"mDot_{i}"}]},
+            ],
+        })
+
+    supplier = {
+        "id": "Supplier",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "admm", "type": "admm_local",
+             "optimization_backend": _backend(AirSupplier),
+             "time_step": TIME_STEP,
+             "prediction_horizon": HORIZON,
+             "max_iterations": max_iterations,
+             "penalty_factor": penalty_factor,
+             "parameters": [{"name": "r_mDot", "value": 1.0}],
+             "controls": [
+                 {"name": "mDot", "value": 0.08, "ub": 0.2, "lb": 0.0,
+                  "alias": "mDot_supply"},
+             ],
+             "exchange": [
+                 {"name": "mDot_net", "alias": EXCHANGE_ALIAS,
+                  "value": -0.08, "ub": 0.0, "lb": -0.2},
+             ]},
+        ],
+    }
+    return [*rooms, supplier, *sims]
+
+
+def run_example(until: float = 3600.0, testing: bool = False,
+                verbose: bool = True) -> dict:
+    mas = LocalMAS(agent_configs(), env={"rt": False})
+    mas.run(until=until)
+    results = mas.get_results()
+
+    temps = {}
+    flows = {}
+    for i in range(1, N_ROOMS + 1):
+        sim_df = results[f"Simulation_{i}"]["simulator"]
+        temps[i] = np.asarray(sim_df["T_out"], dtype=float)
+        flows[i] = np.asarray(sim_df["mDot"], dtype=float)
+    total_consumption = sum(flows.values())
+
+    supplier_mod = mas.agents["Supplier"].get_module("admm")
+    supply = float(supplier_mod.vars["mDot"].value)
+
+    if verbose:
+        for i in range(1, N_ROOMS + 1):
+            print(f"room {i}: {temps[i][0]:.2f} K -> {temps[i][-1]:.2f} K "
+                  f"(load {LOADS[i - 1]:.0f} W, "
+                  f"mean flow {np.mean(flows[i]):.4f})")
+        print(f"final supplier flow {supply:.4f}, "
+              f"final total consumption {total_consumption[-1]:.4f}")
+
+    if testing:
+        mean_start = np.mean([temps[i][0] for i in range(1, N_ROOMS + 1)])
+        mean_end = np.mean([temps[i][-1] for i in range(1, N_ROOMS + 1)])
+        assert mean_end < mean_start, "building must cool on average"
+        # exchange balance: supplier production tracks total consumption
+        assert abs(supply - total_consumption[-1]) < 0.02, (
+            f"supply {supply:.4f} vs consumption "
+            f"{total_consumption[-1]:.4f}")
+        # higher-load rooms draw more air
+        assert np.mean(flows[N_ROOMS]) > np.mean(flows[1])
+    return results
+
+
+if __name__ == "__main__":
+    run_example(until=3600.0, testing=True)

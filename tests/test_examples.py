@@ -19,6 +19,22 @@ def test_admm_cooled_room_example():
     assert "CooledRoom" in results and "Cooler" in results
 
 
+@pytest.mark.slow
+def test_admm_4rooms_coordinator_example():
+    from examples.admm_4rooms_coordinator import run_example
+
+    results = run_example(until=1800, testing=True, verbose=False)
+    assert "Coordinator" in results and "AHU" in results
+
+
+@pytest.mark.slow
+def test_exchange_admm_4rooms_example():
+    from examples.exchange_admm_4rooms import run_example
+
+    results = run_example(until=1800, testing=True, verbose=False)
+    assert "Supplier" in results
+
+
 def test_minlp_switched_room_example():
     from examples.minlp_switched_room import run_example
 
