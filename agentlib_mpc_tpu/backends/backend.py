@@ -102,6 +102,20 @@ def load_model(model_cfg: dict | Model, dt: float | None = None) -> Model:
     return cls(overrides=overrides or None, dt=dt)
 
 
+def load_model_for_backend(model_cfg: dict | Model,
+                           dt: float | None = None) -> Model:
+    """Backend-aware model loading for the owning *module*: ML model
+    configs carry ``ml_model_sources`` that plain :func:`load_model` would
+    silently drop (the surrogates would never register and the NARX
+    transcription would see no learned states). Dispatches to the ML
+    loader when the config asks for it."""
+    if isinstance(model_cfg, dict) and model_cfg.get("ml_model_sources"):
+        from agentlib_mpc_tpu.backends.ml_backend import load_ml_model
+
+        return load_ml_model(model_cfg, dt=dt)
+    return load_model(model_cfg, dt=dt)
+
+
 class OptimizationBackend:
     """Abstract backend. Subclasses implement setup_optimization/solve."""
 
@@ -126,6 +140,24 @@ class OptimizationBackend:
         Returns a result dict with at least 'u0' (first controls, by name),
         'traj' (full trajectories), 'stats'."""
         raise NotImplementedError
+
+    def trajectory_layout(self) -> dict[str, list[str]]:
+        """Column names of the trajectories this backend's ``solve`` returns
+        in ``result["traj"]`` — the contract the module's results writer
+        iterates (reference result-format bookkeeping,
+        ``discretization.py:398-484``). Keys: "x" (node states), "u"
+        (optimized inputs incl. merged couplings), "y" (outputs), "z"
+        (algebraic/slack states)."""
+        model = self.model
+        ocp = getattr(self, "ocp", None)
+        u = list(ocp.control_names) if ocp is not None \
+            else list(self.var_ref.controls)
+        return {
+            "x": list(model.diff_state_names),
+            "u": u,
+            "y": list(model.output_names),
+            "z": list(model.free_state_names),
+        }
 
     def get_lags_per_variable(self) -> dict[str, int]:
         """name → number of past samples the backend needs (NARX models;

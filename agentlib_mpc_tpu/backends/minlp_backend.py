@@ -126,6 +126,14 @@ class MINLPBackend(JAXBackend):
 
         self._step_fixed = step_fixed
 
+    def trajectory_layout(self) -> dict[str, list[str]]:
+        """The returned ``traj`` comes from the *fixed* phase-3 program, so
+        its "u" columns are the continuous controls only (binaries ride in
+        ``binary_schedule``)."""
+        layout = super().trajectory_layout()
+        layout["u"] = list(self.ocp_fixed.control_names)
+        return layout
+
     # -- binary scheduling (host side, between the two device solves) ---------
 
     def _binary_schedule(self, b_rel: np.ndarray) -> tuple[np.ndarray, float]:

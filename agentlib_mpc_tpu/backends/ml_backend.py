@@ -89,6 +89,16 @@ class MLBackend(OptimizationBackend):
     def get_lags_per_variable(self) -> dict[str, int]:
         return self.model.get_lags_per_variable()
 
+    def trajectory_layout(self) -> dict[str, list[str]]:
+        """NARX layout: learned (narx) states live in "x" alongside
+        white-box ODE states; "z" holds only the remaining slack states."""
+        return {
+            "x": list(self.ocp.dyn_names),
+            "u": list(self.ocp.control_names),
+            "y": list(self.model.output_names),
+            "z": list(self.ocp.slack_names),
+        }
+
     def update_ml_models(self, *serialized) -> None:
         """Hot-swap retrained surrogates. Same lag structure → parameters
         swap into the compiled pipeline; changed lags/columns → the NARX

@@ -177,7 +177,7 @@ class ADMMModule(BaseMPC):
         super()._declare(var, group)
 
     def _setup_backend(self) -> None:
-        from agentlib_mpc_tpu.backends.backend import load_model
+        from agentlib_mpc_tpu.backends.backend import load_model_for_backend
 
         self.couplings = [CouplingEntry(n)
                           for n in self._groups.get("couplings", [])]
@@ -196,7 +196,8 @@ class ADMMModule(BaseMPC):
             couplings=[c.name for c in self.couplings],
             exchange=[e.name for e in self.exchange],
         )
-        model = load_model(self.backend.config["model"])
+        model = load_model_for_backend(self.backend.config["model"],
+                                       dt=self.time_step)
         self.backend.config["model"] = model
         self.backend.setup_optimization(
             self.var_ref, self.time_step, self.prediction_horizon)

@@ -18,7 +18,10 @@ from typing import Dict
 
 import numpy as np
 
-from agentlib_mpc_tpu.backends.backend import create_backend, load_model
+from agentlib_mpc_tpu.backends.backend import (
+    create_backend,
+    load_model_for_backend,
+)
 from agentlib_mpc_tpu.backends.mhe_backend import (
     MEASURED_PREFIX,
     MHEVariableReference,
@@ -68,7 +71,8 @@ class MHE(SkippableMixin, BaseModule):
             known_parameters=self._groups.get("known_parameters", []),
             outputs=self._groups.get("outputs", []),
         )
-        model = load_model(self.backend.config["model"])
+        model = load_model_for_backend(self.backend.config["model"],
+                                       dt=self.time_step)
         self.backend.config["model"] = model
         self.backend.setup_optimization(
             self.var_ref, self.time_step, self.horizon)
@@ -89,8 +93,12 @@ class MHE(SkippableMixin, BaseModule):
     def _make_hist_callback(self, name: str):
         def _cb(incoming):
             # never record our own published estimates as measurements
-            # (self.set() broadcasts loop back through the local broker)
-            if incoming.source.agent_id == self.agent.id:
+            # (self.set() broadcasts loop back through the local broker) —
+            # but sibling modules in the same agent are legitimate sources:
+            # the reference runs MHE and MPC side by side in one agent and
+            # the MHE must see the MPC's actuation (mhe_example.py)
+            if (incoming.source.agent_id == self.agent.id
+                    and incoming.source.module_id == self.id):
                 return
             local = self.vars[name]
             local.value = incoming.value

@@ -58,10 +58,12 @@ class BaseMPC(SkippableMixin, BaseModule):
             binary_controls=self._groups.get("binary_controls", []),
         )
         # load the model once, validate, and hand the instance to the
-        # backend (load_model passes instances through)
-        from agentlib_mpc_tpu.backends.backend import load_model
+        # backend (the loaders pass instances through); ML configs need the
+        # ML-aware loader so ml_model_sources register before the stomp
+        from agentlib_mpc_tpu.backends.backend import load_model_for_backend
 
-        model = load_model(self.backend.config["model"])
+        model = load_model_for_backend(self.backend.config["model"],
+                                       dt=self.time_step)
         self._assert_config_matches_model(model)
         self.backend.config["model"] = model
         self.backend.setup_optimization(
@@ -137,22 +139,20 @@ class BaseMPC(SkippableMixin, BaseModule):
 
         if not self._history_rows:
             return None
-        model = self.backend.model
+        layout = self.backend.trajectory_layout()
         frames = []
         for row in self._history_rows:
             traj = row["traj"]
             grid = np.asarray(traj["time_state"]) - row["time"]
+            n_nodes = len(grid)
             data = {}
-            for i, n in enumerate(model.diff_state_names):
-                data[("variable", n)] = np.asarray(traj["x"])[:, i]
-            for i, n in enumerate(self.var_ref.controls):
-                u = np.asarray(traj["u"])[:, i]
-                data[("variable", n)] = np.append(u, np.nan)
-            for i, n in enumerate(model.output_names):
-                data[("variable", n)] = np.asarray(traj["y"])[:, i]
-            for i, n in enumerate(model.free_state_names):
-                z = np.asarray(traj["z"])[:, i]
-                data[("variable", n)] = np.append(z, np.nan)
+            for key in ("x", "u", "y", "z"):
+                for i, n in enumerate(layout[key]):
+                    col = np.asarray(traj[key])[:, i]
+                    if col.shape[0] < n_nodes:  # control-grid quantities
+                        col = np.append(col, [np.nan] * (n_nodes -
+                                                         col.shape[0]))
+                    data[("variable", n)] = col
             df = pd.DataFrame(data)
             df.index = pd.MultiIndex.from_product(
                 [[row["time"]], grid], names=["time", "grid"])

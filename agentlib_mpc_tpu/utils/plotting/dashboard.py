@@ -1,0 +1,341 @@
+"""Interactive dashboard internals: pure data layer + dash/plotly layer.
+
+Capability port of the reference's interactive tooling
+(``utils/plotting/mpc_dashboard.py`` — agent/module browsing, per-variable
+prediction plots with fade, solver-stats and objective panels;
+``utils/plotting/admm_dashboard.py`` — time-step/iteration sliders over
+coupling variables plus Boyd-residual views; ``interactive.py:300``).
+
+Design: everything the dashboard *computes* lives in pure functions over
+the results dict / stats DataFrames so it is unit-testable without dash
+installed (this environment has no dash); the dash/plotly app is a thin
+layer over those functions, imported lazily and exercised by a stub-based
+smoke test.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# pure data layer
+# ---------------------------------------------------------------------------
+
+def discover_frames(results: dict) -> dict:
+    """(agent_id, module_id) → DataFrame for every MultiIndex results frame
+    in a ``mas.get_results()`` dict. 2-level = MPC/MHE, 3-level = ADMM."""
+    frames = {}
+    for agent_id, modules in (results or {}).items():
+        if not isinstance(modules, dict):
+            continue
+        for module_id, df in modules.items():
+            nlevels = getattr(getattr(df, "index", None), "nlevels", 1)
+            if df is not None and nlevels in (2, 3):
+                frames[(agent_id, module_id)] = df
+    return frames
+
+
+def frame_kind(df) -> str:
+    """"mpc" for (time, grid) frames, "admm" for (time, iter, grid)."""
+    return "admm" if df.index.nlevels == 3 else "mpc"
+
+
+def variables_of(df) -> list:
+    """Plottable variable names (('variable', name) columns, else flat)."""
+    names = []
+    for c in df.columns:
+        if isinstance(c, tuple):
+            if c[0] == "variable":
+                names.append(c[1])
+        else:
+            names.append(c)
+    return sorted(dict.fromkeys(names))
+
+
+def time_steps_of(df) -> np.ndarray:
+    """Sorted unique solve times (level 0 of the MultiIndex)."""
+    return np.asarray(sorted(df.index.get_level_values(0).unique()))
+
+
+def iterations_at(df, time) -> np.ndarray:
+    """ADMM frames: sorted iteration numbers stored for one solve time."""
+    sub = df.xs(time, level=0)
+    return np.asarray(sorted(sub.index.get_level_values(0).unique()))
+
+
+def _col(df, variable):
+    return ("variable", variable) if ("variable", variable) in df.columns \
+        else variable
+
+
+def prediction_traces(df, variable: str, max_steps: Optional[int] = None):
+    """[(t_solve, abs_times, values)] — one predicted trajectory per solve
+    (the reference's fade plot, ``plot_mpc_plotly``). For ADMM frames the
+    last stored iteration per step is used."""
+    col = _col(df, variable)
+    if col not in df.columns:
+        return []
+    times = time_steps_of(df)
+    if max_steps is not None and len(times) > max_steps:
+        idx = np.linspace(0, len(times) - 1, max_steps).astype(int)
+        times = times[np.unique(idx)]
+    out = []
+    for t in times:
+        sub = df.xs(t, level=0)
+        if sub.index.nlevels == 2:  # admm: (iter, grid) → last iteration
+            last_iter = sub.index.get_level_values(0).max()
+            sub = sub.xs(last_iter, level=0)
+        series = sub[col].dropna()
+        grid = np.asarray(series.index, dtype=float)
+        out.append((float(t), float(t) + grid,
+                    np.asarray(series, dtype=float)))
+    return out
+
+
+def actual_series(df, variable: str):
+    """(times, values): the realized closed-loop series — first value of
+    each prediction (reference ``first_vals_at_trajectory_index``)."""
+    traces = prediction_traces(df, variable)
+    ts, vs = [], []
+    for t, _, vals in traces:
+        if len(vals):
+            ts.append(t)
+            vs.append(vals[0])
+    return np.asarray(ts), np.asarray(vs)
+
+
+def admm_iteration_traces(df, variable: str, time) -> list:
+    """[(iteration, grid, values)] for one solve time — the iteration
+    browser of the reference ADMM dashboard (``create_coupling_var_plot``)."""
+    col = _col(df, variable)
+    if col not in df.columns:
+        return []
+    sub = df.xs(time, level=0)
+    out = []
+    for it in sorted(sub.index.get_level_values(0).unique()):
+        series = sub.xs(it, level=0)[col].dropna()
+        out.append((int(it), np.asarray(series.index, dtype=float),
+                    np.asarray(series, dtype=float)))
+    return out
+
+
+def residual_table(stats):
+    """Tidy per-(time, iteration) residual frame from coordinator stats
+    (columns: primal_residual, dual_residual, rho when present)."""
+    if stats is None or len(stats) == 0:
+        return None
+    cols = [c for c in ("primal_residual", "dual_residual", "rho")
+            if c in stats.columns]
+    if not cols or stats.index.nlevels != 2:
+        return None
+    return stats[cols]
+
+
+def solver_table(stats):
+    """Per-solve stats columns for the solver panel (iterations, success,
+    solve_wall_time, kkt_error, objective where available)."""
+    if stats is None or len(stats) == 0:
+        return None
+    cols = [c for c in ("iterations", "success", "solve_wall_time",
+                        "kkt_error", "objective") if c in stats.columns]
+    return stats[cols] if cols else None
+
+
+# ---------------------------------------------------------------------------
+# plotly figure builders (lazy imports; pure functions of the data layer)
+# ---------------------------------------------------------------------------
+
+def prediction_figure(df, variable: str, max_steps: int = 40):
+    """Prediction-fade figure: one fading line per solve + the realized
+    series on top (reference ``plot_mpc_plotly``)."""
+    import plotly.graph_objects as go
+
+    traces = prediction_traces(df, variable, max_steps=max_steps)
+    fig = go.Figure()
+    n = max(len(traces), 1)
+    for i, (t, abs_t, vals) in enumerate(traces):
+        alpha = 0.15 + 0.55 * (i + 1) / n
+        fig.add_trace(go.Scatter(
+            x=abs_t, y=vals, mode="lines",
+            line={"color": f"rgba(0, 84, 159, {alpha:.3f})", "width": 1},
+            name=f"t={t:g}", showlegend=False,
+            hovertemplate=f"pred@t={t:g}<br>%{{x}}: %{{y:.4g}}"))
+    ts, vs = actual_series(df, variable)
+    if len(ts):
+        fig.add_trace(go.Scatter(
+            x=ts, y=vs, mode="lines+markers",
+            line={"color": "rgb(204, 7, 30)", "width": 2},
+            name="closed loop"))
+    fig.update_layout(title=variable, margin=dict(l=40, r=10, t=40, b=30),
+                      height=320)
+    return fig
+
+
+def admm_iteration_figure(df, variable: str, time, iteration=None):
+    """Coupling-variable trajectories across ADMM iterations at one step;
+    iterations up to ``iteration`` fade in (reference
+    ``create_coupling_var_plot``)."""
+    import plotly.graph_objects as go
+
+    traces = admm_iteration_traces(df, variable, time)
+    if iteration is not None:
+        traces = [tr for tr in traces if tr[0] <= iteration]
+    fig = go.Figure()
+    n = max(len(traces), 1)
+    for i, (it, grid, vals) in enumerate(traces):
+        alpha = 0.2 + 0.6 * (i + 1) / n
+        fig.add_trace(go.Scatter(
+            x=grid, y=vals, mode="lines",
+            line={"color": f"rgba(0, 84, 159, {alpha:.3f})", "width": 1.5},
+            name=f"iter {it}"))
+    fig.update_layout(title=f"{variable} @ t={time:g}",
+                      xaxis_title="horizon [s]",
+                      margin=dict(l=40, r=10, t=40, b=30), height=320)
+    return fig
+
+
+def residual_figure(stats, time=None):
+    """Primal/dual residual (log scale) per iteration — one solve time or
+    all (reference ``create_residuals_plot``)."""
+    import plotly.graph_objects as go
+
+    table = residual_table(stats)
+    fig = go.Figure()
+    if table is None:
+        return fig
+    if time is not None:
+        try:
+            sub = table.xs(time, level=0)
+        except KeyError:
+            return fig
+        x = np.asarray(sub.index, dtype=float)
+        for col in ("primal_residual", "dual_residual"):
+            if col in sub.columns:
+                fig.add_trace(go.Scatter(
+                    x=x, y=np.asarray(sub[col], dtype=float),
+                    mode="lines+markers", name=col))
+        fig.update_layout(title=f"residuals @ t={time:g}",
+                          xaxis_title="iteration")
+    else:
+        x = np.arange(len(table))
+        for col in ("primal_residual", "dual_residual"):
+            if col in table.columns:
+                fig.add_trace(go.Scatter(
+                    x=x, y=np.asarray(table[col], dtype=float),
+                    mode="lines", name=col))
+        fig.update_layout(title="residuals (all iterations)",
+                          xaxis_title="cumulative iteration")
+    fig.update_yaxes(type="log")
+    fig.update_layout(margin=dict(l=40, r=10, t=40, b=30), height=320)
+    return fig
+
+
+def solver_figure(stats):
+    """Solver panel: iterations + wall time per solve (reference
+    ``solver_return``/``solver plot``)."""
+    import plotly.graph_objects as go
+
+    table = solver_table(stats)
+    fig = go.Figure()
+    if table is None:
+        return fig
+    x = np.asarray(table.index.get_level_values(0) if
+                   table.index.nlevels > 1 else table.index, dtype=float)
+    if "iterations" in table.columns:
+        fig.add_trace(go.Scatter(
+            x=x, y=np.asarray(table["iterations"], dtype=float),
+            mode="lines+markers", name="iterations", yaxis="y1"))
+    if "solve_wall_time" in table.columns:
+        fig.add_trace(go.Scatter(
+            x=x, y=1e3 * np.asarray(table["solve_wall_time"], dtype=float),
+            mode="lines+markers", name="wall [ms]", yaxis="y2"))
+    fig.update_layout(
+        title="solver", xaxis_title="time [s]",
+        yaxis=dict(title="iterations"),
+        yaxis2=dict(title="wall [ms]", overlaying="y", side="right"),
+        margin=dict(l=40, r=40, t=40, b=30), height=320)
+    return fig
+
+
+# ---------------------------------------------------------------------------
+# dash app layer
+# ---------------------------------------------------------------------------
+
+def build_app(results: dict, stats=None):
+    """Construct (but do not run) the dash app: agent/module dropdowns,
+    variable checklist, per-step slider for ADMM frames, residual/solver
+    panels. Requires dash + plotly."""
+    import dash
+    from dash import dcc, html
+    from dash.dependencies import Input, Output
+
+    frames = discover_frames(results)
+    if not frames:
+        raise ValueError("no MPC/ADMM-shaped results to show")
+    keys = [f"{a}/{m}" for a, m in frames]
+    by_key = {f"{a}/{m}": df for (a, m), df in frames.items()}
+
+    app = dash.Dash("agentlib_mpc_tpu")
+    app.layout = html.Div([
+        html.H2("agentlib-mpc-tpu results"),
+        html.Div([
+            html.Label("module"),
+            dcc.Dropdown(id="module", options=[{"label": k, "value": k}
+                                               for k in keys],
+                         value=keys[0], clearable=False),
+        ]),
+        html.Div(id="step-controls"),
+        html.Div(id="graphs"),
+        dcc.Store(id="placeholder"),
+    ])
+
+    @app.callback(Output("step-controls", "children"),
+                  Input("module", "value"))
+    def _step_controls(key):
+        df = by_key[key]
+        if frame_kind(df) != "admm":
+            return html.Div()
+        times = time_steps_of(df)
+        return html.Div([
+            html.Label("solve time"),
+            dcc.Slider(id="step-slider", min=0, max=len(times) - 1, step=1,
+                       value=len(times) - 1,
+                       marks={i: f"{t:g}" for i, t in
+                              enumerate(times) if i % max(1, len(times) // 10)
+                              == 0}),
+        ])
+
+    @app.callback(Output("graphs", "children"), Input("module", "value"))
+    def _graphs(key):
+        df = by_key[key]
+        children = []
+        if frame_kind(df) == "admm":
+            times = time_steps_of(df)
+            t_last = times[-1]
+            for var in variables_of(df):
+                children.append(dcc.Graph(
+                    figure=admm_iteration_figure(df, var, t_last)))
+            if stats is not None:
+                children.append(dcc.Graph(
+                    figure=residual_figure(stats, t_last)))
+        else:
+            for var in variables_of(df):
+                children.append(dcc.Graph(
+                    figure=prediction_figure(df, var)))
+            if stats is not None:
+                children.append(dcc.Graph(figure=solver_figure(stats)))
+        return html.Div(children)
+
+    return app
+
+
+def run_dashboard(results: dict, stats=None, port: int = 8050,
+                  debug: bool = False):  # pragma: no cover - needs dash
+    """Build and serve the dash app (blocks)."""
+    app = build_app(results, stats)
+    run = getattr(app, "run", None) or getattr(app, "run_server")
+    run(port=port, debug=debug)
+    return app

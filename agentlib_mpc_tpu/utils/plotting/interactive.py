@@ -8,16 +8,38 @@ from __future__ import annotations
 from typing import Optional
 
 
-def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None):
-    """MPC results overview. With dash+plotly installed, serves the
-    interactive dashboard; otherwise renders a static multi-panel
-    matplotlib figure (returned; saved when ``save_path`` given)."""
+def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None,
+                   port: int = 8050, block: bool = True):
+    """MPC/ADMM results overview. With dash+plotly installed, serves the
+    interactive dashboard (agent/module browsing, prediction fades, ADMM
+    iteration browser, residual/solver panels — the reference's
+    ``mpc_dashboard``/``admm_dashboard`` capability); otherwise renders a
+    static multi-panel matplotlib figure (returned; saved when
+    ``save_path`` given). Never raises just because dash is present —
+    any dashboard failure falls back to the static figure."""
     try:
         import dash  # noqa: F401
         import plotly  # noqa: F401
     except ImportError:
         return _static_dashboard(results, stats, save_path)
-    return _dash_dashboard(results, stats)
+    try:
+        from agentlib_mpc_tpu.utils.plotting.dashboard import (
+            build_app,
+            run_dashboard,
+        )
+
+        if not block:
+            return build_app(results, stats)
+        return run_dashboard(results, stats, port=port)
+    except ValueError:
+        raise  # empty/unshaped results: same error contract as static
+    except Exception as exc:  # pragma: no cover - dash runtime issues
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "interactive dashboard failed (%s); falling back to static",
+            exc)
+        return _static_dashboard(results, stats, save_path)
 
 
 def _static_dashboard(results, stats, save_path):
@@ -49,8 +71,3 @@ def _static_dashboard(results, stats, save_path):
     return fig
 
 
-def _dash_dashboard(results, stats):  # pragma: no cover - optional dep
-    raise NotImplementedError(
-        "dash detected but the interactive server is not implemented on "
-        "this stack yet; use the static dashboard (uninstall dash) or the "
-        "plotting API directly")

@@ -1,0 +1,180 @@
+"""MHE + MPC: estimate an unknown heat load online, control with it.
+
+Native re-design of the reference's estimator example
+(``examples/Estimators/mhe_example.py``): one controller agent runs a
+moving-horizon estimator and an MPC side by side — the MHE reconstructs an
+unmeasured model parameter (here the zone heat load; the reference
+estimates a thermal-capacity factor) from temperature measurements, and
+the MPC consumes the live estimate so its predictions match the true
+plant. A separate agent simulates the plant with the *true* load.
+
+Run directly for a report, or call ``run_example`` (examples-as-tests,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu.models.objective import SubObjective
+from agentlib_mpc_tpu.models.variables import (
+    Var,
+    control_input,
+    output,
+    parameter,
+    state,
+)
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+DT = 120.0
+UB = 295.15
+START_TEMP = 298.16
+TRUE_LOAD = 260.0   # the plant's real heat load [W]
+GUESS_LOAD = 100.0  # what the controller initially believes
+
+
+class RoomLoadParam(Model):
+    """One-room cooling model with the heat load as a *parameter* so the
+    MHE can estimate it (it becomes a zero-dynamics state in the MHE OCP,
+    reference ``casadi_/mhe.py:34-123``)."""
+
+    inputs = [
+        control_input("mDot", 0.0225, lb=0.0, ub=0.05, unit="m^3/s"),
+        control_input("T_in", 290.15, unit="K"),
+        control_input("T_upper", UB, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=303.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("cp", 1000.0),
+        parameter("C", 100000.0),
+        Var(name="load", value=150.0, lb=0.0, ub=500.0, unit="W",
+            role="parameter"),
+        parameter("s_T", 1.0),
+        parameter("r_mDot", 0.1),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", v.cp * v.mDot / v.C * (v.T_in - v.T) + v.load / v.C)
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.mDot, weight=v.r_mDot, name="control_costs")
+            + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
+        )
+        return eq
+
+
+def agent_configs(horizon: int = 10):
+    controller = {
+        "id": "Controller",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "mhe", "type": "mhe",
+             "optimization_backend": {
+                 "type": "jax_mhe",
+                 "model": {"class": RoomLoadParam},
+                 "discretization_options": {"collocation_order": 2},
+                 "solver": {"max_iter": 50},
+             },
+             "time_step": DT,
+             "horizon": horizon,
+             "state_weights": {"T": 1.0},
+             "states": [
+                 {"name": "T", "value": START_TEMP, "alias": "T",
+                  "source": "Plant"},
+             ],
+             "known_inputs": [
+                 {"name": "mDot", "value": 0.02, "alias": "mDot"},
+                 {"name": "T_in", "value": 290.15},
+                 {"name": "T_upper", "value": UB},
+             ],
+             "estimated_parameters": [
+                 {"name": "load", "value": GUESS_LOAD, "lb": 0.0,
+                  "ub": 500.0, "alias": "load_estimate"},
+             ]},
+            {"module_id": "mpc", "type": "mpc",
+             "optimization_backend": {
+                 "type": "jax",
+                 "model": {"class": RoomLoadParam},
+                 "discretization_options": {"collocation_order": 2},
+                 "solver": {"max_iter": 50},
+             },
+             "time_step": DT,
+             "prediction_horizon": horizon,
+             "parameters": [
+                 {"name": "load", "value": GUESS_LOAD,
+                  "alias": "load_estimate", "source": "Controller"},
+                 {"name": "s_T", "value": 1.0},
+                 {"name": "r_mDot", "value": 0.1},
+             ],
+             "inputs": [
+                 {"name": "T_in", "value": 290.15},
+                 {"name": "T_upper", "value": UB},
+             ],
+             "controls": [
+                 {"name": "mDot", "value": 0.02, "ub": 0.05, "lb": 0.0,
+                  "alias": "mDot"},
+             ],
+             "states": [
+                 {"name": "T", "value": START_TEMP, "ub": 303.15,
+                  "lb": 288.15, "alias": "T", "source": "Plant"},
+             ]},
+        ],
+    }
+    plant = {
+        "id": "Plant",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "room", "type": "simulator",
+             "model": {"class": RoomLoadParam,
+                       "states": [{"name": "T", "value": START_TEMP}],
+                       "parameters": [{"name": "load",
+                                       "value": TRUE_LOAD}]},
+             "t_sample": 60,
+             "outputs": [{"name": "T_out", "value": START_TEMP,
+                          "alias": "T"}],
+             "inputs": [{"name": "mDot", "value": 0.02, "alias": "mDot"}]},
+        ],
+    }
+    return [controller, plant]
+
+
+def run_example(until: float = 3600.0, testing: bool = False,
+                verbose: bool = True) -> dict:
+    mas = LocalMAS(agent_configs(), env={"rt": False})
+    mas.run(until=until)
+    results = mas.get_results()
+
+    mhe = mas.agents["Controller"].get_module("mhe")
+    est_load = float(mhe.get_value("load"))
+    sim_df = results["Plant"]["room"]
+    temps = np.asarray(sim_df["T_out"], dtype=float)
+
+    if verbose:
+        print(f"estimated load: {est_load:.1f} W (true {TRUE_LOAD:.1f}, "
+              f"initial guess {GUESS_LOAD:.1f})")
+        print(f"room temperature: {temps[0]:.2f} K -> {temps[-1]:.2f} K "
+              f"(band {UB} K)")
+
+    if testing:
+        assert abs(est_load - TRUE_LOAD) < 40.0, (
+            f"MHE estimate {est_load:.1f} W far from true load "
+            f"{TRUE_LOAD:.1f} W")
+        assert temps[-1] < START_TEMP - 1.0, "room must cool toward band"
+    return results
+
+
+if __name__ == "__main__":
+    run_example(testing=True)

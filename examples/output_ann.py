@@ -1,0 +1,78 @@
+"""Output-ANN training: learn *absolute, non-recursive* output maps.
+
+Native re-design of the reference's output-ANN example
+(``examples/output_ann/generate_training_data.py``): an ANN with multiple
+non-recursive ("output") targets — static maps rather than NARX dynamics —
+is trained from generated data, serialized to the exchange format,
+round-tripped, and verified against the ground-truth functions. This is
+the trainer-side counterpart of the ``ml_output_names`` path in the hybrid
+model (algebraic ML outputs, reference ``casadi_ml_model.py:401-416``).
+
+Run directly for a report, or call ``run_example`` (examples-as-tests,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from agentlib_mpc_tpu.ml import Feature, OutputFeature
+from agentlib_mpc_tpu.ml.predictors import make_predictor
+from agentlib_mpc_tpu.ml.serialized import load_serialized_model
+from agentlib_mpc_tpu.ml.training import ANNTrainerCore, fit_ann
+
+
+def generate_training_data(n: int = 4000, seed: int = 0):
+    """Two static maps of one input (the reference's y = 2x, y2 = x + 10)."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-50.0, 50.0, size=(n, 1))
+    y = np.column_stack([2.0 * x[:, 0], x[:, 0] + 10.0])
+    return x, y
+
+
+def run_example(testing: bool = False, verbose: bool = True,
+                epochs: int = 400) -> dict:
+    X, Y = generate_training_data()
+    model = fit_ann(
+        X, Y, dt=1.0,
+        inputs={"x": Feature(name="x")},
+        output={
+            "y": OutputFeature(name="y", output_type="absolute",
+                               recursive=False),
+            "y2": OutputFeature(name="y2", output_type="absolute",
+                                recursive=False),
+        },
+        trainer=ANNTrainerCore(hidden=(32, 32), epochs=epochs,
+                               learning_rate=3e-3, seed=0))
+
+    # serialize → JSON → deserialize round trip (the exchange format the
+    # trainer broadcasts and the controller hot-swaps, SURVEY.md §3.5)
+    payload = model.to_json()
+    restored = load_serialized_model(payload)
+    pred = make_predictor(restored)
+
+    xq = np.linspace(-40.0, 40.0, 41)
+    got = np.stack([np.asarray(pred.apply(pred.params, np.array([v])))
+                    for v in xq])
+    want = np.column_stack([2.0 * xq, xq + 10.0])
+    rmse = np.sqrt(np.mean((got - want) ** 2, axis=0))
+
+    if verbose:
+        print(f"output-ANN fit: rmse(y)={rmse[0]:.3f}, "
+              f"rmse(y2)={rmse[1]:.3f} over x in [-40, 40]")
+
+    if testing:
+        assert rmse[0] < 1.5 and rmse[1] < 1.5, (
+            f"learned static maps too inaccurate: {rmse}")
+        assert restored.output["y"].recursive is False
+        assert restored.output["y2"].output_type == "absolute"
+    return {"model": restored, "rmse": rmse}
+
+
+if __name__ == "__main__":
+    run_example(testing=True)

@@ -35,6 +35,29 @@ def test_exchange_admm_4rooms_example():
     assert "Supplier" in results
 
 
+@pytest.mark.slow
+def test_three_zone_datadriven_admm_example():
+    from examples.three_zone_datadriven_admm import run_example
+
+    results = run_example(until=1800, testing=True, verbose=False,
+                          epochs=200)
+    assert "AHU" in results and "Zone_1" in results
+
+
+def test_output_ann_example():
+    from examples.output_ann import run_example
+
+    out = run_example(testing=True, verbose=False, epochs=300)
+    assert out["rmse"].shape == (2,)
+
+
+def test_mhe_one_room_example():
+    from examples.mhe_one_room import run_example
+
+    results = run_example(until=3600, testing=True, verbose=False)
+    assert "Plant" in results
+
+
 def test_minlp_switched_room_example():
     from examples.minlp_switched_room import run_example
 
