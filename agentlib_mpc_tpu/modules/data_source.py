@@ -71,11 +71,21 @@ class DataSource(BaseModule):
         import pandas as pd
 
         if isinstance(data, (str, Path)):
-            df = pd.read_csv(data, index_col=0)
-            try:
-                df.index = pd.to_datetime(df.index)
-            except (ValueError, TypeError):
-                pass
+            from agentlib_mpc_tpu.utils.try_format import (
+                is_try_file,
+                read_try_file,
+            )
+
+            if is_try_file(data):
+                # German TRY weather dataset (the reference's TRYPredictor
+                # input format, ``modules/InputPrediction/try_predictor.py``)
+                df = read_try_file(data)
+            else:
+                df = pd.read_csv(data, index_col=0)
+                try:
+                    df.index = pd.to_datetime(df.index)
+                except (ValueError, TypeError):
+                    pass
         elif isinstance(data, pd.DataFrame):
             df = data
         elif isinstance(data, dict):

@@ -38,34 +38,153 @@ def implicit_midpoint_step(f: ODE, x, t, h, newton_iters: int = 5):
     hands to CVODES. The Newton loop is a lax.fori_loop with a dense linear
     solve on the (small) state dimension.
     """
-    n = x.shape[0]
-    eye = jnp.eye(n, dtype=x.dtype)
 
     def residual(x_next):
         xm = 0.5 * (x + x_next)
         return x_next - x - h * f(xm, t + 0.5 * h)
 
+    return _newton_solve(residual, x + h * f(x, t), newton_iters, reg=1e-10)
+
+
+def _newton_solve(residual, x_guess, iters: int = 6, reg: float = 1e-12):
+    """Fixed-iteration Newton on a small dense system (shape-static)."""
+    n = x_guess.shape[0]
+    eye = jnp.eye(n, dtype=x_guess.dtype)
     jac = jax.jacfwd(residual)
 
-    def body(_, x_next):
-        r = residual(x_next)
-        J = jac(x_next)
-        dx = jnp.linalg.solve(J + 1e-10 * eye, -r)
-        return x_next + dx
+    def body(_, xk):
+        r = residual(xk)
+        J = jac(xk)
+        dx = jnp.linalg.solve(J + reg * eye, -r)
+        return xk + dx
 
-    x0 = x + h * f(x, t)  # explicit predictor
-    return jax.lax.fori_loop(0, newton_iters, body, x0)
+    return jax.lax.fori_loop(0, iters, body, x_guess)
+
+
+# TR-BDF2 constants (Bank et al.; error pair per Hosea & Shampine 1996).
+_TRBDF2_GAMMA = 2.0 - 2.0 ** 0.5          # γ = 2 - √2
+_TRBDF2_W = 2.0 ** 0.5 / 4.0              # w = √2 / 4
+_TRBDF2_D = _TRBDF2_GAMMA / 2.0           # diagonal DIRK coefficient γ/2
+#: 2nd-order weights b and embedded 3rd-order weights b̂ of the DIRK tableau
+_TRBDF2_B = (_TRBDF2_W, _TRBDF2_W, _TRBDF2_D)
+_TRBDF2_BHAT = ((1.0 - _TRBDF2_W) / 3.0, (3.0 * _TRBDF2_W + 1.0) / 3.0,
+                _TRBDF2_D / 3.0)
+
+
+def trbdf2_step(f: ODE, x, t, h, newton_iters: int = 6):
+    """One TR-BDF2 step; returns (x_next, embedded error estimate).
+
+    TR-BDF2 is the one-step L-stable composite of a trapezoidal half-stage
+    to t+γh and a BDF2 closure to t+h — the workhorse implicit method for
+    stiff plant simulation (the role CVODES plays for the reference,
+    ``agentlib_mpc/models/casadi_model.py:402-447``). The embedded
+    3rd-order companion weights give a per-step local error estimate,
+    stiffly filtered through (I - γ/2 h J)⁻¹ so the controller is not
+    fooled by fast transients (Hosea & Shampine 1996).
+    """
+    g, d = _TRBDF2_GAMMA, _TRBDF2_D
+    k1 = f(x, t)
+
+    # stage 2: trapezoidal to t + γh
+    def res_tr(xg):
+        return xg - x - d * h * (k1 + f(xg, t + g * h))
+
+    xg = _newton_solve(res_tr, x + g * h * k1, newton_iters)
+    k2 = f(xg, t + g * h)
+
+    # stage 3: BDF2 closure to t + h
+    w = _TRBDF2_W
+
+    def res_bdf(xn):
+        return xn - x - h * (w * k1 + w * k2 + d * f(xn, t + h))
+
+    xn = _newton_solve(res_bdf, xg + (1.0 - g) * h * k2, newton_iters)
+    k3 = f(xn, t + h)
+
+    b, bh = _TRBDF2_B, _TRBDF2_BHAT
+    est = h * ((b[0] - bh[0]) * k1 + (b[1] - bh[1]) * k2
+               + (b[2] - bh[2]) * k3)
+    # stiff filter: est ← (I - d h J)⁻¹ est
+    n = x.shape[0]
+    eye = jnp.eye(n, dtype=x.dtype)
+    J = jax.jacfwd(lambda xx: f(xx, t + h))(xn)
+    est = jnp.linalg.solve(eye - d * h * J, est)
+    return xn, est
+
+
+def integrate_adaptive(f: ODE, x0, t0, dt, rtol: float = 1e-6,
+                       atol: float = 1e-8, h0: float | None = None,
+                       max_steps: int = 10_000, newton_iters: int = 6):
+    """Adaptive TR-BDF2 integration of x' = f(x, t) over [t0, t0+dt].
+
+    Embedded-error step control inside one ``lax.while_loop`` (shape-static,
+    jit/vmap-safe): a step is accepted when the weighted RMS of the local
+    error estimate is ≤ 1, and the next step size follows the standard
+    third-order controller ``h ← h · clip(0.9 · err^(-1/3), 0.2, 5)``.
+    This is the framework's CVODES-fidelity plant integrator; the fixed-step
+    methods in :func:`integrate` remain the in-OCP fast paths.
+
+    Returns ``(x_final, stats)`` with ``stats = (n_accepted, n_rejected)``.
+    If the step budget is exhausted before reaching ``t0+dt`` the returned
+    state is NaN-poisoned — a silently wrong plant state must never be
+    indistinguishable from a successful integration.
+    """
+    dtype = x0.dtype
+    t_end = t0 + dt
+    h_init = jnp.asarray(dt / 16.0 if h0 is None else h0, dtype)
+
+    def err_norm(est, x_new, x_old):
+        scale = atol + rtol * jnp.maximum(jnp.abs(x_new), jnp.abs(x_old))
+        return jnp.sqrt(jnp.mean((est / scale) ** 2))
+
+    def cond(carry):
+        t, _x, _h, _acc, _rej, k = carry
+        return (t < t_end - 1e-12 * jnp.abs(t_end)) & (k < max_steps)
+
+    def body(carry):
+        t, x, h, acc, rej, k = carry
+        h_eff = jnp.minimum(h, t_end - t)
+        x_new, est = trbdf2_step(f, x, t, h_eff, newton_iters)
+        err = err_norm(est, x_new, x)
+        ok = (err <= 1.0) & jnp.all(jnp.isfinite(x_new))
+        # 3rd-order embedded → exponent -1/3; safety 0.9; bounded factor.
+        # A non-finite estimate (Newton blow-up) must SHRINK the step, not
+        # ride the err>0 branch to the 5x growth clip.
+        fac = jnp.where(
+            jnp.isfinite(err),
+            jnp.clip(0.9 * jnp.maximum(err, 1e-10) ** (-1.0 / 3.0), 0.2, 5.0),
+            0.2)
+        t_n = jnp.where(ok, t + h_eff, t)
+        x_n = jnp.where(ok, x_new, x)
+        h_n = h_eff * fac
+        return (t_n, x_n, h_n, acc + ok.astype(jnp.int32),
+                rej + (~ok).astype(jnp.int32), k + 1)
+
+    t_f, x_f, _h, acc, rej, _k = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(t0, dtype), x0, h_init, jnp.asarray(0), jnp.asarray(0),
+         jnp.asarray(0)))
+    reached = t_f >= t_end - 1e-12 * jnp.abs(t_end)
+    x_f = jnp.where(reached, x_f, jnp.nan)
+    return x_f, (acc, rej)
 
 
 _STEPPERS = {
     "euler": euler_step,
     "rk4": rk4_step,
     "implicit_midpoint": implicit_midpoint_step,
+    "trbdf2": lambda f, x, t, h: trbdf2_step(f, x, t, h)[0],
 }
 
 
 def integrate(f: ODE, x0, t0, dt, substeps: int = 1, method: str = "rk4"):
-    """Integrate x' = f(x, t) from t0 over dt with `substeps` fixed steps."""
+    """Integrate x' = f(x, t) from t0 over dt with `substeps` fixed steps.
+
+    ``method="adaptive"`` dispatches to :func:`integrate_adaptive`
+    (embedded-error TR-BDF2) and ignores ``substeps``.
+    """
+    if method == "adaptive":
+        return integrate_adaptive(f, x0, t0, dt)[0]
     stepper = _STEPPERS[method]
     h = dt / substeps
 

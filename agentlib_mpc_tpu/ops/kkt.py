@@ -1,0 +1,309 @@
+"""Batched KKT linear algebra: a Pallas TPU LDLᵀ factorization.
+
+Why this exists: the interior-point solver (``ops/solver.py``) factors one
+symmetric quasi-definite KKT matrix
+
+    K = [[W, Jgᵀ], [Jg, -δ_c I]],   W ≻ 0 (Levenberg-regularized)
+
+per Newton iteration, for every agent in a vmapped batch. The reference
+delegates this to IPOPT's sparse MA27/MUMPS factorization inside each
+per-agent CasADi process (``agentlib_mpc/data_structures/casadi_utils.py:
+117-300``). On TPU the equivalent hot op is a *batched small dense*
+factorization — and XLA's stock ``lu_factor`` lowers partial pivoting to a
+long sequential op chain that dominates the whole solve (measured ≈9 ms of
+an ≈11.6 ms IP iteration for 256 agents of a 92² system on v5e).
+
+TPU-native design:
+
+* **No pivoting.** A symmetric *quasi-definite* matrix (W ≻ 0, lower-right
+  block ≺ 0) admits a stable LDLᵀ factorization for any symmetric pivot
+  order (Vanderbei 1995) — the interior-point regularization δ·I / δ_c·I
+  guarantees quasi-definiteness, so partial pivoting (the sequential part
+  of LU) is unnecessary. Jacobi equilibration + iterative refinement (in
+  ``solve_kkt``) recover the last bits of accuracy in f32.
+* **Batch in lanes.** The working set is laid out ``(M, M, batch)`` so the
+  batch dimension occupies the 128-wide vector lanes: every step of the
+  factorization recursion is an (M, M)-shaped VPU op applied to 128 agents
+  at once. The sequential k-loop runs *inside* one kernel — one launch for
+  the whole batched factorization instead of XLA's per-step op chain.
+* **vmap-transparent.** ``ldl_factor`` / ``ldl_solve`` are
+  ``jax.custom_batching.custom_vmap`` functions: called un-batched they
+  process a single matrix; under ``jax.vmap`` the whole batch is routed to
+  the lanes-batched kernel. The interior-point solver code is written
+  per-agent and stays oblivious.
+
+On non-TPU backends the same algorithm runs as pure JAX (``*_ref``) or the
+solver keeps XLA's LU (CPU LU is fine; see ``solver.SolverOptions.kkt_method``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU-oriented; keep import failures non-fatal off-TPU
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # noqa: BLE001 - optional dependency path
+    pl = None
+    _HAS_PALLAS = False
+
+_LANES = 128
+_TINY = 1e-30
+
+
+def _pad_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def _safe_d(d):
+    """Clamp a pivot away from zero, preserving sign (0 counts as +)."""
+    return jnp.where(d >= 0, jnp.maximum(d, _TINY), jnp.minimum(d, -_TINY))
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels (TPU): batch in the 128-wide lane dimension
+# --------------------------------------------------------------------------
+
+def _ldl_factor_kernel(m_real: int, k_ref, out_ref):
+    """In-place right-looking LDLᵀ on an (M_pad, M_pad, 128) block.
+
+    After step k, column k (rows > k) holds L, the diagonal holds D. The
+    strictly-upper / stale-lower entries are junk that later steps never
+    read (each step k only reads row k, column k and the trailing block,
+    all of which are written by earlier steps only at column indices > their
+    own k).
+    """
+    out_ref[:] = k_ref[:]
+    m_pad = out_ref.shape[0]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1, 1), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (1, m_pad, 1), 1)
+
+    def step(k, _):
+        d = _safe_d(out_ref[pl.ds(k, 1), pl.ds(k, 1), :])   # (1, 1, L)
+        row = out_ref[pl.ds(k, 1), :, :]                    # (1, M, L)
+        col = out_ref[:, pl.ds(k, 1), :]                    # (M, 1, L)
+        below = row_ids > k
+        l = jnp.where(below, col / d, 0.0)                  # (M, 1, L)
+        # trailing-block rank-1 update, masked to (i > k) & (j > k): the
+        # column mask keeps already-stored L columns (j < k) intact
+        upd = jnp.where(col_ids > k, l * row, 0.0)
+        out_ref[:] = out_ref[:] - upd
+        # stash L into column k (untouched by the update: j == k excluded)
+        out_ref[:, pl.ds(k, 1), :] = jnp.where(below, l,
+                                               out_ref[:, pl.ds(k, 1), :])
+        return 0
+
+    jax.lax.fori_loop(0, m_real, step, 0)
+
+
+def _ldl_solve_kernel(m_real: int, ld_ref, b_ref, dinv_ref, x_ref):
+    """Solve L D Lᵀ x = b on (M_pad, 128) lane-batched vectors."""
+    x_ref[:] = b_ref[:]
+    m_pad = x_ref.shape[0]
+    rid = jax.lax.broadcasted_iota(jnp.int32, (m_pad, 1), 0)
+
+    def fwd(k, _):
+        xk = x_ref[pl.ds(k, 1), :]                 # (1, L)
+        colk = ld_ref[:, pl.ds(k, 1), :][:, 0, :]  # (M, L)
+        x_ref[:] = x_ref[:] - jnp.where(rid > k, colk * xk, 0.0)
+        return 0
+
+    jax.lax.fori_loop(0, m_real, fwd, 0)
+    x_ref[:] = x_ref[:] * dinv_ref[:]
+
+    def bwd(kk, _):
+        k = m_real - 1 - kk
+        xk = x_ref[pl.ds(k, 1), :]
+        rowk = ld_ref[pl.ds(k, 1), :, :][0]        # (M, L)
+        x_ref[:] = x_ref[:] - jnp.where(rid < k, rowk * xk, 0.0)
+        return 0
+
+    jax.lax.fori_loop(0, m_real, bwd, 0)
+
+
+def _to_lanes(Kb):
+    """(B, M, M) → zero-padded (M_pad, M_pad, B_pad), batch in lanes.
+
+    Zero padding is safe: the factorization / solve loops run only over the
+    real ``M`` rows, so padded rows are never pivot rows and their (zero)
+    columns contribute nothing to real rows.
+    """
+    B, M, _ = Kb.shape
+    m_pad = _pad_up(max(M, 8), 8)
+    b_pad = _pad_up(B, _LANES)
+    K_t = jnp.transpose(Kb, (1, 2, 0))                      # (M, M, B)
+    K_t = jnp.pad(K_t, ((0, m_pad - M), (0, m_pad - M), (0, b_pad - B)))
+    return K_t, m_pad, b_pad
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ldl_factor_batched(Kb: jnp.ndarray, interpret: bool = False):
+    """Batched compact LDLᵀ: (B, M, M) → (B, M, M) holding L (unit, strictly
+    lower) and D (diagonal)."""
+    B, M, _ = Kb.shape
+    dtype = Kb.dtype
+    Kb32 = Kb.astype(jnp.float32)
+    K_t, m_pad, b_pad = _to_lanes(Kb32)
+    grid = b_pad // _LANES
+    out = pl.pallas_call(
+        functools.partial(_ldl_factor_kernel, M),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((m_pad, m_pad, _LANES),
+                               lambda i: (0, 0, i))],
+        out_specs=pl.BlockSpec((m_pad, m_pad, _LANES), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, m_pad, b_pad), jnp.float32),
+        interpret=interpret,
+    )(K_t)
+    return jnp.transpose(out[:M, :M, :B], (2, 0, 1)).astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ldl_solve_batched(LDb: jnp.ndarray, bb: jnp.ndarray,
+                       interpret: bool = False):
+    """Batched L D Lᵀ solve: (B, M, M), (B, M) → (B, M)."""
+    B, M, _ = LDb.shape
+    dtype = bb.dtype
+    LD32 = LDb.astype(jnp.float32)
+    LD_t, m_pad, b_pad = _to_lanes(LD32)
+    b_t = jnp.pad(jnp.transpose(bb.astype(jnp.float32), (1, 0)),
+                  ((0, m_pad - M), (0, b_pad - B)))
+    d = jnp.diagonal(LD32, axis1=1, axis2=2)                # (B, M)
+    dinv_t = jnp.pad(jnp.transpose(1.0 / _safe_d(d), (1, 0)),
+                     ((0, m_pad - M), (0, b_pad - B)),
+                     constant_values=1.0)
+    grid = b_pad // _LANES
+    out = pl.pallas_call(
+        functools.partial(_ldl_solve_kernel, M),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((m_pad, m_pad, _LANES), lambda i: (0, 0, i)),
+            pl.BlockSpec((m_pad, _LANES), lambda i: (0, i)),
+            pl.BlockSpec((m_pad, _LANES), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m_pad, _LANES), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, b_pad), jnp.float32),
+        interpret=interpret,
+    )(LD_t, b_t, dinv_t)
+    return jnp.transpose(out[:M, :B], (1, 0)).astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# Pure-JAX reference (any platform; also the un-batched fallback)
+# --------------------------------------------------------------------------
+
+def ldl_factor_ref(K: jnp.ndarray) -> jnp.ndarray:
+    """Compact LDLᵀ of one (M, M) symmetric quasi-definite matrix."""
+    M = K.shape[-1]
+    ids = jnp.arange(M)
+
+    def step(k, A):
+        d = _safe_d(A[k, k])
+        l = jnp.where(ids > k, A[:, k] / d, 0.0)             # (M,)
+        # update masked to (i > k) & (j > k) so stored L columns survive
+        mask2 = (ids > k)[:, None] & (ids > k)[None, :]
+        A = A - jnp.where(mask2, l[:, None] * A[k, :][None, :], 0.0)
+        A = A.at[:, k].set(jnp.where(ids > k, l, A[:, k]))
+        return A
+
+    return jax.lax.fori_loop(0, M, step, K)
+
+
+def ldl_solve_ref(LD: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve L D Lᵀ x = b from a compact factor (single system)."""
+    M = LD.shape[-1]
+    ids = jnp.arange(M)
+
+    def fwd(k, x):
+        return x - jnp.where(ids > k, LD[:, k] * x[k], 0.0)
+
+    x = jax.lax.fori_loop(0, M, fwd, b)
+    x = x / _safe_d(jnp.diagonal(LD))
+
+    def bwd(kk, x):
+        k = M - 1 - kk
+        return x - jnp.where(ids < k, LD[k, :] * x[k], 0.0)
+
+    return jax.lax.fori_loop(0, M, bwd, x)
+
+
+# --------------------------------------------------------------------------
+# vmap-transparent entry points
+# --------------------------------------------------------------------------
+
+def _use_pallas() -> bool:
+    return _HAS_PALLAS and jax.default_backend() == "tpu"
+
+
+@jax.custom_batching.custom_vmap
+def ldl_factor(K: jnp.ndarray) -> jnp.ndarray:
+    """Compact LDLᵀ factor of one symmetric quasi-definite matrix.
+
+    Under ``jax.vmap`` the whole batch is dispatched to one lanes-batched
+    Pallas kernel (TPU). Un-batched, or on other platforms, the pure-JAX
+    recursion runs.
+    """
+    return ldl_factor_ref(K)
+
+
+@ldl_factor.def_vmap
+def _ldl_factor_vmap(axis_size, in_batched, K):
+    del axis_size
+    if not in_batched[0]:
+        return ldl_factor_ref(K), False
+    lead = K.shape[:-2]
+    Kb = K.reshape((-1,) + K.shape[-2:])
+    if _use_pallas():
+        out = _ldl_factor_batched(Kb)
+    else:
+        out = jax.vmap(ldl_factor_ref)(Kb)
+    return out.reshape(lead + K.shape[-2:]), True
+
+
+@jax.custom_batching.custom_vmap
+def ldl_solve(LD: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve L D Lᵀ x = b from :func:`ldl_factor` output (vmap-aware)."""
+    return ldl_solve_ref(LD, b)
+
+
+@ldl_solve.def_vmap
+def _ldl_solve_vmap(axis_size, in_batched, LD, b):
+    if not (in_batched[0] and in_batched[1]):
+        # broadcast the un-batched operand; both batched is the hot path
+        LDb = LD if in_batched[0] else jnp.broadcast_to(
+            LD, (axis_size,) + LD.shape)
+        bb = b if in_batched[1] else jnp.broadcast_to(
+            b, (axis_size,) + b.shape)
+    else:
+        LDb, bb = LD, b
+    lead = bb.shape[:-1]
+    LDf = LDb.reshape((-1,) + LDb.shape[-2:])
+    bf = bb.reshape((-1,) + bb.shape[-1:])
+    if _use_pallas():
+        out = _ldl_solve_batched(LDf, bf)
+    else:
+        out = jax.vmap(ldl_solve_ref)(LDf, bf)
+    return out.reshape(lead + bb.shape[-1:]), True
+
+
+def solve_kkt_ldl(K: jnp.ndarray, rhs: jnp.ndarray,
+                  refine_steps: int = 2) -> jnp.ndarray:
+    """Equilibrated LDLᵀ solve with iterative refinement (f32-safe).
+
+    Drop-in replacement for the dense-LU path: symmetric Jacobi
+    equilibration keeps the scaling symmetric (so the scaled matrix stays
+    quasi-definite), refinement recovers f32 accuracy lost to the
+    pivot-free factorization.
+    """
+    hi = jax.lax.Precision.HIGHEST
+    scale = 1.0 / jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(K), axis=1), 1e-12))
+    Ks = K * scale[:, None] * scale[None, :]
+    rs = rhs * scale
+    LD = ldl_factor(Ks)
+    x = ldl_solve(LD, rs)
+    for _ in range(refine_steps):
+        r = rs - jnp.matmul(Ks, x, precision=hi)
+        x = x + ldl_solve(LD, r)
+    return x * scale

@@ -1,11 +1,13 @@
-"""Benchmark: 256-zone consensus-ADMM MPC, wall-clock per control step.
+"""Benchmark: consensus-ADMM MPC fleets, wall-clock per control step.
 
 The BASELINE.json north-star metric: "ADMM-MPC wall-clock per control step;
 agents/sec scaling 4->256 zones". One control step = `ADMM_ITERS` fused
 consensus-ADMM iterations, each iteration = vmapped per-zone interior-point
 NLP solves + consensus mean + scaled-dual update, all inside one jitted XLA
 computation (the TPU-native replacement for the reference's coordinator
-round driving 256 IPOPT processes, ``admm_coordinator.py:259-321``).
+round driving one IPOPT process per zone, ``admm_coordinator.py:259-321``).
+On TPU the per-iteration KKT systems factor in the lanes-batched Pallas
+LDLᵀ kernel (``agentlib_mpc_tpu/ops/kkt.py``).
 
 The reference itself cannot run here (CasADi/IPOPT not installed, zero
 egress) and publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
@@ -14,7 +16,13 @@ same workload forced onto host CPU — a conservative stand-in: the CPU run
 uses the same fused XLA path, which is already far faster than 256
 sequential CasADi+IPOPT processes.
 
-Prints ONE JSON line:
+Modes:
+    python bench.py             # headline: 256 zones + CPU baseline probe,
+                                # prints ONE JSON line
+    python bench.py --scaling   # 4/16/64/256-zone curve (BASELINE.md rows),
+                                # prints one JSON line per size + a table
+
+Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
      "vs_baseline": <cpu_ms / this_ms>}
 """
@@ -31,9 +39,10 @@ N_AGENTS = 256
 HORIZON = 10
 ADMM_ITERS = 10
 DT = 300.0
+SCALING_SIZES = (4, 16, 64, 256)
 
 
-def build_step():
+def build_step(n_agents: int = N_AGENTS):
     import jax
     import jax.numpy as jnp
 
@@ -106,22 +115,22 @@ def build_step():
                                  (w_gs, y_gs, z_gs, zbar, lams))
 
     theta0 = ocp.default_params()
-    x0s = jnp.linspace(294.0, 300.0, N_AGENTS).reshape(N_AGENTS, 1)
-    loads = jnp.linspace(80.0, 250.0, N_AGENTS)
-    w_gs = jnp.broadcast_to(ocp.initial_guess(theta0), (N_AGENTS, ocp.n_w))
-    y_gs = jnp.zeros((N_AGENTS, ocp.n_g))
-    z_gs = jnp.full((N_AGENTS, ocp.n_h), 0.1)
+    x0s = jnp.linspace(294.0, 300.0, n_agents).reshape(n_agents, 1)
+    loads = jnp.linspace(80.0, 250.0, n_agents)
+    w_gs = jnp.broadcast_to(ocp.initial_guess(theta0), (n_agents, ocp.n_w))
+    y_gs = jnp.zeros((n_agents, ocp.n_g))
+    z_gs = jnp.full((n_agents, ocp.n_h), 0.1)
     zbar = jnp.full((HORIZON, 1), 0.02)
-    lams = jnp.zeros((N_AGENTS, HORIZON, 1))
+    lams = jnp.zeros((n_agents, HORIZON, 1))
     rho = jnp.asarray(20.0)
     args = (x0s, loads, w_gs, y_gs, z_gs, zbar, lams, rho)
     return jax.jit(control_step), args
 
 
-def measure() -> dict:
+def measure(n_agents: int = N_AGENTS) -> dict:
     import jax
 
-    step, args = build_step()
+    step, args = build_step(n_agents)
     t0 = time.perf_counter()
     out = step(*args)
     jax.block_until_ready(out)
@@ -136,11 +145,32 @@ def measure() -> dict:
         times.append(time.perf_counter() - t0)
     step_ms = 1e3 * min(times)
     return {
+        "n_agents": n_agents,
         "step_ms": step_ms,
         "compile_ms": compile_ms,
-        "agents_per_sec": N_AGENTS * ADMM_ITERS / (step_ms / 1e3),
+        "agents_per_sec": n_agents * ADMM_ITERS / (step_ms / 1e3),
         "platform": jax.devices()[0].platform,
     }
+
+
+def run_scaling() -> list[dict]:
+    """The 4→256-zone curve (BASELINE.md scaling rows)."""
+    rows = []
+    for n in SCALING_SIZES:
+        res = measure(n)
+        rows.append(res)
+        print(f"[bench] n={n:4d}  step={res['step_ms']:8.1f}ms  "
+              f"agents/s={res['agents_per_sec']:8.0f}  "
+              f"compile={res['compile_ms']:.0f}ms", file=sys.stderr)
+    for res in rows:
+        print(json.dumps({
+            "metric": f"admm{res['n_agents']}_step_ms",
+            "value": round(res["step_ms"], 2),
+            "unit": "ms",
+            "agents_per_sec": round(res["agents_per_sec"], 1),
+            "platform": res["platform"],
+        }))
+    return rows
 
 
 def main() -> None:
@@ -152,6 +182,10 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(measure()))
+        return
+
+    if "--scaling" in sys.argv:
+        run_scaling()
         return
 
     res = measure()

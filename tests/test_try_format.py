@@ -1,0 +1,76 @@
+"""TRY weather-file parsing tests (reference format:
+``modules/InputPrediction/try_predictor.py:7-90``)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.utils.try_format import (
+    TRY_QUANTITIES,
+    is_try_file,
+    read_try_file,
+)
+
+FIXTURE = Path(__file__).parent / "data" / "try_fixture.dat"
+
+
+def test_sniffer():
+    assert is_try_file(FIXTURE)
+    assert not is_try_file(__file__)
+
+
+def test_parse_columns_and_index():
+    df = read_try_file(FIXTURE)
+    assert list(df.columns) == list(TRY_QUANTITIES.values())
+    assert len(df) == 24
+    np.testing.assert_allclose(df.index.to_numpy(),
+                               np.arange(24) * 3600.0)
+
+
+def test_temperature_converted_to_kelvin():
+    df = read_try_file(FIXTURE)
+    # fixture's nighttime temperature is -1.5 degC
+    assert abs(df["T_oda"].iloc[0] - (273.15 - 1.5)) < 1e-9
+    assert (df["T_oda"] > 200).all()
+
+
+def test_radiation_zero_at_night_positive_at_noon():
+    df = read_try_file(FIXTURE)
+    assert df["beam_direct"].iloc[0] == 0.0
+    assert df["beam_direct"].iloc[12] > 100.0
+    assert (df["beam_terr"] < 0).all()
+
+
+def test_malformed_rows_raise():
+    bad = FIXTURE.parent / "bad.dat"
+    bad.write_text("header\n*** \n1 2 3\n")
+    try:
+        with pytest.raises(ValueError, match="malformed"):
+            read_try_file(bad)
+    finally:
+        bad.unlink()
+
+
+def test_data_source_loads_try_file():
+    from agentlib_mpc_tpu.runtime.agent import Agent
+    from agentlib_mpc_tpu.runtime.environment import Environment
+
+    env = Environment()
+    agent = Agent(env=env, config={"id": "weather", "modules": []})
+    from agentlib_mpc_tpu.modules.input_prediction import InputPredictor
+
+    mod = InputPredictor(
+        {"module_id": "try", "type": "try_predictor",
+         "data": str(FIXTURE), "t_sample": 3600.0,
+         "prediction_horizon": 4 * 3600.0,
+         "prediction_sample": 3600.0},
+        agent)
+    now_vals = mod.get_data_at_time(0.0)
+    assert set(now_vals) == set(TRY_QUANTITIES.values())
+    assert abs(now_vals["T_oda"] - (273.15 - 1.5)) < 1e-9
+    pred = mod.get_prediction_at_time(6 * 3600.0)
+    times, temps = pred["T_oda"]
+    assert len(times) == 5 and times[0] == 6 * 3600.0
+    # forecast covers the warming flank of the synthetic day
+    assert temps[-1] > temps[0]
