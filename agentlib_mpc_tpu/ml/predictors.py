@@ -25,6 +25,8 @@ import numpy as np
 from agentlib_mpc_tpu.ml.serialized import (
     SerializedANN,
     SerializedGPR,
+    SerializedGraphANN,
+    SerializedKerasANN,
     SerializedLinReg,
     SerializedMLModel,
 )
@@ -124,10 +126,29 @@ def _linreg_predictor(m: SerializedLinReg) -> Predictor:
                      tuple(m.input_columns), tuple(m.output_names))
 
 
+def _graph_predictor(m: SerializedGraphANN) -> Predictor:
+    from agentlib_mpc_tpu.ml.keras_graph import (
+        build_graph_apply,
+        spec_from_jsonable,
+    )
+
+    spec, params = spec_from_jsonable(m.graph)
+    apply = build_graph_apply(spec)
+    return Predictor(apply, params, m.n_inputs, len(m.output),
+                     tuple(m.input_columns), tuple(m.output_names))
+
+
+def _keras_predictor(m: SerializedKerasANN) -> Predictor:
+    # load the .keras artifact, convert once, evaluate as a graph
+    return _graph_predictor(m.to_graph())
+
+
 _MAKERS = {
     SerializedANN: _ann_predictor,
     SerializedGPR: _gpr_predictor,
     SerializedLinReg: _linreg_predictor,
+    SerializedGraphANN: _graph_predictor,
+    SerializedKerasANN: _keras_predictor,
 }
 
 

@@ -347,3 +347,73 @@ class SerializedLinReg(SerializedMLModel):
                    intercept=(np.asarray(linreg.intercept_).tolist()
                               if np.ndim(linreg.intercept_) else
                               float(linreg.intercept_)))
+
+
+@dataclasses.dataclass
+class SerializedGraphANN(SerializedMLModel):
+    """Self-contained layer-graph ANN: topology + weights in the document.
+
+    The TPU-native counterpart of the reference's Keras coverage
+    (``casadi_predictor.py:197-719``): any supported Keras ``Sequential`` /
+    ``Functional`` model converts once (``ml/keras_graph.from_keras``) into
+    a JSON graph spec + weight lists, after which neither keras nor
+    tensorflow is needed anywhere — the document alone rebuilds the pure-JAX
+    evaluator (`ml/keras_graph.build_graph_apply`).
+    """
+
+    model_type: ClassVar[str] = "GraphANN"
+
+    graph: dict = dataclasses.field(default_factory=dict)
+
+    def _parameters_dict(self) -> dict:
+        return {"graph": self.graph}
+
+    @classmethod
+    def from_keras(cls, model, dt, inputs, output,
+                   trainer_config=None) -> "SerializedGraphANN":
+        """Convert a live Keras model into the self-contained document."""
+        from agentlib_mpc_tpu.ml.keras_graph import (
+            from_keras,
+            spec_to_jsonable,
+        )
+
+        spec, params = from_keras(model)
+        return cls(dt=dt, inputs=inputs, output=output,
+                   trainer_config=trainer_config,
+                   graph=spec_to_jsonable(spec, params))
+
+
+@dataclasses.dataclass
+class SerializedKerasANN(SerializedMLModel):
+    """Path-referencing Keras artifact (reference ``SerializedKerasANN``,
+    ``serialized_ml_model.py:662-709``): stores the ``.keras`` file path;
+    loading requires keras and converts to the layer-graph evaluator."""
+
+    model_type: ClassVar[str] = "KerasANN"
+
+    model_path: str = ""
+
+    def _parameters_dict(self) -> dict:
+        return {"model_path": str(self.model_path)}
+
+    @classmethod
+    def serialize(cls, model, dt, inputs, output, model_path,
+                  trainer_config=None) -> "SerializedKerasANN":
+        """Save `model` to ``model_path`` (.keras) and reference it."""
+        path = Path(model_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        model.save(path)
+        return cls(dt=dt, inputs=inputs, output=output,
+                   trainer_config=trainer_config, model_path=str(path))
+
+    def deserialize(self):
+        """Load the referenced Keras model (requires keras installed)."""
+        import keras
+
+        return keras.saving.load_model(self.model_path)
+
+    def to_graph(self) -> SerializedGraphANN:
+        """Load + convert into the self-contained graph document."""
+        return SerializedGraphANN.from_keras(
+            self.deserialize(), dt=self.dt, inputs=self.inputs,
+            output=self.output, trainer_config=self.trainer_config)

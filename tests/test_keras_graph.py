@@ -1,0 +1,255 @@
+"""Keras interop equivalence tests (reference pattern:
+``tests/test_serialized_keras_ann.py:34-107`` — stored Keras artifacts must
+predict identically through the in-OCP evaluator).
+
+Each test builds a real Keras model, converts it with
+``ml/keras_graph.from_keras`` and checks the pure-JAX evaluation against
+``model.predict`` on random inputs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.ml.keras_graph import (
+    build_graph_apply,
+    from_keras,
+    spec_from_jsonable,
+    spec_to_jsonable,
+)
+from agentlib_mpc_tpu.ml.predictors import make_predictor
+from agentlib_mpc_tpu.ml.serialized import (
+    Feature,
+    OutputFeature,
+    SerializedGraphANN,
+    SerializedKerasANN,
+    SerializedMLModel,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _check_equiv(model, n_in, atol=1e-5, n_samples=5):
+    spec, params = from_keras(model)
+    apply = build_graph_apply(spec)
+    x = RNG.normal(size=(n_samples, n_in)).astype(np.float32)
+    y_keras = np.asarray(model.predict(x, verbose=0))
+    y_jax = np.stack([np.asarray(apply(params, jnp.asarray(xi)))
+                      for xi in x])
+    np.testing.assert_allclose(y_jax, y_keras.reshape(n_samples, -1),
+                               atol=atol, rtol=1e-4)
+    return spec, params, apply
+
+
+def test_sequential_dense_stack():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(6, activation="tanh"),
+        keras.layers.Dense(5, activation="sigmoid"),
+        keras.layers.Dense(2, activation="softplus"),
+        keras.layers.Dense(1, activation="linear"),
+    ])
+    _check_equiv(model, 4)
+
+
+def test_sequential_batchnorm_rescaling():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(3,)),
+        keras.layers.Rescaling(scale=2.5, offset=-1.0),
+        keras.layers.Dense(6, activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(1),
+    ])
+    # give batchnorm non-trivial moving statistics
+    model(np.zeros((1, 3), np.float32))
+    bn = model.layers[2]
+    bn.set_weights([
+        RNG.normal(size=6).astype(np.float32) + 1.0,   # gamma
+        RNG.normal(size=6).astype(np.float32),         # beta
+        RNG.normal(size=6).astype(np.float32),         # moving mean
+        RNG.uniform(0.5, 2.0, size=6).astype(np.float32),  # moving var
+    ])
+    _check_equiv(model, 3)
+
+
+def test_sequential_normalization_adapted():
+    norm = keras.layers.Normalization(axis=-1)
+    data = RNG.normal(size=(100, 4)).astype(np.float32) * 3.0 + 2.0
+    norm.adapt(data)
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        norm,
+        keras.layers.Dense(1),
+    ])
+    _check_equiv(model, 4)
+
+
+def test_functional_branches_and_merges():
+    inp = keras.layers.Input(shape=(5,))
+    a = keras.layers.Dense(7, activation="relu")(inp)
+    b = keras.layers.Dense(7, activation="tanh")(inp)
+    added = keras.layers.Add()([a, b])
+    subbed = keras.layers.Subtract()([a, b])
+    mult = keras.layers.Multiply()([added, subbed])
+    avg = keras.layers.Average()([a, b])
+    cat = keras.layers.Concatenate()([mult, avg])
+    out = keras.layers.Dense(1)(cat)
+    model = keras.Model(inputs=inp, outputs=out)
+    _check_equiv(model, 5)
+
+
+def test_functional_nested_submodel():
+    inner = keras.Sequential(
+        [keras.layers.Input(shape=(6,)),
+         keras.layers.Dense(4, activation="relu"),
+         keras.layers.Dense(3, activation="tanh")],
+        name="inner_encoder")
+    inp = keras.layers.Input(shape=(6,))
+    enc = inner(inp)
+    out = keras.layers.Dense(1)(enc)
+    model = keras.Model(inputs=inp, outputs=out)
+    _check_equiv(model, 6)
+
+
+def test_flatten_reshape_cropping():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(8,)),
+        keras.layers.Reshape((4, 2)),
+        keras.layers.Cropping1D(cropping=(1, 1)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(1),
+    ])
+    _check_equiv(model, 8)
+
+
+class _RBF(keras.layers.Layer):
+    """Minimal RBF layer with the reference's attributes
+    (``casadi_predictor.py:517-532``)."""
+
+    def __init__(self, units, dim, **kw):
+        super().__init__(**kw)
+        self.units = units
+        self.centers = self.add_weight(shape=(units, dim), name="centers")
+        self.log_gamma = self.add_weight(shape=(units,), name="log_gamma")
+
+    def call(self, x):
+        diff = x[:, None, :] - self.centers[None, :, :]
+        dist_sq = keras.ops.sum(diff ** 2, axis=2)
+        return keras.ops.exp(-keras.ops.exp(self.log_gamma) * dist_sq)
+
+
+def test_rbf_layer():
+    inp = keras.layers.Input(shape=(3,))
+    phi = _RBF(5, 3, name="rbf_basis")(inp)
+    out = keras.layers.Dense(1)(phi)
+    model = keras.Model(inputs=inp, outputs=out)
+    _check_equiv(model, 3)
+
+
+def test_exponential_and_gaussian_activations():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(2,)),
+        keras.layers.Dense(4, activation="exponential"),
+        keras.layers.Dense(1),
+    ])
+    _check_equiv(model, 2)
+
+
+def test_graph_document_roundtrip():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(3,)),
+        keras.layers.Dense(4, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    spec, params, apply = _check_equiv(model, 3)
+    doc = spec_to_jsonable(spec, params)
+    doc2 = json.loads(json.dumps(doc))          # through-the-wire
+    spec2, params2 = spec_from_jsonable(doc2)
+    apply2 = build_graph_apply(spec2)
+    x = jnp.asarray(RNG.normal(size=3))
+    np.testing.assert_allclose(np.asarray(apply2(params2, x)),
+                               np.asarray(apply(params, x)), atol=1e-6)
+
+
+def test_serialized_keras_ann_artifact(tmp_path):
+    """Reference flow: save .keras, reference by path, load, predict
+    (``serialized_ml_model.py:662-709``)."""
+    model = keras.Sequential([
+        keras.layers.Input(shape=(2,)),
+        keras.layers.Dense(5, activation="tanh"),
+        keras.layers.Dense(1),
+    ])
+    feats = {"T": Feature(name="T", lag=1), "u": Feature(name="u", lag=1)}
+    outs = {"T": OutputFeature(name="T", lag=1, output_type="absolute")}
+    ser = SerializedKerasANN.serialize(
+        model, dt=300.0, inputs=feats, output=outs,
+        model_path=tmp_path / "m.keras")
+    # JSON round trip of the document
+    ser2 = SerializedMLModel.from_json(ser.to_json())
+    pred = make_predictor(ser2)
+    x = RNG.normal(size=(4, 2)).astype(np.float32)
+    y_keras = np.asarray(model.predict(x, verbose=0)).reshape(-1)
+    y_jax = np.asarray([float(pred.apply(pred.params, jnp.asarray(xi))[0])
+                        for xi in x])
+    np.testing.assert_allclose(y_jax, y_keras, atol=1e-5)
+    # conversion to the self-contained document drops the keras dependency
+    graph_doc = ser2.to_graph()
+    pred3 = make_predictor(SerializedMLModel.from_json(graph_doc.to_json()))
+    y3 = np.asarray([float(pred3.apply(pred3.params, jnp.asarray(xi))[0])
+                     for xi in x])
+    np.testing.assert_allclose(y3, y_keras, atol=1e-5)
+
+
+def test_shared_layer_two_calls():
+    """Weight sharing: one Dense applied to two tensors must yield two
+    distinct graph nodes (not a silent overwrite)."""
+    shared = keras.layers.Dense(4, activation="tanh", name="shared_dense")
+    inp = keras.layers.Input(shape=(4,))
+    a = shared(inp)
+    b = shared(keras.layers.Rescaling(scale=2.0)(inp))
+    out = keras.layers.Dense(1)(keras.layers.Concatenate()([a, b]))
+    model = keras.Model(inputs=inp, outputs=out)
+    spec, params, _ = _check_equiv(model, 4)
+    dense_nodes = [n for n in spec["nodes"] if "shared_dense" in n["name"]]
+    assert len(dense_nodes) == 2
+    assert len({n["name"] for n in dense_nodes}) == 2
+
+
+def test_unsupported_layer_raises():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4, 2)),
+        keras.layers.GlobalAveragePooling1D(),
+        keras.layers.Dense(1),
+    ])
+    with pytest.raises(NotImplementedError, match="not supported"):
+        from_keras(model)
+
+
+def test_rescaling_per_feature_arrays():
+    model = keras.Sequential([
+        keras.layers.Input(shape=(2,)),
+        keras.layers.Rescaling(scale=[0.1, 10.0], offset=[0.0, -1.0]),
+        keras.layers.Dense(1),
+    ])
+    _check_equiv(model, 2)
+
+
+def test_converted_model_is_differentiable_and_vmappable():
+    """The point of the exercise: the converted ANN sits inside the OCP."""
+    inp = keras.layers.Input(shape=(3,))
+    h = keras.layers.Dense(6, activation="tanh")(inp)
+    out = keras.layers.Dense(1)(h)
+    model = keras.Model(inputs=inp, outputs=out)
+    spec, params = from_keras(model)
+    apply = build_graph_apply(spec)
+    g = jax.grad(lambda x: apply(params, x)[0])(jnp.ones(3))
+    assert g.shape == (3,) and bool(jnp.all(jnp.isfinite(g)))
+    ys = jax.vmap(lambda x: apply(params, x))(jnp.ones((7, 3)))
+    assert ys.shape == (7, 1)
