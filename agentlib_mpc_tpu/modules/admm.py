@@ -392,6 +392,8 @@ class ADMMModule(BaseMPC):
         """Wall-clock budget ∨ iteration cap (``_check_termination``,
         ``admm.py:263-296``). In fast simulation the clock does not advance
         inside a round, so the iteration cap governs."""
+        if self._stop.is_set():
+            return True     # MAS shutdown: abandon the round cleanly
         budget = self.time_step - self.registration_period
         elapsed = (_time.time() - start_wall) if self.env.rt \
             else (self.env.now - start_time)
@@ -521,7 +523,7 @@ class RealtimeADMM(ADMMModule):
     def __init__(self, config: dict, agent):
         self.start_step = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        super().__init__(config, agent)
+        super().__init__(config, agent)   # provides self._stop
 
     def process(self):
         self._thread = threading.Thread(
@@ -533,22 +535,42 @@ class RealtimeADMM(ADMMModule):
         if self.env.rt:
             yield self.time_step - (_time.time() % self.time_step)
         while True:
-            if self.start_step.is_set():
-                self.logger.error(
-                    "previous ADMM round still running; skipping trigger")
-            else:
-                self.start_step.set()
+            self._fire_trigger()
             yield self.time_step
 
+    def _fire_trigger(self) -> None:
+        """Kick the worker for the next round — unless the previous round
+        is still in flight, which is reported, not queued
+        (reference overrun detection, ``admm.py:277-286``)."""
+        if self.start_step.is_set():
+            self.logger.error(
+                "previous ADMM round still running; skipping trigger")
+        else:
+            self.start_step.set()
+
     def _admm_loop(self) -> None:
-        while True:
-            self.start_step.wait()
+        while not self._stop.is_set():
+            # bounded wait so the worker notices a stop request promptly
+            if not self.start_step.wait(timeout=0.2):
+                continue
             self.start_step.clear()
+            if self._stop.is_set():
+                break
             try:
                 self.admm_step()
             except Exception:  # pragma: no cover - diagnostic path
-                self.logger.exception("ADMM round failed")
+                if not self._stop.is_set():
+                    self.logger.exception("ADMM round failed")
             self._status = ModuleStatus.sleeping
+
+    def terminate(self) -> None:
+        """Join the worker thread (clean interpreter shutdown: a daemon
+        thread killed while blocked inside a C frame dies with 'FATAL:
+        exception not rethrown'). An in-flight round exits at its next
+        iteration boundary via the ``_stop``-aware termination check."""
+        self._thread = self._join_worker(
+            self._thread, wake_events=(self.start_step,),
+            timeout=self.registration_period + self.iteration_timeout + 5.0)
 
     def admm_step(self) -> None:
         self._status = ModuleStatus.at_registration

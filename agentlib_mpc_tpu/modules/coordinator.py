@@ -301,6 +301,7 @@ class ADMMCoordinator(BaseModule):
         self._exchange_variables: Dict[str, ExchangeVariable] = {}
         self.penalty_parameter = self.penalty_factor
         self.received_variable = threading.Event()
+        self._thread: "threading.Thread | None" = None
         # RLock: in fast simulation broker delivery is synchronous, so the
         # registration handshake re-enters this module's callback stack
         # (request → params → confirm) within one acquire
@@ -567,22 +568,35 @@ class ADMMCoordinator(BaseModule):
         loop stays responsive (reference ``_realtime_process``,
         ``admm_coordinator.py:161-251``)."""
         self._start_algorithm = threading.Event()
-        thread = threading.Thread(target=self._realtime_thread, daemon=True,
-                                  name=f"admm_coordinator_{self.agent.id}")
-        thread.start()
+        self._thread = threading.Thread(
+            target=self._realtime_thread, daemon=True,
+            name=f"admm_coordinator_{self.agent.id}")
+        self._thread.start()
         while True:
             self._start_algorithm.set()
             yield self.sampling_time
 
     def _realtime_thread(self) -> None:
-        while True:
-            self._start_algorithm.wait()
+        while not self._stop.is_set():
+            if not self._start_algorithm.wait(timeout=0.2):
+                continue
             self._start_algorithm.clear()
+            if self._stop.is_set():
+                break
             with self._registration_lock:
                 try:
                     self._realtime_step()
                 except Exception:  # pragma: no cover
-                    self.logger.exception("coordinator round failed")
+                    if not self._stop.is_set():
+                        self.logger.exception("coordinator round failed")
+
+    def terminate(self) -> None:
+        """Join the realtime worker thread for a clean interpreter exit."""
+        wake = [self.received_variable]    # unblock a wait on agents
+        if getattr(self, "_start_algorithm", None) is not None:
+            wake.append(self._start_algorithm)
+        self._thread = self._join_worker(
+            self._thread, wake_events=tuple(wake), timeout=10.0)
 
     def _realtime_step(self) -> None:
         self.status = CoordinatorStatus.init_iterations
@@ -597,6 +611,8 @@ class ADMMCoordinator(BaseModule):
         self._shift_coupling_variables()
         converged = False
         for admm_iter in range(1, self.admm_iter_max + 1):
+            if self._stop.is_set():
+                return     # MAS shutdown mid-round
             self.status = CoordinatorStatus.optimization
             self.trigger_optimizations()
             self._wait_for_ready(block=True)
@@ -619,6 +635,8 @@ class ADMMCoordinator(BaseModule):
         (reference ``coordinator.py:232-265``)."""
         self.received_variable.clear()
         while not self.all_finished:
+            if self._stop.is_set():
+                return     # MAS shutdown: abandon the wait
             if not block:
                 # synchronous delivery: busy agents at this point failed
                 self._deregister_slow()

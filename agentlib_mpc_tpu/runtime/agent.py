@@ -47,3 +47,18 @@ class Agent:
 
     def get_module(self, module_id: str) -> BaseModule:
         return self.modules[module_id]
+
+    def terminate(self) -> None:
+        """Shut down every module's background resources (reverse order).
+        A failing terminate() is logged, not raised — but never silent: a
+        skipped module's worker thread resurfaces as an interpreter-exit
+        crash, and the log line is the only clue connecting the two."""
+        import logging
+
+        for module in reversed(list(self.modules.values())):
+            try:
+                module.terminate()
+            except Exception:  # noqa: BLE001 - shutdown must not raise
+                logging.getLogger(__name__).exception(
+                    "terminate() of module %r failed",
+                    getattr(module, "module_id", module))

@@ -61,6 +61,14 @@ class LocalMAS:
             self._started = True
         self.env.run(until)
 
+    def terminate(self) -> None:
+        """Join background worker threads of all agents' modules. Without
+        this, a realtime ADMM worker blocked in a wait can be killed
+        mid-C-frame at interpreter exit ('FATAL: exception not rethrown').
+        Idempotent; call after the last :meth:`run`."""
+        for agent in self.agents.values():
+            agent.terminate()
+
     def get_results(self, cleanup: bool = False) -> dict:
         """dict[agent_id][module_id] → DataFrame (reference
         ``mas.get_results()`` shape, tests/test_examples.py:39-72)."""

@@ -20,6 +20,7 @@ the import indirection.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Iterable, Optional, Type
 
 from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
@@ -72,6 +73,10 @@ class BaseModule:
         self.env = agent.env
         self.logger = logging.getLogger(
             f"{type(self).__name__}[{agent.id}/{self.id}]")
+        #: shutdown signal for modules running background workers; checked
+        #: by abortable loops (e.g. ADMM round termination) and set by
+        #: :meth:`terminate`. Part of the module contract, not ad-hoc.
+        self._stop = threading.Event()
         self.vars: dict[str, AgentVariable] = {}
         self._groups: dict[str, list[str]] = {}
         for group in self.variable_groups:
@@ -146,6 +151,27 @@ class BaseModule:
 
     def process(self):
         """Override: generator yielding delays (seconds). Default: inert."""
+        return None
+
+    def terminate(self) -> None:
+        """Release background resources (worker threads, sockets). Called
+        by :meth:`Agent.terminate` at MAS shutdown; the default sets the
+        ``_stop`` event. Must be idempotent and must not raise."""
+        self._stop.set()
+
+    def _join_worker(self, thread, wake_events=(), timeout: float = 10.0):
+        """Shared worker-shutdown sequence: signal stop, wake the thread
+        out of any event wait, join with a budget, report a stuck worker.
+        Returns None (the caller clears its thread reference)."""
+        self._stop.set()
+        for event in wake_events:
+            event.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+            if thread.is_alive():  # pragma: no cover - diagnostic path
+                self.logger.error(
+                    "worker thread %s did not stop within %.1fs",
+                    thread.name, timeout)
         return None
 
     def cleanup_results(self) -> None:

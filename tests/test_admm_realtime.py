@@ -1,7 +1,15 @@
-"""Real-time threaded ADMM: two agents exchange couplings in wall-clock
-mode (the reference's threaded two-agent test, ``tests/test_admm.py:26-80``:
-rt env, local broadcast, asserts registration + mean computation)."""
+"""Real-time threaded ADMM: wall-clock rounds, registration windows,
+degradation paths and clean shutdown.
 
+Reference behaviors mirrored: threaded two-agent exchange
+(``tests/test_admm.py:26-80``), slow-participant de-registration and
+receive timeouts (``modules/dmpc/admm/admm.py:298-321``), wall-clock budget
+(``admm.py:263-296``), double-start detection (``admm.py:277-286``).
+The shutdown tests are the regression suite for the round-2 teardown crash
+('FATAL: exception not rethrown' from a worker killed mid-C-frame)."""
+
+import logging
+import queue
 import sys
 import time
 from pathlib import Path
@@ -12,8 +20,13 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from agentlib_mpc_tpu.models.zoo import CooledRoom, Cooler
-from agentlib_mpc_tpu.modules.admm import ParticipantStatus
+from agentlib_mpc_tpu.modules.admm import (
+    ADMMParticipation,
+    ModuleStatus,
+    ParticipantStatus,
+)
 from agentlib_mpc_tpu.runtime.mas import LocalMAS
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
 import agentlib_mpc_tpu.modules  # noqa: F401
 
 
@@ -69,19 +82,27 @@ COOLER = _agent(
 )
 
 
-@pytest.mark.slow
-def test_realtime_admm_round():
+@pytest.fixture(scope="module")
+def rt_mas():
+    """One short wall-clock run shared by the realtime tests; torn down
+    through the public terminate() path."""
     mas = LocalMAS([ROOM, COOLER], env={"rt": True, "factor": 1.0})
     mas.run(until=10.0)
     # let the daemon threads finish the round the last trigger started
     time.sleep(1.0)
+    yield mas
+    mas.terminate()
 
-    room = mas.agents["Room"].get_module("admm")
-    cooler = mas.agents["Cooler"].get_module("admm")
+
+@pytest.mark.slow
+def test_realtime_admm_round(rt_mas):
+    room = rt_mas.agents["Room"].get_module("admm")
+    cooler = rt_mas.agents["Cooler"].get_module("admm")
 
     # both saw each other on the shared wire alias
     assert any(p for p in room._registered_participants["admm_coupling_air"])
-    assert any(p for p in cooler._registered_participants["admm_coupling_air"])
+    assert any(
+        p for p in cooler._registered_participants["admm_coupling_air"])
 
     # at least one full iteration with mean computation ran on each side
     assert room._iter_rows, "room completed no ADMM iteration"
@@ -89,3 +110,156 @@ def test_realtime_admm_round():
     mean_room = room._admm_values["admm_coupling_mean_mDot"]
     assert np.all(np.isfinite(mean_room))
     assert mean_room.shape == (4,)
+
+
+@pytest.mark.slow
+def test_midrun_join_registers_participant(rt_mas):
+    """A participant broadcasting on the wire alias mid-run is registered
+    on first contact (reference initial registration, ``admm.py:440-470``)."""
+    room = rt_mas.agents["Room"].get_module("admm")
+    newcomer = AgentVariable(
+        name="admm_coupling_air", alias="admm_coupling_air",
+        value=[0.01, 0.01, 0.01, 0.01],
+        source=Source(agent_id="LateJoiner", module_id="admm"))
+    room.participant_callback(newcomer)
+    inboxes = room._registered_participants["admm_coupling_air"]
+    assert Source(agent_id="LateJoiner", module_id="admm") in inboxes
+
+
+@pytest.mark.slow
+def test_iterating_broadcast_lands_in_inbox(rt_mas):
+    """While iterating, fresh trajectories go into the bounded inbox and
+    flip the sender to available (``admm.py:471-501``)."""
+    room = rt_mas.agents["Room"].get_module("admm")
+    src = Source(agent_id="LateJoiner", module_id="admm")
+    var = AgentVariable(name="admm_coupling_air", alias="admm_coupling_air",
+                        value=[0.02] * 4, source=src)
+    room.participant_callback(var)              # ensure registered
+    old_status = room._status
+    room._status = ModuleStatus.optimizing
+    try:
+        room.participant_callback(var)
+        p = room._registered_participants["admm_coupling_air"][src]
+        assert p.status is ParticipantStatus.available
+        assert p.received.qsize() >= 1
+        p.empty_memory()
+    finally:
+        room._status = old_status
+
+
+@pytest.mark.slow
+def test_slow_participant_deregistered_mid_iteration(rt_mas, caplog):
+    """An empty inbox after the receive timeout de-registers the sender for
+    the rest of the round (``admm.py:298-321``)."""
+    room = rt_mas.agents["Room"].get_module("admm")
+    src = Source(agent_id="Sluggish", module_id="admm")
+    var = AgentVariable(name="admm_coupling_air", alias="admm_coupling_air",
+                        value=[0.02] * 4, source=src)
+    participation = ADMMParticipation(var)
+    participation.status = ParticipantStatus.available
+    # the sweep hits every participation: snapshot the fixture's state so
+    # later fixture-sharing tests see it unchanged
+    snapshot = [(p, p.status) for p in room.all_participations()]
+    room._registered_participants["admm_coupling_air"][src] = participation
+    try:
+        with caplog.at_level(logging.INFO):
+            # start_wall far in the past => remaining timeout clamps to 0
+            room._receive_variables(start_wall=time.time() - 999.0,
+                                    block=True)
+        assert participation.status is ParticipantStatus.not_participating
+        assert any("de-registered" in r.message and "Sluggish" in r.message
+                   for r in caplog.records)
+    finally:
+        del room._registered_participants["admm_coupling_air"][src]
+        for p, status in snapshot:
+            p.status = status
+
+
+@pytest.mark.slow
+def test_wall_clock_budget_exhaustion(rt_mas, caplog):
+    """Round must terminate once wall time exceeds
+    time_step - registration_period (``admm.py:263-296``)."""
+    room = rt_mas.agents["Room"].get_module("admm")
+    with caplog.at_level(logging.WARNING):
+        hit = room._check_termination(
+            admm_iter=1, start_time=room.env.now,
+            start_wall=time.time() - 2 * room.time_step)
+    assert hit
+    assert any("budget" in r.message for r in caplog.records)
+
+
+@pytest.mark.slow
+def test_iteration_cap_terminates(rt_mas):
+    room = rt_mas.agents["Room"].get_module("admm")
+    assert room._check_termination(
+        admm_iter=room.max_iterations, start_time=room.env.now,
+        start_wall=time.time())
+    assert not room._check_termination(
+        admm_iter=0, start_time=room.env.now, start_wall=time.time())
+
+
+@pytest.mark.slow
+def test_stop_request_aborts_round(rt_mas):
+    """A shutdown request ends an in-flight round at the next iteration
+    boundary (the terminate() contract)."""
+    room = rt_mas.agents["Room"].get_module("admm")
+    room._stop.set()
+    try:
+        assert room._check_termination(admm_iter=0, start_time=room.env.now,
+                                       start_wall=time.time())
+    finally:
+        room._stop.clear()
+
+
+def test_double_start_detection(caplog):
+    """A trigger firing while the previous round still runs is reported,
+    not queued (reference ``admm.py:277-286``). Tested on a detached stub
+    so no live worker can race the event between set and check."""
+    import threading
+    import types
+
+    from agentlib_mpc_tpu.modules.admm import RealtimeADMM
+
+    stub = types.SimpleNamespace(
+        start_step=threading.Event(),
+        logger=logging.getLogger("test_double_start"))
+    with caplog.at_level(logging.ERROR, logger="test_double_start"):
+        RealtimeADMM._fire_trigger(stub)        # idle -> sets the event
+        assert stub.start_step.is_set()
+        RealtimeADMM._fire_trigger(stub)        # in flight -> reported
+    assert any("still running" in r.message for r in caplog.records)
+
+
+@pytest.mark.slow
+def test_terminate_joins_workers_and_is_idempotent():
+    """After terminate(): this MAS's worker threads are dead; second call
+    no-op. Regression for the round-2 teardown crash. Collects the exact
+    thread objects (a concurrently-running fixture MAS uses the same
+    thread names)."""
+    mas2 = LocalMAS([ROOM, COOLER], env={"rt": True, "factor": 1.0})
+    mas2.run(until=2.0)
+    workers = [mas2.agents[aid].get_module("admm")._thread
+               for aid in ("Room", "Cooler")]
+    assert all(t is not None and t.is_alive() for t in workers), \
+        "workers should be running"
+    mas2.terminate()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(t.is_alive() for t in workers):
+        time.sleep(0.05)
+    assert not any(t.is_alive() for t in workers)
+    for aid in ("Room", "Cooler"):
+        assert mas2.agents[aid].get_module("admm")._thread is None
+    mas2.terminate()    # idempotent
+
+
+def test_participation_inbox_bounded():
+    """Flooding sender cannot exhaust memory (bounded queue)."""
+    var = AgentVariable(name="x", alias="x", value=[0.0],
+                        source=Source(agent_id="a", module_id="m"))
+    p = ADMMParticipation(var)
+    for _ in range(5):
+        p.received.put_nowait(var)
+    with pytest.raises(queue.Full):
+        p.received.put_nowait(var)
+    p.empty_memory()
+    assert p.received.qsize() == 0

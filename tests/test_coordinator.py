@@ -151,3 +151,114 @@ def test_couplings_agree(mas):
     trajs = list(var.local_trajectories.values())
     assert len(trajs) == 2
     assert np.max(np.abs(trajs[0] - trajs[1])) < 5e-3
+
+
+def test_midrun_join_new_agent_handshake(mas):
+    """A never-seen agent broadcasting a registration mid-run enters the
+    two-phase handshake: pending entry + parameter reply, then full
+    registration on the guess reply (reference
+    ``admm_coordinator.py:596-654``)."""
+    from agentlib_mpc_tpu.modules.coordinator import (
+        AgentStatus as AS,
+    )
+    from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+    coord = mas.agents["Coordinator"].get_module("coordinator")
+    src = Source(agent_id="LateZone", module_id="admm")
+    n_before = len(coord.agent_dict)
+    hello = AgentVariable(name="admm_register_a2c",
+                          alias="admm_register_a2c",
+                          value=None, source=src)
+    coord.registration_callback(hello)
+    assert len(coord.agent_dict) == n_before + 1
+    assert coord.agent_dict[src].status is AS.pending
+    # reply with initial guesses completes the registration
+    guesses = AgentVariable(
+        name="admm_register_a2c", alias="admm_register_a2c",
+        value={"local_trajectory": {"mDotCoolAir": [0.02] * HORIZON},
+               "local_exchange_trajectory": {}},
+        source=src)
+    coord.registration_callback(guesses)
+    assert coord.agent_dict[src].status is AS.standby
+    assert src in coord._coupling_variables["mDotCoolAir"].local_trajectories
+    # cleanup so other fixture-sharing tests see the original fleet
+    del coord.agent_dict[src]
+    coord._coupling_variables["mDotCoolAir"].local_trajectories.pop(src)
+    coord._coupling_variables["mDotCoolAir"].multipliers.pop(src, None)
+
+
+def test_deregister_slow_agent_midround(mas, caplog):
+    """Busy agents that never reply are de-registered for the round
+    (reference ``coordinator.py:232-265``)."""
+    import logging
+
+    coord = mas.agents["Coordinator"].get_module("coordinator")
+    entry = next(iter(coord.agent_dict.values()))
+    old_status = entry.status
+    entry.status = AgentStatus.busy
+    try:
+        with caplog.at_level(logging.INFO):
+            coord._deregister_slow()
+        assert entry.status is AgentStatus.standby
+        assert any("de-registered slow agent" in r.message
+                   for r in caplog.records)
+    finally:
+        entry.status = old_status
+
+
+def test_wait_for_ready_nonblocking_degrades(mas):
+    """Non-blocking wait (fast simulation) immediately de-registers
+    non-responders instead of deadlocking."""
+    coord = mas.agents["Coordinator"].get_module("coordinator")
+    entry = next(iter(coord.agent_dict.values()))
+    old_status = entry.status
+    entry.status = AgentStatus.busy
+    try:
+        coord._wait_for_ready(block=False)
+        assert entry.status is AgentStatus.standby
+    finally:
+        entry.status = old_status
+
+
+def test_wait_for_ready_aborts_on_stop(mas):
+    """A shutdown request unblocks a coordinator waiting on agents."""
+    coord = mas.agents["Coordinator"].get_module("coordinator")
+    entry = next(iter(coord.agent_dict.values()))
+    old_status = entry.status
+    entry.status = AgentStatus.busy
+    coord._stop.set()
+    try:
+        t0 = __import__("time").time()
+        coord._wait_for_ready(block=True)   # must return promptly
+        assert __import__("time").time() - t0 < coord.time_out_non_responders
+        assert entry.status is AgentStatus.busy  # untouched: just abandoned
+    finally:
+        coord._stop.clear()
+        entry.status = old_status
+
+
+def test_realtime_coordinator_terminate_joins_worker():
+    """Realtime coordinator thread lifecycle without any backend: start
+    the wall-clock driver, then terminate() must join the worker."""
+    import time as _t
+
+    from agentlib_mpc_tpu.runtime.agent import Agent
+    from agentlib_mpc_tpu.runtime.environment import Environment
+
+    env = Environment({"rt": True, "factor": 1.0})
+    agent = Agent(env=env, config={"id": "Coord", "modules": []})
+    from agentlib_mpc_tpu.modules.coordinator import ADMMCoordinator
+
+    coord = ADMMCoordinator(
+        {"module_id": "coordinator", "type": "admm_coordinator",
+         "time_step": 5.0, "prediction_horizon": 4}, agent)
+    gen = coord._realtime_process()
+    next(gen)                                   # starts the worker thread
+    worker = coord._thread
+    assert worker is not None and worker.is_alive()
+    coord.terminate()
+    deadline = _t.time() + 5.0
+    while _t.time() < deadline and worker.is_alive():
+        _t.sleep(0.05)
+    assert not worker.is_alive()
+    coord.terminate()                           # idempotent

@@ -1,0 +1,156 @@
+"""MQTT communicator loopback test with a fake in-memory paho client.
+
+The image has no paho-mqtt and no broker; a fake ``paho.mqtt.client``
+module is injected so the full publish → topic-filter → wire-decode →
+broker-delivery path of :class:`runtime.mqtt.MqttBus` runs in-process
+(reference MQTT communicator role: SURVEY.md §2.9)."""
+
+import sys
+import types
+
+import pytest
+
+from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+
+class _FakeBrokerHub:
+    """Shared in-memory 'broker': routes publishes to subscribed clients."""
+
+    def __init__(self):
+        self.clients = []
+
+
+class _FakeMessage:
+    def __init__(self, topic, payload):
+        self.topic = topic
+        self.payload = payload
+
+
+def _install_fake_paho(monkeypatch, hub):
+    class FakeClient:
+        def __init__(self, *args, **kwargs):
+            self.on_message = None
+            self._subs = []
+            self.connected = False
+            self.loop_running = False
+            self.credentials = None
+            hub.clients.append(self)
+
+        def username_pw_set(self, username, password=None):
+            self.credentials = (username, password)
+
+        def connect(self, host, port):
+            self.connected = (host, port)
+
+        def subscribe(self, pattern):
+            self._subs.append(pattern)
+
+        def loop_start(self):
+            self.loop_running = True
+
+        def loop_stop(self):
+            self.loop_running = False
+
+        def disconnect(self):
+            self.connected = False
+
+        def publish(self, topic, payload):
+            # like a real broker: a '#' subscriber receives its OWN
+            # publishes back too — that echo is what MqttBus's own-topic
+            # guard must filter
+            for client in hub.clients:
+                if not client.loop_running:
+                    continue
+                for pattern in client._subs:
+                    prefix = pattern[:-1] if pattern.endswith("#") \
+                        else pattern
+                    if topic.startswith(prefix) and client.on_message:
+                        client.on_message(client, None,
+                                          _FakeMessage(topic, payload))
+                        break
+
+    class CallbackAPIVersion:
+        VERSION1 = 1
+
+    mqtt_mod = types.ModuleType("paho.mqtt.client")
+    mqtt_mod.Client = FakeClient
+    mqtt_mod.CallbackAPIVersion = CallbackAPIVersion
+    paho_mod = types.ModuleType("paho")
+    paho_mqtt_mod = types.ModuleType("paho.mqtt")
+    paho_mod.mqtt = paho_mqtt_mod
+    paho_mqtt_mod.client = mqtt_mod
+    monkeypatch.setitem(sys.modules, "paho", paho_mod)
+    monkeypatch.setitem(sys.modules, "paho.mqtt", paho_mqtt_mod)
+    monkeypatch.setitem(sys.modules, "paho.mqtt.client", mqtt_mod)
+    return FakeClient
+
+
+class _RecordingBroker:
+    def __init__(self):
+        self.received = []
+        self.bus = None
+
+    def attach_bus(self, bus):
+        self.bus = bus
+
+    def send_variable(self, var, from_external=False):
+        self.received.append((var, from_external))
+
+
+def test_mqtt_loopback_two_agents(monkeypatch):
+    hub = _FakeBrokerHub()
+    _install_fake_paho(monkeypatch, hub)
+    from agentlib_mpc_tpu.runtime.mqtt import MqttBus
+
+    bus_a = MqttBus("AgentA")
+    bus_b = MqttBus("AgentB")
+    broker_a, broker_b = _RecordingBroker(), _RecordingBroker()
+    bus_a.attach(broker_a)
+    bus_b.attach(broker_b)
+
+    var = AgentVariable(name="T", alias="T_room", value=[1.0, 2.0],
+                        source=Source(agent_id="AgentA", module_id="mpc"))
+    bus_a.broadcast("AgentA", var)
+
+    # B received the decoded variable, delivered as external
+    assert len(broker_b.received) == 1
+    got, from_external = broker_b.received[0]
+    assert from_external is True
+    assert got.alias == "T_room"
+    assert list(got.value) == [1.0, 2.0]
+    assert got.source.agent_id == "AgentA"
+    # A's own echo is filtered by topic
+    assert broker_a.received == []
+
+    bus_a.close()
+    bus_b.close()
+    assert bus_a._client.loop_running is False
+
+
+def test_mqtt_malformed_payload_dropped(monkeypatch, caplog):
+    import logging
+
+    hub = _FakeBrokerHub()
+    _install_fake_paho(monkeypatch, hub)
+    from agentlib_mpc_tpu.runtime.mqtt import MqttBus
+
+    bus_a = MqttBus("AgentA")
+    bus_b = MqttBus("AgentB")
+    broker_b = _RecordingBroker()
+    bus_b.attach(broker_b)
+    with caplog.at_level(logging.WARNING):
+        bus_a._client.publish("/agentlib_mpc_tpu/AgentA", b"{not json!")
+    assert broker_b.received == []
+    assert any("malformed" in r.message for r in caplog.records)
+    bus_a.close()
+    bus_b.close()
+
+
+def test_mqtt_missing_paho_raises_actionable_error(monkeypatch):
+    """Without paho, construction raises the documented ImportError."""
+    for mod in ("paho", "paho.mqtt", "paho.mqtt.client"):
+        monkeypatch.setitem(sys.modules, mod, None)
+    from agentlib_mpc_tpu.runtime.mqtt import MqttBus
+
+    with pytest.raises(ImportError, match="paho-mqtt"):
+        MqttBus("AgentA")
