@@ -22,6 +22,27 @@ On a multi-chip mesh, shard each group's agent axis with
 ``jax.sharding.NamedSharding(mesh, P("agents"))`` (see
 ``FusedADMM.shard_args``); the coupling means then lower to all-reduces
 over ICI — the reference's broker traffic becomes one collective.
+
+Heterogeneous fleets — the pad/bucket strategy (SURVEY §7 hard part
+"vmap across heterogeneous agents"):
+
+* **Bucket by structure.** Agents batch under ``vmap`` only when they
+  evaluate the *same* transcribed OCP (same traced functions, same
+  shapes). :func:`bucket_agents` partitions a mixed fleet into minimal
+  structure groups keyed by the shared ``TranscribedOCP`` object +
+  coupling layout + solver options — same-model agents with different
+  *parameter values* (sizes, loads, bounds) land in one bucket; agents
+  with different structure get their own. Transcribe each model class
+  ONCE and reuse the OCP across its agents — per-agent re-transcription
+  produces distinct objects that cannot batch (and would recompile).
+* **Pad to the mesh.** A bucket whose agent count does not divide the
+  device mesh would fall back to replication in :meth:`FusedADMM.shard_args`.
+  :func:`pad_group_to_devices` instead pads the batch with copies of the
+  last agent and hands the engine a per-group ``active`` mask; padded
+  lanes solve (dense math, no wasted control flow) but are masked out of
+  every consensus/exchange mean, multiplier update, residual norm and
+  solver-health flag, so results match the unpadded fleet (up to
+  floating-point reduction-order effects of the masked means).
 """
 
 from __future__ import annotations
@@ -124,9 +145,21 @@ class FusedADMM:
     structure; call :meth:`step` once per control step."""
 
     def __init__(self, groups: Sequence[AgentGroup],
-                 options: FusedADMMOptions = FusedADMMOptions()):
+                 options: FusedADMMOptions = FusedADMMOptions(),
+                 active: "Sequence[jnp.ndarray] | None" = None):
+        """``active``: optional per-group boolean masks (n_agents,) —
+        False lanes are padding (see :func:`pad_group_to_devices`): they
+        run the dense math but never influence consensus results."""
         self.groups = tuple(groups)
         self.options = options
+        if active is None:
+            active = [jnp.ones((g.n_agents,), bool) for g in self.groups]
+        self.active = tuple(jnp.asarray(a, bool) for a in active)
+        for g, a in zip(self.groups, self.active):
+            if a.shape != (g.n_agents,):
+                raise ValueError(
+                    f"active mask of group {g.name!r} has shape {a.shape}, "
+                    f"expected ({g.n_agents},)")
         self._aliases = sorted(
             {a for g in self.groups for a in g.couplings})
         self._ex_aliases = sorted(
@@ -335,7 +368,8 @@ class FusedADMM:
                     y_new.append(y_b)
                     z_new.append(z_b)
                     u_groups.append(u_b)
-                    ok_all = ok_all & jnp.all(ok_b)
+                    # padded lanes may fail to converge without penalty
+                    ok_all = ok_all & jnp.all(ok_b | ~self.active[gi])
 
                 residuals = []
                 zbar_new = dict(state.zbar)
@@ -348,10 +382,13 @@ class FusedADMM:
                     lam_stack = jnp.concatenate(
                         [state.lam[alias][slot] for _, _, slot in parts],
                         axis=0)
+                    act = jnp.concatenate(
+                        [self.active[gi] for gi, _, _ in parts])
                     cstate = admm_ops.ConsensusState(
                         zbar=state.zbar[alias], lam=lam_stack,
                         rho=state.rho)
-                    cnew, res = admm_ops.consensus_update(locals_, cstate)
+                    cnew, res = admm_ops.consensus_update(locals_, cstate,
+                                                          active=act)
                     residuals.append(res)
                     zbar_new[alias] = cnew.zbar
                     offs = 0
@@ -373,10 +410,13 @@ class FusedADMM:
                     diff_stack = jnp.concatenate(
                         [state.ex_diff[alias][slot] for _, _, slot in parts],
                         axis=0)
+                    act = jnp.concatenate(
+                        [self.active[gi] for gi, _, _ in parts])
                     estate = admm_ops.ExchangeState(
                         mean=state.ex_mean[alias], diff=diff_stack,
                         lam=state.ex_lam[alias], rho=state.rho)
-                    enew, res = admm_ops.exchange_update(locals_, estate)
+                    enew, res = admm_ops.exchange_update(locals_, estate,
+                                                         active=act)
                     residuals.append(res)
                     ex_mean_new[alias] = enew.mean
                     ex_lam_new[alias] = enew.lam
@@ -495,3 +535,79 @@ class FusedADMM:
             jax.tree.map(lambda leaf, gi=gi: shard_group(gi, leaf), theta)
             for gi, theta in enumerate(theta_batches))
         return state, thetas
+
+
+# -- heterogeneous-fleet helpers (pad/bucket strategy, module docstring) ------
+
+def bucket_agents(specs: Sequence[dict]):
+    """Partition a mixed fleet into minimal structure groups.
+
+    Each spec: ``{"ocp": TranscribedOCP, "theta": OCPParams,
+    "couplings": {...}, "exchanges": {...}, "name": str,
+    "solver_options": SolverOptions, "warm_solver_options": ...}``.
+    Agents sharing one transcribed OCP *object*, coupling layout and
+    (warm) solver options batch together — their *parameter values* may
+    differ freely; that is the vmapped axis. Anything else gets its own
+    group. Transcribe once per model class: two structurally identical
+    but separately transcribed OCPs are distinct traced functions and
+    deliberately do not bucket.
+
+    Returns ``(groups, theta_batches, index_map)`` where ``index_map[g]``
+    lists each group member's position in ``specs`` (for scattering
+    results back to the fleet order).
+    """
+    buckets: dict = {}
+    order: list = []
+    for i, spec in enumerate(specs):
+        key = (
+            id(spec["ocp"]),
+            tuple(sorted(spec.get("couplings", {}).items())),
+            tuple(sorted(spec.get("exchanges", {}).items())),
+            spec.get("solver_options", SolverOptions()),
+            spec.get("warm_solver_options"),
+        )
+        if key not in buckets:
+            buckets[key] = {"spec": spec, "members": []}
+            order.append(key)
+        buckets[key]["members"].append(i)
+    groups, thetas, index_map = [], [], []
+    for key in order:
+        spec = buckets[key]["spec"]
+        members = buckets[key]["members"]
+        groups.append(AgentGroup(
+            name=spec.get("name", f"group{len(groups)}"),
+            ocp=spec["ocp"],
+            n_agents=len(members),
+            couplings=dict(spec.get("couplings", {})),
+            exchanges=dict(spec.get("exchanges", {})),
+            solver_options=spec.get("solver_options", SolverOptions()),
+            warm_solver_options=spec.get("warm_solver_options"),
+        ))
+        thetas.append(stack_params([specs[i]["theta"] for i in members]))
+        index_map.append(list(members))
+    return groups, thetas, index_map
+
+
+def pad_group_to_devices(group: AgentGroup, theta_batch: OCPParams,
+                         n_devices: int):
+    """Pad a group's agent axis up to a multiple of the mesh size.
+
+    Padding lanes repeat the last agent's parameters; the returned boolean
+    mask marks the real agents. Hand the mask to
+    ``FusedADMM(groups, options, active=masks)`` — padded lanes then solve
+    (uniform dense math) but contribute nothing to consensus/exchange
+    means, multipliers, residuals or the solver-health flag, so the result
+    equals the unpadded fleet while :meth:`FusedADMM.shard_args` can shard
+    the agent axis instead of replicating it.
+    """
+    n = group.n_agents
+    n_pad = (-n) % n_devices
+    mask = jnp.concatenate([jnp.ones((n,), bool), jnp.zeros((n_pad,), bool)])
+    if n_pad == 0:
+        return group, theta_batch, mask
+    padded = jax.tree.map(
+        lambda leaf: jnp.concatenate(
+            [leaf, jnp.repeat(leaf[-1:], n_pad, axis=0)], axis=0),
+        theta_batch)
+    new_group = dataclasses.replace(group, n_agents=n + n_pad)
+    return new_group, padded, mask

@@ -53,3 +53,78 @@ def plot_admm_consensus(data, variable: str, time_step: float, ax=None,
     ax.set_xlabel("time / s")
     ax.set_ylabel(variable)
     return ax
+
+
+def interpolate_colors(progress: float, colors: list) -> tuple:
+    """Linear interpolation along a list of RGB tuples (reference
+    ``utils/plotting/mpc.interpolate_colors``): ``progress`` in [0, 1]
+    walks from the first to the last color."""
+    progress = float(np.clip(progress, 0.0, 1.0))
+    if len(colors) == 1:
+        return tuple(colors[0])
+    span = progress * (len(colors) - 1)
+    i = min(int(span), len(colors) - 2)
+    frac = span - i
+    a, b = np.asarray(colors[i], float), np.asarray(colors[i + 1], float)
+    return tuple((1.0 - frac) * a + frac * b)
+
+
+#: red → dark grey → light grey prediction-age ramp (reference
+#: ``admm_consensus_shades.py`` uses EBCColors.red/dark_grey/light_grey)
+SHADE_RAMP = [(0.75, 0.11, 0.18), (0.35, 0.35, 0.35), (0.82, 0.82, 0.82)]
+
+
+def plot_consensus_shades(results: dict, variable: str,
+                          ax=None, plot_actual_values: bool = True,
+                          step: bool = False, style: Optional[Style] = None,
+                          final_iteration_only: bool = True):
+    """Closed-loop consensus evolution of one coupling across agents.
+
+    Functional counterpart of the reference's
+    ``utils/plotting/admm_consensus_shades.py``: every agent's local
+    trajectory of coupling ``variable`` is drawn for every control step,
+    colored along a red→grey age ramp (newest solve red), with the realized
+    first values as a solid line on top.
+
+    Args:
+        results: display label → (time, iteration, grid)-indexed ADMM
+            results frame of one agent (``ADMMModule.admm_results()`` /
+            ``analysis.load_admm``).
+        variable: coupling column (under the ``variable`` level).
+        final_iteration_only: plot only each step's converged (last)
+            iteration; False shades every iteration of every step.
+    """
+    if ax is None:
+        _, axes = make_fig(style)
+        ax = axes[0, 0]
+    drawstyle = "steps-post" if step else "default"
+    for df in results.values():
+        times = np.unique(np.asarray(df.index.get_level_values(0),
+                                     dtype=float))
+        n = len(times)
+        actual: dict[float, float] = {}
+        for i, t in enumerate(times):
+            color = interpolate_colors(1.0 - (i + 1) / n, SHADE_RAMP)
+            sl = admm_at_time_step(df, t)
+            iters = np.unique(np.asarray(
+                sl.index.get_level_values(0), dtype=float))
+            chosen = iters[-1:] if final_iteration_only else iters
+            series = None
+            for it in chosen:   # ends on iters[-1] either way
+                series = admm_at_time_step(df, t, variable=variable,
+                                           iteration=it).dropna()
+                alpha = 1.0 if final_iteration_only else \
+                    0.15 + 0.85 * (np.searchsorted(iters, it) + 1) / len(iters)
+                ax.plot(series.index, series.to_numpy(dtype=float),
+                        color=color, alpha=alpha, linewidth=0.9,
+                        drawstyle=drawstyle)
+            if series is not None and len(series):
+                actual[t] = float(series.iloc[0])
+        if plot_actual_values and actual:
+            keys = np.asarray(sorted(actual), dtype=float)
+            vals = np.asarray([actual[k] for k in keys], dtype=float)
+            ax.plot(keys, vals, color="black", linewidth=1.8,
+                    drawstyle=drawstyle)
+    ax.set_xlabel("time / s")
+    ax.set_ylabel(variable)
+    return ax

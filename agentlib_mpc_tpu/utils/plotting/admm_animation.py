@@ -1,0 +1,166 @@
+"""ADMM convergence animation: per-iteration frames of coupling
+trajectories.
+
+Counterpart of the reference's ``utils/plotting/admm_animation.py``: there,
+``make_image``/``make_animation`` drive a matplotlib ``FuncAnimation`` over
+the ADMM iterations of one control step, one line per agent, with an
+iteration annotation. Same public shape here — ``data`` maps a display
+label to an agent's iteration-indexed ADMM results (the ``(time,
+iteration, grid)`` MultiIndex frames from
+:meth:`modules.admm.ADMMModule.admm_results` / ``utils.analysis.load_admm``)
+— but the gif writer is matplotlib's built-in Pillow writer (no
+imagemagick system dependency), and frame data extraction is a plain
+function reused by both the still image and the animation paths.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu.utils.analysis import (
+    admm_at_time_step,
+    get_number_of_iterations,
+)
+from agentlib_mpc_tpu.utils.plotting.basic import Style, make_fig
+
+#: data: display label → iteration-indexed ADMM results of one agent
+Data = dict[str, "pd.DataFrame"]  # noqa: F821 - pandas imported lazily
+Customizer = Callable[["plt.Figure", "plt.Axes"],  # noqa: F821
+                      "tuple[plt.Figure, plt.Axes]"]  # noqa: F821
+
+
+def _iteration_series(data: Data, variable: Optional[str],
+                      time_step: float, iteration: int):
+    """label → (grid, values) of one iteration's trajectory.
+
+    ``data`` values may be full results frames (pass ``variable`` to pick
+    the coupling column) or pre-selected (time, iteration, grid)-indexed
+    Series — the reference's calling convention, which also covers agents
+    whose coupling columns have different local names."""
+    out = {}
+    for label, df in data.items():
+        var = variable if hasattr(df, "columns") else None
+        series = admm_at_time_step(df, time_step, variable=var,
+                                   iteration=iteration)
+        if hasattr(series, "columns"):      # frame without a variable pick
+            series = series.iloc[:, 0]
+        series = series.dropna()
+        out[label] = (np.asarray(series.index, dtype=float),
+                      series.to_numpy(dtype=float))
+    return out
+
+
+def _extract_frames(data: Data, variable: Optional[str], time_step: float,
+                    n_iter: int):
+    """All iterations' series, sliced from the MultiIndex frames ONCE and
+    shared by autoscaling and the draw callbacks."""
+    return [_iteration_series(data, variable, time_step, i)
+            for i in range(n_iter)]
+
+
+def _count_iterations(data: Data, time_step: float) -> int:
+    counts = []
+    for df in data.values():
+        per_time = get_number_of_iterations(df)
+        times = np.asarray(list(per_time), dtype=float)
+        t = times[int(np.argmin(np.abs(times - float(time_step))))]
+        counts.append(int(per_time[t]))
+    return min(counts)
+
+
+def _setup(data: Data, customize: Optional[Customizer], style):
+    import matplotlib.pyplot as plt  # noqa: F401 - backend via make_fig
+
+    fig, axes = make_fig(style)
+    ax = axes[0, 0]
+    if customize:
+        fig, ax = customize(fig, ax)
+    lines = {label: ax.plot([], [], lw=2, label=str(label))[0]
+             for label in data}
+    annotation = ax.annotate(
+        text="Iteration: 0", xy=(0.1, 0.1), xytext=(0.5, 1.05),
+        textcoords="axes fraction", xycoords="axes fraction", ha="center")
+    ax.legend(list(lines.values()), list(lines))
+    return fig, ax, lines, annotation
+
+
+def _draw_frame(lines, annotation, frames, i: int):
+    for label, (grid, vals) in frames[i].items():
+        lines[label].set_data(grid, vals)
+    annotation.set_text(f"Iteration: {i}")
+    return tuple(lines.values()) + (annotation,)
+
+
+def _autoscale(ax, frames):
+    """FuncAnimation with blitting never autoscales — fix limits from the
+    union of all frames."""
+    los, his, t_lo, t_hi = [], [], [], []
+    for frame in frames:
+        for grid, vals in frame.values():
+            if len(vals):
+                los.append(np.min(vals))
+                his.append(np.max(vals))
+                t_lo.append(np.min(grid))
+                t_hi.append(np.max(grid))
+    if los:
+        pad = 0.05 * max(max(his) - min(los), 1e-9)
+        ax.set_xlim(min(t_lo), max(t_hi))
+        ax.set_ylim(min(los) - pad, max(his) + pad)
+
+
+def make_image(data: Data, time_step: float = 0, file_name: str = "",
+               variable: Optional[str] = None,
+               customize: Optional[Customizer] = None,
+               iteration: int = -1, style: Optional[Style] = None):
+    """Still frame of ADMM iteration index ``iteration`` (negative counts
+    from the end; reference ``make_image``)."""
+    n_iter = _count_iterations(data, time_step)
+    if iteration < 0:
+        iteration = n_iter + iteration
+    frames = _extract_frames(data, variable, time_step, n_iter)
+    fig, ax, lines, annotation = _setup(data, customize, style)
+    _autoscale(ax, frames)
+    _draw_frame(lines, annotation, frames, iteration)
+    if file_name:
+        fig.savefig(fname=file_name)
+    return fig, ax
+
+
+def make_animation(data: Data, time_step: float = 0,
+                   file_name: str = "admm_convergence.gif",
+                   variable: Optional[str] = None,
+                   customize: Optional[Customizer] = None,
+                   iteration: Optional[int] = None, interval: int = 300,
+                   style: Optional[Style] = None):
+    """Animate the iterations of one control step into a ``.gif``
+    (reference ``make_animation``; Pillow writer instead of imagemagick).
+
+    ``iteration`` is the LAST iteration index to include (same semantics
+    as :func:`make_image`'s index argument; the frame set is 0..iteration);
+    ``None`` animates every recorded iteration of that step."""
+    from matplotlib.animation import FuncAnimation, PillowWriter
+
+    if not file_name.endswith(".gif"):
+        raise ValueError(
+            f"Target filename needs '.gif' extension. Given filename was "
+            f"{file_name}")
+    n_iter = (iteration + 1) if iteration is not None else \
+        _count_iterations(data, time_step)
+    frames = _extract_frames(data, variable, time_step, n_iter)
+    fig, ax, lines, annotation = _setup(data, customize, style)
+    _autoscale(ax, frames)
+
+    def animate(i):
+        return _draw_frame(lines, annotation, frames, i)
+
+    def init():
+        for line in lines.values():
+            line.set_data([], [])
+        return tuple(lines.values()) + (annotation,)
+
+    anim = FuncAnimation(fig, animate, init_func=init, frames=n_iter,
+                         interval=interval, blit=True, repeat_delay=1500)
+    anim.save(file_name, writer=PillowWriter(fps=max(1000 // interval, 1)))
+    return file_name

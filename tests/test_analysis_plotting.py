@@ -155,3 +155,93 @@ class TestPlotting:
         metrics = evaluate_ml_fit(m, X, y, plot=False)
         assert metrics["y"]["rmse"] == pytest.approx(0.0, abs=1e-9)
         assert metrics["y"]["r2"] == pytest.approx(1.0)
+
+
+class TestAdmmAnimation:
+    """Counterparts of the reference's admm_animation / consensus shades."""
+
+    def _two_agent_data(self):
+        return {"room": _admm_frame(), "cooler": _admm_frame()}
+
+    def test_make_image_renders_chosen_iteration(self, tmp_path):
+        from agentlib_mpc_tpu.utils.plotting.admm_animation import (
+            make_image,
+        )
+
+        out = tmp_path / "frame.png"
+        fig, ax = make_image(self._two_agent_data(), time_step=0.0,
+                             variable="mDot", file_name=str(out),
+                             iteration=-1)
+        assert out.exists() and out.stat().st_size > 0
+        # two agents -> two lines, last iteration values 0.03
+        lines = [ln for ln in ax.get_lines() if len(ln.get_ydata())]
+        assert len(lines) == 2
+        assert np.allclose(lines[0].get_ydata(), 0.03)
+
+    def test_make_animation_writes_gif(self, tmp_path):
+        from agentlib_mpc_tpu.utils.plotting.admm_animation import (
+            make_animation,
+        )
+
+        out = tmp_path / "conv.gif"
+        name = make_animation(self._two_agent_data(), time_step=0.0,
+                              variable="mDot", file_name=str(out),
+                              interval=50)
+        assert name == str(out)
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_animation_rejects_non_gif(self, tmp_path):
+        from agentlib_mpc_tpu.utils.plotting.admm_animation import (
+            make_animation,
+        )
+
+        with pytest.raises(ValueError, match="gif"):
+            make_animation(self._two_agent_data(), time_step=0.0,
+                           file_name=str(tmp_path / "anim.mp4"))
+
+    def test_consensus_shades_renders(self):
+        from agentlib_mpc_tpu.utils.plotting.admm import (
+            plot_consensus_shades,
+        )
+
+        ax = plot_consensus_shades({"room": _admm_frame()}, "mDot")
+        # 2 control steps (final iteration each) + 1 actual-values line
+        assert len(ax.get_lines()) == 3
+        matplotlib.pyplot.close("all")
+
+    def test_consensus_shades_all_iterations(self):
+        from agentlib_mpc_tpu.utils.plotting.admm import (
+            plot_consensus_shades,
+        )
+
+        ax = plot_consensus_shades({"room": _admm_frame()}, "mDot",
+                                   final_iteration_only=False)
+        # 2 steps x 3 iterations + actual line
+        assert len(ax.get_lines()) == 7
+        matplotlib.pyplot.close("all")
+
+    def test_interpolate_colors_endpoints(self):
+        from agentlib_mpc_tpu.utils.plotting.admm import (
+            SHADE_RAMP,
+            interpolate_colors,
+        )
+
+        assert interpolate_colors(0.0, SHADE_RAMP) == tuple(SHADE_RAMP[0])
+        assert interpolate_colors(1.0, SHADE_RAMP) == tuple(SHADE_RAMP[-1])
+        mid = interpolate_colors(0.5, SHADE_RAMP)
+        assert mid == tuple(SHADE_RAMP[1])
+
+    def test_make_image_accepts_preselected_series(self, tmp_path):
+        """Reference calling convention: per-label Series (covers agents
+        whose coupling columns have different local names)."""
+        from agentlib_mpc_tpu.utils.plotting.admm_animation import (
+            make_image,
+        )
+
+        frame = _admm_frame()
+        data = {"room": frame["mDot"], "cooler": frame["mDot"] * 2.0}
+        out = tmp_path / "series_frame.png"
+        fig, ax = make_image(data, time_step=0.0, file_name=str(out))
+        assert out.exists() and out.stat().st_size > 0
+        lines = [ln for ln in ax.get_lines() if len(ln.get_ydata())]
+        assert len(lines) == 2
