@@ -42,6 +42,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 try:  # pallas is TPU-oriented; keep import failures non-fatal off-TPU
     from jax.experimental import pallas as pl
@@ -235,6 +236,42 @@ def ldl_solve_ref(LD: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def _use_pallas() -> bool:
     return _HAS_PALLAS and jax.default_backend() == "tpu"
+
+
+_PROBE_RESULT: dict = {}
+
+
+def kkt_method_available() -> bool:
+    """Eagerly probe the Pallas LDLᵀ path on the current backend ONCE.
+
+    Safety net for environments where the TPU kernel cannot compile or
+    returns garbage (driver hardware differs from the CPU interpret-mode
+    tests): the solver's ``kkt_method="auto"`` consults this and falls
+    back to the pivoted-LU path instead of crashing the benchmark.
+    """
+    key = jax.default_backend()
+    if key in _PROBE_RESULT:
+        return _PROBE_RESULT[key]
+    if not _use_pallas():
+        _PROBE_RESULT[key] = False
+        return False
+    try:
+        n, m = 5, 2
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(n, n))
+        W = A @ A.T + 3 * np.eye(n)
+        Jg = rng.normal(size=(m, n))
+        K = np.block([[W, Jg.T], [Jg, -1e-6 * np.eye(m)]])
+        Kb = jnp.asarray(np.stack([K, K]), jnp.float32)
+        rhs = jnp.asarray(rng.normal(size=(2, n + m)), jnp.float32)
+        LD = _ldl_factor_batched(Kb)
+        x = _ldl_solve_batched(LD, rhs)
+        res = jnp.max(jnp.abs(jnp.einsum("bij,bj->bi", Kb, x) - rhs))
+        ok = bool(jnp.isfinite(res) and res < 1e-2)
+    except Exception:  # noqa: BLE001 - any compile/runtime failure
+        ok = False
+    _PROBE_RESULT[key] = ok
+    return ok
 
 
 @jax.custom_batching.custom_vmap

@@ -156,7 +156,9 @@ def _solve_kkt_lu(K, rhs):
 
 def _solve_kkt(K, rhs, method: str):
     if method == "auto":
-        method = "ldl" if jax.default_backend() == "tpu" else "lu"
+        # TPU → Pallas LDLᵀ, after a one-time eager probe that falls back
+        # to LU if the kernel cannot compile/run on this backend
+        method = "ldl" if kkt_ops.kkt_method_available() else "lu"
     if method == "ldl":
         return kkt_ops.solve_kkt_ldl(K, rhs)
     return _solve_kkt_lu(K, rhs)

@@ -154,6 +154,10 @@ class FusedADMM:
         self.options = options
         if active is None:
             active = [jnp.ones((g.n_agents,), bool) for g in self.groups]
+        if len(active) != len(self.groups):
+            raise ValueError(
+                f"active has {len(active)} masks for {len(self.groups)} "
+                f"groups — one (n_agents,) bool mask per group required")
         self.active = tuple(jnp.asarray(a, bool) for a in active)
         for g, a in zip(self.groups, self.active):
             if a.shape != (g.n_agents,):

@@ -1,0 +1,107 @@
+"""Container entry point: run one agent (or a local group) from a config
+file, joined to the fleet over MQTT.
+
+Counterpart of the reference's cloneMAP container entry
+(``DockerfileMPC:25`` → agentlib's clonemap communicator): each container
+hosts an agent process; inter-agent traffic rides an external broker.
+Configuration via environment:
+
+``AGENT_CONFIG``      path to a JSON agent config (reference shape:
+                      ``{"id": ..., "modules": [...]}``) or a JSON list of
+                      such configs (one container hosting a local group)
+``MQTT_HOST``/``MQTT_PORT``  broker address (default localhost:1883);
+                      set ``MQTT_HOST=none`` for an isolated container
+                      (single-agent simulation, no fleet)
+``RUN_UNTIL``         simulation/wall-clock horizon in seconds
+                      (default: run forever in wall-clock mode)
+``REALTIME``          "1" (default) wall-clock env; "0" fast simulation
+
+Usage: ``python -m agentlib_mpc_tpu.runtime.container``
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def load_configs(path: str) -> list[dict]:
+    with open(path) as fh:
+        cfg = json.load(fh)
+    return cfg if isinstance(cfg, list) else [cfg]
+
+
+def build_mas(configs: list[dict], realtime: bool = True,
+              mqtt_host: str | None = None, mqtt_port: int = 1883):
+    """LocalMAS over the configs; optionally bridged onto an MQTT broker
+    so other containers' agents appear as external peers."""
+    import agentlib_mpc_tpu.modules  # noqa: F401 - register module types
+    from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+    mas = LocalMAS(configs, env={"rt": realtime, "factor": 1.0})
+    buses = []
+    if mqtt_host and mqtt_host.lower() != "none":
+        from agentlib_mpc_tpu.runtime.mqtt import MqttBus
+
+        for agent_id, agent in mas.agents.items():
+            bus = MqttBus(agent_id, broker_host=mqtt_host,
+                          broker_port=mqtt_port)
+            bus.attach(agent.data_broker)
+            buses.append(bus)
+    return mas, buses
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    config_path = os.environ.get("AGENT_CONFIG")
+    if not config_path:
+        print("AGENT_CONFIG must point to a JSON agent config",
+              file=sys.stderr)
+        return 2
+    configs = load_configs(config_path)
+    realtime = os.environ.get("REALTIME", "1") != "0"
+    until_env = os.environ.get("RUN_UNTIL")
+    until = float(until_env) if until_env else (
+        float("inf") if realtime else 24 * 3600.0)
+    mas, buses = build_mas(
+        configs, realtime=realtime,
+        mqtt_host=os.environ.get("MQTT_HOST", "localhost"),
+        mqtt_port=int(os.environ.get("MQTT_PORT", "1883")))
+
+    stop = {"flag": False}
+
+    def _sig(_signum, _frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        if realtime:
+            # run in slices so SIGTERM can land between env.run calls —
+            # a finite wall-clock horizon must be interruptible too, or
+            # docker stop's grace period expires and SIGKILL skips the
+            # clean terminate()/close() below
+            t = 0.0
+            while not stop["flag"] and t < until:
+                t = min(t + 60.0, until)
+                mas.run(until=t)
+        else:
+            mas.run(until=until)
+    finally:
+        mas.terminate()
+        for bus in buses:
+            bus.close()
+    logger.info("container agent(s) %s shut down cleanly",
+                [c.get("id") for c in configs])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

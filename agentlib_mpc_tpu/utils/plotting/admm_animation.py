@@ -138,16 +138,26 @@ def make_animation(data: Data, time_step: float = 0,
     (reference ``make_animation``; Pillow writer instead of imagemagick).
 
     ``iteration`` is the LAST iteration index to include (same semantics
-    as :func:`make_image`'s index argument; the frame set is 0..iteration);
-    ``None`` animates every recorded iteration of that step."""
+    as :func:`make_image`'s index argument, negatives count from the end;
+    the frame set is 0..iteration); ``None`` animates every recorded
+    iteration of that step."""
     from matplotlib.animation import FuncAnimation, PillowWriter
 
     if not file_name.endswith(".gif"):
         raise ValueError(
             f"Target filename needs '.gif' extension. Given filename was "
             f"{file_name}")
-    n_iter = (iteration + 1) if iteration is not None else \
-        _count_iterations(data, time_step)
+    n_total = _count_iterations(data, time_step)
+    if iteration is None:
+        n_iter = n_total
+    else:
+        if iteration < 0:
+            iteration = n_total + iteration
+        n_iter = iteration + 1
+    if n_iter < 1:
+        raise ValueError(
+            f"iteration={iteration} selects no frames "
+            f"({n_total} iterations recorded)")
     frames = _extract_frames(data, variable, time_step, n_iter)
     fig, ax, lines, annotation = _setup(data, customize, style)
     _autoscale(ax, frames)

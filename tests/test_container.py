@@ -1,0 +1,66 @@
+"""Container entry point tests (deploy/Dockerfile CMD surface)."""
+
+import json
+
+import pytest
+
+from agentlib_mpc_tpu.runtime.container import build_mas, load_configs, main
+from test_mqtt import _FakeBrokerHub, _install_fake_paho
+
+AGENT = {
+    "id": "weather",
+    "modules": [
+        {"module_id": "com", "type": "local_broadcast"},
+        {"module_id": "src", "type": "data_source",
+         "data": {"T_amb": {0.0: 280.0, 3600.0: 290.0}},
+         "t_sample": 600.0},
+    ],
+}
+
+
+def test_load_configs_single_and_list(tmp_path):
+    p1 = tmp_path / "one.json"
+    p1.write_text(json.dumps(AGENT))
+    assert [c["id"] for c in load_configs(p1)] == ["weather"]
+    p2 = tmp_path / "two.json"
+    p2.write_text(json.dumps([AGENT, {**AGENT, "id": "weather2"}]))
+    assert [c["id"] for c in load_configs(p2)] == ["weather", "weather2"]
+
+
+def test_build_and_run_isolated():
+    mas, buses = build_mas([AGENT], realtime=False, mqtt_host="none")
+    assert buses == []
+    mas.run(until=1800.0)
+    mod = mas.agents["weather"].get_module("src")
+    # last replay tick at t=1800 -> linear interpolation of the table
+    assert abs(mod.get_value("T_amb") - (280.0 + 10.0 * 1800 / 3600)) < 1e-6
+    mas.terminate()
+
+
+def test_build_with_mqtt_bridge(monkeypatch):
+    hub = _FakeBrokerHub()
+    _install_fake_paho(monkeypatch, hub)
+    mas, buses = build_mas([AGENT], realtime=False,
+                           mqtt_host="broker.local", mqtt_port=1884)
+    assert len(buses) == 1
+    assert buses[0]._client.connected == ("broker.local", 1884)
+    mas.run(until=600.0)
+    mas.terminate()
+    for bus in buses:
+        bus.close()
+    assert buses[0]._client.loop_running is False
+
+
+def test_main_end_to_end(tmp_path, monkeypatch):
+    cfg = tmp_path / "agent.json"
+    cfg.write_text(json.dumps(AGENT))
+    monkeypatch.setenv("AGENT_CONFIG", str(cfg))
+    monkeypatch.setenv("MQTT_HOST", "none")
+    monkeypatch.setenv("REALTIME", "0")
+    monkeypatch.setenv("RUN_UNTIL", "1200")
+    assert main([]) == 0
+
+
+def test_main_requires_config(monkeypatch):
+    monkeypatch.delenv("AGENT_CONFIG", raising=False)
+    assert main([]) == 2

@@ -103,3 +103,12 @@ def test_solver_end_to_end_kkt_methods_agree(method):
     if len(objs) == 2:
         assert abs(objs["lu"] - objs["ldl"]) <= 1e-4 * (
             1.0 + abs(objs["lu"]))
+
+
+def test_kkt_method_probe_cpu_falls_back():
+    """On non-TPU backends the auto path must select LU (probe False),
+    and the probe result is cached."""
+    assert kkt.kkt_method_available() is False
+    assert kkt._PROBE_RESULT.get("cpu") is False
+    # cached second call
+    assert kkt.kkt_method_available() is False
