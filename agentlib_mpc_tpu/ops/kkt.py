@@ -325,6 +325,29 @@ def _ldl_solve_vmap(axis_size, in_batched, LD, b):
     return out.reshape(lead + bb.shape[-1:]), True
 
 
+def factor_kkt_ldl(K: jnp.ndarray):
+    """Equilibrate + factor once; returns an opaque factor for
+    :func:`resolve_kkt_ldl` (predictor-corrector steps re-solve with new
+    right-hand sides at one back-substitution each)."""
+    scale = 1.0 / jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(K), axis=1), 1e-12))
+    Ks = K * scale[:, None] * scale[None, :]
+    LD = ldl_factor(Ks)
+    return (LD, Ks, scale)
+
+
+def resolve_kkt_ldl(factor, rhs: jnp.ndarray,
+                    refine_steps: int = 2) -> jnp.ndarray:
+    """Solve with a stored factor + iterative refinement (f32-safe)."""
+    hi = jax.lax.Precision.HIGHEST
+    LD, Ks, scale = factor
+    rs = rhs * scale
+    x = ldl_solve(LD, rs)
+    for _ in range(refine_steps):
+        r = rs - jnp.matmul(Ks, x, precision=hi)
+        x = x + ldl_solve(LD, r)
+    return x * scale
+
+
 def solve_kkt_ldl(K: jnp.ndarray, rhs: jnp.ndarray,
                   refine_steps: int = 2) -> jnp.ndarray:
     """Equilibrated LDLᵀ solve with iterative refinement (f32-safe).
@@ -334,13 +357,4 @@ def solve_kkt_ldl(K: jnp.ndarray, rhs: jnp.ndarray,
     quasi-definite), refinement recovers f32 accuracy lost to the
     pivot-free factorization.
     """
-    hi = jax.lax.Precision.HIGHEST
-    scale = 1.0 / jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(K), axis=1), 1e-12))
-    Ks = K * scale[:, None] * scale[None, :]
-    rs = rhs * scale
-    LD = ldl_factor(Ks)
-    x = ldl_solve(LD, rs)
-    for _ in range(refine_steps):
-        r = rs - jnp.matmul(Ks, x, precision=hi)
-        x = x + ldl_solve(LD, r)
-    return x * scale
+    return resolve_kkt_ldl(factor_kkt_ldl(K), rhs, refine_steps)

@@ -127,3 +127,28 @@ def test_stats_fields():
     assert res.stats.iterations < OPTS.max_iter
     assert float(res.stats.kkt_error) <= OPTS.tol
     assert float(res.stats.constraint_violation) <= 1e-8
+
+
+def test_corrector_option_converges_to_same_solution():
+    """Mehrotra-style corrector (SolverOptions.corrector): same optimum,
+    tighter feasibility, factorization reused for the second solve."""
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+    from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    model = OneRoom(overrides={"s_T": 0.001, "r_mDot": 0.01})
+    ocp = transcribe(model, ["mDot"], N=6, dt=300.0,
+                     method="collocation", collocation_degree=2)
+    theta = ocp.default_params(x0=jnp.array([297.8]))
+    lb, ub = ocp.bounds(theta)
+    w0 = ocp.initial_guess(theta)
+    objs = {}
+    for corr in (False, True):
+        res = solve_nlp(ocp.nlp, w0, theta, lb, ub,
+                        SolverOptions(tol=1e-6, max_iter=80,
+                                      corrector=corr))
+        assert bool(res.stats.success)
+        objs[corr] = float(res.stats.objective)
+    assert abs(objs[False] - objs[True]) <= 1e-4 * (1 + abs(objs[False]))
