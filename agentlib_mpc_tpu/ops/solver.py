@@ -65,13 +65,19 @@ class NLPFunctions(NamedTuple):
 class SolverOptions(NamedTuple):
     max_iter: int = 100
     tol: float = 1e-6
-    #: secondary convergence criteria (IPOPT dual_inf_tol / constr_viol_tol /
-    #: compl_inf_tol semantics): when progress stalls — e.g. at the f32
-    #: precision floor — accept the point if feasibility and complementarity
-    #: are tight even though scaled stationarity exceeds `tol`
-    dual_inf_tol: float = 1.0
+    #: secondary convergence criteria (IPOPT acceptable_* semantics): when
+    #: progress stalls — the f32 precision floor, or a degenerate active
+    #: set pinning a control at its bound with a genuinely non-vanishing
+    #: stationarity residual — accept the point if feasibility and
+    #: complementarity are tight even though scaled stationarity exceeds
+    #: `tol`. IPOPT's acceptable_dual_inf_tol default is 1e10; 1e4 here
+    #: keeps the same practical behavior with a saner ceiling.
+    dual_inf_tol: float = 1.0e4
     constr_viol_tol: float = 1e-4
-    compl_inf_tol: float = 1e-4
+    #: IPOPT acceptable_compl_inf_tol default is 1e-2; a weakly-active
+    #: constraint (s ~ 1e-4, z ~ O(1)) legitimately parks its product
+    #: above a 1e-4 gate while the solution is fine
+    compl_inf_tol: float = 1e-2
     mu_init: float = 1e-1
     mu_linear_decrease: float = 0.2     # kappa_mu
     mu_superlinear_power: float = 1.5   # theta_mu
@@ -294,6 +300,11 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
 
     hess_l = jax.hessian(lagrangian, argnums=0)
 
+    # dtype-aware barrier floor: below ~100 eps the f32 barrier subproblem
+    # is noise-dominated and the line search stalls; the in-loop and
+    # post-loop acceptance gates both compare against this ONE definition
+    mu_floor = jnp.maximum(opts.tol / 10.0, 100.0 * eps)
+
     # ---- initial point -------------------------------------------------------
     span = jnp.maximum(ub - lb, 1e-8)
     push = opts.bound_push * jnp.minimum(1.0, span)
@@ -512,9 +523,6 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             (st.stall >= 2)
             & (viol_0 <= opts.constr_viol_tol)
             & (compl_mu <= opts.barrier_tol_factor * mu))
-        # dtype-aware barrier floor: below ~100 eps the f32 barrier
-        # subproblem is noise-dominated and the line search stalls
-        mu_floor = jnp.maximum(opts.tol / 10.0, 100.0 * eps)
         mu_n = jnp.where(
             shrink,
             jnp.maximum(mu_floor,
@@ -529,7 +537,12 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         improved = err_0 < 0.95 * st.best_err
         stall_n = jnp.where(improved, 0, st.stall + 1)
         best_n = jnp.minimum(st.best_err, err_0)
+        # barrier-progress guard: at large mu an interior point passes the
+        # loose complementarity gate trivially (s∘z ≈ mu ≤ 1e-2) — only
+        # accept once the barrier sits at its floor
+        mu_small = mu_n <= 2.0 * mu_floor
         acceptable = ((stall_n >= 4)
+                      & mu_small
                       & (dual_0 <= opts.dual_inf_tol)
                       & (viol_0 <= opts.constr_viol_tol)
                       & (compl_0 <= opts.compl_inf_tol))
@@ -560,7 +573,8 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
     err_f, viol_f, dual_f, compl_f = kkt_error(
         final.gf, final.Jg, final.Jh, final.gv, final.hv, final.s, final.y,
         final.z, final.zL, final.zU, final.w, 0.0)
-    final_acceptable = ((dual_f <= opts.dual_inf_tol)
+    final_acceptable = ((final.mu <= 2.0 * mu_floor)
+                        & (dual_f <= opts.dual_inf_tol)
                         & (viol_f <= opts.constr_viol_tol)
                         & (compl_f <= opts.compl_inf_tol))
     final = final._replace(done=final.done | final_acceptable)

@@ -132,10 +132,7 @@ def test_stats_fields():
 def test_corrector_option_converges_to_same_solution():
     """Mehrotra-style corrector (SolverOptions.corrector): same optimum,
     tighter feasibility, factorization reused for the second solve."""
-    import jax.numpy as jnp
-
     from agentlib_mpc_tpu.models.zoo import OneRoom
-    from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
     from agentlib_mpc_tpu.ops.transcription import transcribe
 
     model = OneRoom(overrides={"s_T": 0.001, "r_mDot": 0.01})
