@@ -299,3 +299,44 @@ def fit_linreg(X, y, dt: float = 1.0, inputs=None, output=None,
                             trainer_config=trainer_config,
                             coef=coef.tolist(),
                             intercept=intercept.tolist())
+
+
+def fit_keras_ann(X, y, X_val=None, y_val=None, dt: float = 1.0,
+                  inputs: dict[str, Feature] = None,
+                  output: dict[str, OutputFeature] = None,
+                  layers: tuple = (32, 32), activation: str = "tanh",
+                  epochs: int = 200, learning_rate: float = 1e-2,
+                  batch_size: int = 64, early_stopping_patience: int = 30,
+                  trainer_config: Optional[dict] = None):
+    """Train a Keras Sequential MLP and return a self-contained
+    :class:`~agentlib_mpc_tpu.ml.serialized.SerializedGraphANN`.
+
+    The reference's ANN trainer builds/fits a Keras model directly
+    (``ml_model_trainer.py:617-667``) and ships the Keras artifact; here
+    the trained model converts once through ``ml/keras_graph.from_keras``
+    so the resulting document needs neither keras nor tensorflow at
+    prediction time. Requires keras installed at TRAINING time only.
+    """
+    import keras
+
+    from agentlib_mpc_tpu.ml.serialized import SerializedGraphANN
+
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    model = keras.Sequential([keras.layers.Input(shape=(X.shape[1],))] + [
+        keras.layers.Dense(int(u), activation=activation) for u in layers
+    ] + [keras.layers.Dense(y.shape[1], activation="linear")])
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate),
+                  loss="mse")
+    callbacks = []
+    validation = None
+    if X_val is not None and len(np.asarray(X_val)):
+        validation = (np.asarray(X_val, dtype=np.float32),
+                      np.asarray(y_val, dtype=np.float32))
+        callbacks.append(keras.callbacks.EarlyStopping(
+            patience=early_stopping_patience, restore_best_weights=True))
+    model.fit(X, y, validation_data=validation, epochs=epochs,
+              batch_size=batch_size, verbose=0, callbacks=callbacks)
+    return SerializedGraphANN.from_keras(
+        model, dt=dt, inputs=inputs, output=output,
+        trainer_config=trainer_config)

@@ -261,3 +261,32 @@ class LinRegTrainer(MLModelTrainer):
             dt=self.step_size, inputs=self.input_features,
             output=self.output_features,
             trainer_config={"module_id": self.id, "type": "linreg_trainer"})
+
+
+@register_module("keras_ann_trainer")
+class KerasANNTrainer(MLModelTrainer):
+    """Keras-backed ANN trainer (the reference's actual trainer stack,
+    ``ml_model_trainer.py:617-667``): trains a Keras Sequential MLP and
+    broadcasts a self-contained GraphANN document (keras needed at
+    training time only; prediction is pure JAX via ``ml/keras_graph``)."""
+
+    model_type = "GraphANN"
+
+    def fit(self, data):
+        from agentlib_mpc_tpu.ml.training import fit_keras_ann
+
+        cfg = self.config
+        return fit_keras_ann(
+            data.training_inputs, data.training_outputs,
+            data.validation_inputs, data.validation_outputs,
+            dt=self.step_size, inputs=self.input_features,
+            output=self.output_features,
+            layers=tuple(cfg.get("layers", (32, 32))),
+            activation=cfg.get("activation", "tanh"),
+            epochs=int(cfg.get("epochs", 200)),
+            learning_rate=float(cfg.get("learning_rate", 1e-2)),
+            batch_size=int(cfg.get("batch_size", 64)),
+            early_stopping_patience=int(
+                cfg.get("early_stopping_patience", 30)),
+            trainer_config={"module_id": self.id,
+                            "type": "keras_ann_trainer"})

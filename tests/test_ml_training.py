@@ -234,3 +234,41 @@ class TestTrainingLoop:
         m = twin.model.serialized[twin.model._model_of_output["T"]]
         assert m.trainer_config is not None  # came from the trainer
         assert m.trainer_config["type"] == "linreg_trainer"
+
+
+def test_keras_ann_trainer_roundtrip():
+    """Train with keras, predict with the pure-JAX graph evaluator
+    (the reference's trainer stack end-to-end, ml_model_trainer.py:617-667)."""
+    pytest.importorskip("keras")
+    import numpy as np
+
+    from agentlib_mpc_tpu.ml.predictors import make_predictor
+    from agentlib_mpc_tpu.ml.serialized import (
+        Feature,
+        OutputFeature,
+        SerializedMLModel,
+    )
+    from agentlib_mpc_tpu.ml.training import fit_keras_ann
+
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-1, 1, size=(400, 2)).astype(np.float32)
+    y = (0.5 * X[:, :1] - 0.25 * X[:, 1:]).astype(np.float32)
+    ser = fit_keras_ann(
+        X[:320], y[:320], X[320:], y[320:], dt=60.0,
+        inputs={"a": Feature(name="a", lag=1),
+                "b": Feature(name="b", lag=1)},
+        output={"o": OutputFeature(name="o", lag=1,
+                                   output_type="absolute",
+                                   recursive=False)},
+        layers=(16,), epochs=300, learning_rate=5e-3)
+    # wire round-trip, then evaluate without keras in the loop
+    ser2 = SerializedMLModel.from_json(ser.to_json())
+    pred = make_predictor(ser2)
+    import jax.numpy as jnp
+
+    err = 0.0
+    for xi, yi in zip(X[:50], y[:50]):
+        err = max(err, abs(float(pred.apply(pred.params,
+                                            jnp.asarray(xi))[0])
+                           - float(yi[0])))
+    assert err < 0.1, f"keras-trained surrogate off by {err}"
