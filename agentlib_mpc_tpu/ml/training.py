@@ -322,7 +322,7 @@ def fit_keras_ann(X, y, X_val=None, y_val=None, dt: float = 1.0,
     from agentlib_mpc_tpu.ml.serialized import SerializedGraphANN
 
     X = np.asarray(X, dtype=np.float32)
-    y = np.asarray(y, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32).reshape(len(X), -1)
     model = keras.Sequential([keras.layers.Input(shape=(X.shape[1],))] + [
         keras.layers.Dense(int(u), activation=activation) for u in layers
     ] + [keras.layers.Dense(y.shape[1], activation="linear")])
@@ -330,9 +330,11 @@ def fit_keras_ann(X, y, X_val=None, y_val=None, dt: float = 1.0,
                   loss="mse")
     callbacks = []
     validation = None
-    if X_val is not None and len(np.asarray(X_val)):
-        validation = (np.asarray(X_val, dtype=np.float32),
-                      np.asarray(y_val, dtype=np.float32))
+    if (X_val is not None and y_val is not None
+            and len(np.asarray(X_val))):
+        X_val = np.asarray(X_val, dtype=np.float32)
+        validation = (X_val, np.asarray(
+            y_val, dtype=np.float32).reshape(len(X_val), -1))
         callbacks.append(keras.callbacks.EarlyStopping(
             patience=early_stopping_patience, restore_best_weights=True))
     model.fit(X, y, validation_data=validation, epochs=epochs,
