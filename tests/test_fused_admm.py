@@ -169,6 +169,7 @@ class TestRoomCoolerPair:
 
 
 class TestMeshSharding:
+    @pytest.mark.slow
     def test_sharded_step_matches_single_device(self, eight_devices):
         from jax.sharding import Mesh
 
@@ -269,6 +270,7 @@ class TestHeterogeneousFleet:
             float(np.mean(np.asarray(state_p.zbar["c"]))),
             np.mean(np.concatenate([targets_a, targets_b])), atol=1e-2)
 
+    @pytest.mark.slow
     def test_padded_unequal_groups_shard_on_mesh(self, eight_devices,
                                                  tracker_ocp):
         """Two unequal groups (5 + 3 agents) padded to a device mesh: the

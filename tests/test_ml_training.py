@@ -260,7 +260,7 @@ def test_keras_ann_trainer_roundtrip():
         output={"o": OutputFeature(name="o", lag=1,
                                    output_type="absolute",
                                    recursive=False)},
-        layers=(16,), epochs=300, learning_rate=5e-3)
+        layers=(16,), epochs=120, learning_rate=1e-2)
     # wire round-trip, then evaluate without keras in the loop
     ser2 = SerializedMLModel.from_json(ser.to_json())
     pred = make_predictor(ser2)
