@@ -323,3 +323,52 @@ class TestHeterogeneousFleet:
         assert bool(stats_p.converged)
         np.testing.assert_allclose(np.asarray(state_p.zbar["c"]), zbar_ref,
                                    atol=1e-4)
+
+
+class TwoChannelTracker(Model):
+    """Two independent controls: consensus on one, exchange on the other."""
+
+    inputs = [control_input("u1", 0.0, lb=-5.0, ub=5.0),
+              control_input("u2", 0.0, lb=-5.0, ub=5.0)]
+    parameters = [parameter("a", 1.0), parameter("b", 0.0)]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.objective = (SubObjective((v.u1 - v.a) ** 2, name="track1")
+                        + SubObjective((v.u2 - v.b) ** 2, name="track2"))
+        return eq
+
+
+class TestMixedCouplings:
+    """Consensus and exchange couplings active simultaneously in one
+    engine (the reference supports both per agent,
+    ``admm_datatypes.py:26-77``)."""
+
+    def test_consensus_and_exchange_together(self):
+        from agentlib_mpc_tpu.models.objective import SubObjective as _  # noqa: F401
+
+        ocp = transcribe(TwoChannelTracker(), ["u1", "u2"], N=N, dt=DT,
+                         method="multiple_shooting")
+        group = AgentGroup(
+            name="duo", ocp=ocp, n_agents=2,
+            couplings={"shared": "u1"}, exchanges={"balance": "u2"},
+            solver_options=SOLVER)
+        engine = FusedADMM(
+            [group],
+            FusedADMMOptions(max_iterations=60, rho=1.5, abs_tol=1e-6,
+                             rel_tol=1e-5))
+        thetas = stack_params([
+            ocp.default_params(p=jnp.array([1.0, 2.0])),
+            ocp.default_params(p=jnp.array([3.0, -1.0])),
+        ])
+        state = engine.init_state([thetas])
+        state, trajs, stats = engine.step(state, [thetas])
+        assert bool(stats.converged)
+        # consensus channel agrees on the mean of the a-targets
+        np.testing.assert_allclose(np.asarray(state.zbar["shared"]), 2.0,
+                                   atol=5e-3)
+        u = np.asarray(trajs[0]["u"])          # (2, N, 2)
+        np.testing.assert_allclose(u[0, :, 0], u[1, :, 0], atol=1e-2)
+        # exchange channel balances: sum u2 = 0, split b_i - mean(b)
+        np.testing.assert_allclose(u[:, :, 1].sum(axis=0), 0.0, atol=1e-2)
+        np.testing.assert_allclose(u[0, :, 1], 1.5, atol=1e-2)
