@@ -217,21 +217,27 @@ def solve_nlp(
     y0: jnp.ndarray | None = None,
     z0: jnp.ndarray | None = None,
     mu0: jnp.ndarray | None = None,
+    max_iter: jnp.ndarray | None = None,
 ) -> SolverResult:
     """Solve one NLP. Static in `nlp` and `options`; everything else traced,
     so the call vmaps over (w0, theta, bounds, warm-start duals). `mu0`
     optionally overrides options.mu_init with a traced value — warm-started
     MPC re-solves pass a small barrier (with their previous duals) without
-    triggering a recompile."""
+    triggering a recompile. `max_iter` likewise overrides
+    ``options.max_iter`` with a traced iteration budget: two-phase schemes
+    (a cold full-budget solve + short warm re-solves, e.g. inexact ADMM)
+    then share ONE solver trace/compilation instead of one per static
+    budget — Python tracing of this function is the warm-start latency
+    floor of the big fused programs (PERF.md)."""
     # KKT math needs true-f32 matmuls: TPU default precision would run them
     # as bf16 MXU passes and destroy Newton step accuracy
     with jax.default_matmul_precision("highest"):
         return _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
-                               mu0)
+                               mu0, max_iter)
 
 
 def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
-                    mu0_arg=None) -> SolverResult:
+                    mu0_arg=None, max_iter_arg=None) -> SolverResult:
     opts = options
     dtype = w0.dtype
     eps = jnp.finfo(dtype).eps
@@ -553,8 +559,11 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                         fv=fv_n, gf=gf_n, gv=gv_n, Jg=Jg_n, hv=hv_n,
                         Jh=Jh_n)
 
+    budget = jnp.asarray(opts.max_iter if max_iter_arg is None
+                         else max_iter_arg)
+
     def cond(st: _IPState):
-        return (~st.done) & (st.it < opts.max_iter)
+        return (~st.done) & (st.it < budget)
 
     err0, _, _, _ = kkt_error(gf_i, Jg_i, Jh_i, gv_i, hv_i, s_init, y_init,
                               z_init, zL_init, zU_init, w_init, 0.0)

@@ -288,8 +288,19 @@ class FusedADMM:
             or g.solver_options._replace(
                 max_iter=min(g.solver_options.max_iter, 6))
             for g in groups]
+        # When every group's warm options differ from its cold options only
+        # in the traced-overridable knobs (iteration budget, initial
+        # barrier), the cold and warm phases can share ONE solver call site
+        # inside the while_loop — a single interior-point trace/compilation
+        # instead of one per phase (Python tracing of the solver is the
+        # latency floor of the fused program, see PERF.md).
+        shared_trace = all(
+            warm_opts[gi]._replace(max_iter=0, mu_init=0.0)
+            == groups[gi].solver_options._replace(max_iter=0, mu_init=0.0)
+            for gi in range(n_groups))
 
-        def local_solves(gi, state: FusedState, theta_batch, opts, mu0):
+        def local_solves(gi, state: FusedState, theta_batch, opts, mu0,
+                         budget=None):
             """vmapped augmented solves of one group. Returns (w_batch,
             y_batch, z_batch, u_batch) with u on the control grid."""
             g = groups[gi]
@@ -328,7 +339,7 @@ class FusedADMM:
                 lb, ub = g.ocp.bounds(ocp_theta)
                 res = solve_nlp(group_nlps[gi], w_guess, (ocp_theta, aug),
                                 lb, ub, opts, y0=y_guess, z0=z_guess,
-                                mu0=mu0)
+                                mu0=mu0, max_iter=budget)
                 u = g.ocp.unflatten(res.w)["u"]
                 return res.w, res.y, res.z, u, res.stats.success
 
@@ -348,7 +359,12 @@ class FusedADMM:
         def step_fn(state: FusedState, theta_batches: tuple):
             max_it = opts.max_iterations
 
-            def make_iteration(cold: bool):
+            def make_iteration(cold: "bool | None"):
+              # cold=True/False: phase-specific static solver options (the
+              # fallback when warm_solver_options changes more than budget
+              # and barrier). cold=None: ONE shared body — the iteration
+              # budget and initial barrier are traced values selected by
+              # ``it == 0``, so both phases reuse a single solver trace.
               def iteration(carry):
                 (state, it, _res, prim_hist, dual_hist, rho_hist, done,
                  ok_hist) = carry
@@ -357,17 +373,26 @@ class FusedADMM:
                 w_new, y_new, z_new = [], [], []
                 ok_all = jnp.asarray(True)
                 for gi in range(n_groups):
-                    solver_opts = (groups[gi].solver_options if cold
-                                   else warm_opts[gi])
-                    # warm iterations restart the barrier small; an
-                    # explicitly supplied warm_solver_options wins
-                    mu0 = jnp.asarray(
-                        groups[gi].solver_options.mu_init if cold
-                        else (groups[gi].warm_solver_options.mu_init
-                              if groups[gi].warm_solver_options is not None
-                              else 1e-2))
+                    cold_opts = groups[gi].solver_options
+                    warm_mu = (groups[gi].warm_solver_options.mu_init
+                               if groups[gi].warm_solver_options is not None
+                               else 1e-2)
+                    if cold is None:
+                        solver_opts = cold_opts
+                        is_cold = it == 0
+                        # warm iterations restart the barrier small; an
+                        # explicitly supplied warm_solver_options wins
+                        mu0 = jnp.where(is_cold, cold_opts.mu_init, warm_mu)
+                        budget = jnp.where(is_cold, cold_opts.max_iter,
+                                           warm_opts[gi].max_iter)
+                    else:
+                        solver_opts = cold_opts if cold else warm_opts[gi]
+                        mu0 = jnp.asarray(
+                            cold_opts.mu_init if cold else warm_mu)
+                        budget = None
                     w_b, y_b, z_b, u_b, ok_b = local_solves(
-                        gi, state, theta_batches[gi], solver_opts, mu0)
+                        gi, state, theta_batches[gi], solver_opts, mu0,
+                        budget)
                     w_new.append(w_b)
                     y_new.append(y_b)
                     z_new.append(z_b)
@@ -469,12 +494,19 @@ class FusedADMM:
                      jnp.full((max_it,), jnp.nan), jnp.asarray(False),
                      jnp.asarray(True))
             # two-phase inexact ADMM: iteration 0 runs the full (cold)
-            # interior-point budget, the while_loop continues with the
-            # short warm budget — primal, duals and barrier all carry over
-            carry = make_iteration(cold=True)(carry)
-            (state, it, res, prim_hist, dual_hist, rho_hist, done,
-             ok_hist) = jax.lax.while_loop(
-                cond, make_iteration(cold=False), carry)
+            # interior-point budget, subsequent iterations the short warm
+            # budget — primal, duals and barrier all carry over
+            if shared_trace:
+                # one body, budgets selected inside by it == 0 (the cond
+                # admits the first iteration unconditionally: done=False)
+                (state, it, res, prim_hist, dual_hist, rho_hist, done,
+                 ok_hist) = jax.lax.while_loop(
+                    cond, make_iteration(cold=None), carry)
+            else:
+                carry = make_iteration(cold=True)(carry)
+                (state, it, res, prim_hist, dual_hist, rho_hist, done,
+                 ok_hist) = jax.lax.while_loop(
+                    cond, make_iteration(cold=False), carry)
 
             stats = IterationStats(
                 iterations=it, primal_residuals=prim_hist,

@@ -75,44 +75,49 @@ def build_step(n_agents: int = N_AGENTS):
     # interior-point budget; subsequent iterations are warm-started in
     # primal, duals AND barrier, so a short budget suffices — in a vmapped
     # while_loop wall time is the slowest lane's iteration count, so the
-    # static budget is the lever (measured 2.4x on this workload at equal
-    # final consensus error)
-    def make_vsolve(opts):
-        def local_solve(x0, load, w_guess, y_guess, z_guess, mu0,
-                        zbar, lam, rho):
-            theta = ocp.default_params(
-                x0=x0, d_traj=jnp.broadcast_to(
-                    jnp.array([load, 290.15, 294.15]), (HORIZON, 3)))
-            lb, ub = ocp.bounds(theta)
-            res = solve_nlp(nlp, w_guess, (theta, zbar, lam, rho), lb, ub,
-                            opts, y0=y_guess, z0=z_guess, mu0=mu0)
-            return res.w, res.y, res.z, ocp.unflatten(res.w)["u"]
+    # budget is the lever (measured 2.4x on this workload at equal final
+    # consensus error). The budget is a TRACED scalar (solve_nlp max_iter
+    # override), so the cold and warm phases share one solver trace — the
+    # Python-tracing floor of this program was 2 solver traces ≈ 7 s.
+    opts = SolverOptions(tol=1e-4, max_iter=10)
 
-        return jax.vmap(local_solve,
-                        in_axes=(0, 0, 0, 0, 0, None, None, 0, None))
+    def local_solve(x0, load, w_guess, y_guess, z_guess, mu0, budget,
+                    zbar, lam, rho):
+        theta = ocp.default_params(
+            x0=x0, d_traj=jnp.broadcast_to(
+                jnp.array([load, 290.15, 294.15]), (HORIZON, 3)))
+        lb, ub = ocp.bounds(theta)
+        res = solve_nlp(nlp, w_guess, (theta, zbar, lam, rho), lb, ub,
+                        opts, y0=y_guess, z0=z_guess, mu0=mu0,
+                        max_iter=budget)
+        return res.w, res.y, res.z, ocp.unflatten(res.w)["u"]
+
+    vsolve = jax.vmap(local_solve,
+                      in_axes=(0, 0, 0, 0, 0, None, None, None, 0, None))
 
     # budgets swept on this workload: cold=10/warm=3 is 3.8x the naive
     # 10x15 schedule at slightly *better* final consensus error (warm-start
-    # quality compounds across ADMM iterations)
-    v_cold = make_vsolve(SolverOptions(tol=1e-4, max_iter=10))
-    v_warm = make_vsolve(SolverOptions(tol=1e-4, max_iter=3))
+    # quality compounds across ADMM iterations). All ADMM_ITERS iterations
+    # run in ONE scan whose per-iteration (budget, mu0) are scanned-over
+    # values — a single solver call site means a single solver trace (the
+    # jit trace cache is trace-context-sensitive, so a separate cold call
+    # outside the loop would trace the whole interior-point method twice).
+    budgets = jnp.full((ADMM_ITERS,), 3).at[0].set(10)
+    mu0s = jnp.full((ADMM_ITERS,), 1e-2).at[0].set(0.1)
 
     def control_step(x0s, loads, w_gs, y_gs, z_gs, zbar, lams, rho):
-        w_gs, y_gs, z_gs, u = v_cold(x0s, loads, w_gs, y_gs, z_gs,
-                                     jnp.asarray(0.1), zbar, lams, rho)
-        zbar = jnp.mean(u, axis=0)
-        lams = lams + (u - zbar)
-
-        def admm_iter(_, carry):
+        def admm_iter(carry, x):
+            budget, mu0 = x
             w_gs, y_gs, z_gs, zbar, lams = carry
-            w_gs, y_gs, z_gs, u = v_warm(x0s, loads, w_gs, y_gs, z_gs,
-                                         jnp.asarray(1e-2), zbar, lams, rho)
+            w_gs, y_gs, z_gs, u = vsolve(x0s, loads, w_gs, y_gs, z_gs,
+                                         mu0, budget, zbar, lams, rho)
             zbar_new = jnp.mean(u, axis=0)
             lams_new = lams + (u - zbar_new)
-            return (w_gs, y_gs, z_gs, zbar_new, lams_new)
+            return (w_gs, y_gs, z_gs, zbar_new, lams_new), None
 
-        return jax.lax.fori_loop(0, ADMM_ITERS - 1, admm_iter,
-                                 (w_gs, y_gs, z_gs, zbar, lams))
+        carry, _ = jax.lax.scan(admm_iter, (w_gs, y_gs, z_gs, zbar, lams),
+                                (budgets, mu0s))
+        return carry
 
     theta0 = ocp.default_params()
     x0s = jnp.linspace(294.0, 300.0, n_agents).reshape(n_agents, 1)

@@ -71,6 +71,28 @@ class TestConsensusTrackers:
         u0 = np.asarray(trajs[0]["u"])  # (2, N, 1)
         np.testing.assert_allclose(u0[0], u0[1], atol=5e-3)
 
+    def test_explicit_warm_options_fallback_path(self, tracker_ocp):
+        """warm_solver_options differing beyond (max_iter, mu_init) forces
+        the static two-phase path (shared_trace=False) — pin it to the same
+        fixed point as the shared-trace default."""
+        group = AgentGroup(
+            name="trackers", ocp=tracker_ocp, n_agents=2,
+            couplings={"shared_u": "u"}, solver_options=SOLVER,
+            warm_solver_options=SOLVER._replace(tol=1e-6, max_iter=6))
+        engine = FusedADMM(
+            [group],
+            FusedADMMOptions(max_iterations=40, rho=2.0, abs_tol=1e-6,
+                             rel_tol=1e-5))
+        thetas = stack_params([
+            tracker_ocp.default_params(p=jnp.array([1.0])),
+            tracker_ocp.default_params(p=jnp.array([3.0])),
+        ])
+        state = engine.init_state([thetas])
+        state, trajs, stats = engine.step(state, [thetas])
+        assert bool(stats.converged)
+        np.testing.assert_allclose(
+            np.asarray(state.zbar["shared_u"]), 2.0, atol=1e-3)
+
     def test_residual_history_monotone_tail(self, tracker_ocp):
         group = AgentGroup(
             name="trackers", ocp=tracker_ocp, n_agents=3,
@@ -345,7 +367,6 @@ class TestMixedCouplings:
     ``admm_datatypes.py:26-77``)."""
 
     def test_consensus_and_exchange_together(self):
-        from agentlib_mpc_tpu.models.objective import SubObjective as _  # noqa: F401
 
         ocp = transcribe(TwoChannelTracker(), ["u1", "u2"], N=N, dt=DT,
                          method="multiple_shooting")
