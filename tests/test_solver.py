@@ -149,3 +149,24 @@ def test_corrector_option_converges_to_same_solution():
         assert bool(res.stats.success)
         objs[corr] = float(res.stats.objective)
     assert abs(objs[False] - objs[True]) <= 1e-4 * (1 + abs(objs[False]))
+
+
+def test_traced_max_iter_matches_static_budget():
+    """The traced max_iter override (the shared-trace budget knob used by
+    the two-phase ADMM schemes) must behave exactly like the same static
+    options.max_iter: identical iterate after an identical number of
+    interior-point iterations."""
+    nlp = NLPFunctions(
+        f=lambda w, t: (1 - w[0]) ** 2 + 100 * (w[1] - w[0] ** 2) ** 2,
+        g=_no_g, h=_no_h)
+    w0 = jnp.array([-1.2, 1.0])
+    lb, ub = -BIG * jnp.ones(2), BIG * jnp.ones(2)
+    for budget in (3, 8):
+        res_static = solve_nlp(nlp, w0, None, lb, ub,
+                               OPTS._replace(max_iter=budget))
+        res_traced = solve_nlp(nlp, w0, None, lb, ub, OPTS,
+                               max_iter=jnp.asarray(budget))
+        assert int(res_static.stats.iterations) == \
+            int(res_traced.stats.iterations) == budget
+        np.testing.assert_allclose(res_static.w, res_traced.w, rtol=0,
+                                   atol=0)
