@@ -99,6 +99,15 @@ class SolverOptions(NamedTuple):
     #: KKT linear solver: "auto" → Pallas LDLᵀ on TPU, LU elsewhere;
     #: "ldl" / "lu" force a path
     kkt_method: str = "auto"
+    #: evaluate the stacked value+Jacobian for ALL line-search candidates
+    #: inside the one batched trial call and select the accepted one,
+    #: instead of a separate fgh_and_jac pass at the accepted point.
+    #: Trades ``ls_samples``× more vjp-pullback FLOPs for one fewer
+    #: *sequential* model evaluation per iteration — a win on TPU where
+    #: the tiny-OCP iteration is kernel-latency-bound (PERF.md), a loss
+    #: on CPU where FLOPs dominate. "auto" resolves by backend at trace
+    #: time; "on"/"off" force it.
+    fused_ls_jacobian: str = "auto"
     #: Mehrotra-style second-order corrector: re-solve with the SAME
     #: factorization against complementarity targets corrected by the
     #: predictor's Δ∘Δ products (one extra back-substitution per
@@ -239,6 +248,15 @@ def solve_nlp(
 def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                     mu0_arg=None, max_iter_arg=None) -> SolverResult:
     opts = options
+    # resolved at trace time (Python): the latency/FLOP trade is a property
+    # of the backend the program is being built for
+    if opts.fused_ls_jacobian not in ("auto", "on", "off"):
+        raise ValueError(
+            f"fused_ls_jacobian must be 'auto', 'on' or 'off', got "
+            f"{opts.fused_ls_jacobian!r} (booleans are not accepted: use "
+            f"the strings)")
+    fused_ls = opts.fused_ls_jacobian == "on" or (
+        opts.fused_ls_jacobian == "auto" and jax.default_backend() == "tpu")
     dtype = w0.dtype
     eps = jnp.finfo(dtype).eps
     n = w0.shape[0]
@@ -471,7 +489,10 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         trial_w = w[None, :] + alphas[:, None] * dw[None, :]
         trial_s = s[None, :] + alphas[:, None] * ds[None, :] \
             if m_h else jnp.zeros((opts.ls_samples, 0), dtype)
-        trial_vals = jax.vmap(fgh)(trial_w)
+        if fused_ls:
+            trial_vals, trial_jacs = jax.vmap(fgh_and_jac)(trial_w)
+        else:
+            trial_vals = jax.vmap(fgh)(trial_w)
         phis = jax.vmap(
             lambda ww, ss, vv: merit_terms(ww, ss, vv[0], vv[1:1 + m_e],
                                            vv[1 + m_e:])
@@ -511,8 +532,17 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                             jnp.minimum(delta * 10.0 + 1e-6, opts.delta_max))
 
         # ---- refresh carried derivatives at the accepted point ---------------
-        # (w_n == w on rejection; the evaluation is still exact then)
-        vals_n, jac_n = fgh_and_jac(w_n)
+        if fused_ls:
+            # the accepted trial's values/Jacobian were already computed in
+            # the batched line-search call — select instead of re-evaluating
+            # (on rejection w_n == w: reuse the carried derivatives)
+            vals_prev = jnp.concatenate([st.fv[None], gv, hv])
+            jac_prev = jnp.concatenate([gf[None, :], Jg, Jh])
+            vals_n = jnp.where(accepted, trial_vals[first_ok], vals_prev)
+            jac_n = jnp.where(accepted, trial_jacs[first_ok], jac_prev)
+        else:
+            # (w_n == w on rejection; the evaluation is still exact then)
+            vals_n, jac_n = fgh_and_jac(w_n)
         fv_n, gf_n, gv_n, Jg_n, hv_n, Jh_n = split(vals_n, jac_n)
 
         # ---- barrier update --------------------------------------------------

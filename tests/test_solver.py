@@ -170,3 +170,23 @@ def test_traced_max_iter_matches_static_budget():
             int(res_traced.stats.iterations) == budget
         np.testing.assert_allclose(res_static.w, res_traced.w, rtol=0,
                                    atol=0)
+
+
+def test_fused_linesearch_jacobian_matches_default():
+    """fused_ls_jacobian="on" (the TPU latency path: Jacobians of all
+    line-search candidates in the one batched call) must walk the exact
+    same iterate sequence as the separate accepted-point evaluation."""
+    nlp = NLPFunctions(
+        f=lambda w, t: w[0] * w[3] * (w[0] + w[1] + w[2]) + w[2],
+        g=lambda w, t: jnp.array([jnp.sum(w**2) - 40.0]),
+        h=lambda w, t: jnp.array([w[0] * w[1] * w[2] * w[3] - 25.0]),
+    )
+    w0 = jnp.array([1.0, 5.0, 5.0, 1.0])
+    lb, ub = jnp.ones(4), 5.0 * jnp.ones(4)
+    res_off = solve_nlp(nlp, w0, None, lb, ub,
+                        OPTS._replace(fused_ls_jacobian="off"))
+    res_on = solve_nlp(nlp, w0, None, lb, ub,
+                       OPTS._replace(fused_ls_jacobian="on"))
+    assert bool(res_off.stats.success) and bool(res_on.stats.success)
+    assert int(res_off.stats.iterations) == int(res_on.stats.iterations)
+    np.testing.assert_allclose(res_off.w, res_on.w, atol=1e-9)
