@@ -3,3 +3,8 @@ from agentlib_mpc_tpu.parallel.fused_admm import (
     FusedADMM,
     FusedADMMOptions,
 )
+from agentlib_mpc_tpu.parallel.multihost import (
+    fleet_mesh,
+    host_local_batch,
+    initialize_multihost,
+)

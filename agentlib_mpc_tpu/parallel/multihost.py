@@ -1,0 +1,128 @@
+"""Multi-host execution of the fused data plane.
+
+The reference scales out by running one OS process per agent and wiring
+them over MQTT or cloneMAP containers (SURVEY §2.2/§2.9; reference
+``modules/dmpc/admm/admm.py``, ``DockerfileMPC``) — the *control plane*
+and the *data plane* are the same fabric. Here they are deliberately
+split:
+
+* **Control plane** (slow, robust): broker / TCP / MQTT messaging between
+  agent processes — ``runtime/broker.py``, ``runtime/multiprocessing_mas.py``,
+  ``runtime/mqtt.py``. Latency-tolerant, schema-stable JSON.
+* **Data plane** (fast): the fused ADMM round as ONE SPMD program over a
+  ``jax.sharding.Mesh`` (``parallel/fused_admm.py``). Consensus means
+  lower to XLA all-reduces that ride ICI within a host and DCN across
+  hosts — the TPU-native replacement for per-agent NCCL/MPI traffic.
+
+This module provides the two pieces a multi-host deployment needs on top
+of the single-controller API:
+
+* :func:`initialize_multihost` — env-var-aware wrapper over
+  ``jax.distributed.initialize`` (the JAX multi-controller runtime). A
+  no-op for single-process runs, so the same launch script works from a
+  laptop to a pod slice.
+* :func:`fleet_mesh` — the 1-D "agents" mesh over all global devices.
+  ``jax.devices()`` orders devices process-major, so consecutive mesh
+  positions sit on the same host wherever possible: XLA's hierarchical
+  all-reduce then reduces over ICI first and crosses DCN once per host
+  pair, not once per chip pair (the "ride ICI, not DCN" rule of the
+  scaling playbook).
+
+Typical multi-host launch (same script on every host)::
+
+    from agentlib_mpc_tpu.parallel import multihost
+
+    multihost.initialize_multihost()          # reads JAX_COORDINATOR etc.
+    mesh = multihost.fleet_mesh()
+    engine = FusedADMM(groups, options)
+    state, thetas = engine.shard_args(mesh, engine.init_state(thetas),
+                                      thetas)
+    state, trajs, stats = engine.step(state, thetas)
+
+Every process executes the same jitted step; XLA inserts the cross-host
+collectives. There is no coordinator process in the data plane — the
+ADMM "coordinator" of the reference's star topology becomes a mean
+(all-reduce) inside the program.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import Mesh
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the JAX multi-controller runtime if configured.
+
+    Resolution order: explicit arguments, then the standard environment
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``, as set by most TPU pod launchers). When neither
+    is present this is a single-process run and the call is a no-op —
+    the same entry point works unmodified on one host.
+
+    Returns True when the distributed runtime was (already) initialized.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single-process: nothing to initialize
+
+    # NOTE: nothing here may touch the backend (jax.devices(),
+    # jax.process_count(), ...) before initialize() — that would
+    # initialize XLA and make distributed init impossible. Idempotence is
+    # handled by catching initialize()'s own already-initialized error;
+    # a "must be called before any JAX calls" error is a real caller bug
+    # and propagates.
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as exc:
+        msg = str(exc).lower()
+        if "once" not in msg and "already" not in msg:
+            raise
+    return True
+
+
+def fleet_mesh(axis: str = "agents", devices=None) -> Mesh:
+    """1-D mesh over all global devices for agent-axis sharding.
+
+    ``jax.devices()`` is process-major (all of host 0's chips, then host
+    1's, ...), so sharding a contiguous agent batch over this mesh keeps
+    each host's shard local and lets XLA's hierarchical collectives
+    reduce over ICI before touching DCN. Pass ``devices`` to sub-select
+    (e.g. an 8-device virtual CPU mesh in tests).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return Mesh(devices, (axis,))
+
+
+def host_local_batch(n_agents_global: int) -> tuple[int, int]:
+    """(start, count) of this process's slice of a global agent batch.
+
+    For data loading in multi-controller runs: each process materializes
+    only its own shard of the per-agent parameter batch (``jax.device_put``
+    with a :func:`fleet_mesh` sharding then forms the global array from
+    the per-host pieces via ``jax.make_array_from_process_local_data``).
+    Agents are dealt contiguously, remainder to the low process ids —
+    matching the layout :func:`fleet_mesh` induces.
+    """
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    base, extra = divmod(n_agents_global, n_proc)
+    count = base + (1 if pid < extra else 0)
+    start = pid * base + min(pid, extra)
+    return start, count
