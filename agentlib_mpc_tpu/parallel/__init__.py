@@ -8,3 +8,13 @@ from agentlib_mpc_tpu.parallel.multihost import (
     host_local_batch,
     initialize_multihost,
 )
+
+
+def __getattr__(name):
+    # config_bridge pulls in the backend layer; import lazily so
+    # `parallel` stays light for solver-only users
+    if name == "FusedFleet":
+        from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet
+
+        return FusedFleet
+    raise AttributeError(name)

@@ -1,0 +1,312 @@
+"""Reference-shaped agent configs → the fused SPMD data plane.
+
+The module world (`modules/admm.py`, reference
+``modules/dmpc/admm/admm.py``) runs one agent per config over the
+message broker — right for field deployment, wasteful for cluster
+simulation of a large fleet. This bridge takes the SAME agent configs an
+``admm_local`` MAS consumes and compiles the whole fleet into one
+:class:`~agentlib_mpc_tpu.parallel.fused_admm.FusedADMM` program: every
+agent's local solve, the consensus updates and the convergence test in a
+single jitted step over a device mesh (docs/DISTRIBUTED.md, "data
+plane").
+
+Scope: input couplings (the coupling variable is a control input of the
+agent's model — the reference 4-room topologies). Output-expression
+couplings (e.g. a coupling alias bound to a model *output*) need the
+expression machinery of ``backends/admm_backend.py`` and stay on the
+module path; the bridge raises a pointed error for them rather than
+silently mis-modelling.
+
+Typical use::
+
+    from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet
+
+    fleet = FusedFleet.from_configs(configs)       # admm_local configs
+    out = fleet.step()                             # one coordinated round
+    u0 = out["Room_3"]["u"]["mDot"][0]             # first control move
+    fleet.update_agent("Room_3", x0=[296.2])       # plant feedback
+    out = fleet.step()                             # warm-started next round
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.backends.backend import load_model
+from agentlib_mpc_tpu.backends.mpc_backend import (
+    solver_options_from_config,
+    transcription_kwargs_from_config,
+)
+from agentlib_mpc_tpu.models.model import Model
+from agentlib_mpc_tpu.ops.transcription import TranscribedOCP, transcribe
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    FusedADMM,
+    FusedADMMOptions,
+    bucket_agents,
+    stack_params,
+)
+
+#: module types whose config block the bridge understands
+_ADMM_TYPES = ("admm_local", "admm", "admm_coordinated")
+
+
+@dataclasses.dataclass
+class _FleetAgent:
+    agent_id: str
+    model: Model
+    ocp: TranscribedOCP
+    couplings: dict[str, str]          # alias -> control input name
+    exchanges: dict[str, str]
+    solver_options: Any
+    x0: np.ndarray                     # (n_diff,)
+    p: np.ndarray                      # (n_params,)
+    exo: dict[str, float]              # constant disturbance values
+    u_bounds: dict[str, tuple[float | None, float | None]]
+
+    def theta(self, N: int):
+        ocp = self.ocp
+        d = None
+        if ocp.exo_names:
+            d = jnp.broadcast_to(
+                jnp.array([self.exo[n] for n in ocp.exo_names]),
+                (N, len(ocp.exo_names)))
+        kw: dict[str, Any] = {"x0": jnp.asarray(self.x0),
+                              "p": jnp.asarray(self.p)}
+        if d is not None:
+            kw["d_traj"] = d
+        theta = ocp.default_params(**kw)
+        # config-level lb/ub on couplings/controls override the model's
+        if self.u_bounds:
+            u_lb = np.asarray(theta.u_lb).copy()
+            u_ub = np.asarray(theta.u_ub).copy()
+            for name, (lb, ub) in self.u_bounds.items():
+                j = ocp.control_names.index(name)
+                if lb is not None:
+                    u_lb[:, j] = lb
+                if ub is not None:
+                    u_ub[:, j] = ub
+            theta = theta._replace(u_lb=jnp.asarray(u_lb),
+                                   u_ub=jnp.asarray(u_ub))
+        return theta
+
+
+def _find_admm_module(agent_cfg: Mapping) -> Mapping | None:
+    for m in agent_cfg.get("modules", []):
+        if m.get("type") in _ADMM_TYPES:
+            return m
+    return None
+
+
+def _values(entries) -> dict[str, float]:
+    return {e["name"]: e["value"] for e in (entries or []) if "value" in e}
+
+
+class FusedFleet:
+    """A fleet of config-defined ADMM agents as one fused engine.
+
+    Build with :meth:`from_configs`; drive with :meth:`step` /
+    :meth:`update_agent`. State (consensus means, multipliers, warm
+    starts) persists across steps and is shift-warm-started by
+    :meth:`advance` between control intervals.
+    """
+
+    def __init__(self, agents: Sequence[_FleetAgent], N: int,
+                 options: FusedADMMOptions):
+        self._agents = list(agents)
+        self.N = N
+        specs = [
+            {"ocp": a.ocp, "theta": a.theta(N), "couplings": a.couplings,
+             "exchanges": a.exchanges, "name": a.agent_id,
+             "solver_options": a.solver_options}
+            for a in self._agents
+        ]
+        groups, theta_batches, index_map = bucket_agents(specs)
+        self.engine = FusedADMM(groups, options)
+        self._theta_batches = list(theta_batches)
+        self._index_map = index_map
+        # agent_id -> (group index, position in the group batch)
+        self._where: dict[str, tuple[int, int]] = {}
+        for gi, members in enumerate(index_map):
+            for slot, spec_idx in enumerate(members):
+                self._where[self._agents[spec_idx].agent_id] = (gi, slot)
+        self.state = self.engine.init_state(self._theta_batches)
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def from_configs(cls, configs: Sequence[Mapping],
+                     options: FusedADMMOptions | None = None,
+                     ) -> "FusedFleet":
+        """Parse ``admm_local``-style agent configs into a fused fleet.
+
+        Agents whose configs share model class, horizon, discretization
+        and solver options batch into one vmapped group automatically
+        (one transcription per structure). Configs without an ADMM module
+        (e.g. simulator agents) are skipped — the bridge is the optimizer
+        fleet; plants stay outside, feeding back via
+        :meth:`update_agent`.
+        """
+        agents: list[_FleetAgent] = []
+        ocp_cache: dict[tuple, TranscribedOCP] = {}
+        N_ref: int | None = None
+        rho = None
+        max_iterations = None
+        for cfg in configs:
+            m = _find_admm_module(cfg)
+            if m is None:
+                continue
+            backend = m.get("optimization_backend") or {}
+            model = load_model(backend.get("model", {}))
+            N = int(m.get("prediction_horizon", 10))
+            dt = float(m.get("time_step", 300.0))
+            if N_ref is None:
+                N_ref = N
+            elif N != N_ref:
+                raise ValueError(
+                    f"fused fleet needs one shared horizon: agent "
+                    f"{cfg.get('id')} has N={N}, fleet has N={N_ref}")
+            for attr, current in (("penalty_factor", rho),
+                                  ("max_iterations", max_iterations)):
+                val = m.get(attr)
+                if val is not None and current is not None and \
+                        val != current:
+                    raise ValueError(
+                        f"fused fleet needs one shared {attr}: agent "
+                        f"{cfg.get('id')} has {val}, fleet has {current}")
+            rho = m.get("penalty_factor", rho)
+            max_iterations = m.get("max_iterations", max_iterations)
+
+            couplings, exchanges, u_bounds = {}, {}, {}
+            control_names = [e["name"] for e in m.get("controls", [])]
+            def _merge_bounds(e):
+                old = u_bounds.get(e["name"], (None, None))
+                u_bounds[e["name"]] = (e.get("lb", old[0]),
+                                       e.get("ub", old[1]))
+
+            for e in m.get("controls", []):
+                if "lb" in e or "ub" in e:
+                    _merge_bounds(e)
+            model_controls = {v.name for v in model.inputs}
+            for kind, target in (("couplings", couplings),
+                                 ("exchange", exchanges)):
+                for e in m.get(kind, []):
+                    name, alias = e["name"], e.get("alias", e["name"])
+                    if name not in model_controls:
+                        raise NotImplementedError(
+                            f"agent {cfg.get('id')}: coupling '{name}' is "
+                            f"not a control input of "
+                            f"{type(model).__name__} — output-expression "
+                            f"couplings run on the module path "
+                            f"(modules/admm.py), not the fused bridge")
+                    target[alias] = name
+                    if name not in control_names:
+                        control_names.append(name)
+                    if "lb" in e or "ub" in e:
+                        _merge_bounds(e)
+
+            trans_kwargs = transcription_kwargs_from_config(
+                backend.get("discretization_options"))
+            key = (type(model), tuple(control_names), N, dt,
+                   tuple(sorted(trans_kwargs.items())))
+            if key not in ocp_cache:
+                ocp_cache[key] = transcribe(model, control_names, N=N,
+                                            dt=dt, **trans_kwargs)
+            ocp = ocp_cache[key]
+
+            state_vals = _values(m.get("states"))
+            x0 = np.array([
+                state_vals.get(n, model.get_var(n).value)
+                for n in model.diff_state_names], dtype=float)
+            param_vals = _values(m.get("parameters"))
+            p = np.array([
+                param_vals.get(v.name, v.value) for v in model.parameters],
+                dtype=float)
+            input_vals = _values(m.get("inputs"))
+            exo = {n: float(input_vals.get(n, model.get_var(n).value))
+                   for n in ocp.exo_names}
+
+            agents.append(_FleetAgent(
+                agent_id=str(cfg.get("id", f"agent{len(agents)}")),
+                model=model, ocp=ocp, couplings=couplings,
+                exchanges=exchanges,
+                solver_options=solver_options_from_config(
+                    backend.get("solver")),
+                x0=x0, p=p, exo=exo, u_bounds=u_bounds))
+
+        if not agents:
+            raise ValueError("no ADMM modules found in the given configs")
+        if options is None:
+            options = FusedADMMOptions(
+                max_iterations=int(max_iterations or 10),
+                rho=float(rho if rho is not None else 10.0))
+        return cls(agents, N_ref, options)
+
+    # -- runtime --------------------------------------------------------------
+
+    def update_agent(self, agent_id: str, x0=None, inputs=None,
+                     parameters=None) -> None:
+        """Feed plant state / disturbance / parameter updates back into an
+        agent before the next :meth:`step` (the module path receives these
+        over the broker; the bridge takes them directly)."""
+        a = self._agents_by_id()[agent_id]
+        if x0 is not None:
+            a.x0 = np.asarray(x0, dtype=float)
+        for name, val in (inputs or {}).items():
+            if name not in a.exo:
+                raise KeyError(
+                    f"{agent_id}: '{name}' is not an exogenous input of "
+                    f"its OCP (has: {sorted(a.exo)}) — controls and "
+                    f"couplings are decided by the solver, not fed back")
+            a.exo[name] = float(val)
+        if parameters is not None:
+            byname = {v.name: i for i, v in enumerate(a.model.parameters)}
+            for name, val in parameters.items():
+                a.p[byname[name]] = float(val)
+        gi, slot = self._where[agent_id]
+        theta = a.theta(self.N)
+        import jax
+
+        self._theta_batches[gi] = jax.tree.map(
+            lambda batch, leaf: batch.at[slot].set(leaf),
+            self._theta_batches[gi], theta)
+
+    def step(self) -> dict[str, dict]:
+        """One coordinated ADMM round for the whole fleet.
+
+        Returns per-agent results: ``{"u": {name: (N,) array}, "x": ...,
+        "converged": bool, "iterations": int}``.
+        """
+        self.state, trajs, stats = self.engine.step(
+            self.state, self._theta_batches)
+        out: dict[str, dict] = {}
+        for a in self._agents:
+            gi, slot = self._where[a.agent_id]
+            tr = trajs[gi]
+            u = np.asarray(tr["u"])[slot]          # (N, n_u)
+            res = {
+                "u": {n: u[:, j]
+                      for j, n in enumerate(a.ocp.control_names)},
+                "converged": bool(stats.converged),
+                "iterations": int(stats.iterations),
+            }
+            if "x" in tr:
+                res["x"] = np.asarray(tr["x"])[slot]
+            out[a.agent_id] = res
+        self._last_stats = stats
+        return out
+
+    def advance(self) -> None:
+        """Shift-by-one warm start between control intervals
+        (``shift_state``; reference ``_shift_coupling_variables``)."""
+        self.state = self.engine.shift_state(self.state)
+
+    @property
+    def last_stats(self):
+        return getattr(self, "_last_stats", None)
+
+    def _agents_by_id(self) -> dict[str, _FleetAgent]:
+        return {a.agent_id: a for a in self._agents}

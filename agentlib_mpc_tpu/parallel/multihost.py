@@ -114,15 +114,28 @@ def host_local_batch(n_agents_global: int) -> tuple[int, int]:
     """(start, count) of this process's slice of a global agent batch.
 
     For data loading in multi-controller runs: each process materializes
-    only its own shard of the per-agent parameter batch (``jax.device_put``
-    with a :func:`fleet_mesh` sharding then forms the global array from
-    the per-host pieces via ``jax.make_array_from_process_local_data``).
-    Agents are dealt contiguously, remainder to the low process ids —
-    matching the layout :func:`fleet_mesh` induces.
+    only its own shard of the per-agent parameter batch
+    (``jax.make_array_from_process_local_data`` with a :func:`fleet_mesh`
+    sharding then forms the global array from the per-host pieces).
+
+    The agent axis must divide the global device count — that is the
+    layout a 1-D ``NamedSharding`` accepts (uneven axes are rejected by
+    JAX). Pad uneven fleets first
+    (:func:`agentlib_mpc_tpu.parallel.fused_admm.pad_group_to_devices`);
+    the slice is then device-granular and exactly matches where
+    :func:`fleet_mesh` places the rows.
     """
-    n_proc = jax.process_count()
-    pid = jax.process_index()
-    base, extra = divmod(n_agents_global, n_proc)
-    count = base + (1 if pid < extra else 0)
-    start = pid * base + min(pid, extra)
-    return start, count
+    n_dev = len(jax.devices())
+    if n_agents_global % n_dev:
+        raise ValueError(
+            f"n_agents={n_agents_global} does not divide the "
+            f"{n_dev}-device fleet mesh; pad the batch first "
+            f"(parallel.fused_admm.pad_group_to_devices)")
+    per_dev = n_agents_global // n_dev
+    local = jax.local_device_count() * per_dev
+    # jax.devices() is process-major, so this process's rows start after
+    # the devices of all lower process ids
+    start = sum(
+        per_dev for d in jax.devices() if d.process_index <
+        jax.process_index())
+    return start, local

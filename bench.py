@@ -42,7 +42,7 @@ DT = 300.0
 SCALING_SIZES = (4, 16, 64, 256)
 
 
-def build_step(n_agents: int = N_AGENTS):
+def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -79,7 +79,8 @@ def build_step(n_agents: int = N_AGENTS):
     # consensus error). The budget is a TRACED scalar (solve_nlp max_iter
     # override), so the cold and warm phases share one solver trace — the
     # Python-tracing floor of this program was 2 solver traces ≈ 7 s.
-    opts = SolverOptions(tol=1e-4, max_iter=10)
+    opts = SolverOptions(tol=1e-4, max_iter=10,
+                         **(solver_overrides or {}))
 
     def local_solve(x0, load, w_guess, y_guess, z_guess, mu0, budget,
                     zbar, lam, rho):
@@ -132,10 +133,11 @@ def build_step(n_agents: int = N_AGENTS):
     return jax.jit(control_step), args
 
 
-def measure(n_agents: int = N_AGENTS) -> dict:
+def measure(n_agents: int = N_AGENTS,
+            solver_overrides: dict | None = None) -> dict:
     import jax
 
-    step, args = build_step(n_agents)
+    step, args = build_step(n_agents, solver_overrides)
     t0 = time.perf_counter()
     out = step(*args)
     jax.block_until_ready(out)
@@ -196,6 +198,19 @@ def main() -> None:
 
     if "--scaling" in sys.argv:
         run_scaling()
+        return
+
+    if "--ab" in sys.argv:
+        # A/B the per-iteration latency knobs on the current backend
+        # (used to validate SolverOptions defaults on real TPU hardware)
+        for label, ov in (("fused_ls=off", {"fused_ls_jacobian": "off"}),
+                          ("fused_ls=on", {"fused_ls_jacobian": "on"})):
+            res = measure(N_AGENTS, ov)
+            print(json.dumps({
+                "metric": f"admm256_step_ms[{label}]",
+                "value": round(res["step_ms"], 2), "unit": "ms",
+                "compile_ms": round(res["compile_ms"]),
+                "platform": res["platform"]}))
         return
 
     res = measure()

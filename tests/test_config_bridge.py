@@ -1,0 +1,133 @@
+"""Config→fused-engine bridge (parallel/config_bridge.py).
+
+The bridge compiles reference-shaped ``admm_local`` agent configs into
+one FusedADMM program: same config dialect as the module path
+(`modules/admm.py`), data-plane execution (docs/DISTRIBUTED.md).
+"""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.models.zoo import CooledRoom
+from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet
+from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+
+UB = 295.15
+START = 298.16
+
+
+def _room_cfg(i: int, load: float, alias: str = "mDotShared") -> dict:
+    return {
+        "id": f"Room_{i}",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "admm", "type": "admm_local",
+             "optimization_backend": {
+                 "type": "jax_admm",
+                 "model": {"class": CooledRoom},
+                 "discretization_options": {"collocation_order": 2,
+                                            "collocation_method": "legendre"},
+                 "solver": {"max_iter": 30},
+             },
+             "time_step": 300.0,
+             "prediction_horizon": 6,
+             "max_iterations": 8,
+             "penalty_factor": 20.0,
+             "parameters": [{"name": "s_T", "value": 1.0}],
+             "inputs": [
+                 {"name": "load", "value": load},
+                 {"name": "T_in", "value": 290.15},
+                 {"name": "T_upper", "value": UB},
+             ],
+             "states": [{"name": "T", "value": START}],
+             "couplings": [
+                 {"name": "mDot", "alias": alias, "value": 0.02,
+                  "lb": 0.0, "ub": 0.05},
+             ]},
+        ],
+    }
+
+
+def _sim_cfg() -> dict:
+    return {
+        "id": "Simulation",
+        "modules": [
+            {"module_id": "sim", "type": "simulator",
+             "model": {"class": CooledRoom}, "t_sample": 60},
+        ],
+    }
+
+
+class TestFromConfigs:
+    def test_identical_agents_bucket_into_one_group(self):
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 80.0 + 30 * i) for i in range(4)] + [_sim_cfg()])
+        assert len(fleet.engine.groups) == 1
+        assert fleet.engine.groups[0].n_agents == 4
+        # module-level knobs made it into the engine options
+        assert fleet.engine.options.max_iterations == 8
+        assert float(np.asarray(fleet.state.rho)) == 20.0
+
+    def test_step_reaches_consensus_and_cools(self):
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 80.0 + 30 * i) for i in range(4)])
+        out = fleet.step()
+        assert set(out) == {f"Room_{i}" for i in range(4)}
+        u = np.stack([out[f"Room_{i}"]["u"]["mDot"] for i in range(4)])
+        # consensus: all rooms agree on the shared trajectory
+        assert np.max(np.abs(u - u.mean(axis=0))) < 5e-3
+        # warm rooms request cooling air within bounds
+        assert u.max() <= 0.05 + 1e-6 and u[:, 0].mean() > 1e-3
+        # temperatures head down across the horizon
+        x = out["Room_3"]["x"]
+        assert x[-1, 0] < x[0, 0]
+
+    def test_update_agent_feeds_back_plant_state(self):
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 100.0) for i in range(2)])
+        fleet.step()
+        fleet.advance()
+        fleet.update_agent("Room_1", x0=[294.0], inputs={"load": 250.0})
+        out = fleet.step()
+        assert out["Room_1"]["x"][0, 0] == pytest.approx(294.0, abs=0.2)
+
+    def test_output_coupling_raises_pointed_error(self):
+        cfg = _room_cfg(0, 100.0)
+        cfg["modules"][1]["couplings"] = [
+            {"name": "not_a_control", "alias": "x"}]
+        with pytest.raises(NotImplementedError, match="module path"):
+            FusedFleet.from_configs([cfg])
+
+    def test_mixed_horizons_rejected(self):
+        a, b = _room_cfg(0, 100.0), _room_cfg(1, 100.0)
+        b["modules"][1]["prediction_horizon"] = 9
+        with pytest.raises(ValueError, match="horizon"):
+            FusedFleet.from_configs([a, b])
+
+    def test_no_admm_modules_rejected(self):
+        with pytest.raises(ValueError, match="no ADMM"):
+            FusedFleet.from_configs([_sim_cfg()])
+
+    def test_partial_bounds_merge_across_lists(self):
+        """ub from the controls list + lb from the couplings list for the
+        same variable must BOTH survive into the OCP bounds."""
+        cfg = _room_cfg(0, 100.0)
+        mod = cfg["modules"][1]
+        mod["controls"] = [{"name": "mDot", "ub": 0.03}]
+        mod["couplings"] = [{"name": "mDot", "alias": "mDotShared",
+                             "lb": 0.01}]
+        fleet = FusedFleet.from_configs([cfg])
+        theta = fleet._agents[0].theta(fleet.N)
+        assert float(np.asarray(theta.u_lb).max()) == pytest.approx(0.01)
+        assert float(np.asarray(theta.u_ub).min()) == pytest.approx(0.03)
+
+    def test_conflicting_penalty_factor_rejected(self):
+        a, b = _room_cfg(0, 100.0), _room_cfg(1, 100.0)
+        b["modules"][1]["penalty_factor"] = 50.0
+        with pytest.raises(ValueError, match="penalty_factor"):
+            FusedFleet.from_configs([a, b])
+
+    def test_unknown_input_feedback_rejected(self):
+        fleet = FusedFleet.from_configs([_room_cfg(0, 100.0)])
+        with pytest.raises(KeyError, match="exogenous"):
+            fleet.update_agent("Room_0", inputs={"Load": 250.0})

@@ -56,30 +56,39 @@ def test_fleet_mesh_covers_all_devices(eight_devices):
     assert mesh.devices.size == len(jax.devices())
 
 
-def test_host_local_batch_partitions_exactly():
-    # single process: the whole batch
-    start, count = host_local_batch(11)
-    assert (start, count) == (0, 11)
+def test_host_local_batch_partitions_exactly(eight_devices):
+    # single process, 8 virtual devices: the whole (divisible) batch
+    start, count = host_local_batch(16)
+    assert (start, count) == (0, 16)
 
 
-def test_host_local_batch_layout_math():
-    # the dealing rule itself (pure arithmetic, any process count):
-    # contiguous, remainder to low ids, concatenation covers the batch
-    def deal(n, n_proc):
-        out = []
-        base, extra = divmod(n, n_proc)
-        for pid in range(n_proc):
-            count = base + (1 if pid < extra else 0)
-            start = pid * base + min(pid, extra)
-            out.append((start, count))
-        return out
+def test_host_local_batch_rejects_uneven(eight_devices):
+    with pytest.raises(ValueError, match="pad"):
+        host_local_batch(11)
 
-    for n, p in [(11, 4), (8, 8), (3, 4), (256, 8)]:
-        slices = deal(n, p)
-        covered = []
-        for start, count in slices:
-            covered.extend(range(start, start + count))
-        assert covered == list(range(n))
+
+def test_host_local_batch_multi_process_layout(eight_devices, monkeypatch):
+    """Drive the REAL function under a faked 2-process view of the
+    8-device fleet: slices must be contiguous, device-granular, and
+    concatenate to the full batch in process-major order."""
+
+    class _Dev:
+        def __init__(self, pid):
+            self.process_index = pid
+
+    devs = [_Dev(0)] * 4 + [_Dev(1)] * 4
+    monkeypatch.setattr(jax, "devices", lambda *a: devs)
+    monkeypatch.setattr(jax, "local_device_count", lambda *a: 4)
+
+    slices = []
+    for pid in (0, 1):
+        monkeypatch.setattr(jax, "process_index", lambda p=pid: p)
+        slices.append(host_local_batch(16))
+    assert slices == [(0, 8), (8, 8)]
+    covered = []
+    for start, count in slices:
+        covered.extend(range(start, start + count))
+    assert covered == list(range(16))
 
 
 def test_fused_step_on_fleet_mesh(eight_devices, tracker_ocp_factory):
