@@ -31,3 +31,23 @@ def eight_devices():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
     return devs[:8]
+
+
+def make_tracker_model(lb: float = -5.0, ub: float = 5.0):
+    """Shared stateless test model: min (u - a)^2 — analytic ADMM fixed
+    points (consensus -> mean(a), exchange -> a_i - mean(a)). Used by the
+    fused-engine, multihost and config-bridge tests."""
+    from agentlib_mpc_tpu.models.model import Model, ModelEquations
+    from agentlib_mpc_tpu.models.objective import SubObjective
+    from agentlib_mpc_tpu.models.variables import control_input, parameter
+
+    class Tracker(Model):
+        inputs = [control_input("u", 0.0, lb=lb, ub=ub)]
+        parameters = [parameter("a", 1.0)]
+
+        def setup(self, v):
+            eq = ModelEquations()
+            eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
+            return eq
+
+    return Tracker

@@ -131,3 +131,40 @@ class TestFromConfigs:
         fleet = FusedFleet.from_configs([_room_cfg(0, 100.0)])
         with pytest.raises(KeyError, match="exogenous"):
             fleet.update_agent("Room_0", inputs={"Load": 250.0})
+
+
+class TestExchangeBridge:
+    def test_exchange_configs_balance_to_zero(self):
+        """'exchange' entries ride the bridge too: trackers exchanging on
+        their control settle at u_i = a_i - mean(a) (sum-zero condition,
+        the analytic exchange-ADMM fixed point)."""
+        from conftest import make_tracker_model
+
+        Tracker = make_tracker_model(lb=-10.0, ub=10.0)
+
+        def cfg(i, a):
+            return {"id": f"T_{i}", "modules": [
+                {"module_id": "admm", "type": "admm_local",
+                 "optimization_backend": {
+                     "type": "jax_admm",
+                     "model": {"class": Tracker},
+                     "discretization_options": {
+                         "method": "multiple_shooting"},
+                     "solver": {"max_iter": 40, "tol": 1e-8},
+                 },
+                 "time_step": 300.0, "prediction_horizon": 4,
+                 "max_iterations": 50, "penalty_factor": 1.0,
+                 "parameters": [{"name": "a", "value": a}],
+                 "exchange": [{"name": "u", "alias": "power"}]}]}
+
+        targets = (2.0, -1.0, 5.0)
+        fleet = FusedFleet.from_configs(
+            [cfg(i, a) for i, a in enumerate(targets)],
+            options=FusedADMMOptions(max_iterations=50, rho=1.0,
+                                     abs_tol=1e-6, rel_tol=1e-5))
+        out = fleet.step()
+        u = np.stack([out[f"T_{i}"]["u"]["u"] for i in range(3)])
+        np.testing.assert_allclose(u.sum(axis=0), 0.0, atol=5e-3)
+        mean_a = np.mean(targets)
+        for i, a in enumerate(targets):
+            np.testing.assert_allclose(u[i], a - mean_a, atol=5e-3)

@@ -29,16 +29,10 @@ DT = 300.0
 SOLVER = SolverOptions(tol=1e-8, max_iter=40)
 
 
-class Tracker(Model):
-    """Stateless agent: min (u - a)^2 — analytic ADMM fixed points."""
+from conftest import make_tracker_model  # noqa: E402
 
-    inputs = [control_input("u", 0.0, lb=-5.0, ub=5.0)]
-    parameters = [parameter("a", 1.0)]
-
-    def setup(self, v):
-        eq = ModelEquations()
-        eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
-        return eq
+#: stateless agent min (u - a)^2 — analytic ADMM fixed points
+Tracker = make_tracker_model(lb=-5.0, ub=5.0)
 
 
 @pytest.fixture(scope="module")

@@ -10,9 +10,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from agentlib_mpc_tpu.models.model import Model, ModelEquations
-from agentlib_mpc_tpu.models.objective import SubObjective
-from agentlib_mpc_tpu.models.variables import control_input, parameter
 from agentlib_mpc_tpu.parallel import (
     AgentGroup,
     FusedADMM,
@@ -25,20 +22,14 @@ from agentlib_mpc_tpu.ops.solver import SolverOptions
 from agentlib_mpc_tpu.ops.transcription import transcribe
 
 
-class _Tracker(Model):
-    inputs = [control_input("u", 0.0, lb=-10.0, ub=10.0)]
-    parameters = [parameter("a", 1.0)]
-
-    def setup(self, v):
-        eq = ModelEquations()
-        eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
-        return eq
+from conftest import make_tracker_model  # noqa: E402
 
 
 @pytest.fixture(scope="module")
 def tracker_ocp_factory():
     def make():
-        return transcribe(_Tracker(), ["u"], N=4, dt=300.0,
+        Tracker = make_tracker_model(lb=-10.0, ub=10.0)
+        return transcribe(Tracker(), ["u"], N=4, dt=300.0,
                           method="multiple_shooting")
 
     return make
