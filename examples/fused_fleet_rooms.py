@@ -1,0 +1,117 @@
+"""Config-driven fused fleet: 16 rooms negotiate one shared supply on the
+data plane.
+
+The same ``admm_local``-style agent configs the module path consumes
+(``examples/admm_cooled_room.py``) are compiled by
+:class:`~agentlib_mpc_tpu.parallel.config_bridge.FusedFleet` into ONE
+jitted ADMM program — every room's interior-point solve, the consensus
+mean and the dual updates fused (docs/DISTRIBUTED.md, "data plane").
+Closed loop: each control interval the fused round plans, the plant
+models integrate one step, measurements feed back via ``update_agent``,
+and the warm start shifts. This is the cluster-simulation workflow the
+reference runs as N CasADi processes around a coordinator agent
+(``examples/4_Room_ADMM_Coordinator/admm_4rooms_coord_main.py``), here
+one XLA computation per round.
+
+Run directly for a report, or call ``run_example`` (examples-as-tests,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.models.zoo import CooledRoom
+from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet
+
+N_ROOMS = 16
+TIME_STEP = 300.0
+HORIZON = 6
+MAX_ITERATIONS = 8
+UB = 295.15
+START_TEMP = 298.16
+
+
+def room_config(i: int, load: float) -> dict:
+    return {
+        "id": f"Room_{i}",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {"module_id": "admm", "type": "admm_local",
+             "optimization_backend": {
+                 "type": "jax_admm",
+                 "model": {"class": CooledRoom},
+                 "discretization_options": {"collocation_order": 2,
+                                            "collocation_method": "legendre"},
+                 "solver": {"max_iter": 30},
+             },
+             "time_step": TIME_STEP,
+             "prediction_horizon": HORIZON,
+             "max_iterations": MAX_ITERATIONS,
+             "penalty_factor": 20.0,
+             "parameters": [{"name": "s_T", "value": 1.0}],
+             "inputs": [
+                 {"name": "load", "value": load},
+                 {"name": "T_in", "value": 290.15},
+                 {"name": "T_upper", "value": UB},
+             ],
+             "states": [{"name": "T", "value": START_TEMP}],
+             "couplings": [
+                 {"name": "mDot", "alias": "mDotShared", "value": 0.02,
+                  "lb": 0.0, "ub": 0.05},
+             ]},
+        ],
+    }
+
+
+def run_example(until: float = 3600.0, n_rooms: int = N_ROOMS,
+                testing: bool = False, verbose: bool = True) -> dict:
+    loads = np.linspace(80.0, 220.0, n_rooms)
+    fleet = FusedFleet.from_configs(
+        [room_config(i, float(loads[i])) for i in range(n_rooms)])
+
+    plant = CooledRoom()
+    p_plant = plant.default_vector("parameters")
+    temps = {f"Room_{i}": START_TEMP for i in range(n_rooms)}
+    iter_trail: list[int] = []
+
+    n_steps = int(until // TIME_STEP)
+    for _ in range(n_steps):
+        out = fleet.step()
+        iter_trail.append(out[f"Room_0"]["iterations"])
+        for i in range(n_rooms):
+            aid = f"Room_{i}"
+            mdot = float(out[aid]["u"]["mDot"][0])
+            u = jnp.array([mdot, float(loads[i]), 290.15, UB])
+            x_next, _ = plant.simulate_step(
+                jnp.array([temps[aid]]), u, p_plant, TIME_STEP)
+            temps[aid] = float(x_next[0])
+            fleet.update_agent(aid, x0=[temps[aid]])
+        fleet.advance()
+
+    t = np.array([temps[f"Room_{i}"] for i in range(n_rooms)])
+    if verbose:
+        print(f"{n_rooms} rooms, {n_steps} control steps "
+              f"({len(fleet.engine.groups)} fused group(s))")
+        print(f"temperatures: start {START_TEMP:.2f} K -> "
+              f"[{t.min():.2f}, {t.max():.2f}] K (band {UB} K)")
+        print(f"ADMM iterations per round: {iter_trail}")
+    if testing:
+        assert len(fleet.engine.groups) == 1, "identical rooms must batch"
+        assert np.all(t < START_TEMP), "every room must cool"
+        # warm starts pay off: some later round beats the cold round
+        # (meaningful only when there are later rounds and the cold round
+        # did not already saturate the iteration cap)
+        if len(iter_trail) >= 2 and iter_trail[0] < MAX_ITERATIONS:
+            assert min(iter_trail[1:]) <= iter_trail[0]
+    return {"temps": temps, "iterations": iter_trail}
+
+
+if __name__ == "__main__":
+    run_example(until=7200.0, testing=True)

@@ -70,3 +70,10 @@ def test_ml_mpc_example():
 
     out = run_example(until=4500, testing=True, verbose=False, epochs=200)
     assert len(out["temps"]) == 15
+
+
+def test_fused_fleet_rooms_example():
+    from examples.fused_fleet_rooms import run_example
+
+    out = run_example(until=1800, n_rooms=8, testing=True, verbose=False)
+    assert len(out["iterations"]) == 6
