@@ -35,6 +35,7 @@ TIME_STEP = 300.0
 HORIZON = 6
 MAX_ITERATIONS = 8
 UB = 295.15
+T_IN = 290.15
 START_TEMP = 298.16
 
 
@@ -58,7 +59,7 @@ def room_config(i: int, load: float) -> dict:
              "parameters": [{"name": "s_T", "value": 1.0}],
              "inputs": [
                  {"name": "load", "value": load},
-                 {"name": "T_in", "value": 290.15},
+                 {"name": "T_in", "value": T_IN},
                  {"name": "T_upper", "value": UB},
              ],
              "states": [{"name": "T", "value": START_TEMP}],
@@ -84,11 +85,11 @@ def run_example(until: float = 3600.0, n_rooms: int = N_ROOMS,
     n_steps = int(until // TIME_STEP)
     for _ in range(n_steps):
         out = fleet.step()
-        iter_trail.append(out[f"Room_0"]["iterations"])
+        iter_trail.append(out["Room_0"]["iterations"])
         for i in range(n_rooms):
             aid = f"Room_{i}"
             mdot = float(out[aid]["u"]["mDot"][0])
-            u = jnp.array([mdot, float(loads[i]), 290.15, UB])
+            u = jnp.array([mdot, float(loads[i]), T_IN, UB])
             x_next, _ = plant.simulate_step(
                 jnp.array([temps[aid]]), u, p_plant, TIME_STEP)
             temps[aid] = float(x_next[0])
