@@ -148,16 +148,12 @@ class OptimizationBackend:
         ``discretization.py:398-484``). Keys: "x" (node states), "u"
         (optimized inputs incl. merged couplings), "y" (outputs), "z"
         (algebraic/slack states)."""
-        model = self.model
+        from agentlib_mpc_tpu.utils.results import trajectory_layout
+
         ocp = getattr(self, "ocp", None)
         u = list(ocp.control_names) if ocp is not None \
             else list(self.var_ref.controls)
-        return {
-            "x": list(model.diff_state_names),
-            "u": u,
-            "y": list(model.output_names),
-            "z": list(model.free_state_names),
-        }
+        return trajectory_layout(self.model, u)
 
     def get_lags_per_variable(self) -> dict[str, int]:
         """name → number of past samples the backend needs (NARX models;

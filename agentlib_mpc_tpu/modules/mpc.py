@@ -135,31 +135,10 @@ class BaseMPC(SkippableMixin, BaseModule):
         """MultiIndex (time, grid-offset) DataFrame with ('variable', name)
         columns — the reference's results layout
         (``discretization.py:398-484``, loaded by ``utils/analysis.py``)."""
-        import pandas as pd
+        from agentlib_mpc_tpu.utils.results import mpc_trajectory_frame
 
-        if not self._history_rows:
-            return None
-        layout = self.backend.trajectory_layout()
-        frames = []
-        for row in self._history_rows:
-            traj = row["traj"]
-            grid = np.asarray(traj["time_state"]) - row["time"]
-            n_nodes = len(grid)
-            data = {}
-            for key in ("x", "u", "y", "z"):
-                for i, n in enumerate(layout[key]):
-                    col = np.asarray(traj[key])[:, i]
-                    if col.shape[0] < n_nodes:  # control-grid quantities
-                        col = np.append(col, [np.nan] * (n_nodes -
-                                                         col.shape[0]))
-                    data[("variable", n)] = col
-            df = pd.DataFrame(data)
-            df.index = pd.MultiIndex.from_product(
-                [[row["time"]], grid], names=["time", "grid"])
-            frames.append(df)
-        out = pd.concat(frames)
-        out.columns = pd.MultiIndex.from_tuples(out.columns)
-        return out
+        return mpc_trajectory_frame(self._history_rows,
+                                    self.backend.trajectory_layout())
 
     def solver_stats(self):
         import pandas as pd

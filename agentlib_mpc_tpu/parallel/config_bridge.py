@@ -115,9 +115,19 @@ class FusedFleet:
     """
 
     def __init__(self, agents: Sequence[_FleetAgent], N: int,
-                 options: FusedADMMOptions):
+                 options: FusedADMMOptions, dt: float = 300.0,
+                 record: bool = True):
         self._agents = list(agents)
         self.N = N
+        self.dt = float(dt)
+        self.time = 0.0
+        #: record per-step trajectories/residuals for :meth:`results` /
+        #: :meth:`iteration_stats`; disable (or call
+        #: :meth:`cleanup_results` periodically) for very long runs
+        self.record = record
+        self._history: dict[str, list[dict]] = {
+            a.agent_id: [] for a in self._agents}
+        self._stats_rows: list[dict] = []
         specs = [
             {"ocp": a.ocp, "theta": a.theta(N), "couplings": a.couplings,
              "exchanges": a.exchanges, "name": a.agent_id,
@@ -153,6 +163,7 @@ class FusedFleet:
         agents: list[_FleetAgent] = []
         ocp_cache: dict[tuple, TranscribedOCP] = {}
         N_ref: int | None = None
+        dt_ref: float | None = None
         rho = None
         max_iterations = None
         for cfg in configs:
@@ -169,6 +180,12 @@ class FusedFleet:
                 raise ValueError(
                     f"fused fleet needs one shared horizon: agent "
                     f"{cfg.get('id')} has N={N}, fleet has N={N_ref}")
+            if dt_ref is None:
+                dt_ref = dt
+            elif dt != dt_ref:
+                raise ValueError(
+                    f"fused fleet needs one shared time_step: agent "
+                    f"{cfg.get('id')} has dt={dt}, fleet has {dt_ref}")
             for attr, current in (("penalty_factor", rho),
                                   ("max_iterations", max_iterations)):
                 val = m.get(attr)
@@ -243,7 +260,7 @@ class FusedFleet:
             options = FusedADMMOptions(
                 max_iterations=int(max_iterations or 10),
                 rho=float(rho if rho is not None else 10.0))
-        return cls(agents, N_ref, options)
+        return cls(agents, N_ref, options, dt=dt_ref)
 
     # -- runtime --------------------------------------------------------------
 
@@ -282,11 +299,13 @@ class FusedFleet:
         """
         self.state, trajs, stats = self.engine.step(
             self.state, self._theta_batches)
+        # one device→host transfer per group, then indexed per agent
+        host = [{k: np.asarray(v) for k, v in tr.items()} for tr in trajs]
         out: dict[str, dict] = {}
         for a in self._agents:
             gi, slot = self._where[a.agent_id]
-            tr = trajs[gi]
-            u = np.asarray(tr["u"])[slot]          # (N, n_u)
+            tr = host[gi]
+            u = tr["u"][slot]                      # (N, n_u)
             res = {
                 "u": {n: u[:, j]
                       for j, n in enumerate(a.ocp.control_names)},
@@ -294,15 +313,77 @@ class FusedFleet:
                 "iterations": int(stats.iterations),
             }
             if "x" in tr:
-                res["x"] = np.asarray(tr["x"])[slot]
+                res["x"] = tr["x"][slot]
             out[a.agent_id] = res
+            if self.record:
+                # reference-layout history (same record shape as the
+                # module path, modules/mpc.py _record)
+                self._history[a.agent_id].append({
+                    "time": self.time,
+                    "traj": {k: v[slot] + (self.time
+                             if k in ("time_state", "time_control")
+                             else 0.0)
+                             for k, v in tr.items()},
+                })
+        if self.record:
+            it = int(stats.iterations)
+            self._stats_rows.append({
+                "time": self.time,
+                "primal": np.asarray(stats.primal_residuals)[:it],
+                "dual": np.asarray(stats.dual_residuals)[:it],
+                "rho": np.asarray(stats.penalty)[:it],
+            })
         self._last_stats = stats
         return out
 
     def advance(self) -> None:
-        """Shift-by-one warm start between control intervals
-        (``shift_state``; reference ``_shift_coupling_variables``)."""
+        """Shift-by-one warm start + clock advance between control
+        intervals (``shift_state``; reference
+        ``_shift_coupling_variables``)."""
         self.state = self.engine.shift_state(self.state)
+        self.time += self.dt
+
+    # -- results (reference CSV layouts, utils/analysis-compatible) -----------
+
+    def results(self, agent_id: str):
+        """(time, grid) MultiIndex trajectory DataFrame for one agent —
+        the same layout the module path records, so `utils/analysis` and
+        the plotting toolkit work on fused runs unchanged."""
+        from agentlib_mpc_tpu.utils.results import (
+            mpc_trajectory_frame,
+            trajectory_layout,
+        )
+
+        a = self._agents_by_id()[agent_id]
+        return mpc_trajectory_frame(
+            self._history[agent_id],
+            trajectory_layout(a.model, a.ocp.control_names))
+
+    def cleanup_results(self) -> None:
+        """Drop recorded history (module-path parity:
+        ``modules/mpc.py cleanup_results``) — bounds memory on long
+        closed-loop runs."""
+        for rows in self._history.values():
+            rows.clear()
+        self._stats_rows.clear()
+
+    def iteration_stats(self):
+        """(time, iteration)-indexed residual/penalty trail of every
+        fused round (the reference coordinator's per-iteration stats,
+        ``admm_coordinator.py:396-402``)."""
+        import pandas as pd
+
+        if not self._stats_rows:
+            return None
+        frames = []
+        for row in self._stats_rows:
+            df = pd.DataFrame({"primal": row["primal"],
+                               "dual": row["dual"], "rho": row["rho"]})
+            df.index = pd.MultiIndex.from_product(
+                [[row["time"]], range(len(row["primal"]))],
+                names=["time", "iteration"])
+            frames.append(df)
+        return pd.concat(frames)
 
     @property
     def last_stats(self):

@@ -1,0 +1,62 @@
+"""Shared results-frame builders (reference CSV layouts).
+
+One implementation of the reference's MultiIndex result layouts
+(``optimization_backends/casadi_/core/discretization.py:398-484``), used
+by both the module path (`modules/mpc.py`) and the fused data plane
+(`parallel/config_bridge.py`) so `utils/analysis.py` loaders and the
+plotting toolkit work identically on either.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def trajectory_layout(model, control_names) -> dict[str, list[str]]:
+    """Column names of an OCP's result trajectories — the single
+    definition of the layout contract (keys "x"/"u"/"y"/"z"), shared by
+    `OptimizationBackend.trajectory_layout` and the fused fleet."""
+    return {
+        "x": list(model.diff_state_names),
+        "u": list(control_names),
+        "y": list(model.output_names),
+        "z": list(model.free_state_names),
+    }
+
+
+def mpc_trajectory_frame(rows, layout):
+    """(time, grid-offset) MultiIndex DataFrame with ('variable', name)
+    columns from recorded per-step trajectories.
+
+    ``rows``: iterable of ``{"time": float, "traj": {key: array}}`` where
+    ``traj`` has the `TranscribedOCP.trajectories` keys (time_state, x,
+    u, y, z). ``layout``: {"x": [names], "u": [...], "y": [...],
+    "z": [...]} — `OptimizationBackend.trajectory_layout` shape.
+    Control-grid quantities (one row shorter than the state grid) are
+    NaN-padded at the terminal node, as the reference does.
+    """
+    import pandas as pd
+
+    rows = list(rows)
+    if not rows:
+        return None
+    frames = []
+    for row in rows:
+        traj = row["traj"]
+        grid = np.asarray(traj["time_state"]) - row["time"]
+        n_nodes = len(grid)
+        data = {}
+        for key in ("x", "u", "y", "z"):
+            for i, n in enumerate(layout[key]):
+                col = np.asarray(traj[key])[:, i]
+                if col.shape[0] < n_nodes:  # control-grid quantities
+                    col = np.append(col, [np.nan] * (n_nodes -
+                                                     col.shape[0]))
+                data[("variable", n)] = col
+        df = pd.DataFrame(data)
+        df.index = pd.MultiIndex.from_product(
+            [[row["time"]], grid], names=["time", "grid"])
+        frames.append(df)
+    out = pd.concat(frames)
+    out.columns = pd.MultiIndex.from_tuples(out.columns)
+    return out

@@ -168,3 +168,39 @@ class TestExchangeBridge:
         mean_a = np.mean(targets)
         for i, a in enumerate(targets):
             np.testing.assert_allclose(u[i], a - mean_a, atol=5e-3)
+
+
+class TestFleetResults:
+    def test_results_roundtrip_through_analysis_loader(self, tmp_path):
+        """Fused-fleet history writes/loads as the reference MPC CSV
+        layout (utils/analysis.load_mpc) — the module path's format."""
+        import pandas as pd
+        from agentlib_mpc_tpu.utils.analysis import load_mpc
+
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 100.0 + 40 * i) for i in range(2)])
+        for _ in range(3):
+            fleet.step()
+            fleet.advance()
+        df = fleet.results("Room_1")
+        assert df.index.names == ["time", "grid"]
+        times = df.index.get_level_values("time").unique()
+        assert list(times) == [0.0, 300.0, 600.0]
+        assert ("variable", "T") in df.columns
+        assert ("variable", "mDot") in df.columns
+        path = tmp_path / "room1.csv"
+        df.to_csv(path)
+        loaded = load_mpc(path)
+        assert loaded.shape[0] == df.shape[0]
+
+    def test_iteration_stats_trail(self):
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 120.0) for i in range(2)])
+        fleet.step()
+        fleet.advance()
+        fleet.step()
+        st = fleet.iteration_stats()
+        assert st.index.names == ["time", "iteration"]
+        assert set(st.columns) == {"primal", "dual", "rho"}
+        # residuals recorded for every executed iteration, all finite
+        assert np.all(np.isfinite(st["primal"].to_numpy()))
