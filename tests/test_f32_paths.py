@@ -1,0 +1,104 @@
+"""f32 tier: the TPU-native precision, exercised explicitly on CPU.
+
+The suite runs in f64 (conftest enables x64 for tight tolerances); the
+TPU data plane runs f32. These tests re-trace the hot paths under
+``jax.enable_x64(False)`` and pin the f32-specific behavior the solver
+was engineered for (scaling, stall acceptance, barrier floor —
+``ops/solver.py`` docstring): solves still succeed and land on the f64
+answer to f32-appropriate tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.models.zoo import OneRoom
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    SolverOptions,
+    solve_nlp,
+)
+from agentlib_mpc_tpu.ops.transcription import transcribe
+
+
+@pytest.fixture()
+def f32():
+    with jax.enable_x64(False):
+        yield
+
+
+class TestSolverF32:
+    def test_hs071_f32(self, f32):
+        nlp = NLPFunctions(
+            f=lambda w, t: w[0] * w[3] * (w[0] + w[1] + w[2]) + w[2],
+            g=lambda w, t: jnp.array([jnp.sum(w**2) - 40.0]),
+            h=lambda w, t: jnp.array([w[0] * w[1] * w[2] * w[3] - 25.0]),
+        )
+        res = solve_nlp(nlp, jnp.array([1.0, 5.0, 5.0, 1.0]), None,
+                        jnp.ones(4), 5.0 * jnp.ones(4),
+                        SolverOptions(tol=1e-4, max_iter=60))
+        assert res.w.dtype == jnp.float32
+        assert bool(res.stats.success)
+        np.testing.assert_allclose(
+            np.asarray(res.w), [1.0, 4.743, 3.8211, 1.3794], atol=2e-3)
+
+    @pytest.mark.slow
+    def test_one_room_ocp_f32_matches_f64_objective(self, f32):
+        """The benchmark-shaped OCP: f32 solve succeeds and the optimal
+        cost matches the f64 solve to well under a percent (the
+        closed-loop-cost parity claim of BASELINE.md rests on this)."""
+        model = OneRoom(overrides={"s_T": 0.001, "r_mDot": 0.01})
+        ocp = transcribe(model, ["mDot"], N=8, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params(x0=jnp.array([298.16]))
+        lb, ub = ocp.bounds(theta)
+        res32 = solve_nlp(ocp.nlp, ocp.initial_guess(theta), theta, lb,
+                          ub, SolverOptions(tol=1e-4, max_iter=60))
+        assert res32.w.dtype == jnp.float32
+        assert bool(res32.stats.success)
+        obj32 = float(res32.stats.objective)
+
+        with jax.enable_x64(True):
+            ocp64 = transcribe(model, ["mDot"], N=8, dt=300.0,
+                               method="collocation", collocation_degree=2)
+            theta64 = ocp64.default_params(x0=jnp.array([298.16]))
+            lb64, ub64 = ocp64.bounds(theta64)
+            res64 = solve_nlp(ocp64.nlp, ocp64.initial_guess(theta64),
+                              theta64, lb64, ub64,
+                              SolverOptions(tol=1e-7, max_iter=80))
+        assert bool(res64.stats.success)
+        obj64 = float(res64.stats.objective)
+        assert obj32 == pytest.approx(obj64, rel=5e-3)
+
+
+class TestFusedEngineF32:
+    def test_consensus_fixed_point_f32(self, f32):
+        from conftest import make_tracker_model
+
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+            stack_params,
+        )
+
+        Tracker = make_tracker_model()
+        ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        group = AgentGroup(
+            name="trackers", ocp=ocp, n_agents=3,
+            couplings={"shared": "u"},
+            solver_options=SolverOptions(tol=1e-5, max_iter=30))
+        engine = FusedADMM(
+            [group], FusedADMMOptions(max_iterations=40, rho=2.0,
+                                      abs_tol=1e-4, rel_tol=1e-3))
+        thetas = stack_params([
+            ocp.default_params(p=jnp.array([float(a)]))
+            for a in (0.0, 2.0, 4.0)])
+        state = engine.init_state([thetas])
+        state, _trajs, stats = engine.step(state, [thetas])
+        assert state.zbar["shared"].dtype == jnp.float32
+        assert bool(stats.converged)
+        np.testing.assert_allclose(
+            np.asarray(state.zbar["shared"]), 2.0, atol=5e-3)
