@@ -377,8 +377,10 @@ class FusedFleet:
             return None
         frames = []
         for row in self._stats_rows:
-            df = pd.DataFrame({"primal": row["primal"],
-                               "dual": row["dual"], "rho": row["rho"]})
+            # coordinator column names (modules/coordinator.py stats rows)
+            df = pd.DataFrame({"primal_residual": row["primal"],
+                               "dual_residual": row["dual"],
+                               "penalty_parameter": row["rho"]})
             df.index = pd.MultiIndex.from_product(
                 [[row["time"]], range(len(row["primal"]))],
                 names=["time", "iteration"])

@@ -25,8 +25,10 @@ def plot_admm_residuals(stats, ax=None, rho: bool = True,
                 color=COLORS["blue"], label="primal residual")
     ax.semilogy(idx, np.abs(stats["dual_residual"].to_numpy(dtype=float)),
                 color=COLORS["red"], label="dual residual")
-    if rho and "penalty" in stats:
-        ax.semilogy(idx, stats["penalty"].to_numpy(dtype=float),
+    pen_col = next((c for c in ("penalty_parameter", "penalty", "rho")
+                    if c in stats), None)
+    if rho and pen_col:
+        ax.semilogy(idx, stats[pen_col].to_numpy(dtype=float),
                     color=COLORS["grey"], linestyle="--", label="rho")
     ax.set_xlabel("ADMM iteration")
     ax.set_ylabel("residual")

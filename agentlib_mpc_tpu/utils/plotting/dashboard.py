@@ -123,11 +123,13 @@ def admm_iteration_traces(df, variable: str, time) -> list:
 
 
 def residual_table(stats):
-    """Tidy per-(time, iteration) residual frame from coordinator stats
-    (columns: primal_residual, dual_residual, rho when present)."""
+    """Tidy per-(time, iteration) residual frame from coordinator or
+    fused-fleet stats (columns: primal_residual, dual_residual, and the
+    penalty under any of its historical names)."""
     if stats is None or len(stats) == 0:
         return None
-    cols = [c for c in ("primal_residual", "dual_residual", "rho")
+    cols = [c for c in ("primal_residual", "dual_residual",
+                        "penalty_parameter", "penalty", "rho")
             if c in stats.columns]
     if not cols or stats.index.nlevels != 2:
         return None

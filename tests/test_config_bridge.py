@@ -201,6 +201,16 @@ class TestFleetResults:
         fleet.step()
         st = fleet.iteration_stats()
         assert st.index.names == ["time", "iteration"]
-        assert set(st.columns) == {"primal", "dual", "rho"}
-        # residuals recorded for every executed iteration, all finite
-        assert np.all(np.isfinite(st["primal"].to_numpy()))
+        # coordinator column names: plot_admm_residuals consumes directly
+        assert set(st.columns) == {"primal_residual", "dual_residual",
+                                   "penalty_parameter"}
+        assert np.all(np.isfinite(st["primal_residual"].to_numpy()))
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from agentlib_mpc_tpu.utils.plotting.admm import (
+            plot_admm_residuals,
+        )
+
+        ax = plot_admm_residuals(st.loc[0.0])
+        assert ax.get_xlabel() == "ADMM iteration"
