@@ -241,31 +241,38 @@ def _use_pallas() -> bool:
 _PROBE_RESULT: dict = {}
 
 
-def kkt_method_available() -> bool:
-    """Eagerly probe the Pallas LDLᵀ path on the current backend ONCE.
+def kkt_method_available(size: int = 7) -> bool:
+    """Eagerly probe the Pallas LDLᵀ path on the current backend ONCE per
+    padded problem size.
 
     Safety net for environments where the TPU kernel cannot compile or
     returns garbage (driver hardware differs from the CPU interpret-mode
     tests): the solver's ``kkt_method="auto"`` consults this and falls
     back to the pivoted-LU path instead of crashing the benchmark.
+
+    ``size`` is the KKT dimension the caller will factor. The probe runs
+    at the SAME padded tile shape ``(m_pad, m_pad, 128)`` the real solve
+    will use — a tiny probe would compile a tiny tile and miss VMEM or
+    lowering failures that only appear at the production size.
     """
-    key = jax.default_backend()
+    m_pad = _pad_up(max(size, 8), 8)
+    key = (jax.default_backend(), m_pad)
     if key in _PROBE_RESULT:
         return _PROBE_RESULT[key]
     if not _use_pallas():
         _PROBE_RESULT[key] = False
         return False
     try:
-        n, m = 5, 2
+        n, m = max(size - 2, 1), 2
         rng = np.random.default_rng(0)
-        A = rng.normal(size=(n, n))
+        A = rng.normal(size=(n, n)) / np.sqrt(n)
         W = A @ A.T + 3 * np.eye(n)
         Jg = rng.normal(size=(m, n))
         K = np.block([[W, Jg.T], [Jg, -1e-6 * np.eye(m)]])
+        # batch 2 pads to the full 128-lane tile — the production shape
         Kb = jnp.asarray(np.stack([K, K]), jnp.float32)
         rhs = jnp.asarray(rng.normal(size=(2, n + m)), jnp.float32)
-        LD = _ldl_factor_batched(Kb)
-        x = _ldl_solve_batched(LD, rhs)
+        x = jax.vmap(solve_kkt_ldl)(Kb, rhs)
         res = jnp.max(jnp.abs(jnp.einsum("bij,bj->bi", Kb, x) - rhs))
         ok = bool(jnp.isfinite(res) and res < 1e-2)
     except Exception:  # noqa: BLE001 - any compile/runtime failure

@@ -183,24 +183,28 @@ def _resolve_kkt_lu(factor, rhs):
     return x * scale
 
 
-def _resolve_method(method: str) -> str:
+def _resolve_method(method: str, size: int) -> str:
     if method == "auto":
-        # TPU → Pallas LDLᵀ, after a one-time eager probe that falls back
-        # to LU if the kernel cannot compile/run on this backend
-        return "ldl" if kkt_ops.kkt_method_available() else "lu"
+        # TPU → Pallas LDLᵀ, after a one-time eager probe AT THIS padded
+        # size that falls back to LU if the kernel cannot compile/run on
+        # this backend at the production tile shape
+        return "ldl" if kkt_ops.kkt_method_available(size) else "lu"
     return method
 
 
 def _factor_kkt(K, method: str):
-    if _resolve_method(method) == "ldl":
-        return kkt_ops.factor_kkt_ldl(K)
-    return _factor_kkt_lu(K)
+    """Factor once; returns a method-tagged factor so the resolve path
+    cannot diverge from the factor path."""
+    if _resolve_method(method, K.shape[-1]) == "ldl":
+        return ("ldl", kkt_ops.factor_kkt_ldl(K))
+    return ("lu", _factor_kkt_lu(K))
 
 
-def _resolve_kkt(factor, rhs, method: str):
-    if _resolve_method(method) == "ldl":
-        return kkt_ops.resolve_kkt_ldl(factor, rhs)
-    return _resolve_kkt_lu(factor, rhs)
+def _resolve_kkt(factor, rhs):
+    kind, f = factor  # the factor carries its own method tag
+    if kind == "ldl":
+        return kkt_ops.resolve_kkt_ldl(f, rhs)
+    return _resolve_kkt_lu(f, rhs)
 
 
 
@@ -410,11 +414,11 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             """Direction from the stored factor for (possibly per-entry)
             complementarity targets."""
             if m_e:
-                sol = _resolve_kkt(factor, jnp.concatenate([rhs_w_k, -gv]),
-                                   opts.kkt_method)
+                sol = _resolve_kkt(factor,
+                                   jnp.concatenate([rhs_w_k, -gv]))
                 dw_k, dy_k = sol[:n], sol[n:]
             else:
-                dw_k = _resolve_kkt(factor, rhs_w_k, opts.kkt_method)
+                dw_k = _resolve_kkt(factor, rhs_w_k)
                 dy_k = jnp.zeros((0,), dtype)
             ds_k = (Jh @ dw_k + r_h) if m_h else s
             dz_k = (mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * ds_k) \

@@ -109,6 +109,8 @@ def test_kkt_method_probe_cpu_falls_back():
     """On non-TPU backends the auto path must select LU (probe False),
     and the probe result is cached."""
     assert kkt.kkt_method_available() is False
-    assert kkt._PROBE_RESULT.get("cpu") is False
-    # cached second call
+    assert kkt._PROBE_RESULT.get(("cpu", 8)) is False
+    # cached second call, and a size-specific probe caches its own key
     assert kkt.kkt_method_available() is False
+    assert kkt.kkt_method_available(92) is False
+    assert kkt._PROBE_RESULT.get(("cpu", 96)) is False
