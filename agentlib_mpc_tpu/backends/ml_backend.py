@@ -91,13 +91,12 @@ class MLBackend(OptimizationBackend):
 
     def trajectory_layout(self) -> dict[str, list[str]]:
         """NARX layout: learned (narx) states live in "x" alongside
-        white-box ODE states; "z" holds only the remaining slack states."""
-        return {
-            "x": list(self.ocp.dyn_names),
-            "u": list(self.ocp.control_names),
-            "y": list(self.model.output_names),
-            "z": list(self.ocp.slack_names),
-        }
+        white-box ODE states; "z" holds only the remaining slack states
+        (the shared ocp-aware contract in utils/results.py)."""
+        from agentlib_mpc_tpu.utils.results import trajectory_layout
+
+        return trajectory_layout(self.model, self.ocp.control_names,
+                                 ocp=self.ocp)
 
     def update_ml_models(self, *serialized) -> None:
         """Hot-swap retrained surrogates. Same lag structure → parameters

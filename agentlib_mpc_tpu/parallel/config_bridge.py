@@ -36,12 +36,14 @@ from typing import Any, Mapping, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from agentlib_mpc_tpu.backends.backend import load_model
+from agentlib_mpc_tpu.backends.backend import load_model_for_backend
 from agentlib_mpc_tpu.backends.mpc_backend import (
     solver_options_from_config,
     transcription_kwargs_from_config,
 )
+from agentlib_mpc_tpu.models.ml_model import MLModel
 from agentlib_mpc_tpu.models.model import Model
+from agentlib_mpc_tpu.ops.ml_transcription import transcribe_ml
 from agentlib_mpc_tpu.ops.transcription import TranscribedOCP, transcribe
 from agentlib_mpc_tpu.parallel.fused_admm import (
     FusedADMM,
@@ -78,6 +80,12 @@ class _FleetAgent:
                               "p": jnp.asarray(self.p)}
         if d is not None:
             kw["d_traj"] = d
+        if isinstance(self.model, MLModel):
+            # learned weights ride theta (the hot-swap design,
+            # ops/ml_transcription.py): each agent's OWN surrogate
+            # parameters, even though structure-identical agents share
+            # one transcription
+            kw["ml_params"] = self.model.ml_params
         theta = ocp.default_params(**kw)
         # config-level lb/ub on couplings/controls override the model's
         if self.u_bounds:
@@ -171,9 +179,11 @@ class FusedFleet:
             if m is None:
                 continue
             backend = m.get("optimization_backend") or {}
-            model = load_model(backend.get("model", {}))
             N = int(m.get("prediction_horizon", 10))
             dt = float(m.get("time_step", 300.0))
+            # ML-aware loading: configs with ml_model_sources come back as
+            # MLModel and transcribe through the NARX path below
+            model = load_model_for_backend(backend.get("model", {}), dt=dt)
             if N_ref is None:
                 N_ref = N
             elif N != N_ref:
@@ -225,19 +235,37 @@ class FusedFleet:
                     if "lb" in e or "ub" in e:
                         _merge_bounds(e)
 
-            trans_kwargs = transcription_kwargs_from_config(
-                backend.get("discretization_options"))
-            key = (type(model), tuple(control_names), N, dt,
-                   tuple(sorted(trans_kwargs.items())))
-            if key not in ocp_cache:
-                ocp_cache[key] = transcribe(model, control_names, N=N,
-                                            dt=dt, **trans_kwargs)
+            is_ml = isinstance(model, MLModel)
+            if is_ml:
+                # NARX shooting over the learned step (discretization
+                # options do not apply — the surrogate IS the integrator).
+                # The cache key carries the surrogate's lag STRUCTURE:
+                # same-structure agents share one transcription (their
+                # weights ride theta.ml_params); different lag layouts
+                # need their own transcribed program.
+                key = (type(model), tuple(control_names), N, dt, "ml",
+                       tuple(sorted(model.ml_lags.items())))
+                if key not in ocp_cache:
+                    ocp_cache[key] = transcribe_ml(model, control_names,
+                                                   N=N, dt=dt)
+            else:
+                trans_kwargs = transcription_kwargs_from_config(
+                    backend.get("discretization_options"))
+                key = (type(model), tuple(control_names), N, dt,
+                       tuple(sorted(trans_kwargs.items())))
+                if key not in ocp_cache:
+                    ocp_cache[key] = transcribe(model, control_names, N=N,
+                                                dt=dt, **trans_kwargs)
             ocp = ocp_cache[key]
 
             state_vals = _values(m.get("states"))
+            # ML OCPs order their state vector by dyn_names (NARX +
+            # white-box states); physical OCPs by diff_state_names
+            state_names = list(getattr(ocp, "dyn_names", None)
+                               or model.diff_state_names)
             x0 = np.array([
                 state_vals.get(n, model.get_var(n).value)
-                for n in model.diff_state_names], dtype=float)
+                for n in state_names], dtype=float)
             param_vals = _values(m.get("parameters"))
             p = np.array([
                 param_vals.get(v.name, v.value) for v in model.parameters],
@@ -357,7 +385,7 @@ class FusedFleet:
         a = self._agents_by_id()[agent_id]
         return mpc_trajectory_frame(
             self._history[agent_id],
-            trajectory_layout(a.model, a.ocp.control_names))
+            trajectory_layout(a.model, a.ocp.control_names, ocp=a.ocp))
 
     def cleanup_results(self) -> None:
         """Drop recorded history (module-path parity:

@@ -12,10 +12,21 @@ from __future__ import annotations
 import numpy as np
 
 
-def trajectory_layout(model, control_names) -> dict[str, list[str]]:
+def trajectory_layout(model, control_names,
+                      ocp=None) -> dict[str, list[str]]:
     """Column names of an OCP's result trajectories — the single
     definition of the layout contract (keys "x"/"u"/"y"/"z"), shared by
-    `OptimizationBackend.trajectory_layout` and the fused fleet."""
+    `OptimizationBackend.trajectory_layout`, the ML backend and the
+    fused fleet. Pass the transcribed ``ocp`` when available: NARX OCPs
+    order "x" by their dyn_names (learned + white-box states) and keep
+    only slack states in "z"."""
+    if ocp is not None and hasattr(ocp, "dyn_names"):
+        return {
+            "x": list(ocp.dyn_names),
+            "u": list(ocp.control_names),
+            "y": list(model.output_names),
+            "z": list(ocp.slack_names),
+        }
     return {
         "x": list(model.diff_state_names),
         "u": list(control_names),
