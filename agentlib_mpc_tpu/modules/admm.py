@@ -422,24 +422,21 @@ class ADMMModule(BaseMPC):
     def admm_results(self):
         """(time, iteration, grid) MultiIndex coupling trajectories — the
         reference's iteration-buffered ADMM results layout
-        (``casadi_/admm.py:364-424``)."""
-        import pandas as pd
+        (``casadi_/admm.py:364-424``; shared frame builder in
+        utils/results.py, also used by the fused fleet)."""
+        from agentlib_mpc_tpu.utils.results import (
+            admm_iteration_frame,
+            concat_admm_frames,
+        )
 
         if not self._iter_rows:
             return None
         grid = np.asarray(self.backend.coupling_grid, dtype=float)
-        frames = []
-        for row in self._iter_rows:
-            data = {("variable", name): traj
-                    for name, traj in row["couplings"].items()}
-            df = pd.DataFrame(data)
-            df.index = pd.MultiIndex.from_product(
-                [[row["time"]], [row["iteration"]], grid],
-                names=["time", "iteration", "grid"])
-            frames.append(df)
-        out = pd.concat(frames)
-        out.columns = pd.MultiIndex.from_tuples(out.columns)
-        return out
+        frames = [
+            admm_iteration_frame(row["time"], [row["iteration"]], grid,
+                                 row["couplings"])
+            for row in self._iter_rows]
+        return concat_admm_frames(frames)
 
     def results(self):
         """dict with 'admm' (per-iteration couplings) and 'mpc' (per-step

@@ -136,6 +136,7 @@ class FusedFleet:
         self._history: dict[str, list[dict]] = {
             a.agent_id: [] for a in self._agents}
         self._stats_rows: list[dict] = []
+        self._admm_rows: dict[str, list[dict]] = {}
         specs = [
             {"ocp": a.ocp, "theta": a.theta(N), "couplings": a.couplings,
              "exchanges": a.exchanges, "name": a.agent_id,
@@ -143,7 +144,7 @@ class FusedFleet:
             for a in self._agents
         ]
         groups, theta_batches, index_map = bucket_agents(specs)
-        self.engine = FusedADMM(groups, options)
+        self.engine = FusedADMM(groups, options, record_locals=record)
         self._theta_batches = list(theta_batches)
         self._index_map = index_map
         # agent_id -> (group index, position in the group batch)
@@ -361,6 +362,27 @@ class FusedFleet:
                 "dual": np.asarray(stats.dual_residuals)[:it],
                 "rho": np.asarray(stats.penalty)[:it],
             })
+            # per-iteration local coupling trajectories per agent (the
+            # reference's iteration-buffered ADMM record); one block per
+            # step() call, so repeated solves at one time all survive
+            per_agent: dict[str, dict[str, np.ndarray]] = {}
+            for kind, hist in (("consensus", stats.coupling_locals),
+                               ("exchange", stats.exchange_locals)):
+                for alias, arr in (hist or {}).items():
+                    arr = np.asarray(arr)[:it]       # (it, n_part, T)
+                    for a in self._agents:
+                        amap = (a.couplings if kind == "consensus"
+                                else a.exchanges)
+                        if alias not in amap:
+                            continue
+                        gi, slot = self._where[a.agent_id]
+                        row = self.engine.participant_offset(
+                            alias, kind, gi) + slot
+                        per_agent.setdefault(a.agent_id, {})[alias] = \
+                            arr[:, row, :]           # (it, T)
+            for aid, aliases_d in per_agent.items():
+                self._admm_rows.setdefault(aid, []).append(
+                    {"time": self.time, "aliases": aliases_d})
         self._last_stats = stats
         return out
 
@@ -387,6 +409,31 @@ class FusedFleet:
             self._history[agent_id],
             trajectory_layout(a.model, a.ocp.control_names, ocp=a.ocp))
 
+    def admm_results(self, agent_id: str):
+        """(time, iteration, grid) MultiIndex frame of one agent's local
+        coupling trajectories per fused iteration — the module path's
+        ``ADMMModule.admm_results`` layout (reference iteration-buffered
+        record, ``casadi_/admm.py:364-424``), so `analysis.load_admm`
+        slicing, `plot_consensus_shades` and the convergence animation
+        work on fused runs unchanged."""
+        from agentlib_mpc_tpu.utils.results import (
+            admm_iteration_frame,
+            concat_admm_frames,
+        )
+
+        rows = self._admm_rows.get(agent_id)
+        if not rows:
+            return None
+        grid = np.arange(self.N) * self.dt
+        frames = []
+        for row in rows:
+            per_alias = row["aliases"]               # alias -> (it, T)
+            # one stats object per step: every alias shares its `it`
+            n_it = next(iter(per_alias.values())).shape[0]
+            frames.append(admm_iteration_frame(
+                row["time"], range(n_it), grid, per_alias))
+        return concat_admm_frames(frames)
+
     def cleanup_results(self) -> None:
         """Drop recorded history (module-path parity:
         ``modules/mpc.py cleanup_results``) — bounds memory on long
@@ -394,6 +441,7 @@ class FusedFleet:
         for rows in self._history.values():
             rows.clear()
         self._stats_rows.clear()
+        self._admm_rows.clear()
 
     def iteration_stats(self):
         """(time, iteration)-indexed residual/penalty trail of every

@@ -143,6 +143,14 @@ class IterationStats(NamedTuple):
     #: every inner interior-point solve of every iteration reached an
     #: acceptable point (False flags inexact-budget exhaustion)
     local_solves_ok: jnp.ndarray     # () bool
+    #: per-iteration local coupling trajectories, alias ->
+    #: (max_iter, n_participants, T), NaN-padded beyond ``iterations`` —
+    #: the fused analogue of the reference's iteration-buffered ADMM
+    #: results (``casadi_/admm.py:364-424``); participant rows follow
+    #: :meth:`FusedADMM.participant_offset` order. None when the engine
+    #: was built with ``record_locals=False``.
+    coupling_locals: "dict | None" = None
+    exchange_locals: "dict | None" = None
 
 
 class FusedADMM:
@@ -151,12 +159,18 @@ class FusedADMM:
 
     def __init__(self, groups: Sequence[AgentGroup],
                  options: FusedADMMOptions = FusedADMMOptions(),
-                 active: "Sequence[jnp.ndarray] | None" = None):
+                 active: "Sequence[jnp.ndarray] | None" = None,
+                 record_locals: bool = True):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
-        run the dense math but never influence consensus results."""
+        run the dense math but never influence consensus results.
+        ``record_locals``: carry per-iteration local coupling
+        trajectories through the loop for ``IterationStats``
+        (analysis/animation data); False compiles without the history
+        buffers and the stats fields come back None."""
         self.groups = tuple(groups)
         self.options = options
+        self.record_locals = bool(record_locals)
         if active is None:
             active = [jnp.ones((g.n_agents,), bool) for g in self.groups]
         if len(active) != len(self.groups):
@@ -240,6 +254,21 @@ class FusedADMM:
                 out.append((gi, g.control_index(mapping[alias]), slot))
                 slot += 1
         return out
+
+    def _participant_count(self, alias, kind) -> int:
+        return sum(self.groups[gi].n_agents
+                   for gi, _c, _s in self._group_participations(alias, kind))
+
+    def participant_offset(self, alias: str, kind: str, gi: int) -> int:
+        """Row offset of group ``gi``'s agents in the stacked
+        ``IterationStats.coupling_locals[alias]`` / ``exchange_locals``
+        participant axis (agent ``slot`` within the group adds to it)."""
+        offs = 0
+        for gj, _c, _s in self._group_participations(alias, kind):
+            if gj == gi:
+                return offs
+            offs += self.groups[gj].n_agents
+        raise KeyError(f"group {gi} does not participate in {alias!r}")
 
     def _build_step(self):
         groups = self.groups
@@ -361,6 +390,8 @@ class FusedADMM:
                 state.w[gi], state.y[gi], state.z[gi], theta_batch, *vargs)
             return w_b, y_b, z_b, u_b, ok_b
 
+        record = self.record_locals
+
         def step_fn(state: FusedState, theta_batches: tuple):
             max_it = opts.max_iterations
 
@@ -372,7 +403,9 @@ class FusedADMM:
               # ``it == 0``, so both phases reuse a single solver trace.
               def iteration(carry):
                 (state, it, _res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist) = carry
+                 ok_hist, cl_hist, ex_hist) = carry
+                cl_hist = dict(cl_hist)
+                ex_hist = dict(ex_hist)
 
                 u_groups = []
                 w_new, y_new, z_new = [], [], []
@@ -418,6 +451,9 @@ class FusedADMM:
                         axis=0)
                     act = jnp.concatenate(
                         [self.active[gi] for gi, _, _ in parts])
+                    if record:
+                        cl_hist[alias] = \
+                            cl_hist[alias].at[it].set(locals_)
                     cstate = admm_ops.ConsensusState(
                         zbar=state.zbar[alias], lam=lam_stack,
                         rho=state.rho)
@@ -446,6 +482,9 @@ class FusedADMM:
                         axis=0)
                     act = jnp.concatenate(
                         [self.active[gi] for gi, _, _ in parts])
+                    if record:
+                        ex_hist[alias] = \
+                            ex_hist[alias].at[it].set(locals_)
                     estate = admm_ops.ExchangeState(
                         mean=state.ex_mean[alias], diff=diff_stack,
                         lam=state.ex_lam[alias], rho=state.rho)
@@ -483,21 +522,30 @@ class FusedADMM:
                     rho=rho_next, w=tuple(w_new), y=tuple(y_new),
                     z=tuple(z_new))
                 return (state, it + 1, res_all, prim_hist, dual_hist,
-                        rho_hist, is_conv, ok_hist & ok_all)
+                        rho_hist, is_conv, ok_hist & ok_all, cl_hist,
+                        ex_hist)
 
               return iteration
 
             def cond(carry):
-                _state, it, _res, _p, _d, _r, done, _ok = carry
+                done, it = carry[6], carry[1]
                 return (~done) & (it < max_it)
 
             nan_hist = jnp.full((max_it,), jnp.nan)
             init_res = AdmmResiduals(*([jnp.asarray(jnp.inf)] * 2),
                                      *([jnp.asarray(0.0)] * 4))
+            cl_hist0 = {
+                a: jnp.full((max_it, self._participant_count(a, "consensus"),
+                             self.T), jnp.nan) for a in aliases} \
+                if record else {}
+            ex_hist0 = {
+                a: jnp.full((max_it, self._participant_count(a, "exchange"),
+                             self.T), jnp.nan) for a in ex_aliases} \
+                if record else {}
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
                      jnp.full((max_it,), jnp.nan), jnp.asarray(False),
-                     jnp.asarray(True))
+                     jnp.asarray(True), cl_hist0, ex_hist0)
             # two-phase inexact ADMM: iteration 0 runs the full (cold)
             # interior-point budget, subsequent iterations the short warm
             # budget — primal, duals and barrier all carry over
@@ -505,18 +553,20 @@ class FusedADMM:
                 # one body, budgets selected inside by it == 0 (the cond
                 # admits the first iteration unconditionally: done=False)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist) = jax.lax.while_loop(
+                 ok_hist, cl_hist, ex_hist) = jax.lax.while_loop(
                     cond, make_iteration(cold=None), carry)
             else:
                 carry = make_iteration(cold=True)(carry)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist) = jax.lax.while_loop(
+                 ok_hist, cl_hist, ex_hist) = jax.lax.while_loop(
                     cond, make_iteration(cold=False), carry)
 
             stats = IterationStats(
                 iterations=it, primal_residuals=prim_hist,
                 dual_residuals=dual_hist, penalty=rho_hist, converged=done,
-                local_solves_ok=ok_hist)
+                local_solves_ok=ok_hist,
+                coupling_locals=cl_hist if record else None,
+                exchange_locals=ex_hist if record else None)
             trajs = tuple(
                 jax.vmap(lambda w, th, g=g: g.ocp.trajectories(w, th))(
                     state.w[gi], theta_batches[gi])

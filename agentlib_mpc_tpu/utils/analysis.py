@@ -58,11 +58,13 @@ def load_mpc(path) -> "pd.DataFrame":
 
 
 def load_admm(path) -> "pd.DataFrame":
-    """(time, iteration, grid)-indexed ADMM results
-    (reference ``load_admm``, same layout as ``casadi_/admm.py:364-424``)."""
+    """(time, iteration, grid)-indexed ADMM results with the two-level
+    ('variable', name) column header (reference ``load_admm`` delegates
+    to ``load_mpc`` with ``header=[0, 1]``, ``utils/analysis.py:17-25``;
+    layout from ``casadi_/admm.py:364-424``)."""
     import pandas as pd
 
-    return pd.read_csv(path, index_col=[0, 1, 2])
+    return pd.read_csv(path, index_col=[0, 1, 2], header=[0, 1])
 
 
 def load_sim(path, causality=None) -> "pd.DataFrame":

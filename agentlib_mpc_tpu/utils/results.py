@@ -35,6 +35,37 @@ def trajectory_layout(model, control_names,
     }
 
 
+def admm_iteration_frame(time, iterations, grid, columns):
+    """One (time, iteration, grid) MultiIndex block of ADMM coupling
+    trajectories — the reference's iteration-buffered layout
+    (``casadi_/admm.py:364-424``), shared by the module path
+    (`modules/admm.py admm_results`) and the fused fleet.
+
+    ``columns``: name → array reshaping to ``len(iterations) * len(grid)``
+    (either ``(n_it, G)`` or flat).
+    """
+    import pandas as pd
+
+    df = pd.DataFrame({("variable", name): np.asarray(arr).reshape(-1)
+                       for name, arr in columns.items()})
+    df.index = pd.MultiIndex.from_product(
+        [[time], list(iterations), np.asarray(grid, dtype=float)],
+        names=["time", "iteration", "grid"])
+    return df
+
+
+def concat_admm_frames(frames):
+    """Concatenate :func:`admm_iteration_frame` blocks into one results
+    frame with normalized two-level columns."""
+    import pandas as pd
+
+    if not frames:
+        return None
+    out = pd.concat(frames)
+    out.columns = pd.MultiIndex.from_tuples(out.columns)
+    return out
+
+
 def mpc_trajectory_frame(rows, layout):
     """(time, grid-offset) MultiIndex DataFrame with ('variable', name)
     columns from recorded per-step trajectories.

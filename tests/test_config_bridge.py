@@ -253,3 +253,71 @@ class TestHeterogeneousBridge:
         np.testing.assert_allclose(u_room, u_cooler, atol=2e-3)
         # warm room requests cooling; cooler supplies it
         assert u_room[0] > 1e-3
+
+
+class TestAdmmIterationRecord:
+    def test_engine_coupling_locals_match_final_trajectories(self):
+        """The last recorded iteration's locals must equal the final
+        returned control trajectories (the history is the real data, not
+        a separate computation)."""
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 90.0 + 50 * i) for i in range(3)])
+        out = fleet.step()
+        stats = fleet.last_stats
+        it = int(stats.iterations)
+        hist = np.asarray(stats.coupling_locals["mDotShared"])  # (mx,n,T)
+        assert np.all(np.isfinite(hist[:it]))
+        assert np.all(np.isnan(hist[it:]))
+        for i in range(3):
+            np.testing.assert_allclose(
+                hist[it - 1, i], out[f"Room_{i}"]["u"]["mDot"],
+                rtol=0, atol=0)
+
+    def test_admm_results_roundtrip_and_shades(self, tmp_path):
+        """(time, iteration, grid) frames load via analysis.load_admm and
+        feed plot_consensus_shades / the convergence animation — the
+        last analysis tools that needed module-path data."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from agentlib_mpc_tpu.utils.analysis import (
+            admm_at_time_step,
+            load_admm,
+        )
+        from agentlib_mpc_tpu.utils.plotting.admm import (
+            plot_consensus_shades,
+        )
+
+        fleet = FusedFleet.from_configs(
+            [_room_cfg(i, 100.0 + 60 * i) for i in range(2)])
+        for _ in range(3):
+            fleet.step()
+            fleet.advance()
+        df = fleet.admm_results("Room_1")
+        assert df.index.names == ["time", "iteration", "grid"]
+        assert ("variable", "mDotShared") in df.columns
+        path = tmp_path / "room1_admm.csv"
+        df.to_csv(path)
+        loaded = load_admm(path)
+        assert loaded.shape == df.shape
+        # slicing API works: all iterations of the second control step
+        sl = admm_at_time_step(loaded, 300.0)
+        assert len(sl) > 0
+        ax = plot_consensus_shades({"Room_1": loaded}, "mDotShared",
+                                   final_iteration_only=False)
+        assert ax.get_xlabel() == "time / s"
+
+    def test_record_false_compiles_without_history(self):
+        """record=False builds the engine without the per-iteration
+        buffers: stats fields None, accessors empty, step still works."""
+        agents = FusedFleet.from_configs(
+            [_room_cfg(i, 110.0) for i in range(2)])._agents
+        from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet as F
+        fleet = F(agents, N=6,
+                  options=FusedADMMOptions(max_iterations=6, rho=20.0),
+                  record=False)
+        out = fleet.step()
+        assert out["Room_0"]["converged"] in (True, False)
+        assert fleet.last_stats.coupling_locals is None
+        assert fleet.admm_results("Room_0") is None
+        assert fleet.iteration_stats() is None
