@@ -224,9 +224,15 @@ def main() -> None:
 
     vs_baseline = 0.0
     try:
+        # the CPU probe must never touch the TPU tunnel: with
+        # PALLAS_AXON_POOL_IPS unset the axon sitecustomize skips its
+        # register() dial entirely (a wedged tunnel otherwise hangs the
+        # subprocess at interpreter start, before --probe even runs)
+        probe_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        probe_env.pop("PALLAS_AXON_POOL_IPS", None)
         probe = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--probe"],
-            capture_output=True, text=True, timeout=1200,
+            capture_output=True, text=True, timeout=1200, env=probe_env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         cpu = json.loads(probe.stdout.strip().splitlines()[-1])
         print(f"[bench] cpu baseline step={cpu['step_ms']:.1f}ms",
