@@ -73,3 +73,20 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:  # noqa: BLE001 - backends already initialized
         pass
+
+
+def cpu_subprocess_env(base: "dict | None" = None) -> dict:
+    """Environment for a CPU-only child process that must NEVER touch the
+    TPU tunnel.
+
+    The image's axon ``sitecustomize`` gates its relay dial (which hangs
+    the interpreter when the tunnel is wedged) on ``PALLAS_AXON_POOL_IPS``
+    — scrubbing it means the axon platform is never registered and a
+    launch-time ``JAX_PLATFORMS=cpu`` pin is safe. Single definition of
+    the scrub set, used by ``bench.py`` (CPU baseline probe) and
+    ``__graft_entry__.py`` (multichip dry-run child).
+    """
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

@@ -191,9 +191,10 @@ def run_scaling() -> list[dict]:
 
 def main() -> None:
     if "--probe" in sys.argv:
-        # subprocess mode: force host CPU *in-process* (setting JAX_PLATFORMS
-        # at launch can hang under the axon sitecustomize, which imports jax
-        # at interpreter startup), then print the measurement
+        # subprocess mode: the launcher hands us a scrubbed env
+        # (cpu_subprocess_env) so the axon sitecustomize never dials the
+        # tunnel; the in-process override is belt-and-braces for direct
+        # --probe invocations from an unscrubbed shell
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -224,12 +225,11 @@ def main() -> None:
 
     vs_baseline = 0.0
     try:
-        # the CPU probe must never touch the TPU tunnel: with
-        # PALLAS_AXON_POOL_IPS unset the axon sitecustomize skips its
-        # register() dial entirely (a wedged tunnel otherwise hangs the
-        # subprocess at interpreter start, before --probe even runs)
-        probe_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-        probe_env.pop("PALLAS_AXON_POOL_IPS", None)
+        from agentlib_mpc_tpu.utils.jax_setup import cpu_subprocess_env
+
+        # the CPU probe must never touch the TPU tunnel (a wedged tunnel
+        # hangs the child at interpreter start, before --probe runs)
+        probe_env = cpu_subprocess_env()
         probe = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--probe"],
             capture_output=True, text=True, timeout=1200, env=probe_env,
