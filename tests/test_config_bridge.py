@@ -171,6 +171,7 @@ class TestExchangeBridge:
 
 
 class TestFleetResults:
+    @pytest.mark.slow
     def test_results_roundtrip_through_analysis_loader(self, tmp_path):
         """Fused-fleet history writes/loads as the reference MPC CSV
         layout (utils/analysis.load_mpc) — the module path's format."""
@@ -193,6 +194,7 @@ class TestFleetResults:
         loaded = load_mpc(path)
         assert loaded.shape[0] == df.shape[0]
 
+    @pytest.mark.slow
     def test_iteration_stats_trail(self):
         fleet = FusedFleet.from_configs(
             [_room_cfg(i, 120.0) for i in range(2)])
@@ -217,6 +219,7 @@ class TestFleetResults:
 
 
 class TestHeterogeneousBridge:
+    @pytest.mark.slow
     def test_room_cooler_pair_as_two_groups(self):
         """Different model classes bucket into separate vmapped groups
         that consensus-couple ACROSS groups — the reference's
@@ -273,6 +276,7 @@ class TestAdmmIterationRecord:
                 hist[it - 1, i], out[f"Room_{i}"]["u"]["mDot"],
                 rtol=0, atol=0)
 
+    @pytest.mark.slow
     def test_admm_results_roundtrip_and_shades(self, tmp_path):
         """(time, iteration, grid) frames load via analysis.load_admm and
         feed plot_consensus_shades / the convergence animation — the
