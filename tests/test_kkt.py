@@ -114,3 +114,18 @@ def test_kkt_method_probe_cpu_falls_back():
     assert kkt.kkt_method_available() is False
     assert kkt.kkt_method_available(92) is False
     assert kkt._PROBE_RESULT.get(("cpu", 96)) is False
+
+
+def test_pallas_interpret_production_size():
+    """The exact TPU kernel at the PRODUCTION tile shape: the 256-zone
+    benchmark factors 92-dim KKT systems, padding to (96, 96, 128) — the
+    same padded shape the size-aware availability probe compiles on real
+    hardware. Raw-kernel residual (no equilibration/refinement) must
+    already be small."""
+    K, rhs = _quasi_definite_batch(2, 61, 31, seed=9)
+    LD = kkt._ldl_factor_batched(K, interpret=True)
+    x = kkt._ldl_solve_batched(LD, rhs, interpret=True)
+    assert _residual(K, x, rhs) < 1e-2
+    # and through the full solve path (equilibration + refinement)
+    x2 = jax.vmap(kkt.solve_kkt_ldl)(K, rhs)
+    assert _residual(K, x2, rhs) < 1e-3
