@@ -272,8 +272,17 @@ class FusedFleet:
                 param_vals.get(v.name, v.value) for v in model.parameters],
                 dtype=float)
             input_vals = _values(m.get("inputs"))
-            exo = {n: float(input_vals.get(n, model.get_var(n).value))
-                   for n in ocp.exo_names}
+            exo = {}
+            for n in ocp.exo_names:
+                val = input_vals.get(n, model.get_var(n).value)
+                if val is None:
+                    raise ValueError(
+                        f"agent {cfg.get('id', f'agent{len(agents)}')!r}: "
+                        f"exogenous input {n!r} has no value in the config "
+                        f"and no default in the model — add it to the "
+                        f"module's 'inputs' list or give the model "
+                        f"variable a default value")
+                exo[n] = float(val)
 
             agents.append(_FleetAgent(
                 agent_id=str(cfg.get("id", f"agent{len(agents)}")),
@@ -324,7 +333,11 @@ class FusedFleet:
         """One coordinated ADMM round for the whole fleet.
 
         Returns per-agent results: ``{"u": {name: (N,) array}, "x": ...,
-        "converged": bool, "iterations": int}``.
+        "converged": bool, "iterations": int}``. ``converged`` and
+        ``iterations`` are **fleet-wide** values (the fused round has one
+        Boyd convergence check and one iteration count for all agents,
+        like the reference coordinator); they are replicated into every
+        agent's dict for ergonomic per-agent consumption.
         """
         self.state, trajs, stats = self.engine.step(
             self.state, self._theta_batches)

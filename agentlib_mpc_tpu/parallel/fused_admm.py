@@ -160,14 +160,18 @@ class FusedADMM:
     def __init__(self, groups: Sequence[AgentGroup],
                  options: FusedADMMOptions = FusedADMMOptions(),
                  active: "Sequence[jnp.ndarray] | None" = None,
-                 record_locals: bool = True):
+                 record_locals: bool = False):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results.
         ``record_locals``: carry per-iteration local coupling
         trajectories through the loop for ``IterationStats``
-        (analysis/animation data); False compiles without the history
-        buffers and the stats fields come back None."""
+        (analysis/animation data). Off by default: the history buffers
+        are (max_iterations × participants × T) per alias and ride the
+        while_loop carry, growing memory traffic and compile time even
+        when unused. :class:`~agentlib_mpc_tpu.parallel.config_bridge.FusedFleet`
+        opts in when built with ``record=True`` (its default) because its
+        results/animation API consumes them."""
         self.groups = tuple(groups)
         self.options = options
         self.record_locals = bool(record_locals)

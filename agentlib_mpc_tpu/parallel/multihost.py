@@ -52,6 +52,11 @@ import os
 import jax
 from jax.sharding import Mesh
 
+# set after a successful jax.distributed.initialize in THIS process, so
+# repeated initialize_multihost calls are idempotent without depending on
+# the wording of JAX's already-initialized error message
+_initialized = False
+
 
 def initialize_multihost(
     coordinator_address: str | None = None,
@@ -78,11 +83,16 @@ def initialize_multihost(
     if coordinator_address is None and num_processes in (None, 1):
         return False  # single-process: nothing to initialize
 
+    global _initialized
+    if _initialized:
+        return True
+
     # NOTE: nothing here may touch the backend (jax.devices(),
     # jax.process_count(), ...) before initialize() — that would
-    # initialize XLA and make distributed init impossible. Idempotence is
-    # handled by catching initialize()'s own already-initialized error;
-    # a "must be called before any JAX calls" error is a real caller bug
+    # initialize XLA and make distributed init impossible. The flag above
+    # handles idempotence within this process; the message sniff below is
+    # only a fallback for an initialize() done outside this module. A
+    # "must be called before any JAX calls" error is a real caller bug
     # and propagates.
     try:
         jax.distributed.initialize(
@@ -94,6 +104,7 @@ def initialize_multihost(
         msg = str(exc).lower()
         if "once" not in msg and "already" not in msg:
             raise
+    _initialized = True
     return True
 
 
