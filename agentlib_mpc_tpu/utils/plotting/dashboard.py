@@ -249,7 +249,7 @@ def solver_figure(stats):
     if "iterations" in table.columns:
         fig.add_trace(go.Scatter(
             x=x, y=np.asarray(table["iterations"], dtype=float),
-            mode="lines+markers", name="iterations", yaxis="y1"))
+            mode="lines+markers", name="iterations", yaxis="y"))
     if "solve_wall_time" in table.columns:
         fig.add_trace(go.Scatter(
             x=x, y=1e3 * np.asarray(table["solve_wall_time"], dtype=float),

@@ -1,0 +1,258 @@
+"""Structural validator for the plotly figures this package emits.
+
+This environment (and many headless deployments) has no plotly installed,
+so the dash/plotly layer (``dashboard.py``) cannot be smoke-tested against
+the real library — yet an attribute typo (``line={"colour": ...}``,
+``mode="line"``, ``yaxis="y-2"``) would only surface at the user's first
+``show_dashboard`` call. This module vendors the *relevant subset* of the
+public plotly.js figure schema — attribute names, enum values and value
+shapes for the scatter traces and layout keys the builders actually use —
+and validates figure structures against it, the same contract
+``plotly.graph_objects`` enforces with ``validate=True``.
+
+Scope is deliberately the package's own figure vocabulary (scatter traces,
+cartesian axes, margins): it is a golden-structure gate for
+``dashboard.py``/``interactive.py`` (reference surface:
+``utils/plotting/mpc_dashboard.py``, ``admm_dashboard.py``,
+``interactive.py``), not a general plotly replacement. Unknown attributes
+FAIL — exactly how an API typo is caught.
+"""
+
+from __future__ import annotations
+
+import numbers
+import re
+
+__all__ = [
+    "SchemaError",
+    "validate_trace",
+    "validate_layout",
+    "validate_figure",
+]
+
+
+class SchemaError(ValueError):
+    """A figure structure that plotly would reject (or silently drop)."""
+
+
+# -- value validators --------------------------------------------------------
+
+_NAMED_COLORS = {
+    "black", "white", "red", "green", "blue", "gray", "grey", "orange",
+    "purple", "cyan", "magenta", "yellow", "lightgray", "lightgrey",
+    "darkgray", "darkgrey", "steelblue", "firebrick", "seagreen",
+}
+_COLOR_RE = re.compile(
+    r"^(#[0-9a-fA-F]{3}|#[0-9a-fA-F]{6}|#[0-9a-fA-F]{8}"
+    r"|rgb\(\s*\d{1,3}\s*,\s*\d{1,3}\s*,\s*\d{1,3}\s*\)"
+    r"|rgba\(\s*\d{1,3}\s*,\s*\d{1,3}\s*,\s*\d{1,3}\s*,"
+    r"\s*(0|1|0?\.\d+|1\.0+)\s*\))$")
+# trace-side axis references: "y", "y2", "y3", ... (plotly.js: /^y([2-9]|
+# [1-9][0-9]+)?$/ — "y1" is not a valid subplot ref, the first axis is "y")
+_TRACE_AXIS_RE = {"x": re.compile(r"^x([2-9]|[1-9]\d+)?$"),
+                  "y": re.compile(r"^y([2-9]|[1-9]\d+)?$")}
+# layout-side axis container keys: "yaxis", "yaxis2", ...
+_LAYOUT_AXIS_RE = re.compile(r"^([xy])axis([2-9]|[1-9]\d+)?$")
+
+_SCATTER_MODE_FLAGS = {"lines", "markers", "text"}
+_DASH_STYLES = {"solid", "dot", "dash", "longdash", "dashdot",
+                "longdashdot"}
+
+
+def _is_color(v) -> bool:
+    return isinstance(v, str) and (
+        v.lower() in _NAMED_COLORS or bool(_COLOR_RE.match(v)))
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def _is_array(v) -> bool:
+    return hasattr(v, "__len__") and not isinstance(v, (str, dict))
+
+
+def _check(cond: bool, path: str, msg: str) -> None:
+    if not cond:
+        raise SchemaError(f"{path}: {msg}")
+
+
+def _check_mode(v, path):
+    _check(isinstance(v, str), path, f"mode must be a string, got {v!r}")
+    parts = v.split("+")
+    bad = [p for p in parts if p not in _SCATTER_MODE_FLAGS]
+    _check(not bad and len(parts) == len(set(parts)), path,
+           f"invalid scatter mode {v!r} (flaglist over "
+           f"{sorted(_SCATTER_MODE_FLAGS)})")
+
+
+def _check_enum(allowed):
+    def check(v, path):
+        _check(v in allowed, path, f"{v!r} not one of {sorted(allowed)}")
+    return check
+
+
+def _check_color(v, path):
+    _check(_is_color(v), path, f"{v!r} is not a CSS color plotly accepts "
+                               f"(hex / rgb() / rgba() / named)")
+
+
+def _check_num(v, path):
+    _check(_is_num(v), path, f"expected a number, got {v!r}")
+
+
+def _check_str(v, path):
+    _check(isinstance(v, str), path, f"expected a string, got {v!r}")
+
+
+def _check_bool(v, path):
+    _check(isinstance(v, bool), path, f"expected a bool, got {v!r}")
+
+
+def _check_array(v, path):
+    _check(_is_array(v), path, f"expected an array-like, got {type(v)}")
+
+
+def _check_title(v, path):
+    # plotly accepts a plain string (auto-wrapped) or {"text": ...}
+    if isinstance(v, str):
+        return
+    _check(isinstance(v, dict) and set(v) <= {"text", "font", "x", "y"},
+           path, f"title must be a string or {{'text': ...}}, got {v!r}")
+
+
+def _axis_ref_checker(letter):
+    def check(v, path):
+        _check(isinstance(v, str) and
+               bool(_TRACE_AXIS_RE[letter].match(v)), path,
+               f"{v!r} is not a valid {letter}-axis reference "
+               f"('{letter}', '{letter}2', ...)")
+    return check
+
+
+# -- vendored schema subset --------------------------------------------------
+
+_LINE_SCHEMA = {"color": _check_color, "width": _check_num,
+                "dash": _check_enum(_DASH_STYLES), "shape": _check_enum(
+                    {"linear", "spline", "hv", "vh", "hvh", "vhv"})}
+_MARKER_SCHEMA = {"color": _check_color, "size": _check_num,
+                  "symbol": _check_str, "opacity": _check_num}
+
+
+def _check_nested(schema):
+    def check(v, path):
+        _check(isinstance(v, dict), path, f"expected a dict, got {v!r}")
+        for k, val in v.items():
+            _check(k in schema, f"{path}.{k}", "unknown attribute")
+            schema[k](val, f"{path}.{k}")
+    return check
+
+
+SCATTER_SCHEMA = {
+    "x": _check_array,
+    "y": _check_array,
+    "mode": _check_mode,
+    "name": _check_str,
+    "text": lambda v, p: None,
+    "showlegend": _check_bool,
+    "legendgroup": _check_str,
+    "hovertemplate": _check_str,
+    "hoverinfo": _check_str,
+    "opacity": _check_num,
+    "visible": _check_enum({True, False, "legendonly"}),
+    "xaxis": _axis_ref_checker("x"),
+    "yaxis": _axis_ref_checker("y"),
+    "line": _check_nested(_LINE_SCHEMA),
+    "marker": _check_nested(_MARKER_SCHEMA),
+    "fill": _check_enum({"none", "tozeroy", "tozerox", "tonexty",
+                         "tonextx", "toself", "tonext"}),
+    "fillcolor": _check_color,
+}
+
+def _check_overlaying(v, path):
+    ok = isinstance(v, str) and (
+        v == "free"
+        or (v[:1] in _TRACE_AXIS_RE and
+            bool(_TRACE_AXIS_RE[v[0]].match(v))))
+    _check(ok, path, f"{v!r} is not a valid overlaying target "
+                     f"('free', 'x', 'y', 'y2', ...)")
+
+
+_AXIS_SCHEMA = {
+    "title": _check_title,
+    "type": _check_enum({"-", "linear", "log", "date", "category"}),
+    "range": _check_array,
+    "overlaying": _check_overlaying,
+    "side": _check_enum({"left", "right", "top", "bottom"}),
+    "showgrid": _check_bool,
+    "zeroline": _check_bool,
+    "autorange": _check_enum({True, False, "reversed"}),
+}
+
+_MARGIN_SCHEMA = {"l": _check_num, "r": _check_num, "t": _check_num,
+                  "b": _check_num, "pad": _check_num,
+                  "autoexpand": _check_bool}
+
+LAYOUT_SCHEMA = {
+    "title": _check_title,
+    "height": _check_num,
+    "width": _check_num,
+    "margin": _check_nested(_MARGIN_SCHEMA),
+    "showlegend": _check_bool,
+    "hovermode": _check_enum({"x", "y", "closest", False, "x unified",
+                              "y unified"}),
+    "template": lambda v, p: None,
+    "legend": lambda v, p: _check(isinstance(v, dict), p,
+                                  f"expected a dict, got {v!r}"),
+    "xaxis_title": _check_title,   # magic-underscore shorthands plotly
+    "yaxis_title": _check_title,   # expands to <axis>.title
+}
+
+TRACE_SCHEMAS = {"scatter": SCATTER_SCHEMA}
+
+
+# -- public API --------------------------------------------------------------
+
+def validate_trace(trace_type: str, attrs: dict) -> None:
+    """Validate one trace's attributes; raises :class:`SchemaError` on an
+    attribute plotly's scatter schema does not define or a value outside
+    its enum/shape."""
+    _check(trace_type in TRACE_SCHEMAS, trace_type,
+           f"unsupported trace type (validator covers "
+           f"{sorted(TRACE_SCHEMAS)})")
+    schema = TRACE_SCHEMAS[trace_type]
+    for k, v in attrs.items():
+        _check(k in schema, f"{trace_type}.{k}", "unknown attribute")
+        schema[k](v, f"{trace_type}.{k}")
+
+
+def validate_layout(attrs: dict) -> None:
+    """Validate layout attributes, including ``xaxis``/``yaxisN`` axis
+    containers and plotly's ``xaxis_title``-style magic underscores."""
+    for k, v in attrs.items():
+        if _LAYOUT_AXIS_RE.match(k):
+            _check_nested(_AXIS_SCHEMA)(v, f"layout.{k}")
+            continue
+        _check(k in LAYOUT_SCHEMA, f"layout.{k}", "unknown attribute")
+        LAYOUT_SCHEMA[k](v, f"layout.{k}")
+
+
+def validate_figure(fig: dict) -> None:
+    """Validate a whole figure dict ``{"data": [...], "layout": {...}}``:
+    every trace, the layout, and the cross-references — a trace pointing
+    at ``yaxis="y2"`` requires a ``layout.yaxis2`` definition (plotly
+    silently renders such traces on a missing axis; here it fails)."""
+    _check(isinstance(fig, dict) and set(fig) <= {"data", "layout"},
+           "figure", f"expected {{'data', 'layout'}}, got {sorted(fig)}")
+    layout = fig.get("layout", {})
+    validate_layout(layout)
+    for i, trace in enumerate(fig.get("data", [])):
+        trace = dict(trace)
+        ttype = trace.pop("type", "scatter")
+        validate_trace(ttype, trace)
+        for letter in ("x", "y"):
+            ref = trace.get(f"{letter}axis")
+            if ref and ref != letter:  # non-default axis must exist
+                key = f"{letter}axis{ref[1:]}"
+                _check(key in layout, f"data[{i}].{letter}axis",
+                       f"references {ref!r} but layout has no {key!r}")
