@@ -1,0 +1,107 @@
+"""Worker process for the two-process ``jax.distributed`` test.
+
+Spawned (twice) by ``test_multihost.py::test_two_process_distributed_
+fused_step``: each process owns 4 virtual CPU devices, joins the JAX
+multi-controller runtime through ``initialize_multihost``, and runs ONE
+fused consensus-ADMM step over the 8-device GLOBAL mesh — the consensus
+mean lowers to a cross-process all-reduce over the Gloo/DCN transport,
+which is exactly the code path a TPU pod run takes across hosts
+(``parallel/multihost.py``; evidence parity with the reference's real
+spawned-process ADMM test, ``tests/test_examples.py:170-186``).
+
+Prints one JSON line with the converged consensus trajectory; the parent
+asserts both processes agree with each other AND with the single-process
+result.
+"""
+
+import json
+import os
+import sys
+
+
+def _host(x):
+    import numpy as np
+
+    return np.asarray(x.addressable_data(0)) if hasattr(
+        x, "addressable_data") else np.asarray(x)
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))  # repo root (package import)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from agentlib_mpc_tpu.parallel.multihost import (
+        fleet_mesh,
+        initialize_multihost,
+    )
+
+    assert initialize_multihost(f"localhost:{port}", nproc, pid)
+    # repeat call must be an idempotent no-op (module-level flag, not
+    # error-message sniffing)
+    assert initialize_multihost(f"localhost:{port}", nproc, pid)
+
+    # materialize the backend NOW, while this process's XLA_FLAGS still
+    # say 4 virtual devices — the conftest import below appends its own
+    # 8-device flag to os.environ at module level, which must not affect
+    # this already-initialized process
+    assert jax.local_device_count() == 4, jax.local_device_count()
+
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from conftest import make_tracker_model
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+    from agentlib_mpc_tpu.parallel import (
+        AgentGroup,
+        FusedADMM,
+        FusedADMMOptions,
+    )
+    from agentlib_mpc_tpu.parallel.fused_admm import stack_params
+
+    Tracker = make_tracker_model(lb=-10.0, ub=10.0)
+    ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                     method="multiple_shooting")
+    group = AgentGroup(
+        name="trackers", ocp=ocp, n_agents=8,
+        couplings={"shared_u": "u"},
+        solver_options=SolverOptions(tol=1e-8, max_iter=30))
+    engine = FusedADMM(
+        [group], FusedADMMOptions(max_iterations=25, rho=2.0,
+                                  abs_tol=1e-6, rel_tol=1e-5))
+    thetas = stack_params([
+        ocp.default_params(p=jnp.array([float(a)])) for a in range(8)])
+
+    mesh = fleet_mesh()  # all GLOBAL devices, process-major
+    assert mesh.devices.size == nproc * jax.local_device_count()
+    state, th = engine.shard_args(mesh, engine.init_state([thetas]),
+                                  [thetas])
+    state2, _trajs, stats = engine.step(state, th)
+    jax.block_until_ready(state2.zbar["shared_u"])
+
+    print(json.dumps({
+        "pid": pid,
+        "n_processes": int(jax.process_count()),
+        "n_global_devices": len(jax.devices()),
+        "converged": bool(_host(stats.converged)),
+        "iterations": int(_host(stats.iterations)),
+        "zbar": _host(state2.zbar["shared_u"]).ravel().tolist(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
