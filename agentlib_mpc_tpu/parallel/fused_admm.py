@@ -93,12 +93,16 @@ class AgentGroup:
     #: inner budget for warm ADMM iterations (primal+dual+barrier all
     #: warm-started, so a short budget suffices; wall time of a vmapped
     #: while_loop is the slowest lane's count). None -> solver_options
-    #: with max_iter capped at 6. (The 256-zone bench runs warm budget 2
-    #: — swept equal-quality there — but bench lanes always run to
+    #: with max_iter capped at 6. (The 256-zone bench runs warm budget 1
+    #: with the Mehrotra corrector — swept equal-quality there, PERF.md
+    #: "Corrector in the warm phase" — but bench lanes always run to
     #: budget; here the solver's own convergence exit stops early lanes,
     #: so the cap only binds when deeper solves are genuinely needed and
     #: truncation would cost consensus accuracy, e.g. heterogeneous
-    #: pairs at few outer iterations.)
+    #: pairs at few outer iterations. For latency-bound fleets where the
+    #: warm cap DOES bind, set ``solver_options=...corrector=True`` and a
+    #: tighter warm ``max_iter`` — enable it in both phases so the cold
+    #: and warm solves keep sharing one trace.)
     warm_solver_options: "SolverOptions | None" = None
 
     def control_index(self, var_name: str) -> int:
