@@ -1,0 +1,145 @@
+"""bench.py fail-soft orchestration (round-4 fix for VERDICT r3 #1a).
+
+Round 3 lost its benchmark to a wedged TPU tunnel (BENCH_r03:
+``rc=1, parsed=null``). These tests pin the contract that made that
+impossible: whatever the platform probe / worker children do — hang,
+crash, emit garbage — ``bench.py`` exits 0 and prints a headline JSON
+line with a ``platform`` field. Children are faked at the ``_spawn`` /
+``_default_platform`` seam so no JAX, no subprocesses, no timing.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench
+
+
+def _headline_lines(capsys) -> list[dict]:
+    out = capsys.readouterr().out
+    return [json.loads(ln) for ln in out.strip().splitlines()
+            if ln.startswith("{")]
+
+
+def _fake_measurement(step_ms=100.0, platform="cpu") -> dict:
+    return {"n_agents": 256, "step_ms": step_ms, "compile_ms": 5000.0,
+            "agents_per_sec": 256 / (step_ms / 1e3),
+            "zone_iters_per_sec": 2560 / (step_ms / 1e3),
+            "platform": platform}
+
+
+@pytest.fixture(autouse=True)
+def _plain_argv(monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+
+
+class TestFailsoft:
+    def test_wedged_tunnel_degrades_to_cpu(self, monkeypatch, capsys):
+        """Probe times out (returns None) → CPU probe child runs, JSON
+        carries platform=cpu and the fallback flag."""
+        monkeypatch.setattr(bench, "_default_platform", lambda: None)
+        calls = []
+
+        def fake_spawn(args, env, timeout):
+            # record only — assertions inside this fake would be
+            # swallowed by main()'s catch-all and surface as a
+            # misleading catastrophe JSON; assert after main() returns
+            calls.append((args, env))
+            return [_fake_measurement()]
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["metric"] == "admm256_step_ms"
+        assert line["value"] == 100.0
+        assert line["platform"] == "cpu"
+        assert line["tpu_fallback_to_cpu"] is True
+        assert line["vs_baseline"] == 1.0
+        assert calls, "CPU child never spawned"
+        args, env = calls[0]
+        assert "--probe" in args, "a dead platform must go to the CPU child"
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        assert "PALLAS_AXON_POOL_IPS" not in env
+
+    def test_tpu_worker_crash_degrades_to_cpu(self, monkeypatch, capsys):
+        """Probe says TPU, but the worker child dies → CPU fallback."""
+        monkeypatch.setattr(bench, "_default_platform", lambda: "axon")
+
+        def fake_spawn(args, env, timeout):
+            if "--worker" in args:
+                raise RuntimeError("bench child rc=1: tunnel reset")
+            return [_fake_measurement()]
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "cpu"
+        assert line["tpu_fallback_to_cpu"] is True
+        assert line["value"] == 100.0
+
+    def test_healthy_tpu_reports_vs_cpu_baseline(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "_default_platform", lambda: "axon")
+
+        def fake_spawn(args, env, timeout):
+            if "--worker" in args:
+                return [_fake_measurement(step_ms=100.0, platform="axon")]
+            return [_fake_measurement(step_ms=1500.0)]
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "axon"
+        assert line["tpu_fallback_to_cpu"] is False
+        assert line["vs_baseline"] == 15.0
+
+    def test_cpu_only_machine_is_not_a_fallback(self, monkeypatch, capsys):
+        """A machine whose default platform IS cpu is a normal run."""
+        monkeypatch.setattr(bench, "_default_platform", lambda: "cpu")
+        monkeypatch.setattr(bench, "_spawn",
+                            lambda *a, **k: [_fake_measurement()])
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "cpu"
+        assert line["tpu_fallback_to_cpu"] is False
+
+    def test_catastrophe_still_emits_json(self, monkeypatch, capsys):
+        """Even probe + both children failing must print a parsable
+        headline line and exit cleanly (the round-3 lesson)."""
+        monkeypatch.setattr(bench, "_default_platform", lambda: None)
+
+        def dead_spawn(args, env, timeout):
+            raise RuntimeError("everything is broken")
+
+        monkeypatch.setattr(bench, "_spawn", dead_spawn)
+        bench.main()  # must not raise
+        line = _headline_lines(capsys)[-1]
+        assert line["metric"] == "admm256_step_ms"
+        assert line["value"] is None
+        assert line["platform"] == "unavailable"
+        assert "error" in line
+
+    def test_scaling_mode_always_emits_json(self, monkeypatch, capsys):
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--scaling"])
+        monkeypatch.setattr(bench, "_default_platform", lambda: None)
+        monkeypatch.setattr(
+            bench, "_spawn",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("dead")))
+        bench.main()  # must not raise
+        line = _headline_lines(capsys)[-1]
+        assert line["value"] is None
+        assert line["platform"] == "unavailable"
+
+    def test_spawn_rejects_json_free_child(self, monkeypatch):
+        class FakeProc:
+            returncode = 0
+            stdout = "no json here\n"
+            stderr = ""
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        with pytest.raises(RuntimeError, match="no JSON"):
+            bench._spawn(["--worker"], {}, 1.0)
