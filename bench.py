@@ -21,6 +21,13 @@ Modes:
                                 # prints ONE JSON line
     python bench.py --scaling   # 4/16/64/256-zone curve (BASELINE.md rows),
                                 # prints one JSON line per size + a table
+    python bench.py --ab        # A/B the solver latency knobs on hardware
+    python bench.py --sequential [n]    # architecture baseline: SAME
+                                # solver driven one-call-per-zone like the
+                                # reference coordinator (BASELINE.md
+                                # "Architecture decomposition")
+    python bench.py --conventional [n]  # independent-solver baseline:
+                                # sequential per-zone SciPy SLSQP
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -41,9 +48,39 @@ ADMM_ITERS = 10
 DT = 300.0
 SCALING_SIZES = (4, 16, 64, 256)
 
+# ONE definition of the solver configuration and inner-budget schedule,
+# shared by the fused program (build_step) and the sequential
+# architecture baseline (run_sequential_native) — the A/B is only valid
+# while both run the identical solver setup. Values from the round-3/4
+# sweeps (PERF.md): Mehrotra corrector ON, cold 10 / warm 1, barrier
+# 0.1 cold / 1e-2 warm.
+SOLVER_BASE = {"tol": 1e-4, "max_iter": 10, "corrector": True}
+COLD_BUDGET, WARM_BUDGET = 10, 1
+COLD_MU, WARM_MU = 0.1, 1e-2
+ZONE_X0_RANGE = (294.0, 300.0)
+ZONE_LOAD_RANGE = (80.0, 250.0)
+
+
+def fleet_inputs(n_agents: int):
+    """Per-zone initial temperatures and loads (the heterogeneity axis)."""
+    import numpy as np
+
+    return (np.linspace(*ZONE_X0_RANGE, n_agents),
+            np.linspace(*ZONE_LOAD_RANGE, n_agents))
+
+
+def zone_ocp():
+    """The per-zone OCP every bench mode solves (61-var collocation NLP)."""
+    from agentlib_mpc_tpu.models.zoo import ZoneWithSupply
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    return transcribe(ZoneWithSupply(), ["mDot"], N=HORIZON, dt=DT,
+                      method="collocation", collocation_degree=2)
+
 
 def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
-               warm_budget: int = 1):
+               warm_budget: int = WARM_BUDGET,
+               cold_budget: int = COLD_BUDGET):
     import jax
     import jax.numpy as jnp
 
@@ -51,17 +88,13 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
 
     enable_persistent_cache()
 
-    from agentlib_mpc_tpu.models.zoo import ZoneWithSupply
     from agentlib_mpc_tpu.ops.solver import (
         NLPFunctions,
         SolverOptions,
         solve_nlp,
     )
-    from agentlib_mpc_tpu.ops.transcription import transcribe
 
-    model = ZoneWithSupply()
-    ocp = transcribe(model, ["mDot"], N=HORIZON, dt=DT,
-                     method="collocation", collocation_degree=2)
+    ocp = zone_ocp()
 
     def f_aug(w, theta):
         ocp_theta, zbar, lam, rho = theta
@@ -84,7 +117,7 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
     # PERF.md "Corrector in the warm phase"): its second back-substitution
     # per iteration buys warm budget 1 at equal-or-better consensus
     # spread — a 32% cut in sequential inner iterations per control step.
-    base_opts = {"tol": 1e-4, "max_iter": 10, "corrector": True}
+    base_opts = dict(SOLVER_BASE)
     base_opts.update(solver_overrides or {})
     opts = SolverOptions(**base_opts)
 
@@ -114,8 +147,8 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
     # means a single solver trace (the jit trace cache is trace-context-
     # sensitive, so a separate cold call outside the loop would trace the
     # whole interior-point method twice).
-    budgets = jnp.full((ADMM_ITERS,), warm_budget).at[0].set(10)
-    mu0s = jnp.full((ADMM_ITERS,), 1e-2).at[0].set(0.1)
+    budgets = jnp.full((ADMM_ITERS,), warm_budget).at[0].set(cold_budget)
+    mu0s = jnp.full((ADMM_ITERS,), WARM_MU).at[0].set(COLD_MU)
 
     def control_step(x0s, loads, w_gs, y_gs, z_gs, zbar, lams, rho):
         def admm_iter(carry, x):
@@ -132,8 +165,9 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
         return carry
 
     theta0 = ocp.default_params()
-    x0s = jnp.linspace(294.0, 300.0, n_agents).reshape(n_agents, 1)
-    loads = jnp.linspace(80.0, 250.0, n_agents)
+    x0s_np, loads_np = fleet_inputs(n_agents)
+    x0s = jnp.asarray(x0s_np).reshape(n_agents, 1)
+    loads = jnp.asarray(loads_np)
     w_gs = jnp.broadcast_to(ocp.initial_guess(theta0), (n_agents, ocp.n_w))
     y_gs = jnp.zeros((n_agents, ocp.n_g))
     z_gs = jnp.full((n_agents, ocp.n_h), 0.1)
@@ -146,7 +180,7 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
 
 def measure(n_agents: int = N_AGENTS,
             solver_overrides: dict | None = None,
-            warm_budget: int = 1) -> dict:
+            warm_budget: int = WARM_BUDGET) -> dict:
     import jax
 
     step, args = build_step(n_agents, solver_overrides, warm_budget)
@@ -195,6 +229,245 @@ def run_scaling() -> list[dict]:
             "platform": res["platform"],
         }))
     return rows
+
+
+def run_conventional(n_agents: int = N_AGENTS,
+                     admm_iters: int = ADMM_ITERS) -> dict:
+    """Measured stand-in for the reference's solver architecture: ONE
+    sequential compiled-solver NLP call per zone per ADMM iteration,
+    coordinator updates on the host between calls — the structure of
+    ``admm_coordinator.py:259-321`` driving per-agent CasADi/IPOPT
+    solves (``casadi_backend.py:133-139``), on identical hardware and
+    the identical 256-zone workload.
+
+    The per-zone solver is SciPy SLSQP (compiled Fortran SQP, the same
+    class of method IPOPT belongs to) with ONE fused XLA-jitted callback
+    per solver iteration evaluating objective+gradient+constraints+
+    Jacobians together, memoized by iterate — compiled derivatives with
+    a single Python dispatch per iteration, the most charitable stand-in
+    for CasADi's C boundary this environment allows. Zones are
+    warm-started across iterations and steps like the reference's
+    ``_determine_initial_guess``. What this measures is therefore the
+    cost of the *architecture* (N sequential solver calls + host
+    round-trips per iteration) vs the fused plane (one XLA computation);
+    it is not an IPOPT binary benchmark."""
+    import numpy as np
+    from scipy.optimize import minimize
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    ocp = zone_ocp()
+
+    def f_aug(w, theta, zbar, lam, rho):
+        u = ocp.unflatten(w)["u"]
+        return ocp.nlp.f(w, theta) + \
+            0.5 * rho * jnp.sum((u - zbar + lam) ** 2)
+
+    # two compiled callbacks: values (+objective gradient, which scipy's
+    # MemoizeJac wants at every fun call) and, LAZILY, the constraint
+    # Jacobians — SLSQP's line search evaluates values only at rejected
+    # trial points, and charging full-Jacobian work there would inflate
+    # the baseline with work a real CasADi stack would not do
+    @jax.jit
+    def eval_vals(w, theta, zbar, lam, rho):
+        fv, gf = jax.value_and_grad(f_aug)(w, theta, zbar, lam, rho)
+        return fv, gf, ocp.nlp.g(w, theta), ocp.nlp.h(w, theta)
+
+    @jax.jit
+    def eval_jacs(w, theta):
+        return (jax.jacfwd(ocp.nlp.g)(w, theta),
+                jax.jacfwd(ocp.nlp.h)(w, theta))
+
+    u_of = jax.jit(lambda w: ocp.unflatten(w)["u"])
+
+    # SLSQP issues several callbacks per iterate; memoize per iterate so
+    # each costs ONE dispatch of the right kind — without this the
+    # measurement is dominated by Python-boundary overhead the
+    # reference does not pay
+    val_memo: dict = {}
+    jac_memo: dict = {}
+
+    def _vals(x, th, zb, lm, rho):
+        key = x.tobytes()
+        if key not in val_memo:
+            val_memo.clear()  # SLSQP only revisits the current iterate
+            val_memo[key] = tuple(
+                np.asarray(v, dtype=float)
+                for v in eval_vals(jnp.asarray(x), th, zb, lm, rho))
+        return val_memo[key]
+
+    def _jacs(x, th):
+        key = x.tobytes()
+        if key not in jac_memo:
+            jac_memo.clear()
+            jac_memo[key] = tuple(
+                np.asarray(v, dtype=float)
+                for v in eval_jacs(jnp.asarray(x), th))
+        return jac_memo[key]
+
+    x0s, loads = fleet_inputs(n_agents)
+    thetas, bnds = [], []
+    for i in range(n_agents):
+        th = ocp.default_params(
+            x0=jnp.array([x0s[i]]),
+            d_traj=jnp.broadcast_to(
+                jnp.array([loads[i], 290.15, 294.15]), (HORIZON, 3)))
+        thetas.append(th)
+        lb, ub = ocp.bounds(th)
+        bnds.append(list(zip(np.asarray(lb), np.asarray(ub))))
+    w = [np.asarray(ocp.initial_guess(th)) for th in thetas]
+    zbar = np.full((HORIZON, 1), 0.02)
+    lams = np.zeros((n_agents, HORIZON, 1))
+    rho = 20.0
+
+    def control_step():
+        nonlocal zbar, lams
+        for _ in range(admm_iters):
+            us = np.zeros((n_agents, HORIZON, 1))
+            for i in range(n_agents):
+                th, zb, lm = thetas[i], jnp.asarray(zbar), \
+                    jnp.asarray(lams[i])
+                val_memo.clear()
+                jac_memo.clear()
+                res = minimize(
+                    lambda x: _vals(x, th, zb, lm, rho)[:2],
+                    x0=w[i], jac=True, bounds=bnds[i], method="SLSQP",
+                    constraints=[
+                        {"type": "eq",
+                         "fun": lambda x: _vals(x, th, zb, lm, rho)[2],
+                         "jac": lambda x: _jacs(x, th)[0]},
+                        {"type": "ineq",
+                         "fun": lambda x: _vals(x, th, zb, lm, rho)[3],
+                         "jac": lambda x: _jacs(x, th)[1]},
+                    ],
+                    options={"maxiter": 50, "ftol": 1e-6})
+                w[i] = res.x
+                us[i] = np.asarray(u_of(jnp.asarray(res.x)))
+            zbar = us.mean(axis=0)
+            lams = lams + (us - zbar)
+        return us
+
+    control_step()                       # warm-up (compiles + warm starts)
+    times = []
+    for _ in range(3):                   # min-of-3, like measure()
+        t0 = time.perf_counter()
+        us = control_step()
+        times.append(time.perf_counter() - t0)
+    step_ms = 1e3 * min(times)
+    spread = float(np.max(np.abs(us - zbar)))
+    out = {
+        "metric": f"admm{n_agents}_step_ms[conventional_sequential]",
+        "value": round(step_ms, 1),
+        "unit": "ms",
+        "agents_per_sec": round(n_agents / (step_ms / 1e3), 2),
+        "nlp_calls_per_step": n_agents * admm_iters,
+        "consensus_spread": round(spread, 6),
+        "platform": "cpu-sequential-slsqp",
+    }
+    print(json.dumps(out))
+    return out
+
+
+def run_sequential_native(n_agents: int = N_AGENTS,
+                          admm_iters: int = ADMM_ITERS) -> dict:
+    """Architecture A/B with the confound removed: the SAME interior-point
+    solver, SAME inner budgets and SAME compiled kernels as the fused
+    plane, but driven the way the reference drives IPOPT — one solver
+    call per zone per ADMM iteration, sequentially, with the coordinator
+    update on the host between calls (``admm_coordinator.py:259-321``).
+    The fused-plane speedup over THIS number is purely what batching the
+    zones into one XLA computation buys (vmapped lanes + no per-call
+    dispatch + no host round-trips); solver-quality questions cancel."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from agentlib_mpc_tpu.ops.solver import (
+        NLPFunctions,
+        SolverOptions,
+        solve_nlp,
+    )
+
+    ocp = zone_ocp()
+
+    def f_aug(w, theta):
+        ocp_theta, zbar, lam, rho = theta
+        u = ocp.unflatten(w)["u"]
+        return ocp.nlp.f(w, ocp_theta) + \
+            0.5 * rho * jnp.sum((u - zbar + lam) ** 2)
+
+    nlp = NLPFunctions(f=f_aug, g=lambda w, th: ocp.nlp.g(w, th[0]),
+                       h=lambda w, th: ocp.nlp.h(w, th[0]))
+    opts = SolverOptions(**SOLVER_BASE)
+
+    @jax.jit
+    def one_solve(w0, y0, z0, theta, zbar, lam, rho, mu0, budget):
+        th = (theta, zbar, lam, rho)
+        lb, ub = ocp.bounds(theta)
+        res = solve_nlp(nlp, w0, th, lb, ub, opts, y0=y0, z0=z0,
+                        mu0=mu0, max_iter=budget)
+        return res.w, res.y, res.z, ocp.unflatten(res.w)["u"]
+
+    x0s, loads = fleet_inputs(n_agents)
+    thetas = [ocp.default_params(
+        x0=jnp.array([x0s[i]]),
+        d_traj=jnp.broadcast_to(
+            jnp.array([loads[i], 290.15, 294.15]), (HORIZON, 3)))
+        for i in range(n_agents)]
+    w = [ocp.initial_guess(th) for th in thetas]
+    y = [jnp.zeros((ocp.n_g,))] * n_agents
+    z = [jnp.full((ocp.n_h,), 0.1)] * n_agents
+    zbar = jnp.full((HORIZON, 1), 0.02)
+    lams = [jnp.zeros((HORIZON, 1))] * n_agents
+    rho = jnp.asarray(20.0)
+
+    def control_step():
+        nonlocal zbar, lams, w, y, z
+        for it in range(admm_iters):
+            budget = jnp.asarray(COLD_BUDGET if it == 0 else WARM_BUDGET)
+            mu0 = jnp.asarray(COLD_MU if it == 0 else WARM_MU)
+            us = []
+            for i in range(n_agents):
+                w[i], y[i], z[i], u = one_solve(
+                    w[i], y[i], z[i], thetas[i], zbar, lams[i], rho,
+                    mu0, budget)
+                us.append(np.asarray(u))   # host round-trip per agent,
+                #                            like the coordinator's reply
+            us = np.stack(us)
+            zbar = jnp.asarray(us.mean(axis=0))
+            lams = [lams[i] + (jnp.asarray(us[i]) - zbar)
+                    for i in range(n_agents)]
+        return us
+
+    control_step()                       # warm-up (compile + warm starts)
+    times = []
+    for _ in range(3):                   # min-of-3, like measure()
+        t0 = time.perf_counter()
+        us = control_step()
+        times.append(time.perf_counter() - t0)
+    step_ms = 1e3 * min(times)
+    spread = float(np.max(np.abs(us - np.asarray(zbar))))
+    out = {
+        "metric": f"admm{n_agents}_step_ms[sequential_same_solver]",
+        "value": round(step_ms, 1),
+        "unit": "ms",
+        "agents_per_sec": round(n_agents / (step_ms / 1e3), 2),
+        "nlp_calls_per_step": n_agents * admm_iters,
+        "consensus_spread": round(spread, 6),
+        "platform": "cpu-sequential-native",
+    }
+    print(json.dumps(out))
+    return out
 
 
 def run_ab() -> None:
@@ -315,6 +588,25 @@ def main() -> None:
     if "--probe" in sys.argv or "--worker" in sys.argv:
         _child_main()
         return
+
+    # architecture baselines: sequential per-zone solver calls on the
+    # host CPU — run in-process (no TPU involvement possible). The SLSQP
+    # variant costs ~200 ms per zone-solve, so it defaults to 16 zones
+    # (the BASELINE.md table point); pass an explicit n to change.
+    for flag, runner, default_n in (
+            ("--conventional", run_conventional, 16),
+            ("--sequential", run_sequential_native, N_AGENTS)):
+        if flag in sys.argv:
+            idx = sys.argv.index(flag)
+            n = default_n
+            if len(sys.argv) > idx + 1 and not \
+                    sys.argv[idx + 1].startswith("-"):
+                n = int(sys.argv[idx + 1])   # typos fail loudly
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            runner(n)
+            return
 
     if "--scaling" in sys.argv or "--ab" in sys.argv:
         mode = "--scaling" if "--scaling" in sys.argv else "--ab"
