@@ -406,6 +406,43 @@ class FusedFleet:
         self.state = self.engine.shift_state(self.state)
         self.time += self.dt
 
+    # -- checkpoint/resume (beyond reference: SURVEY §5 records the
+    #    reference has NO process-state checkpointing) ------------------------
+
+    def save_checkpoint(self, path: str) -> str:
+        """Persist the fleet's control state — consensus means,
+        multipliers, primal/dual warm starts, clock, and the current
+        per-agent parameter batches — to ``path`` (orbax directory).
+
+        A restarted process rebuilds the fleet from the SAME configs and
+        calls :meth:`restore_checkpoint`; the next :meth:`step` then
+        continues with warm-started iteration counts instead of paying a
+        cold start under a real-time deadline. Results/stats history is
+        not included (persist it via :meth:`results` /
+        :meth:`iteration_stats` writers, the reference's append-only
+        CSV role)."""
+        from agentlib_mpc_tpu.utils.checkpoint import save_pytree
+
+        return save_pytree(path, {
+            "state": self.state,
+            "time": self.time,
+            "theta_batches": list(self._theta_batches),
+        })
+
+    def restore_checkpoint(self, path: str) -> None:
+        """Restore state saved by :meth:`save_checkpoint` into this
+        (structurally identical, freshly built) fleet."""
+        from agentlib_mpc_tpu.utils.checkpoint import load_pytree
+
+        tree = load_pytree(path, {
+            "state": self.state,
+            "time": self.time,
+            "theta_batches": list(self._theta_batches),
+        })
+        self.state = tree["state"]
+        self.time = float(tree["time"])
+        self._theta_batches = list(tree["theta_batches"])
+
     # -- results (reference CSV layouts, utils/analysis-compatible) -----------
 
     def results(self, agent_id: str):
