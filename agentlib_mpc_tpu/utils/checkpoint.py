@@ -25,13 +25,24 @@ from typing import Any
 __all__ = ["save_pytree", "load_pytree"]
 
 
+def _stale_siblings(path: str) -> list:
+    import glob
+
+    return sorted(glob.glob(f"{path}.tmp-*") + glob.glob(f"{path}.old-*"),
+                  key=os.path.getmtime)
+
+
 def save_pytree(path: str, tree: Any) -> str:
     """Write a pytree of arrays/scalars to ``path`` (a directory),
-    replacing any existing checkpoint WITHOUT a window where none
-    exists: the new checkpoint is fully written to a sibling temp
-    directory first, then swapped in — a crash mid-save leaves the
-    previous checkpoint intact (periodic checkpointing must survive
-    being killed mid-save; that is its whole purpose).
+    replacing any existing checkpoint crash-safely: the new checkpoint
+    is fully written to a sibling temp directory first, then swapped in
+    via the previous one being parked at ``<path>.old-*``. POSIX cannot
+    atomically replace directories, so a kill in the tiny window between
+    the two renames leaves the previous checkpoint at ``.old-*`` —
+    :func:`load_pytree` falls back to the newest such sibling, so SOME
+    valid checkpoint is always recoverable (that is the feature's whole
+    purpose). Stale siblings from earlier crashed saves (any pid) are
+    cleaned up on the next successful save.
 
     Returns the absolute path."""
     import orbax.checkpoint as ocp
@@ -47,9 +58,12 @@ def save_pytree(path: str, tree: Any) -> str:
         old = f"{path}.old-{os.getpid()}"
         os.rename(path, old)
         os.rename(tmp, path)
-        shutil.rmtree(old)
     else:
         os.rename(tmp, path)
+    # the new checkpoint is in place: drop every leftover sibling,
+    # including tmp/old dirs leaked by crashed saves under other pids
+    for stale in _stale_siblings(path):
+        shutil.rmtree(stale, ignore_errors=True)
     return path
 
 
@@ -58,10 +72,20 @@ def load_pytree(path: str, template: Any) -> Any:
 
     ``template`` supplies the tree structure, container types (incl.
     NamedTuples) and array shapes/dtypes — pass a freshly-initialized
-    state of the same problem; its VALUES are ignored."""
+    state of the same problem; its VALUES are ignored.
+
+    If ``path`` is missing (a save was killed between its two swap
+    renames), the newest ``<path>.old-*``/``.tmp-*`` sibling is
+    restored instead — the previous (or fully-written new) checkpoint a
+    crashed save left behind."""
     import jax
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        stale = _stale_siblings(path)
+        if not stale:
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        path = stale[-1]
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    return ocp.StandardCheckpointer().restore(
-        os.path.abspath(path), abstract)
+    return ocp.StandardCheckpointer().restore(path, abstract)

@@ -28,6 +28,9 @@ Modes:
                                 # "Architecture decomposition")
     python bench.py --conventional [n]  # independent-solver baseline:
                                 # sequential per-zone SciPy SLSQP
+    python bench.py --profile [dir]     # XLA profiler trace of the warm
+                                # step (default platform; pin
+                                # JAX_PLATFORMS=cpu for a host trace)
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -178,6 +181,15 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
     return jax.jit(control_step), args
 
 
+def warm_step(step, args, out):
+    """Re-invoke the compiled control step warm-started from its own
+    outputs (carry: w, y, z, zbar, lams) with the original problem data
+    (x0s, loads, rho) — the closed-loop steady-state regime. The ONE
+    place that knows build_step's positional layout."""
+    return step(args[0], args[1], out[0], out[1], out[2], out[3],
+                out[4], args[7])
+
+
 def measure(n_agents: int = N_AGENTS,
             solver_overrides: dict | None = None,
             warm_budget: int = WARM_BUDGET) -> dict:
@@ -192,8 +204,7 @@ def measure(n_agents: int = N_AGENTS,
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = step(args[0], args[1], out[0], out[1], out[2], out[3],
-                   out[4], args[7])
+        out = warm_step(step, args, out)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     step_ms = 1e3 * min(times)
@@ -470,6 +481,22 @@ def run_sequential_native(n_agents: int = N_AGENTS,
     return out
 
 
+def run_profile(trace_dir: str = "bench_trace") -> None:
+    """Capture an XLA profiler trace of the warm 256-zone step (for
+    TensorBoard / xprof kernel-level analysis on TPU — the tool the
+    PERF.md latency budget comes from)."""
+    import jax
+
+    step, args = build_step()
+    out = step(*args)
+    jax.block_until_ready(out)
+    with jax.profiler.trace(trace_dir):
+        out = warm_step(step, args, out)
+        jax.block_until_ready(out)
+    print(json.dumps({"metric": "profile_trace", "dir": trace_dir,
+                      "platform": jax.devices()[0].platform}))
+
+
 def run_ab() -> None:
     """A/B the per-iteration latency knobs on the current backend
     (used to validate SolverOptions defaults on real TPU hardware)."""
@@ -607,6 +634,23 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             runner(n)
             return
+
+    if "--profile" in sys.argv:
+        idx = sys.argv.index("--profile")
+        trace_dir = (sys.argv[idx + 1]
+                     if len(sys.argv) > idx + 1
+                     and not sys.argv[idx + 1].startswith("-")
+                     else "bench_trace")
+        # same fail-soft rule as the measurements: never hang on a
+        # wedged tunnel — probe first, degrade to a host trace
+        if _default_platform() is None:
+            print("[bench] default platform unavailable; tracing on CPU",
+                  file=sys.stderr)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        run_profile(trace_dir)
+        return
 
     if "--scaling" in sys.argv or "--ab" in sys.argv:
         mode = "--scaling" if "--scaling" in sys.argv else "--ab"
