@@ -4,8 +4,10 @@ Round 3 lost its benchmark to a wedged TPU tunnel (BENCH_r03:
 ``rc=1, parsed=null``). These tests pin the contract that made that
 impossible: whatever the platform probe / worker children do — hang,
 crash, emit garbage — ``bench.py`` exits 0 and prints a headline JSON
-line with a ``platform`` field. Children are faked at the ``_spawn`` /
-``_default_platform`` seam so no JAX, no subprocesses, no timing.
+line with a ``platform`` field. ``TestFailsoft`` fakes the children at
+the ``_spawn`` / ``_default_platform`` seam (no JAX, no subprocesses,
+no timing); ``TestArchitectureBaselines`` (slow tier) smoke-tests the
+BASELINE.md instruments with real tiny solves.
 """
 
 import json
@@ -133,6 +135,22 @@ class TestFailsoft:
         assert line["value"] is None
         assert line["platform"] == "unavailable"
 
+    def test_warm_step_layout_matches_build_step(self):
+        """warm_step is the ONE place that knows build_step's positional
+        layout; pin the mapping with sentinels so a signature change in
+        either trips here instead of silently mis-wiring the profiler
+        or the measurement loop."""
+        calls = {}
+
+        def fake_step(*a):
+            calls["args"] = a
+
+        args = tuple(f"arg{i}" for i in range(8))
+        out = tuple(f"out{i}" for i in range(5))
+        bench.warm_step(fake_step, args, out)
+        assert calls["args"] == ("arg0", "arg1", "out0", "out1", "out2",
+                                 "out3", "out4", "arg7")
+
     def test_spawn_rejects_json_free_child(self, monkeypatch):
         class FakeProc:
             returncode = 0
@@ -143,3 +161,22 @@ class TestFailsoft:
                             lambda *a, **k: FakeProc())
         with pytest.raises(RuntimeError, match="no JSON"):
             bench._spawn(["--worker"], {}, 1.0)
+
+
+@pytest.mark.slow
+class TestArchitectureBaselines:
+    """The BASELINE.md instruments (--sequential / --conventional) keep
+    working: tiny fleets, real solves, sane JSON fields."""
+
+    def test_sequential_native_instrument(self):
+        out = bench.run_sequential_native(2, admm_iters=2)
+        assert out["platform"] == "cpu-sequential-native"
+        assert out["value"] > 0
+        assert out["nlp_calls_per_step"] == 4
+        assert 0 <= out["consensus_spread"] < 1.0
+
+    def test_conventional_slsqp_instrument(self):
+        out = bench.run_conventional(2, admm_iters=2)
+        assert out["platform"] == "cpu-sequential-slsqp"
+        assert out["value"] > 0
+        assert 0 <= out["consensus_spread"] < 1.0
