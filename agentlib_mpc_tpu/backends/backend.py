@@ -131,6 +131,43 @@ class OptimizationBackend:
         (``optimization_backends/backend.py:102-104``)."""
         self.logger = lg
 
+    # -- durable warm-start state (beyond reference: its warm starts die
+    #    with the process, ``casadi_utils.py:94-101``) ------------------------
+
+    def warm_state(self) -> dict:
+        """Pytree snapshot of the warm-start memory every JAX backend
+        keeps (primal ``w``, duals ``y``/``z``, cold flag). Save with
+        :func:`agentlib_mpc_tpu.utils.checkpoint.save_pytree`; a
+        restarted controller restores it via :meth:`set_warm_state` and
+        its first solve runs warm instead of paying cold-start
+        iterations under a real-time deadline."""
+        if not hasattr(self, "_w_guess"):
+            raise NotImplementedError(
+                f"{type(self).__name__} keeps no warm-start state "
+                f"(call setup_optimization first?)")
+        return {"w": self._w_guess, "y": self._y_guess,
+                "z": self._z_guess, "cold": bool(self._cold)}
+
+    def set_warm_state(self, tree: dict) -> None:
+        """Restore a :meth:`warm_state` snapshot (same problem shapes)."""
+        if not hasattr(self, "_w_guess"):
+            raise NotImplementedError(
+                f"{type(self).__name__} keeps no warm-start state "
+                f"(call setup_optimization first?)")
+        for key, current in (("w", self._w_guess), ("y", self._y_guess),
+                             ("z", self._z_guess)):
+            new = tree[key]
+            if current.shape != new.shape or current.dtype != new.dtype:
+                raise ValueError(
+                    f"warm state {key!r} is {new.shape}/{new.dtype}, "
+                    f"this backend's problem needs "
+                    f"{current.shape}/{current.dtype} — restore into a "
+                    f"backend built from the same config")
+        self._w_guess = tree["w"]
+        self._y_guess = tree["y"]
+        self._z_guess = tree["z"]
+        self._cold = bool(tree["cold"])
+
     def setup_optimization(self, var_ref: VariableReference,
                            time_step: float, prediction_horizon: int) -> None:
         raise NotImplementedError

@@ -151,6 +151,21 @@ class BaseMPC(SkippableMixin, BaseModule):
         self._history_rows.clear()
         self.backend.stats_history.clear()
 
+    def save_checkpoint(self, path: str) -> str:
+        """Persist the backend's warm-start memory (beyond reference:
+        SURVEY §5 — its warm starts die with the process). A restarted
+        controller built from the same config restores via
+        :meth:`restore_checkpoint` and its first solve runs warm."""
+        from agentlib_mpc_tpu.utils.checkpoint import save_pytree
+
+        return save_pytree(path, self.backend.warm_state())
+
+    def restore_checkpoint(self, path: str) -> None:
+        from agentlib_mpc_tpu.utils.checkpoint import load_pytree
+
+        self.backend.set_warm_state(
+            load_pytree(path, self.backend.warm_state()))
+
     def re_init_optimization(self) -> None:
         """Rebuild the backend (reference ``re_init_optimization``,
         ``mpc.py:297-302``) — e.g. after a runtime horizon change."""

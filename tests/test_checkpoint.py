@@ -1,0 +1,90 @@
+"""Durable warm-start checkpointing of the module-path backends
+(utils/checkpoint.py + OptimizationBackend.warm_state).
+
+The fused-fleet checkpoint equivalence is pinned in
+test_config_bridge.py::TestCheckpointResume; this covers the central-MPC
+backend path: a restarted backend restored from the checkpoint must
+produce the SAME next solve (trajectory and iteration count) as the
+uninterrupted one, and warm solves must actually be cheaper than cold.
+"""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.backends.backend import (
+    VariableReference,
+    create_backend,
+)
+from agentlib_mpc_tpu.models.zoo import CooledRoom
+from agentlib_mpc_tpu.utils.checkpoint import load_pytree, save_pytree
+
+
+def _backend():
+    backend = create_backend({
+        "type": "jax",
+        "model": {"class": CooledRoom},
+        "discretization_options": {"collocation_order": 2},
+        "solver": {"max_iter": 60},
+    })
+    backend.setup_optimization(
+        VariableReference(
+            states=["T", "T_slack"], controls=["mDot"],
+            inputs=["load", "T_in", "T_upper"],
+            parameters=["cp", "C", "s_T", "r_mDot"],
+        ),
+        time_step=300.0, prediction_horizon=6)
+    return backend
+
+
+class TestBackendWarmState:
+    def test_restored_backend_matches_uninterrupted_solve(self, tmp_path):
+        backend = _backend()
+        backend.solve(0.0, {"T": 297.15})
+        path = save_pytree(str(tmp_path / "warm"), backend.warm_state())
+
+        res_continued = backend.solve(300.0, {"T": 296.9})
+
+        fresh = _backend()                     # "restarted process"
+        fresh.set_warm_state(load_pytree(path, fresh.warm_state()))
+        res_resumed = fresh.solve(300.0, {"T": 296.9})
+
+        np.testing.assert_array_equal(
+            np.asarray(res_continued["traj"]["u"]),
+            np.asarray(res_resumed["traj"]["u"]))
+        assert res_continued["stats"]["iterations"] == \
+            res_resumed["stats"]["iterations"]
+
+    def test_warm_restore_beats_cold_start(self, tmp_path):
+        backend = _backend()
+        cold_iters = backend.solve(0.0, {"T": 297.15})["stats"]["iterations"]
+        backend.solve(300.0, {"T": 296.9})
+        path = save_pytree(str(tmp_path / "warm"), backend.warm_state())
+
+        fresh = _backend()
+        fresh.set_warm_state(load_pytree(path, fresh.warm_state()))
+        warm_iters = fresh.solve(600.0, {"T": 296.7})["stats"]["iterations"]
+        assert warm_iters < cold_iters
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        backend = _backend()
+        other = create_backend({
+            "type": "jax",
+            "model": {"class": CooledRoom},
+            "discretization_options": {"collocation_order": 2},
+            "solver": {"max_iter": 60},
+        })
+        other.setup_optimization(
+            VariableReference(
+                states=["T", "T_slack"], controls=["mDot"],
+                inputs=["load", "T_in", "T_upper"],
+                parameters=["cp", "C", "s_T", "r_mDot"],
+            ),
+            time_step=300.0, prediction_horizon=9)   # different horizon
+        with pytest.raises(ValueError, match="same config"):
+            other.set_warm_state(backend.warm_state())
+
+    def test_unset_backend_has_no_warm_state(self):
+        backend = create_backend({"type": "jax",
+                                  "model": {"class": CooledRoom}})
+        with pytest.raises(NotImplementedError, match="setup_optimization"):
+            backend.warm_state()
