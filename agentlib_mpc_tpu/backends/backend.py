@@ -141,19 +141,26 @@ class OptimizationBackend:
         restarted controller restores it via :meth:`set_warm_state` and
         its first solve runs warm instead of paying cold-start
         iterations under a real-time deadline."""
-        if not hasattr(self, "_w_guess"):
-            raise NotImplementedError(
-                f"{type(self).__name__} keeps no warm-start state "
-                f"(call setup_optimization first?)")
+        self._require_warm_state()
         return {"w": self._w_guess, "y": self._y_guess,
                 "z": self._z_guess, "cold": bool(self._cold)}
 
+    def _require_warm_state(self) -> None:
+        """Distinguish the two no-warm-state conditions: lifecycle error
+        (setup_optimization not called yet) vs a backend that genuinely
+        keeps no warm-start memory."""
+        if hasattr(self, "_w_guess"):
+            return
+        if self.var_ref is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: call setup_optimization before "
+                f"using warm_state/set_warm_state")
+        raise NotImplementedError(
+            f"{type(self).__name__} keeps no warm-start state")
+
     def set_warm_state(self, tree: dict) -> None:
         """Restore a :meth:`warm_state` snapshot (same problem shapes)."""
-        if not hasattr(self, "_w_guess"):
-            raise NotImplementedError(
-                f"{type(self).__name__} keeps no warm-start state "
-                f"(call setup_optimization first?)")
+        self._require_warm_state()
         for key, current in (("w", self._w_guess), ("y", self._y_guess),
                              ("z", self._z_guess)):
             new = tree[key]
