@@ -85,6 +85,43 @@ class TestSUR:
         assert np.mean(B) == pytest.approx(0.3, abs=0.05)
 
 
+def _make_bb_backend(horizon: int, bb_options: dict):
+    backend = create_backend({
+        "type": "jax_minlp_bb",
+        "model": {"class": SwitchedRoom},
+        "discretization_options": {"method": "multiple_shooting"},
+        "solver": {"max_iter": 60},
+        "binary_method": "rounding",
+        "bb_options": bb_options,
+    })
+    backend.setup_optimization(
+        VariableReference(
+            states=["T"], binary_controls=["on"],
+            inputs=["load", "T_upper"],
+            parameters=["C", "Q_cool", "s_T", "r_on"],
+        ),
+        time_step=300.0, prediction_horizon=horizon)
+    return backend
+
+
+def _capture_ctx(monkeypatch) -> dict:
+    """Spy on BranchAndBoundBackend._schedule to expose the solve's ctx
+    (needed to drive the exact evaluator for enumeration proofs)."""
+    from agentlib_mpc_tpu.backends.minlp_backend import (
+        BranchAndBoundBackend,
+    )
+
+    captured = {}
+    orig = BranchAndBoundBackend._schedule
+
+    def spy(self, b_rel, ctx):
+        captured["ctx"] = ctx
+        return orig(self, b_rel, ctx)
+
+    monkeypatch.setattr(BranchAndBoundBackend, "_schedule", spy)
+    return captured
+
+
 @pytest.fixture(scope="module")
 def minlp_backend():
     backend = create_backend({
@@ -168,30 +205,9 @@ class TestMINLPBackend:
             BranchAndBoundBackend,
         )
 
-        backend = create_backend({
-            "type": "jax_minlp_bb",
-            "model": {"class": SwitchedRoom},
-            "discretization_options": {"method": "multiple_shooting"},
-            "solver": {"max_iter": 60},
-            "binary_method": "rounding",
-            "bb_options": {"max_nodes": 64, "batch_pairs": 4},
-        })
-        backend.setup_optimization(
-            VariableReference(
-                states=["T"], binary_controls=["on"],
-                inputs=["load", "T_upper"],
-                parameters=["C", "Q_cool", "s_T", "r_on"],
-            ),
-            time_step=300.0, prediction_horizon=4)
-
-        captured = {}
-        orig = BranchAndBoundBackend._schedule
-
-        def spy(self, b_rel, ctx):
-            captured["ctx"] = ctx
-            return orig(self, b_rel, ctx)
-
-        monkeypatch.setattr(BranchAndBoundBackend, "_schedule", spy)
+        backend = _make_bb_backend(
+            horizon=4, bb_options={"max_nodes": 64, "batch_pairs": 4})
+        captured = _capture_ctx(monkeypatch)
         # room exactly at the comfort bound: holding it needs duty ~0.36
         res = backend.solve(0.0, {"T": 295.15})
         stats = res["stats"]
@@ -218,6 +234,35 @@ class TestMINLPBackend:
         # ... and the heuristic's schedule is strictly worse
         B_round = np.round(np.clip(b_rel, 0.0, 1.0))
         assert objs[tuple(B_round.ravel())] > best + 1e-3
+
+    @pytest.mark.slow
+    def test_bb_matches_enumeration_across_scenarios(self, monkeypatch):
+        """Property-style hardening of the optimality claim: across
+        seeded random initial temperatures and loads, the B&B incumbent
+        must match exhaustive enumeration of all 2^3 schedules with its
+        own exact evaluator (one compiled backend, scenarios amortize
+        the compile)."""
+        import itertools
+
+        backend = _make_bb_backend(
+            horizon=3, bb_options={"max_nodes": 40, "batch_pairs": 2})
+        captured = _capture_ctx(monkeypatch)
+        rng = np.random.default_rng(7)
+        for k in range(4):
+            T0 = float(rng.uniform(294.5, 297.5))
+            load = float(rng.uniform(120.0, 400.0))
+            res = backend.solve(k * 300.0, {"T": T0, "load": load})
+            best = min(
+                backend._exact_objective(
+                    np.array(bits).reshape(3, 1), captured["ctx"])
+                for bits in itertools.product([0.0, 1.0], repeat=3))
+            # a broken phase-3 evaluator returns inf for EVERY schedule,
+            # which would make the optimality assert pass vacuously
+            assert np.isfinite(best), \
+                f"scenario {k}: no schedule evaluated successfully"
+            assert res["stats"]["bb_incumbent"] == pytest.approx(
+                best, rel=1e-3, abs=1e-5), \
+                f"scenario {k}: T0={T0:.2f}, load={load:.0f}"
 
     def test_rounding_variant(self):
         backend = create_backend({
