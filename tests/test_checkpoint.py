@@ -85,6 +85,25 @@ class TestBackendWarmState:
         with pytest.raises(ValueError, match="same config"):
             other.set_warm_state(backend.warm_state())
 
+    def test_ml_backend_warm_state_roundtrips(self, tmp_path):
+        """The warm-state contract is generic over backend subclasses:
+        the NARX ML backend (its own _reset_warm_start) checkpoints and
+        resumes identically too."""
+        from test_ml_backend import _backend as ml_backend
+
+        backend = ml_backend()
+        backend.solve(0.0, {"T": 297.15})
+        path = save_pytree(str(tmp_path / "ml_warm"),
+                           backend.warm_state())
+        res_continued = backend.solve(300.0, {"T": 296.9})
+
+        fresh = ml_backend()
+        fresh.set_warm_state(load_pytree(path, fresh.warm_state()))
+        res_resumed = fresh.solve(300.0, {"T": 296.9})
+        np.testing.assert_array_equal(
+            np.asarray(res_continued["traj"]["u"]),
+            np.asarray(res_resumed["traj"]["u"]))
+
     def test_unset_backend_raises_lifecycle_error(self):
         backend = create_backend({"type": "jax",
                                   "model": {"class": CooledRoom}})

@@ -201,10 +201,6 @@ class TestMINLPBackend:
         phase-3 evaluator the search scores incumbents with."""
         import itertools
 
-        from agentlib_mpc_tpu.backends.minlp_backend import (
-            BranchAndBoundBackend,
-        )
-
         backend = _make_bb_backend(
             horizon=4, bb_options={"max_nodes": 64, "batch_pairs": 4})
         captured = _capture_ctx(monkeypatch)
