@@ -97,8 +97,9 @@ def force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 
-@pytest.mark.timeout(180)
 def test_two_process_mas():
+    # hang protection is the run's own join_timeout below (pytest-timeout
+    # is not installed, so a mark would be a silent no-op)
     source_agent = {
         "id": "Source",
         "modules": [
