@@ -13,6 +13,12 @@ reference runs as N CasADi processes around a coordinator agent
 (``examples/4_Room_ADMM_Coordinator/admm_4rooms_coord_main.py``), here
 one XLA computation per round.
 
+Mid-run the loop checkpoints the fleet's control state and (under
+``testing``) proves a restarted fleet restored from it produces the
+identical next round — the durable-resume workflow a real building
+controller needs across restarts (the reference cannot do this; its
+warm starts die with the process).
+
 Run directly for a report, or call ``run_example`` (examples-as-tests,
 SURVEY.md §4).
 """
@@ -72,19 +78,35 @@ def room_config(i: int, load: float) -> dict:
 
 
 def run_example(until: float = 3600.0, n_rooms: int = N_ROOMS,
-                testing: bool = False, verbose: bool = True) -> dict:
+                testing: bool = False, verbose: bool = True,
+                checkpoint_dir: "str | None" = None) -> dict:
+    import tempfile
+
     loads = np.linspace(80.0, 220.0, n_rooms)
-    fleet = FusedFleet.from_configs(
-        [room_config(i, float(loads[i])) for i in range(n_rooms)])
+    configs = [room_config(i, float(loads[i])) for i in range(n_rooms)]
+    fleet = FusedFleet.from_configs(configs)
 
     plant = CooledRoom()
     p_plant = plant.default_vector("parameters")
     temps = {f"Room_{i}": START_TEMP for i in range(n_rooms)}
     iter_trail: list[int] = []
+    # checkpoint only when someone will consume it (the testing resume
+    # proof, or a caller-supplied directory) — not dead I/O per run
+    ckpt_dir = checkpoint_dir
+    if ckpt_dir is None and testing:
+        ckpt_dir = tempfile.mkdtemp(prefix="fleet_ckpt_")
 
     n_steps = int(until // TIME_STEP)
-    for _ in range(n_steps):
+    out_round2 = None
+    for k in range(n_steps):
+        if k == 1 and ckpt_dir is not None:
+            # durable resume point: warm starts + the round-1 plant
+            # measurements (update_agent ran before this) are all inside
+            ckpt_path = fleet.save_checkpoint(f"{ckpt_dir}/fleet")
         out = fleet.step()
+        if k == 1:
+            out_round2 = {f"Room_{i}": np.asarray(
+                out[f"Room_{i}"]["u"]["mDot"]) for i in range(n_rooms)}
         iter_trail.append(out["Room_0"]["iterations"])
         for i in range(n_rooms):
             aid = f"Room_{i}"
@@ -111,6 +133,19 @@ def run_example(until: float = 3600.0, n_rooms: int = N_ROOMS,
         # did not already saturate the iteration cap)
         if len(iter_trail) >= 2 and iter_trail[0] < MAX_ITERATIONS:
             assert min(iter_trail[1:]) <= iter_trail[0]
+        if out_round2 is not None:
+            # durable resume: a "restarted controller" restored from the
+            # mid-run checkpoint must reproduce round 2 bit-identically
+            resumed = FusedFleet.from_configs(configs)
+            resumed.restore_checkpoint(ckpt_path)
+            out_resumed = resumed.step()
+            for aid, u_ref in out_round2.items():
+                np.testing.assert_array_equal(
+                    np.asarray(out_resumed[aid]["u"]["mDot"]), u_ref)
+            if checkpoint_dir is None:   # auto-created temp dir
+                import shutil
+
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
     return {"temps": temps, "iterations": iter_trail}
 
 
