@@ -223,6 +223,7 @@ class MINLPBackend(JAXBackend):
             "b_max": (u_ub[:, bi] >= 1.0 - eps).astype(float),
             "root_objective": float(stats_rel.objective),
             "root_success": bool(stats_rel.success),
+            "root_kkt": float(stats_rel.kkt_error),
         }
         self._schedule_stats = {}
         B, eta = self._schedule(b_rel, ctx)
@@ -298,7 +299,16 @@ class BranchAndBoundBackend(MINLPBackend):
     fixed-to-0 means ``[0, δ]`` — so the log-barrier always has an
     interior and every node reuses the SAME compiled program. Because a
     binary point of the subtree lies inside its δ-box, each node's
-    relaxation objective is a valid lower bound for the subtree.
+    relaxation objective is a valid lower bound for the subtree — up to
+    the error the node solve actually achieved: an inexactly-converged
+    interior-point objective can sit above the true relaxation optimum
+    by roughly its residual KKT error (far above the nominal ``tol``
+    when the solver exits through its "acceptable" criteria), so every
+    node bound is deflated by its own achieved KKT error, floored at
+    ``tol``, before it is used for pruning. ``bb_proven_optimal`` is
+    therefore rigorous relative to the deflated bounds; the certified
+    gap is ``gap_tol`` *plus* the per-node achieved errors, never
+    tighter than what the node relaxations actually resolved.
     Incumbents are scored EXACTLY by the phase-3 fixed program (binaries
     as data, no box), so the returned schedule's objective is the true
     mixed-integer objective.
@@ -374,6 +384,16 @@ class BranchAndBoundBackend(MINLPBackend):
         delta = float(self._bb.get("box_width", 1e-3))
         gap = float(self._bb.get("gap_tol", 1e-6))
         int_tol = float(self._bb.get("int_tol", 1e-3))
+        # an inexactly-converged node objective is only a lower bound up
+        # to the error the node ACHIEVED — which under the solver's
+        # "acceptable" exit can sit far above the nominal tol. Deflate
+        # every bound by its own achieved KKT error (floored at tol) so
+        # pruning and the optimality certificate never rest on unearned
+        # digits.
+        tol = float(self.solver_options.tol)
+
+        def node_slack(kkt: float) -> float:
+            return max(tol, kkt) if np.isfinite(kkt) else np.inf
         max_nodes = int(self._bb.get("max_nodes", 256))
         dt_vec = np.full(len(b_rel), self.time_step)
         counter = itertools.count()
@@ -416,8 +436,8 @@ class BranchAndBoundBackend(MINLPBackend):
 
         lo0 = np.zeros_like(b_rel)
         hi0 = np.ones_like(b_rel)
-        root_bound = (ctx["root_objective"] if ctx["root_success"]
-                      else -np.inf)
+        root_bound = (ctx["root_objective"] - node_slack(ctx["root_kkt"])
+                      if ctx["root_success"] else -np.inf)
         heap = [(root_bound, next(counter), lo0, hi0,
                  sanitize(b_rel, lo0, hi0))]
         best_open = root_bound
@@ -482,13 +502,15 @@ class BranchAndBoundBackend(MINLPBackend):
             u_host = np.asarray(u_batch)[:n_real]
             objs = np.asarray(stats.objective)[:n_real]
             oks = np.asarray(stats.success)[:n_real]
+            kkts = np.asarray(stats.kkt_error)[:n_real]
             explored += n_real
 
             for i, (parent_bound, lo_c, hi_c) in enumerate(meta):
                 brel_c = sanitize(u_host[i][:, self._bin_idx], lo_c, hi_c)
                 # bounds are monotone down the tree; a failed child solve
                 # cannot tighten the parent's bound
-                bound_c = (max(parent_bound, float(objs[i]))
+                bound_c = (max(parent_bound,
+                               float(objs[i]) - node_slack(float(kkts[i])))
                            if oks[i] else parent_bound)
                 if bound_c >= inc_obj - gap:
                     continue  # prune

@@ -29,9 +29,9 @@ across iterations because means/multipliers are traced arguments.
 from __future__ import annotations
 
 import dataclasses
-import queue
 import threading
 import time as _time
+from collections import deque
 from enum import Enum, auto
 from typing import Dict, Iterable, List, Optional
 
@@ -124,26 +124,61 @@ _ITERATING = (ModuleStatus.optimizing, ModuleStatus.waiting_for_other_agents,
               ModuleStatus.updating)
 
 
-class ADMMParticipation:
-    """Per-(coupling, source) inbox + registration status
-    (reference ``admm.py:47-65``). Bounded queue: a flooding sender is
-    reported instead of exhausting memory."""
+_INBOX_DEPTH = 5
 
-    def __init__(self, variable: AgentVariable):
-        self.variable = variable
-        self.status = ParticipantStatus.not_participating
-        self.received: queue.Queue = queue.Queue(maxsize=5)
 
-    def empty_memory(self) -> None:
-        while True:
-            try:
-                self.received.get_nowait()
-            except queue.Empty:
-                break
+@dataclasses.dataclass
+class NeighborLink:
+    """Registration status + bounded trajectory inbox for one neighbor on
+    one coupling wire (role of the participation record in reference
+    ``admm.py:47-65``, re-done as a condition-guarded ring: broker callback
+    threads deposit with :meth:`push`, the ADMM round takes with
+    :meth:`pop`). Consumption is FIFO — the ADMM round processes a
+    neighbor's iterates in order, one per iteration, keeping rounds
+    aligned when a neighbor runs ahead. Only the bound is newest-biased:
+    under flood the *stalest* queued trajectory is evicted (retention of
+    the newest ``_INBOX_DEPTH``), since once entries must be dropped the
+    oldest iterates are the least useful to the consensus update."""
 
-    def de_register(self) -> None:
-        self.status = ParticipantStatus.not_participating
-        self.empty_memory()
+    variable: AgentVariable
+    status: ParticipantStatus = ParticipantStatus.not_participating
+    _inbox: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=_INBOX_DEPTH))
+    _cv: threading.Condition = dataclasses.field(
+        default_factory=threading.Condition)
+
+    def push(self, variable: AgentVariable) -> bool:
+        """Deposit a broadcast and wake any blocked :meth:`pop`. Returns
+        ``False`` when the bounded inbox evicted its oldest entry (the
+        sender is flooding faster than this agent iterates)."""
+        with self._cv:
+            evicted = len(self._inbox) == self._inbox.maxlen
+            self._inbox.append(variable)
+            self.variable = variable
+            self.status = ParticipantStatus.available
+            self._cv.notify_all()
+        return not evicted
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[AgentVariable]:
+        """Take the oldest pending trajectory, waiting up to ``timeout``
+        seconds for one to arrive (no wait when ``timeout`` is ``None``).
+        Returns ``None`` if nothing arrived in time."""
+        with self._cv:
+            if timeout is not None and not self._inbox:
+                self._cv.wait_for(lambda: bool(self._inbox), timeout)
+            return self._inbox.popleft() if self._inbox else None
+
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._inbox)
+
+    def reset(self, status: ParticipantStatus
+              = ParticipantStatus.not_participating) -> None:
+        """Drop all queued trajectories and move to ``status``."""
+        with self._cv:
+            self._inbox.clear()
+            self.status = status
 
 
 class ADMMModule(BaseMPC):
@@ -161,7 +196,7 @@ class ADMMModule(BaseMPC):
             config.get("registration_period", 2.0))
         self._status = ModuleStatus.syncing
         self._registered_participants: Dict[
-            str, Dict[Source, ADMMParticipation]] = {}
+            str, Dict[Source, NeighborLink]] = {}
         self._admm_values: Dict[str, np.ndarray] = {}
         self._iter_rows: List[dict] = []
         super().__init__(config, agent)
@@ -243,34 +278,30 @@ class ADMMModule(BaseMPC):
         if variable.source not in inboxes:
             self.logger.info("initially registered %s from %s",
                              variable.alias, variable.source)
-            inboxes[variable.source] = ADMMParticipation(variable)
+            inboxes[variable.source] = NeighborLink(variable)
         neighbor = inboxes[variable.source]
         if self._status == ModuleStatus.at_registration:
-            neighbor.empty_memory()
-            neighbor.status = ParticipantStatus.not_available
+            neighbor.reset(ParticipantStatus.not_available)
             neighbor.variable = variable
         elif self._status in _ITERATING:
-            try:
-                neighbor.received.put_nowait(variable)
-                neighbor.status = ParticipantStatus.available
-            except queue.Full:
+            if not neighbor.push(variable):
                 self.logger.error(
-                    "participant %s floods coupling %s; dropping message",
-                    variable.source, variable.alias)
-            neighbor.variable = variable
+                    "participant %s floods coupling %s; evicted its "
+                    "stalest queued trajectory", variable.source,
+                    variable.alias)
 
-    def all_participations(self) -> Iterable[ADMMParticipation]:
+    def all_participations(self) -> Iterable[NeighborLink]:
         for per_coupling in self._registered_participants.values():
             yield from per_coupling.values()
 
     def reset_participants_ready(self) -> None:
         for p in self.all_participations():
-            p.status = (ParticipantStatus.available if p.received.qsize()
+            p.status = (ParticipantStatus.available if p.pending
                         else ParticipantStatus.not_available)
 
     def deregister_all_participants(self) -> None:
         for p in self.all_participations():
-            p.de_register()
+            p.reset()
 
     def _receive_variables(self, start_wall: float, block: bool) -> None:
         """Collect one fresh trajectory per registered participant; slow
@@ -281,15 +312,12 @@ class ADMMModule(BaseMPC):
                 continue
             remaining = max(
                 self.iteration_timeout - (_time.time() - start_wall), 0.0)
-            try:
-                if block:
-                    var = participant.received.get(timeout=remaining)
-                else:
-                    var = participant.received.get_nowait()
+            var = participant.pop(timeout=remaining if block else None)
+            if var is not None:
                 participant.variable = var
                 participant.status = ParticipantStatus.confirmed
-            except queue.Empty:
-                participant.de_register()
+            else:
+                participant.reset()
                 self.logger.info(
                     "de-registered %s from %s (too slow)",
                     participant.variable.source, participant.variable.alias)

@@ -56,6 +56,11 @@ def save_pytree(path: str, tree: Any) -> str:
     ckptr.wait_until_finished()
     if os.path.isdir(path):
         old = f"{path}.old-{os.getpid()}"
+        if os.path.isdir(old):
+            # leftover from an earlier save of this same pid that crashed
+            # between the swap renames (pid reuse is the norm in
+            # containers, where the controller is always e.g. pid 1)
+            shutil.rmtree(old)
         os.rename(path, old)
         os.rename(tmp, path)
     else:
@@ -75,17 +80,34 @@ def load_pytree(path: str, template: Any) -> Any:
     state of the same problem; its VALUES are ignored.
 
     If ``path`` is missing (a save was killed between its two swap
-    renames), the newest ``<path>.old-*``/``.tmp-*`` sibling is
-    restored instead — the previous (or fully-written new) checkpoint a
-    crashed save left behind."""
+    renames), the ``<path>.old-*``/``.tmp-*`` siblings are tried newest
+    first — a ``.tmp-*`` from a save killed *during* the orbax write is
+    incomplete and must not shadow the complete ``.old-*`` next to it,
+    so a sibling that fails to restore falls through to the next."""
     import jax
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    if not os.path.isdir(path):
-        stale = _stale_siblings(path)
-        if not stale:
-            raise FileNotFoundError(f"no checkpoint at {path}")
-        path = stale[-1]
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    return ocp.StandardCheckpointer().restore(path, abstract)
+    ckptr = ocp.StandardCheckpointer()
+    if os.path.isdir(path):
+        return ckptr.restore(path, abstract)
+    candidates = _stale_siblings(path)
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    errors = []
+    last_exc = None
+    for candidate in reversed(candidates):
+        try:
+            return ckptr.restore(candidate, abstract)
+        except Exception as exc:  # partial .tmp-* etc. — try the next
+            errors.append(f"{candidate}: {exc}")
+            last_exc = exc
+    # NOT FileNotFoundError: checkpoint data exists but none of it
+    # restored (corruption, or e.g. a template mismatch after a config
+    # change) — a caller treating "no checkpoint" as cold-start must not
+    # silently discard recoverable state
+    raise RuntimeError(
+        f"checkpoint at {path} is missing its primary directory and "
+        f"every crash-recovery sibling failed to restore: "
+        f"{'; '.join(errors)}") from last_exc

@@ -9,7 +9,6 @@ The shutdown tests are the regression suite for the round-2 teardown crash
 ('FATAL: exception not rethrown' from a worker killed mid-C-frame)."""
 
 import logging
-import queue
 import sys
 import time
 from pathlib import Path
@@ -21,8 +20,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from agentlib_mpc_tpu.models.zoo import CooledRoom, Cooler
 from agentlib_mpc_tpu.modules.admm import (
-    ADMMParticipation,
     ModuleStatus,
+    NeighborLink,
     ParticipantStatus,
 )
 from agentlib_mpc_tpu.runtime.mas import LocalMAS
@@ -141,8 +140,8 @@ def test_iterating_broadcast_lands_in_inbox(rt_mas):
         room.participant_callback(var)
         p = room._registered_participants["admm_coupling_air"][src]
         assert p.status is ParticipantStatus.available
-        assert p.received.qsize() >= 1
-        p.empty_memory()
+        assert p.pending >= 1
+        p.reset()
     finally:
         room._status = old_status
 
@@ -155,7 +154,7 @@ def test_slow_participant_deregistered_mid_iteration(rt_mas, caplog):
     src = Source(agent_id="Sluggish", module_id="admm")
     var = AgentVariable(name="admm_coupling_air", alias="admm_coupling_air",
                         value=[0.02] * 4, source=src)
-    participation = ADMMParticipation(var)
+    participation = NeighborLink(var)
     participation.status = ParticipantStatus.available
     # the sweep hits every participation: snapshot the fixture's state so
     # later fixture-sharing tests see it unchanged
@@ -252,14 +251,19 @@ def test_terminate_joins_workers_and_is_idempotent():
     mas2.terminate()    # idempotent
 
 
-def test_participation_inbox_bounded():
-    """Flooding sender cannot exhaust memory (bounded queue)."""
-    var = AgentVariable(name="x", alias="x", value=[0.0],
-                        source=Source(agent_id="a", module_id="m"))
-    p = ADMMParticipation(var)
-    for _ in range(5):
-        p.received.put_nowait(var)
-    with pytest.raises(queue.Full):
-        p.received.put_nowait(var)
-    p.empty_memory()
-    assert p.received.qsize() == 0
+def test_neighbor_inbox_bounded_evicts_stalest():
+    """Flooding sender cannot exhaust memory: the bounded inbox evicts
+    its stalest entry (push reports the eviction) and keeps the newest."""
+    src = Source(agent_id="a", module_id="m")
+    mk = lambda i: AgentVariable(name="x", alias="x", value=[float(i)],
+                                 source=src)
+    p = NeighborLink(mk(-1))
+    for i in range(5):
+        assert p.push(mk(i))
+    assert not p.push(mk(99))        # full -> evicts oldest, reports it
+    assert p.pending == 5
+    assert p.pop().value == [1.0]    # entry 0 was evicted
+    p.reset()
+    assert p.pending == 0
+    assert p.pop() is None           # non-blocking pop on empty inbox
+    assert p.pop(timeout=0.01) is None

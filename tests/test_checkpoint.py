@@ -104,6 +104,59 @@ class TestBackendWarmState:
             np.asarray(res_continued["traj"]["u"]),
             np.asarray(res_resumed["traj"]["u"]))
 
+    def test_partial_tmp_does_not_shadow_complete_old(self, tmp_path):
+        """Crash scenario: a save killed *during* the orbax write leaves
+        an incomplete (newer) ``.tmp-*`` next to the complete ``.old-*``
+        the swap parked. Restore must fall through the garbage tmp to
+        the old checkpoint instead of failing on exactly the crash the
+        feature exists for."""
+        import os
+        import shutil
+        import time
+
+        tree = {"a": np.arange(4.0), "b": np.float64(2.5)}
+        path = save_pytree(str(tmp_path / "state"), tree)
+        # simulate the mid-swap kill: real checkpoint parked at .old-*,
+        # primary gone, then a NEWER partial .tmp-* from the next save
+        shutil.move(path, f"{path}.old-123")
+        time.sleep(0.02)
+        os.makedirs(f"{path}.tmp-123")
+        (tmp_path / "state.tmp-123" / "junk").write_text("not orbax")
+
+        restored = load_pytree(path, tree)
+        np.testing.assert_array_equal(restored["a"], tree["a"])
+        assert restored["b"] == tree["b"]
+
+    def test_missing_checkpoint_reports_all_failed_siblings(self, tmp_path):
+        """Truly absent -> FileNotFoundError (cold start is correct);
+        present-but-unrestorable -> RuntimeError (cold start would
+        silently discard potentially recoverable state)."""
+        import os
+
+        path = str(tmp_path / "absent")
+        with pytest.raises(FileNotFoundError, match="no checkpoint"):
+            load_pytree(path, {"a": np.zeros(2)})
+        os.makedirs(f"{path}.tmp-1")
+        with pytest.raises(RuntimeError, match="sibling"):
+            load_pytree(path, {"a": np.zeros(2)})
+
+    def test_pid_reuse_old_dir_does_not_abort_save(self, tmp_path):
+        """A container controller is always the same pid: a leftover
+        ``.old-<pid>`` from a crashed earlier save must not make the
+        next save's swap rename fail with ENOTEMPTY."""
+        import os
+
+        tree = {"a": np.arange(3.0)}
+        path = save_pytree(str(tmp_path / "state"), tree)
+        stale = f"{path}.old-{os.getpid()}"
+        os.makedirs(stale)
+        (tmp_path / f"state.old-{os.getpid()}" / "junk").write_text("x")
+        path = save_pytree(str(tmp_path / "state"),
+                           {"a": np.arange(3.0) + 1})
+        restored = load_pytree(path, tree)
+        np.testing.assert_array_equal(restored["a"], np.arange(3.0) + 1)
+        assert not os.path.isdir(stale)
+
     def test_unset_backend_raises_lifecycle_error(self):
         backend = create_backend({"type": "jax",
                                   "model": {"class": CooledRoom}})
