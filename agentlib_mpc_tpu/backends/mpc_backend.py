@@ -82,10 +82,40 @@ class JAXBackend(OptimizationBackend):
         self.solver_options = solver_options_from_config(
             self.config.get("solver"))
         self._exo_names = list(self.ocp.exo_names)
+        self._resolve_qp_fast_path()
         self._build_step_fn()
         self._reset_warm_start()
         if self.config.get("precompile"):
             self._precompile()
+
+    def _resolve_qp_fast_path(self) -> None:
+        """Route LQ problems (linear model, quadratic objective) to the
+        structure-exploiting Mehrotra QP solver — the role qpoases/osqp/
+        proxqp play in the reference's solver menu
+        (``data_structures/casadi_utils.py:52-61,127-161``). Config key
+        ``solver.qp_fast_path``: ``"auto"`` (default — a one-time
+        structure probe at setup decides), ``"on"`` (force; the caller
+        asserts LQ-ness), ``"off"``."""
+        from agentlib_mpc_tpu.ops.qp import is_lq
+
+        mode = str((self.config.get("solver") or {})
+                   .get("qp_fast_path", "auto"))
+        if mode == "on":
+            self.uses_qp_fast_path = True
+        elif mode == "off":
+            self.uses_qp_fast_path = False
+        elif mode == "auto":
+            theta0 = self.ocp.default_params()
+            n = int(self.ocp.initial_guess(theta0).shape[0])
+            self.uses_qp_fast_path = is_lq(self.ocp.nlp, theta0, n)
+        else:
+            raise ValueError(
+                f"solver.qp_fast_path must be 'auto', 'on' or 'off', "
+                f"got {mode!r}")
+        if self.uses_qp_fast_path:
+            self.logger.info(
+                "LQ structure certified: dispatching to the Mehrotra QP "
+                "fast path")
 
     def _precompile(self) -> None:
         """Trigger XLA compilation at setup with default inputs so the first
@@ -101,6 +131,10 @@ class JAXBackend(OptimizationBackend):
     def _build_step_fn(self) -> None:
         ocp = self.ocp
         opts = self.solver_options
+        if getattr(self, "uses_qp_fast_path", False):
+            from agentlib_mpc_tpu.ops.qp import solve_qp as solver_fn
+        else:
+            solver_fn = solve_nlp
 
         @jax.jit
         def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
@@ -109,7 +143,7 @@ class JAXBackend(OptimizationBackend):
                 x0=x0, u_prev=u_prev, d_traj=d_traj, p=p,
                 x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
             lb, ub = ocp.bounds(theta)
-            res = solve_nlp(ocp.nlp, w_guess, theta, lb, ub, opts,
+            res = solver_fn(ocp.nlp, w_guess, theta, lb, ub, opts,
                             y0=y_guess, z0=z_guess, mu0=mu0)
             traj = ocp.trajectories(res.w, theta)
             u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])

@@ -160,6 +160,52 @@ class ZoneWithSupply(Model):
         return eq
 
 
+class LinearRCZone(Model):
+    """Linear 1R1C zone with DIRECT thermal-power actuation — the
+    canonical *linear* MPC formulation of building control (the problem
+    class the reference hands to its QP solvers qpoases/osqp/proxqp,
+    ``data_structures/casadi_utils.py:52-61``). Where :class:`OneRoom`
+    actuates an air mass flow (bilinear ``mDot·(T_in − T)`` term ⇒ a
+    genuine NLP), here the control is the cooling power ``Q`` itself:
+
+        dT/dt = (load − Q) / C + (T_amb − T) / (R·C)
+
+    — affine dynamics, quadratic objective, affine constraints: an LQ
+    program end to end, which the ``jax`` backend's structure probe
+    certifies and routes to the Mehrotra QP fast path (``ops/qp.py``).
+    """
+
+    inputs = [
+        control_input("Q", 0.0, lb=0.0, ub=500.0, unit="W",
+                      description="cooling power extracted from the zone"),
+        control_input("load", 150.0, unit="W"),
+        control_input("T_amb", 303.15, unit="K"),
+        control_input("T_upper", 295.15, unit="K"),
+    ]
+    states = [
+        state("T", 293.15, lb=288.15, ub=310.15, unit="K"),
+        state("T_slack", 0.0, unit="K"),
+    ]
+    parameters = [
+        parameter("C", 100000.0, description="thermal capacity J/K"),
+        parameter("R", 0.05, description="envelope resistance K/W"),
+        parameter("s_T", 1.0),
+        parameter("r_Q", 1e-3),
+    ]
+    outputs = [output("T_out", unit="K")]
+
+    def setup(self, v):
+        eq = ModelEquations()
+        eq.ode("T", (v.load - v.Q) / v.C + (v.T_amb - v.T) / (v.R * v.C))
+        eq.alg("T_out", v.T)
+        eq.constraint(0.0, v.T + v.T_slack, v.T_upper)
+        eq.objective = (
+            SubObjective(v.Q, weight=v.r_Q, name="energy")
+            + SubObjective(v.T_slack ** 2, weight=v.s_T, name="temp_slack")
+        )
+        return eq
+
+
 class AirHandlingUnit(Model):
     """Central air-handling unit serving four zones — the supplier half of
     the 4-room coordinated-ADMM benchmark (reference

@@ -1,0 +1,421 @@
+"""Convex-QP fast path: structure detection + Mehrotra predictor-corrector.
+
+The reference's solver menu routes QP-structured problems (linear model,
+quadratic objective — the standard linear-MPC case) to dedicated QP codes:
+qpoases / osqp / proxqp (``data_structures/casadi_utils.py:52-61,127-161``).
+The general interior-point NLP solver (:mod:`ops.solver`) subsumes them
+functionally, but pays for generality every iteration: a Lagrangian-Hessian
+evaluation, a batched line-search model sweep, and one value+Jacobian pass.
+
+For an LQ program all of that is constant structure:
+
+    min ½ wᵀH w + cᵀw   s.t.  A w + g₀ = 0,  C w + h₀ ≥ 0,  lb ≤ w ≤ ub
+
+so this module
+
+- certifies the structure ONCE at setup (:func:`is_lq` — probabilistic
+  probe: constant Hessian/Jacobians at random points, exact quadratic
+  model match), and
+- solves with :func:`solve_qp`, a Mehrotra predictor-corrector QP IPM
+  that extracts (H, c, A, C) per solve with three AD passes, then runs
+  pure linear algebra: no model evaluations, no line search (convex ⇒
+  fraction-to-boundary steps suffice), one KKT factorization + two
+  back-substitutions per iteration. The KKT system is the same reduced
+  symmetric quasi-definite form as the NLP solver's, so it reuses the
+  identical factorization kernels (lanes-batched Pallas LDLᵀ on TPU,
+  pivoted LU elsewhere, ``ops/kkt.py``).
+
+``solve_qp`` mirrors ``solve_nlp``'s signature and ``SolverResult``
+contract (same dual conventions, scaling, and stats), so backends swap it
+in without touching warm-start plumbing; on a non-LQ problem it converges
+to the wrong point — gate it behind :func:`is_lq` (the backends do).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    SolverOptions,
+    SolverResult,
+    SolverStats,
+    _factor_kkt,
+    _max_step,
+    _resolve_kkt,
+    _safe_max,
+)
+
+__all__ = ["is_lq", "solve_qp"]
+
+
+def is_lq(nlp: NLPFunctions, theta, n: int, *, seed: int = 0,
+          n_probes: int = 2, rtol: float = 1e-5, atol: float = 1e-7) -> bool:
+    """Probabilistic certificate that the NLP is linear-quadratic in ``w``.
+
+    Checks, at ``n_probes`` pairs of random points, with a random probe
+    direction: the objective's Hessian-vector product is constant, the
+    g/h vector-Jacobian products are constant, and the objective equals
+    its own second-order Taylor model exactly between the two points —
+    all O(1) model evaluations (no full Hessians/Jacobians: this runs
+    eagerly at every backend/engine build, so it must be cheap).
+    Polynomials of higher degree fail at random points with probability
+    1; transcendental nonlinearities fail outright. Structure in ``w``
+    does not change with ``theta``."""
+    key = jax.random.PRNGKey(seed)
+    f = lambda w: nlp.f(w, theta)
+    g = lambda w: nlp.g(w, theta)
+    h = lambda w: nlp.h(w, theta)
+    probe0 = g(jnp.zeros((n,)))
+    m_e = probe0.shape[0]
+    m_h = h(jnp.zeros((n,))).shape[0]
+    # dtype-aware tolerances: in f32 (the TPU regime) an exactly-quadratic
+    # function still shows O(eps·scale) differences between its HVPs at
+    # two points; a bilinear/nonlinear term shows O(1) — keep the gate
+    # far above the former, far below the latter
+    eps = float(jnp.finfo(jnp.zeros(0).dtype).eps)
+    rtol = max(rtol, 2e4 * eps)
+    atol = max(atol, 1e3 * eps)
+
+    def close(a, b):
+        return bool(jnp.all(jnp.isfinite(a)) and jnp.all(jnp.isfinite(b))
+                    and jnp.allclose(a, b, rtol=rtol, atol=atol))
+
+    def hvp(w, v):
+        return jax.grad(lambda ww: jax.grad(f)(ww) @ v)(w)
+
+    for _ in range(n_probes):
+        key, k1, k2, k3, k4 = jax.random.split(key, 5)
+        w1 = jax.random.normal(k1, (n,))
+        w2 = 2.0 * jax.random.normal(k2, (n,)) + 0.5
+        d = w2 - w1
+        # Hessian constancy along d AND a random direction v
+        v = jax.random.normal(k3, (n,))
+        if not (close(hvp(w1, d), hvp(w2, d))
+                and close(hvp(w1, v), hvp(w2, v))):
+            return False
+        # exact quadratic model between the two probe points
+        df = f(w2) - f(w1)
+        model = jax.grad(f)(w1) @ d + 0.5 * d @ hvp(w1, d)
+        scale = jnp.maximum(jnp.abs(df), 1.0)
+        if not close(df / scale, model / scale):
+            return False
+        # constraint affineness: constant VJP against a random cotangent
+        # plus the exact linear model g(w2) − g(w1) = J·d
+        for fn, m in ((g, m_e), (h, m_h)):
+            if not m:
+                continue
+            ct = jax.random.normal(k4, (m,))
+            _, pb1 = jax.vjp(fn, w1)
+            _, pb2 = jax.vjp(fn, w2)
+            if not close(pb1(ct)[0], pb2(ct)[0]):
+                return False
+            _, jd = jax.jvp(fn, (w1,), (d,))
+            if not close(fn(w2) - fn(w1), jd):
+                return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def solve_qp(
+    nlp: NLPFunctions,
+    w0: jnp.ndarray,
+    theta,
+    w_lb: jnp.ndarray,
+    w_ub: jnp.ndarray,
+    options: SolverOptions = SolverOptions(),
+    y0: jnp.ndarray | None = None,
+    z0: jnp.ndarray | None = None,
+    mu0: jnp.ndarray | None = None,
+    max_iter: jnp.ndarray | None = None,
+) -> SolverResult:
+    """Solve an LQ program with a Mehrotra predictor-corrector IPM.
+
+    Same signature/result contract as :func:`ops.solver.solve_nlp` (so it
+    vmaps and swaps in transparently); ``mu0`` is accepted for signature
+    compatibility but ignored — Mehrotra's σ heuristic sets the barrier
+    from the iterate's own complementarity, which is what makes warm
+    starts effective without a tuned barrier schedule. Correctness
+    requires the problem to BE LQ (certify with :func:`is_lq`).
+    """
+    with jax.default_matmul_precision("highest"):
+        return _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, options,
+                              y0, z0, max_iter)
+
+
+def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
+    dtype = w0.dtype
+    eps = jnp.finfo(dtype).eps
+    n = w0.shape[0]
+    m_e = nlp.g(w0, theta).shape[0]
+    m_h = nlp.h(w0, theta).shape[0]
+
+    f_raw = lambda w: nlp.f(w, theta)
+    g_raw = lambda w: nlp.g(w, theta)
+    h_raw = lambda w: nlp.h(w, theta)
+
+    # ---- scaling (same scheme as solve_nlp, so duals transfer) -------------
+    if opts.scale_variables:
+        d_w = jnp.maximum(1.0, jnp.abs(w0))
+    else:
+        d_w = jnp.ones((n,), dtype)
+    gmax = opts.scaling_grad_max
+    gf0 = jax.grad(f_raw)(w0) * d_w
+    s_f = jnp.minimum(1.0, gmax / jnp.maximum(_safe_max(jnp.abs(gf0)), 1e-8))
+    if m_e:
+        Jg0 = jax.jacrev(g_raw)(w0) * d_w[None, :]
+        s_g = jnp.minimum(1.0, gmax / jnp.maximum(
+            jnp.max(jnp.abs(Jg0), axis=1), 1e-8))
+    else:
+        s_g = jnp.zeros((0,), dtype)
+    if m_h:
+        Jh0 = jax.jacrev(h_raw)(w0) * d_w[None, :]
+        s_h = jnp.minimum(1.0, gmax / jnp.maximum(
+            jnp.max(jnp.abs(Jh0), axis=1), 1e-8))
+    else:
+        s_h = jnp.zeros((0,), dtype)
+
+    f = lambda w: s_f * f_raw(w * d_w)
+    g = lambda w: s_g * g_raw(w * d_w)
+    h = lambda w: s_h * h_raw(w * d_w)
+    lb = w_lb / d_w
+    ub = w_ub / d_w
+
+    # ---- one-time structure extraction (3 AD passes, exact for LQ) ---------
+    wz = jnp.zeros((n,), dtype)
+    c = jax.grad(f)(wz)                   # ∇f(0)
+    H = jax.hessian(f)(wz)                # constant
+    f0 = f(wz)
+    if m_e:
+        A = jax.jacrev(g)(wz)
+        g0 = g(wz)                        # g(w) = A w + g0
+    else:
+        A = jnp.zeros((0, n), dtype)
+        g0 = jnp.zeros((0,), dtype)
+    if m_h:
+        C = jax.jacrev(h)(wz)
+        h0 = h(wz)                        # h(w) = C w + h0
+    else:
+        C = jnp.zeros((0, n), dtype)
+        h0 = jnp.zeros((0,), dtype)
+
+    def f_val(w):
+        return f0 + c @ w + 0.5 * w @ (H @ w)
+
+    # ---- initial point ------------------------------------------------------
+    span = jnp.maximum(ub - lb, 1e-8)
+    push = opts.bound_push * jnp.minimum(1.0, span)
+    w = jnp.clip(w0 / d_w, lb + push, ub - push)
+    hv = C @ w + h0 if m_h else h0
+    s = jnp.maximum(hv, 1e-2) if m_h else h0
+    z = jnp.clip(0.1 / s, 1e-8, 1e8) if m_h else s
+    if z0 is not None and m_h:
+        z = jnp.maximum(s_f * z0 / jnp.maximum(s_h, 1e-12), 1e-8)
+    if y0 is not None and m_e:
+        y = s_f * y0 / jnp.maximum(s_g, 1e-12)
+    else:
+        y = jnp.zeros((m_e,), dtype)
+    zL = jnp.clip(0.1 / (w - lb), 1e-12, 1e8)
+    zU = jnp.clip(0.1 / (ub - w), 1e-12, 1e8)
+
+    def kkt_error(w, s, y, z, zL, zU):
+        """Scaled optimality error at mu=0 (same scaling as solve_nlp)."""
+        r_w = c + H @ w - zL + zU
+        if m_e:
+            r_w = r_w + A.T @ y
+        if m_h:
+            r_w = r_w - C.T @ z
+        r_g = A @ w + g0 if m_e else g0
+        r_h = (C @ w + h0 - s) if m_h else h0
+        comp = jnp.concatenate([
+            s * z if m_h else h0,
+            (w - lb) * zL,
+            (ub - w) * zU,
+        ])
+        s_max = 100.0
+        dual_sum = (jnp.sum(jnp.abs(y)) + jnp.sum(jnp.abs(z))
+                    + jnp.sum(jnp.abs(zL)) + jnp.sum(jnp.abs(zU)))
+        s_d = jnp.maximum(s_max, dual_sum / (m_e + m_h + 2 * n)) / s_max
+        dual_inf = _safe_max(jnp.abs(r_w)) / s_d
+        viol = jnp.maximum(_safe_max(jnp.abs(r_g)), _safe_max(jnp.abs(r_h)))
+        compl_inf = _safe_max(jnp.abs(comp)) / s_d
+        return jnp.maximum(jnp.maximum(dual_inf, viol), compl_inf), \
+            viol, dual_inf, compl_inf
+
+    n_comp = m_h + 2 * n    # complementarity pairs
+
+    def body(carry):
+        w, s, y, z, zL, zU, it, done, err, best, stall = carry
+
+        dL = jnp.maximum(w - lb, 1e-12)
+        dU = jnp.maximum(ub - w, 1e-12)
+        sigma_s = z / jnp.maximum(s, 1e-12) if m_h else s
+        sigma_L = zL / dL
+        sigma_U = zU / dU
+
+        gv = A @ w + g0 if m_e else g0
+        hv = C @ w + h0 if m_h else h0
+        r_h = hv - s
+        r_w = c + H @ w - zL + zU
+        if m_e:
+            r_w = r_w + A.T @ y
+        if m_h:
+            r_w = r_w - C.T @ z
+
+        # current duality measure
+        mu_now = (jnp.sum(s * z) + jnp.sum((w - lb) * zL)
+                  + jnp.sum((ub - w) * zU)) / n_comp
+
+        W = H + (opts.delta_init * jnp.ones((n,), dtype)
+                 + sigma_L + sigma_U) * jnp.eye(n, dtype=dtype)
+        if m_h:
+            W = W + C.T @ (sigma_s[:, None] * C)
+        if m_e:
+            K = jnp.block([
+                [W, A.T],
+                [A, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
+            ])
+        else:
+            K = W
+        factor = _factor_kkt(K, opts.kkt_method)
+
+        def newton_dir(mu_s, mu_L, mu_U):
+            """Direction for per-entry complementarity targets (same
+            elimination as solve_nlp: bound duals + slacks folded into
+            the reduced system)."""
+            rhs = -r_w + (mu_L / dL - zL) - (mu_U / dU - zU)
+            if m_h:
+                corr = mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * r_h
+                rhs = rhs + C.T @ corr
+            if m_e:
+                sol = _resolve_kkt(factor, jnp.concatenate([rhs, -gv]))
+                dw, dy = sol[:n], sol[n:]
+            else:
+                dw = _resolve_kkt(factor, rhs)
+                dy = jnp.zeros((0,), dtype)
+            ds = (C @ dw + r_h) if m_h else s
+            dz = (mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * ds) \
+                if m_h else z
+            dzL = mu_L / dL - zL - sigma_L * dw
+            dzU = mu_U / dU - zU + sigma_U * dw
+            return dw, dy, ds, dz, dzL, dzU
+
+        def steps(dw, ds, dz, dzL, dzU, tau):
+            a_p = jnp.minimum(_max_step(dL, dw, tau),
+                              _max_step(dU, -dw, tau))
+            a_d = jnp.minimum(_max_step(zL, dzL, tau),
+                              _max_step(zU, dzU, tau))
+            if m_h:
+                a_p = jnp.minimum(a_p, _max_step(s, ds, tau))
+                a_d = jnp.minimum(a_d, _max_step(z, dz, tau))
+            return a_p, a_d
+
+        # ---- affine predictor (mu target 0) --------------------------------
+        zero = jnp.zeros(())
+        dw_a, dy_a, ds_a, dz_a, dzL_a, dzU_a = newton_dir(zero, zero, zero)
+        a_p, a_d = steps(dw_a, ds_a, dz_a, dzL_a, dzU_a, 1.0)
+        w_aff = w + a_p * dw_a
+        s_aff = s + a_p * ds_a if m_h else s
+        z_aff = z + a_d * dz_a if m_h else z
+        zL_aff = zL + a_d * dzL_a
+        zU_aff = zU + a_d * dzU_a
+        mu_aff = (jnp.sum(s_aff * z_aff)
+                  + jnp.sum((w_aff - lb) * zL_aff)
+                  + jnp.sum((ub - w_aff) * zU_aff)) / n_comp
+        sigma = jnp.clip((mu_aff / jnp.maximum(mu_now, 1e-30)) ** 3,
+                         1e-4, 1.0)
+        mu_t = sigma * mu_now
+
+        # ---- corrector: fold the predictor's Δ∘Δ into the targets ----------
+        # (Gondzio-clipped so a wild predictor cannot poison the step)
+        cap = 10.0 * jnp.maximum(mu_t, mu_now)
+        mu_L = jnp.clip(mu_t - dw_a * dzL_a, 0.0, cap)
+        mu_U = jnp.clip(mu_t + dw_a * dzU_a, 0.0, cap)
+        mu_s = jnp.clip(mu_t - ds_a * dz_a, 0.0, cap) if m_h else zero
+        dw, dy, ds, dz, dzL, dzU = newton_dir(mu_s, mu_L, mu_U)
+
+        tau = jnp.maximum(opts.tau_min, 1.0 - mu_now)
+        a_p, a_d = steps(dw, ds, dz, dzL, dzU, tau)
+        # non-finite guard: a failed factorization must not poison the
+        # iterate (keep it; the error stays, the loop runs its budget out)
+        finite = (jnp.all(jnp.isfinite(dw)) & jnp.all(jnp.isfinite(dy))
+                  & jnp.all(jnp.isfinite(ds)) & jnp.all(jnp.isfinite(dz)))
+        pick = lambda v, dv, a: jnp.where(finite, v + a * dv, v)
+        w_n = pick(w, dw, a_p)
+        s_n = pick(s, ds, a_p)
+        y_n = pick(y, dy, a_d)
+        z_n = pick(z, dz, a_d)
+        zL_n = pick(zL, dzL, a_d)
+        zU_n = pick(zU, dzU, a_d)
+
+        err_n, viol_n, dual_n, compl_n = kkt_error(
+            w_n, s_n, y_n, z_n, zL_n, zU_n)
+        # stall-acceptance (same spirit as solve_nlp): when the error has
+        # stopped improving — the f32 precision floor, typically — accept
+        # a point that is feasible with loose-tolerance complementarity
+        # and stationarity instead of burning the budget on noise
+        improved = err_n < 0.95 * best
+        stall_n = jnp.where(improved, 0, stall + 1)
+        best_n = jnp.minimum(best, err_n)
+        acceptable = ((viol_n <= opts.constr_viol_tol)
+                      & (dual_n <= opts.dual_inf_tol)
+                      & (compl_n <= jnp.maximum(opts.tol, 1e3 * eps)))
+        # the complementarity gate scales with the REQUESTED tolerance
+        # (100×tol) and the dtype floor — a loose config-level gate
+        # (compl_inf_tol=1e-2) would let a tol=1e-8 solve accept a
+        # genuinely unconverged warm iterate after 4 flat iterations
+        stalled_ok = ((stall_n >= 4)
+                      & (viol_n <= opts.constr_viol_tol)
+                      & (dual_n <= opts.dual_inf_tol)
+                      & (compl_n <= jnp.minimum(
+                          opts.compl_inf_tol,
+                          jnp.maximum(100.0 * opts.tol, 1e4 * eps))))
+        done_n = (err_n <= opts.tol) | acceptable | stalled_ok
+        return (w_n, s_n, y_n, z_n, zL_n, zU_n, it + 1, done_n, err_n,
+                best_n, stall_n)
+
+    budget = jnp.asarray(opts.max_iter if max_iter_arg is None
+                         else max_iter_arg)
+
+    def cond(carry):
+        it, done = carry[6], carry[7]
+        return (~done) & (it < budget)
+
+    err0, _, _, _ = kkt_error(w, s, y, z, zL, zU)
+    carry = (w, s, y, z, zL, zU, jnp.asarray(0), err0 <= opts.tol, err0,
+             err0, jnp.asarray(0))
+    (w, s, y, z, zL, zU, it, done, err, _best,
+     _stall) = jax.lax.while_loop(cond, body, carry)
+
+    err_f, viol_f, dual_f, compl_f = kkt_error(w, s, y, z, zL, zU)
+    acceptable_f = ((viol_f <= opts.constr_viol_tol)
+                    & (dual_f <= opts.dual_inf_tol)
+                    & (compl_f <= opts.compl_inf_tol))
+
+    # ---- unscale ------------------------------------------------------------
+    gv_f = A @ w + g0 if m_e else g0
+    hv_f = C @ w + h0 if m_h else h0
+    g_raw_v = gv_f / jnp.maximum(s_g, 1e-12) if m_e else gv_f
+    h_raw_v = hv_f / jnp.maximum(s_h, 1e-12) if m_h else hv_f
+    viol_raw = jnp.maximum(
+        _safe_max(jnp.abs(g_raw_v)),
+        _safe_max(jnp.maximum(-h_raw_v, 0.0)))
+    mu_f = (jnp.sum(s * z) + jnp.sum((w - lb) * zL)
+            + jnp.sum((ub - w) * zU)) / n_comp
+    stats = SolverStats(
+        iterations=it,
+        kkt_error=err,
+        success=done | acceptable_f,
+        objective=f_val(w) / s_f,
+        mu=mu_f,
+        constraint_violation=viol_raw,
+    )
+    return SolverResult(
+        w=w * d_w,
+        y=(s_g * y / s_f) if m_e else y,
+        z=(s_h * z / s_f) if m_h else z,
+        s=s / jnp.maximum(s_h, 1e-12) if m_h else s,
+        stats=stats)

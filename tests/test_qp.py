@@ -1,0 +1,209 @@
+"""Convex-QP fast path: structure certification + Mehrotra solver.
+
+The reference routes LQ problems to dedicated QP codes
+(qpoases/osqp/proxqp, ``data_structures/casadi_utils.py:52-61,127-161``);
+here that role is ``ops/qp.py``. Evidence: the QP solver agrees exactly
+with the general IPM and with SciPy on random convex programs, the
+structure probe separates LQ from genuinely nonlinear transcriptions,
+and the ``jax`` backend auto-routes an LQ model while leaving the
+flagship (bilinear) model on the NLP path — with identical closed-loop
+answers whichever solver runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import minimize
+
+from agentlib_mpc_tpu.ops.qp import is_lq, solve_qp
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    SolverOptions,
+    solve_nlp,
+)
+
+OPTS = SolverOptions(tol=1e-8, max_iter=60)
+
+
+def _random_qp_nlp(rng, n, m_eq, m_in):
+    A = rng.normal(size=(n, n))
+    Q = A @ A.T + n * np.eye(n)
+    c = rng.normal(size=n) * 2.0
+    lb = -1.0 - rng.random(n)
+    ub = 1.0 + rng.random(n)
+    x_feas = lb + (ub - lb) * rng.random(n)
+    Aeq = rng.normal(size=(m_eq, n)) if m_eq else np.zeros((0, n))
+    beq = Aeq @ x_feas
+    G = rng.normal(size=(m_in, n)) if m_in else np.zeros((0, n))
+    hvec = G @ x_feas - rng.random(m_in) if m_in else np.zeros(0)
+    Qj, cj = jnp.asarray(Q), jnp.asarray(c)
+    Aj, bj = jnp.asarray(Aeq), jnp.asarray(beq)
+    Gj, hj = jnp.asarray(G), jnp.asarray(hvec)
+    nlp = NLPFunctions(
+        f=lambda w, t: 0.5 * w @ Qj @ w + cj @ w,
+        g=lambda w, t: Aj @ w - bj,
+        h=lambda w, t: Gj @ w - hj,
+    )
+    return nlp, (Q, c, lb, ub, Aeq, beq, G, hvec), x_feas
+
+
+def _scipy_solution(Q, c, lb, ub, Aeq, beq, G, hvec, x0):
+    cons = []
+    if Aeq.shape[0]:
+        cons.append({"type": "eq", "fun": lambda x: Aeq @ x - beq,
+                     "jac": lambda x: Aeq})
+    if G.shape[0]:
+        cons.append({"type": "ineq", "fun": lambda x: G @ x - hvec,
+                     "jac": lambda x: G})
+    res = minimize(lambda x: 0.5 * x @ Q @ x + c @ x,
+                   jac=lambda x: Q @ x + c, x0=x0,
+                   bounds=list(zip(lb, ub)), constraints=cons,
+                   method="SLSQP", options={"maxiter": 500, "ftol": 1e-12})
+    assert res.success, res.message
+    return res.x
+
+
+@pytest.mark.parametrize("n,m_eq,m_in", [
+    (4, 0, 0), (8, 3, 0), (8, 0, 4),
+    pytest.param(12, 4, 5, marks=pytest.mark.slow),
+])
+def test_qp_matches_ipm_and_scipy(n, m_eq, m_in):
+    rng = np.random.default_rng(1000 * n + 10 * m_eq + m_in)
+    for trial in range(3):
+        nlp, data, x_feas = _random_qp_nlp(rng, n, m_eq, m_in)
+        lb, ub = jnp.asarray(data[2]), jnp.asarray(data[3])
+        w0 = jnp.asarray(x_feas)
+        r_qp = solve_qp(nlp, w0, None, lb, ub, OPTS)
+        assert bool(r_qp.stats.success), f"trial {trial}: QP not converged"
+        r_ip = solve_nlp(nlp, w0, None, lb, ub, OPTS)
+        np.testing.assert_allclose(np.asarray(r_qp.w), np.asarray(r_ip.w),
+                                   atol=2e-6, err_msg=f"trial {trial}")
+        x_ref = _scipy_solution(*data, x_feas)
+        np.testing.assert_allclose(np.asarray(r_qp.w), x_ref, atol=2e-5,
+                                   err_msg=f"trial {trial}")
+
+
+def test_qp_vmaps():
+    """Batched solves (the multi-agent substrate) equal per-item solves."""
+    rng = np.random.default_rng(3)
+    nlp, data, x_feas = _random_qp_nlp(rng, 6, 2, 0)
+    lb, ub = jnp.asarray(data[2]), jnp.asarray(data[3])
+    w0s = jnp.asarray(x_feas) + 0.1 * jnp.asarray(
+        rng.normal(size=(4, 6)))
+    batched = jax.vmap(
+        lambda w0: solve_qp(nlp, w0, None, lb, ub, OPTS))(w0s)
+    single0 = solve_qp(nlp, w0s[0], None, lb, ub, OPTS)
+    assert bool(jnp.all(batched.stats.success))
+    np.testing.assert_allclose(np.asarray(batched.w[0]),
+                               np.asarray(single0.w), atol=1e-9)
+    # all instances of the same strictly convex QP land on one optimum
+    np.testing.assert_allclose(np.asarray(batched.w),
+                               np.tile(np.asarray(single0.w), (4, 1)),
+                               atol=1e-6)
+
+
+def test_qp_warm_budget_traced():
+    """`max_iter` as a traced value (the fused-ADMM warm-budget seam)."""
+    rng = np.random.default_rng(5)
+    nlp, data, x_feas = _random_qp_nlp(rng, 6, 0, 3)
+    lb, ub = jnp.asarray(data[2]), jnp.asarray(data[3])
+    full = solve_qp(nlp, jnp.asarray(x_feas), None, lb, ub, OPTS)
+    budget2 = solve_qp(nlp, jnp.asarray(x_feas), None, lb, ub, OPTS,
+                       max_iter=jnp.asarray(2))
+    assert int(budget2.stats.iterations) <= 2 < int(full.stats.iterations)
+    # resuming from the truncated point's primal-duals reaches the optimum
+    resumed = solve_qp(nlp, budget2.w, None, lb, ub, OPTS,
+                       y0=budget2.y, z0=budget2.z)
+    np.testing.assert_allclose(np.asarray(resumed.w), np.asarray(full.w),
+                               atol=2e-6)
+
+
+class TestStructureProbe:
+    def test_lq_transcription_certified(self):
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+
+        ocp = transcribe(LinearRCZone(), ["Q"], N=4, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        n = int(ocp.initial_guess(theta).shape[0])
+        assert is_lq(ocp.nlp, theta, n)
+
+    def test_bilinear_transcription_rejected(self):
+        from agentlib_mpc_tpu.models.zoo import OneRoom
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+
+        ocp = transcribe(OneRoom(), ["mDot"], N=4, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        n = int(ocp.initial_guess(theta).shape[0])
+        assert not is_lq(ocp.nlp, theta, n)
+
+
+class TestBackendRouting:
+    def _backend(self, model_cls, controls, qp_fast_path=None):
+        from agentlib_mpc_tpu.backends.backend import (
+            VariableReference,
+            create_backend,
+        )
+
+        solver = {"max_iter": 80, "tol": 1e-8}
+        if qp_fast_path is not None:
+            solver["qp_fast_path"] = qp_fast_path
+        backend = create_backend({
+            "type": "jax",
+            "model": {"class": model_cls},
+            "discretization_options": {"collocation_order": 2},
+            "solver": solver,
+        })
+        if model_cls.__name__ == "LinearRCZone":
+            var_ref = VariableReference(
+                states=["T", "T_slack"], controls=controls,
+                inputs=["load", "T_amb", "T_upper"],
+                parameters=["C", "R", "s_T", "r_Q"])
+        else:
+            var_ref = VariableReference(
+                states=["T", "T_slack"], controls=controls,
+                inputs=["load", "T_in", "T_upper"],
+                parameters=["cp", "C", "s_T", "r_mDot"])
+        backend.setup_optimization(var_ref, time_step=300.0,
+                                   prediction_horizon=6)
+        return backend
+
+    def test_auto_routes_linear_model_to_qp(self):
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        backend = self._backend(LinearRCZone, ["Q"])
+        assert backend.uses_qp_fast_path
+
+    def test_auto_keeps_bilinear_model_on_nlp(self):
+        from agentlib_mpc_tpu.models.zoo import CooledRoom
+
+        backend = self._backend(CooledRoom, ["mDot"])
+        assert not backend.uses_qp_fast_path
+
+    def test_invalid_mode_rejected(self):
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        with pytest.raises(ValueError, match="qp_fast_path"):
+            self._backend(LinearRCZone, ["Q"], qp_fast_path="yes")
+
+    def test_qp_and_nlp_paths_agree_on_lq_mpc(self):
+        """The A/B VERDICT r4 #3 asks for: same linearized one-room
+        problem, both solver paths, identical trajectories."""
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        fast = self._backend(LinearRCZone, ["Q"])
+        slow = self._backend(LinearRCZone, ["Q"], qp_fast_path="off")
+        assert fast.uses_qp_fast_path and not slow.uses_qp_fast_path
+        for t, temp in ((0.0, 297.15), (300.0, 296.6), (600.0, 296.1)):
+            rf = fast.solve(t, {"T": temp})
+            rs = slow.solve(t, {"T": temp})
+            assert rf["stats"]["success"] and rs["stats"]["success"]
+            np.testing.assert_allclose(
+                np.asarray(rf["traj"]["u"]), np.asarray(rs["traj"]["u"]),
+                atol=1e-3, err_msg=f"t={t}")   # 1 mW on a 500 W scale
+            scale = max(1.0, abs(rs["stats"]["objective"]))
+            assert abs(rf["stats"]["objective"]
+                       - rs["stats"]["objective"]) < 1e-5 * scale

@@ -1,0 +1,265 @@
+"""Solver robustness campaign: degenerate and adversarial programs.
+
+VERDICT r4 #6: the random-QP corpus (test_solver_random.py) certifies the
+happy path; this file certifies HONESTY on the unhappy ones — the stats
+taxonomy (success / kkt_error / constraint_violation) must tell the truth
+for LICQ failure, infeasibility, active-set degeneracy and brutal
+scaling, and a control module must keep stepping after failed solves (the
+reference's tolerance: ``modules/mpc/mpc.py:389-404`` logs and carries
+on). The QP fast path faces the same corpus where its structure
+assumption holds.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.ops.qp import solve_qp
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    SolverOptions,
+    solve_nlp,
+)
+
+OPTS = SolverOptions(tol=1e-8, max_iter=120)
+SOLVERS = [("ipm", solve_nlp), ("qp", solve_qp)]
+
+
+def _qp_nlp(Q, c, Aeq=None, beq=None):
+    Qj, cj = jnp.asarray(Q), jnp.asarray(c)
+    if Aeq is None:
+        g = lambda w, t: jnp.zeros((0,))
+    else:
+        Aj, bj = jnp.asarray(Aeq), jnp.asarray(beq)
+        g = lambda w, t: Aj @ w - bj
+    return NLPFunctions(f=lambda w, t: 0.5 * w @ Qj @ w + cj @ w,
+                        g=g, h=lambda w, t: jnp.zeros((0,)))
+
+
+@pytest.mark.parametrize("name,solver", SOLVERS)
+class TestDegenerateButSolvable:
+    def test_licq_failure_duplicated_constraints(self, name, solver):
+        """The same equality row three times: the constraint Jacobian is
+        rank-deficient everywhere (LICQ fails), but the feasible set and
+        optimum are unchanged — the quasi-definite regularization must
+        still deliver the right point, honestly flagged a success."""
+        n = 6
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(n, n))
+        Q = M @ M.T + n * np.eye(n)
+        c = rng.normal(size=n)
+        a = rng.normal(size=(1, n))
+        Aeq = np.vstack([a, a, a])          # rank 1, three rows
+        beq = np.array([1.0, 1.0, 1.0])
+        nlp = _qp_nlp(Q, c, Aeq, beq)
+        lb, ub = jnp.full(n, -10.0), jnp.full(n, 10.0)
+        res = solver(nlp, jnp.zeros(n), None, lb, ub, OPTS)
+        assert bool(res.stats.success)
+        # KKT conditions of the unduplicated problem hold
+        w = np.asarray(res.w)
+        assert abs(float((a @ w)[0]) - 1.0) < 1e-5
+        # stationarity: Qw + c + A^T y ⊥ (multipliers may split any way
+        # across the duplicated rows — check the residual directly)
+        y = np.asarray(res.y)
+        grad = Q @ w + c + Aeq.T @ y
+        assert np.max(np.abs(grad)) < 1e-4
+
+    def test_weakly_active_bound(self, name, solver):
+        """Optimum exactly ON a bound with a vanishing multiplier (the
+        active-set-flip degeneracy): min (w0)^2 s.t. w0 >= 0 — both the
+        constraint and its dual are zero at the solution."""
+        n = 3
+        Q = np.eye(n)
+        c = np.zeros(n)
+        nlp = _qp_nlp(Q, c)
+        lb = jnp.asarray([0.0, -1.0, -1.0])
+        ub = jnp.full(n, 1.0)
+        res = solver(nlp, jnp.full(n, 0.5), None, lb, ub, OPTS)
+        assert bool(res.stats.success)
+        # the barrier parks the weakly-active coordinate at O(sqrt(mu));
+        # 1e-4 is zero to the solver's own mu floor, not a miss
+        np.testing.assert_allclose(np.asarray(res.w), np.zeros(n),
+                                   atol=1e-4)
+
+    def test_solution_pinned_at_bound_with_active_gradient(self, name,
+                                                           solver):
+        """Strictly active bound: min -w0 on [0, 1] — the optimum sits at
+        ub with a genuinely nonzero bound dual."""
+        nlp = NLPFunctions(f=lambda w, t: -w[0] + 0.5 * w[1] ** 2,
+                           g=lambda w, t: jnp.zeros((0,)),
+                           h=lambda w, t: jnp.zeros((0,)))
+        res = solver(nlp, jnp.asarray([0.5, 0.5]), None,
+                     jnp.zeros(2), jnp.ones(2), OPTS)
+        assert bool(res.stats.success)
+        assert abs(float(res.w[0]) - 1.0) < 1e-6
+
+    def test_brutal_scaling(self, name, solver):
+        """Curvatures spanning 8 orders of magnitude: the automatic
+        scaling layer has to carry this (the stiff-dynamics analogue at
+        the pure-QP level)."""
+        scales = np.array([1e-4, 1.0, 1e4])
+        Q = np.diag(scales)
+        c = -scales * np.array([1.0, 2.0, 3.0])   # optimum [1, 2, 3]
+        nlp = _qp_nlp(Q, c)
+        lb, ub = jnp.full(3, -10.0), jnp.full(3, 10.0)
+        res = solver(nlp, jnp.asarray([0.1, 0.1, 0.1]), None, lb, ub,
+                     OPTS)
+        assert bool(res.stats.success)
+        # the 1e-4-curvature coordinate is only determined to the SCALED
+        # tolerance (its gradient is invisible next to the 1e4 one —
+        # IPOPT behaves identically); the honest gate is the objective
+        w = np.asarray(res.w)
+        w_star = np.array([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(w[1:], w_star[1:], rtol=1e-4)
+        f = 0.5 * w @ (Q @ w) + c @ w
+        f_star = 0.5 * w_star @ (Q @ w_star) + c @ w_star
+        assert f - f_star < 1e-4
+
+
+@pytest.mark.parametrize("name,solver", SOLVERS)
+class TestInfeasible:
+    def test_contradictory_equalities_not_a_success(self, name, solver):
+        """w0 + w1 = 0 AND w0 + w1 = 1: no feasible point exists. The
+        solver must not claim success, and constraint_violation must
+        report a genuinely non-vanishing number."""
+        Aeq = np.array([[1.0, 1.0], [1.0, 1.0]])
+        beq = np.array([0.0, 1.0])
+        nlp = _qp_nlp(np.eye(2), np.zeros(2), Aeq, beq)
+        res = solver(nlp, jnp.zeros(2), None, jnp.full(2, -5.0),
+                     jnp.full(2, 5.0), OPTS)
+        assert not bool(res.stats.success)
+        assert float(res.stats.constraint_violation) > 0.05
+
+    def test_equality_outside_box_not_a_success(self, name, solver):
+        """w0 = 3 with box [-1, 1]: feasibility blocked by the bounds."""
+        Aeq = np.array([[1.0, 0.0]])
+        beq = np.array([3.0])
+        nlp = _qp_nlp(np.eye(2), np.zeros(2), Aeq, beq)
+        res = solver(nlp, jnp.zeros(2), None, jnp.full(2, -1.0),
+                     jnp.ones(2), OPTS)
+        assert not bool(res.stats.success)
+        assert float(res.stats.constraint_violation) > 0.5
+
+
+class TestStiffOCP:
+    def test_stiff_badly_scaled_dynamics_mpc(self):
+        """A stiff two-time-scale plant (rate constants 1 vs 1e4) with
+        badly scaled parameters through the full transcription: the MPC
+        backend must converge and the trajectory stay finite."""
+        from agentlib_mpc_tpu.models.model import Model, ModelEquations
+        from agentlib_mpc_tpu.models.objective import SubObjective
+        from agentlib_mpc_tpu.models.variables import (
+            control_input,
+            parameter,
+            state,
+        )
+        from agentlib_mpc_tpu.backends.backend import (
+            VariableReference,
+            create_backend,
+        )
+
+        class StiffPlant(Model):
+            inputs = [control_input("u", 0.0, lb=0.0, ub=1.0)]
+            states = [state("x_slow", 1.0, lb=-100.0, ub=100.0),
+                      state("x_fast", 0.5, lb=-100.0, ub=100.0)]
+            parameters = [parameter("k_slow", 1.0),
+                          parameter("k_fast", 1e4),
+                          parameter("w_track", 1e6)]
+
+            def setup(self, v):
+                eq = ModelEquations()
+                eq.ode("x_slow", -v.k_slow * v.x_slow + v.u)
+                # fast mode relaxes to x_slow at rate 1e4
+                eq.ode("x_fast", -v.k_fast * (v.x_fast - v.x_slow))
+                eq.objective = (
+                    SubObjective((v.x_slow - 0.2) ** 2, weight=v.w_track,
+                                 name="track")
+                    + SubObjective(v.u ** 2, weight=1e-3, name="effort"))
+                return eq
+
+        backend = create_backend({
+            "type": "jax",
+            "model": {"class": StiffPlant},
+            "discretization_options": {"collocation_order": 3,
+                                       "collocation_method": "radau"},
+            "solver": {"max_iter": 120},
+        })
+        backend.setup_optimization(
+            VariableReference(states=["x_slow", "x_fast"], controls=["u"],
+                              parameters=["k_slow", "k_fast", "w_track"]),
+            time_step=0.1, prediction_horizon=6)
+        res = backend.solve(0.0, {"x_slow": 1.0, "x_fast": 0.5})
+        assert res["stats"]["success"], res["stats"]
+        x = np.asarray(res["traj"]["x"])
+        assert np.all(np.isfinite(x))
+        # the slow mode moved toward its setpoint at its O(1) rate ...
+        assert float(x[-1, 0]) < 0.6 < float(x[0, 0])
+        # ... and the 1e4-rate fast mode collapsed onto it (the stiff
+        # relaxation the collocation must resolve without oscillating)
+        assert abs(float(x[-1, 1]) - float(x[-1, 0])) < 1e-3
+
+
+class TestModuleSurvivesFailedSolves:
+    def test_do_step_keeps_running_after_infeasible_solves(self, caplog):
+        """The reference logs a warning and keeps the loop alive when a
+        solve fails (``modules/mpc/mpc.py:389-404``); the module path
+        here must do the same at scale: an MPC whose state bound makes
+        the problem infeasible completes every step, logs the failures,
+        actuates the (clipped) best effort, and records honest stats."""
+        import logging
+
+        import agentlib_mpc_tpu.modules  # noqa: F401
+        from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+        cfg = {
+            "id": "Doomed",
+            "modules": [
+                {"module_id": "com", "type": "local_broadcast"},
+                {
+                    "module_id": "mpc",
+                    "type": "mpc",
+                    "optimization_backend": {
+                        "type": "jax",
+                        "model": {"class": "OneRoom"},
+                        "discretization_options": {"collocation_order": 2},
+                        "solver": {"max_iter": 15},
+                    },
+                    "time_step": 300.0,
+                    "prediction_horizon": 4,
+                    "inputs": [
+                        {"name": "load", "value": 150.0},
+                        {"name": "T_in", "value": 290.15},
+                        {"name": "T_upper", "value": 295.15},
+                    ],
+                    # infeasible by construction: the state must stay
+                    # BELOW a bound the plant starts far above, with the
+                    # hard bound leaving no slack headroom
+                    "states": [
+                        {"name": "T", "value": 305.15, "ub": 296.15,
+                         "lb": 288.15},
+                        {"name": "T_slack", "value": 0.0, "ub": 0.0,
+                         "lb": 0.0},
+                    ],
+                    "controls": [
+                        {"name": "mDot", "value": 0.02, "ub": 0.05,
+                         "lb": 0.0},
+                    ],
+                    "parameters": [
+                        {"name": "s_T", "value": 1.0},
+                        {"name": "r_mDot", "value": 0.01},
+                    ],
+                },
+            ],
+        }
+        mas = LocalMAS([cfg], env={"rt": False})
+        with caplog.at_level(logging.WARNING):
+            mas.run(until=1500.0)           # steps at t = 0, 300, ..., 1500
+        mpc = mas.agents["Doomed"].get_module("mpc")
+        stats = mpc.solver_stats()
+        assert len(stats) == 6, "a failed solve must not stall the loop"
+        failed = (~stats["success"]).sum()
+        assert failed >= 1, "expected at least one honestly-failed solve"
+        assert any("did not converge" in r.message for r in caplog.records)
+        # actuation stayed in bounds every step (clipped best effort)
+        u = float(mpc.vars["mDot"].value)
+        assert 0.0 <= u <= 0.05
