@@ -373,7 +373,13 @@ class FusedFleet:
                 "time": self.time,
                 "primal": np.asarray(stats.primal_residuals)[:it],
                 "dual": np.asarray(stats.dual_residuals)[:it],
-                "rho": np.asarray(stats.penalty)[:it],
+                # per-alias ρ histories (the engine adapts each alias
+                # independently); "rho" keeps the mean trail for
+                # existing single-alias consumers
+                "rho": np.mean([np.asarray(v)[:it]
+                                for v in stats.penalty.values()], axis=0),
+                "rho_per_alias": {a: np.asarray(v)[:it]
+                                  for a, v in stats.penalty.items()},
             })
             # per-iteration local coupling trajectories per agent (the
             # reference's iteration-buffered ADMM record); one block per

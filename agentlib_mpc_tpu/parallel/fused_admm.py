@@ -104,6 +104,13 @@ class AgentGroup:
     #: tighter warm ``max_iter`` — enable it in both phases so the cold
     #: and warm solves keep sharing one trace.)
     warm_solver_options: "SolverOptions | None" = None
+    #: route this group's inner solves to the Mehrotra QP fast path
+    #: (``ops/qp.py``). The consensus/exchange augmentation terms are
+    #: quadratic, so an LQ group OCP stays LQ inside ADMM. ``"auto"``
+    #: probes the augmented NLP once at engine build; ``"on"``/``"off"``
+    #: force. (The reference's analogous seam is its per-backend solver
+    #: choice, ``casadi_utils.py:52-61``.)
+    qp_fast_path: str = "auto"
 
     def control_index(self, var_name: str) -> int:
         return self.ocp.control_names.index(var_name)
@@ -111,14 +118,25 @@ class AgentGroup:
 
 class FusedADMMOptions(NamedTuple):
     max_iterations: int = 20
-    rho: float = 10.0
+    #: initial penalty — one float for every coupling alias, or a dict
+    #: ``alias -> float`` for per-alias values. The penalty is carried
+    #: and adapted PER ALIAS: aliases whose trajectories live on
+    #: different physical scales (air flow in m³/s vs power in kW) need
+    #: different ρ, and residual-balancing against the combined residual
+    #: lets the dominant alias destabilize the others (observed on the
+    #: r4 mixed fleet: the kW alias oscillated while the flow aliases
+    #: crawled). The reference carries one global penalty
+    #: (``admm_coordinator.py:467-479``) — per-alias adaptation is a
+    #: deliberate improvement, equivalent whenever there is one alias.
+    rho: "float | dict" = 10.0
     #: Boyd relative-tolerance exit (admm_coordinator.py:409-430)
     abs_tol: float = 1e-3
     rel_tol: float = 1e-2
     use_relative_tolerances: bool = True
     primal_tol: float = 1e-3
     dual_tol: float = 1e-3
-    #: residual-balancing adaptive penalty (admm_coordinator.py:467-479);
+    #: residual-balancing adaptive penalty (admm_coordinator.py:467-479),
+    #: applied per alias against that alias's own residuals;
     #: threshold <= 1 disables
     penalty_change_threshold: float = -1.0
     penalty_change_factor: float = 2.0
@@ -132,7 +150,7 @@ class FusedState(NamedTuple):
     ex_mean: dict         # alias -> (T,) exchange means
     ex_diff: dict         # alias -> tuple per group: (n_i, T) diffs
     ex_lam: dict          # alias -> (T,) shared exchange multiplier
-    rho: jnp.ndarray
+    rho: dict             # alias -> () penalty (consensus AND exchange)
     w: tuple              # per group: (n_i, n_w) primal warm starts
     y: tuple              # per group: (n_i, n_g) equality-dual warm starts
     z: tuple              # per group: (n_i, n_h) inequality-dual warm starts
@@ -142,7 +160,7 @@ class IterationStats(NamedTuple):
     iterations: jnp.ndarray          # () actual iterations run
     primal_residuals: jnp.ndarray    # (max_iter,) padded with NaN
     dual_residuals: jnp.ndarray
-    penalty: jnp.ndarray             # (max_iter,)
+    penalty: dict                    # alias -> (max_iter,) ρ history
     converged: jnp.ndarray           # () bool
     #: every inner interior-point solve of every iteration reached an
     #: acceptable point (False flags inexact-budget exhaustion)
@@ -205,6 +223,14 @@ class FusedADMM:
             if not any(alias in g.couplings or alias in g.exchanges
                        for g in self.groups):
                 raise ValueError(f"coupling {alias!r} has no participants")
+        both = set(self._aliases) & set(self._ex_aliases)
+        if both:
+            # per-alias state (rho, residuals) is keyed by the alias
+            # alone; one name carrying both coupling KINDS would collide
+            raise ValueError(
+                f"alias(es) {sorted(both)} are used as both consensus "
+                f"coupling and exchange — give the two couplings "
+                f"distinct aliases")
         self._step = jax.jit(self._build_step())
 
     # -- state ----------------------------------------------------------------
@@ -232,9 +258,20 @@ class FusedADMM:
         y = tuple(jnp.zeros((g.n_agents, g.ocp.n_g)) for g in self.groups)
         z = tuple(jnp.full((g.n_agents, g.ocp.n_h), 0.1)
                   for g in self.groups)
+        rho_opt = self.options.rho
+        if isinstance(rho_opt, dict):
+            missing = {*self._aliases, *self._ex_aliases} - set(rho_opt)
+            if missing:
+                raise ValueError(
+                    f"options.rho is a dict but misses aliases {missing}")
+            rho = {a: jnp.asarray(float(rho_opt[a]))
+                   for a in (*self._aliases, *self._ex_aliases)}
+        else:
+            rho = {a: jnp.asarray(float(rho_opt))
+                   for a in (*self._aliases, *self._ex_aliases)}
         return FusedState(zbar=zbar, lam=lam, ex_mean=ex_mean,
                           ex_diff=ex_diff, ex_lam=ex_lam,
-                          rho=jnp.asarray(self.options.rho), w=w, y=y, z=z)
+                          rho=rho, w=w, y=y, z=z)
 
     def shift_state(self, state: FusedState) -> FusedState:
         """Shift-by-one warm start between control steps
@@ -325,6 +362,32 @@ class FusedADMM:
 
         group_nlps = [make_group_nlp(gi) for gi in range(n_groups)]
 
+        # per-group solver routing: LQ groups (linear models — their
+        # quadratic ADMM augmentation keeps them LQ) ride the Mehrotra
+        # QP fast path; probed once here, eagerly, per group structure
+        from agentlib_mpc_tpu.ops.qp import is_lq, solve_qp
+
+        group_uses_qp = []
+        for gi, g in enumerate(groups):
+            mode = g.qp_fast_path
+            if mode not in ("auto", "on", "off"):
+                raise ValueError(
+                    f"group {g.name!r}: qp_fast_path must be 'auto', "
+                    f"'on' or 'off', got {mode!r}")
+            if mode == "auto":
+                theta0 = g.ocp.default_params()
+                # per-agent aug slices are (T,) for both coupling kinds
+                aug0 = tuple(
+                    (jnp.zeros((self.T,)), jnp.zeros((self.T,)),
+                     jnp.asarray(1.0))
+                    for _ in aug_map[gi])
+                n_w = int(g.ocp.initial_guess(theta0).shape[0])
+                group_uses_qp.append(
+                    is_lq(group_nlps[gi], (theta0, aug0), n_w))
+            else:
+                group_uses_qp.append(mode == "on")
+        self.group_uses_qp = tuple(group_uses_qp)
+
         warm_opts = [
             g.warm_solver_options
             or g.solver_options._replace(
@@ -348,13 +411,9 @@ class FusedADMM:
             g = groups[gi]
             entries = aug_map[gi]
 
-            def aug_for_agent(agent_slices):
-                # agent_slices: per entry (global, lam_slice)
-                return tuple(
-                    (glob, lam_a, state.rho)
-                    for (glob, lam_a) in agent_slices)
-
-            # build per-agent augmentation pytrees (batched on axis 0)
+            # build per-agent augmentation pytrees (batched on axis 0);
+            # each entry carries ITS alias's penalty (replicated over the
+            # agent axis)
             slices = []
             for alias, kind, _col in entries:
                 if kind == "consensus":
@@ -372,27 +431,28 @@ class FusedADMM:
                     glob = state.ex_diff[alias][slot]  # (n_i, T) per agent
                     lam = jnp.broadcast_to(state.ex_lam[alias],
                                            (g.n_agents, self.T))
-                slices.append((glob, lam, kind))
+                slices.append((glob, lam, state.rho[alias], kind))
+
+            inner = solve_qp if group_uses_qp[gi] else solve_nlp
 
             def one_agent(w_guess, y_guess, z_guess, ocp_theta,
                           *per_entry):
-                aug = tuple((glob, lam, state.rho)
-                            for (glob, lam) in per_entry)
+                aug = tuple(per_entry)     # (glob, lam, rho) triples
                 lb, ub = g.ocp.bounds(ocp_theta)
-                res = solve_nlp(group_nlps[gi], w_guess, (ocp_theta, aug),
-                                lb, ub, opts, y0=y_guess, z0=z_guess,
-                                mu0=mu0, max_iter=budget)
+                res = inner(group_nlps[gi], w_guess, (ocp_theta, aug),
+                            lb, ub, opts, y0=y_guess, z0=z_guess,
+                            mu0=mu0, max_iter=budget)
                 u = g.ocp.unflatten(res.w)["u"]
                 return res.w, res.y, res.z, u, res.stats.success
 
             in_axes = [0, 0, 0, 0]
             vargs = []
-            for glob, lam, kind in slices:
+            for glob, lam, rho_a, kind in slices:
                 if kind == "consensus":
-                    in_axes.append((None, 0))
+                    in_axes.append((None, 0, None))
                 else:
-                    in_axes.append((0, 0))
-                vargs.append((glob, lam))
+                    in_axes.append((0, 0, None))
+                vargs.append((glob, lam, rho_a))
             w_b, y_b, z_b, u_b, ok_b = jax.vmap(
                 one_agent, in_axes=tuple(in_axes))(
                 state.w[gi], state.y[gi], state.z[gi], theta_batch, *vargs)
@@ -447,6 +507,7 @@ class FusedADMM:
                     ok_all = ok_all & jnp.all(ok_b | ~self.active[gi])
 
                 residuals = []
+                alias_residuals = {}
                 zbar_new = dict(state.zbar)
                 lam_new = dict(state.lam)
                 for alias in aliases:
@@ -464,10 +525,11 @@ class FusedADMM:
                             cl_hist[alias].at[it].set(locals_)
                     cstate = admm_ops.ConsensusState(
                         zbar=state.zbar[alias], lam=lam_stack,
-                        rho=state.rho)
+                        rho=state.rho[alias])
                     cnew, res = admm_ops.consensus_update(locals_, cstate,
                                                           active=act)
                     residuals.append(res)
+                    alias_residuals[alias] = res
                     zbar_new[alias] = cnew.zbar
                     offs = 0
                     pieces = []
@@ -495,10 +557,11 @@ class FusedADMM:
                             ex_hist[alias].at[it].set(locals_)
                     estate = admm_ops.ExchangeState(
                         mean=state.ex_mean[alias], diff=diff_stack,
-                        lam=state.ex_lam[alias], rho=state.rho)
+                        lam=state.ex_lam[alias], rho=state.rho[alias])
                     enew, res = admm_ops.exchange_update(locals_, estate,
                                                          active=act)
                     residuals.append(res)
+                    alias_residuals[alias] = res
                     ex_mean_new[alias] = enew.mean
                     ex_lam_new[alias] = enew.lam
                     offs = 0
@@ -511,10 +574,13 @@ class FusedADMM:
 
                 res_all = combine_residuals(*residuals) if residuals else \
                     AdmmResiduals(*([jnp.asarray(0.0)] * 6))
-                rho_next = vary_penalty(
-                    state.rho, res_all,
-                    threshold=opts.penalty_change_threshold,
-                    factor=opts.penalty_change_factor)
+                # residual balancing PER ALIAS against its own residuals
+                rho_next = {
+                    a: vary_penalty(
+                        state.rho[a], alias_residuals[a],
+                        threshold=opts.penalty_change_threshold,
+                        factor=opts.penalty_change_factor)
+                    for a in state.rho}
                 is_conv = converged(
                     res_all, abs_tol=opts.abs_tol, rel_tol=opts.rel_tol,
                     use_relative=opts.use_relative_tolerances,
@@ -522,7 +588,8 @@ class FusedADMM:
 
                 prim_hist = prim_hist.at[it].set(res_all.primal)
                 dual_hist = dual_hist.at[it].set(res_all.dual)
-                rho_hist = rho_hist.at[it].set(state.rho)
+                rho_hist = {a: rho_hist[a].at[it].set(state.rho[a])
+                            for a in rho_hist}
 
                 state = state._replace(
                     zbar=zbar_new, lam=lam_new, ex_mean=ex_mean_new,
@@ -550,9 +617,11 @@ class FusedADMM:
                 a: jnp.full((max_it, self._participant_count(a, "exchange"),
                              self.T), jnp.nan) for a in ex_aliases} \
                 if record else {}
+            rho_hist0 = {a: jnp.full((max_it,), jnp.nan)
+                         for a in (*aliases, *ex_aliases)}
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
-                     jnp.full((max_it,), jnp.nan), jnp.asarray(False),
+                     rho_hist0, jnp.asarray(False),
                      jnp.asarray(True), cl_hist0, ex_hist0)
             # two-phase inexact ADMM: iteration 0 runs the full (cold)
             # interior-point budget, subsequent iterations the short warm
@@ -664,6 +733,7 @@ def bucket_agents(specs: Sequence[dict]):
             tuple(sorted(spec.get("exchanges", {}).items())),
             spec.get("solver_options", SolverOptions()),
             spec.get("warm_solver_options"),
+            spec.get("qp_fast_path", "auto"),
         )
         if key not in buckets:
             buckets[key] = {"spec": spec, "members": []}
@@ -681,6 +751,7 @@ def bucket_agents(specs: Sequence[dict]):
             exchanges=dict(spec.get("exchanges", {})),
             solver_options=spec.get("solver_options", SolverOptions()),
             warm_solver_options=spec.get("warm_solver_options"),
+            qp_fast_path=spec.get("qp_fast_path", "auto"),
         ))
         thetas.append(stack_params([specs[i]["theta"] for i in members]))
         index_map.append(list(members))

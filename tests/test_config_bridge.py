@@ -104,7 +104,8 @@ class TestFromConfigs:
         assert fleet.engine.groups[0].n_agents == 4
         # module-level knobs made it into the engine options
         assert fleet.engine.options.max_iterations == 8
-        assert float(np.asarray(fleet.state.rho)) == 20.0
+        assert {float(np.asarray(v))
+                for v in fleet.state.rho.values()} == {20.0}
 
     def test_step_reaches_consensus_and_cools(self):
         fleet = FusedFleet.from_configs(
