@@ -75,10 +75,12 @@ class VariableReference:
 def load_model(model_cfg: dict | Model, dt: float | None = None) -> Model:
     """Instantiate the model named by a config dict.
 
-    Accepts: a Model instance; {"class": ModelClass, ...}; or the
-    reference-style custom injection {"type": {"file": ..., "class_name":
-    ...}, <group overrides>} (``casadi_backend.py`` model loading via
-    agentlib custom_injection).
+    Accepts: a Model instance; {"class": ModelClass, ...}; {"class":
+    "<zoo name>"} (pure-JSON configs, e.g. container deployments, name a
+    built-in model from :mod:`agentlib_mpc_tpu.models.zoo` by string); or
+    the reference-style custom injection {"type": {"file": ...,
+    "class_name": ...}, <group overrides>} (``casadi_backend.py`` model
+    loading via agentlib custom_injection).
     Overrides: any "states"/"inputs"/"parameters"/"outputs" lists of
     {"name", "value"} entries set initial/default values.
     """
@@ -86,6 +88,17 @@ def load_model(model_cfg: dict | Model, dt: float | None = None) -> Model:
         return model_cfg
     model_cfg = dict(model_cfg)
     cls = model_cfg.get("class")
+    if isinstance(cls, str):
+        from agentlib_mpc_tpu.models import zoo
+
+        candidate = getattr(zoo, cls, None)
+        if not (isinstance(candidate, type) and candidate is not Model
+                and issubclass(candidate, Model)):
+            raise KeyError(
+                f"model class {cls!r} is not a built-in zoo model; "
+                f"for custom models use {{'type': {{'file', "
+                f"'class_name'}}}} injection")
+        cls = candidate
     if cls is None:
         type_key = model_cfg.get("type")
         if isinstance(type_key, dict):

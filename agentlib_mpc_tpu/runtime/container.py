@@ -15,6 +15,10 @@ Configuration via environment:
 ``RUN_UNTIL``         simulation/wall-clock horizon in seconds
                       (default: run forever in wall-clock mode)
 ``REALTIME``          "1" (default) wall-clock env; "0" fast simulation
+``RESULTS_DIR``       when set, every module's results frame is written
+                      to ``<dir>/<agent>__<module>.csv`` on shutdown
+                      (the reference's results CSVs, written by the
+                      container instead of the host)
 
 Usage: ``python -m agentlib_mpc_tpu.runtime.container``
 """
@@ -56,6 +60,24 @@ def build_mas(configs: list[dict], realtime: bool = True,
     return mas, buses
 
 
+def write_results(mas, results_dir: str) -> list[str]:
+    """Persist every module's results frame as
+    ``<dir>/<agent>__<module>.csv`` (reference results-CSV role)."""
+    os.makedirs(results_dir, exist_ok=True)
+    written = []
+    for agent_id, modules in mas.get_results().items():
+        for module_id, df in modules.items():
+            path = os.path.join(results_dir,
+                                f"{agent_id}__{module_id}.csv")
+            try:
+                df.to_csv(path)
+                written.append(path)
+            except Exception as exc:  # noqa: BLE001 - best-effort dump
+                logger.warning("could not write %s: %s", path, exc)
+    logger.info("wrote %d results CSVs to %s", len(written), results_dir)
+    return written
+
+
 def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=os.environ.get("LOG_LEVEL", "INFO"),
@@ -90,12 +112,21 @@ def main(argv: list[str] | None = None) -> int:
             # clean terminate()/close() below
             t = 0.0
             while not stop["flag"] and t < until:
-                t = min(t + 60.0, until)
+                t = min(t + 5.0, until)
                 mas.run(until=t)
         else:
             mas.run(until=until)
     finally:
         mas.terminate()
+        results_dir = os.environ.get("RESULTS_DIR")
+        if results_dir:
+            try:
+                write_results(mas, results_dir)
+            except Exception as exc:  # noqa: BLE001 - best-effort dump:
+                # a read-only mount must not leak the buses below or
+                # mask an original exception from the run
+                logger.warning("results dump to %s failed: %s",
+                               results_dir, exc)
         for bus in buses:
             bus.close()
     logger.info("container agent(s) %s shut down cleanly",

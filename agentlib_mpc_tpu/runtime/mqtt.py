@@ -1,11 +1,15 @@
 """MQTT communicator: cross-machine interop path.
 
 Counterpart of the reference's MQTT communicator (SURVEY.md §2.9: topics
-``/agentlib/<agent_id>``, ``docs/source/tutorials/ADMM.md:69-97``). The
-paho-mqtt dependency is optional (not in this image); the class raises a
-clear error at construction when it is missing, and everything else in the
-framework runs without it — the same gating the reference applies to its
-optional communicators.
+``/agentlib/<agent_id>``, ``docs/source/tutorials/ADMM.md:69-97``).
+paho-mqtt is used when installed (full interop with external brokers,
+auth, TLS via paho configuration); without it the bus falls back to the
+first-party MQTT 3.1.1 subset client
+(:mod:`agentlib_mpc_tpu.runtime.mqtt_native`) — real TCP sockets,
+wildcard subscriptions, automatic reconnect — so the MQTT transport
+works out of the box with zero optional dependencies (against
+:class:`~agentlib_mpc_tpu.runtime.mqtt_native.MiniBroker` or any
+standard broker speaking MQTT 3.1.1).
 """
 
 from __future__ import annotations
@@ -28,20 +32,24 @@ class MqttBus:
                  broker_port: int = 1883, prefix: str = TOPIC_PREFIX,
                  username: Optional[str] = None,
                  password: Optional[str] = None):
-        try:
-            import paho.mqtt.client as mqtt
-        except ImportError as exc:  # pragma: no cover - optional dep
-            raise ImportError(
-                "the MQTT communicator needs paho-mqtt (`pip install "
-                "paho-mqtt`); it is an optional extra of this framework"
-            ) from exc
         self.agent_id = agent_id
         self.prefix = prefix.rstrip("/")
         self._broker = None
-        try:  # paho-mqtt >= 2.0 requires an explicit callback API version
-            self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION1)
-        except AttributeError:  # paho-mqtt 1.x
-            self._client = mqtt.Client()
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError:
+            from agentlib_mpc_tpu.runtime.mqtt_native import MiniMqttClient
+
+            logger.info("paho-mqtt not installed; using the first-party "
+                        "MQTT 3.1.1 subset client")
+            self.client_impl = "native"
+            self._client = MiniMqttClient(client_id=agent_id)
+        else:
+            self.client_impl = "paho"
+            try:  # paho-mqtt >= 2.0 requires an explicit callback version
+                self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION1)
+            except AttributeError:  # paho-mqtt 1.x
+                self._client = mqtt.Client()
         if username:
             self._client.username_pw_set(username, password)
         self._client.on_message = self._on_message

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Degraded (no-docker) variant of docker-compose.fleet.yml: the SAME
+# coordinator + room + cooler fleet as three local processes joined over
+# the first-party MQTT broker on real TCP sockets. CI-runnable; the
+# containerized run only swaps process boundaries for container
+# boundaries (same entry points, same configs, same wire traffic).
+#
+#   deploy/run_fleet_local.sh [run_seconds] [results_dir]
+set -euo pipefail
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(dirname "$HERE")"
+RUN_UNTIL="${1:-40}"
+RESULTS_DIR="${2:-$HERE/fleet_results}"
+PORT="${MQTT_PORT:-18830}"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS=cpu
+
+mkdir -p "$RESULTS_DIR"
+python -m agentlib_mpc_tpu.runtime.mqtt_native "$PORT" &
+BROKER_PID=$!
+trap 'kill $BROKER_PID 2>/dev/null || true' EXIT
+sleep 0.5
+
+run_agent() {
+  AGENT_CONFIG="$1" MQTT_HOST=127.0.0.1 MQTT_PORT="$PORT" REALTIME=1 \
+    RUN_UNTIL="$RUN_UNTIL" RESULTS_DIR="$RESULTS_DIR" \
+    python -m agentlib_mpc_tpu.runtime.container &
+}
+
+run_agent "$HERE/fleet/coordinator.json"; CO_PID=$!
+run_agent "$HERE/fleet/room.json";        RO_PID=$!
+run_agent "$HERE/fleet/cooler.json";      CL_PID=$!
+
+wait $CO_PID $RO_PID $CL_PID
+echo "fleet run complete; results:"
+ls -l "$RESULTS_DIR"

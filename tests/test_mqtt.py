@@ -146,11 +146,15 @@ def test_mqtt_malformed_payload_dropped(monkeypatch, caplog):
     bus_b.close()
 
 
-def test_mqtt_missing_paho_raises_actionable_error(monkeypatch):
-    """Without paho, construction raises the documented ImportError."""
-    for mod in ("paho", "paho.mqtt", "paho.mqtt.client"):
-        monkeypatch.setitem(sys.modules, mod, None)
+def test_mqtt_prefers_paho_when_installed(monkeypatch):
+    """With paho importable the bus uses it (external-broker interop,
+    auth, TLS); the paho-less fallback onto the first-party client is
+    covered end-to-end in test_mqtt_native.py."""
+    hub = _FakeBrokerHub()
+    fake_client_cls = _install_fake_paho(monkeypatch, hub)
     from agentlib_mpc_tpu.runtime.mqtt import MqttBus
 
-    with pytest.raises(ImportError, match="paho-mqtt"):
-        MqttBus("AgentA")
+    bus = MqttBus("AgentA")
+    assert bus.client_impl == "paho"
+    assert isinstance(bus._client, fake_client_cls)
+    bus.close()
