@@ -53,6 +53,7 @@ class MHE(SkippableMixin, BaseModule):
             raise ValueError(
                 f"state_weights refer to unknown states: {sorted(unknown)}")
         self._history: Dict[str, deque] = {}
+        self._history_rows: list = []
         self.backend = create_backend(config["optimization_backend"])
         self.backend.register_logger(self.logger)
         self._setup_backend()
@@ -126,6 +127,10 @@ class MHE(SkippableMixin, BaseModule):
         variables = self.collect_variables_for_optimization()
         result = self.backend.solve(self.env.now, variables)
         self._set_estimation(result)
+        self._history_rows.append({
+            "time": float(self.env.now),
+            "traj": {k: np.asarray(v) for k, v in result["traj"].items()},
+        })
         self._prune_history()
 
     def collect_variables_for_optimization(self) -> dict:
@@ -172,5 +177,38 @@ class MHE(SkippableMixin, BaseModule):
             return None
         return pd.DataFrame(self.backend.stats_history).set_index("time")
 
+    # naming parity with the MPC module (results() keeps its historical
+    # stats meaning; the frame APIs below feed the dashboard's MHE view)
+    solver_stats = results
+
+    def estimation_frame(self):
+        """(time, grid-offset) MultiIndex frame of the backward estimate
+        trajectories — the MPC results layout with NEGATIVE offsets
+        ([−N·dt … 0]; the estimate "at now" sits at offset 0). Same
+        builder as the MPC frame, so the analysis loaders and the
+        dashboard consume it unchanged (reference MHE results writing:
+        ``discretization.py:398-484`` via the shared backend)."""
+        from agentlib_mpc_tpu.utils.results import mpc_trajectory_frame
+
+        return mpc_trajectory_frame(self._history_rows,
+                                    self.backend.trajectory_layout())
+
+    def measurements_frame(self):
+        """Tidy (time-indexed) frame of every raw measurement series the
+        estimator has received, one column per measured state/known
+        input — the truth overlay of the dashboard's estimation view."""
+        import pandas as pd
+
+        series = {}
+        for name, dq in self._history.items():
+            if dq:
+                t = [pt[0] for pt in dq]
+                v = [pt[1] for pt in dq]
+                series[name] = pd.Series(v, index=pd.Index(t, name="time"))
+        if not series:
+            return None
+        return pd.DataFrame(series)
+
     def cleanup_results(self) -> None:
+        self._history_rows.clear()
         self.backend.stats_history.clear()

@@ -5,7 +5,9 @@ style ``basic.py:27-58``, prediction-fade MPC plots ``mpc.py``, ADMM
 residual plots ``admm_residuals.py``, NLP sparsity spy
 ``discretization_structure.py``, ML fit evaluation ``ml_model_test.py``,
 Dash dashboards ``interactive.py``/``mpc_dashboard.py``/
-``admm_dashboard.py``). Matplotlib backends are imported lazily; the
+``admm_dashboard.py`` — unified here into ``dashboard.py``'s
+``show_dashboard``, with an MHE estimation view and a static export
+mode). Matplotlib backends are imported lazily; the
 interactive dashboard degrades with a clear message when dash/plotly are
 not installed (they are optional extras here, like the reference's).
 """
@@ -23,4 +25,7 @@ from agentlib_mpc_tpu.utils.plotting.admm import (
 )
 from agentlib_mpc_tpu.utils.plotting.structure import spy_nlp
 from agentlib_mpc_tpu.utils.plotting.ml import evaluate_ml_fit
-from agentlib_mpc_tpu.utils.plotting.interactive import show_dashboard
+from agentlib_mpc_tpu.utils.plotting.dashboard import (
+    show_dashboard,
+    static_dashboard,
+)

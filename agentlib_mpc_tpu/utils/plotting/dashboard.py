@@ -39,8 +39,13 @@ def discover_frames(results: dict) -> dict:
 
 
 def frame_kind(df) -> str:
-    """"mpc" for (time, grid) frames, "admm" for (time, iter, grid)."""
-    return "admm" if df.index.nlevels == 3 else "mpc"
+    """"admm" for (time, iter, grid) frames; 2-level frames split into
+    "mhe" (backward horizon: negative grid offsets — the estimation
+    module's `estimation_frame`) vs "mpc" (forward predictions)."""
+    if df.index.nlevels == 3:
+        return "admm"
+    grid = df.index.get_level_values(-1)
+    return "mhe" if len(grid) and float(np.min(grid)) < 0 else "mpc"
 
 
 def variables_of(df) -> list:
@@ -105,6 +110,31 @@ def actual_series(df, variable: str):
             ts.append(t)
             vs.append(vals[0])
     return np.asarray(ts), np.asarray(vs)
+
+
+def estimate_series(df, variable: str):
+    """(times, values): the published estimate over time — the LAST node
+    of each backward trajectory (grid offset 0 = "estimate at now")."""
+    ts, vs = [], []
+    for t, _, vals in prediction_traces(df, variable):
+        if len(vals):
+            ts.append(t)
+            vs.append(vals[-1])
+    return np.asarray(ts), np.asarray(vs)
+
+
+def measurement_points(measurements, variable: str):
+    """(times, values) scatter data for one variable from an
+    MHE ``measurements_frame`` (columns may carry a ``measured_``
+    prefix) — empty arrays when the variable has no measurements."""
+    if measurements is None:
+        return np.asarray([]), np.asarray([])
+    for col in (variable, f"measured_{variable}"):
+        if col in getattr(measurements, "columns", ()):
+            series = measurements[col].dropna()
+            return (np.asarray(series.index, dtype=float),
+                    np.asarray(series, dtype=float))
+    return np.asarray([]), np.asarray([])
 
 
 def admm_iteration_traces(df, variable: str, time) -> list:
@@ -199,6 +229,42 @@ def admm_iteration_figure(df, variable: str, time, iteration=None):
     return fig
 
 
+def mhe_figure(df, variable: str, measurements=None, max_steps: int = 40):
+    """Estimation view (the reference's MHE half of its unified
+    MPC/MHE dashboard, ``utils/plotting/mpc_dashboard.py``): per-solve
+    backward estimate trajectories fading in, the published
+    estimate-at-now series on top, and the raw measurement scatter as
+    the truth overlay."""
+    import plotly.graph_objects as go
+
+    traces = prediction_traces(df, variable, max_steps=max_steps)
+    fig = go.Figure()
+    n = max(len(traces), 1)
+    for i, (t, abs_t, vals) in enumerate(traces):
+        alpha = 0.15 + 0.55 * (i + 1) / n
+        fig.add_trace(go.Scatter(
+            x=abs_t, y=vals, mode="lines",
+            line={"color": f"rgba(87, 171, 39, {alpha:.3f})", "width": 1},
+            name=f"t={t:g}", showlegend=False,
+            hovertemplate=f"estimate@t={t:g}<br>%{{x}}: %{{y:.4g}}"))
+    ts, vs = estimate_series(df, variable)
+    if len(ts):
+        fig.add_trace(go.Scatter(
+            x=ts, y=vs, mode="lines+markers",
+            line={"color": "rgb(204, 7, 30)", "width": 2},
+            name="estimate"))
+    mt, mv = measurement_points(measurements, variable)
+    if len(mt):
+        fig.add_trace(go.Scatter(
+            x=mt, y=mv, mode="markers",
+            marker={"color": "rgba(0, 0, 0, 0.55)", "size": 5,
+                    "symbol": "x"},
+            name="measured"))
+    fig.update_layout(title=f"{variable} (estimation)",
+                      margin=dict(l=40, r=10, t=40, b=30), height=320)
+    return fig
+
+
 def residual_figure(stats, time=None):
     """Primal/dual residual (log scale) per iteration — one solve time or
     all (reference ``create_residuals_plot``)."""
@@ -266,10 +332,12 @@ def solver_figure(stats):
 # dash app layer
 # ---------------------------------------------------------------------------
 
-def build_app(results: dict, stats=None):
+def build_app(results: dict, stats=None, measurements=None):
     """Construct (but do not run) the dash app: agent/module dropdowns,
-    variable checklist, per-step slider for ADMM frames, residual/solver
-    panels. Requires dash + plotly."""
+    variable checklist, per-step slider for ADMM frames, estimation
+    views for MHE frames (``measurements``: optional truth-overlay frame,
+    see :func:`measurement_points`), residual/solver panels. Requires
+    dash + plotly."""
     import dash
     from dash import dcc, html
     from dash.dependencies import Input, Output
@@ -323,6 +391,12 @@ def build_app(results: dict, stats=None):
             if stats is not None:
                 children.append(dcc.Graph(
                     figure=residual_figure(stats, t_last)))
+        elif frame_kind(df) == "mhe":
+            for var in variables_of(df):
+                children.append(dcc.Graph(
+                    figure=mhe_figure(df, var, measurements=measurements)))
+            if stats is not None:
+                children.append(dcc.Graph(figure=solver_figure(stats)))
         else:
             for var in variables_of(df):
                 children.append(dcc.Graph(
@@ -335,9 +409,101 @@ def build_app(results: dict, stats=None):
 
 
 def run_dashboard(results: dict, stats=None, port: int = 8050,
-                  debug: bool = False):  # pragma: no cover - needs dash
+                  debug: bool = False,
+                  measurements=None):  # pragma: no cover - needs dash
     """Build and serve the dash app (blocks)."""
-    app = build_app(results, stats)
+    app = build_app(results, stats, measurements=measurements)
     run = getattr(app, "run", None) or getattr(app, "run_server")
     run(port=port, debug=debug)
     return app
+
+
+# ---------------------------------------------------------------------------
+# unified entry point (interactive when dash+plotly exist, static otherwise)
+# ---------------------------------------------------------------------------
+
+def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None,
+                   port: int = 8050, block: bool = True, mode: str = "auto",
+                   measurements=None):
+    """MPC/MHE/ADMM results overview — the reference's dashboard entry
+    point (``utils/plotting/interactive.py:300``, ``mpc_dashboard.py``,
+    ``admm_dashboard.py``) unified into one call. ``mode``:
+
+    - ``"auto"`` (default): serve the interactive dash app when
+      dash+plotly are importable, else render the static matplotlib
+      overview (returned; saved when ``save_path`` given);
+    - ``"interactive"``: require dash (ImportError propagates);
+    - ``"static"``: always the matplotlib overview — the export path.
+
+    Never half-fails: any dash *runtime* problem in auto mode falls back
+    to the static figure."""
+    if mode not in ("auto", "interactive", "static"):
+        raise ValueError(
+            f"mode must be 'auto', 'interactive' or 'static', got {mode!r}")
+    if mode != "static":
+        try:
+            import dash  # noqa: F401
+            import plotly  # noqa: F401
+        except ImportError:
+            if mode == "interactive":
+                raise
+            return static_dashboard(results, stats, save_path,
+                                    measurements=measurements)
+        try:
+            if not block:
+                return build_app(results, stats, measurements=measurements)
+            return run_dashboard(results, stats, port=port,
+                                 measurements=measurements)
+        except ValueError:
+            raise  # empty/unshaped results: same error contract as static
+        except Exception as exc:  # pragma: no cover - dash runtime issues
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "interactive dashboard failed (%s); falling back to "
+                "static", exc)
+    return static_dashboard(results, stats, save_path,
+                            measurements=measurements)
+
+
+def static_dashboard(results, stats=None, save_path=None, measurements=None):
+    """Static matplotlib overview of the first results frame — one panel
+    per variable; MHE frames get estimate-vs-measurement panels."""
+    from agentlib_mpc_tpu.utils.plotting.basic import make_fig
+    from agentlib_mpc_tpu.utils.plotting.mpc import plot_mpc
+
+    frames = {f"{a}/{m}": df for (a, m), df in
+              discover_frames(results).items()}
+    if not frames:
+        raise ValueError("no MPC-shaped results to show")
+    key, df = next(iter(frames.items()))
+    variables = variables_of(df)
+    rows = max(len(variables), 1)
+    fig, axes = make_fig(rows=rows)
+    kind = frame_kind(df)
+    for ax, var in zip(np.atleast_1d(axes).ravel(), variables):
+        if kind == "mhe":
+            ts, vs = estimate_series(df, var)
+            ax.plot(ts, vs, color="tab:red", lw=1.5, label="estimate")
+            mt, mv = measurement_points(measurements, var)
+            if len(mt):
+                ax.plot(mt, mv, "x", color="0.3", ms=4, label="measured")
+            ax.legend(fontsize=7)
+        elif kind == "admm":
+            # last-iteration prediction fades (prediction_traces already
+            # selects the final ADMM iteration per step) + realized line
+            traces = prediction_traces(df, var, max_steps=40)
+            n = max(len(traces), 1)
+            for i, (_t, abs_t, vals) in enumerate(traces):
+                ax.plot(abs_t, vals, color="tab:blue", lw=0.8,
+                        alpha=0.15 + 0.55 * (i + 1) / n)
+            ts, vs = actual_series(df, var)
+            if len(ts):
+                ax.plot(ts, vs, color="tab:red", lw=1.5)
+        else:
+            plot_mpc(df, var, ax=ax)
+        ax.set_title(f"{key}: {var}", fontsize=9)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path)
+    return fig

@@ -23,7 +23,7 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from agentlib_mpc_tpu.utils.plotting import dashboard as db
-from agentlib_mpc_tpu.utils.plotting.interactive import show_dashboard
+from agentlib_mpc_tpu.utils.plotting.dashboard import show_dashboard
 from agentlib_mpc_tpu.utils.plotting.plotly_schema import (
     SchemaError,
     validate_figure,
@@ -57,6 +57,27 @@ def _admm_frame():
                 names=["time", "iteration", "grid"])
             frames.append(df)
     return pd.concat(frames)
+
+
+def _mhe_frame():
+    """Backward-horizon estimation frame: grid offsets [-200 .. 0]."""
+    frames = []
+    for t in (600.0, 900.0):
+        df = pd.DataFrame({
+            ("variable", "T"): [294.0, 294.5, 295.0 + t / 300],
+        })
+        df.index = pd.MultiIndex.from_product(
+            [[t], [-200.0, -100.0, 0.0]], names=["time", "grid"])
+        frames.append(df)
+    out = pd.concat(frames)
+    out.columns = pd.MultiIndex.from_tuples(out.columns)
+    return out
+
+
+def _measurements():
+    return pd.DataFrame(
+        {"measured_T": [294.1, 294.6, 297.2, 298.1]},
+        index=pd.Index([400.0, 500.0, 600.0, 900.0], name="time"))
 
 
 def _residual_stats():
@@ -111,6 +132,20 @@ class TestDataLayer:
         # prediction_traces uses the LAST iteration for admm frames
         last = db.prediction_traces(df, "mDot")[-1]
         np.testing.assert_allclose(last[2], [0.03] * 3)
+
+    def test_mhe_frame_kind_and_series(self):
+        df = _mhe_frame()
+        assert db.frame_kind(df) == "mhe"
+        ts, vs = db.estimate_series(df, "T")
+        np.testing.assert_allclose(ts, [600.0, 900.0])
+        np.testing.assert_allclose(vs, [297.0, 298.0])  # offset-0 nodes
+        mt, mv = db.measurement_points(_measurements(), "T")
+        np.testing.assert_allclose(mt, [400.0, 500.0, 600.0, 900.0])
+        # unprefixed column name resolves too; absent variable -> empty
+        meas2 = _measurements().rename(columns={"measured_T": "T"})
+        assert len(db.measurement_points(meas2, "T")[0]) == 4
+        assert len(db.measurement_points(None, "T")[0]) == 0
+        assert len(db.measurement_points(_measurements(), "Q")[0]) == 0
 
     def test_residual_and_solver_tables(self):
         stats = _residual_stats()
@@ -240,6 +275,46 @@ class TestDashLayer:
         with pytest.raises(ValueError):
             show_dashboard({"A": {"none": None}})
 
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            show_dashboard({"A": {"mpc": _mpc_frame()}}, mode="png")
+
+    def test_mhe_frames_routed_in_app(self, monkeypatch):
+        _install_stub_dash(monkeypatch)
+        results = {"E": {"mhe": _mhe_frame()}}
+        app = db.build_app(results, measurements=_measurements())
+        graphs_cb = app.callbacks[-1][1]
+        assert graphs_cb("E/mhe") is not None
+
+    def test_static_mode_renders_admm_frame(self, tmp_path):
+        """3-level ADMM frames must render in static mode too (review
+        regression: the rewrite initially fed them to plot_mpc)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        out = tmp_path / "admm.png"
+        fig = show_dashboard({"B": {"admm": _admm_frame()}}, mode="static",
+                             save_path=str(out))
+        assert out.exists()
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    def test_static_mode_renders_mhe_overview(self, tmp_path):
+        """mode='static' is the export path (VERDICT r4 #8): no dash
+        required, measurement overlay included, file written."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        out = tmp_path / "mhe.png"
+        fig = show_dashboard({"E": {"mhe": _mhe_frame()}}, mode="static",
+                             save_path=str(out),
+                             measurements=_measurements())
+        assert out.exists()
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
     def test_figure_builders_with_stub_plotly(self, monkeypatch):
         _install_stub_dash(monkeypatch)
         fig = db.prediction_figure(_mpc_frame(), "T")
@@ -267,6 +342,8 @@ class TestFigureSchema:
             db.admm_iteration_figure(_admm_frame(), "mDot", 300.0),
             db.admm_iteration_figure(_admm_frame(), "mDot", 0.0,
                                      iteration=1),
+            db.mhe_figure(_mhe_frame(), "T",
+                          measurements=_measurements()),
             db.residual_figure(_residual_stats(), 0.0),
             db.residual_figure(_residual_stats()),
             db.solver_figure(solver),
