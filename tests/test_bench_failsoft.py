@@ -84,11 +84,21 @@ class TestFailsoft:
         assert line["value"] == 100.0
 
     def test_healthy_tpu_reports_vs_cpu_baseline(self, monkeypatch, capsys):
+        """A healthy accelerator run spawns ONE evidence worker; the
+        final line embeds every section (VERDICT r4 #1)."""
         monkeypatch.setattr(bench, "_default_platform", lambda: "axon")
+        worker_args = []
 
         def fake_spawn(args, env, timeout):
             if "--worker" in args:
-                return [_fake_measurement(step_ms=100.0, platform="axon")]
+                worker_args.append(args)
+                return [
+                    {"section": "headline",
+                     **_fake_measurement(step_ms=100.0, platform="axon")},
+                    {"section": "ldl_micro", "lu_ms": 5.0, "ldl_ms": 1.0,
+                     "platform": "axon"},
+                    {"section": "scaling", "rows": [{"n_agents": 4}]},
+                ]
             return [_fake_measurement(step_ms=1500.0)]
 
         monkeypatch.setattr(bench, "_spawn", fake_spawn)
@@ -97,6 +107,29 @@ class TestFailsoft:
         assert line["platform"] == "axon"
         assert line["tpu_fallback_to_cpu"] is False
         assert line["vs_baseline"] == 15.0
+        assert "--evidence" in worker_args[0]
+        assert line["evidence"]["ldl_micro"]["ldl_ms"] == 1.0
+        assert line["evidence"]["scaling"]["rows"] == [{"n_agents": 4}]
+
+    def test_dead_headline_section_degrades_to_cpu(self, monkeypatch,
+                                                   capsys):
+        """The evidence child surviving but its HEADLINE section failing
+        still degrades to a CPU measurement (partial evidence must not
+        masquerade as a result)."""
+        monkeypatch.setattr(bench, "_default_platform", lambda: "axon")
+
+        def fake_spawn(args, env, timeout):
+            if "--worker" in args:
+                return [{"section": "headline", "error": "OOM"},
+                        {"section": "ldl_micro", "lu_ms": 5.0}]
+            return [_fake_measurement()]
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "cpu"
+        assert line["tpu_fallback_to_cpu"] is True
+        assert line["value"] == 100.0
 
     def test_cpu_only_machine_is_not_a_fallback(self, monkeypatch, capsys):
         """A machine whose default platform IS cpu is a normal run."""
