@@ -632,6 +632,138 @@ def run_ldl_micro() -> dict:
     return out
 
 
+def run_horizon_shard() -> list[dict]:
+    """SURVEY §5 experiment (VERDICT r4 #9): does sharding the HORIZON
+    axis pay for a single agent whose problem outgrows one core?
+
+    The per-iteration work of an interior-point solve splits into (a) the
+    stage-parallel stacked value+Jacobian evaluation — shardable along
+    the horizon/constraint-row axis — and (b) the KKT factorization,
+    which couples every stage (dense LDLᵀ/LU here; a Riccati
+    restructuring would still be an O(N)-depth sequential recursion).
+    Amdahl therefore bounds any horizon-sharding win by the evaluation
+    share, which this mode measures at growing horizons, alongside a
+    compile+execute check of the row-sharded evaluation on the virtual
+    device mesh. (On this VM the virtual CPU devices timeshare ONE core,
+    so sharded wall times are validity checks, not speedups — the
+    decision number is the work breakdown.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+    from agentlib_mpc_tpu.ops.solver import (
+        SolverOptions,
+        _factor_kkt_lu,
+        _resolve_kkt_lu,
+        solve_nlp,
+    )
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    rows = []
+    for N in (32, 128, 256):
+        ocp = transcribe(OneRoom(), ["mDot"], N=N, dt=60.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        w0 = ocp.initial_guess(theta)
+        lb, ub = ocp.bounds(theta)
+        n, m_e, m_h = ocp.n_w, ocp.n_g, ocp.n_h
+
+        def timed(fn, *args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                jax.block_until_ready(out)
+                ts.append(time.perf_counter() - t0)
+            return 1e3 * min(ts)
+
+        # (a) the stage-parallel stacked value+Jacobian pass (what the
+        # solver evaluates once per accepted point)
+        def fgh(w):
+            return jnp.concatenate([ocp.nlp.f(w, theta)[None],
+                                    ocp.nlp.g(w, theta),
+                                    ocp.nlp.h(w, theta)])
+
+        eye = jnp.eye(1 + m_e + m_h)
+
+        @jax.jit
+        def eval_and_jac(w):
+            vals, pullback = jax.vjp(fgh, w)
+            return vals, jax.vmap(lambda ct: pullback(ct)[0])(eye)
+
+        eval_ms = timed(eval_and_jac, w0)
+
+        # (b) the horizon-coupled KKT factor+solve at this problem's
+        # reduced dimension
+        size = n + m_e
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(size, size))
+        K = jnp.asarray(M @ M.T + size * np.eye(size))
+        rhs = jnp.asarray(rng.normal(size=size))
+        kkt_ms = timed(jax.jit(
+            lambda K, r: _resolve_kkt_lu(_factor_kkt_lu(K), r)), K, rhs)
+
+        # (c) whole warm solve for scale
+        opts = SolverOptions(tol=1e-4, max_iter=15)
+        solve_ms = timed(
+            lambda w: solve_nlp(ocp.nlp, w, theta, lb, ub, opts), w0)
+
+        # (d) row-sharded evaluation across the virtual mesh: must
+        # compile + run + agree; its wall time is reported but on shared
+        # physical hardware it measures partition overhead, not speedup
+        shard_ok, shard_ms = False, None
+        devices = jax.devices()
+        if len(devices) >= 2:
+            try:
+                from jax.sharding import (
+                    Mesh,
+                    NamedSharding,
+                    PartitionSpec,
+                )
+
+                n_dev = max(d for d in range(1, len(devices) + 1)
+                            if (1 + m_e + m_h) % d == 0)
+                if n_dev > 1:
+                    mesh = Mesh(np.array(devices[:n_dev]), ("rows",))
+                    sharding = NamedSharding(mesh, PartitionSpec("rows"))
+
+                    @jax.jit
+                    def eval_sharded(w):
+                        vals, pullback = jax.vjp(fgh, w)
+                        rows_sh = jax.lax.with_sharding_constraint(
+                            eye, sharding)
+                        return vals, jax.vmap(
+                            lambda ct: pullback(ct)[0])(rows_sh)
+
+                    v1, j1 = eval_and_jac(w0)
+                    v2, j2 = eval_sharded(w0)
+                    shard_ok = bool(jnp.allclose(j1, j2, atol=1e-6))
+                    shard_ms = timed(eval_sharded, w0)
+            except Exception as exc:  # noqa: BLE001 - record, not die
+                print(f"[bench] horizon-shard N={N}: sharded eval "
+                      f"failed: {exc}", file=sys.stderr)
+        row = {
+            "metric": f"horizon_shard[N={N}]",
+            "n_w": n, "kkt_size": size,
+            "eval_jac_ms": round(eval_ms, 3),
+            "kkt_factor_solve_ms": round(kkt_ms, 3),
+            "warm_solve_ms": round(solve_ms, 2),
+            #: Amdahl ceiling: fraction of (eval + factor) that sharding
+            #: the stage-parallel part could ever remove
+            "shardable_share": round(eval_ms / (eval_ms + kkt_ms), 3),
+            "sharded_eval_ok": shard_ok,
+            "sharded_eval_ms": (round(shard_ms, 3)
+                                if shard_ms is not None else None),
+            "platform": jax.devices()[0].platform,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
 def run_evidence() -> None:
     """The whole evidence matrix in ONE child process (VERDICT r4 #1):
     headline, LDL micro, knob A/Bs, QP A/B, scaling curve — each section
@@ -688,6 +820,8 @@ def _child_main() -> None:
         run_qp_ab()
     elif "--ldl" in sys.argv:
         print(json.dumps(run_ldl_micro()))
+    elif "--horizon-shard" in sys.argv:
+        run_horizon_shard()
     elif "--evidence" in sys.argv:
         run_evidence()
     else:
@@ -810,7 +944,8 @@ def main() -> None:
         run_profile(trace_dir)
         return
 
-    for mode in ("--scaling", "--ab", "--qp-ab", "--ldl", "--evidence"):
+    for mode in ("--scaling", "--ab", "--qp-ab", "--ldl",
+                 "--horizon-shard", "--evidence"):
         if mode in sys.argv:
             try:
                 lines, _, _ = _measure_failsoft([mode])
