@@ -29,6 +29,9 @@ Modes:
     python bench.py --ab        # A/B the solver latency knobs on hardware
     python bench.py --qp-ab     # QP fast path vs IPM on the linear fleet
     python bench.py --ldl       # LDLᵀ-vs-LU micro at the 256-lane KKT tile
+    python bench.py --horizon-shard  # single-agent horizon-sharding
+                                # work-split experiment (SURVEY §5;
+                                # provisions an 8-virtual-device mesh)
     python bench.py --sequential [n]    # architecture baseline: SAME
                                 # solver driven one-call-per-zone like the
                                 # reference coordinator (BASELINE.md
@@ -787,6 +790,7 @@ def run_evidence() -> None:
     section("ab", run_ab)
     section("qp_ab", run_qp_ab)
     section("scaling", run_scaling)
+    section("horizon_shard", run_horizon_shard)
 
 
 # --- fail-soft orchestration (round-3 lesson: a wedged TPU tunnel hangs
@@ -808,6 +812,13 @@ def _child_main() -> None:
     tunnel; the in-process override is belt-and-braces for direct
     invocations from an unscrubbed shell); ``--worker`` runs on whatever
     the default platform is (TPU under the driver)."""
+    if "--horizon-shard" in sys.argv or "--evidence" in sys.argv:
+        # the sharded-eval validity check needs a multi-device mesh;
+        # on CPU that means virtual host devices, which must be
+        # requested BEFORE backend init (no-op on real multi-chip)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
     if "--probe" in sys.argv:
         import jax
 
@@ -830,18 +841,35 @@ def _child_main() -> None:
 
 def _spawn(args: list, env: dict, timeout: float) -> list:
     """Run this script as a child, forward its stderr, return its parsed
-    JSON stdout lines. Raises on rc != 0, timeout, or no JSON output."""
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__)] + args,
-        capture_output=True, text=True, timeout=timeout, env=env,
-        cwd=_HERE)
+    JSON stdout lines. Raises on rc != 0 or no JSON output. A TIMEOUT
+    salvages whatever JSON the child already flushed (the evidence
+    worker prints+flushes per section, so a late heavy section dying
+    must not discard the completed ones) and raises only when nothing
+    was produced."""
+    def parse(out: str) -> list:
+        return [json.loads(line)
+                for line in (out or "").strip().splitlines()
+                if line.strip().startswith("{")]
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=_HERE)
+    except subprocess.TimeoutExpired as exc:
+        lines = parse(exc.stdout if isinstance(exc.stdout, str)
+                      else (exc.stdout or b"").decode(errors="replace"))
+        if lines:
+            print(f"[bench] child timed out after {timeout:.0f}s; "
+                  f"salvaged {len(lines)} completed JSON line(s)",
+                  file=sys.stderr)
+            return lines
+        raise
     sys.stderr.write(proc.stderr)
     if proc.returncode != 0:
         raise RuntimeError(
             f"bench child rc={proc.returncode}: {proc.stderr[-500:]}")
-    lines = [json.loads(line)
-             for line in proc.stdout.strip().splitlines()
-             if line.strip().startswith("{")]
+    lines = parse(proc.stdout)
     if not lines:
         raise RuntimeError("bench child emitted no JSON")
     return lines

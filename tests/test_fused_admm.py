@@ -87,6 +87,50 @@ class TestConsensusTrackers:
         np.testing.assert_allclose(
             np.asarray(state.zbar["shared_u"]), 2.0, atol=1e-3)
 
+    def test_lq_group_auto_routes_to_qp_path(self, tracker_ocp):
+        """The Tracker OCP is LQ, and its quadratic ADMM augmentation
+        keeps it LQ — the group probe must certify it and the QP-path
+        round must land on the same consensus fixed point as the forced
+        NLP path."""
+        def build(mode):
+            group = AgentGroup(
+                name="trackers", ocp=tracker_ocp, n_agents=2,
+                couplings={"shared_u": "u"}, solver_options=SOLVER,
+                qp_fast_path=mode)
+            return FusedADMM(
+                [group],
+                FusedADMMOptions(max_iterations=40, rho=2.0,
+                                 abs_tol=1e-6, rel_tol=1e-5))
+
+        auto, off = build("auto"), build("off")
+        assert auto.group_uses_qp == (True,)
+        assert off.group_uses_qp == (False,)
+        thetas = stack_params([
+            tracker_ocp.default_params(p=jnp.array([1.0])),
+            tracker_ocp.default_params(p=jnp.array([3.0])),
+        ])
+        for engine in (auto, off):
+            state = engine.init_state([thetas])
+            state, _trajs, stats = engine.step(state, [thetas])
+            assert bool(stats.converged)
+            np.testing.assert_allclose(
+                np.asarray(state.zbar["shared_u"]), 2.0, atol=1e-3)
+        with pytest.raises(ValueError, match="qp_fast_path"):
+            build("maybe")
+
+    def test_alias_in_both_coupling_kinds_rejected(self, tracker_ocp):
+        """One alias as consensus in one group and exchange in another
+        would collide in the per-alias penalty state — rejected at
+        engine build."""
+        g1 = AgentGroup(name="a", ocp=tracker_ocp, n_agents=1,
+                        couplings={"shared_u": "u"},
+                        solver_options=SOLVER)
+        g2 = AgentGroup(name="b", ocp=tracker_ocp, n_agents=1,
+                        exchanges={"shared_u": "u"},
+                        solver_options=SOLVER)
+        with pytest.raises(ValueError, match="both consensus"):
+            FusedADMM([g1, g2], FusedADMMOptions())
+
     def test_residual_history_monotone_tail(self, tracker_ocp):
         group = AgentGroup(
             name="trackers", ocp=tracker_ocp, n_agents=3,
