@@ -52,6 +52,19 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     return path
 
 
+def request_virtual_devices(n: int) -> None:
+    """Set ``--xla_force_host_platform_device_count=n`` in XLA_FLAGS,
+    replacing any existing count (idempotent — a blind append would
+    leave two copies with unspecified precedence). Only honored if it
+    runs before the backend comes up."""
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    want = f"--xla_force_host_platform_device_count={n}"
+    os.environ["XLA_FLAGS"] = f"{flags} {want}".strip()
+
+
 def force_cpu(n_virtual_devices: int | None = None) -> None:
     """Pin this process to the host-CPU backend.
 
@@ -60,12 +73,7 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
     before the backend comes up — i.e. call this first thing).
     """
     if n_virtual_devices is not None:
-        import re
-
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                       os.environ.get("XLA_FLAGS", ""))
-        want = f"--xla_force_host_platform_device_count={n_virtual_devices}"
-        os.environ["XLA_FLAGS"] = f"{flags} {want}".strip()
+        request_virtual_devices(n_virtual_devices)
 
     import jax
 

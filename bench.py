@@ -816,9 +816,11 @@ def _child_main() -> None:
         # the sharded-eval validity check needs a multi-device mesh;
         # on CPU that means virtual host devices, which must be
         # requested BEFORE backend init (no-op on real multi-chip)
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
+        from agentlib_mpc_tpu.utils.jax_setup import (
+            request_virtual_devices,
+        )
+
+        request_virtual_devices(8)
     if "--probe" in sys.argv:
         import jax
 
@@ -847,9 +849,22 @@ def _spawn(args: list, env: dict, timeout: float) -> list:
     must not discard the completed ones) and raises only when nothing
     was produced."""
     def parse(out: str) -> list:
-        return [json.loads(line)
-                for line in (out or "").strip().splitlines()
-                if line.strip().startswith("{")]
+        lines = []
+        for line in (out or "").strip().splitlines():
+            if not line.strip().startswith("{"):
+                continue
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a kill can land mid-write of a multi-KB section line;
+                # the truncated tail must not discard the complete ones
+                print("[bench] dropping truncated JSON line",
+                      file=sys.stderr)
+        return lines
+
+    def as_text(stream) -> str:
+        return stream if isinstance(stream, str) else \
+            (stream or b"").decode(errors="replace")
 
     try:
         proc = subprocess.run(
@@ -857,8 +872,8 @@ def _spawn(args: list, env: dict, timeout: float) -> list:
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=_HERE)
     except subprocess.TimeoutExpired as exc:
-        lines = parse(exc.stdout if isinstance(exc.stdout, str)
-                      else (exc.stdout or b"").decode(errors="replace"))
+        sys.stderr.write(as_text(exc.stderr))
+        lines = parse(as_text(exc.stdout))
         if lines:
             print(f"[bench] child timed out after {timeout:.0f}s; "
                   f"salvaged {len(lines)} completed JSON line(s)",

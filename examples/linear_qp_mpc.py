@@ -1,0 +1,117 @@
+"""Linear MPC on the convex-QP fast path: closed-loop RC-zone cooling.
+
+The reference hands linear-MPC problems to dedicated QP solvers
+(qpoases/osqp/proxqp via its solver menu,
+``data_structures/casadi_utils.py:52-61``); here the same problem class
+is auto-detected and routed to the Mehrotra QP interior-point solver
+(``ops/qp.py``): the ``jax`` backend certifies LQ structure at setup
+(``solver.qp_fast_path: "auto"``) and the whole closed loop runs on the
+fast path — identical module configs, nothing QP-specific in them.
+
+The plant is :class:`~agentlib_mpc_tpu.models.zoo.LinearRCZone`: a 1R1C
+zone actuated directly in cooling POWER (affine dynamics ⇒ LQ program),
+started warm above its comfort band under an ambient of 30 °C.
+
+This is one of the examples-as-tests (``tests/test_examples.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import agentlib_mpc_tpu.modules  # noqa: F401 - registers module types
+from agentlib_mpc_tpu.runtime.mas import LocalMAS
+
+TIME_STEP = 300.0
+HORIZON = 8
+T_UPPER = 295.15
+START_TEMP = 299.15
+
+
+def agent_config() -> dict:
+    return {
+        "id": "LinearZone",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {
+                "module_id": "mpc",
+                "type": "mpc",
+                "optimization_backend": {
+                    "type": "jax",
+                    # zoo model by NAME: the config is pure JSON
+                    "model": {"class": "LinearRCZone"},
+                    "discretization_options": {"collocation_order": 2},
+                    "solver": {"max_iter": 60, "tol": 1e-4},
+                },
+                "time_step": TIME_STEP,
+                "prediction_horizon": HORIZON,
+                "inputs": [
+                    {"name": "load", "value": 150.0},
+                    {"name": "T_amb", "value": 303.15},
+                    {"name": "T_upper", "value": T_UPPER},
+                ],
+                "states": [
+                    {"name": "T", "value": START_TEMP, "ub": 310.15,
+                     "lb": 288.15},
+                    {"name": "T_slack", "value": 0.0},
+                ],
+                "controls": [
+                    {"name": "Q", "value": 0.0, "ub": 500.0, "lb": 0.0},
+                ],
+                "parameters": [
+                    {"name": "C", "value": 100000.0},
+                    {"name": "R", "value": 0.05},
+                    {"name": "s_T", "value": 1.0},
+                    {"name": "r_Q", "value": 1e-3},
+                ],
+            },
+            {
+                "module_id": "sim",
+                "type": "simulator",
+                "model": {"class": "LinearRCZone",
+                          "states": [{"name": "T", "value": START_TEMP}]},
+                "t_sample": TIME_STEP,
+                "outputs": [{"name": "T_out", "value": START_TEMP,
+                             "alias": "T"}],
+                "inputs": [{"name": "Q", "value": 0.0, "alias": "Q"}],
+            },
+        ],
+    }
+
+
+def run_example(until: float = 7200.0, testing: bool = False,
+                verbose: bool = True):
+    mas = LocalMAS([agent_config()], env={"rt": False})
+    mas.run(until=until)
+
+    mpc = mas.agents["LinearZone"].get_module("mpc")
+    sim = mas.agents["LinearZone"].get_module("sim")
+    stats = mpc.solver_stats()
+    t_final = float(np.asarray(sim.vars["T_out"].value))
+    if verbose:
+        for t, row in stats.iterrows():
+            print(f"t={t:7.0f}s  iters={int(row['iterations']):3d}  "
+                  f"ok={bool(row['success'])}  "
+                  f"solve={1e3 * row['solve_wall_time']:7.1f}ms")
+        print(f"QP fast path: {mpc.backend.uses_qp_fast_path}")
+        print(f"plant temperature: {START_TEMP:.2f} K -> {t_final:.2f} K "
+              f"(band {T_UPPER} K)")
+
+    if testing:
+        assert mpc.backend.uses_qp_fast_path, \
+            "LinearRCZone must certify as LQ and ride the QP path"
+        assert bool(stats["success"].all()), stats
+        # the plant was pulled to (or just at) the comfort band
+        assert t_final <= T_UPPER + 0.1
+        # warm solves are ms-scale
+        assert float(stats["solve_wall_time"][1:].mean()) < 0.5
+    return mas.get_results()
+
+
+if __name__ == "__main__":
+    run_example()

@@ -58,6 +58,13 @@ def test_mhe_one_room_example():
     assert "Plant" in results
 
 
+def test_linear_qp_mpc_example():
+    from examples.linear_qp_mpc import run_example
+
+    results = run_example(until=3600, testing=True, verbose=False)
+    assert "LinearZone" in results
+
+
 def test_minlp_switched_room_example():
     from examples.minlp_switched_room import run_example
 
