@@ -219,6 +219,39 @@ class ADMMBackend(JAXBackend):
             g=lambda w, th: ocp.nlp.g(w, th[0]),
             h=lambda w, th: ocp.nlp.h(w, th[0]))
 
+        # QP fast-path routing for the AUGMENTED problem: input-kind
+        # coupling penalties are quadratic in w, but output-kind
+        # couplings pull the (possibly nonlinear) output map into the
+        # objective — so the probe must run on the augmented NLP, not
+        # the base OCP (solver.qp_fast_path: auto/on/off, as in the
+        # central backend). Means/multipliers probe at RANDOM values:
+        # zeros would hide a nonlinear output map that only enters
+        # through the LINEAR penalty terms (λᵀx_loc, −ρ z̄ᵀ x_loc)
+        from agentlib_mpc_tpu.ops.qp import (
+            is_lq,
+            resolve_qp_routing,
+            solve_qp,
+        )
+
+        def probe():
+            theta0 = ocp.default_params()
+            key = jax.random.PRNGKey(17)
+            ks = jax.random.split(key, 4)
+            aug0 = (theta0,
+                    jax.random.normal(ks[0], (len(coup_names), self.N)),
+                    jax.random.normal(ks[1], (len(coup_names), self.N)),
+                    jax.random.normal(ks[2], (len(ex_names), self.N)),
+                    jax.random.normal(ks[3], (len(ex_names), self.N)),
+                    jnp.asarray(1.0))
+            n_w = int(ocp.initial_guess(theta0).shape[0])
+            return is_lq(nlp, aug0, n_w)
+
+        self.uses_qp_fast_path = resolve_qp_routing(
+            str((self.config.get("solver") or {})
+                .get("qp_fast_path", "auto")),
+            probe, logger=self.logger, label="the augmented ADMM OCP")
+        inner = solve_qp if self.uses_qp_fast_path else solve_nlp
+
         def make_step(opts):
             @jax.jit
             def step(x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
@@ -229,8 +262,8 @@ class ADMMBackend(JAXBackend):
                     x_lb=x_lb, x_ub=x_ub, u_lb=u_lb, u_ub=u_ub, t0=t0)
                 lb, ub = ocp.bounds(theta)
                 full_theta = (theta, means, lams, ex_diffs, ex_lams, rho)
-                res = solve_nlp(nlp, w_guess, full_theta, lb, ub, opts,
-                                y0=y_guess, z0=z_guess, mu0=mu0)
+                res = inner(nlp, w_guess, full_theta, lb, ub, opts,
+                            y0=y_guess, z0=z_guess, mu0=mu0)
                 traj = ocp.trajectories(res.w, theta)
                 u0 = jnp.clip(traj["u"][0], theta.u_lb[0], theta.u_ub[0])
                 coup_trajs = {n: extractors[n](res.w, theta)

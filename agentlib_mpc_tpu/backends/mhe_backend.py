@@ -152,12 +152,33 @@ class MHEBackend(OptimizationBackend):
         self.solver_options = solver_options_from_config(
             self.config.get("solver"))
         self._exo_names = list(self.ocp.exo_names)
+        self._resolve_qp_fast_path()
         self._build_step_fn()
         self._reset_warm_start()
+
+    def _resolve_qp_fast_path(self) -> None:
+        """Linear plant + weighted least-squares tracking = an LQ
+        estimation program (the tracking terms are quadratic in ``w``
+        for any weight, so probing this OCP's own nlp is exact)."""
+        from agentlib_mpc_tpu.ops.qp import is_lq, resolve_qp_routing
+
+        def probe():
+            theta0 = self.ocp.default_params()
+            n = int(self.ocp.initial_guess(theta0).shape[0])
+            return is_lq(self.ocp.nlp, theta0, n)
+
+        self.uses_qp_fast_path = resolve_qp_routing(
+            str((self.config.get("solver") or {})
+                .get("qp_fast_path", "auto")),
+            probe, logger=self.logger, label="the MHE OCP")
 
     def _build_step_fn(self) -> None:
         ocp = self.ocp
         opts = self.solver_options
+        if getattr(self, "uses_qp_fast_path", False):
+            from agentlib_mpc_tpu.ops.qp import solve_qp as solver_fn
+        else:
+            solver_fn = solve_nlp
 
         @jax.jit
         def step(x0, d_traj, p, x_lb, x_ub, u_lb, u_ub,
@@ -166,7 +187,7 @@ class MHEBackend(OptimizationBackend):
                 x0=x0, d_traj=d_traj, p=p, x_lb=x_lb, x_ub=x_ub,
                 u_lb=u_lb, u_ub=u_ub, t0=t0)
             lb, ub = ocp.bounds(theta)
-            res = solve_nlp(ocp.nlp, w_guess, theta, lb, ub, opts,
+            res = solver_fn(ocp.nlp, w_guess, theta, lb, ub, opts,
                             y0=y_guess, z0=z_guess, mu0=mu0)
             traj = ocp.trajectories(res.w, theta)
             return traj, res.w, res.y, res.z, res.stats

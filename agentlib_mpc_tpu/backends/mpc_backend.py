@@ -96,26 +96,18 @@ class JAXBackend(OptimizationBackend):
         ``solver.qp_fast_path``: ``"auto"`` (default — a one-time
         structure probe at setup decides), ``"on"`` (force; the caller
         asserts LQ-ness), ``"off"``."""
-        from agentlib_mpc_tpu.ops.qp import is_lq
+        from agentlib_mpc_tpu.ops.qp import is_lq, resolve_qp_routing
 
-        mode = str((self.config.get("solver") or {})
-                   .get("qp_fast_path", "auto"))
-        if mode == "on":
-            self.uses_qp_fast_path = True
-        elif mode == "off":
-            self.uses_qp_fast_path = False
-        elif mode == "auto":
+        def probe():
             theta0 = self.ocp.default_params()
             n = int(self.ocp.initial_guess(theta0).shape[0])
-            self.uses_qp_fast_path = is_lq(self.ocp.nlp, theta0, n)
-        else:
-            raise ValueError(
-                f"solver.qp_fast_path must be 'auto', 'on' or 'off', "
-                f"got {mode!r}")
-        if self.uses_qp_fast_path:
-            self.logger.info(
-                "LQ structure certified: dispatching to the Mehrotra QP "
-                "fast path")
+            return is_lq(self.ocp.nlp, theta0, n)
+
+        self.uses_qp_fast_path = resolve_qp_routing(
+            str((self.config.get("solver") or {})
+                .get("qp_fast_path", "auto")),
+            probe, logger=self.logger,
+            label=f"the {type(self).__name__} OCP")
 
     def _precompile(self) -> None:
         """Trigger XLA compilation at setup with default inputs so the first

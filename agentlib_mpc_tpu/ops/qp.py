@@ -49,7 +49,28 @@ from agentlib_mpc_tpu.ops.solver import (
     _safe_max,
 )
 
-__all__ = ["is_lq", "solve_qp"]
+__all__ = ["is_lq", "resolve_qp_routing", "solve_qp"]
+
+
+def resolve_qp_routing(mode: str, probe, logger=None,
+                       label: str = "problem") -> bool:
+    """Shared auto/on/off routing decision for every QP-fast-path seam
+    (central backend, ADMM backend, MHE backend, fused groups — one
+    definition so mode validation and probe semantics cannot drift).
+    ``probe`` is a zero-arg callable returning the :func:`is_lq` verdict;
+    it only runs for ``"auto"``."""
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if mode != "auto":
+        raise ValueError(
+            f"qp_fast_path must be 'auto', 'on' or 'off', got {mode!r}")
+    use = bool(probe())
+    if use and logger is not None:
+        logger.info("LQ structure certified for %s: dispatching to the "
+                    "Mehrotra QP fast path", label)
+    return use
 
 
 def is_lq(nlp: NLPFunctions, theta, n: int, *, seed: int = 0,

@@ -364,28 +364,39 @@ class FusedADMM:
 
         # per-group solver routing: LQ groups (linear models — their
         # quadratic ADMM augmentation keeps them LQ) ride the Mehrotra
-        # QP fast path; probed once here, eagerly, per group structure
-        from agentlib_mpc_tpu.ops.qp import is_lq, solve_qp
+        # QP fast path; probed once here, eagerly, per group structure.
+        # Means/multipliers probe at RANDOM values: zeros would hide a
+        # nonlinear coupling map entering only through the linear
+        # penalty terms.
+        from agentlib_mpc_tpu.ops.qp import (
+            is_lq,
+            resolve_qp_routing,
+            solve_qp,
+        )
 
         group_uses_qp = []
         for gi, g in enumerate(groups):
-            mode = g.qp_fast_path
-            if mode not in ("auto", "on", "off"):
-                raise ValueError(
-                    f"group {g.name!r}: qp_fast_path must be 'auto', "
-                    f"'on' or 'off', got {mode!r}")
-            if mode == "auto":
+            def probe(gi=gi, g=g):
                 theta0 = g.ocp.default_params()
+                key = jax.random.PRNGKey(17 + gi)
                 # per-agent aug slices are (T,) for both coupling kinds
                 aug0 = tuple(
-                    (jnp.zeros((self.T,)), jnp.zeros((self.T,)),
+                    (jax.random.normal(k1, (self.T,)),
+                     jax.random.normal(k2, (self.T,)),
                      jnp.asarray(1.0))
-                    for _ in aug_map[gi])
+                    for k1, k2 in zip(
+                        jax.random.split(key, max(len(aug_map[gi]), 1)),
+                        jax.random.split(jax.random.PRNGKey(31 + gi),
+                                         max(len(aug_map[gi]), 1))))
+                aug0 = aug0[:len(aug_map[gi])]
                 n_w = int(g.ocp.initial_guess(theta0).shape[0])
-                group_uses_qp.append(
-                    is_lq(group_nlps[gi], (theta0, aug0), n_w))
-            else:
-                group_uses_qp.append(mode == "on")
+                return is_lq(group_nlps[gi], (theta0, aug0), n_w)
+
+            try:
+                group_uses_qp.append(resolve_qp_routing(
+                    g.qp_fast_path, probe, label=f"group {g.name!r}"))
+            except ValueError as exc:
+                raise ValueError(f"group {g.name!r}: {exc}") from exc
         self.group_uses_qp = tuple(group_uses_qp)
 
         warm_opts = [

@@ -189,6 +189,90 @@ class TestBackendRouting:
         with pytest.raises(ValueError, match="qp_fast_path"):
             self._backend(LinearRCZone, ["Q"], qp_fast_path="yes")
 
+    def test_admm_backend_probes_augmented_problem(self):
+        """The decentralized-ADMM backend routes on the AUGMENTED OCP:
+        a linear model with quadratic coupling penalties certifies; the
+        bilinear cooled room does not."""
+        from conftest import make_tracker_model
+
+        from agentlib_mpc_tpu.backends.admm_backend import (
+            ADMMVariableReference,
+        )
+        from agentlib_mpc_tpu.backends.backend import create_backend
+        from agentlib_mpc_tpu.models.zoo import CooledRoom
+
+        def admm_backend(model_cls, var_ref):
+            backend = create_backend({
+                "type": "jax_admm",
+                "model": {"class": model_cls},
+                "discretization_options": {"collocation_order": 1},
+                "solver": {"max_iter": 40},
+            })
+            backend.setup_optimization(var_ref, time_step=300.0,
+                                       prediction_horizon=4)
+            return backend
+
+        linear = admm_backend(
+            make_tracker_model(),
+            ADMMVariableReference(parameters=["a"], couplings=["u"]))
+        assert linear.uses_qp_fast_path
+        bilinear = admm_backend(
+            CooledRoom,
+            ADMMVariableReference(
+                states=["T", "T_slack"],
+                inputs=["load", "T_in", "T_upper"],
+                parameters=["cp", "C", "s_T"], couplings=["mDot"]))
+        assert not bilinear.uses_qp_fast_path
+        # the routed backend still solves the coupled problem
+        res = linear.solve(0.0, {"a": 2.0})
+        assert res["stats"]["success"]
+
+    def test_mhe_backend_routes_linear_estimation(self):
+        """Linear plant + quadratic tracking = LQ estimation program:
+        the MHE backend certifies and both paths agree."""
+        from agentlib_mpc_tpu.backends.backend import create_backend
+        from agentlib_mpc_tpu.backends.mhe_backend import (
+            MHEVariableReference,
+        )
+
+        def mhe_backend(qp):
+            backend = create_backend({
+                "type": "jax_mhe",
+                "model": {"class": "LinearRCZone"},
+                "discretization_options": {"collocation_order": 2},
+                "solver": {"max_iter": 60, "tol": 1e-8,
+                           "qp_fast_path": qp},
+            })
+            backend.setup_optimization(
+                MHEVariableReference(
+                    states=["T"], measured_states=["measured_T"],
+                    weights_states=["weight_T"],
+                    estimated_inputs=["Q"],
+                    known_inputs=["load", "T_amb", "T_upper"]),
+                time_step=300.0, prediction_horizon=4)
+            return backend
+
+        fast, slow = mhe_backend("auto"), mhe_backend("off")
+        assert fast.uses_qp_fast_path and not slow.uses_qp_fast_path
+        meas = (np.array([0.0, 300.0, 600.0, 900.0, 1200.0]),
+                np.array([298.0, 297.4, 296.9, 296.5, 296.2]))
+        variables = {"measured_T": meas, "weight_T": 10.0,
+                     "load": 150.0, "T_amb": 303.15, "T_upper": 295.15}
+        rf = fast.solve(1200.0, dict(variables))
+        rs = slow.solve(1200.0, dict(variables))
+        assert rf["stats"]["success"] and rs["stats"]["success"]
+        # the estimation problem is near-degenerate (input + free
+        # initial state anchored only by tracking) and heavily scaled,
+        # so both solvers stop at honest near-optima in a flat valley
+        # (measured: ~1e-3 relative objective gap persists even at
+        # tol=1e-10 for either path) — equivalence is judged at that
+        # resolution
+        scale = max(1.0, abs(rs["stats"]["objective"]))
+        assert abs(rf["stats"]["objective"]
+                   - rs["stats"]["objective"]) < 2e-3 * scale
+        np.testing.assert_allclose(rf["estimates"]["T"],
+                                   rs["estimates"]["T"], atol=0.05)
+
     def test_qp_and_nlp_paths_agree_on_lq_mpc(self):
         """The A/B VERDICT r4 #3 asks for: same linearized one-room
         problem, both solver paths, identical trajectories."""
