@@ -30,3 +30,4 @@ from agentlib_mpc_tpu.models.variables import (
     output,
 )
 from agentlib_mpc_tpu.models.model import Model, ModelEquations
+from agentlib_mpc_tpu import telemetry

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.backends.backend import (
     VariableReference,
     load_model,
@@ -319,15 +320,18 @@ class ADMMBackend(JAXBackend):
             self.solver_options.mu_init if self._cold else 1e-2,
             dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
-        u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
-            step_fn(
-                x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-                jnp.asarray(means), jnp.asarray(lams),
-                jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
-                jnp.asarray(rho),
-                self._w_guess, self._y_guess, self._z_guess, mu0,
-                jnp.asarray(float(now)))
-        u0.block_until_ready()
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}",
+                            warm=str(warm)):
+            u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
+                step_fn(
+                    x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                    jnp.asarray(means), jnp.asarray(lams),
+                    jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
+                    jnp.asarray(rho),
+                    self._w_guess, self._y_guess, self._z_guess, mu0,
+                    jnp.asarray(float(now)))
+            u0.block_until_ready()
         wall = _time.perf_counter() - t_start
         self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
         self._cold = False
@@ -341,11 +345,7 @@ class ADMMBackend(JAXBackend):
             "constraint_violation": float(stats.constraint_violation),
             "solve_wall_time": wall,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning(
-                "admm solve at t=%s did not converge (kkt=%.2e)",
-                now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
         controls = list(self.ocp.control_names)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(controls)

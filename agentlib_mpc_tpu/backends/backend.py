@@ -15,9 +15,13 @@ import importlib.util
 import logging
 from typing import Any, Optional, Type
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.models.model import Model
 
 logger = logging.getLogger(__name__)
+
+# the shared solver metric families (declared once in telemetry)
+_SOLVER_METRICS = telemetry.solver_metrics()
 
 backend_types: dict[str, Type["OptimizationBackend"]] = {}
 
@@ -136,8 +140,57 @@ class OptimizationBackend:
         self.config = dict(config)
         self.var_ref: Optional[VariableReference] = None
         self.model: Optional[Model] = None
-        self.stats_history: list[dict] = []
+        self._stats_history: list[dict] = []
         self.logger = logger
+
+    @property
+    def stats_history(self) -> list[dict]:
+        """Back-compat view of the per-solve stats rows.
+
+        Telemetry is the first-class record now (``solver_*`` metric
+        families in :mod:`agentlib_mpc_tpu.telemetry`); this property keeps
+        the pre-telemetry contract — a mutable list of per-solve dicts with
+        the historical key schema (time, iterations, success, kkt_error,
+        objective, constraint_violation, solve_wall_time) — for the module
+        results writers and existing user code. ``append``/``clear`` on the
+        returned list behave exactly as before.
+        """
+        return self._stats_history
+
+    def _record_solve(self, stats_row: dict) -> None:
+        """Record one solve: stats row (back-compat history), telemetry
+        metrics, and — on failure — ONE warning carrying the full stats row
+        (iterations / objective / constraint violation included, not just
+        the kkt error) plus a ``solver_failures_total{backend=...}``
+        increment. All five backends route their ``solve()`` through here.
+        """
+        if getattr(self, "_suppress_record", False):
+            # throwaway solves (precompile warm-up) must not pollute the
+            # solver_* families: a 10+ s compile-inclusive sample would
+            # dominate solver_solve_seconds and read as a runtime solve.
+            # The backend.solve span still records — compile attribution
+            # is exactly what a precompile solve is for.
+            return
+        self._stats_history.append(stats_row)
+        backend = type(self).__name__
+        m = _SOLVER_METRICS
+        if telemetry.enabled():
+            m["solves"].inc(backend=backend)
+            if "iterations" in stats_row:
+                m["iterations"].observe(float(stats_row["iterations"]),
+                                        backend=backend)
+            if "solve_wall_time" in stats_row:
+                m["solve_seconds"].observe(
+                    float(stats_row["solve_wall_time"]), backend=backend)
+            if "kkt_error" in stats_row:
+                m["kkt_error"].set(float(stats_row["kkt_error"]),
+                                   backend=backend)
+        if not stats_row.get("success", True):
+            if telemetry.enabled():
+                m["failures"].inc(backend=backend)
+            self.logger.warning(
+                "%s solve at t=%s did not converge; stats: %s",
+                backend, stats_row.get("time"), stats_row)
 
     def register_logger(self, lg: logging.Logger) -> None:
         """Reference contract: the owning module injects its logger

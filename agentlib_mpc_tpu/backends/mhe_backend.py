@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.backends.backend import (
     OptimizationBackend,
     load_model,
@@ -276,11 +277,13 @@ class MHEBackend(OptimizationBackend):
         mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
                           dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
-        traj, w_next, y_next, z_next, stats = self._step(
-            x0, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-            self._w_guess, self._y_guess, self._z_guess, mu0,
-            jnp.asarray(t0))
-        jax.block_until_ready(traj)
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}"):
+            traj, w_next, y_next, z_next, stats = self._step(
+                x0, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                self._w_guess, self._y_guess, self._z_guess, mu0,
+                jnp.asarray(t0))
+            jax.block_until_ready(traj)
         wall = _time.perf_counter() - t_start
         self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
         self._cold = False
@@ -294,10 +297,7 @@ class MHEBackend(OptimizationBackend):
             "constraint_violation": float(stats.constraint_violation),
             "solve_wall_time": wall,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning("MHE solve at t=%s did not converge "
-                                "(kkt=%.2e)", now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
 
         x_traj = np.asarray(traj["x"])
         u_traj = np.asarray(traj["u"])

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.backends.backend import (
     VariableReference,
     register_backend,
@@ -206,10 +207,13 @@ class MINLPBackend(JAXBackend):
         t_start = _time.perf_counter()
 
         # phase 1: relaxed NLP
-        _, traj_rel, w_next, y_next, z_next, stats_rel = self._step(
-            x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-            self._w_guess, self._y_guess, self._z_guess, mu0, t_now)
-        b_rel = np.asarray(traj_rel["u"])[:, bi]
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}",
+                            phase="relaxed"):
+            _, traj_rel, w_next, y_next, z_next, stats_rel = self._step(
+                x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                self._w_guess, self._y_guess, self._z_guess, mu0, t_now)
+            b_rel = np.asarray(traj_rel["u"])[:, bi]
 
         # phase 2: binary schedule, clamped to the binary values the bound
         # trajectories actually admit (an interval with ub < 1 cannot
@@ -230,8 +234,11 @@ class MINLPBackend(JAXBackend):
 
         # phase 3: binaries enter as exogenous data of the fixed program
         ci = self._cont_idx
-        u0_c, traj, stats = self._solve_fixed(B, ctx)
-        jax.block_until_ready(traj)
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}",
+                            phase="fixed"):
+            u0_c, traj, stats = self._solve_fixed(B, ctx)
+            jax.block_until_ready(traj)
         wall = _time.perf_counter() - t_start
 
         # warm-start bookkeeping rides the relaxed program; a non-finite
@@ -263,11 +270,7 @@ class MINLPBackend(JAXBackend):
             "relaxed_success": bool(stats_rel.success),
             **self._schedule_stats,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning(
-                "MINLP solve at t=%s did not converge (kkt=%.2e)",
-                now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i])
                    for i, n in enumerate(self.var_ref.controls)},

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.backends.admm_backend import (
     ADMMVariableReference,
     EXCHANGE_MEAN_PREFIX,
@@ -82,7 +83,11 @@ class MLBackend(OptimizationBackend):
         self._build_step_fn()
         self._reset_warm_start()
         if self.config.get("precompile"):
-            self.solve(0.0, {})
+            self._suppress_record = True
+            try:
+                self.solve(0.0, {})
+            finally:
+                self._suppress_record = False
             self.stats_history.clear()
             self._reset_warm_start()
 
@@ -213,12 +218,14 @@ class MLBackend(OptimizationBackend):
         mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
                           dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
-        u0, traj, w_next, y_next, z_next, stats = self._step(
-            x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-            self.model.ml_params,
-            self._w_guess, self._y_guess, self._z_guess, mu0,
-            jnp.asarray(float(now)))
-        u0.block_until_ready()
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}"):
+            u0, traj, w_next, y_next, z_next, stats = self._step(
+                x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                self.model.ml_params,
+                self._w_guess, self._y_guess, self._z_guess, mu0,
+                jnp.asarray(float(now)))
+            u0.block_until_ready()
         wall = _time.perf_counter() - t_start
         self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
         self._cold = False
@@ -232,10 +239,7 @@ class MLBackend(OptimizationBackend):
             "constraint_violation": float(stats.constraint_violation),
             "solve_wall_time": wall,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning("ML solve at t=%s did not converge "
-                                "(kkt=%.2e)", now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},
             "traj": {k: np.asarray(v) for k, v in traj.items()},
@@ -371,16 +375,18 @@ class MLADMMBackend(MLBackend):
         mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
                           dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
-        u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
-            self._step_admm(
-                x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-                self.model.ml_params,
-                jnp.asarray(means), jnp.asarray(lams),
-                jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
-                jnp.asarray(rho),
-                self._w_guess, self._y_guess, self._z_guess, mu0,
-                jnp.asarray(float(now)))
-        u0.block_until_ready()
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}"):
+            u0, traj, coup_trajs, w_next, y_next, z_next, stats = \
+                self._step_admm(
+                    x0, u_prev, past, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                    self.model.ml_params,
+                    jnp.asarray(means), jnp.asarray(lams),
+                    jnp.asarray(ex_diffs), jnp.asarray(ex_lams),
+                    jnp.asarray(rho),
+                    self._w_guess, self._y_guess, self._z_guess, mu0,
+                    jnp.asarray(float(now)))
+            u0.block_until_ready()
         wall = _time.perf_counter() - t_start
         self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
         self._cold = False
@@ -394,10 +400,7 @@ class MLADMMBackend(MLBackend):
             "constraint_violation": float(stats.constraint_violation),
             "solve_wall_time": wall,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning("admm-ml solve at t=%s did not converge "
-                                "(kkt=%.2e)", now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
         controls = list(self.ocp.control_names)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(controls)

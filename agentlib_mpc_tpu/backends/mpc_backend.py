@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.backends.backend import (
     OptimizationBackend,
     VariableReference,
@@ -113,8 +114,14 @@ class JAXBackend(OptimizationBackend):
         """Trigger XLA compilation at setup with default inputs so the first
         real-time control step meets its wall-clock budget (the reference
         pays this cost to CasADi codegen/DLL compilation instead,
-        ``casadi_utils.py:313-369``; here it is one throwaway solve)."""
-        self.solve(0.0, {})
+        ``casadi_utils.py:313-369``; here it is one throwaway solve).
+        Telemetry recording is suppressed for the throwaway solve (the
+        compile still attributes to the ``backend.solve`` span)."""
+        self._suppress_record = True
+        try:
+            self.solve(0.0, {})
+        finally:
+            self._suppress_record = False
         self.stats_history.clear()
         self._reset_warm_start()
 
@@ -201,11 +208,13 @@ class JAXBackend(OptimizationBackend):
         mu0 = jnp.asarray(self.solver_options.mu_init if self._cold else 1e-2,
                           dtype=self._w_guess.dtype)
         t_start = _time.perf_counter()
-        u0, traj, w_next, y_next, z_next, stats = self._step(
-            x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
-            self._w_guess, self._y_guess, self._z_guess, mu0,
-            jnp.asarray(float(now)))
-        u0.block_until_ready()
+        with telemetry.span("backend.solve", backend=type(self).__name__,
+                            instance=f"{id(self):x}"):
+            u0, traj, w_next, y_next, z_next, stats = self._step(
+                x0, u_prev, d_traj, p, x_lb, x_ub, u_lb, u_ub,
+                self._w_guess, self._y_guess, self._z_guess, mu0,
+                jnp.asarray(float(now)))
+            u0.block_until_ready()
         wall = _time.perf_counter() - t_start
         self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
         self._cold = False
@@ -219,10 +228,7 @@ class JAXBackend(OptimizationBackend):
             "constraint_violation": float(stats.constraint_violation),
             "solve_wall_time": wall,
         }
-        self.stats_history.append(stats_row)
-        if not stats_row["success"]:
-            self.logger.warning("solve at t=%s did not converge (kkt=%.2e)",
-                                now, stats_row["kkt_error"])
+        self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},
             "traj": {k: np.asarray(v) for k, v in traj.items()},

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from agentlib_mpc_tpu.modules.admm import ADMMModule, CouplingEntry
+from agentlib_mpc_tpu.ops.admm import record_residuals, trim_residuals
 from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
 from agentlib_mpc_tpu.utils.sampling import shift_time_series
 from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
@@ -478,6 +479,15 @@ class ADMMCoordinator(BaseModule):
         prim_norm = float(np.linalg.norm(prim))
         dual_norm = float(np.linalg.norm(dual))
         self._vary_penalty(prim_norm, dual_norm)
+        record_residuals(prim_norm, dual_norm, iteration=iteration,
+                         agent=self.agent.id)
+        # new round: drop the stale tail of the previous (longer) round so
+        # the per-iteration gauges always describe ONE round
+        prev = getattr(self, "_recorded_admm_iters", 0)
+        if iteration == 0 and prev > 1:
+            trim_residuals(1, prev, agent=self.agent.id)
+            prev = 1
+        self._recorded_admm_iters = max(prev, iteration + 1)
         self._stats_rows.append({
             "time": self._round_start,
             "iteration": iteration,

@@ -172,6 +172,61 @@ def converged(res: AdmmResiduals, abs_tol: float = 1e-3,
     return (res.primal < primal_tol) & (res.dual < dual_tol)
 
 
+def record_residuals(primal, dual, *, iteration=None, registry=None,
+                     **labels) -> None:
+    """Host-side: write one ADMM iteration's primal/dual residuals into
+    the telemetry registry as ``admm_primal_residual`` /
+    ``admm_dual_residual`` gauges (labeled by ``iteration`` and any extra
+    labels, e.g. ``fleet=...`` or ``agent=...``) plus an
+    ``admm_iterations_total`` counter.
+
+    One definition shared by every ADMM driver — the broker-based
+    :mod:`~agentlib_mpc_tpu.modules.coordinator`, the fused engine
+    (:meth:`~agentlib_mpc_tpu.parallel.fused_admm.FusedADMM.step`) and the
+    bench — so the per-iteration residual view reads the same regardless
+    of which plane produced it. Call with concrete floats outside any jit;
+    a no-op when telemetry is disabled."""
+    from agentlib_mpc_tpu import telemetry
+
+    reg = registry or telemetry.metrics()
+    if not reg.enabled:
+        return
+    lbl = dict(labels)
+    if iteration is not None:
+        lbl["iteration"] = str(int(iteration))
+    reg.gauge("admm_primal_residual",
+              "ADMM primal residual of the labeled iteration"
+              ).set(float(primal), **lbl)
+    reg.gauge("admm_dual_residual",
+              "ADMM dual residual of the labeled iteration"
+              ).set(float(dual), **lbl)
+    reg.counter("admm_iterations_total",
+                "global ADMM iterations recorded").inc(**labels)
+
+
+def trim_residuals(start_iteration: int, end_iteration: int, *,
+                   registry=None, **labels) -> None:
+    """Remove stale per-iteration residual gauges in
+    ``[start_iteration, end_iteration)`` for one label set.
+
+    A round that converges in fewer iterations than the previous one only
+    overwrites the low iterations; without trimming, the registry would
+    mix iterations 0..1 of round N with 2..9 of round N-1 and the
+    residual-vs-iteration view would render a fictitious curve. Drivers
+    call this after recording each round with the previous round's length
+    as ``end_iteration``."""
+    from agentlib_mpc_tpu import telemetry
+
+    reg = registry or telemetry.metrics()
+    prim = reg.gauge("admm_primal_residual",
+                     "ADMM primal residual of the labeled iteration")
+    dual = reg.gauge("admm_dual_residual",
+                     "ADMM dual residual of the labeled iteration")
+    for k in range(start_iteration, end_iteration):
+        prim.remove(iteration=str(k), **labels)
+        dual.remove(iteration=str(k), **labels)
+
+
 def vary_penalty(rho, res: AdmmResiduals, threshold: float = 10.0,
                  factor: float = 2.0):
     """Residual-balancing adaptive penalty (``admm_coordinator.py:467-479``):

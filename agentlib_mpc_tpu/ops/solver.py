@@ -51,6 +51,7 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.ops import kkt as kkt_ops
 
 
@@ -133,6 +134,31 @@ class SolverResult(NamedTuple):
     z: jnp.ndarray       # inequality multipliers for h
     s: jnp.ndarray       # slacks for h
     stats: SolverStats
+
+
+def record_solver_stats(stats: SolverStats, **labels) -> None:
+    """Host-side: emit one solve's :class:`SolverStats` fields into the
+    telemetry registry (``solver_solves_total`` / ``solver_failures_total``
+    counters, ``solver_iterations`` histogram, ``solver_kkt_error`` gauge —
+    the same families the backends write, so fused/batched callers and the
+    module backends land in one view). Forces a device→host transfer of
+    the tiny stats scalars; call it once per solve outside the jit, never
+    inside a traced region. ``stats`` may be batched (vmapped lanes): each
+    lane records individually."""
+    if not telemetry.enabled():
+        return
+    import numpy as np
+
+    iters = np.atleast_1d(np.asarray(stats.iterations))
+    succ = np.atleast_1d(np.asarray(stats.success))
+    kkt = np.atleast_1d(np.asarray(stats.kkt_error))
+    m = telemetry.solver_metrics()
+    for i in range(iters.shape[0]):
+        m["solves"].inc(**labels)
+        m["iterations"].observe(float(iters[i]), **labels)
+        if not bool(succ[i]):
+            m["failures"].inc(**labels)
+    m["kkt_error"].set(float(np.max(kkt)), **labels)
 
 
 class _IPState(NamedTuple):
@@ -226,6 +252,32 @@ def solve_nlp(
     theta,
     w_lb: jnp.ndarray,
     w_ub: jnp.ndarray,
+    options: SolverOptions,
+    y0: jnp.ndarray | None = None,
+    z0: jnp.ndarray | None = None,
+    mu0: jnp.ndarray | None = None,
+    max_iter: jnp.ndarray | None = None,
+) -> SolverResult:
+    # KKT math needs true-f32 matmuls: TPU default precision would run them
+    # as bf16 MXU passes and destroy Newton step accuracy
+    with jax.default_matmul_precision("highest"):
+        return _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
+                               mu0, max_iter)
+
+
+# the jitted computation keeps the name ``solve_nlp`` (the XLA module name
+# enters the persistent-compilation-cache key — renaming it would
+# invalidate every cached solver executable); the telemetry wrapper below
+# shadows the module attribute for callers
+_solve_nlp_jit = solve_nlp
+
+
+def solve_nlp(
+    nlp: NLPFunctions,
+    w0: jnp.ndarray,
+    theta,
+    w_lb: jnp.ndarray,
+    w_ub: jnp.ndarray,
     options: SolverOptions = SolverOptions(),
     y0: jnp.ndarray | None = None,
     z0: jnp.ndarray | None = None,
@@ -241,12 +293,21 @@ def solve_nlp(
     (a cold full-budget solve + short warm re-solves, e.g. inexact ADMM)
     then share ONE solver trace/compilation instead of one per static
     budget — Python tracing of this function is the warm-start latency
-    floor of the big fused programs (PERF.md)."""
-    # KKT math needs true-f32 matmuls: TPU default precision would run them
-    # as bf16 MXU passes and destroy Newton step accuracy
-    with jax.default_matmul_precision("highest"):
-        return _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
-                               mu0, max_iter)
+    floor of the big fused programs (PERF.md).
+
+    Eager top-level calls (not under an enclosing jit/vmap trace) are
+    wrapped in a ``solver.solve_nlp`` telemetry span, so first-call
+    trace+compile latency is attributed to this entry point by the JAX
+    profiling hooks (``docs/telemetry.md``); calls made while tracing a
+    larger program (fused ADMM, backend step functions) dispatch straight
+    through — host-side instrumentation cannot run per inner solve inside
+    one XLA computation, and those programs carry their own spans."""
+    if isinstance(w0, jax.core.Tracer) or not telemetry.enabled():
+        return _solve_nlp_jit(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
+                              mu0, max_iter)
+    with telemetry.span("solver.solve_nlp", n_w=int(w0.shape[0])):
+        return _solve_nlp_jit(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
+                              mu0, max_iter)
 
 
 def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,

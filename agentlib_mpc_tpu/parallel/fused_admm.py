@@ -53,6 +53,7 @@ from typing import Any, NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.ops import admm as admm_ops
 from agentlib_mpc_tpu.ops.admm import (
     AdmmResiduals,
@@ -668,8 +669,56 @@ class FusedADMM:
     def step(self, state: FusedState, theta_batches: Sequence[OCPParams]):
         """Run one full ADMM round (≤ max_iterations, early exit on the
         relative-tolerance criterion). Returns (new_state, per-group
-        trajectory pytrees, IterationStats)."""
-        return self._step(state, tuple(theta_batches))
+        trajectory pytrees, IterationStats).
+
+        With telemetry enabled, the round runs under an
+        ``admm.fused_step`` span (compile latency of the fused program
+        attributes here) and the returned :class:`IterationStats` are
+        mirrored into the registry (per-iteration residual gauges, round
+        counters) — a device→host read of the small stats arrays the
+        caller consumes anyway."""
+        if not telemetry.enabled():
+            return self._step(state, tuple(theta_batches))
+        with telemetry.span("admm.fused_step",
+                            groups=",".join(g.name for g in self.groups)):
+            out = self._step(state, tuple(theta_batches))
+        self._record_round(out[2])
+        return out
+
+    def _record_round(self, stats: IterationStats) -> None:
+        """Mirror one round's IterationStats into the telemetry registry."""
+        import numpy as np
+
+        fleet = ",".join(g.name for g in self.groups)
+        n_it = int(stats.iterations)
+        prim = np.asarray(stats.primal_residuals)
+        dual = np.asarray(stats.dual_residuals)
+        n_rec = min(n_it, prim.shape[0])
+        for i in range(n_rec):
+            admm_ops.record_residuals(prim[i], dual[i], iteration=i,
+                                      fleet=fleet)
+        # a shorter round than the previous one must not leave the old
+        # round's tail iterations standing in the gauges
+        prev = getattr(self, "_recorded_iterations", 0)
+        if prev > n_rec:
+            admm_ops.trim_residuals(n_rec, prev, fleet=fleet)
+        self._recorded_iterations = n_rec
+        telemetry.counter(
+            "admm_rounds_total", "fused ADMM rounds run").inc(fleet=fleet)
+        if bool(stats.converged):
+            telemetry.counter(
+                "admm_rounds_converged_total",
+                "fused ADMM rounds that met the residual tolerances"
+                ).inc(fleet=fleet)
+        if not bool(stats.local_solves_ok):
+            telemetry.counter(
+                "admm_local_solve_failures_total",
+                "fused rounds where >= 1 inner solve exhausted its budget "
+                "without reaching an acceptable point").inc(fleet=fleet)
+        telemetry.histogram(
+            "admm_round_iterations", "ADMM iterations per fused round",
+            buckets=telemetry.ITERATION_BUCKETS
+            ).observe(float(n_it), fleet=fleet)
 
     def shard_args(self, mesh, state: FusedState,
                    theta_batches: Sequence[OCPParams]):

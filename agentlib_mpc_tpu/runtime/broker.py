@@ -15,14 +15,39 @@ reference's layering (fast path vs interop path).
 from __future__ import annotations
 
 import logging
+import time as _time
 from collections import defaultdict
 from typing import Callable, Optional
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
 
 logger = logging.getLogger(__name__)
 
 Callback = Callable[[AgentVariable], None]
+
+# telemetry families (labeled per agent; declared at import so exports list
+# them even before the first message — the bench artifact relies on that)
+_MESSAGES = telemetry.counter(
+    "broker_messages_total", "variables sent through DataBroker")
+_CALLBACKS = telemetry.counter(
+    "broker_callbacks_total", "subscriber callbacks dispatched")
+_UNMATCHED = telemetry.counter(
+    "broker_unmatched_total",
+    "variables that matched no callback AND were not forwarded anywhere "
+    "— genuinely dropped (normal broadcast fan-out to non-subscribing "
+    "agents does not count, or the misconfiguration signal would drown "
+    "in healthy cross-traffic)")
+_DISPATCH_SECONDS = telemetry.histogram(
+    "broker_dispatch_seconds",
+    "wall-clock seconds spent in local callback dispatch per message")
+
+#: dispatches at least this slow additionally record a ``broker.dispatch``
+#: span — fast-path messages stay out of the span ring buffer (thousands
+#: of per-message spans would evict the rare, valuable backend.solve /
+#: admm.fused_step records; their timing is fully captured by the
+#: ``broker_dispatch_seconds`` histogram anyway)
+SLOW_DISPATCH_S = 1e-3
 
 
 class DataBroker:
@@ -32,6 +57,9 @@ class DataBroker:
         self.agent_id = agent_id
         self._subs: list[tuple[str, Source, Callback]] = []
         self._bus: Optional["BroadcastBus"] = None
+        #: aliases already warned about (one dropped-variable warning per
+        #: alias per broker — rate limiting, not suppression of the count)
+        self._warned_unmatched: set[str] = set()
 
     def register_callback(self, alias: str, source, callback: Callback) -> None:
         self._subs.append((alias, Source.coerce(source), callback))
@@ -41,11 +69,47 @@ class DataBroker:
         self._subs = [s for s in self._subs if s != key]
 
     def send_variable(self, var: AgentVariable, from_external: bool = False) -> None:
-        """Deliver to local subscribers; forward shared vars to the bus."""
+        """Deliver to local subscribers; forward shared vars to the bus.
+
+        A variable that matches no local callback AND is not forwarded
+        anywhere (not shared / no bus / already external) is genuinely
+        dropped: it counts into
+        ``broker_unmatched_total{agent=...,alias=...}`` and logs ONE
+        warning per alias — the classic silent-misconfiguration (alias
+        typo, missing module) that previously vanished without a trace.
+        Unmatched *external* deliveries are normal broadcast fan-out and
+        deliberately do not count.
+        """
+        matched = 0
+        t0 = _time.perf_counter()
         for alias, source, cb in list(self._subs):
             if alias == var.alias and source.matches(var.source):
                 cb(var)
-        if var.shared and not from_external and self._bus is not None:
+                matched += 1
+        dt = _time.perf_counter() - t0
+        forwarded = var.shared and not from_external and self._bus is not None
+        if telemetry.enabled():
+            _MESSAGES.inc(agent=self.agent_id)
+            if matched:
+                _CALLBACKS.inc(matched, agent=self.agent_id)
+            _DISPATCH_SECONDS.observe(dt, agent=self.agent_id)
+            if dt >= SLOW_DISPATCH_S:
+                rec = telemetry.SpanRecord(
+                    "broker.dispatch",
+                    {"agent": self.agent_id, "alias": var.alias})
+                rec.start = t0
+                rec.duration = dt
+                telemetry.recorder().record(rec)
+        if not matched and not forwarded and not from_external:
+            _UNMATCHED.inc(agent=self.agent_id, alias=var.alias)
+            if var.alias not in self._warned_unmatched:
+                self._warned_unmatched.add(var.alias)
+                logger.warning(
+                    "agent %s: variable alias %r (source %s) matched no "
+                    "registered callback and was not forwarded — dropped "
+                    "(counted in broker_unmatched_total; warning once per "
+                    "alias)", self.agent_id, var.alias, var.source)
+        if forwarded:
             self._bus.broadcast(self.agent_id, var)
 
     def attach_bus(self, bus: "BroadcastBus") -> None:

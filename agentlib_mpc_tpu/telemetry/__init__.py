@@ -1,0 +1,134 @@
+"""Unified telemetry: metrics registry + span tracing + JAX compile hooks.
+
+The one import every instrumentation site uses::
+
+    from agentlib_mpc_tpu import telemetry
+
+    telemetry.counter("broker_messages_total").inc(agent="room_1")
+    with telemetry.span("backend.solve", backend="JAXBackend"):
+        ...jit dispatch...
+    telemetry.metrics().prometheus_text()     # scrape payload
+    telemetry.metrics().write_jsonl(path)     # artifact export
+
+Layout:
+
+- :mod:`.registry` — :class:`MetricsRegistry` (counters / gauges /
+  fixed-bucket histograms, labels, Prometheus text + JSONL export) and the
+  process-global :data:`~agentlib_mpc_tpu.telemetry.registry.DEFAULT`
+- :mod:`.spans` — ``span(name, **labels)`` context manager + ring-buffer
+  :class:`SpanRecorder`
+- :mod:`.jax_events` — ``jax.monitoring`` listeners turning XLA
+  compiles/retraces into metrics (installed via
+  :func:`agentlib_mpc_tpu.utils.jax_setup.enable_compile_profiling`)
+
+Enablement is process-global and ON by default (counters are ~100 ns;
+spans a few µs). ``telemetry.configure(enabled=False)`` turns every write
+into a near-zero no-op — the mode the latency-critical fleets run in, and
+what the ``telemetry-overhead`` tier-1 test pins. See ``docs/telemetry.md``.
+"""
+
+from __future__ import annotations
+
+from agentlib_mpc_tpu.telemetry.registry import (
+    DEFAULT,
+    ITERATION_BUCKETS,
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from agentlib_mpc_tpu.telemetry.spans import (
+    NOOP_SPAN,
+    RECORDER,
+    SpanRecord,
+    SpanRecorder,
+    current_span,
+    span,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ITERATION_BUCKETS", "LATENCY_BUCKETS_S",
+    "SpanRecord", "SpanRecorder", "NOOP_SPAN",
+    "metrics", "recorder", "span", "current_span",
+    "configure", "enabled", "counter", "gauge", "histogram",
+    "solver_metrics", "install_jax_hooks", "reset",
+]
+
+
+def metrics() -> MetricsRegistry:
+    """The process-global registry."""
+    return DEFAULT
+
+
+def recorder() -> SpanRecorder:
+    """The process-global span ring buffer."""
+    return RECORDER
+
+
+def enabled() -> bool:
+    return DEFAULT.enabled
+
+
+def configure(enabled: bool) -> None:
+    """Turn all telemetry writes on/off process-wide (metrics AND spans)."""
+    DEFAULT.configure(enabled)
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return DEFAULT.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return DEFAULT.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets=LATENCY_BUCKETS_S) -> Histogram:
+    return DEFAULT.histogram(name, help, buckets=buckets)
+
+
+def solver_metrics(registry: "MetricsRegistry | None" = None) -> dict:
+    """The shared solver metric families — ONE declaration site (names,
+    help text, buckets) used by both the backend base class
+    (``OptimizationBackend._record_solve``) and the host-side helper
+    :func:`agentlib_mpc_tpu.ops.solver.record_solver_stats`, so the two
+    writers can never drift apart. Keys: solves, failures, iterations,
+    solve_seconds, kkt_error."""
+    reg = registry or DEFAULT
+    return {
+        "solves": reg.counter(
+            "solver_solves_total", "backend solve() calls"),
+        "failures": reg.counter(
+            "solver_failures_total",
+            "backend solve() calls whose solver did not reach an "
+            "acceptable point"),
+        "iterations": reg.histogram(
+            "solver_iterations", "interior-point iterations per solve",
+            buckets=ITERATION_BUCKETS),
+        "solve_seconds": reg.histogram(
+            "solver_solve_seconds", "wall-clock seconds per backend solve"),
+        "kkt_error": reg.gauge(
+            "solver_kkt_error", "KKT error of the most recent solve"),
+    }
+
+
+def install_jax_hooks(registry: "MetricsRegistry | None" = None
+                      ) -> MetricsRegistry:
+    """Install the compile/retrace listeners (idempotent; lazy jax import).
+    Prefer :func:`agentlib_mpc_tpu.utils.jax_setup.enable_compile_profiling`
+    which also documents the platform story."""
+    from agentlib_mpc_tpu.telemetry import jax_events
+
+    return jax_events.install(registry)
+
+
+def reset() -> None:
+    """Clear all recorded samples, spans and retrace scopes (declared
+    metric families survive). Test-isolation / between-runs helper."""
+    DEFAULT.reset()
+    RECORDER.clear()
+    from agentlib_mpc_tpu.telemetry import jax_events
+
+    jax_events.reset_scopes()

@@ -1,0 +1,173 @@
+"""JAX compile/retrace profiling hooks (``jax.monitoring`` listeners).
+
+Cache misses are the dominant mystery latency of a jitted MPC stack: a
+config change that perturbs a static argument (solver options, horizon,
+shapes) silently retraces and recompiles the whole interior-point program
+(tens of seconds), and nothing in the old ``stats_history`` could say so.
+These hooks turn JAX's internal monitoring events into registry metrics:
+
+- ``jax_traces_total{entry_point=...}`` — jaxpr traces (every ``jit``
+  cache miss traces; inner jits of one entry point each count)
+- ``jax_retraces_total{entry_point=...}`` — traces for an entry point that
+  had already traced in an *earlier* instrumented call: the "why is this
+  warm call slow" alarm
+- ``jax_compiles_total{entry_point=...}`` / ``jax_compile_seconds_total``
+  — XLA backend compiles and their latency
+- ``jax_trace_seconds_total{entry_point=...}`` — Python tracing latency
+- ``jax_lower_seconds_total{entry_point=...}`` — jaxpr→MLIR lowering
+  latency (the third cold-start phase besides trace and compile)
+- ``jax_cache_events_total{event=...}`` — persistent-compilation-cache
+  activity (hits/misses/requests)
+
+``entry_point`` is the innermost active telemetry span
+(:func:`agentlib_mpc_tpu.telemetry.spans.current_span`) at the moment the
+event fires — the instrumented call sites (solver, backends, fused ADMM,
+bench) each wrap their jit dispatch in a span, so compile time lands on the
+call that paid it.  Events outside any span are attributed to
+``"(unscoped)"``.
+
+Retrace classification needs a call boundary (one trace batch fires several
+events): events within the *same span instance* as the scope's previous
+trace batch belong to that batch; a trace event from a *new* span instance
+of an already-traced scope is a retrace.  The scope identity is the span's
+``(name, labels)`` — two first-time traces under the same span *name* but
+different labels (``backend.solve{backend=JAXBackend}`` vs
+``{backend=MHEBackend}``, or the MINLP relaxed/fixed phases) are distinct
+programs and must not read as retraces of each other.  Unscoped events
+cannot be batch-separated and are never classified as retraces (documented
+in ``docs/telemetry.md``).
+
+Install once per process via
+:func:`agentlib_mpc_tpu.utils.jax_setup.enable_compile_profiling` (or
+:func:`install` directly); listeners respect the registry's enabled flag,
+so installing is safe even when telemetry is off.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from agentlib_mpc_tpu.telemetry import registry as _registry_mod
+from agentlib_mpc_tpu.telemetry import spans as _spans
+
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
+
+UNSCOPED = "(unscoped)"
+
+_lock = threading.Lock()
+_installed = False
+_registry: "_registry_mod.MetricsRegistry | None" = None
+
+#: (span name, canonical labels) -> span seq of the most recent trace
+#: batch (retrace detection); grows one entry per distinct traced scope
+_last_trace_span: dict[tuple, "int | None"] = {}
+
+
+def _declare(reg: _registry_mod.MetricsRegistry) -> dict:
+    return {
+        "traces": reg.counter(
+            "jax_traces_total", "jaxpr traces (jit cache misses)"),
+        "retraces": reg.counter(
+            "jax_retraces_total",
+            "traces of an entry point that had already traced once"),
+        "compiles": reg.counter(
+            "jax_compiles_total", "XLA backend compiles"),
+        "compile_seconds": reg.counter(
+            "jax_compile_seconds_total", "XLA backend compile latency"),
+        "trace_seconds": reg.counter(
+            "jax_trace_seconds_total", "Python jaxpr tracing latency"),
+        "lower_seconds": reg.counter(
+            "jax_lower_seconds_total", "jaxpr->MLIR lowering latency"),
+        "cache_events": reg.counter(
+            "jax_cache_events_total",
+            "persistent compilation cache activity"),
+    }
+
+
+def _scope() -> "tuple[str, tuple, int | None]":
+    """(entry-point name for metric labels, full scope key for retrace
+    detection, span instance id)."""
+    sp = _spans.current_span()
+    if sp is None:
+        return UNSCOPED, (UNSCOPED,), None
+    key = (sp.name, tuple(sorted((str(k), str(v))
+                                 for k, v in sp.labels.items())))
+    return sp.name, key, sp.seq
+
+
+def _on_duration(name: str, secs: float, **kwargs) -> None:
+    reg = _registry
+    if reg is None or not reg._enabled:
+        return
+    # one atomic read of the binding: install() swaps the whole dict, so a
+    # concurrent re-install can never expose a half-built mapping here
+    m = _metrics
+    if not m:
+        return
+    if name == TRACE_EVENT:
+        scope, key, sid = _scope()
+        m["traces"].inc(entry_point=scope)
+        m["trace_seconds"].inc(secs, entry_point=scope)
+        with _lock:
+            if key not in _last_trace_span:
+                _last_trace_span[key] = sid
+            elif sid is not None and _last_trace_span[key] != sid:
+                _last_trace_span[key] = sid
+                m["retraces"].inc(entry_point=scope)
+    elif name == COMPILE_EVENT:
+        scope, _key, _sid = _scope()
+        m["compiles"].inc(entry_point=scope)
+        m["compile_seconds"].inc(secs, entry_point=scope)
+    elif name == LOWER_EVENT:
+        scope, _key, _sid = _scope()
+        m["lower_seconds"].inc(secs, entry_point=scope)
+
+
+def _on_event(name: str, **kwargs) -> None:
+    reg = _registry
+    if reg is None or not reg._enabled:
+        return
+    m = _metrics
+    if m and name.startswith(CACHE_EVENT_PREFIX):
+        m["cache_events"].inc(event=name[len(CACHE_EVENT_PREFIX):])
+
+
+_metrics: dict = {}
+
+
+def install(registry: "_registry_mod.MetricsRegistry | None" = None
+            ) -> _registry_mod.MetricsRegistry:
+    """Register the ``jax.monitoring`` listeners (idempotent). Returns the
+    registry the hooks write into. Imports jax lazily so the telemetry
+    package stays importable in jax-free tooling contexts."""
+    global _installed, _registry, _metrics
+    reg = registry or _registry_mod.DEFAULT
+    with _lock:
+        # build the family dict fully, then swap the binding in one
+        # assignment — listeners on other threads read the binding once
+        # and never see a half-built mapping
+        new_metrics = _declare(reg)
+        _registry = reg
+        _metrics = new_metrics
+        if _installed:
+            return reg
+        import jax.monitoring as mon
+
+        mon.register_event_duration_secs_listener(_on_duration)
+        mon.register_event_listener(_on_event)
+        _installed = True
+    return reg
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset_scopes() -> None:
+    """Forget which entry points have traced (so the next trace counts as a
+    first trace, not a retrace) — test isolation helper."""
+    with _lock:
+        _last_trace_span.clear()

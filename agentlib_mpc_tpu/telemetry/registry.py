@@ -1,0 +1,354 @@
+"""Process-global metrics registry: counters, gauges, fixed-bucket histograms.
+
+The observability spine of the framework (ISSUE 1): every layer — solver,
+ADMM engines, backends, runtime broker, JAX compile hooks — writes into one
+:class:`MetricsRegistry` instead of keeping private stats lists.  Design
+constraints, in order:
+
+1. **Near-zero disabled cost.**  Every write path starts with one attribute
+   check (``registry._enabled``) and returns immediately when telemetry is
+   off — no locks, no allocation.  Hot paths (broker message dispatch,
+   per-solve recording) stay safe to instrument unconditionally.
+2. **Label support without label explosions.**  Instruments are *families*
+   (one name, one kind); samples are keyed by their label sets
+   (``solver_failures_total{backend="JAXBackend"}``), Prometheus style.
+3. **Exportable.**  :meth:`MetricsRegistry.prometheus_text` renders the
+   Prometheus text exposition format (scrape-able / pushable);
+   :meth:`MetricsRegistry.write_jsonl` writes one JSON document per family
+   (the format ``bench.py --emit-metrics`` embeds into BENCH artifacts).
+
+Per-process: agents running under ``MultiProcessingMAS`` each own their
+process's default registry (export per process, aggregate downstream —
+exactly how Prometheus treats multi-process targets).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import IO, Iterable, Optional
+
+#: default histogram buckets for latencies in seconds (power-of-~2.5 ladder
+#: from 1 ms to 60 s — solver solves, ADMM rounds, broker dispatch all fit)
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: default buckets for iteration counts (interior-point iterations per
+#: solve, ADMM iterations per round)
+ITERATION_BUCKETS = (1.0, 2.0, 3.0, 5.0, 8.0, 12.0, 20.0, 30.0, 50.0, 100.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    """Canonical hashable identity of a label set."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _format_value(value: float) -> str:
+    """Prometheus sample rendering: integers without a trailing ``.0``."""
+    if value != value:  # NaN
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _render_labels(key: tuple, extra: "tuple | None" = None) -> str:
+    pairs = list(key) + list(extra or ())
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                     for k, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Bound:
+    """A family bound to one label set — resolve labels once, write many."""
+
+    __slots__ = ("_family", "_key")
+
+    def __init__(self, family: "_MetricFamily", key: tuple):
+        self._family = family
+        self._key = key
+
+    def inc(self, value: float = 1.0) -> None:
+        self._family._write(self._key, value, mode="inc")
+
+    def set(self, value: float) -> None:
+        self._family._write(self._key, value, mode="set")
+
+    def observe(self, value: float) -> None:
+        self._family._write(self._key, value, mode="observe")
+
+
+class _MetricFamily:
+    kind = "untyped"
+    #: write modes this kind accepts — a bound child calling a
+    #: kind-inappropriate method (e.g. .set() on a Counter) must raise,
+    #: not silently do the wrong thing
+    _modes: frozenset = frozenset()
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self._values: dict = {}
+
+    # -- binding ---------------------------------------------------------------
+
+    def labels(self, **labels) -> _Bound:
+        return _Bound(self, _label_key(labels))
+
+    # -- writes ----------------------------------------------------------------
+
+    def _write(self, key: tuple, value: float, mode: str) -> None:
+        reg = self._registry
+        if not reg._enabled:          # the disabled-mode fast path
+            return
+        if mode not in self._modes:
+            raise ValueError(
+                f"metric {self.name!r} is a {self.kind}; it does not "
+                f"support .{mode}()")
+        with reg._lock:
+            self._write_locked(key, float(value), mode)
+
+    def _write_locked(self, key, value, mode):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- reads -----------------------------------------------------------------
+
+    def samples(self) -> list[dict]:
+        """[{'labels': {...}, ...kind-specific fields}] snapshot."""
+        with self._registry._lock:
+            return [self._sample_dict(key, val)
+                    for key, val in sorted(self._values.items())]
+
+    def _sample_dict(self, key, val) -> dict:
+        return {"labels": dict(key), "value": val}
+
+    def remove(self, **labels) -> None:
+        """Drop the sample for one label set (no-op when absent) — for
+        families whose label sets can go stale, e.g. per-iteration gauges
+        of a round that ran shorter than the previous one. Cleanup runs
+        regardless of the enabled flag."""
+        with self._registry._lock:
+            self._values.pop(_label_key(labels), None)
+
+    def value(self, **labels) -> Optional[float]:
+        """Current scalar value for one label set (None if never written).
+        Histograms return their observation count."""
+        with self._registry._lock:
+            val = self._values.get(_label_key(labels))
+        if val is None:
+            return None
+        if isinstance(val, _HistogramState):
+            return float(val.count)
+        return float(val)
+
+    def total(self) -> float:
+        """Sum over all label sets (histograms: total observation count)."""
+        with self._registry._lock:
+            vals = list(self._values.values())
+        return float(sum(v.count if isinstance(v, _HistogramState) else v
+                         for v in vals))
+
+
+class Counter(_MetricFamily):
+    """Monotone counter (``*_total`` naming convention)."""
+
+    kind = "counter"
+    _modes = frozenset({"inc"})
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        self._write(_label_key(labels), value, "inc")
+
+    def _write_locked(self, key, value, mode):
+        if value < 0:
+            raise ValueError(
+                f"counter {self.name!r} cannot decrease (got {value})")
+        self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(_MetricFamily):
+    """Set-to-current-value instrument (residuals, queue depths, ρ)."""
+
+    kind = "gauge"
+    _modes = frozenset({"inc", "set"})
+
+    def set(self, value: float, **labels) -> None:
+        self._write(_label_key(labels), value, "set")
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        self._write(_label_key(labels), value, "inc")
+
+    def _write_locked(self, key, value, mode):
+        if mode == "inc":
+            self._values[key] = self._values.get(key, 0.0) + value
+        else:
+            self._values[key] = value
+
+
+class _HistogramState:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)   # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_MetricFamily):
+    """Fixed-bucket histogram (cumulative buckets in exports, Prometheus
+    semantics: ``le`` upper bounds, implicit ``+Inf``)."""
+
+    kind = "histogram"
+    _modes = frozenset({"observe"})
+
+    def __init__(self, registry, name, help,
+                 buckets: Iterable[float] = LATENCY_BUCKETS_S):
+        super().__init__(registry, name, help)
+        bks = tuple(sorted(float(b) for b in buckets))
+        if not bks:
+            raise ValueError(f"histogram {name!r} needs >= 1 bucket")
+        self.buckets = bks
+
+    def observe(self, value: float, **labels) -> None:
+        self._write(_label_key(labels), value, "observe")
+
+    def _write_locked(self, key, value, mode):
+        st = self._values.get(key)
+        if st is None:
+            st = self._values[key] = _HistogramState(len(self.buckets))
+        st.counts[bisect.bisect_left(self.buckets, value)] += 1
+        st.sum += value
+        st.count += 1
+
+    def _sample_dict(self, key, st: _HistogramState) -> dict:
+        cum, cumulative = 0, {}
+        for b, c in zip(self.buckets, st.counts):
+            cum += c
+            cumulative[_format_value(b)] = cum
+        cumulative["+Inf"] = st.count
+        return {"labels": dict(key), "count": st.count, "sum": st.sum,
+                "buckets": cumulative}
+
+
+class MetricsRegistry:
+    """A set of metric families. Most code uses the process-global
+    :data:`DEFAULT` through :mod:`agentlib_mpc_tpu.telemetry`; tests and
+    embedders can carry private instances."""
+
+    def __init__(self, enabled: bool = True):
+        self._lock = threading.RLock()
+        self._families: dict[str, _MetricFamily] = {}
+        self._enabled = bool(enabled)
+
+    # -- enablement ------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    # -- declaration (idempotent) ----------------------------------------------
+
+    def _declare(self, cls, name: str, help: str, **kwargs) -> _MetricFamily:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind}, "
+                        f"cannot re-register as {cls.kind}")
+                # idempotent re-declaration: first declaration wins
+                # (help text and histogram buckets included)
+                return fam
+            fam = cls(self, name, help or "", **kwargs)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._declare(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._declare(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = LATENCY_BUCKETS_S) -> Histogram:
+        return self._declare(Histogram, name, help, buckets=buckets)
+
+    # -- reads -----------------------------------------------------------------
+
+    def get(self, name: str, **labels) -> Optional[float]:
+        """Scalar lookup convenience (None for unknown metric / label set)."""
+        fam = self._families.get(name)
+        return None if fam is None else fam.value(**labels)
+
+    def families(self) -> list[_MetricFamily]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def snapshot(self) -> list[dict]:
+        """JSON-ready export: one dict per family, samples sorted by label
+        set — the payload of ``bench.py --emit-metrics``."""
+        return [{"name": fam.name, "kind": fam.kind, "help": fam.help,
+                 "samples": fam.samples(), "total": fam.total()}
+                for fam in self.families()]
+
+    # -- exports ---------------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (v0.0.4), deterministically
+        ordered (family name, then label set) so it can be golden-tested."""
+        out: list[str] = []
+        for fam in self.families():
+            out.append(f"# HELP {fam.name} {fam.help}")
+            out.append(f"# TYPE {fam.name} {fam.kind}")
+            for sample in fam.samples():
+                key = _label_key(sample["labels"])
+                if fam.kind == "histogram":
+                    for le, cum in sample["buckets"].items():
+                        out.append(
+                            f"{fam.name}_bucket"
+                            f"{_render_labels(key, (('le', le),))} {cum}")
+                    out.append(f"{fam.name}_sum{_render_labels(key)} "
+                               f"{_format_value(sample['sum'])}")
+                    out.append(f"{fam.name}_count{_render_labels(key)} "
+                               f"{sample['count']}")
+                else:
+                    out.append(f"{fam.name}{_render_labels(key)} "
+                               f"{_format_value(sample['value'])}")
+        return "\n".join(out) + ("\n" if out else "")
+
+    def write_jsonl(self, path_or_file: "str | IO[str]") -> None:
+        """One JSON document per family, one per line (append-friendly,
+        ``jq``-friendly)."""
+        if hasattr(path_or_file, "write"):
+            for fam_dict in self.snapshot():
+                path_or_file.write(json.dumps(fam_dict) + "\n")
+            return
+        with open(path_or_file, "w", encoding="utf-8") as fh:
+            self.write_jsonl(fh)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Clear all sample values; declared families survive (so exports
+        keep showing zero-valued families — dashboards and the bench
+        artifact rely on presence, not just non-zero values)."""
+        with self._lock:
+            for fam in self._families.values():
+                fam._values.clear()
+
+
+#: the process-global registry every built-in instrumentation site uses
+DEFAULT = MetricsRegistry(enabled=True)

@@ -1,0 +1,174 @@
+"""Lightweight span tracing: ``with span("solver.solve_nlp", backend=...)``.
+
+Answers "where did this ADMM round's 400 ms go": every instrumented region
+records a :class:`SpanRecord` (name, labels, wall-clock start/duration,
+nesting depth, parent) into a process-global ring-buffer
+:class:`SpanRecorder`.  The ring buffer bounds memory for long-lived
+controllers — old spans are evicted, aggregates survive via
+:meth:`SpanRecorder.aggregate`.
+
+Spans also carry the *compile attribution scope* for the JAX profiling
+hooks (:mod:`agentlib_mpc_tpu.telemetry.jax_events`): a compile/trace event
+fired while a span is active is attributed to that span's name, which is
+how ``jax_compile_seconds_total{entry_point="backend.solve"}`` knows its
+entry point.
+
+Disabled mode (``telemetry.configure(enabled=False)``) makes ``span(...)``
+return a shared no-op context manager — no allocation beyond the call's own
+kwargs, no contextvar writes, no recording.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextvars import ContextVar
+
+from agentlib_mpc_tpu.telemetry import registry as _registry_mod
+
+_seq = itertools.count(1)
+
+#: innermost active span of the current thread/context (None at top level)
+_current: ContextVar["SpanRecord | None"] = ContextVar(
+    "agentlib_mpc_tpu_current_span", default=None)
+
+
+class SpanRecord:
+    """One timed region. ``duration`` is None while the span is open."""
+
+    __slots__ = ("name", "labels", "start", "duration", "depth", "parent",
+                 "seq", "_token")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.start = 0.0
+        self.duration: "float | None" = None
+        self.depth = 0
+        self.parent: "str | None" = None
+        self.seq = next(_seq)
+        self._token = None
+
+    # -- context-manager protocol ---------------------------------------------
+
+    def __enter__(self) -> "SpanRecord":
+        outer = _current.get()
+        if outer is not None:
+            self.depth = outer.depth + 1
+            self.parent = outer.name
+        self._token = _current.set(self)
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration = time.perf_counter() - self.start
+        _current.reset(self._token)
+        RECORDER.record(self)
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "labels": dict(self.labels),
+                "start": self.start, "duration_s": self.duration,
+                "depth": self.depth, "parent": self.parent}
+
+
+class _NoopSpan:
+    """Shared do-nothing span — what ``span()`` returns when telemetry is
+    disabled. Identity-stable so tests can assert zero allocation."""
+
+    __slots__ = ()
+    name = None
+    duration = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, **labels) -> "SpanRecord | _NoopSpan":
+    """Open a timed region::
+
+        with span("admm.fused_step", fleet="rooms") as sp:
+            ...
+        # sp.duration holds the wall-clock seconds after exit
+
+    Nesting is tracked per thread/context; the record lands in the global
+    ring buffer at exit. Returns a shared no-op when telemetry is disabled.
+    """
+    if not _registry_mod.DEFAULT._enabled:
+        return NOOP_SPAN
+    return SpanRecord(name, labels)
+
+
+def current_span() -> "SpanRecord | None":
+    """Innermost active span of this thread/context (compile attribution
+    scope for the JAX hooks)."""
+    return _current.get()
+
+
+class SpanRecorder:
+    """Fixed-capacity ring buffer of completed spans, plus running
+    per-name aggregates that are NOT subject to eviction — long-lived
+    controllers keep exact count/total/max per span name even after the
+    individual records have been overwritten."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("SpanRecorder capacity must be >= 1")
+        self._capacity = capacity
+        self._buf: list = [None] * capacity
+        self._write = 0      # next slot
+        self._count = 0      # total ever recorded
+        self._agg: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def total_recorded(self) -> int:
+        return self._count
+
+    def record(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self._buf[self._write] = rec
+            self._write = (self._write + 1) % self._capacity
+            self._count += 1
+            agg = self._agg.setdefault(
+                rec.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            d = rec.duration or 0.0
+            agg["total_s"] += d
+            agg["max_s"] = max(agg["max_s"], d)
+
+    def spans(self) -> list[SpanRecord]:
+        """Retained spans, oldest first (at most ``capacity``)."""
+        with self._lock:
+            if self._count < self._capacity:
+                return [s for s in self._buf[:self._write]]
+            return (self._buf[self._write:] + self._buf[:self._write])[:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self._capacity
+            self._write = 0
+            self._count = 0
+            self._agg = {}
+
+    def aggregate(self) -> dict:
+        """name -> {count, total_s, max_s} over EVERY span ever recorded
+        (running totals maintained at record time, immune to ring-buffer
+        eviction) — the per-phase wall-clock breakdown
+        ``bench.py --emit-metrics`` emits."""
+        with self._lock:
+            return {name: dict(agg) for name, agg in self._agg.items()}
+
+
+#: the process-global recorder `span()` writes into
+RECORDER = SpanRecorder()

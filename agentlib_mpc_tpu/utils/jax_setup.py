@@ -17,6 +17,11 @@ Two recurring ergonomics problems this module solves (VERDICT.md round 1,
   host CPU (tests, dry runs, baseline probes) can block on the TPU tunnel.
   ``force_cpu`` pins the process to the CPU backend before any backend
   initialization.
+
+* **Compile observability.** ``enable_compile_profiling`` installs
+  ``jax.monitoring`` listeners that surface compiles, retraces and compile
+  latency as telemetry metrics (:mod:`agentlib_mpc_tpu.telemetry`) — cache
+  misses become numbers instead of mystery latency.
 """
 
 from __future__ import annotations
@@ -50,6 +55,30 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     return path
+
+
+def enable_compile_profiling(registry=None):
+    """Install JAX compile/retrace telemetry hooks (idempotent).
+
+    Registers ``jax.monitoring`` listeners that mirror every jaxpr trace,
+    XLA backend compile and persistent-cache event into the telemetry
+    registry (``jax_traces_total``, ``jax_retraces_total``,
+    ``jax_compiles_total``, ``jax_compile_seconds_total``,
+    ``jax_cache_events_total`` — see ``docs/telemetry.md``).  Compile
+    latency is attributed to the innermost active telemetry span, so the
+    instrumented entry points (``backend.solve``, ``admm.fused_step``,
+    ``solver.solve_nlp``, the bench phases) each own their compile cost —
+    an unexpected ``jax_retraces_total`` increment on a warm path is the
+    "what config change just recompiled my solver" alarm that previously
+    required print-debugging.
+
+    Safe to call before or after backend initialization and with telemetry
+    disabled (listeners no-op until enabled). Returns the registry the
+    hooks write into.
+    """
+    from agentlib_mpc_tpu.telemetry import jax_events
+
+    return jax_events.install(registry)
 
 
 def request_virtual_devices(n: int) -> None:

@@ -301,6 +301,154 @@ def residual_figure(stats, time=None):
     return fig
 
 
+# ---------------------------------------------------------------------------
+# telemetry section (pure data layer over a MetricsRegistry snapshot)
+# ---------------------------------------------------------------------------
+
+def _snapshot_of(telemetry_src) -> list:
+    """Normalize a telemetry source: a MetricsRegistry (snapshot() called),
+    an already-made snapshot list, or None (the process default registry)."""
+    if telemetry_src is None:
+        from agentlib_mpc_tpu import telemetry as _t
+
+        return _t.metrics().snapshot()
+    if hasattr(telemetry_src, "snapshot"):
+        return telemetry_src.snapshot()
+    return list(telemetry_src)
+
+
+def _labels_str(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def scalar_rows(snapshot, prefix: str = "") -> list:
+    """[(name, labels-string, value)] for every counter/gauge sample whose
+    family name starts with ``prefix`` — the generic metrics table."""
+    rows = []
+    for fam in snapshot:
+        if fam["kind"] not in ("counter", "gauge"):
+            continue
+        if not fam["name"].startswith(prefix):
+            continue
+        for s in fam["samples"]:
+            rows.append((fam["name"], _labels_str(s["labels"]), s["value"]))
+    return rows
+
+
+def compile_table(snapshot) -> list:
+    """Per-entry-point compile economics: [{'entry_point', 'traces',
+    'retraces', 'compiles', 'compile_seconds'}] from the ``jax_*``
+    families (rows sorted by compile seconds, heaviest first)."""
+    per: dict = {}
+
+    def acc(fam_name, field):
+        for fam in snapshot:
+            if fam["name"] != fam_name:
+                continue
+            for s in fam["samples"]:
+                ep = s["labels"].get("entry_point", "(unscoped)")
+                per.setdefault(ep, {"entry_point": ep, "traces": 0,
+                                    "retraces": 0, "compiles": 0,
+                                    "compile_seconds": 0.0})[field] \
+                    += s["value"]
+
+    acc("jax_traces_total", "traces")
+    acc("jax_retraces_total", "retraces")
+    acc("jax_compiles_total", "compiles")
+    acc("jax_compile_seconds_total", "compile_seconds")
+    return sorted(per.values(), key=lambda r: -r["compile_seconds"])
+
+
+def residual_gauge_table(snapshot) -> list:
+    """[(iteration, primal, dual, extra-labels-str)] from the per-iteration
+    ``admm_*_residual`` gauges — the fused/coordinator residual view when
+    no results DataFrame is around (e.g. reading a bench metrics file)."""
+    per: dict = {}
+    for fam in snapshot:
+        if fam["name"] not in ("admm_primal_residual",
+                               "admm_dual_residual"):
+            continue
+        which = "primal" if "primal" in fam["name"] else "dual"
+        for s in fam["samples"]:
+            labels = dict(s["labels"])
+            it = labels.pop("iteration", None)
+            if it is None:
+                continue
+            key = (int(it), _labels_str(labels))
+            per.setdefault(key, {})[which] = s["value"]
+    return [(it, vals.get("primal"), vals.get("dual"), rest)
+            for (it, rest), vals in sorted(per.items())]
+
+
+def span_summary(recorder=None) -> list:
+    """[(name, count, total_s, max_s)] sorted by total time, heaviest
+    first — where the wall-clock of the retained spans went."""
+    if recorder is None:
+        from agentlib_mpc_tpu import telemetry as _t
+
+        recorder = _t.recorder()
+    agg = recorder.aggregate() if hasattr(recorder, "aggregate") \
+        else dict(recorder)
+    return sorted(
+        ((name, a["count"], a["total_s"], a["max_s"])
+         for name, a in agg.items()),
+        key=lambda r: -r[2])
+
+
+def telemetry_figure(telemetry_src=None):
+    """Compile-cost panel: per-entry-point compile seconds (bars) with the
+    retrace count as hover detail — the "which call site paid XLA and did
+    it recompile" view."""
+    import plotly.graph_objects as go
+
+    table = compile_table(_snapshot_of(telemetry_src))
+    fig = go.Figure()
+    if table:
+        fig.add_trace(go.Bar(
+            x=[r["entry_point"] for r in table],
+            y=[r["compile_seconds"] for r in table],
+            customdata=[(r["compiles"], r["retraces"]) for r in table],
+            hovertemplate=("%{x}<br>compile %{y:.2f}s"
+                           "<br>%{customdata[0]} compiles, "
+                           "%{customdata[1]} retraces<extra></extra>"),
+            marker_color="rgb(0, 84, 159)"))
+    fig.update_layout(title="XLA compile cost per entry point",
+                      yaxis_title="compile seconds",
+                      margin=dict(l=40, r=10, t=40, b=30), height=320)
+    return fig
+
+
+def admm_residual_gauge_figure(telemetry_src=None):
+    """Primal/dual residuals per ADMM iteration from the telemetry gauges
+    (log scale — the same view ``residual_figure`` builds from stats
+    DataFrames, sourced from the registry instead). One trace pair per
+    residual source (the non-iteration labels, e.g. ``fleet=bench`` vs
+    ``agent=coordinator``) — mixing sources into one line would zig-zag
+    over repeated iteration values and misrepresent both curves."""
+    import plotly.graph_objects as go
+
+    rows = residual_gauge_table(_snapshot_of(telemetry_src))
+    fig = go.Figure()
+    by_source: dict = {}
+    for it, prim, dual, rest in rows:
+        by_source.setdefault(rest, []).append((it, prim, dual))
+    for rest, series in sorted(by_source.items()):
+        suffix = f" [{rest}]" if rest and len(by_source) > 1 else ""
+        its = [s[0] for s in series]
+        fig.add_trace(go.Scatter(
+            x=its, y=[s[1] for s in series], mode="lines+markers",
+            name=f"primal_residual{suffix}"))
+        fig.add_trace(go.Scatter(
+            x=its, y=[s[2] for s in series], mode="lines+markers",
+            name=f"dual_residual{suffix}"))
+    if rows:
+        fig.update_yaxes(type="log")
+    fig.update_layout(title="ADMM residuals (telemetry gauges)",
+                      xaxis_title="iteration",
+                      margin=dict(l=40, r=10, t=40, b=30), height=320)
+    return fig
+
+
 def solver_figure(stats):
     """Solver panel: iterations + wall time per solve (reference
     ``solver_return``/``solver plot``)."""
@@ -332,12 +480,20 @@ def solver_figure(stats):
 # dash app layer
 # ---------------------------------------------------------------------------
 
-def build_app(results: dict, stats=None, measurements=None):
+def build_app(results: dict, stats=None, measurements=None, telemetry=None,
+              spans=None):
     """Construct (but do not run) the dash app: agent/module dropdowns,
     variable checklist, per-step slider for ADMM frames, estimation
     views for MHE frames (``measurements``: optional truth-overlay frame,
-    see :func:`measurement_points`), residual/solver panels. Requires
-    dash + plotly."""
+    see :func:`measurement_points`), residual/solver panels, and — when
+    ``telemetry`` is given (a MetricsRegistry, a snapshot list, or
+    ``True`` for the process default registry) — a telemetry section with
+    the compile-cost panel, residual gauges, span summary and the raw
+    counter/gauge table. ``spans``: span source for the summary table — an
+    aggregate dict (e.g. the ``"spans"`` key of an ``--emit-metrics``
+    artifact) or a SpanRecorder; defaults to the live process recorder for
+    live telemetry sources, and is omitted for a plain snapshot list
+    (whose spans this process does not know). Requires dash + plotly."""
     import dash
     from dash import dcc, html
     from dash.dependencies import Input, Output
@@ -347,6 +503,38 @@ def build_app(results: dict, stats=None, measurements=None):
         raise ValueError("no MPC/ADMM-shaped results to show")
     keys = [f"{a}/{m}" for a, m in frames]
     by_key = {f"{a}/{m}": df for (a, m), df in frames.items()}
+
+    telemetry_children = []
+    if telemetry is not None:
+        snapshot = _snapshot_of(None if telemetry is True else telemetry)
+        rows = scalar_rows(snapshot)
+        if spans is None and not isinstance(telemetry, (list, tuple)):
+            # live source (registry / True): the process recorder is the
+            # matching span source; a plain snapshot list carries no spans
+            span_rows = span_summary()
+        elif spans is not None:
+            span_rows = span_summary(spans)
+        else:
+            span_rows = []
+        telemetry_children = [
+            html.H3("telemetry"),
+            dcc.Graph(figure=telemetry_figure(snapshot)),
+            dcc.Graph(figure=admm_residual_gauge_figure(snapshot)),
+            html.Details([
+                html.Summary("span summary / raw metrics"),
+                html.Table(
+                    [html.Tr([html.Th(h) for h in
+                              ("span", "count", "total [s]", "max [s]")])]
+                    + [html.Tr([html.Td(n), html.Td(c),
+                                html.Td(f"{t:.4f}"), html.Td(f"{m:.4f}")])
+                       for n, c, t, m in span_rows]),
+                html.Table(
+                    [html.Tr([html.Th(h) for h in
+                              ("metric", "labels", "value")])]
+                    + [html.Tr([html.Td(n), html.Td(l), html.Td(v)])
+                       for n, l, v in rows]),
+            ]),
+        ]
 
     app = dash.Dash("agentlib_mpc_tpu")
     app.layout = html.Div([
@@ -359,6 +547,7 @@ def build_app(results: dict, stats=None, measurements=None):
         ]),
         html.Div(id="step-controls"),
         html.Div(id="graphs"),
+        html.Div(telemetry_children),
         dcc.Store(id="placeholder"),
     ])
 
@@ -409,10 +598,11 @@ def build_app(results: dict, stats=None, measurements=None):
 
 
 def run_dashboard(results: dict, stats=None, port: int = 8050,
-                  debug: bool = False,
-                  measurements=None):  # pragma: no cover - needs dash
+                  debug: bool = False, measurements=None,
+                  telemetry=None):  # pragma: no cover - needs dash
     """Build and serve the dash app (blocks)."""
-    app = build_app(results, stats, measurements=measurements)
+    app = build_app(results, stats, measurements=measurements,
+                    telemetry=telemetry)
     run = getattr(app, "run", None) or getattr(app, "run_server")
     run(port=port, debug=debug)
     return app
@@ -424,10 +614,12 @@ def run_dashboard(results: dict, stats=None, port: int = 8050,
 
 def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None,
                    port: int = 8050, block: bool = True, mode: str = "auto",
-                   measurements=None):
+                   measurements=None, telemetry=None):
     """MPC/MHE/ADMM results overview — the reference's dashboard entry
     point (``utils/plotting/interactive.py:300``, ``mpc_dashboard.py``,
-    ``admm_dashboard.py``) unified into one call. ``mode``:
+    ``admm_dashboard.py``) unified into one call. ``telemetry``: optional
+    registry/snapshot (or ``True`` for the process default) adding the
+    compile/residual/span telemetry section in interactive mode. ``mode``:
 
     - ``"auto"`` (default): serve the interactive dash app when
       dash+plotly are importable, else render the static matplotlib
@@ -451,9 +643,11 @@ def show_dashboard(results: dict, stats=None, save_path: Optional[str] = None,
                                     measurements=measurements)
         try:
             if not block:
-                return build_app(results, stats, measurements=measurements)
+                return build_app(results, stats, measurements=measurements,
+                                 telemetry=telemetry)
             return run_dashboard(results, stats, port=port,
-                                 measurements=measurements)
+                                 measurements=measurements,
+                                 telemetry=telemetry)
         except ValueError:
             raise  # empty/unshaped results: same error contract as static
         except Exception as exc:  # pragma: no cover - dash runtime issues

@@ -41,6 +41,13 @@ Modes:
     python bench.py --profile [dir]     # XLA profiler trace of the warm
                                 # step (default platform; pin
                                 # JAX_PLATFORMS=cpu for a host trace)
+    python bench.py --emit-metrics PATH [n]   # telemetry-instrumented
+                                # run: writes a phase-breakdown artifact
+                                # (compile/trace/retrace counts + seconds
+                                # per entry point, solver-iterations
+                                # histogram, per-ADMM-iteration residual
+                                # gauges, span aggregates, full metrics
+                                # snapshot) — see docs/telemetry.md
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -113,7 +120,8 @@ _MODELS = {
 def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
                warm_budget: int = WARM_BUDGET,
                cold_budget: int = COLD_BUDGET,
-               model: str = "zone", inner: str = "nlp"):
+               model: str = "zone", inner: str = "nlp",
+               record_stats: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -169,7 +177,11 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
         res = inner_solve(nlp, w_guess, (theta, zbar, lam, rho), lb, ub,
                           opts, y0=y_guess, z0=z_guess, mu0=mu0,
                           max_iter=budget)
-        return res.w, res.y, res.z, ocp.unflatten(res.w)["u"]
+        # solver stats ride along for --emit-metrics; XLA dead-code-
+        # eliminates the outputs when the caller drops them
+        return (res.w, res.y, res.z, ocp.unflatten(res.w)["u"],
+                res.stats.iterations, res.stats.success,
+                res.stats.kkt_error)
 
     vsolve = jax.vmap(local_solve,
                       in_axes=(0, 0, 0, 0, 0, None, None, None, 0, None))
@@ -193,15 +205,27 @@ def build_step(n_agents: int = N_AGENTS, solver_overrides: dict | None = None,
         def admm_iter(carry, x):
             budget, mu0 = x
             w_gs, y_gs, z_gs, zbar, lams = carry
-            w_gs, y_gs, z_gs, u = vsolve(x0s, loads, w_gs, y_gs, z_gs,
-                                         mu0, budget, zbar, lams, rho)
+            w_gs, y_gs, z_gs, u, iters, ok, kkt = vsolve(
+                x0s, loads, w_gs, y_gs, z_gs, mu0, budget, zbar, lams, rho)
             zbar_new = jnp.mean(u, axis=0)
             lams_new = lams + (u - zbar_new)
-            return (w_gs, y_gs, z_gs, zbar_new, lams_new), None
+            if record_stats:
+                # Boyd residuals of this iteration (the same quantities
+                # ops/admm.consensus_update reports in the fused engine)
+                ys = (jnp.linalg.norm((u - zbar_new).reshape(-1)),
+                      jnp.linalg.norm((rho * (zbar_new - zbar)).reshape(-1)),
+                      iters, ok, kkt)
+            else:
+                ys = None
+            return (w_gs, y_gs, z_gs, zbar_new, lams_new), ys
 
-        carry, _ = jax.lax.scan(admm_iter, (w_gs, y_gs, z_gs, zbar, lams),
-                                (budgets, mu0s))
-        return carry
+        carry, stats = jax.lax.scan(admm_iter,
+                                    (w_gs, y_gs, z_gs, zbar, lams),
+                                    (budgets, mu0s))
+        # stats: (prim (I,), dual (I,), iters/ok/kkt (I, n_agents)) when
+        # record_stats, else None — default callers get the carry alone so
+        # measure()/warm_step() layouts are unchanged
+        return (carry, stats) if record_stats else carry
 
     theta0 = ocp.default_params()
     x0s_np, loads_np = fleet_inputs(n_agents)
@@ -525,6 +549,133 @@ def run_sequential_native(n_agents: int = N_AGENTS,
     }
     print(json.dumps(out))
     return out
+
+
+def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
+    """``--emit-metrics PATH``: run the fused ADMM bench step with the
+    full telemetry stack on (metrics registry + spans + JAX compile hooks)
+    and write a phase-breakdown artifact to PATH — the file future
+    ``BENCH_r*.json`` rounds embed so a regression can be attributed to
+    compile vs. execute instead of staring at one wall-clock number.
+
+    The artifact carries: compile counts/seconds and retraces per entry
+    point, the solver-iterations histogram over every inner solve of the
+    round, per-ADMM-iteration primal/dual residual gauges, the span
+    breakdown (cold step = trace+compile+execute, warm steps = execute),
+    and the broker counter families (zero-valued here — the fused plane
+    does not route messages; their presence keys the dashboards).
+
+    Runs on the current process's default platform — pin
+    ``JAX_PLATFORMS=cpu`` for a host run.
+    """
+    import numpy as np
+
+    import jax
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.ops.admm import record_residuals
+    from agentlib_mpc_tpu.ops.solver import record_solver_stats, SolverStats
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+    import agentlib_mpc_tpu.runtime.broker  # noqa: F401 - declares the
+    #                      broker_* metric families (exported even at zero)
+
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    enable_compile_profiling()
+
+    # the build (transcription, structure probes) compiles its own small
+    # programs — give it its own span so those do not pollute the
+    # cold-step attribution below
+    with telemetry.span("bench.build"):
+        step, args = build_step(n_agents, record_stats=True)
+    with telemetry.span("bench.cold_step") as cold_sp:
+        carry, stats = step(*args)
+        jax.block_until_ready(carry)
+    warm_times = []
+    for _ in range(3):
+        with telemetry.span("bench.warm_step") as sp:
+            # warm start: carry (w, y, z, zbar, lams) feeds back, problem
+            # data (x0s, loads, rho) unchanged — warm_step()'s layout with
+            # the record_stats carry
+            carry, stats = step(args[0], args[1], *carry[:5], args[7])
+            jax.block_until_ready(carry)
+        warm_times.append(sp.duration)
+
+    prim, dual, iters, ok, kkt = (np.asarray(s) for s in stats)
+    for k in range(prim.shape[0]):
+        record_residuals(prim[k], dual[k], iteration=k, fleet="bench")
+    # real per-lane solver stats of the final warm step (note: warm
+    # inexact iterations run a 1-iteration budget, so success=False lanes
+    # are expected — that IS the inexact-ADMM operating point)
+    record_solver_stats(
+        SolverStats(iterations=iters.reshape(-1),
+                    kkt_error=kkt.reshape(-1),
+                    success=ok.reshape(-1),
+                    objective=np.zeros(iters.size),
+                    mu=np.zeros(iters.size),
+                    constraint_violation=np.zeros(iters.size)),
+        backend="bench")
+
+    reg = telemetry.metrics()
+
+    def scoped(name, entry_point):
+        return reg.get(name, entry_point=entry_point) or 0.0
+
+    cold_s = cold_sp.duration
+    warm_s = min(warm_times)
+    # decompose the cold step from ITS OWN entry-point-labeled events —
+    # registry-wide totals also cover the build-phase compiles and would
+    # overcount (the whole point of span-scoped attribution)
+    cold_compile_s = scoped("jax_compile_seconds_total", "bench.cold_step")
+    cold_trace_s = scoped("jax_trace_seconds_total", "bench.cold_step")
+    cold_lower_s = scoped("jax_lower_seconds_total", "bench.cold_step")
+    payload = {
+        "metric": "telemetry_phase_breakdown",
+        "n_agents": n_agents,
+        "admm_iters": ADMM_ITERS,
+        "platform": jax.devices()[0].platform,
+        "phases": {
+            # process-wide compile economics (build + cold step)
+            "compile_count": reg.counter("jax_compiles_total").total(),
+            "compile_seconds_total":
+                reg.counter("jax_compile_seconds_total").total(),
+            "trace_count": reg.counter("jax_traces_total").total(),
+            "trace_seconds_total":
+                reg.counter("jax_trace_seconds_total").total(),
+            "retrace_count": reg.counter("jax_retraces_total").total(),
+            # the cold step's own entry-point-attributed phase seconds.
+            # Diagnostics, NOT an additive decomposition: trace events
+            # nest (an outer jit's trace duration includes its inner
+            # jits') and XLA compiles sub-modules concurrently, so these
+            # can sum past the wall-clock.
+            "cold_step_s": cold_s,
+            "cold_step_trace_s": cold_trace_s,
+            "cold_step_lower_s": cold_lower_s,
+            "cold_step_compile_s": cold_compile_s,
+            "warm_step_s": warm_s,
+            # the warm step runs the SAME program with zero compile work,
+            # so it is the measured execute time; the rest of the cold
+            # step is trace+lower+compile overhead
+            "cold_overhead_s": max(0.0, cold_s - warm_s),
+            "execute_share_of_cold": (warm_s / cold_s if cold_s else None),
+        },
+        "spans": telemetry.recorder().aggregate(),
+        "metrics": reg.snapshot(),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1)
+    summary = {
+        "metric": "admm_emit_metrics",
+        "n_agents": n_agents,
+        "path": path,
+        "warm_step_ms": round(1e3 * warm_s, 2),
+        "compile_count": payload["phases"]["compile_count"],
+        "compile_seconds_total": round(
+            payload["phases"]["compile_seconds_total"], 2),
+        "platform": payload["platform"],
+    }
+    print(json.dumps(summary))
+    return payload
 
 
 def run_profile(trace_dir: str = "bench_trace") -> None:
@@ -969,6 +1120,22 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             runner(n)
             return
+
+    if "--emit-metrics" in sys.argv:
+        # telemetry-instrumented run, in-process (initializes JAX here;
+        # pin JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --emit-metrics out.json [n_agents]
+        idx = sys.argv.index("--emit-metrics")
+        if len(sys.argv) <= idx + 1 or sys.argv[idx + 1].startswith("-"):
+            print("usage: bench.py --emit-metrics PATH [n_agents]",
+                  file=sys.stderr)
+            sys.exit(2)
+        path = sys.argv[idx + 1]
+        n = N_AGENTS
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_emit_metrics(path, n)
+        return
 
     if "--profile" in sys.argv:
         idx = sys.argv.index("--profile")

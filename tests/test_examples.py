@@ -84,3 +84,47 @@ def test_fused_fleet_rooms_example():
 
     out = run_example(until=1800, n_rooms=8, testing=True, verbose=False)
     assert len(out["iterations"]) == 6
+
+
+@pytest.mark.slow
+def test_bench_emit_metrics_smoke(tmp_path):
+    """``bench.py --emit-metrics`` is the telemetry artifact every future
+    BENCH round embeds — smoke-run it on a 4-agent fleet and pin the
+    acceptance-criteria payload: compile count + seconds, the
+    solver-iterations histogram, per-ADMM-iteration residual gauges and
+    the broker counter families (present even at zero)."""
+    import json
+    import os
+    import subprocess
+
+    repo = Path(__file__).resolve().parents[1]
+    out = tmp_path / "metrics.json"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--emit-metrics",
+         str(out), "4"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(out.read_text())
+    phases = data["phases"]
+    assert phases["compile_count"] >= 1
+    assert phases["compile_seconds_total"] > 0
+    assert phases["warm_step_s"] > 0
+    families = {f["name"]: f for f in data["metrics"]}
+    assert families["solver_iterations"]["kind"] == "histogram"
+    assert families["solver_iterations"]["total"] > 0
+    residuals = families["admm_primal_residual"]["samples"]
+    assert len(residuals) == data["admm_iters"]
+    assert {s["labels"]["iteration"] for s in residuals} == \
+        {str(i) for i in range(data["admm_iters"])}
+    assert "admm_dual_residual" in families
+    for name in ("broker_messages_total", "broker_unmatched_total",
+                 "broker_callbacks_total"):
+        assert name in families
+    assert "bench.cold_step" in data["spans"]
+    assert "bench.warm_step" in data["spans"]
+    # the summary line on stdout is a JSON artifact too
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["metric"] == "admm_emit_metrics"

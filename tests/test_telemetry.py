@@ -1,0 +1,509 @@
+"""Telemetry subsystem tests (ISSUE 1).
+
+Covers: registry counter/gauge/histogram semantics, Prometheus text
+golden rendering, JSONL export, span nesting + ring-buffer overflow, the
+retrace-counter hooks (a deliberate static-shape change must increment the
+retrace metric), broker unmatched counting with the rate-limited warning,
+the solver-failure telemetry path, the ``MPCBackend.stats_history``
+back-compat schema, and the dashboard telemetry data layer.
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.telemetry.registry import MetricsRegistry
+from agentlib_mpc_tpu.telemetry.spans import SpanRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Each test starts from an enabled, empty default registry."""
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    yield
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+
+
+class TestRegistry:
+    def test_counter_labels_and_totals(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        c.inc(agent="a")
+        c.inc(2.0, agent="a")
+        c.inc(agent="b")
+        assert reg.get("reqs_total", agent="a") == 3.0
+        assert reg.get("reqs_total", agent="b") == 1.0
+        assert reg.get("reqs_total", agent="missing") is None
+        assert c.total() == 4.0
+
+    def test_counter_rejects_decrease(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            reg.counter("c_total").inc(-1.0)
+
+    def test_gauge_set_and_inc(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set(4.0, q="x")
+        g.set(2.5, q="x")
+        g.inc(0.5, q="x")
+        assert reg.get("depth", q="x") == 3.0
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        (sample,) = h.samples()
+        assert sample["count"] == 5
+        assert sample["sum"] == pytest.approx(56.05)
+        assert sample["buckets"] == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+
+    def test_histogram_boundary_is_inclusive(self):
+        # Prometheus `le` semantics: an observation equal to the bound
+        # lands in that bucket
+        reg = MetricsRegistry()
+        h = reg.histogram("b", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        (sample,) = h.samples()
+        assert sample["buckets"]["1"] == 1
+
+    def test_kind_conflict_raises_and_redeclare_is_idempotent(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "first help")
+        assert reg.counter("x_total", "other help") is c
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_reset_keeps_families(self):
+        reg = MetricsRegistry()
+        reg.counter("kept_total").inc()
+        reg.reset()
+        names = [f["name"] for f in reg.snapshot()]
+        assert names == ["kept_total"]
+        assert reg.snapshot()[0]["samples"] == []
+        assert reg.snapshot()[0]["total"] == 0.0
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("c_total").inc()
+        reg.gauge("g").set(1.0)
+        reg.histogram("h").observe(1.0)
+        assert all(f["samples"] == [] for f in reg.snapshot())
+
+    def test_bound_labels_child(self):
+        reg = MetricsRegistry()
+        child = reg.counter("c_total").labels(agent="a1")
+        child.inc()
+        child.inc(2.0)
+        assert reg.get("c_total", agent="a1") == 3.0
+
+    def test_kind_inappropriate_writes_raise(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="does not support"):
+            reg.counter("kc_total").labels(a="1").set(5.0)
+        with pytest.raises(ValueError, match="does not support"):
+            reg.histogram("kh").labels(a="1").inc()
+        with pytest.raises(ValueError, match="does not support"):
+            reg.histogram("kh").labels(a="1").set(1.0)
+        # gauges legitimately support both set and inc
+        g = reg.gauge("kg").labels(a="1")
+        g.set(1.0)
+        g.inc(1.0)
+        assert reg.get("kg", a="1") == 2.0
+
+
+class TestPrometheusText:
+    def test_golden_rendering(self):
+        reg = MetricsRegistry()
+        c = reg.counter("solves_total", "solver calls")
+        c.inc(2, backend="jax")
+        c.inc(backend="mhe")
+        reg.gauge("kkt", "last kkt").set(1.5e-3, backend="jax")
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        expected = "\n".join([
+            '# HELP kkt last kkt',
+            '# TYPE kkt gauge',
+            'kkt{backend="jax"} 0.0015',
+            '# HELP lat_seconds latency',
+            '# TYPE lat_seconds histogram',
+            'lat_seconds_bucket{le="0.1"} 1',
+            'lat_seconds_bucket{le="1"} 2',
+            'lat_seconds_bucket{le="+Inf"} 2',
+            'lat_seconds_sum 0.55',
+            'lat_seconds_count 2',
+            '# HELP solves_total solver calls',
+            '# TYPE solves_total counter',
+            'solves_total{backend="jax"} 2',
+            'solves_total{backend="mhe"} 1',
+        ]) + "\n"
+        assert reg.prometheus_text() == expected
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(path='a"b\\c\nd')
+        text = reg.prometheus_text()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+
+class TestJsonlExport:
+    def test_round_trip(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "help").inc(3, agent="a")
+        reg.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+        path = tmp_path / "metrics.jsonl"
+        reg.write_jsonl(str(path))
+        lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+        by_name = {ln["name"]: ln for ln in lines}
+        assert by_name["c_total"]["kind"] == "counter"
+        assert by_name["c_total"]["samples"] == [
+            {"labels": {"agent": "a"}, "value": 3.0}]
+        assert by_name["h_seconds"]["samples"][0]["count"] == 1
+
+
+class TestSpans:
+    def test_nesting_depth_and_parent(self):
+        with telemetry.span("outer") as outer:
+            with telemetry.span("inner", k="v") as inner:
+                assert telemetry.current_span() is inner
+            assert telemetry.current_span() is outer
+        assert telemetry.current_span() is None
+        assert outer.depth == 0 and outer.parent is None
+        assert inner.depth == 1 and inner.parent == "outer"
+        assert inner.duration is not None and inner.duration >= 0.0
+        assert outer.duration >= inner.duration
+        names = [s.name for s in telemetry.recorder().spans()]
+        # inner exits (and records) first
+        assert names[-2:] == ["inner", "outer"]
+
+    def test_ring_buffer_overflow(self):
+        rec = SpanRecorder(capacity=4)
+        for i in range(10):
+            with telemetry.span(f"s{i}") as sp:
+                pass
+            rec.record(sp)
+        assert rec.total_recorded == 10
+        # records evict oldest-first...
+        assert [s.name for s in rec.spans()] == ["s6", "s7", "s8", "s9"]
+        # ...but the running aggregates survive eviction
+        agg = rec.aggregate()
+        assert set(agg) == {f"s{i}" for i in range(10)}
+        assert agg["s0"]["count"] == 1 and agg["s9"]["count"] == 1
+
+    def test_disabled_spans_are_shared_noop(self):
+        telemetry.configure(enabled=False)
+        a = telemetry.span("a")
+        b = telemetry.span("b", with_label="x")
+        assert a is b is telemetry.NOOP_SPAN
+        with a:
+            assert telemetry.current_span() is None
+        assert telemetry.recorder().spans() == []
+
+    def test_span_dict_export(self):
+        with telemetry.span("x", agent="a") as sp:
+            pass
+        d = sp.as_dict()
+        assert d["name"] == "x" and d["labels"] == {"agent": "a"}
+        assert d["duration_s"] == sp.duration
+
+
+class TestJaxCompileHooks:
+    def test_retrace_counter_increments_on_shape_change(self):
+        import jax
+        import jax.numpy as jnp
+
+        telemetry.install_jax_hooks()
+
+        @jax.jit
+        def fn(x):
+            return x * 2.0 + 1.0
+
+        def get(name):
+            return telemetry.metrics().get(
+                name, entry_point="test.retrace") or 0.0
+
+        with telemetry.span("test.retrace"):
+            fn(jnp.ones((3,)))
+        assert get("jax_traces_total") >= 1
+        assert get("jax_compiles_total") >= 1
+        assert get("jax_retraces_total") == 0
+        assert get("jax_compile_seconds_total") > 0
+
+        with telemetry.span("test.retrace"):
+            fn(jnp.ones((3,)))          # cache hit: nothing fires
+        assert get("jax_retraces_total") == 0
+
+        with telemetry.span("test.retrace"):
+            fn(jnp.ones((5,)))          # static shape change -> retrace
+        assert get("jax_retraces_total") == 1
+
+    def test_hooks_silent_when_disabled(self):
+        import jax
+        import jax.numpy as jnp
+
+        telemetry.install_jax_hooks()
+        telemetry.configure(enabled=False)
+
+        @jax.jit
+        def fn(x):
+            return x + 1.0
+
+        with telemetry.span("test.disabled"):
+            fn(jnp.ones((2,)))
+        telemetry.configure(enabled=True)
+        assert telemetry.metrics().get(
+            "jax_traces_total", entry_point="test.disabled") is None
+
+
+class TestBrokerTelemetry:
+    def _broker(self):
+        from agentlib_mpc_tpu.runtime.broker import DataBroker
+
+        return DataBroker("agent_t")
+
+    def _var(self, alias):
+        from agentlib_mpc_tpu.runtime.variables import AgentVariable
+
+        return AgentVariable(name=alias, alias=alias, value=1.0)
+
+    def test_unmatched_counter_and_single_warning(self, caplog):
+        broker = self._broker()
+        seen = []
+        broker.register_callback("known", None, seen.append)
+        with caplog.at_level(logging.WARNING,
+                             logger="agentlib_mpc_tpu.runtime.broker"):
+            broker.send_variable(self._var("known"))
+            broker.send_variable(self._var("typo_alias"))
+            broker.send_variable(self._var("typo_alias"))
+            broker.send_variable(self._var("typo_alias"))
+        get = telemetry.metrics().get
+        assert get("broker_messages_total", agent="agent_t") == 4.0
+        assert get("broker_callbacks_total", agent="agent_t") == 1.0
+        assert get("broker_unmatched_total", agent="agent_t",
+                   alias="typo_alias") == 3.0
+        warnings = [r for r in caplog.records
+                    if "typo_alias" in r.getMessage()]
+        assert len(warnings) == 1   # rate-limited: once per alias
+        assert len(seen) == 1
+
+    def test_forwarded_shared_variable_does_not_warn(self, caplog):
+        from agentlib_mpc_tpu.runtime.broker import BroadcastBus, DataBroker
+        from agentlib_mpc_tpu.runtime.variables import AgentVariable
+
+        bus = BroadcastBus()
+        a, b = DataBroker("a"), DataBroker("b")
+        bus.join(a)
+        bus.join(b)
+        got = []
+        b.register_callback("x", None, got.append)
+        with caplog.at_level(logging.WARNING,
+                             logger="agentlib_mpc_tpu.runtime.broker"):
+            a.send_variable(AgentVariable(name="x", alias="x", value=2.0,
+                                          shared=True))
+        assert len(got) == 1
+        # unmatched on a's *local* table, but forwarded — not a drop:
+        # neither warned nor counted (normal broadcast fan-out must not
+        # drown the misconfiguration signal)
+        assert not [r for r in caplog.records if "dropped" in r.getMessage()]
+        assert telemetry.metrics().get("broker_unmatched_total",
+                                       agent="a", alias="x") is None
+        # ...and the receiving side's external non-match does not count
+        # either
+        assert telemetry.metrics().get("broker_unmatched_total",
+                                       agent="b", alias="x") is None
+
+
+class TestSolveRecording:
+    def _bare_backend(self):
+        from agentlib_mpc_tpu.backends.backend import OptimizationBackend
+
+        return OptimizationBackend({})
+
+    def _row(self, success, time=0.0):
+        return {"time": time, "iterations": 7, "success": success,
+                "kkt_error": 3e-3, "objective": 1.25,
+                "constraint_violation": 0.0, "solve_wall_time": 0.01}
+
+    def test_metrics_and_history(self):
+        be = self._bare_backend()
+        be._record_solve(self._row(True))
+        be._record_solve(self._row(True, time=300.0))
+        get = telemetry.metrics().get
+        assert get("solver_solves_total",
+                   backend="OptimizationBackend") == 2.0
+        assert get("solver_failures_total",
+                   backend="OptimizationBackend") is None
+        assert get("solver_iterations",
+                   backend="OptimizationBackend") == 2.0  # observation count
+        assert be.stats_history == [self._row(True),
+                                    self._row(True, time=300.0)]
+        be.stats_history.clear()     # back-compat mutation still works
+        assert be.stats_history == []
+
+    def test_failure_warns_with_full_stats_row(self, caplog):
+        be = self._bare_backend()
+        with caplog.at_level(logging.WARNING):
+            be._record_solve(self._row(False, time=42.0))
+        assert telemetry.metrics().get(
+            "solver_failures_total", backend="OptimizationBackend") == 1.0
+        msg = " ".join(r.getMessage() for r in caplog.records)
+        # the full stats row rides in the warning: iterations AND
+        # objective, not just the kkt error (ISSUE 1 satellite)
+        for fragment in ("iterations", "objective", "kkt_error", "42.0"):
+            assert fragment in msg
+
+
+class TestStatsHistoryBackCompat:
+    """The pre-telemetry `stats_history` contract survives the migration:
+    same key schema, same mutability (ISSUE 1 satellite)."""
+
+    EXPECTED_KEYS = {"time", "iterations", "success", "kkt_error",
+                     "objective", "constraint_violation", "solve_wall_time"}
+
+    @pytest.fixture(scope="class")
+    def backend(self):
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent))
+        from conftest import make_tracker_model
+
+        from agentlib_mpc_tpu.backends.backend import (
+            VariableReference,
+            create_backend,
+        )
+
+        Tracker = make_tracker_model()
+        be = create_backend({
+            "type": "jax",
+            "model": {"class": Tracker},
+            "discretization_options": {"method": "multiple_shooting"},
+            "solver": {"max_iter": 30},
+        })
+        be.setup_optimization(
+            VariableReference(controls=["u"], parameters=["a"]),
+            time_step=300.0, prediction_horizon=3)
+        return be
+
+    def test_solve_row_schema_unchanged(self, backend):
+        result = backend.solve(0.0, {})
+        assert set(result["stats"].keys()) == self.EXPECTED_KEYS
+        assert len(backend.stats_history) == 1
+        row = backend.stats_history[0]
+        assert set(row.keys()) == self.EXPECTED_KEYS
+        assert isinstance(row["iterations"], int)
+        assert isinstance(row["success"], bool)
+        assert isinstance(row["kkt_error"], float)
+        assert isinstance(row["solve_wall_time"], float)
+
+    def test_history_is_mutable_list(self, backend):
+        hist = backend.stats_history
+        hist.append({"time": -1.0})
+        assert backend.stats_history[-1] == {"time": -1.0}
+        hist.clear()
+        assert backend.stats_history == []
+
+
+class TestAdmmResidualRecording:
+    def test_record_residuals_gauges(self):
+        from agentlib_mpc_tpu.ops.admm import record_residuals
+
+        record_residuals(0.5, 0.25, iteration=0, fleet="f")
+        record_residuals(0.1, 0.05, iteration=1, fleet="f")
+        get = telemetry.metrics().get
+        assert get("admm_primal_residual", fleet="f", iteration="0") == 0.5
+        assert get("admm_dual_residual", fleet="f", iteration="1") == 0.05
+        assert get("admm_iterations_total", fleet="f") == 2.0
+
+    def test_noop_when_disabled(self):
+        from agentlib_mpc_tpu.ops.admm import record_residuals
+
+        telemetry.configure(enabled=False)
+        record_residuals(1.0, 1.0, iteration=0)
+        telemetry.configure(enabled=True)
+        assert telemetry.metrics().get("admm_primal_residual",
+                                       iteration="0") is None
+
+    def test_trim_removes_stale_round_tail(self):
+        from agentlib_mpc_tpu.ops.admm import (
+            record_residuals,
+            trim_residuals,
+        )
+
+        # round 1: 4 iterations; round 2: 2 iterations + trim of the tail
+        for k in range(4):
+            record_residuals(1.0 / (k + 1), 0.5 / (k + 1), iteration=k,
+                             fleet="f")
+        for k in range(2):
+            record_residuals(0.1 / (k + 1), 0.05 / (k + 1), iteration=k,
+                             fleet="f")
+        trim_residuals(2, 4, fleet="f")
+        get = telemetry.metrics().get
+        assert get("admm_primal_residual", fleet="f", iteration="1") == 0.05
+        assert get("admm_primal_residual", fleet="f", iteration="2") is None
+        assert get("admm_dual_residual", fleet="f", iteration="3") is None
+
+
+class TestDashboardTelemetryLayer:
+    def _snapshot(self):
+        reg = MetricsRegistry()
+        t = reg.counter("jax_traces_total")
+        r = reg.counter("jax_retraces_total")
+        c = reg.counter("jax_compiles_total")
+        s = reg.counter("jax_compile_seconds_total")
+        for ep, n in (("backend.solve", 4), ("admm.fused_step", 2)):
+            t.inc(n, entry_point=ep)
+            c.inc(n, entry_point=ep)
+            s.inc(0.5 * n, entry_point=ep)
+        r.inc(entry_point="backend.solve")
+        reg.gauge("admm_primal_residual").set(0.5, iteration="0", fleet="f")
+        reg.gauge("admm_primal_residual").set(0.2, iteration="1", fleet="f")
+        reg.gauge("admm_dual_residual").set(0.4, iteration="0", fleet="f")
+        reg.gauge("admm_dual_residual").set(0.1, iteration="1", fleet="f")
+        reg.counter("broker_messages_total").inc(5, agent="a")
+        return reg.snapshot()
+
+    def test_compile_table(self):
+        from agentlib_mpc_tpu.utils.plotting.dashboard import compile_table
+
+        rows = compile_table(self._snapshot())
+        assert rows[0]["entry_point"] == "backend.solve"   # heaviest first
+        assert rows[0]["compiles"] == 4 and rows[0]["retraces"] == 1
+        assert rows[1]["entry_point"] == "admm.fused_step"
+        assert rows[1]["compile_seconds"] == pytest.approx(1.0)
+
+    def test_residual_gauge_table(self):
+        from agentlib_mpc_tpu.utils.plotting.dashboard import (
+            residual_gauge_table,
+        )
+
+        rows = residual_gauge_table(self._snapshot())
+        assert [(r[0], r[1], r[2]) for r in rows] == [
+            (0, 0.5, 0.4), (1, 0.2, 0.1)]
+
+    def test_scalar_rows_prefix_filter(self):
+        from agentlib_mpc_tpu.utils.plotting.dashboard import scalar_rows
+
+        rows = scalar_rows(self._snapshot(), prefix="broker_")
+        assert rows == [("broker_messages_total", "agent=a", 5.0)]
+
+    def test_span_summary_sorted(self):
+        from agentlib_mpc_tpu.utils.plotting.dashboard import span_summary
+
+        rec = SpanRecorder(capacity=8)
+        for name, dur in (("fast", 0.01), ("slow", 0.5), ("fast", 0.02)):
+            with telemetry.span(name) as sp:
+                pass
+            sp.duration = dur      # deterministic totals
+            rec.record(sp)
+        rows = span_summary(rec)
+        assert rows[0][0] == "slow" and rows[0][1] == 1
+        assert rows[1][0] == "fast" and rows[1][1] == 2
+        assert rows[1][2] == pytest.approx(0.03)

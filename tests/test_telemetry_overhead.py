@@ -1,0 +1,115 @@
+"""telemetry-overhead tier-1 gate (ISSUE 1 satellite).
+
+Instrumenting the 4-agent fused ADMM bench step — span + per-iteration
+residual gauges + solver-iterations histogram, exactly what
+``bench.py --emit-metrics`` records per step — must add <5% wall-clock
+over the same compiled step with telemetry disabled (the no-op registry
+fast path), and the disabled fast path itself must be structurally
+zero-cost (shared no-op span, no samples written).
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from agentlib_mpc_tpu import telemetry  # noqa: E402
+
+N_AGENTS = 4
+#: the telemetry budget: all host-side instrumentation work per step must
+#: stay below this fraction of the step's own wall-clock
+REL_BUDGET = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _restore_telemetry():
+    yield
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+
+
+def _record_step_telemetry(stats):
+    """The full --emit-metrics per-step recording load: per-iteration
+    residual gauges + real per-lane solver stats."""
+    from agentlib_mpc_tpu.ops.admm import record_residuals
+    from agentlib_mpc_tpu.ops.solver import SolverStats, record_solver_stats
+
+    prim, dual, iters, ok, kkt = (np.asarray(s) for s in stats)
+    for k in range(prim.shape[0]):
+        record_residuals(prim[k], dual[k], iteration=k, fleet="overhead")
+    record_solver_stats(
+        SolverStats(iterations=iters.reshape(-1),
+                    kkt_error=kkt.reshape(-1),
+                    success=ok.reshape(-1),
+                    objective=np.zeros(iters.size),
+                    mu=np.zeros(iters.size),
+                    constraint_violation=np.zeros(iters.size)),
+        backend="overhead")
+
+
+def test_instrumented_bench_step_overhead_under_5_percent():
+    """The instrumentation around one warm fused step is purely additive
+    host-side work (a span, the stats device→host read, ~50 registry
+    writes), so the honest measurement is its standalone cost against the
+    step's own wall-clock — differencing two ~250 ms step timings would
+    drown the ~1 ms telemetry cost in this VM's ±8% scheduler noise and
+    flake either way."""
+    import bench
+
+    telemetry.install_jax_hooks()
+    step, args = bench.build_step(N_AGENTS, record_stats=True)
+    telemetry.configure(enabled=False)
+    carry, stats = step(*args)                   # compile once
+    jax.block_until_ready(carry)
+
+    # the step's own wall-clock, no-op registry (min-of-5 warm)
+    step_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        carry, stats = step(args[0], args[1], *carry[:5], args[7])
+        jax.block_until_ready(carry)
+        step_times.append(time.perf_counter() - t0)
+    t_step = min(step_times)
+
+    # worst-of-5 cost of EVERYTHING telemetry adds per instrumented step
+    telemetry.configure(enabled=True)
+    telemetry_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        with telemetry.span("overhead.warm_step"):
+            _record_step_telemetry(stats)
+        telemetry_times.append(time.perf_counter() - t0)
+    t_telemetry = max(telemetry_times)
+
+    assert t_telemetry <= REL_BUDGET * t_step, (
+        f"per-step telemetry work {1e3 * t_telemetry:.2f} ms exceeds 5% of "
+        f"the {1e3 * t_step:.1f} ms fused step")
+    # the instrumented runs really recorded (not a no-op A/A)
+    assert telemetry.metrics().get("solver_solves_total",
+                                   backend="overhead") > 0
+    assert telemetry.metrics().get("admm_primal_residual",
+                                   fleet="overhead", iteration="0") \
+        is not None
+
+
+def test_disabled_fast_path_is_structurally_free():
+    telemetry.configure(enabled=False)
+    # spans: one shared no-op object, no allocation, no recording
+    assert telemetry.span("a") is telemetry.span("b") is telemetry.NOOP_SPAN
+    before = telemetry.recorder().total_recorded
+    with telemetry.span("x"):
+        pass
+    assert telemetry.recorder().total_recorded == before
+    # metrics: writes vanish
+    telemetry.counter("off_total").inc()
+    telemetry.gauge("off_gauge").set(1.0)
+    telemetry.histogram("off_hist").observe(1.0)
+    telemetry.configure(enabled=True)
+    assert telemetry.metrics().get("off_total") is None
+    assert telemetry.metrics().get("off_gauge") is None
+    assert telemetry.metrics().get("off_hist") is None
