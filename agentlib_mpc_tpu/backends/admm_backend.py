@@ -333,8 +333,7 @@ class ADMMBackend(JAXBackend):
                     jnp.asarray(float(now)))
             u0.block_until_ready()
         wall = _time.perf_counter() - t_start
-        self._w_guess, self._y_guess, self._z_guess = w_next, y_next, z_next
-        self._cold = False
+        self._carry_warm_start(w_next, y_next, z_next, now=now)
 
         stats_row = {
             "time": float(now),

@@ -197,6 +197,21 @@ class OptimizationBackend:
         (``optimization_backends/backend.py:102-104``)."""
         self.logger = lg
 
+    def health_check(self, result: dict) -> tuple[bool, tuple[str, ...]]:
+        """Backend-specific validity hook for one ``solve`` result,
+        merged into the actuation guard's assessment (``BaseMPC.do_step``
+        passes it as ``ActuationGuard.assess(..., precheck=...)``).
+
+        The generic checks — solver success, finite ``u0``/trajectories,
+        control bounds — already run in
+        :func:`agentlib_mpc_tpu.resilience.guard.check_result`; the base
+        hook therefore reports healthy and subclasses override to ADD
+        checks only they can make (e.g. a surrogate's trust region, an
+        integer schedule's feasibility). Returns ``(healthy, reasons)``;
+        every reason becomes a ``mpc_unhealthy_solves_total{reason=...}``
+        label."""
+        return True, ()
+
     # -- durable warm-start state (beyond reference: its warm starts die
     #    with the process, ``casadi_utils.py:94-101``) ------------------------
 
@@ -223,6 +238,27 @@ class OptimizationBackend:
                 f"using warm_state/set_warm_state")
         raise NotImplementedError(
             f"{type(self).__name__} keeps no warm-start state")
+
+    def _carry_warm_start(self, w_next, y_next, z_next, now=None) -> None:
+        """Adopt a solve's final iterate as the next warm start — unless
+        it is non-finite: carrying a NaN-diverged iterate would make
+        EVERY subsequent solve non-finite, so the actuation guard's
+        probe mode could never observe a recovery (and a restart would
+        re-checkpoint the poison). Resets to the cold start instead,
+        like the fused engine's quarantine."""
+        import jax.numpy as jnp
+
+        if bool(jnp.all(jnp.isfinite(w_next))
+                & jnp.all(jnp.isfinite(y_next))
+                & jnp.all(jnp.isfinite(z_next))):
+            self._w_guess, self._y_guess, self._z_guess = \
+                w_next, y_next, z_next
+            self._cold = False
+        else:
+            self.logger.warning(
+                "solve at t=%s produced non-finite iterates; resetting "
+                "warm start", now)
+            self._reset_warm_start()
 
     def set_warm_state(self, tree: dict) -> None:
         """Restore a :meth:`warm_state` snapshot (same problem shapes)."""

@@ -241,16 +241,10 @@ class MINLPBackend(JAXBackend):
             jax.block_until_ready(traj)
         wall = _time.perf_counter() - t_start
 
-        # warm-start bookkeeping rides the relaxed program; a non-finite
-        # relaxed result must not poison the next step (reset instead)
-        if bool(jnp.all(jnp.isfinite(w_next))):
-            self._w_guess, self._y_guess, self._z_guess = \
-                w_next, y_next, z_next
-            self._cold = False
-        else:
-            self.logger.warning("relaxed solve at t=%s produced non-finite "
-                                "iterates; resetting warm start", now)
-            self._reset_warm_start()
+        # warm-start bookkeeping rides the relaxed program; the shared
+        # guard resets on non-finite iterates (duals included) instead
+        # of poisoning the next step
+        self._carry_warm_start(w_next, y_next, z_next, now=now)
 
         # assemble the actuation vector in merged-control order
         u0 = np.zeros(len(self.var_ref.controls))

@@ -532,8 +532,9 @@ class LocalADMM(ADMMModule):
                     break
 
             self.deregister_all_participants()
-            self.set_actuation(result)
-            self._record(result)
+            decision = self.guarded_actuation(result)
+            if decision.action == "actuate":
+                self._record(result)
             self._status = ModuleStatus.sleeping
             spent = self.env.now - start_round
             yield max(self.time_step - spent, 0.0)
@@ -629,5 +630,6 @@ class RealtimeADMM(ADMMModule):
                 break
 
         self.deregister_all_participants()
-        self.set_actuation(result)
-        self._record(result)
+        decision = self.guarded_actuation(result)
+        if decision.action == "actuate":
+            self._record(result)

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.modules.admm import ADMMModule, CouplingEntry
 from agentlib_mpc_tpu.ops.admm import record_residuals, trim_residuals
 from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
@@ -262,6 +263,9 @@ class AgentEntry:
     status: AgentStatus = AgentStatus.pending
     coup_vars: List[str] = dataclasses.field(default_factory=list)
     exchange_vars: List[str] = dataclasses.field(default_factory=list)
+    #: consecutive rounds this participant was de-registered from for
+    #: not responding in time (reset on the next successful reply)
+    missed_rounds: int = 0
 
 
 @register_module("admm_coordinator")
@@ -310,6 +314,8 @@ class ADMMCoordinator(BaseModule):
         self._stats_rows: List[dict] = []
         self._round_start: float = 0.0
         self._perf_counter: float = 0.0
+        #: sources already warned about as slow (one WARNING per agent)
+        self._dereg_warned: set = set()
 
     # -- messaging -------------------------------------------------------------
 
@@ -393,6 +399,7 @@ class ADMMCoordinator(BaseModule):
             self._exchange_variables[alias].local_trajectories[
                 variable.source] = np.asarray(traj, dtype=float)
         entry.status = AgentStatus.ready
+        entry.missed_rounds = 0
         self.received_variable.set()
 
     # -- the round -------------------------------------------------------------
@@ -659,10 +666,34 @@ class ADMMCoordinator(BaseModule):
                 break
 
     def _deregister_slow(self) -> None:
+        """Drop non-responders from THIS round only: the participant goes
+        back to standby, so the next round's start-iteration sync
+        re-admits it (a transient stall — GC pause, one slow solve, a
+        dropped message — must not exile an agent forever). Every drop
+        counts into ``coordinator_deregistrations_total{agent=...}``; the
+        WARNING is rate-limited to one per agent (the counter carries the
+        rate, the log carries the news)."""
         for entry in self.agent_dict.values():
             if entry.status is AgentStatus.busy:
                 entry.status = AgentStatus.standby
-                self.logger.info("de-registered slow agent %s", entry.source)
+                entry.missed_rounds += 1
+                agent_id = entry.source.agent_id or str(entry.source)
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "coordinator_deregistrations_total",
+                        "participants de-registered from an ADMM round "
+                        "for not responding in time").inc(agent=agent_id)
+                if entry.source not in self._dereg_warned:
+                    self._dereg_warned.add(entry.source)
+                    self.logger.warning(
+                        "de-registered slow agent %s from this round "
+                        "(re-admitted next round; warned once per agent — "
+                        "rate lives in coordinator_deregistrations_total)",
+                        entry.source)
+                else:
+                    self.logger.debug(
+                        "de-registered slow agent %s (%d rounds missed)",
+                        entry.source, entry.missed_rounds)
 
     # -- results ---------------------------------------------------------------
 
@@ -769,8 +800,9 @@ class CoordinatedADMM(ADMMModule):
             self._broadcast(START_ITERATION_A2C, True)
         else:
             if self._result_obtained and self._result is not None:
-                self.set_actuation(self._result)
-                self._record(self._result)
+                decision = self.guarded_actuation(self._result)
+                if decision.action == "actuate":
+                    self._record(self._result)
             self._result = None
             self._result_obtained = False
 

@@ -22,8 +22,9 @@ from typing import Optional
 import numpy as np
 
 from agentlib_mpc_tpu.backends.backend import VariableReference, create_backend
-from agentlib_mpc_tpu.modules.deactivate_mpc import SkippableMixin
+from agentlib_mpc_tpu.modules.deactivate_mpc import MPC_FLAG_ACTIVE, SkippableMixin
 from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+from agentlib_mpc_tpu.runtime.variables import AgentVariable
 
 logger = logging.getLogger(__name__)
 
@@ -47,6 +48,67 @@ class BaseMPC(SkippableMixin, BaseModule):
         self._history_rows: list[dict] = []
         self._setup_backend()
         self.init_skippable()
+        self._init_resilience()
+
+    def _init_resilience(self) -> None:
+        """Guarded actuation (config key ``resilience``) + periodic
+        warm-start auto-checkpointing (``checkpoint_path`` /
+        ``checkpoint_every``, with restore-on-construct) — see
+        docs/robustness.md."""
+        from agentlib_mpc_tpu.resilience.guard import (
+            ActuationGuard,
+            DegradationPolicy,
+        )
+
+        cfg = dict(self.config.get("resilience") or {})
+        self.guard_enabled = bool(cfg.pop("enabled", True))
+        plan_columns = None
+        try:
+            plan_columns = list(
+                self.backend.trajectory_layout().get("u") or []) or None
+        except Exception:  # noqa: BLE001 - a layout-less custom backend
+            pass           # falls back to u0-order mapping in the guard
+        #: broadcast guard flag flips beyond this agent. Off by default:
+        #: the FallbackPID normally lives in the SAME agent, and a
+        #: fleet-wide shared ``mpc_active`` broadcast would deactivate
+        #: every OTHER healthy MPC agent on the bus. Enable only for a
+        #: fallback controller deployed in a different agent.
+        self._share_fallback_flag = bool(
+            cfg.pop("share_fallback_flag", False))
+        self.guard = ActuationGuard(
+            DegradationPolicy.from_config(cfg), logger_=self.logger,
+            agent=self.agent.id, module=self.id)
+        self.guard.plan_columns = plan_columns
+        self.guard.binary_plan_columns = \
+            list(self.var_ref.binary_controls) or None
+        #: last flag value set by someone OTHER than this module's guard
+        #: (an operator's MPCOnOff / SkipMPCInIntervals window). Guard
+        #: recovery must not override an operator-mandated off interval.
+        self._external_flag = True
+        #: effective flag value as last written by ANY writer (the guard
+        #: included) — True mid-fallback means the FallbackPID is
+        #: disengaged and the guard must serve a degraded hold
+        self._flag_value = True
+        self.checkpoint_path = self.config.get("checkpoint_path")
+        self.checkpoint_every = int(self.config.get("checkpoint_every", 0))
+        self._steps_since_checkpoint = 0
+        if self.checkpoint_path:
+            from agentlib_mpc_tpu.utils.checkpoint import has_checkpoint
+
+            if has_checkpoint(self.checkpoint_path):
+                try:
+                    self.restore_checkpoint(self.checkpoint_path)
+                    self.logger.info(
+                        "restored warm-start state from checkpoint %s",
+                        self.checkpoint_path)
+                except Exception as exc:  # noqa: BLE001 - an
+                    # incompatible/corrupt checkpoint (e.g. after a
+                    # horizon change) must degrade to a cold start, not
+                    # crash-loop the controller it exists to protect
+                    self.logger.warning(
+                        "could not restore checkpoint %s (%s); starting "
+                        "cold — delete it or fix the config to silence "
+                        "this", self.checkpoint_path, exc)
 
     def _setup_backend(self) -> None:
         self.var_ref = VariableReference(
@@ -91,6 +153,22 @@ class BaseMPC(SkippableMixin, BaseModule):
 
     # -- control loop ---------------------------------------------------------
 
+    def register_callbacks(self) -> None:
+        super().register_callbacks()
+        if self.guard_enabled:
+            self.agent.data_broker.register_callback(
+                MPC_FLAG_ACTIVE, None, self._external_flag_callback)
+
+    def _external_flag_callback(self, incoming) -> None:
+        """Track flag writes from OTHER modules (operator deactivation
+        windows), so guard recovery cannot re-activate an MPC an operator
+        turned off."""
+        src = incoming.source
+        if src.agent_id == self.agent.id and src.module_id == self.id:
+            return                      # our own guard broadcast
+        self._external_flag = bool(incoming.value)
+        self._flag_value = bool(incoming.value)
+
     def process(self):
         while True:
             self.do_step()
@@ -98,11 +176,121 @@ class BaseMPC(SkippableMixin, BaseModule):
 
     def do_step(self) -> None:
         if self.check_if_should_be_skipped():
-            return
+            if not (self.guard_enabled and self.guard.in_fallback):
+                return
+            # the guard itself flipped the flag: keep solving in probe
+            # mode (nothing actuated) so recovery hysteresis can observe
+            # healthy solves and re-engage
         variables = self.collect_variables_for_optimization()
         result = self.backend.solve(self.env.now, variables)
-        self.set_actuation(result)
-        self._record(result)
+        decision = self.guarded_actuation(result)
+        # results record only what actually drove the plant: probe
+        # solves during a fallback outage (healthy, never actuated)
+        # must not masquerade as MPC trajectories
+        if decision.action == "actuate":
+            self._record(result)
+
+    def guarded_actuation(self, result: dict):
+        """The ONE guarded actuation seam: assess the solve result and
+        actuate it (or a degraded substitute) accordingly. ``do_step``
+        routes through here, and so do the decentralized/coordinated
+        ADMM modes that own their step loop — any actuation path that
+        called ``set_actuation`` directly would re-open the 'failed or
+        NaN solve still actuates u[0]' hole this subsystem closes.
+        Returns the :class:`GuardDecision` (``decision.healthy`` gates
+        results recording and checkpointing)."""
+        from agentlib_mpc_tpu.resilience.guard import GuardDecision
+
+        if not self.guard_enabled:
+            self.set_actuation(result)
+            self._maybe_checkpoint()
+            return GuardDecision("actuate", None, True, ())
+        decision = self.guard.assess(
+            result, self._control_bounds(),
+            precheck=self.backend.health_check(result))
+        if decision.healthy:
+            # checkpointing lives on this seam so the ADMM modes (which
+            # own their step loops) auto-checkpoint too; it needs only a
+            # HEALTHY warm state — probe solves qualify, but a poisoned
+            # iterate must never be persisted and auto-restored
+            self._maybe_checkpoint()
+        if decision.entered_fallback:
+            self._set_mpc_flag(False)
+        elif decision.reengaged:
+            if self._external_flag:
+                self._set_mpc_flag(True)
+            else:
+                # an operator (MPCOnOff / skip interval) holds the MPC
+                # off: the guard has recovered, but the flag and the
+                # plant stay with the operator's choice
+                self.logger.info(
+                    "guard recovered but an external deactivation is in "
+                    "force; leaving mpc_active False")
+                # nothing was actuated: report it like a probe so the
+                # caller does not record the plan as a driven trajectory
+                return decision._replace(action="fallback")
+        if decision.action == "actuate":
+            self.set_actuation(result)
+        elif decision.controls is not None:     # replay / hold
+            self.logger.warning(
+                "solve at t=%s rejected (%s); %s", self.env.now,
+                ", ".join(decision.reasons),
+                "replaying the last accepted plan"
+                if decision.action == "replay"
+                else "holding the last actuated control")
+            self._actuate_degraded(decision.controls)
+        elif not decision.entered_fallback and self._flag_value:
+            # mid-outage, an external writer re-asserted the flag True
+            # (MPCOnOff's periodic activate heartbeat) — the FallbackPID
+            # is disengaged, so the plant would be uncommanded: serve a
+            # degraded hold instead of fighting over the flag
+            held = self.guard.external_override_hold()
+            if held is not None:
+                self._actuate_degraded(held)
+        # fallback otherwise: nothing actuated — FallbackPID owns the plant
+        return decision
+
+    def _control_bounds(self) -> dict:
+        """Live (lb, ub) per actuated control — the guard's bound check."""
+        out = {}
+        for name in (*self.var_ref.controls, *self.var_ref.binary_controls):
+            var = self.vars[name]
+            out[name] = (var.lb, var.ub)
+        return out
+
+    def _actuate_degraded(self, controls: dict) -> None:
+        """Actuate replay/hold controls, clipped like set_actuation."""
+        for name, value in controls.items():
+            var = self.vars[name]
+            self.set(name, float(np.clip(value, var.lb, var.ub)))
+
+    def _set_mpc_flag(self, active: bool) -> None:
+        """Flip the ``mpc_active`` flag so the FallbackPID hands over,
+        and mirror it into the local store when deactivation is enabled.
+        Agent-local by default — a fleet-shared broadcast would switch
+        every OTHER MPC agent to its fallback too; set
+        ``resilience.share_fallback_flag`` when the fallback controller
+        lives in a different agent."""
+        self._flag_value = bool(active)
+        if MPC_FLAG_ACTIVE in self.vars:
+            self.vars[MPC_FLAG_ACTIVE].value = bool(active)
+        self.send(AgentVariable(name=MPC_FLAG_ACTIVE, alias=MPC_FLAG_ACTIVE,
+                                value=bool(active),
+                                shared=self._share_fallback_flag))
+
+    def _maybe_checkpoint(self) -> None:
+        if not (self.checkpoint_path and self.checkpoint_every > 0):
+            return
+        self._steps_since_checkpoint += 1
+        if self._steps_since_checkpoint < self.checkpoint_every:
+            return
+        self._steps_since_checkpoint = 0
+        try:
+            self.save_checkpoint(self.checkpoint_path)
+        except Exception as exc:  # noqa: BLE001 - checkpointing must
+            #              never take down the control loop it protects
+            self.logger.warning("auto-checkpoint to %s failed: %s",
+                                self.checkpoint_path, exc)
 
     def collect_variables_for_optimization(self) -> dict:
         """Current value of every referenced variable, plus per-variable

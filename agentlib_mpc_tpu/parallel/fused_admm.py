@@ -141,6 +141,15 @@ class FusedADMMOptions(NamedTuple):
     #: threshold <= 1 disables
     penalty_change_threshold: float = -1.0
     penalty_change_factor: float = 2.0
+    #: quarantine non-finite local solutions inside the jitted loop: a
+    #: diverged agent's w/y/z/u are replaced by its previous iterate via
+    #: ``jnp.where`` (no host round-trip, no retrace), so one NaN agent
+    #: cannot poison every other agent through the consensus mean
+    quarantine: bool = True
+    #: consecutive quarantined iterations before the agent's warm start
+    #: is reset to the OCP initial guess (a fresh attempt often recovers
+    #: from a corrupted iterate where the stale one cannot)
+    quarantine_reset_after: int = 3
 
 
 class FusedState(NamedTuple):
@@ -174,6 +183,10 @@ class IterationStats(NamedTuple):
     #: was built with ``record_locals=False``.
     coupling_locals: "dict | None" = None
     exchange_locals: "dict | None" = None
+    #: per-iteration count of quarantined (non-finite, substituted)
+    #: active agents, (max_iter,) int32, zero beyond ``iterations``;
+    #: None when the engine was built with ``quarantine=False``
+    quarantined: "jnp.ndarray | None" = None
 
 
 class FusedADMM:
@@ -257,7 +270,12 @@ class FusedADMM:
             jax.vmap(g.ocp.initial_guess)(theta)
             for g, theta in zip(self.groups, theta_batches))
         y = tuple(jnp.zeros((g.n_agents, g.ocp.n_g)) for g in self.groups)
-        z = tuple(jnp.full((g.n_agents, g.ocp.n_h), 0.1)
+        # strong-typed like the solver's returned duals/penalties: a
+        # weak-typed scalar fill here means the SECOND step's avals
+        # differ from the first's and the whole fused program retraces
+        # and recompiles once per engine (seconds of wasted latency)
+        fdtype = jnp.zeros(()).dtype
+        z = tuple(jnp.full((g.n_agents, g.ocp.n_h), 0.1, dtype=fdtype)
                   for g in self.groups)
         rho_opt = self.options.rho
         if isinstance(rho_opt, dict):
@@ -265,10 +283,10 @@ class FusedADMM:
             if missing:
                 raise ValueError(
                     f"options.rho is a dict but misses aliases {missing}")
-            rho = {a: jnp.asarray(float(rho_opt[a]))
+            rho = {a: jnp.asarray(float(rho_opt[a]), dtype=fdtype)
                    for a in (*self._aliases, *self._ex_aliases)}
         else:
-            rho = {a: jnp.asarray(float(rho_opt))
+            rho = {a: jnp.asarray(float(rho_opt), dtype=fdtype)
                    for a in (*self._aliases, *self._ex_aliases)}
         return FusedState(zbar=zbar, lam=lam, ex_mean=ex_mean,
                           ex_diff=ex_diff, ex_lam=ex_lam,
@@ -471,6 +489,53 @@ class FusedADMM:
             return w_b, y_b, z_b, u_b, ok_b
 
         record = self.record_locals
+        quarantine = bool(opts.quarantine)
+        q_reset_after = max(int(opts.quarantine_reset_after), 1)
+
+        def row_finite(arr):
+            return jnp.all(jnp.isfinite(arr), axis=tuple(range(1, arr.ndim)))
+
+        def apply_quarantine(gi, state, theta_batch, streak,
+                             w_b, y_b, z_b, u_b):
+            """Quarantine diverged lanes of one group, inside the jit: a
+            non-finite local solution is replaced by the agent's previous
+            iterate via ``jnp.where`` (no host round-trip, no retrace), so
+            one NaN agent cannot poison the consensus mean. Lanes
+            quarantined ``quarantine_reset_after`` iterations in a row get
+            their warm start reset to the (sanitized) OCP initial guess —
+            a fresh attempt can recover where a corrupted iterate cannot.
+            Returns the substituted batches, the updated per-lane streak
+            and the number of quarantined ACTIVE lanes."""
+            bad = ~(row_finite(w_b) & row_finite(y_b) & row_finite(z_b)
+                    & row_finite(u_b))
+            u_prev = jax.vmap(
+                lambda w: groups[gi].ocp.unflatten(w)["u"])(state.w[gi])
+            w_b = jnp.where(bad[:, None], state.w[gi], w_b)
+            y_b = jnp.where(bad[:, None], state.y[gi], y_b)
+            z_b = jnp.where(bad[:, None], state.z[gi], z_b)
+            u_b = jnp.where(bad[:, None, None], u_prev, u_b)
+            streak = jnp.where(bad, streak + 1, 0)
+            resetting = streak >= q_reset_after
+            w_init = jax.vmap(groups[gi].ocp.initial_guess)(theta_batch)
+            # a NaN theta yields a NaN guess; the carried state must stay
+            # finite or the next substitution source is poisoned too
+            w_init = jnp.where(jnp.isfinite(w_init), w_init, 0.0)
+            w_b = jnp.where(resetting[:, None], w_init, w_b)
+            y_b = jnp.where(resetting[:, None], 0.0, y_b)
+            z_b = jnp.where(resetting[:, None], 0.1, z_b)
+            streak = jnp.where(resetting, 0, streak)
+            # last-resort elementwise sanitize: when the substitution
+            # source ITSELF is non-finite (the carry was poisoned before
+            # the round), the lane must still never write NaN into the
+            # consensus update — an unmasked NaN mean would bake NaN into
+            # every active lane's multiplier, and the lam update never
+            # heals. Healthy entries are untouched.
+            w_b = jnp.where(jnp.isfinite(w_b), w_b, 0.0)
+            y_b = jnp.where(jnp.isfinite(y_b), y_b, 0.0)
+            z_b = jnp.where(jnp.isfinite(z_b), z_b, 0.1)
+            u_b = jnp.where(jnp.isfinite(u_b), u_b, 0.0)
+            n_q = jnp.sum(bad & self.active[gi], dtype=jnp.int32)
+            return w_b, y_b, z_b, u_b, streak, n_q
 
         def step_fn(state: FusedState, theta_batches: tuple):
             max_it = opts.max_iterations
@@ -483,12 +548,14 @@ class FusedADMM:
               # ``it == 0``, so both phases reuse a single solver trace.
               def iteration(carry):
                 (state, it, _res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist) = carry
+                 ok_hist, cl_hist, ex_hist, q_streak, q_hist) = carry
                 cl_hist = dict(cl_hist)
                 ex_hist = dict(ex_hist)
 
                 u_groups = []
                 w_new, y_new, z_new = [], [], []
+                q_streak_new = []
+                n_quarantined = jnp.asarray(0, jnp.int32)
                 ok_all = jnp.asarray(True)
                 for gi in range(n_groups):
                     cold_opts = groups[gi].solver_options
@@ -511,6 +578,15 @@ class FusedADMM:
                     w_b, y_b, z_b, u_b, ok_b = local_solves(
                         gi, state, theta_batches[gi], solver_opts, mu0,
                         budget)
+                    if quarantine:
+                        w_b, y_b, z_b, u_b, streak_gi, n_q = \
+                            apply_quarantine(gi, state, theta_batches[gi],
+                                             q_streak[gi], w_b, y_b, z_b,
+                                             u_b)
+                        q_streak_new.append(streak_gi)
+                        n_quarantined = n_quarantined + n_q
+                    else:
+                        q_streak_new.append(q_streak[gi])
                     w_new.append(w_b)
                     y_new.append(y_b)
                     z_new.append(z_b)
@@ -608,9 +684,10 @@ class FusedADMM:
                     ex_diff=ex_diff_new, ex_lam=ex_lam_new,
                     rho=rho_next, w=tuple(w_new), y=tuple(y_new),
                     z=tuple(z_new))
+                q_hist = q_hist.at[it].set(n_quarantined)
                 return (state, it + 1, res_all, prim_hist, dual_hist,
                         rho_hist, is_conv, ok_hist & ok_all, cl_hist,
-                        ex_hist)
+                        ex_hist, tuple(q_streak_new), q_hist)
 
               return iteration
 
@@ -631,10 +708,14 @@ class FusedADMM:
                 if record else {}
             rho_hist0 = {a: jnp.full((max_it,), jnp.nan)
                          for a in (*aliases, *ex_aliases)}
+            q_streak0 = tuple(jnp.zeros((g.n_agents,), jnp.int32)
+                              for g in groups)
+            q_hist0 = jnp.zeros((max_it,), jnp.int32)
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
                      rho_hist0, jnp.asarray(False),
-                     jnp.asarray(True), cl_hist0, ex_hist0)
+                     jnp.asarray(True), cl_hist0, ex_hist0,
+                     q_streak0, q_hist0)
             # two-phase inexact ADMM: iteration 0 runs the full (cold)
             # interior-point budget, subsequent iterations the short warm
             # budget — primal, duals and barrier all carry over
@@ -642,20 +723,23 @@ class FusedADMM:
                 # one body, budgets selected inside by it == 0 (the cond
                 # admits the first iteration unconditionally: done=False)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist) = jax.lax.while_loop(
-                    cond, make_iteration(cold=None), carry)
+                 ok_hist, cl_hist, ex_hist, _qs, q_hist) = \
+                    jax.lax.while_loop(
+                        cond, make_iteration(cold=None), carry)
             else:
                 carry = make_iteration(cold=True)(carry)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist) = jax.lax.while_loop(
-                    cond, make_iteration(cold=False), carry)
+                 ok_hist, cl_hist, ex_hist, _qs, q_hist) = \
+                    jax.lax.while_loop(
+                        cond, make_iteration(cold=False), carry)
 
             stats = IterationStats(
                 iterations=it, primal_residuals=prim_hist,
                 dual_residuals=dual_hist, penalty=rho_hist, converged=done,
                 local_solves_ok=ok_hist,
                 coupling_locals=cl_hist if record else None,
-                exchange_locals=ex_hist if record else None)
+                exchange_locals=ex_hist if record else None,
+                quarantined=q_hist if quarantine else None)
             trajs = tuple(
                 jax.vmap(lambda w, th, g=g: g.ocp.trajectories(w, th))(
                     state.w[gi], theta_batches[gi])
@@ -715,6 +799,18 @@ class FusedADMM:
                 "admm_local_solve_failures_total",
                 "fused rounds where >= 1 inner solve exhausted its budget "
                 "without reaching an acceptable point").inc(fleet=fleet)
+        if stats.quarantined is not None:
+            n_q = int(np.asarray(stats.quarantined).sum())
+            telemetry.gauge(
+                "admm_quarantined_agents_last_round",
+                "quarantined (non-finite, substituted) agent-iterations in "
+                "the most recent fused round").set(float(n_q), fleet=fleet)
+            if n_q:
+                telemetry.counter(
+                    "admm_quarantined_agent_iters_total",
+                    "agent-iterations whose non-finite local solution was "
+                    "quarantined and substituted with the previous iterate"
+                    ).inc(n_q, fleet=fleet)
         telemetry.histogram(
             "admm_round_iterations", "ADMM iterations per fused round",
             buckets=telemetry.ITERATION_BUCKETS

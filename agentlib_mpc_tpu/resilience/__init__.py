@@ -1,0 +1,51 @@
+"""Resilience subsystem: guarded actuation, degradation ladder, chaos.
+
+The reference tolerates failed solves by logging and carrying on
+(``modules/mpc/mpc.py:389-404``) and ships a hand-operated
+``fallback_pid`` escape hatch; a failed or NaN solve still actuates
+``u[0]`` from the garbage trajectory. This package gives the framework
+reflexes instead of hope:
+
+- :mod:`.guard` — per-solve health checks and the configurable
+  degradation cascade (shift-and-replay → hold-last-control →
+  FallbackPID hand-over, with hysteresis before MPC re-engages), driven
+  from :class:`~agentlib_mpc_tpu.modules.mpc.BaseMPC`.
+- :mod:`.chaos` — deterministic, seeded fault injectors for the
+  DataBroker (drop/delay/duplicate/reorder), the backend solve seam
+  (forced failure / NaN poisoning) and ADMM participants (silent
+  mid-round death), so the unhappy paths are *tested*, not hoped for.
+
+The fused-ADMM quarantine (non-finite local solutions substituted with
+the agent's previous iterate inside the jitted step) lives with the
+engine in :mod:`agentlib_mpc_tpu.parallel.fused_admm`; its knobs are
+``FusedADMMOptions.quarantine`` / ``quarantine_reset_after``.
+
+See ``docs/robustness.md`` for the full degradation-ladder and
+chaos-config reference.
+"""
+
+from agentlib_mpc_tpu.resilience.guard import (
+    LEVEL_FALLBACK,
+    LEVEL_HOLD,
+    LEVEL_MPC,
+    LEVEL_REPLAY,
+    ActuationGuard,
+    DegradationPolicy,
+    GuardDecision,
+    check_result,
+)
+from agentlib_mpc_tpu.resilience.chaos import (
+    AdmmDeathRule,
+    BrokerRule,
+    ChaosConfig,
+    ChaosController,
+    SolverRule,
+    install_chaos,
+)
+
+__all__ = [
+    "ActuationGuard", "DegradationPolicy", "GuardDecision", "check_result",
+    "LEVEL_MPC", "LEVEL_REPLAY", "LEVEL_HOLD", "LEVEL_FALLBACK",
+    "ChaosConfig", "ChaosController", "BrokerRule", "SolverRule",
+    "AdmmDeathRule", "install_chaos",
+]

@@ -1,0 +1,300 @@
+"""Deterministic chaos harness: seeded fault injectors for the runtime.
+
+Every injector draws from a :class:`random.Random` seeded from the
+config seed plus the injection point's identity, so a chaos run is a
+pure function of ``(seed, message/solve order)`` — tests and
+``bench.py --chaos SEED`` replay the exact same fault sequence every
+time. Three seams are covered, matching where production fleets
+actually fail:
+
+- **DataBroker** (:class:`BrokerRule`) — per-alias drop / delay /
+  duplicate / reorder of variables flowing through an agent's broker.
+  The broker delivers synchronously, so *delay* and *reorder* both
+  express as one-slot displacement: the message is held and delivered
+  right after the next message passes through.
+- **Solver seam** (:class:`SolverRule`) — wrap a module's
+  ``backend.solve`` and poison what the *module* sees (the backend's
+  own telemetry records the real solve): ``fail`` marks the result
+  unsuccessful, ``nan`` NaN-poisons ``u0`` and the trajectories,
+  ``huge`` drives ``u0`` out of every plausible bound. Windowed:
+  ``start_call`` / ``n_calls`` / ``every`` select which calls are hit —
+  ``every=1`` with a window is the "100 %-failure solver window" the
+  degradation-cascade acceptance test runs.
+- **ADMM participants** (:class:`AdmmDeathRule`) — silent mid-round
+  death: a coordinated participant's ``optimize`` callback swallows the
+  trigger without replying, exactly what a crashed agent process looks
+  like to the coordinator.
+
+Injections are counted in ``chaos_injections_total{kind=...}`` and
+logged on the returned :class:`ChaosController` (``.events``), which
+also restores every seam on ``uninstall()``. Config reference:
+``docs/robustness.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+from typing import Optional
+
+import numpy as np
+
+from agentlib_mpc_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerRule:
+    """Per-alias message chaos (probabilities in [0, 1])."""
+
+    alias: str = "*"
+    drop: float = 0.0
+    delay: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+
+    def matches(self, alias: str) -> bool:
+        return self.alias in ("*", alias)
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverRule:
+    """Windowed solve poisoning for one module's backend seam."""
+
+    target: str = "*"          # "*", "<agent_id>" or "<agent_id>/<module_id>"
+    mode: str = "fail"         # fail | nan | huge
+    every: int = 1             # poison every Nth call inside the window
+    start_call: int = 0        # first affected solve index (0-based)
+    n_calls: Optional[int] = None  # window length; None = open-ended
+
+    def matches(self, agent_id: str, module_id: str) -> bool:
+        return self.target in ("*", agent_id, f"{agent_id}/{module_id}")
+
+    def triggered(self, call: int) -> bool:
+        if call < self.start_call:
+            return False
+        if self.n_calls is not None and \
+                call >= self.start_call + self.n_calls:
+            return False
+        return (call - self.start_call) % max(int(self.every), 1) == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmmDeathRule:
+    """Silent participant death: swallow optimization triggers."""
+
+    agent: str
+    die_at_call: int = 0
+    revive_at_call: Optional[int] = None  # None = stays dead
+
+    def dead(self, call: int) -> bool:
+        if call < self.die_at_call:
+            return False
+        return self.revive_at_call is None or call < self.revive_at_call
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    seed: int = 0
+    broker: tuple = ()
+    solver: tuple = ()
+    admm: tuple = ()
+
+    @classmethod
+    def from_dict(cls, cfg: dict) -> "ChaosConfig":
+        cfg = dict(cfg)
+        known = {"seed", "broker", "solver", "admm"}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown chaos option(s) {sorted(unknown)}; "
+                             f"known: {sorted(known)}")
+        return cls(
+            seed=int(cfg.get("seed", 0)),
+            broker=tuple(r if isinstance(r, BrokerRule) else BrokerRule(**r)
+                         for r in cfg.get("broker", ())),
+            solver=tuple(r if isinstance(r, SolverRule) else SolverRule(**r)
+                         for r in cfg.get("solver", ())),
+            admm=tuple(r if isinstance(r, AdmmDeathRule)
+                       else AdmmDeathRule(**r) for r in cfg.get("admm", ())),
+        )
+
+
+def _rng(seed: int, scope: str) -> random.Random:
+    """One independent, reproducible stream per injection point."""
+    return random.Random(f"chaos:{seed}:{scope}")
+
+
+class ChaosController:
+    """Owns the installed injectors: event log, counters, uninstall."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self.events: list[tuple[str, str]] = []   # (kind, where)
+        self._restores: list = []                 # () -> None, LIFO
+        self._flushes: list = []
+
+    def note(self, kind: str, where: str) -> None:
+        self.events.append((kind, where))
+        if telemetry.enabled():
+            telemetry.counter(
+                "chaos_injections_total",
+                "faults injected by the chaos harness").inc(kind=kind)
+        logger.debug("chaos: %s at %s", kind, where)
+
+    def count(self, kind: str) -> int:
+        return sum(1 for k, _ in self.events if k == kind)
+
+    def flush(self) -> None:
+        """Deliver every message still held by delay/reorder injectors."""
+        for fn in self._flushes:
+            fn()
+
+    def uninstall(self) -> None:
+        """Restore every wrapped seam (idempotent)."""
+        self.flush()
+        while self._restores:
+            self._restores.pop()()
+
+
+class _BrokerChaos:
+    def __init__(self, broker, rules, rng, controller: ChaosController,
+                 where: str):
+        self._orig = broker.send_variable
+        self._rules = tuple(rules)
+        self._rng = rng
+        self._ctl = controller
+        self._where = where
+        self._held: list = []
+
+    def send_variable(self, var, from_external: bool = False) -> None:
+        rule = next((r for r in self._rules if r.matches(var.alias)), None)
+        if rule is not None:
+            tag = f"{self._where}:{var.alias}"
+            if rule.drop and self._rng.random() < rule.drop:
+                self._ctl.note("drop", tag)
+                self._flush()
+                return
+            if rule.delay and self._rng.random() < rule.delay:
+                self._ctl.note("delay", tag)
+                self._held.append((var, from_external))
+                return
+            if rule.reorder and self._rng.random() < rule.reorder:
+                self._ctl.note("reorder", tag)
+                self._held.append((var, from_external))
+                return
+            if rule.duplicate and self._rng.random() < rule.duplicate:
+                self._ctl.note("duplicate", tag)
+                self._orig(var, from_external)
+        self._orig(var, from_external)
+        self._flush()
+
+    def _flush(self) -> None:
+        while self._held:
+            var, ext = self._held.pop(0)
+            self._orig(var, ext)
+
+
+class _SolverChaos:
+    def __init__(self, backend, rule: SolverRule, controller: ChaosController,
+                 where: str):
+        self._orig = backend.solve
+        self._rule = rule
+        self._ctl = controller
+        self._where = where
+        self.calls = 0
+
+    def solve(self, now, variables) -> dict:
+        result = self._orig(now, variables)
+        call = self.calls
+        self.calls += 1
+        if not self._rule.triggered(call):
+            return result
+        self._ctl.note(f"solver_{self._rule.mode}",
+                       f"{self._where}:call{call}")
+        return self._poison(result)
+
+    def _poison(self, result: dict) -> dict:
+        mode = self._rule.mode
+        result = dict(result)
+        stats = dict(result.get("stats") or {})
+        stats["success"] = False
+        stats["chaos"] = mode
+        result["stats"] = stats
+        if mode == "nan":
+            result["u0"] = {n: float("nan") for n in result.get("u0", {})}
+            result["traj"] = {
+                k: np.full_like(np.asarray(v, dtype=float), np.nan)
+                for k, v in (result.get("traj") or {}).items()}
+        elif mode == "huge":
+            result["u0"] = {n: 1e12 for n in result.get("u0", {})}
+        elif mode != "fail":
+            raise ValueError(f"unknown solver chaos mode {mode!r}")
+        return result
+
+
+class _AdmmDeath:
+    def __init__(self, module, rule: AdmmDeathRule,
+                 controller: ChaosController, where: str):
+        self._orig = module.optimize
+        self._rule = rule
+        self._ctl = controller
+        self._where = where
+        self.calls = 0
+
+    def optimize(self, variable) -> None:
+        call = self.calls
+        self.calls += 1
+        if self._rule.dead(call):
+            self._ctl.note("admm_death", f"{self._where}:call{call}")
+            return
+        self._orig(variable)
+
+
+def install_chaos(target, config: "ChaosConfig | dict",
+                  seed: "int | None" = None) -> ChaosController:
+    """Install the configured injectors on a LocalMAS (or a single
+    agent). Returns the :class:`ChaosController`; call ``uninstall()``
+    to restore every seam. ``seed`` overrides ``config.seed``."""
+    if not isinstance(config, ChaosConfig):
+        config = ChaosConfig.from_dict(config)
+    if seed is not None:
+        config = dataclasses.replace(config, seed=int(seed))
+    controller = ChaosController(config)
+    agents = list(target.agents.values()) if hasattr(target, "agents") \
+        else [target]
+    for agent in agents:
+        if config.broker:
+            broker = agent.data_broker
+            wrapper = _BrokerChaos(
+                broker, config.broker,
+                _rng(config.seed, f"broker:{agent.id}"),
+                controller, agent.id)
+            orig = broker.send_variable
+            broker.send_variable = wrapper.send_variable
+            controller._restores.append(
+                lambda b=broker, o=orig: setattr(b, "send_variable", o))
+            controller._flushes.append(wrapper._flush)
+        for module in agent.modules.values():
+            backend = getattr(module, "backend", None)
+            if backend is not None:
+                rule = next((r for r in config.solver
+                             if r.matches(agent.id, module.id)), None)
+                if rule is not None:
+                    where = f"{agent.id}/{module.id}"
+                    wrapper = _SolverChaos(backend, rule, controller, where)
+                    orig = backend.solve
+                    backend.solve = wrapper.solve
+                    controller._restores.append(
+                        lambda b=backend, o=orig: setattr(b, "solve", o))
+            if hasattr(module, "optimize"):
+                rule = next((r for r in config.admm
+                             if r.agent in ("*", agent.id)), None)
+                if rule is not None:
+                    wrapper = _AdmmDeath(module, rule, controller, agent.id)
+                    orig = module.optimize
+                    module.optimize = wrapper.optimize
+                    controller._restores.append(
+                        lambda m=module, o=orig: setattr(m, "optimize", o))
+    return controller

@@ -12,6 +12,8 @@ Configuration via environment:
 ``MQTT_HOST``/``MQTT_PORT``  broker address (default localhost:1883);
                       set ``MQTT_HOST=none`` for an isolated container
                       (single-agent simulation, no fleet)
+``MQTT_RECONNECT_MAX_DELAY``  cap (s) on the decorrelated-jitter
+                      reconnect backoff (default 1.0; docs/robustness.md)
 ``RUN_UNTIL``         simulation/wall-clock horizon in seconds
                       (default: run forever in wall-clock mode)
 ``REALTIME``          "1" (default) wall-clock env; "0" fast simulation
@@ -52,9 +54,12 @@ def build_mas(configs: list[dict], realtime: bool = True,
     if mqtt_host and mqtt_host.lower() != "none":
         from agentlib_mpc_tpu.runtime.mqtt import MqttBus
 
+        reconnect_cap = float(
+            os.environ.get("MQTT_RECONNECT_MAX_DELAY", "1.0"))
         for agent_id, agent in mas.agents.items():
             bus = MqttBus(agent_id, broker_host=mqtt_host,
-                          broker_port=mqtt_port)
+                          broker_port=mqtt_port,
+                          reconnect_max_delay=reconnect_cap)
             bus.attach(agent.data_broker)
             buses.append(bus)
     return mas, buses

@@ -31,7 +31,13 @@ class MqttBus:
     def __init__(self, agent_id: str, broker_host: str = "localhost",
                  broker_port: int = 1883, prefix: str = TOPIC_PREFIX,
                  username: Optional[str] = None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 reconnect_base: float = 0.05,
+                 reconnect_max_delay: float = 1.0):
+        """``reconnect_base`` / ``reconnect_max_delay`` bound the native
+        client's decorrelated-jitter redial backoff (a fleet must not
+        thundering-herd a restarting broker); with paho installed they
+        map onto ``reconnect_delay_set(min_delay, max_delay)``."""
         self.agent_id = agent_id
         self.prefix = prefix.rstrip("/")
         self._broker = None
@@ -43,13 +49,21 @@ class MqttBus:
             logger.info("paho-mqtt not installed; using the first-party "
                         "MQTT 3.1.1 subset client")
             self.client_impl = "native"
-            self._client = MiniMqttClient(client_id=agent_id)
+            self._client = MiniMqttClient(
+                client_id=agent_id, reconnect_base=reconnect_base,
+                reconnect_max_delay=reconnect_max_delay)
         else:
             self.client_impl = "paho"
             try:  # paho-mqtt >= 2.0 requires an explicit callback version
                 self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION1)
             except AttributeError:  # paho-mqtt 1.x
                 self._client = mqtt.Client()
+            try:
+                self._client.reconnect_delay_set(
+                    min_delay=max(reconnect_base, 1e-3),
+                    max_delay=reconnect_max_delay)
+            except AttributeError:   # stub/exotic client without the knob
+                pass
         if username:
             self._client.username_pw_set(username, password)
         self._client.on_message = self._on_message

@@ -26,6 +26,7 @@ plain sockets + threads — no third-party dependency.
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import struct
 import threading
@@ -253,13 +254,25 @@ class _Message:
 class MiniMqttClient:
     """Minimal client with the paho surface
     :class:`~agentlib_mpc_tpu.runtime.mqtt.MqttBus` uses, plus automatic
-    reconnect: on EOF the reader thread redials with capped backoff and
-    re-subscribes its filters, so a broker restart (or
+    reconnect: on EOF the reader thread redials with decorrelated-jitter
+    backoff and re-subscribes its filters, so a broker restart (or
     :meth:`MiniBroker.drop_clients`) only costs the messages published
     while the link was down — QoS-0 semantics, like paho's
-    ``reconnect_delay_set`` behavior."""
+    ``reconnect_delay_set`` behavior.
 
-    def __init__(self, client_id: str = ""):
+    Backoff: a fixed 0.05 → 1.0 doubling ladder makes every client of a
+    fleet redial on the SAME schedule after a broker restart — a
+    thundering herd precisely when the broker is weakest. Each redial
+    instead sleeps ``min(cap, uniform(base, 3 · previous))`` (the
+    decorrelated-jitter scheme) from a per-client seeded stream, so the
+    fleet's dials spread out while any single client's sequence stays
+    reproducible. ``reconnect_max_delay`` configures the cap,
+    ``reconnect_base`` the floor, ``reconnect_seed`` pins the stream
+    (defaults to the client id, so a named client is deterministic)."""
+
+    def __init__(self, client_id: str = "", reconnect_base: float = 0.05,
+                 reconnect_max_delay: float = 1.0,
+                 reconnect_seed: "int | str | None" = None):
         self.client_id = client_id or f"mini-{id(self):x}"
         self.on_message: Optional[Callable] = None
         self._sock: Optional[socket.socket] = None
@@ -270,6 +283,27 @@ class MiniMqttClient:
         self._thread: Optional[threading.Thread] = None
         self._connected = threading.Event()
         self.reconnects = 0
+        self._reconnect_base = float(reconnect_base)
+        self._reconnect_cap = float(reconnect_max_delay)
+        if self._reconnect_cap < self._reconnect_base:
+            raise ValueError(
+                f"reconnect_max_delay={self._reconnect_cap} must be >= "
+                f"reconnect_base={self._reconnect_base}")
+        self._backoff_rng = random.Random(
+            self.client_id if reconnect_seed is None else reconnect_seed)
+        self._backoff = self._reconnect_base
+
+    def _next_backoff(self) -> float:
+        """Advance the decorrelated-jitter sequence and return the next
+        redial delay."""
+        self._backoff = min(
+            self._reconnect_cap,
+            self._backoff_rng.uniform(self._reconnect_base,
+                                      self._backoff * 3))
+        return self._backoff
+
+    def _reset_backoff(self) -> None:
+        self._backoff = self._reconnect_base
 
     # paho-compat no-op (the subset has no auth)
     def username_pw_set(self, username, password=None) -> None:
@@ -335,11 +369,10 @@ class MiniMqttClient:
             self._thread.start()
 
     def _reader(self) -> None:
-        backoff = 0.05
         while not self._stop.is_set():
             sock = self._sock
             if sock is None:
-                time.sleep(backoff)
+                time.sleep(self._reconnect_base)
                 continue
             try:
                 ptype, _flags, body = _read_packet(sock)
@@ -353,11 +386,10 @@ class MiniMqttClient:
                     try:
                         self._dial(timeout=1.0)
                         self.reconnects += 1
-                        backoff = 0.05
+                        self._reset_backoff()
                         break
                     except OSError:
-                        time.sleep(backoff)
-                        backoff = min(backoff * 2, 1.0)
+                        time.sleep(self._next_backoff())
                 continue
             if ptype == PUBLISH and self.on_message is not None:
                 tlen = struct.unpack(">H", body[:2])[0]

@@ -22,7 +22,7 @@ import os
 import shutil
 from typing import Any
 
-__all__ = ["save_pytree", "load_pytree"]
+__all__ = ["save_pytree", "load_pytree", "has_checkpoint"]
 
 
 def _stale_siblings(path: str) -> list:
@@ -30,6 +30,17 @@ def _stale_siblings(path: str) -> list:
 
     return sorted(glob.glob(f"{path}.tmp-*") + glob.glob(f"{path}.old-*"),
                   key=os.path.getmtime)
+
+
+def has_checkpoint(path: str) -> bool:
+    """True when :func:`load_pytree` has something to try at ``path``:
+    the primary checkpoint directory or any crash-recovery sibling
+    (``.old-*`` / ``.tmp-*``). The restore-on-construct guard used by
+    ``BaseMPC``'s auto-checkpointing (``checkpoint_path`` config) —
+    a fresh deployment with no checkpoint yet must start cold instead
+    of raising."""
+    path = os.path.abspath(path)
+    return os.path.isdir(path) or bool(_stale_siblings(path))
 
 
 def save_pytree(path: str, tree: Any) -> str:
@@ -72,12 +83,50 @@ def save_pytree(path: str, tree: Any) -> str:
     return path
 
 
+def _leaf_signature(tree) -> list:
+    """Order-insensitive (shape, dtype) multiset of a pytree's leaves —
+    comparable between a template and orbax's stored ArrayMetadata tree
+    even though the two flatten in different container orders."""
+    import jax
+    import numpy as np
+
+    sig = []
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(np.dtype(leaf.dtype))))
+        else:
+            arr = np.asarray(leaf)
+            sig.append((tuple(arr.shape), str(arr.dtype)))
+    return sorted(sig)
+
+
+def _assert_compatible(ckptr, path: str, template) -> None:
+    """Reject a structurally mismatched restore BEFORE orbax touches it:
+    newer orbax versions (>= 0.7) silently RESHAPE stored arrays into
+    the requested abstract shapes, so restoring e.g. a 3-agent fleet's
+    checkpoint into a 4-agent fleet would fabricate state instead of
+    failing — the exact corruption a checkpoint exists to prevent."""
+    try:
+        meta = ckptr.metadata(path)
+    except Exception:  # noqa: BLE001 - no metadata (older orbax):
+        return         # the restore itself validates structure then
+    stored = _leaf_signature(meta)
+    expected = _leaf_signature(template)
+    if stored != expected:
+        raise ValueError(
+            f"checkpoint at {path} is not compatible with the template: "
+            f"stored leaves {stored} != template leaves {expected} — "
+            f"restore into a fleet/backend built from the same config")
+
+
 def load_pytree(path: str, template: Any) -> Any:
     """Restore a pytree written by :func:`save_pytree`.
 
     ``template`` supplies the tree structure, container types (incl.
     NamedTuples) and array shapes/dtypes — pass a freshly-initialized
-    state of the same problem; its VALUES are ignored.
+    state of the same problem; its VALUES are ignored. A checkpoint
+    whose stored leaves do not match the template's shapes/dtypes is
+    rejected with ``ValueError`` (see :func:`_assert_compatible`).
 
     If ``path`` is missing (a save was killed between its two swap
     renames), the ``<path>.old-*``/``.tmp-*`` siblings are tried newest
@@ -91,6 +140,7 @@ def load_pytree(path: str, template: Any) -> Any:
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     ckptr = ocp.StandardCheckpointer()
     if os.path.isdir(path):
+        _assert_compatible(ckptr, path, abstract)
         return ckptr.restore(path, abstract)
     candidates = _stale_siblings(path)
     if not candidates:
@@ -99,6 +149,7 @@ def load_pytree(path: str, template: Any) -> Any:
     last_exc = None
     for candidate in reversed(candidates):
         try:
+            _assert_compatible(ckptr, candidate, abstract)
             return ckptr.restore(candidate, abstract)
         except Exception as exc:  # partial .tmp-* etc. — try the next
             errors.append(f"{candidate}: {exc}")

@@ -48,6 +48,12 @@ Modes:
                                 # histogram, per-ADMM-iteration residual
                                 # gauges, span aggregates, full metrics
                                 # snapshot) — see docs/telemetry.md
+    python bench.py --chaos SEED [n]    # resilience smoke: the n-zone
+                                # (default 4) fused consensus fleet with
+                                # one seeded agent's theta NaN-poisoned —
+                                # asserts the quarantine keeps consensus
+                                # state/warm starts finite end-to-end
+                                # (docs/robustness.md); ONE JSON line
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -678,6 +684,94 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     return payload
 
 
+def run_chaos(seed: int = 0, n_agents: int = 4) -> dict:
+    """``--chaos SEED``: deterministic resilience smoke on the fused
+    plane. Builds the ``n_agents``-zone consensus fleet as a
+    :class:`FusedADMM` engine (quarantine ON — the production
+    configuration), runs one healthy round, then NaN-poisons a
+    seeded-random agent's parameters and runs another. The quarantine
+    contract (``docs/robustness.md``): the poisoned agent's non-finite
+    local solutions are substituted inside the jit, so consensus means,
+    warm starts and every healthy agent's trajectories stay finite, and
+    the poisoning causes zero additional retraces. Mirrors the tier-1
+    chaos tests; here it runs on whatever the process's default platform
+    is, so the driver can exercise the same path on real hardware."""
+    import random
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import (
+        AgentGroup,
+        FusedADMM,
+        FusedADMMOptions,
+        stack_params,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import (
+        enable_compile_profiling,
+        enable_persistent_cache,
+    )
+    from agentlib_mpc_tpu import telemetry
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    enable_compile_profiling()
+
+    rng = random.Random(f"bench-chaos:{seed}")
+    ocp = zone_ocp()
+    group = AgentGroup(
+        name="zones", ocp=ocp, n_agents=n_agents,
+        couplings={"mDotCoolAir": "mDot"},
+        solver_options=SolverOptions(**SOLVER_BASE))
+    engine = FusedADMM([group], FusedADMMOptions(
+        max_iterations=ADMM_ITERS, rho=20.0))
+    x0s, loads = fleet_inputs(n_agents)
+    thetas = stack_params([
+        ocp.default_params(
+            x0=jnp.array([x0s[i]]),
+            d_traj=jnp.broadcast_to(
+                jnp.array([loads[i], 290.15, 294.15]), (HORIZON, 3)))
+        for i in range(n_agents)])
+    state = engine.init_state([thetas])
+    state, _, _ = engine.step(state, [thetas])     # healthy warm round
+    retraces_before = telemetry.metrics().counter(
+        "jax_retraces_total").total()
+
+    victim = rng.randrange(n_agents)
+    poisoned = jax.tree.map(
+        lambda leaf: leaf.at[victim].set(jnp.nan)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1
+        and leaf.shape[0] == n_agents else leaf, thetas)
+    state, trajs, stats = engine.step(state, [poisoned])
+
+    # EVERY carried leaf, multipliers included — lam is where an unmasked
+    # NaN consensus mean would hide while zbar/w stay finite
+    finite_state = all(
+        bool(jnp.all(jnp.isfinite(leaf)))
+        for leaf in jax.tree.leaves(state))
+    healthy_u = np.asarray(trajs[0]["u"])[
+        [i for i in range(n_agents) if i != victim]]
+    out = {
+        "metric": "chaos_smoke",
+        "seed": seed,
+        "n_agents": n_agents,
+        "poisoned_agent": victim,
+        "quarantined_agent_iters": int(
+            np.asarray(stats.quarantined).sum()),
+        "state_finite": bool(finite_state),
+        "healthy_trajectories_finite": bool(np.isfinite(healthy_u).all()),
+        "extra_retraces": int(telemetry.metrics().counter(
+            "jax_retraces_total").total() - retraces_before),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def run_profile(trace_dir: str = "bench_trace") -> None:
     """Capture an XLA profiler trace of the warm 256-zone step (for
     TensorBoard / xprof kernel-level analysis on TPU — the tool the
@@ -1120,6 +1214,19 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             runner(n)
             return
+
+    if "--chaos" in sys.argv:
+        # resilience smoke, in-process like --emit-metrics (pin
+        # JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --chaos SEED [n_agents]
+        idx = sys.argv.index("--chaos")
+        seed, n = 0, 4
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_chaos(seed, n)
+        return
 
     if "--emit-metrics" in sys.argv:
         # telemetry-instrumented run, in-process (initializes JAX here;

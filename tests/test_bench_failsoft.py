@@ -213,3 +213,31 @@ class TestArchitectureBaselines:
         assert out["platform"] == "cpu-sequential-slsqp"
         assert out["value"] > 0
         assert 0 <= out["consensus_spread"] < 1.0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosSmoke:
+    """``bench.py --chaos SEED`` (ISSUE 2 satellite): the fused 4-zone
+    quarantine smoke emits sane, platform-tagged JSON and upholds the
+    resilience contract with real zone solves."""
+
+    def test_chaos_mode_contract(self, capsys):
+        out = bench.run_chaos(seed=3, n_agents=4)
+        assert out["metric"] == "chaos_smoke"
+        assert out["seed"] == 3
+        assert 0 <= out["poisoned_agent"] < 4
+        assert out["state_finite"] is True
+        assert out["healthy_trajectories_finite"] is True
+        assert out["quarantined_agent_iters"] >= 1
+        assert out["extra_retraces"] == 0
+        assert out["platform"]
+        # the CLI contract: ONE parsable JSON line on stdout
+        lines = _headline_lines(capsys)
+        assert lines[-1]["metric"] == "chaos_smoke"
+
+    def test_chaos_is_deterministic_in_the_seed(self):
+        a = bench.run_chaos(seed=11, n_agents=4)
+        b = bench.run_chaos(seed=11, n_agents=4)
+        assert a["poisoned_agent"] == b["poisoned_agent"]
+        assert a["quarantined_agent_iters"] == b["quarantined_agent_iters"]

@@ -1,0 +1,307 @@
+"""Chaos harness (resilience/chaos.py) + fused-ADMM quarantine.
+
+The injectors are seeded and deterministic — a chaos run is a pure
+function of (seed, message/solve order) — and the fused engine's
+quarantine keeps a 4-agent consensus step finite when one agent's theta
+is NaN-poisoned, with ZERO additional retraces (pinned via the PR 1
+``jax_retraces_total`` counter).
+"""
+
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.resilience.chaos import (
+    AdmmDeathRule,
+    BrokerRule,
+    ChaosConfig,
+    SolverRule,
+    install_chaos,
+)
+from agentlib_mpc_tpu.runtime.broker import DataBroker
+from agentlib_mpc_tpu.runtime.variables import AgentVariable
+
+pytestmark = pytest.mark.chaos
+
+
+def _fake_agent(broker=None, modules=None, agent_id="a"):
+    return types.SimpleNamespace(
+        id=agent_id,
+        data_broker=broker if broker is not None else DataBroker(agent_id),
+        modules=modules or {})
+
+
+def _send_n(agent, n, alias="x"):
+    got = []
+    agent.data_broker.register_callback(alias, None,
+                                        lambda v: got.append(v.value))
+    for i in range(n):
+        agent.data_broker.send_variable(
+            AgentVariable(name=alias, alias=alias, value=float(i)))
+    return got
+
+
+class TestBrokerChaos:
+    def test_drop_is_seeded_and_deterministic(self):
+        runs = []
+        for _ in range(2):
+            agent = _fake_agent()
+            ctl = install_chaos(agent, {
+                "seed": 42, "broker": [{"alias": "x", "drop": 0.4}]})
+            runs.append(tuple(_send_n(agent, 40)))
+            assert ctl.count("drop") > 0
+        assert runs[0] == runs[1]           # same seed → same fault train
+
+        other = _fake_agent()
+        install_chaos(other, {"seed": 43,
+                              "broker": [{"alias": "x", "drop": 0.4}]})
+        assert tuple(_send_n(other, 40)) != runs[0]
+
+    def test_duplicate_and_delay(self):
+        agent = _fake_agent()
+        ctl = install_chaos(agent, {
+            "seed": 7,
+            "broker": [{"alias": "x", "duplicate": 0.3, "delay": 0.3}]})
+        got = _send_n(agent, 50)
+        assert ctl.count("duplicate") > 0 and ctl.count("delay") > 0
+        ctl.flush()
+        # nothing is lost (drop=0): every message arrives, some twice
+        assert set(got) == {float(i) for i in range(50)}
+        assert len(got) == 50 + ctl.count("duplicate")
+
+    def test_untargeted_alias_passes_clean(self):
+        agent = _fake_agent()
+        install_chaos(agent, {"seed": 7,
+                              "broker": [{"alias": "y", "drop": 1.0}]})
+        assert _send_n(agent, 10, alias="x") == [float(i) for i in range(10)]
+
+    def test_uninstall_restores_the_seam(self):
+        agent = _fake_agent()
+        ctl = install_chaos(agent, {"seed": 7,
+                                    "broker": [{"alias": "x", "drop": 1.0}]})
+        assert _send_n(agent, 5) == []
+        ctl.uninstall()
+        agent2got = []
+        agent.data_broker.register_callback(
+            "x", None, lambda v: agent2got.append(v.value))
+        agent.data_broker.send_variable(
+            AgentVariable(name="x", alias="x", value=1.0))
+        assert agent2got == [1.0]
+
+
+class TestSolverChaos:
+    def _module_with_backend(self):
+        def solve(now, variables):
+            return {"u0": {"u": 0.5}, "traj": {"u": np.ones((4, 1))},
+                    "stats": {"success": True}}
+
+        backend = types.SimpleNamespace(solve=solve)
+        module = types.SimpleNamespace(id="m", backend=backend)
+        return module, backend
+
+    def test_window_and_every(self):
+        rule = SolverRule(every=2, start_call=3, n_calls=5)
+        hits = [i for i in range(12) if rule.triggered(i)]
+        assert hits == [3, 5, 7]
+
+    def test_nan_mode_poisons_what_the_module_sees(self):
+        module, backend = self._module_with_backend()
+        agent = _fake_agent(modules={"m": module})
+        ctl = install_chaos(agent, {
+            "seed": 0,
+            "solver": [{"target": "a/m", "mode": "nan", "every": 1,
+                        "start_call": 1, "n_calls": 1}]})
+        ok = backend.solve(0.0, {})
+        assert ok["stats"]["success"] and np.isfinite(ok["u0"]["u"])
+        poisoned = backend.solve(1.0, {})
+        assert poisoned["stats"]["success"] is False
+        assert np.isnan(poisoned["u0"]["u"])
+        assert np.isnan(poisoned["traj"]["u"]).all()
+        clean_again = backend.solve(2.0, {})
+        assert clean_again["stats"]["success"]
+        assert ctl.count("solver_nan") == 1
+
+    def test_huge_mode_drives_out_of_bounds(self):
+        module, backend = self._module_with_backend()
+        agent = _fake_agent(modules={"m": module})
+        install_chaos(agent, {
+            "seed": 0, "solver": [{"target": "*", "mode": "huge"}]})
+        res = backend.solve(0.0, {})
+        assert res["u0"]["u"] > 1e9 and res["stats"]["success"] is False
+
+    def test_target_mismatch_leaves_backend_alone(self):
+        module, backend = self._module_with_backend()
+        orig = backend.solve
+        agent = _fake_agent(modules={"m": module})
+        install_chaos(agent, {
+            "seed": 0, "solver": [{"target": "other/m", "mode": "nan"}]})
+        assert backend.solve is orig
+
+
+class TestAdmmDeath:
+    def test_silent_death_and_revival(self):
+        calls = []
+        module = types.SimpleNamespace(
+            id="admm", optimize=lambda v: calls.append(v))
+        agent = _fake_agent(modules={"admm": module}, agent_id="emp")
+        ctl = install_chaos(agent, {
+            "seed": 0,
+            "admm": [{"agent": "emp", "die_at_call": 2,
+                      "revive_at_call": 4}]})
+        for i in range(6):
+            module.optimize(i)
+        assert calls == [0, 1, 4, 5]        # 2 and 3 swallowed silently
+        assert ctl.count("admm_death") == 2
+
+
+class TestConfigParsing:
+    def test_from_dict_round_trip(self):
+        cfg = ChaosConfig.from_dict({
+            "seed": 3,
+            "broker": [{"alias": "T", "drop": 0.1}],
+            "solver": [{"target": "a/m", "mode": "fail"}],
+            "admm": [{"agent": "emp", "die_at_call": 1}],
+        })
+        assert cfg.seed == 3
+        assert cfg.broker[0] == BrokerRule(alias="T", drop=0.1)
+        assert cfg.solver[0].mode == "fail"
+        assert cfg.admm[0] == AdmmDeathRule(agent="emp", die_at_call=1)
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos option"):
+            ChaosConfig.from_dict({"sover": []})
+
+
+# -- fused-ADMM quarantine (acceptance criterion) ----------------------------
+
+from conftest import make_tracker_model  # noqa: E402
+
+from agentlib_mpc_tpu.ops.solver import SolverOptions  # noqa: E402
+from agentlib_mpc_tpu.ops.transcription import transcribe  # noqa: E402
+from agentlib_mpc_tpu.parallel.fused_admm import (  # noqa: E402
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+    stack_params,
+)
+
+N_AGENTS = 4
+
+
+@pytest.fixture(scope="module")
+def quarantine_setup():
+    """4-agent fused consensus engine, warmed with one healthy round —
+    compile/retrace hooks installed BEFORE the first trace so the
+    retrace pin observes the whole lifetime."""
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    telemetry.configure(enabled=True)
+    enable_compile_profiling()
+    Tracker = make_tracker_model(lb=-5.0, ub=5.0)
+    ocp = transcribe(Tracker(), ["u"], N=5, dt=300.0,
+                     method="multiple_shooting")
+    group = AgentGroup(
+        name="t", ocp=ocp, n_agents=N_AGENTS, couplings={"shared_u": "u"},
+        solver_options=SolverOptions(tol=1e-8, max_iter=40))
+    engine = FusedADMM([group], FusedADMMOptions(max_iterations=12, rho=2.0))
+    thetas = stack_params([ocp.default_params(p=jnp.array([float(a)]))
+                           for a in (1.0, 2.0, 3.0, 4.0)])
+    state = engine.init_state([thetas])
+    state, _, stats = engine.step(state, [thetas])
+    assert int(np.asarray(stats.quarantined).sum()) == 0
+    return engine, state, thetas, ocp
+
+
+def _poison_theta(thetas, victim):
+    return jax.tree.map(
+        lambda leaf: leaf.at[victim].set(jnp.nan)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1
+        and leaf.shape[0] == N_AGENTS else leaf, thetas)
+
+
+class TestQuarantine:
+    def test_nan_warm_start_is_quarantined_and_recovers(self,
+                                                        quarantine_setup):
+        """A corrupted carry (NaN iterate) is quarantined and sanitized
+        — the lane recovers within the first iterations and the round
+        stays finite end to end, multipliers included."""
+        engine, state, thetas, _ = quarantine_setup
+        w_bad = state.w[0].at[1].set(jnp.nan)
+        new_state, trajs, stats = engine.step(
+            state._replace(w=(w_bad,)), [thetas])
+        per_iter = np.asarray(stats.quarantined)
+        assert per_iter.sum() >= 1
+        # recovered: no quarantine events survive past the reset window
+        assert per_iter[engine.options.quarantine_reset_after:].sum() == 0
+        # EVERY carried leaf — lam included: a NaN substitution source
+        # used to bake NaN into the multipliers through the consensus
+        # mean while zbar/w/y/z stayed finite (review finding)
+        for leaf in jax.tree.leaves(new_state):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+        assert bool(np.isfinite(np.asarray(trajs[0]["u"])).all())
+
+    def test_nan_theta_keeps_the_fleet_finite(self, quarantine_setup):
+        """One agent's NaN-poisoned parameters cannot poison the others
+        through the consensus mean: means, multipliers and warm starts
+        stay finite and the healthy agents' trajectories are unharmed."""
+        engine, state, thetas, _ = quarantine_setup
+        new_state, trajs, stats = engine.step(
+            state, [_poison_theta(thetas, 1)])
+        for leaf in jax.tree.leaves(new_state):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+        u = np.asarray(trajs[0]["u"])
+        assert np.isfinite(u[[0, 2, 3]]).all()
+
+    def test_poisoning_causes_zero_additional_retraces(self,
+                                                       quarantine_setup):
+        """The quarantine is pure jnp data flow: a poisoned round runs
+        the SAME compiled program (pinned via the PR 1 retrace/compile
+        counters)."""
+        engine, state, thetas, _ = quarantine_setup
+        reg = telemetry.metrics()
+        engine.step(state, [thetas])            # warm reference round
+        retraces = reg.counter("jax_retraces_total").total()
+        compiles = reg.counter("jax_compiles_total").total()
+        engine.step(state, [_poison_theta(thetas, 2)])
+        assert reg.counter("jax_retraces_total").total() == retraces
+        assert reg.counter("jax_compiles_total").total() == compiles
+
+    def test_quarantine_counts_surface_in_telemetry(self, quarantine_setup):
+        # poison the carry (NaN iterate) — the tracker NLP itself is
+        # NaN-robust to a poisoned theta, so the warm start is the
+        # injection point that reliably produces non-finite solutions
+        engine, state, thetas, _ = quarantine_setup
+        w_bad = state.w[0].at[3].set(jnp.nan)
+        _, _, stats = engine.step(state._replace(w=(w_bad,)), [thetas])
+        assert int(np.asarray(stats.quarantined).sum()) >= 1
+        reg = telemetry.metrics()
+        last = reg.get("admm_quarantined_agents_last_round", fleet="t")
+        assert last is not None and last >= 1.0
+        assert reg.get("admm_quarantined_agent_iters_total",
+                       fleet="t") >= 1.0
+
+    def test_quarantine_off_is_respected(self):
+        """quarantine=False restores the raw engine (stats carry None)."""
+        Tracker = make_tracker_model()
+        ocp = transcribe(Tracker(), ["u"], N=3, dt=300.0,
+                         method="multiple_shooting")
+        group = AgentGroup(name="t", ocp=ocp, n_agents=2,
+                           couplings={"shared_u": "u"},
+                           solver_options=SolverOptions(tol=1e-6,
+                                                        max_iter=15))
+        engine = FusedADMM([group], FusedADMMOptions(
+            max_iterations=3, quarantine=False))
+        thetas = stack_params([ocp.default_params(p=jnp.array([1.0])),
+                               ocp.default_params(p=jnp.array([2.0]))])
+        state = engine.init_state([thetas])
+        _, _, stats = engine.step(state, [thetas])
+        assert stats.quarantined is None

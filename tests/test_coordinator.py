@@ -262,3 +262,54 @@ def test_realtime_coordinator_terminate_joins_worker():
         _t.sleep(0.05)
     assert not worker.is_alive()
     coord.terminate()                           # idempotent
+
+
+def test_deregistration_telemetry_and_readmission(caplog):
+    """ISSUE 2 satellite: every de-registration counts into
+    ``coordinator_deregistrations_total{agent=...}`` with ONE rate-limited
+    warning per agent, and a de-registered participant is re-admitted at
+    the next round's start-iteration sync instead of staying dropped."""
+    import logging
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.modules.coordinator import (
+        ADMMCoordinator,
+        AgentEntry,
+        CoordinatorStatus,
+    )
+    from agentlib_mpc_tpu.runtime.agent import Agent
+    from agentlib_mpc_tpu.runtime.environment import Environment
+    from agentlib_mpc_tpu.runtime.variables import AgentVariable, Source
+
+    telemetry.configure(enabled=True)
+    env = Environment({"rt": False})
+    agent = Agent(env=env, config={"id": "Coord", "modules": []})
+    coord = ADMMCoordinator(
+        {"module_id": "coordinator", "type": "admm_coordinator",
+         "time_step": 5.0, "prediction_horizon": 4}, agent)
+    src = Source(agent_id="SlowRoom", module_id="admm")
+    coord.agent_dict[src] = AgentEntry(source=src,
+                                       status=AgentStatus.busy)
+    before = telemetry.metrics().get(
+        "coordinator_deregistrations_total", agent="SlowRoom") or 0.0
+
+    with caplog.at_level(logging.DEBUG):
+        coord._deregister_slow()                    # round 1: slow
+        coord.agent_dict[src].status = AgentStatus.busy
+        coord._deregister_slow()                    # round 2: slow again
+    assert telemetry.metrics().get(
+        "coordinator_deregistrations_total", agent="SlowRoom") == before + 2
+    assert coord.agent_dict[src].missed_rounds == 2
+    warnings = [r for r in caplog.records
+                if r.levelno == logging.WARNING
+                and "de-registered slow agent" in r.message]
+    assert len(warnings) == 1, "warning must be rate-limited to one/agent"
+
+    # re-admission: standby → ready on the next round's sync reply
+    assert coord.agent_dict[src].status is AgentStatus.standby
+    coord.status = CoordinatorStatus.init_iterations
+    coord.init_iteration_callback(AgentVariable(
+        name="startIteration_agent_to_coordinator",
+        alias="startIteration_agent_to_coordinator",
+        value=True, source=src))
+    assert coord.agent_dict[src].status is AgentStatus.ready

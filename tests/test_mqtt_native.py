@@ -152,6 +152,66 @@ def test_reconnect_after_drop(broker):
     pub.disconnect()
 
 
+class TestReconnectBackoff:
+    """Decorrelated-jitter redial backoff (ISSUE 2 satellite): a fleet
+    dropped by a broker restart must not redial in lockstep on the old
+    fixed 0.05→1.0 doubling ladder."""
+
+    def test_sequence_is_jittered_bounded_and_seeded(self):
+        a = MiniMqttClient("a", reconnect_seed=1)
+        b = MiniMqttClient("a", reconnect_seed=1)
+        c = MiniMqttClient("a", reconnect_seed=2)
+        seq_a = [a._next_backoff() for _ in range(8)]
+        assert seq_a == [b._next_backoff() for _ in range(8)]
+        assert seq_a != [c._next_backoff() for _ in range(8)]
+        assert all(0.05 <= s <= 1.0 for s in seq_a)
+        # NOT the fixed doubling ladder the fleet used to synchronize on
+        assert seq_a != [min(0.05 * 2 ** (i + 1), 1.0) for i in range(8)]
+
+    def test_default_seed_is_the_client_id(self):
+        assert [MiniMqttClient("x")._next_backoff() for _ in range(4)] == \
+            [MiniMqttClient("x")._next_backoff() for _ in range(4)]
+
+    def test_cap_is_configurable(self):
+        client = MiniMqttClient("a", reconnect_max_delay=0.2,
+                                reconnect_seed=3)
+        assert all(client._next_backoff() <= 0.2 for _ in range(20))
+        with pytest.raises(ValueError, match="reconnect_max_delay"):
+            MiniMqttClient("a", reconnect_base=0.5, reconnect_max_delay=0.1)
+
+    def test_reader_redials_with_jitter_on_a_fake_socket(self, monkeypatch):
+        """Drive the reader loop against a dead fake socket: every failed
+        redial sleeps a fresh jittered delay; success resets the ladder."""
+        from agentlib_mpc_tpu.runtime import mqtt_native
+
+        client = MiniMqttClient("jitter", reconnect_max_delay=0.5,
+                                reconnect_seed=7)
+        sleeps: list[float] = []
+        monkeypatch.setattr(mqtt_native.time, "sleep", sleeps.append)
+        dials = {"n": 0}
+
+        def fake_dial(timeout=1.0):
+            dials["n"] += 1
+            if dials["n"] <= 5:
+                raise OSError("connection refused")
+            client._stop.set()          # reconnected: end the loop
+
+        monkeypatch.setattr(client, "_dial", fake_dial)
+
+        class DeadSocket:
+            def recv(self, n):
+                raise ConnectionError("gone")
+
+        client._sock = DeadSocket()
+        client._reader()                # runs inline, exits via _stop
+        assert dials["n"] == 6
+        assert len(sleeps) == 5
+        assert all(0.05 <= s <= 0.5 for s in sleeps)
+        assert len(set(sleeps)) > 1     # jittered, not a constant
+        assert client.reconnects == 1
+        assert client._backoff == client._reconnect_base  # ladder reset
+
+
 @pytest.mark.slow
 def test_cooled_room_admm_pair_over_mqtt(monkeypatch, broker):
     """The realtime cooled-room ADMM pair with each agent in its OWN MAS

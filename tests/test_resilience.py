@@ -1,0 +1,505 @@
+"""Guarded actuation cascade (ISSUE 2 tentpole) + auto-checkpointing.
+
+The acceptance contract: with the chaos harness injecting a 100%-failure
+solver window, a running BaseMPC never actuates a non-finite or
+out-of-bounds control, degrades to FallbackPID within the configured
+budget, and re-engages MPC after the recovery hysteresis — pinned here
+end-to-end on the one-room MAS, plus pure-host unit coverage of the
+ladder itself and the crash/restart warm-start round-trip.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.resilience import install_chaos
+from agentlib_mpc_tpu.resilience.guard import (
+    LEVEL_FALLBACK,
+    LEVEL_HOLD,
+    LEVEL_MPC,
+    LEVEL_REPLAY,
+    ActuationGuard,
+    DegradationPolicy,
+    check_result,
+)
+
+
+def _result(u0=0.02, success=True, with_plan=True, n=5):
+    traj = {"u": np.full((n, 1), float(u0) if np.isfinite(u0) else u0)}
+    if with_plan:
+        traj["u"] = np.linspace(u0, u0, n).reshape(n, 1) \
+            if np.isfinite(u0) else np.full((n, 1), u0)
+    return {"u0": {"mDot": u0}, "traj": traj,
+            "stats": {"success": success}}
+
+
+BOUNDS = {"mDot": (0.0, 0.05)}
+
+
+class TestCheckResult:
+    def test_healthy(self):
+        ok, reasons = check_result(_result(), BOUNDS)
+        assert ok and reasons == ()
+
+    def test_solver_failure(self):
+        ok, reasons = check_result(_result(success=False), BOUNDS)
+        assert not ok and "solver_failure" in reasons
+
+    def test_nonfinite_control_and_trajectory(self):
+        ok, reasons = check_result(_result(u0=float("nan")), BOUNDS)
+        assert not ok
+        assert "nonfinite_control" in reasons
+        assert "nonfinite_trajectory" in reasons
+
+    def test_out_of_bounds(self):
+        ok, reasons = check_result(_result(u0=0.2), BOUNDS)
+        assert not ok and reasons == ("control_out_of_bounds",)
+
+    def test_bounds_are_the_module_layer(self):
+        # without bounds, an in-range-unknown control passes; the module
+        # supplies the live lb/ub (backend.health_check is a pure
+        # backend-specific hook on top)
+        ok, _ = check_result(_result(u0=0.2), bounds=None)
+        assert ok
+
+    def test_backend_precheck_merges_into_assessment(self):
+        guard = ActuationGuard(DegradationPolicy(recovery_steps=1),
+                               agent="a", module="m")
+        d = guard.assess(_result(), BOUNDS,
+                         precheck=(False, ("surrogate_off_manifold",)))
+        assert not d.healthy
+        assert "surrogate_off_manifold" in d.reasons
+
+
+class TestLadder:
+    def _guard(self, **kw):
+        policy = DegradationPolicy(replay_steps=2, hold_steps=1,
+                                   recovery_steps=2, **kw)
+        return ActuationGuard(policy, agent="a", module="m")
+
+    def test_replay_hold_fallback_then_hysteretic_recovery(self):
+        guard = self._guard()
+        plan = {"u0": {"mDot": 0.01},
+                "traj": {"u": np.arange(5, dtype=float).reshape(5, 1) / 100},
+                "stats": {"success": True}}
+        d = guard.assess(plan, BOUNDS)
+        assert d.action == "actuate" and guard.level == LEVEL_MPC
+
+        bad = _result(success=False)
+        d1 = guard.assess(bad, BOUNDS)          # failure 1 → replay row 1
+        assert d1.action == "replay"
+        assert d1.controls == {"mDot": 0.01}
+        assert guard.level == LEVEL_REPLAY
+        d2 = guard.assess(bad, BOUNDS)          # failure 2 → replay row 2
+        assert d2.action == "replay" and d2.controls == {"mDot": 0.02}
+        d3 = guard.assess(bad, BOUNDS)          # budget (2+1) not yet hit
+        assert d3.action == "hold"
+        assert d3.controls == {"mDot": 0.02}    # holds the last actuated
+        assert guard.level == LEVEL_HOLD
+        d4 = guard.assess(bad, BOUNDS)          # budget exhausted
+        assert d4.action == "fallback" and d4.entered_fallback
+        assert guard.level == LEVEL_FALLBACK
+        d5 = guard.assess(bad, BOUNDS)          # stays in fallback
+        assert d5.action == "fallback" and not d5.entered_fallback
+
+        ok = _result()
+        d6 = guard.assess(ok, BOUNDS)           # healthy probe 1: hysteresis
+        assert d6.action == "fallback" and not d6.reengaged
+        assert guard.in_fallback
+        d7 = guard.assess(ok, BOUNDS)           # healthy probe 2: re-engage
+        assert d7.action == "actuate" and d7.reengaged
+        assert guard.level == LEVEL_MPC
+
+    def test_one_healthy_solve_resets_the_streak(self):
+        guard = self._guard()
+        guard.assess(_result(), BOUNDS)
+        bad = _result(success=False)
+        guard.assess(bad, BOUNDS)
+        guard.assess(_result(), BOUNDS)         # replay-level recovery is
+        assert guard.level == LEVEL_MPC         # immediate (plant never
+        d = guard.assess(bad, BOUNDS)           # left MPC)
+        assert d.action == "replay"             # streak restarted at 1
+
+    def test_no_plan_no_last_control_goes_straight_to_fallback(self):
+        guard = self._guard()
+        d = guard.assess(_result(success=False), BOUNDS)
+        assert d.action == "fallback" and d.entered_fallback
+
+    def test_fallback_after_caps_the_budget(self):
+        guard = ActuationGuard(DegradationPolicy(
+            replay_steps=3, hold_steps=3, fallback_after=1,
+            recovery_steps=1), agent="a", module="m")
+        guard.assess(_result(), BOUNDS)
+        d1 = guard.assess(_result(success=False), BOUNDS)
+        assert d1.action == "replay"            # within the hard budget
+        d2 = guard.assess(_result(success=False), BOUNDS)
+        assert d2.action == "fallback"          # budget 1 exhausted
+
+    def test_degradation_level_gauge_exported(self):
+        telemetry.configure(enabled=True)
+        guard = self._guard()
+        guard.assess(_result(success=False), BOUNDS)
+        level = telemetry.metrics().get("mpc_degradation_level",
+                                        agent="a", module="m")
+        assert level == float(LEVEL_FALLBACK)
+
+    def test_minlp_shaped_plan_replays_binaries_too(self):
+        """MINLP results keep binaries in the top-level binary_schedule
+        (traj['u'] holds only the continuous columns) — the replay rung
+        must still engage, with name-mapped columns (review finding)."""
+        guard = ActuationGuard(DegradationPolicy(replay_steps=2,
+                                                 hold_steps=1),
+                               agent="a", module="m")
+        guard.plan_columns = ["mDot"]            # continuous traj columns
+        guard.binary_plan_columns = ["valve"]
+        result = {
+            "u0": {"mDot": 0.0, "valve": 1.0},
+            "traj": {"u": np.arange(4, dtype=float).reshape(4, 1) / 100},
+            "binary_schedule": np.array([[1.0], [1.0], [0.0], [0.0]]),
+            "stats": {"success": True},
+        }
+        bounds = {"mDot": (0.0, 0.05), "valve": (0.0, 1.0)}
+        guard.assess(result, bounds)
+        bad = {"u0": {"mDot": float("nan"), "valve": float("nan")},
+               "traj": {}, "stats": {"success": False}}
+        d1 = guard.assess(bad, bounds)
+        assert d1.action == "replay"
+        assert d1.controls == {"mDot": 0.01, "valve": 1.0}
+        d2 = guard.assess(bad, bounds)
+        assert d2.action == "replay"
+        assert d2.controls == {"mDot": 0.02, "valve": 0.0}
+
+    def test_rejects_unknown_policy_keys(self):
+        with pytest.raises(ValueError, match="unknown resilience option"):
+            DegradationPolicy.from_config({"replays": 3})
+
+
+# -- end-to-end: chaos solver window → FallbackPID hand-over → recovery ------
+
+UB = 295.15
+TIME_STEP = 300.0
+
+
+def _mas_configs():
+    from examples.one_room_mpc import OneRoom
+
+    agent_mpc = {
+        "id": "ctrl",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {
+                "module_id": "mpc",
+                "type": "mpc",
+                "enable_deactivation": True,
+                "resilience": {"replay_steps": 1, "hold_steps": 1,
+                               "recovery_steps": 2},
+                "optimization_backend": {
+                    "type": "jax",
+                    "model": {"class": OneRoom},
+                    "discretization_options": {
+                        "collocation_order": 2,
+                        "collocation_method": "legendre",
+                    },
+                    "solver": {"max_iter": 60},
+                },
+                "time_step": TIME_STEP,
+                "prediction_horizon": 6,
+                "parameters": [
+                    {"name": "s_T", "value": 0.001},
+                    {"name": "r_mDot", "value": 0.01},
+                ],
+                "inputs": [
+                    {"name": "T_in", "value": 290.15},
+                    {"name": "load", "value": 150},
+                    {"name": "T_upper", "value": UB},
+                ],
+                "controls": [{"name": "mDot", "value": 0.02,
+                              "ub": 0.05, "lb": 0}],
+                "outputs": [{"name": "T_out"}],
+                "states": [
+                    {"name": "T", "value": 298.16, "ub": 303.15,
+                     "lb": 288.15, "alias": "T", "source": "plant"},
+                ],
+            },
+            {
+                "module_id": "pid",
+                "type": "fallback_pid",
+                "input": {"name": "T", "alias": "T", "source": "plant"},
+                "output": {"name": "mDot_pid", "alias": "mDot"},
+                "setpoint": UB,
+                "Kp": 0.005, "reverse_acting": True,
+                "lb": 0.0, "ub": 0.05,
+            },
+        ],
+    }
+    agent_sim = {
+        "id": "plant",
+        "modules": [
+            {"module_id": "com", "type": "local_broadcast"},
+            {
+                "module_id": "room",
+                "type": "simulator",
+                "model": {"class": OneRoom,
+                          "states": [{"name": "T", "value": 298.16}]},
+                "t_sample": 50,
+                "outputs": [{"name": "T_out", "value": 298.16,
+                             "alias": "T"}],
+                "inputs": [{"name": "mDot", "value": 0.02,
+                            "alias": "mDot"}],
+            },
+        ],
+    }
+    return agent_mpc, agent_sim
+
+
+@pytest.fixture(scope="module")
+def outage_run():
+    """Run the closed loop through a 4-step 100%-failure solver window
+    (solve calls 3..6 NaN-poisoned) and record everything the plant and
+    the flag subscribers saw."""
+    from agentlib_mpc_tpu.runtime.mas import LocalMAS
+    from agentlib_mpc_tpu.runtime.module import BaseModule, register_module
+    import agentlib_mpc_tpu.modules  # noqa: F401
+
+    telemetry.configure(enabled=True)
+    received_mdot = []     # (t, value, source module) — external probe
+    flag_events = []       # (t, value) — listener INSIDE the ctrl agent
+    ext_flags = []         # flag events leaking to OTHER agents: none
+    #                        allowed (the guard flag is agent-local)
+
+    @register_module("_resilience_probe")
+    class Probe(BaseModule):
+        def register_callbacks(self):
+            self.agent.data_broker.register_callback(
+                "mDot", None,
+                lambda v: received_mdot.append(
+                    (v.timestamp, v.value, v.source.module_id)))
+            self.agent.data_broker.register_callback(
+                "mpc_active", None,
+                lambda v: ext_flags.append((v.timestamp, v.value)))
+
+    @register_module("_flag_listener")
+    class FlagListener(BaseModule):
+        def register_callbacks(self):
+            self.agent.data_broker.register_callback(
+                "mpc_active", None,
+                lambda v: flag_events.append((v.timestamp, v.value)))
+
+    agent_mpc, agent_sim = _mas_configs()
+    agent_mpc["modules"].append(
+        {"module_id": "flags", "type": "_flag_listener"})
+    probe = {"id": "probe",
+             "modules": [{"module_id": "p", "type": "_resilience_probe"}]}
+    mas = LocalMAS([agent_mpc, agent_sim, probe], env={"rt": False})
+    ctl = install_chaos(mas, {
+        "seed": 1,
+        "solver": [{"target": "ctrl/mpc", "mode": "nan",
+                    "every": 1, "start_call": 3, "n_calls": 4}],
+    })
+    mas.run(until=3600)
+    module = mas.agents["ctrl"].get_module("mpc")
+    return {"mas": mas, "ctl": ctl, "module": module,
+            "mdot": received_mdot, "flags": flag_events,
+            "ext_flags": ext_flags}
+
+
+@pytest.mark.chaos
+class TestFallbackHandover:
+    def test_window_actually_injected(self, outage_run):
+        assert outage_run["ctl"].count("solver_nan") == 4
+
+    def test_plant_only_ever_receives_bounded_controls(self, outage_run):
+        values = np.array([v for _, v, _ in outage_run["mdot"]], dtype=float)
+        assert len(values) > 0
+        assert np.isfinite(values).all()
+        assert (values >= -1e-9).all() and (values <= 0.05 + 1e-9).all()
+
+    def test_flag_flips_within_the_budget_and_recovers(self, outage_run):
+        flags = outage_run["flags"]
+        offs = [t for t, v in flags if v is False]
+        ons = [t for t, v in flags if v is True]
+        # window starts at solve call 3 (t=900); budget replay+hold = 2
+        # → fallback at the 3rd failed call, t=1500
+        assert offs and min(offs) == pytest.approx(1500.0)
+        # recovery: first healthy probe t=2100, hysteresis 2 → re-engage
+        # at t=2400
+        assert any(t == pytest.approx(2400.0) for t in ons)
+
+    def test_pid_served_the_plant_during_the_outage(self, outage_run):
+        pid_msgs = [(t, v) for t, v, src in outage_run["mdot"]
+                    if src == "pid" and 1500.0 <= t <= 2400.0]
+        assert pid_msgs, "FallbackPID never actuated during the outage"
+        assert all(0.0 <= v <= 0.05 for _, v in pid_msgs)
+
+    def test_mpc_back_in_charge_after_recovery(self, outage_run):
+        assert outage_run["module"].guard.level == LEVEL_MPC
+        mpc_after = [t for t, _, src in outage_run["mdot"]
+                     if src == "mpc" and t > 2400.0]
+        assert mpc_after, "MPC never actuated again after re-engaging"
+
+    def test_degraded_steps_not_recorded_as_results(self, outage_run):
+        df = outage_run["module"].results()
+        times = set(df.index.get_level_values("time").unique())
+        # neither the 4 poisoned solves (t=900..1800) nor the healthy
+        # but never-actuated recovery probe (t=2100) may pollute the
+        # results: recorded rows are exactly what drove the plant
+        assert times == {0.0, 300.0, 600.0, 2400.0,
+                         2700.0, 3000.0, 3300.0, 3600.0}
+        # dropna: u is N entries on the N+1 results grid — the terminal
+        # node is layout padding, not data
+        assert np.isfinite(
+            df[("variable", "mDot")].dropna().to_numpy(dtype=float)).all()
+
+    def test_recovery_does_not_override_operator_deactivation(
+            self, outage_run):
+        """If an operator (MPCOnOff / skip-interval window) set the flag
+        False, guard recovery must NOT flip it back on — the plant stays
+        with the operator's choice (review finding). Runs last: it
+        drives the already-finished module by hand."""
+        module = outage_run["module"]
+        flags_before = list(outage_run["flags"])
+        # put the guard one healthy solve away from re-engagement while
+        # an external deactivation is in force
+        module.guard.level = LEVEL_FALLBACK
+        module.guard._healthy_streak = \
+            module.guard.policy.recovery_steps - 1
+        module._external_flag = False
+        module.do_step()
+        assert module.guard.level == LEVEL_MPC      # guard DID recover
+        assert outage_run["flags"] == flags_before  # but stayed silent
+
+        # with no external deactivation, the same recovery flips the flag
+        module.guard.level = LEVEL_FALLBACK
+        module.guard._healthy_streak = \
+            module.guard.policy.recovery_steps - 1
+        module._external_flag = True
+        module.do_step()
+        assert outage_run["flags"][-1][1] is True
+
+    def test_fallback_flag_stays_agent_local(self, outage_run):
+        """The guard's flag flips must not leak onto the bus: a shared
+        broadcast would switch every OTHER healthy MPC agent in the
+        fleet to its fallback (review finding). Opt in with
+        resilience.share_fallback_flag for a remote fallback
+        controller."""
+        assert outage_run["flags"], "ctrl-local listener saw no flips"
+        assert outage_run["ext_flags"] == []
+
+    def test_guarded_actuation_is_the_shared_seam(self, outage_run):
+        """The decentralized/coordinated ADMM loops route through
+        guarded_actuation — pin the seam directly: a NaN result never
+        reaches set_actuation; a finite degraded substitute does."""
+        module = outage_run["module"]
+        n_before = len(outage_run["mdot"])
+        bad = {"u0": {"mDot": float("nan")},
+               "traj": {"u": np.full((6, 1), np.nan)},
+               "stats": {"success": False}}
+        decision = module.guarded_actuation(bad)
+        assert decision.action in ("replay", "hold")
+        new = [v for _, v, _ in outage_run["mdot"][n_before:]]
+        assert new and all(np.isfinite(v) for v in new)
+
+    def test_guard_telemetry_counters(self, outage_run):
+        reg = telemetry.metrics()
+        assert reg.get("mpc_fallback_engagements_total",
+                       agent="ctrl", module="mpc") >= 1
+        assert reg.get("mpc_recoveries_total",
+                       agent="ctrl", module="mpc") >= 1
+        assert reg.get("mpc_unhealthy_solves_total", agent="ctrl",
+                       module="mpc", reason="solver_failure") >= 4
+
+
+# -- crash/restart warm-start round-trip (checkpoint_every satellite) --------
+
+def _checkpoint_agent(path):
+    from examples.one_room_mpc import OneRoom
+
+    return {
+        "id": "solo",
+        "modules": [{
+            "module_id": "mpc",
+            "type": "mpc",
+            "checkpoint_path": str(path),
+            "checkpoint_every": 1,
+            "optimization_backend": {
+                "type": "jax",
+                "model": {"class": OneRoom},
+                "discretization_options": {"collocation_order": 2,
+                                           "collocation_method": "legendre"},
+                "solver": {"max_iter": 60},
+            },
+            "time_step": TIME_STEP,
+            "prediction_horizon": 6,
+            "parameters": [{"name": "s_T", "value": 0.001},
+                           {"name": "r_mDot", "value": 0.01}],
+            "inputs": [{"name": "T_in", "value": 290.15},
+                       {"name": "load", "value": 150},
+                       {"name": "T_upper", "value": UB}],
+            "controls": [{"name": "mDot", "value": 0.02,
+                          "ub": 0.05, "lb": 0}],
+            "outputs": [{"name": "T_out"}],
+            "states": [{"name": "T", "value": 298.16,
+                        "ub": 303.15, "lb": 288.15}],
+        }],
+    }
+
+
+class TestAutoCheckpoint:
+    def test_crash_restart_round_trip(self, tmp_path):
+        """checkpoint_every writes after every step; a 'crashed' process
+        rebuilt from the same config restores on construct and its next
+        solve matches the uninterrupted controller exactly."""
+        pytest.importorskip("orbax.checkpoint")
+        from agentlib_mpc_tpu.runtime.mas import LocalMAS
+        import agentlib_mpc_tpu.modules  # noqa: F401
+
+        path = tmp_path / "warm"
+        mas_a = LocalMAS([_checkpoint_agent(path)], env={"rt": False})
+        mas_a.run(until=650)                    # solves at t=0, 300, 600
+        mod_a = mas_a.agents["solo"].get_module("mpc")
+        assert path.is_dir(), "auto-checkpoint never wrote"
+
+        # "restart": a fresh process builds the same module and restores
+        mas_b = LocalMAS([_checkpoint_agent(path)], env={"rt": False})
+        mod_b = mas_b.agents["solo"].get_module("mpc")
+        assert mod_b.backend._cold is False     # restored, not cold
+        a_state = mod_a.backend.warm_state()
+        b_state = mod_b.backend.warm_state()
+        for key in ("w", "y", "z"):
+            np.testing.assert_array_equal(np.asarray(a_state[key]),
+                                          np.asarray(b_state[key]))
+
+        res_a = mod_a.backend.solve(900.0, {"T": 296.5})
+        res_b = mod_b.backend.solve(900.0, {"T": 296.5})
+        np.testing.assert_array_equal(np.asarray(res_a["traj"]["u"]),
+                                      np.asarray(res_b["traj"]["u"]))
+        assert res_a["stats"]["iterations"] == res_b["stats"]["iterations"]
+
+    def test_missing_checkpoint_starts_cold(self, tmp_path):
+        from agentlib_mpc_tpu.utils.checkpoint import has_checkpoint
+
+        assert not has_checkpoint(str(tmp_path / "nothing_here"))
+
+    def test_checkpointing_rides_the_guarded_actuation_seam(
+            self, outage_run, tmp_path):
+        """Auto-checkpointing lives on guarded_actuation — the seam the
+        ADMM modes (which own their step loops, never do_step) route
+        through — so they checkpoint too (review finding)."""
+        pytest.importorskip("orbax.checkpoint")
+        from agentlib_mpc_tpu.utils.checkpoint import has_checkpoint
+
+        module = outage_run["module"]
+        module.checkpoint_path = str(tmp_path / "warm")
+        module.checkpoint_every = 1
+        module._steps_since_checkpoint = 0
+        try:
+            healthy = module.backend.solve(3900.0, {})
+            module.guarded_actuation(healthy)
+            assert has_checkpoint(module.checkpoint_path)
+        finally:
+            module.checkpoint_path = None
