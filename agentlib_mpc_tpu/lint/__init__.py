@@ -1,0 +1,46 @@
+"""jaxlint: project-specific JIT-hygiene & thread-discipline analyzer.
+
+The framework's performance story is "compile once, dispatch forever"
+(PERF.md: one stray dispatch costs ~64 ms against 1-9 ms kernels), and its
+runtime story is "callback threads + locks around every shared structure".
+Both disciplines were tribal knowledge enforced by review; the two worst
+regressions so far (the weak-typed ``init_state`` z/rho that silently
+recompiled the fused-ADMM engine every round, and scattered host syncs
+turning jitted paths into per-step tunnels) were compile-cache bugs found
+by accident. This package machine-checks them:
+
+* :mod:`.jit_hygiene` — AST passes over the jit-reachable call graph:
+  host syncs (``float``/``int``/``.item()``/``.tolist()``/``np.*``/
+  ``print``), Python ``if``/``while`` on tracer-typed values, wall-clock
+  reads inside traced code, weak-typed scalar literals stored into carried
+  state pytrees, non-hashable static args.
+* :mod:`.thread_discipline` — every mutation of a field annotated
+  ``# guarded-by: <lock>`` must sit inside a ``with <lock>`` block; and
+  callback (de)registration must never run under a lock annotated
+  ``# lint: dispatch-lock`` (the classic dispatch-reentry deadlock).
+* :mod:`.retrace_budget` — a runtime gate: run the 4-agent fused-ADMM
+  bench step for N rounds after warmup and fail when any entry point
+  compiles more often than ``lint_budgets.toml`` allows.
+
+Findings carry stable fingerprints; pre-existing debt lives in a
+checked-in ``lint_baseline.json`` (with justifications) so only NEW
+violations fail CI. See ``docs/static_analysis.md``.
+
+The static passes are stdlib-only (``ast`` + ``tokenize``) — no jax
+import, so the linter runs in tooling contexts (CI collect phase, editor
+hooks) without touching an accelerator.
+"""
+
+from __future__ import annotations
+
+from agentlib_mpc_tpu.lint.findings import (  # noqa: F401
+    Baseline,
+    Finding,
+    fingerprint,
+)
+from agentlib_mpc_tpu.lint.runner import (  # noqa: F401
+    collect_findings,
+    collect_stats,
+    package_root,
+    repo_root,
+)

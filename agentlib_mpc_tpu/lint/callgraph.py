@@ -1,0 +1,456 @@
+"""Package index + jit-reachability call graph (pure ``ast``).
+
+The jit-hygiene passes need to know which functions execute *under a JAX
+trace*. That set is built here from intra-package call edges:
+
+* **Trace roots** — functions decorated ``@jax.jit`` /
+  ``@partial(jax.jit, ...)``; functions passed by name to ``jax.jit`` /
+  ``jax.vmap`` / ``jax.grad`` / ``jax.lax.while_loop`` / ``scan`` /
+  ``cond`` / ``fori_loop`` etc.; and, for the build-then-jit idiom
+  (``jax.jit(self._build_step())``), the functions *returned by* the
+  called builder.
+* **Propagation** — a call inside a reachable function marks its callee
+  reachable when the callee resolves inside the package: lexically nested
+  defs and sibling closures, module top-level functions, ``self.method``
+  within the class, imported package functions
+  (``from agentlib_mpc_tpu.ops.admm import consensus_update``), module
+  aliases (``from agentlib_mpc_tpu.ops import admm as admm_ops``), and —
+  as a deliberate over-approximation — ``<expr>.method()`` calls whose
+  method name is defined by at most :data:`METHOD_FANOUT_CAP` classes
+  package-wide (the ``ocp.trajectories(...)`` pattern, where the receiver
+  type is not statically known).
+
+Resolution is last-definition-wins (Python semantics), taint-free and
+flow-insensitive; cycles are fine (BFS). External roots (``jax``,
+``numpy``, stdlib) never resolve into the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+
+#: jax transforms whose function-valued arguments trace under jit (or are
+#: themselves tracing): positions are which args are trace targets; None
+#: means "every argument"
+_TRACING_CALLS = {
+    "jit": None,
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "jacfwd": (0,),
+    "jacrev": (0,),
+    "hessian": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "custom_jvp": (0,),
+    "custom_vjp": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": None,
+    "associative_scan": (0,),
+}
+
+#: method-name fan-out cap for receiver-unknown attribute calls
+METHOD_FANOUT_CAP = 4
+
+#: generic method names excluded from receiver-unknown fan-out — these
+#: collide with dict/list/socket/threading vocabulary and would drag
+#: runtime classes into the "jit-reachable" set on every ``d.pop(...)``
+_FANOUT_SKIP = {
+    "pop", "get", "put", "update", "append", "clear", "copy", "items",
+    "keys", "values", "send", "broadcast", "reset", "close", "read",
+    "write", "run", "start", "stop", "join", "set", "wait", "notify",
+    "inc", "observe", "record", "add", "remove", "extend", "insert",
+    "setdefault", "publish", "connect", "subscribe",
+}
+
+#: import roots that never resolve into the package
+_EXTERNAL_ROOTS = {
+    "jax", "jnp", "np", "numpy", "lax", "functools", "math", "time",
+    "datetime", "os", "sys", "itertools", "collections", "logging",
+    "threading", "json", "struct", "socket", "random", "re", "dataclasses",
+}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str                     # package-relative posix path
+    qualname: str                   # dotted, no <locals>
+    node: ast.AST                   # FunctionDef/AsyncFunctionDef/Lambda
+    parent: "FunctionInfo | None"
+    cls: "str | None"               # innermost enclosing class name
+    is_root: bool = False
+    #: names of nested defs, for lexical resolution
+    nested: "dict[str, FunctionInfo]" = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class ModuleInfo:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.functions: list[FunctionInfo] = []
+        #: top-level function name -> info (last def wins)
+        self.top_level: dict[str, FunctionInfo] = {}
+        #: (class name, method name) -> info
+        self.methods: dict[tuple, FunctionInfo] = {}
+        #: import alias -> package-relative module path ("ops/admm.py")
+        self.module_aliases: dict[str, str] = {}
+        #: imported name -> (module path, remote name)
+        self.imported: dict[str, tuple] = {}
+        #: module-level simple aliases: name -> name
+        self.name_aliases: dict[str, str] = {}
+        #: names bound from the jax family (jnp, lax, jax, ...)
+        self.jax_names: set[str] = set()
+        #: names bound from numpy
+        self.numpy_names: set[str] = set()
+
+
+def _mod_to_path(dotted: str, package: str) -> "str | None":
+    """'agentlib_mpc_tpu.ops.admm' -> 'ops/admm.py' (None if external)."""
+    if dotted == package:
+        return "__init__.py"
+    prefix = package + "."
+    if not dotted.startswith(prefix):
+        return None
+    return dotted[len(prefix):].replace(".", "/") + ".py"
+
+
+class _Collector(ast.NodeVisitor):
+    """One pass over a module: functions, imports, trace-root marks."""
+
+    def __init__(self, info: ModuleInfo, package: str):
+        self.info = info
+        self.package = package
+        self._func_stack: list[FunctionInfo] = []
+        self._class_stack: list[str] = []
+        #: deferred root requests: (kind, payload)
+        self.root_requests: list[tuple] = []
+
+    # -- imports ---------------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            if alias.name.split(".")[0] == "jax":
+                self.info.jax_names.add(name)
+            if alias.name.split(".")[0] == "numpy":
+                self.info.numpy_names.add(name)
+            path = _mod_to_path(alias.name, self.package)
+            if path is not None:
+                self.info.module_aliases[alias.asname or alias.name] = path
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if node.level:      # relative import — resolve against the package
+            mod = self.package + ("." + mod if mod else "")
+        root = mod.split(".")[0]
+        for alias in node.names:
+            name = alias.asname or alias.name
+            if root == "jax":
+                self.info.jax_names.add(name)
+            if root == "numpy":
+                self.info.numpy_names.add(name)
+            sub = _mod_to_path(f"{mod}.{alias.name}", self.package)
+            if sub is not None:
+                # ``from agentlib_mpc_tpu.ops import admm`` — module alias
+                self.info.module_aliases[name] = sub
+            path = _mod_to_path(mod, self.package)
+            if path is not None:
+                self.info.imported[name] = (path, alias.name)
+
+    # -- scopes ----------------------------------------------------------------
+
+    def _qualname(self, name: str) -> str:
+        parts = []
+        if self._func_stack:
+            parts.append(self._func_stack[-1].qualname)
+        elif self._class_stack:
+            parts.append(".".join(self._class_stack))
+        parts.append(name)
+        return ".".join(parts)
+
+    def _add_function(self, name: str, node) -> FunctionInfo:
+        parent = self._func_stack[-1] if self._func_stack else None
+        qual = self._qualname(name)
+        # duplicate defs (the decorated/wrapper shadow pattern): keep both
+        # infos, disambiguate the qualname of the earlier one is NOT needed
+        # — last-wins resolution matches Python
+        fn = FunctionInfo(module=self.info.path, qualname=qual, node=node,
+                          parent=parent,
+                          cls=self._class_stack[-1] if self._class_stack
+                          else None)
+        self.info.functions.append(fn)
+        if parent is not None:
+            parent.nested[name] = fn
+        elif self._class_stack:
+            self.info.methods[(self._class_stack[-1], name)] = fn
+        else:
+            self.info.top_level[name] = fn
+        return fn
+
+    def _visit_func(self, node, name: str) -> None:
+        fn = self._add_function(name, node)
+        for dec in getattr(node, "decorator_list", []):
+            if self._is_tracing_expr(dec):
+                fn.is_root = True
+        self._func_stack.append(fn)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._func_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_func(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        fn = self._add_function(f"<lambda:{node.lineno}>", node)
+        self._func_stack.append(fn)
+        self.visit(node.body)
+        self._func_stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._class_stack.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # name = lambda ...: treat as a def under that name
+        if isinstance(node.value, ast.Lambda) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            fn = self._add_function(node.targets[0].id, node.value)
+            self._func_stack.append(fn)
+            self.visit(node.value.body)
+            self._func_stack.pop()
+            return
+        # simple alias: name = other_name (module or function scope)
+        if isinstance(node.value, ast.Name) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if not self._func_stack and not self._class_stack:
+                self.info.name_aliases[node.targets[0].id] = node.value.id
+        self.generic_visit(node)
+
+    # -- trace-root detection --------------------------------------------------
+
+    def _jax_attr_name(self, func: ast.AST) -> "str | None":
+        """Terminal attribute name of a call into the jax family
+        (``jax.jit`` -> 'jit', ``jax.lax.while_loop`` -> 'while_loop',
+        bare ``jit``/``vmap`` if imported from jax), else None."""
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and \
+                    root.id in self.info.jax_names | {"jax", "lax"}:
+                return func.attr
+            return None
+        if isinstance(func, ast.Name) and func.id in self.info.jax_names:
+            return func.id
+        return None
+
+    def _is_tracing_expr(self, expr: ast.AST) -> bool:
+        """Decorator forms: jax.jit / jit / partial(jax.jit, ...) /
+        jax.vmap / functools.partial(jax.jit, ...)."""
+        if self._jax_attr_name(expr) in _TRACING_CALLS:
+            return True
+        if isinstance(expr, ast.Call):
+            fname = expr.func
+            is_partial = (isinstance(fname, ast.Name)
+                          and fname.id == "partial") or (
+                isinstance(fname, ast.Attribute)
+                and fname.attr == "partial")
+            if is_partial and expr.args:
+                return self._jax_attr_name(expr.args[0]) in _TRACING_CALLS
+            # jax.jit(fn, static_argnums=...) used as decorator factory
+            return self._jax_attr_name(expr.func) in _TRACING_CALLS
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self._jax_attr_name(node.func)
+        if name in _TRACING_CALLS:
+            positions = _TRACING_CALLS[name]
+            args = node.args if positions is None else [
+                node.args[i] for i in positions if i < len(node.args)]
+            scope = self._func_stack[-1] if self._func_stack else None
+            for arg in args:
+                self.root_requests.append((scope, arg))
+        self.generic_visit(node)
+
+
+class PackageIndex:
+    """All modules of one package + the jit-reachable set."""
+
+    def __init__(self, package: str = "agentlib_mpc_tpu"):
+        self.package = package
+        self.modules: dict[str, ModuleInfo] = {}
+        #: method name -> [FunctionInfo] across every class in the package
+        self.methods_by_name: dict[str, list] = {}
+        self._root_requests: list[tuple] = []
+
+    # -- construction ----------------------------------------------------------
+
+    def add_module(self, path: str, source: str) -> "ModuleInfo | None":
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return None
+        info = ModuleInfo(path, tree, source)
+        collector = _Collector(info, self.package)
+        collector.visit(tree)
+        self.modules[path] = info
+        for fn in info.functions:
+            if fn.cls is not None and fn.parent is None:
+                self.methods_by_name.setdefault(fn.name, []).append(fn)
+        self._root_requests.extend(
+            (info, scope, arg) for scope, arg in collector.root_requests)
+        return info
+
+    # -- resolution ------------------------------------------------------------
+
+    def resolve_name(self, info: ModuleInfo, scope: "FunctionInfo | None",
+                     name: str, _depth: int = 0):
+        """Resolve a bare name to a FunctionInfo: lexical nested defs,
+        module top level, imports, simple aliases."""
+        if _depth > 4:
+            return None
+        s = scope
+        while s is not None:
+            if name in s.nested:
+                return s.nested[name]
+            # sibling closures: the parent's nested defs are visible
+            s = s.parent
+        if name in info.top_level:
+            return info.top_level[name]
+        if name in info.name_aliases and info.name_aliases[name] != name:
+            return self.resolve_name(info, scope, info.name_aliases[name],
+                                     _depth + 1)
+        if name in info.imported:
+            mod_path, remote = info.imported[name]
+            target = self.modules.get(mod_path)
+            if target is not None:
+                if remote in target.top_level:
+                    return target.top_level[remote]
+                # ``from pkg import name`` re-exported via __init__
+                if remote in target.imported:
+                    m2, r2 = target.imported[remote]
+                    t2 = self.modules.get(m2)
+                    if t2 is not None and r2 in t2.top_level:
+                        return t2.top_level[r2]
+        return None
+
+    def resolve_call(self, info: ModuleInfo, scope: "FunctionInfo | None",
+                     func: ast.AST) -> list:
+        """FunctionInfos a call expression may reach (possibly empty)."""
+        if isinstance(func, ast.Name):
+            target = self.resolve_name(info, scope, func.id)
+            return [target] if target is not None else []
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            # self.method() / cls.method()
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and scope is not None:
+                s, cls = scope, None
+                while s is not None and cls is None:
+                    cls, s = s.cls, s.parent
+                if cls is not None:
+                    m = info.methods.get((cls, func.attr))
+                    if m is not None:
+                        return [m]
+            # module_alias.func()
+            if isinstance(base, ast.Name):
+                if base.id in _EXTERNAL_ROOTS or \
+                        base.id in info.jax_names or \
+                        base.id in info.numpy_names:
+                    return []
+                mod_path = info.module_aliases.get(base.id)
+                if mod_path is not None:
+                    target = self.modules.get(mod_path)
+                    if target is not None and \
+                            func.attr in target.top_level:
+                        return [target.top_level[func.attr]]
+            # receiver of unknown type: fan out across same-named methods
+            # when the name is package-rare (the ocp.bounds(...) pattern)
+            if func.attr not in _FANOUT_SKIP:
+                candidates = self.methods_by_name.get(func.attr, [])
+                if 0 < len(candidates) <= METHOD_FANOUT_CAP:
+                    return list(candidates)
+        return []
+
+    # -- reachability ----------------------------------------------------------
+
+    def _returned_functions(self, fn: FunctionInfo) -> list:
+        """Nested functions returned by ``fn`` (the build-then-jit idiom)."""
+        out = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Name):
+                if node.value.id in fn.nested:
+                    out.append(fn.nested[node.value.id])
+        return out
+
+    def compute_reachable(self) -> "set[int]":
+        """ids of FunctionInfos reachable from any trace root."""
+        roots: list[FunctionInfo] = []
+        for info in self.modules.values():
+            roots.extend(f for f in info.functions if f.is_root)
+        # deferred root requests: arguments of tracing calls
+        for info, scope, arg in self._root_requests:
+            if isinstance(arg, (ast.Name,)):
+                t = self.resolve_name(info, scope, arg.id)
+                if t is not None:
+                    roots.append(t)
+            elif isinstance(arg, ast.Lambda):
+                # the collector registered the lambda as a nested def
+                for fn in info.functions:
+                    if fn.node is arg:
+                        roots.append(fn)
+            elif isinstance(arg, ast.Call):
+                # jax.jit(self._build_step()) — root the functions the
+                # builder returns
+                for builder in self.resolve_call(
+                        info, scope, arg.func):
+                    roots.extend(self._returned_functions(builder))
+
+        reachable: set[int] = set()
+        by_id = {}
+        queue = deque()
+        for fn in roots:
+            if id(fn) not in reachable:
+                reachable.add(id(fn))
+                by_id[id(fn)] = fn
+                queue.append(fn)
+        while queue:
+            fn = queue.popleft()
+            info = self.modules[fn.module]
+            for node in ast.walk(fn.node):
+                targets = []
+                if isinstance(node, ast.Call):
+                    targets = self.resolve_call(info, fn, node.func)
+                # a nested def that is itself decorated with a tracer
+                # transform inside a reachable builder is a root already;
+                # plain nested defs only join via calls/returns
+                for t in targets:
+                    if id(t) not in reachable:
+                        reachable.add(id(t))
+                        by_id[id(t)] = t
+                        queue.append(t)
+        self._reachable_infos = [by_id[i] for i in reachable]
+        return reachable
+
+    def reachable_functions(self) -> list:
+        if not hasattr(self, "_reachable_infos"):
+            self.compute_reachable()
+        return list(self._reachable_infos)

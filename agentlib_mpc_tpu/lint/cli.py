@@ -1,0 +1,106 @@
+"""``python -m agentlib_mpc_tpu.lint`` — the CI entry point.
+
+Modes:
+
+* default — run the static passes, compare against ``lint_baseline.json``
+  (repo root), print NEW findings, exit 1 if any. Baselined findings and
+  stale baseline fingerprints are summarized, never fatal.
+* ``--list`` — print every finding including baselined ones.
+* ``--stats`` — JSON findings-per-rule-per-module (the lint-debt trend
+  artifact ``bench.py --emit-metrics`` embeds).
+* ``--write-baseline`` — rewrite the baseline from the current findings
+  (edit the ``justification`` fields afterwards!).
+* ``--retrace-budget`` — run the runtime compile-budget gate against
+  ``lint_budgets.toml`` (imports jax; the static modes never do).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m agentlib_mpc_tpu.lint",
+        description="JIT-hygiene & thread-discipline static analyzer")
+    parser.add_argument("--stats", action="store_true",
+                        help="print findings-per-rule-per-module JSON")
+    parser.add_argument("--list", action="store_true",
+                        help="print every finding, baselined included")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite lint_baseline.json from the "
+                             "current findings")
+    parser.add_argument("--retrace-budget", action="store_true",
+                        help="run the runtime compile-budget gate "
+                             "(lint_budgets.toml)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline path (default: "
+                             "<repo root>/lint_baseline.json)")
+    parser.add_argument("--budgets", default=None,
+                        help="budgets path (default: "
+                             "<repo root>/lint_budgets.toml)")
+    parser.add_argument("--root", default=None,
+                        help="package source root to scan (default: the "
+                             "installed agentlib_mpc_tpu package)")
+    args = parser.parse_args(argv)
+
+    from agentlib_mpc_tpu.lint.findings import Baseline
+    from agentlib_mpc_tpu.lint.runner import (
+        collect_findings,
+        collect_stats,
+        repo_root,
+    )
+
+    if args.retrace_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_gate(budgets)
+        return 1 if report["violations"] else 0
+
+    if args.stats:
+        print(json.dumps(collect_stats(args.root), indent=1))
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        root = repo_root()
+        baseline_path = os.path.join(root or ".", "lint_baseline.json")
+
+    findings = collect_findings(args.root)
+    baseline = Baseline.load(baseline_path)
+
+    if args.write_baseline:
+        baseline.save(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    new, old, stale = baseline.split(findings)
+    if args.list:
+        for f in findings:
+            mark = " [baselined]" if f.fingerprint in baseline.entries \
+                else ""
+            print(f.render() + mark)
+    else:
+        for f in new:
+            print(f.render())
+    if old:
+        print(f"note: {len(old)} baselined finding(s) "
+              f"(see lint_baseline.json)", file=sys.stderr)
+    if stale:
+        print(f"note: {len(stale)} stale baseline fingerprint(s) — the "
+              f"debt was paid, prune them with --write-baseline: "
+              f"{', '.join(stale[:5])}{'…' if len(stale) > 5 else ''}",
+              file=sys.stderr)
+    if new:
+        print(f"FAILED: {len(new)} new lint finding(s) — fix them or "
+              f"baseline with a justification "
+              f"(docs/static_analysis.md)", file=sys.stderr)
+        return 1
+    print(f"lint OK: 0 new findings "
+          f"({len(old)} baselined, {len(stale)} stale)", file=sys.stderr)
+    return 0

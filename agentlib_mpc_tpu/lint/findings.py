@@ -1,0 +1,196 @@
+"""Finding records, stable fingerprints, suppressions and the baseline.
+
+Fingerprints must survive unrelated edits (line shifts, neighbouring
+functions) or the baseline churns into noise: they hash the *identity* of
+a finding — rule, file, enclosing qualname and the normalized source of
+the flagged statement — never the line number. Two identical statements
+in one function disambiguate by occurrence index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import re
+import tokenize
+
+#: suppression comment: ``# lint: ignore[rule-a,rule-b]`` or bare
+#: ``# lint: ignore`` (suppresses every rule on that statement)
+_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+#: caller-holds-lock contract: ``# lint: holds[self._lock]``
+_HOLDS_RE = re.compile(r"#\s*lint:\s*holds\[([^\]]+)\]")
+#: field guard annotation: ``# guarded-by: self._lock``
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+#: marks a lock under which callback (de)registration must never run
+_DISPATCH_RE = re.compile(r"#\s*lint:\s*dispatch-lock")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str            # e.g. "jit-host-sync"
+    path: str            # package-relative posix path
+    line: int            # 1-based, for display only (not fingerprinted)
+    qualname: str        # module-level qualified name of enclosing scope
+    message: str
+    snippet: str = ""    # normalized source of the flagged statement
+    occurrence: int = 0  # index among identical (rule, qualname, snippet)
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.rule, self.path, self.qualname,
+                           self.snippet, self.occurrence)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}"
+                f"[{self.fingerprint}] {self.message} (in {self.qualname})")
+
+
+def fingerprint(rule: str, path: str, qualname: str, snippet: str,
+                occurrence: int = 0) -> str:
+    norm = re.sub(r"\s+", " ", snippet).strip()
+    key = "\x1f".join([rule, path, qualname, norm, str(occurrence)])
+    return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+
+def number_occurrences(findings: "list[Finding]") -> "list[Finding]":
+    """Assign occurrence indices so identical statements in one scope get
+    distinct fingerprints (stable under reordering of OTHER lines because
+    numbering follows source order within the duplicate set only)."""
+    seen: dict[tuple, int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        key = (f.rule, f.path, f.qualname,
+               re.sub(r"\s+", " ", f.snippet).strip())
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        out.append(dataclasses.replace(f, occurrence=n))
+    return out
+
+
+class SourceAnnotations:
+    """Comment-layer facts of one file: suppressions, holds-contracts,
+    guarded-by declarations, dispatch-lock marks. Keyed by line number."""
+
+    def __init__(self, source: str):
+        #: line -> (rules-or-None, inline?); inline comments bind to their
+        #: own line, standalone comments to the line BELOW them
+        self.ignores: dict[int, tuple] = {}
+        self.holds: dict[int, str] = {}
+        self.guarded: dict[int, tuple] = {}
+        self.dispatch_locks: dict[int, bool] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                inline = bool(tok.line[:tok.start[1]].strip())
+                m = _IGNORE_RE.search(tok.string)
+                if m:
+                    rules = m.group(1)
+                    self.ignores[line] = (
+                        None if rules is None else
+                        {r.strip() for r in rules.split(",") if r.strip()},
+                        inline)
+                m = _HOLDS_RE.search(tok.string)
+                if m:
+                    self.holds[line] = m.group(1).strip()
+                m = _GUARDED_RE.search(tok.string)
+                if m:
+                    self.guarded[line] = (m.group(1).strip(), inline)
+                if _DISPATCH_RE.search(tok.string):
+                    self.dispatch_locks[line] = inline
+        except tokenize.TokenizeError:
+            pass
+
+    def guard_at(self, line: int) -> "str | None":
+        """Lock annotation binding to code at ``line``: an inline comment
+        on that line, or a standalone comment on the line above."""
+        got = self.guarded.get(line)
+        if got is not None and got[1]:
+            return got[0]
+        above = self.guarded.get(line - 1)
+        if above is not None and not above[1]:
+            return above[0]
+        return None
+
+    def dispatch_at(self, line: int) -> bool:
+        if self.dispatch_locks.get(line) is True:
+            return True
+        return self.dispatch_locks.get(line - 1) is False
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when the statement starting at ``line`` is covered by an
+        ignore: inline on the same line, or standalone directly above."""
+        for at, want_inline in ((line, True), (line - 1, False)):
+            got = self.ignores.get(at)
+            if got is None:
+                continue
+            rules, inline = got
+            if inline is not want_inline:
+                continue
+            if rules is None or rule in rules:
+                return True
+        return False
+
+
+class Baseline:
+    """Checked-in ledger of pre-existing findings.
+
+    ``lint_baseline.json`` maps fingerprint -> {"rule", "path",
+    "qualname", "justification"}. A finding whose fingerprint is present
+    is reported as baselined (never fails the run); fingerprints with no
+    matching finding any more are reported as stale so the ledger shrinks
+    as debt is paid down.
+    """
+
+    def __init__(self, entries: "dict[str, dict] | None" = None):
+        self.entries: dict[str, dict] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except FileNotFoundError:
+            return cls()
+        return cls(data.get("findings", {}))
+
+    def save(self, path, findings: "list[Finding]",
+             justification: str = "baselined by --write-baseline") -> None:
+        merged = {}
+        for f in findings:
+            prev = self.entries.get(f.fingerprint, {})
+            merged[f.fingerprint] = {
+                "rule": f.rule,
+                "path": f.path,
+                "qualname": f.qualname,
+                "message": f.message,
+                "justification": prev.get("justification", justification),
+            }
+        payload = {
+            "_comment": ("pre-existing lint debt; new findings fail CI. "
+                         "Regenerate with python -m agentlib_mpc_tpu.lint "
+                         "--write-baseline, then EDIT the justification "
+                         "fields — an unjustified entry is a review smell."),
+            "findings": dict(sorted(merged.items())),
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+
+    def split(self, findings: "list[Finding]"):
+        """(new, baselined, stale_fingerprints)."""
+        new, old = [], []
+        seen = set()
+        for f in findings:
+            fp = f.fingerprint
+            if fp in self.entries:
+                old.append(f)
+                seen.add(fp)
+            else:
+                new.append(f)
+        stale = sorted(set(self.entries) - seen)
+        return new, old, stale

@@ -1,0 +1,435 @@
+"""JIT-hygiene passes: host syncs, tracer branches, wall-clock reads,
+weak-typed state literals, non-hashable static args.
+
+Taint model (documented recall/precision trade): a value is
+*tracer-tainted* when it is produced by a call into the jax family
+(``jnp.*``, ``lax.*``, ``jax.*`` and names imported from them) or derived
+from a tainted value. Function parameters are NOT tainted — jitted helpers
+routinely branch on static Python options at trace time
+(``if opts.corrector:``), and flagging every parameter branch would bury
+the real findings. Static attribute reads (``.shape``, ``.ndim``,
+``.dtype``, ``.size``) launder taint: branching on a shape is trace-time
+Python, not a device sync.
+
+Rules (fired only inside jit-reachable functions, except jit-weak-type
+which fires only OUTSIDE them — see its docstring):
+
+* ``jit-host-sync`` — ``print(...)``, ``.item()``/``.tolist()`` on any
+  receiver, ``float``/``int``/``bool`` on a tainted value, ``np.*(...)``
+  with a tainted argument. Each of these forces a device→host transfer
+  per call (~64 ms of dispatch on the TPU path) or bakes a traced value
+  into a Python constant.
+* ``jit-tracer-branch`` — Python ``if``/``while``/ternary/``assert`` on a
+  tainted test: under trace this calls ``__bool__`` on a tracer
+  (ConcretizationTypeError at best, silent per-call recompile via
+  implicit ``jnp.ndarray.__bool__`` sync at worst). Use ``lax.cond`` /
+  ``jnp.where``.
+* ``jit-wall-clock`` — argless ``time.time()`` / ``time.perf_counter()``
+  / ``datetime.now()`` inside traced code: evaluated ONCE at trace time
+  and baked into the program as a constant — a silent logic bug.
+* ``jit-static-args`` — ``static_argnums``/``static_argnames`` marking a
+  parameter whose default is a list/dict/set literal: non-hashable
+  statics raise at dispatch, and every distinct value recompiles.
+* ``jit-weak-type`` — in *eager* state-constructing functions (the code
+  that builds carry pytrees fed INTO a jit): ``jnp.full``/``jnp.array``/
+  ``jnp.asarray`` of a bare Python scalar without ``dtype=``, or a raw
+  numeric literal passed straight into a ``*State(...)`` constructor /
+  ``state._replace(...)``. Weak-typed leaves make the second call's
+  avals differ from the first's and the whole program retraces — the
+  exact fused-ADMM ``init_state`` z/rho bug this rule exists to pin.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from agentlib_mpc_tpu.lint.callgraph import FunctionInfo, PackageIndex
+from agentlib_mpc_tpu.lint.findings import Finding
+
+#: attribute reads that launder taint (static under trace)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding",
+                 "itemsize", "at"}
+#: builtins that force a host sync when applied to a tracer
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+#: method calls that force a host sync on any array receiver
+_SYNC_METHODS = {"item", "tolist", "to_py"}
+#: wall-clock reads that trace to a constant
+_CLOCK_CALLS = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("time", "time_ns"), ("time", "monotonic_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+}
+#: jnp constructors that yield weak-typed arrays from bare scalars
+_WEAK_CONSTRUCTORS = {"full", "array", "asarray", "full_like"}
+
+#: jax-family calls that return HOST values (introspection, dtype meta),
+#: not tracers — they must not taint
+_JAX_HOST_CALLS = {
+    "default_backend", "devices", "local_devices", "device_count",
+    "local_device_count", "process_index", "process_count", "finfo",
+    "iinfo", "result_type", "promote_types", "issubdtype", "dtype",
+    "named_scope", "default_matmul_precision", "disable_jit",
+    "make_mesh", "tree_structure", "eval_shape",
+}
+
+
+def _func_root(expr: ast.AST) -> "ast.Name | None":
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr if isinstance(expr, ast.Name) else None
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                     (int, float)) \
+            and not isinstance(node.value, bool):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return _is_numeric_literal(node.operand)
+    return False
+
+
+class _Taint:
+    """Per-function forward taint over local names (two passes so names
+    assigned after first use in loops still settle)."""
+
+    def __init__(self, fn: FunctionInfo, jax_names: "set[str]"):
+        self.jax_names = jax_names
+        self.tainted: set[str] = set()
+        body = getattr(fn.node, "body", fn.node)
+        stmts = body if isinstance(body, list) else [body]
+        for _ in range(2):
+            for stmt in stmts:
+                self._scan(stmt, top=fn.node)
+
+    def _scan(self, node: ast.AST, top: ast.AST) -> None:
+        for child in ast.walk(node):
+            # do not descend into nested function bodies: they have their
+            # own analysis (ast.walk does descend; accept the
+            # over-approximation — closure vars genuinely flow in)
+            if isinstance(child, ast.Assign):
+                if self.is_tainted(child.value):
+                    for tgt in child.targets:
+                        self._taint_target(tgt)
+            elif isinstance(child, ast.AugAssign):
+                if self.is_tainted(child.value) or \
+                        self.is_tainted(child.target):
+                    self._taint_target(child.target)
+            elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                if self.is_tainted(child.value):
+                    self._taint_target(child.target)
+            elif isinstance(child, ast.For):
+                if self.is_tainted(child.iter):
+                    self._taint_target(child.target)
+            elif isinstance(child, ast.withitem):
+                if child.optional_vars is not None and \
+                        self.is_tainted(child.context_expr):
+                    self._taint_target(child.optional_vars)
+            elif isinstance(child, (ast.NamedExpr,)):
+                if self.is_tainted(child.value):
+                    self._taint_target(child.target)
+
+    def _taint_target(self, tgt: ast.AST) -> None:
+        if isinstance(tgt, ast.Name):
+            self.tainted.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._taint_target(el)
+        elif isinstance(tgt, ast.Starred):
+            self._taint_target(tgt.value)
+
+    def is_tainted(self, expr: ast.AST) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False            # .shape/.ndim/... launder taint
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Call):
+            root = _func_root(expr.func)
+            if root is not None and root.id in self.jax_names:
+                term = expr.func.attr \
+                    if isinstance(expr.func, ast.Attribute) else root.id
+                if term in _JAX_HOST_CALLS:
+                    return False
+                # jnp.*/lax.*/jax.* call: result is (or closes over) a
+                # traced array
+                return True
+            if isinstance(expr.func, ast.Name) and \
+                    expr.func.id in ("len", "isinstance", "hasattr",
+                                     "getattr", "type", "range"):
+                return False            # static-by-construction
+            return any(self.is_tainted(a) for a in expr.args) or \
+                any(self.is_tainted(k.value) for k in expr.keywords)
+        if isinstance(expr, ast.BinOp):
+            return self.is_tainted(expr.left) or self.is_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_tainted(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            # identity tests are trace-time Python, never a tracer
+            # __bool__ (`if du is None:` is the idiomatic default-arg
+            # pattern inside jitted helpers)
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return False
+            return self.is_tainted(expr.left) or \
+                any(self.is_tainted(c) for c in expr.comparators)
+        if isinstance(expr, ast.Subscript):
+            return self.is_tainted(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return any(self.is_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return self.is_tainted(expr.body) or \
+                self.is_tainted(expr.orelse)
+        if isinstance(expr, ast.Starred):
+            return self.is_tainted(expr.value)
+        return False
+
+
+def _snippet(info, node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:       # pragma: no cover - unparse is total on 3.10
+        return ast.dump(node)
+
+
+def _own_nodes(fn: FunctionInfo):
+    """Walk fn's body without descending into nested function defs (those
+    are separate FunctionInfos and analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def run(index: PackageIndex, scope_dirs: "tuple[str, ...] | None" = (
+        "ops", "backends", "parallel", "resilience", "ml", "models",
+        "modules"),
+        ) -> "list[Finding]":
+    findings: list[Finding] = []
+    reachable_ids = index.compute_reachable()
+
+    def in_scope(path: str) -> bool:
+        if scope_dirs is None or "/" not in path:
+            return True         # top-level modules are always in scope
+        return any(path.startswith(d + "/") for d in scope_dirs)
+
+    for info in index.modules.values():
+        if not in_scope(info.path):
+            continue
+        jaxish = info.jax_names | {"jax", "jnp", "lax"}
+        np_names = info.numpy_names | {"np", "numpy"}
+        for fn in info.functions:
+            if id(fn) in reachable_ids:
+                findings.extend(_check_traced_function(
+                    info, fn, jaxish, np_names))
+            else:
+                findings.extend(_check_weak_type(info, fn, jaxish))
+        findings.extend(_check_static_args(info))
+    return findings
+
+
+def _check_traced_function(info, fn: FunctionInfo, jaxish, np_names):
+    out = []
+    taint = _Taint(fn, jaxish)
+
+    def emit(rule, node, message):
+        out.append(Finding(
+            rule=rule, path=info.path, line=node.lineno,
+            qualname=fn.qualname, message=message,
+            snippet=_snippet(info, node)))
+
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            # print(...) in traced code
+            if isinstance(func, ast.Name) and func.id == "print":
+                emit("jit-host-sync", node,
+                     "print() inside jit-reachable code runs at trace "
+                     "time only (or syncs if it formats a tracer) — use "
+                     "jax.debug.print")
+            # .item()/.tolist()
+            elif isinstance(func, ast.Attribute) and \
+                    func.attr in _SYNC_METHODS:
+                emit("jit-host-sync", node,
+                     f".{func.attr}() forces a device->host sync inside "
+                     f"jit-reachable code")
+            # float/int/bool on tainted
+            elif isinstance(func, ast.Name) and \
+                    func.id in _SYNC_BUILTINS and (
+                        any(taint.is_tainted(a) for a in node.args)):
+                emit("jit-host-sync", node,
+                     f"{func.id}() on a traced value concretizes the "
+                     f"tracer (host sync / ConcretizationTypeError)")
+            # np.* on tainted
+            else:
+                root = _func_root(func)
+                if root is not None and root.id in np_names and (
+                        any(taint.is_tainted(a) for a in node.args) or
+                        any(taint.is_tainted(k.value)
+                            for k in node.keywords)):
+                    emit("jit-host-sync", node,
+                         "numpy call on a traced value pulls it to host "
+                         "— use jnp inside jit-reachable code")
+                # wall-clock reads
+                if isinstance(func, ast.Attribute) and not node.args:
+                    base = _func_root(func)
+                    if base is not None and \
+                            (base.id, func.attr) in _CLOCK_CALLS:
+                        emit("jit-wall-clock", node,
+                             f"{base.id}.{func.attr}() in jit-reachable "
+                             f"code is evaluated once at trace time and "
+                             f"baked in as a constant")
+        elif isinstance(node, (ast.If, ast.While)) and \
+                taint.is_tainted(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            emit("jit-tracer-branch", node.test,
+                 f"Python `{kind}` on a traced value calls "
+                 f"__bool__ on a tracer — use lax.cond/jnp.where "
+                 f"(or lax.while_loop)")
+        elif isinstance(node, ast.IfExp) and taint.is_tainted(node.test):
+            emit("jit-tracer-branch", node.test,
+                 "ternary on a traced value calls __bool__ on a tracer "
+                 "— use jnp.where")
+        elif isinstance(node, ast.Assert) and taint.is_tainted(node.test):
+            emit("jit-tracer-branch", node.test,
+                 "assert on a traced value syncs (or is traced away "
+                 "under -O) — use checkify or debug.check")
+    return out
+
+
+def _constructs_state(fn: FunctionInfo):
+    """Calls to ``*State(...)`` constructors / ``state._replace`` in fn."""
+    ctor_calls, replace_calls = [], []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name is not None and name.endswith("State") and \
+                name != "State" and name[0].isupper():
+            ctor_calls.append(node)
+        if isinstance(func, ast.Attribute) and func.attr == "_replace":
+            recv = func.value
+            if isinstance(recv, ast.Name) and \
+                    "state" in recv.id.lower():
+                replace_calls.append(node)
+    return ctor_calls, replace_calls
+
+
+def _check_weak_type(info, fn: FunctionInfo, jaxish):
+    """Weak-type hazards in EAGER state constructors only: inside a jit
+    trace, weak literals unify during tracing and are harmless; it is the
+    host-built carry fed INTO the jit whose avals must be stable."""
+    ctor_calls, replace_calls = _constructs_state(fn)
+    if not ctor_calls and not replace_calls:
+        return []
+    out = []
+
+    def emit(node, message):
+        out.append(Finding(
+            rule="jit-weak-type", path=info.path, line=node.lineno,
+            qualname=fn.qualname, message=message,
+            snippet=_snippet(info, node)))
+
+    # (a) weak jnp constructions anywhere in the state-building function
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        root = _func_root(node.func)
+        if root is None or root.id not in jaxish:
+            continue
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _WEAK_CONSTRUCTORS:
+            continue
+        has_dtype = any(k.arg == "dtype" for k in node.keywords)
+        if has_dtype:
+            continue
+        # the scalar payload: full(shape, v) -> args[1]; array/asarray(v)
+        # -> args[0]; full_like(x, v) -> args[1]
+        payload_idx = 1 if node.func.attr in ("full", "full_like") else 0
+        if len(node.args) > payload_idx and \
+                _is_numeric_literal(node.args[payload_idx]):
+            emit(node,
+                 f"jnp.{node.func.attr} of a bare Python scalar without "
+                 f"dtype= builds a WEAK-typed leaf; carried through a jit "
+                 f"boundary it changes avals on the second call and "
+                 f"retraces the whole program (the PR 2 init_state bug)")
+    # (b) raw scalar literals placed directly into the state pytree
+    for call in ctor_calls + replace_calls:
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            if _is_numeric_literal(arg):
+                emit(call,
+                     "bare Python scalar stored into a carried state "
+                     "pytree is weak-typed — wrap in "
+                     "jnp.asarray(..., dtype=...)")
+                break
+    return out
+
+
+def _check_static_args(info):
+    """Non-hashable defaults on parameters marked static in a jit."""
+    out = []
+    for fn in info.functions:
+        node = fn.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        static_params = set()
+        for dec in node.decorator_list:
+            static_params |= _static_params_of(dec, node)
+        if not static_params:
+            continue
+        args = node.args
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) + \
+            list(args.defaults)
+        for name, default in zip([a.arg for a in pos], defaults):
+            if name in static_params and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)):
+                out.append(Finding(
+                    rule="jit-static-args", path=info.path,
+                    line=node.lineno, qualname=fn.qualname,
+                    message=(f"static arg {name!r} has a non-hashable "
+                             f"{type(default).__name__.lower()} default — "
+                             f"jit statics must be hashable; every "
+                             f"distinct value also recompiles"),
+                    snippet=f"def {node.name}({name}=...)"))
+    return out
+
+
+def _static_params_of(dec: ast.AST, func_node) -> "set[str]":
+    """Parameter names marked static by a jit decorator expression."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    # jax.jit(...) or partial(jax.jit, ...)
+    keywords = dec.keywords
+    names: set[str] = set()
+    pos = func_node.args.posonlyargs + func_node.args.args
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            for el in getattr(kw.value, "elts", [kw.value]):
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    names.add(el.value)
+        elif kw.arg == "static_argnums":
+            elts = getattr(kw.value, "elts", [kw.value])
+            for el in elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int) and \
+                        el.value < len(pos):
+                    names.add(pos[el.value].arg)
+    return names

@@ -1,0 +1,210 @@
+"""Retrace-budget gate: the runtime complement of the static passes.
+
+Static analysis catches the *shape* of compile-cache bugs; this gate
+catches their *effect*: it builds the 4-agent fused-ADMM consensus fleet
+(the bench workload), runs ``step()`` for ``warmup_rounds`` rounds, then
+measures ``rounds`` more with the PR 1 ``jax.monitoring`` hooks
+(:func:`agentlib_mpc_tpu.utils.jax_setup.enable_compile_profiling`)
+installed, and fails when any entry point traces or compiles more than
+``lint_budgets.toml`` allows.  A weak-typed carry leaf, a shape-unstable
+static arg, a host-rebuilt options object — anything that silently
+retraces a warm path — trips this gate even if no static rule names it.
+
+Budgets file (checked in at the repo root)::
+
+    [retrace]
+    warmup_rounds = 2
+    rounds = 3
+    n_agents = 4
+
+    [retrace.budgets]
+    default = 0
+    "admm.fused_step" = 0
+
+``default`` applies to entry points without their own key. Budget = max
+allowed (traces + compiles) DELTA per entry point across the measured
+rounds; 0 is the steady-state contract the whole performance story rests
+on.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def load_budgets(path: "str | None" = None) -> dict:
+    """Parse lint_budgets.toml (tomllib on 3.11+, tomli when present, and
+    a minimal built-in parser for the flat subset this file uses — the
+    image constraint is no new deps, not no config)."""
+    if path is None:
+        from agentlib_mpc_tpu.lint.runner import repo_root
+
+        root = repo_root()
+        path = os.path.join(root or ".", "lint_budgets.toml")
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except FileNotFoundError:
+        return {"retrace": {"warmup_rounds": 2, "rounds": 3, "n_agents": 4,
+                            "budgets": {"default": 0}}}
+    try:
+        import tomllib as toml_mod              # 3.11+
+    except ModuleNotFoundError:
+        try:
+            import tomli as toml_mod            # common in test images
+        except ModuleNotFoundError:
+            toml_mod = None
+    if toml_mod is not None:
+        return toml_mod.loads(raw.decode("utf-8"))
+    return _mini_toml(raw.decode("utf-8"))
+
+
+def _mini_toml(text: str) -> dict:
+    """Tables + string/int/float/bool scalars — the subset
+    lint_budgets.toml uses. Not a general TOML parser."""
+    out: dict = {}
+    table = out
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        m = re.fullmatch(r"\[([^\]]+)\]", line)
+        if m:
+            table = out
+            for part in m.group(1).split("."):
+                table = table.setdefault(part.strip().strip('"'), {})
+            continue
+        if "=" not in line:
+            continue
+        key, val = line.split("=", 1)
+        key = key.strip().strip('"').strip("'")
+        val = val.strip()
+        if val in ("true", "false"):
+            table[key] = val == "true"
+        elif re.fullmatch(r"-?\d+", val):
+            table[key] = int(val)
+        elif re.fullmatch(r"-?\d*\.\d+(e-?\d+)?", val):
+            table[key] = float(val)
+        else:
+            table[key] = val.strip('"').strip("'")
+    return out
+
+
+def build_bench_engine(n_agents: int = 4):
+    """The gate's workload: one consensus group of ``n_agents`` trackers
+    (min (u - a)^2 coupled on a shared control) — small enough to compile
+    in seconds on CPU, structurally identical to the 4-agent bench step.
+    Returns (engine, state, theta_batches)."""
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.models.model import Model, ModelEquations
+    from agentlib_mpc_tpu.models.objective import SubObjective
+    from agentlib_mpc_tpu.models.variables import control_input, parameter
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+    from agentlib_mpc_tpu.parallel.fused_admm import (
+        AgentGroup,
+        FusedADMM,
+        FusedADMMOptions,
+        stack_params,
+    )
+
+    class _Tracker(Model):
+        inputs = [control_input("u", 0.0, lb=-5.0, ub=5.0)]
+        parameters = [parameter("a", 1.0)]
+
+        def setup(self, v):
+            eq = ModelEquations()
+            eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
+            return eq
+
+    ocp = transcribe(_Tracker(), ["u"], N=4, dt=0.5,
+                     method="multiple_shooting")
+    group = AgentGroup(
+        name="retrace-gate", ocp=ocp, n_agents=n_agents,
+        couplings={"shared_u": "u"},
+        solver_options=SolverOptions(max_iter=30))
+    engine = FusedADMM([group], FusedADMMOptions(max_iterations=8, rho=2.0))
+    thetas = stack_params([
+        ocp.default_params(p=jnp.array([float(i + 1)]))
+        for i in range(n_agents)])
+    state = engine.init_state([thetas])
+    return engine, state, [thetas]
+
+
+def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
+    """Run the gate; returns a report dict with ``violations``.
+
+    Steps alternate ``shift_state`` between rounds the way the production
+    control loop does — state *values* change every round while avals
+    must not, which is precisely the regression surface (weak types,
+    dtype drift) this gate pins.
+    """
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    cfg = (budgets or load_budgets()).get("retrace", {})
+    warmup = int(cfg.get("warmup_rounds", 2))
+    rounds = int(cfg.get("rounds", 3))
+    n_agents = int(cfg.get("n_agents", 4))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+
+    def snapshot() -> dict:
+        totals: dict = {}
+        for name in ("jax_traces_total", "jax_compiles_total"):
+            for sample in reg.counter(name).samples():
+                entry = sample["labels"].get("entry_point", "(unscoped)")
+                totals[entry] = totals.get(entry, 0) + int(sample["value"])
+        return totals
+
+    try:
+        engine, state, thetas = build_bench_engine(n_agents)
+        for _ in range(max(warmup, 1)):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+
+        before = snapshot()
+        for _ in range(rounds):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+        after = snapshot()
+    finally:
+        # the gate must not leave process-global telemetry flipped on for
+        # whoever embeds it (the pytest run, a bench process)
+        telemetry.configure(enabled=was_enabled)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({
+                "entry_point": entry,
+                "observed": delta,
+                "budget": budget,
+            })
+    report = {
+        "warmup_rounds": warmup,
+        "rounds": rounds,
+        "n_agents": n_agents,
+        "deltas": dict(sorted(deltas.items())),
+        "violations": violations,
+    }
+    if verbose:
+        for v in violations:
+            print(f"retrace-budget: {v['entry_point']!r} compiled/traced "
+                  f"{v['observed']}x in {rounds} post-warmup rounds "
+                  f"(budget {v['budget']}) — a warm path is recompiling")
+        if not violations:
+            print(f"retrace-budget: OK — zero excess compiles across "
+                  f"{rounds} rounds ({n_agents} agents)")
+    return report

@@ -1,0 +1,115 @@
+"""Orchestration: walk the package, run every static pass, apply
+suppressions, number duplicate fingerprints, split against the baseline."""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+from agentlib_mpc_tpu.lint import jit_hygiene, thread_discipline
+from agentlib_mpc_tpu.lint.callgraph import PackageIndex
+from agentlib_mpc_tpu.lint.findings import (
+    Finding,
+    SourceAnnotations,
+    number_occurrences,
+)
+
+#: directories (package-relative) the jit-hygiene passes cover — the
+#: jit-bearing subsystems (ISSUE scope: ops/backends/parallel/resilience,
+#: widened to every dir whose functions are traced into an OCP); the
+#: thread-discipline pass self-scopes via annotations and runs everywhere
+JIT_SCOPE = ("ops", "backends", "parallel", "resilience", "ml", "models",
+             "modules")
+
+
+def package_root() -> str:
+    import agentlib_mpc_tpu
+
+    return os.path.dirname(os.path.abspath(agentlib_mpc_tpu.__file__))
+
+
+def repo_root() -> "str | None":
+    """Checkout root (parent of the package holding pyproject.toml), or
+    None for an installed site-packages tree."""
+    root = os.path.dirname(package_root())
+    if os.path.isfile(os.path.join(root, "pyproject.toml")):
+        return root
+    return None
+
+
+def _walk_sources(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                yield rel, full
+
+
+def build_index(root: "str | None" = None,
+                extra_files: "dict[str, str] | None" = None
+                ) -> PackageIndex:
+    """Parse every package module (plus ``extra_files``: relpath ->
+    source, used by the golden-file tests) into one index."""
+    index = PackageIndex()
+    if root is None:
+        root = package_root()
+    for rel, full in _walk_sources(root):
+        try:
+            with open(full, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        index.add_module(rel, source)
+    for rel, source in (extra_files or {}).items():
+        index.add_module(rel, source)
+    return index
+
+
+def collect_findings(root: "str | None" = None,
+                     extra_files: "dict[str, str] | None" = None,
+                     jit_scope: "tuple[str, ...] | None" = JIT_SCOPE,
+                     ) -> "list[Finding]":
+    """``jit_scope=None`` scans every module (the golden-file fixture
+    tests point ``root`` at a directory of bad snippets)."""
+    index = build_index(root, extra_files)
+    scope = None if jit_scope is None else tuple(jit_scope)
+    if extra_files and scope is not None:
+        # golden-file fixtures live outside the package layout: put their
+        # top-level dirs in scope too
+        scope = scope + tuple({rel.split("/")[0] for rel in extra_files})
+    findings = list(jit_hygiene.run(index, scope_dirs=scope))
+    for info in index.modules.values():
+        findings.extend(thread_discipline.run_module(
+            info.path, info.tree, info.source))
+    # suppression comments apply to every rule (annotations tokenized
+    # once per file, not once per finding)
+    ann_cache: dict[str, SourceAnnotations] = {}
+    out = []
+    for f in findings:
+        if f.path in index.modules:
+            ann = ann_cache.get(f.path)
+            if ann is None:
+                ann = SourceAnnotations(index.modules[f.path].source)
+                ann_cache[f.path] = ann
+            if ann.suppressed(f.rule, f.line):
+                continue
+        out.append(f)
+    return number_occurrences(out)
+
+
+def collect_stats(root: "str | None" = None) -> dict:
+    """Findings per rule per module — the lint-debt trend line that rides
+    along in ``bench.py --emit-metrics`` artifacts."""
+    findings = collect_findings(root)
+    per_rule: Counter = Counter(f.rule for f in findings)
+    per_module: dict = {}
+    for f in findings:
+        per_module.setdefault(f.path, Counter())[f.rule] += 1
+    return {
+        "total": len(findings),
+        "per_rule": dict(sorted(per_rule.items())),
+        "per_module": {m: dict(sorted(c.items()))
+                       for m, c in sorted(per_module.items())},
+    }

@@ -1,0 +1,278 @@
+"""Thread-discipline passes: guarded-field mutations and dispatch-lock
+reentry.
+
+Annotations (comment layer, parsed by
+:class:`~agentlib_mpc_tpu.lint.findings.SourceAnnotations`):
+
+* ``# guarded-by: self._lock`` on a field declaration (class-body
+  ``field: T = ...`` line or the ``self.field = ...`` line in
+  ``__init__``; the line above also binds). Every *mutation* of that
+  field — plain/augmented assignment, subscript store/delete, or a
+  mutator-method call (``append``/``pop``/``clear``/``update``/...) —
+  must sit lexically inside a ``with <lock>:`` block in the enclosing
+  function.  ``__init__`` is exempt (construction happens-before
+  publication).  Functions only ever called with the lock held declare
+  the contract with ``# lint: holds[self._lock]`` in their body.
+* ``# lint: dispatch-lock`` on a lock field marks the broker
+  dispatch-lock: calls to ``register_callback`` / ``deregister_callback``
+  while that lock is held are flagged (``guard-dispatch-reentry``) — the
+  deadlock shape where a callback fired under the dispatch lock tries to
+  (de)register and the non-reentrant lock self-deadlocks, or the
+  registration list mutates under the iterating dispatcher.
+
+Scope notes, deliberately conservative: only *direct* container
+mutations are checked (``self.field[...] = x`` yes,
+``self.field[k].attr = x`` no — the latter mutates the contained object,
+whose own discipline is its own class's business). Reads are not
+checked: the project idiom is copy-under-lock then act outside it, and a
+read pass would flag exactly those correct snapshot reads. Cross-object
+mutations (``link.status = ...`` where ``status`` is guarded in class
+``NeighborLink`` of the same module) are checked against the annotation
+with ``self`` rewritten to the receiver (``with link._cv``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from agentlib_mpc_tpu.lint.findings import Finding, SourceAnnotations
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "add", "discard", "update", "setdefault", "sort", "reverse",
+    "popitem",
+}
+
+_REGISTRATION_CALLS = {"register_callback", "deregister_callback"}
+
+
+def _norm(text: str) -> str:
+    return "".join(text.split())
+
+
+class _FieldGuards:
+    """Per-module: guarded fields and dispatch locks, from annotations."""
+
+    def __init__(self, tree: ast.Module, ann: SourceAnnotations):
+        #: (class name, field name) -> lock expression text ("self._lock")
+        self.guards: dict[tuple, str] = {}
+        #: field name -> [(class, lock)] for cross-object checks
+        self.by_field: dict[str, list] = {}
+        #: lock field names marked as dispatch locks, with class
+        self.dispatch: set[tuple] = set()
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for node in ast.walk(cls):
+                field = None
+                if isinstance(node, ast.AnnAssign) and \
+                        isinstance(node.target, ast.Name):
+                    field = node.target.id
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        field = tgt.id
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        field = tgt.attr
+                if field is None:
+                    continue
+                lock = ann.guard_at(node.lineno)
+                if lock is not None:
+                    self.guards[(cls.name, field)] = lock
+                    self.by_field.setdefault(field, []).append(
+                        (cls.name, lock))
+                if ann.dispatch_at(node.lineno):
+                    self.dispatch.add((cls.name, field))
+
+
+def _holds_for(fn_node, ann: SourceAnnotations,
+               nested_spans: list) -> "set[str]":
+    """holds[...] contracts declared inside fn (not in nested defs)."""
+    out = set()
+    for line, lock in ann.holds.items():
+        if fn_node.lineno <= line <= (fn_node.end_lineno or fn_node.lineno):
+            if any(lo <= line <= hi for lo, hi in nested_spans):
+                continue
+            out.add(_norm(lock))
+    return out
+
+
+def run_module(path: str, tree: ast.Module, source: str) -> "list[Finding]":
+    ann = SourceAnnotations(source)
+    guards = _FieldGuards(tree, ann)
+    if not guards.guards and not guards.dispatch:
+        return []
+    findings: list[Finding] = []
+
+    class_of_func: dict[int, str] = {}
+    funcs: list = []
+
+    def collect(node, cls=None, qual=""):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect(child, cls=child.name,
+                        qual=f"{qual}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                funcs.append((child, cls, f"{qual}{child.name}"))
+                class_of_func[id(child)] = cls
+                collect(child, cls=cls, qual=f"{qual}{child.name}.")
+
+    collect(tree)
+
+    for fn_node, cls, qual in funcs:
+        nested_spans = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(fn_node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn_node]
+        held_contracts = _holds_for(fn_node, ann, nested_spans)
+        _check_function(path, fn_node, cls, qual, guards, ann,
+                        held_contracts, findings)
+    return findings
+
+
+def _receiver_and_field(expr: ast.AST) -> "tuple[str, str] | None":
+    """('self'|receiver-src, field) when expr is a direct field access."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name):
+        return expr.value.id, expr.attr
+    return None
+
+
+def _mutations_in(stmt: ast.AST):
+    """(node, receiver, field) direct-mutation triples in one statement
+    (not descending into nested defs — caller guarantees)."""
+    out = []
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        if isinstance(node, ast.Delete):
+            return node.targets
+        return []
+
+    for node in ast.walk(stmt):
+        for tgt in targets_of(node):
+            rf = _receiver_and_field(tgt)
+            if rf is not None:
+                out.append((node, *rf))
+            elif isinstance(tgt, ast.Subscript):
+                rf = _receiver_and_field(tgt.value)
+                if rf is not None:
+                    out.append((node, *rf))
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    rf = _receiver_and_field(el)
+                    if rf is not None:
+                        out.append((node, *rf))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            rf = _receiver_and_field(node.func.value)
+            if rf is not None:
+                out.append((node, *rf))
+    return out
+
+
+def _check_function(path, fn_node, cls, qual, guards: _FieldGuards, ann,
+                    held_contracts, findings) -> None:
+    is_init = fn_node.name in ("__init__", "__post_init__")
+
+    def lock_for(receiver: str, field: str) -> "str | None":
+        if receiver == "self" and cls is not None:
+            return guards.guards.get((cls, field))
+        if receiver != "self":
+            cands = guards.by_field.get(field, [])
+            if len(cands) == 1:
+                _cls, lock = cands[0]
+                return lock.replace("self.", f"{receiver}.", 1) \
+                    if lock.startswith("self.") else lock
+        return None
+
+    def dispatch_held(held: "set[str]") -> "str | None":
+        for cls_name, lockfield in guards.dispatch:
+            for h in held:
+                if h.endswith("." + lockfield) or h == lockfield:
+                    return lockfield
+        return None
+
+    def walk(stmts, held: "set[str]") -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue        # nested defs checked on their own
+            if isinstance(stmt, ast.With):
+                new_held = set(held)
+                for item in stmt.items:
+                    try:
+                        new_held.add(_norm(ast.unparse(item.context_expr)))
+                    except Exception:       # pragma: no cover
+                        pass
+                walk(stmt.body, new_held)
+                continue
+            if isinstance(stmt, (ast.If, ast.While, ast.For)):
+                _check_leaf(stmt, held, header_only=True)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, held)
+                for h in stmt.handlers:
+                    walk(h.body, held)
+                walk(stmt.orelse, held)
+                walk(stmt.finalbody, held)
+                continue
+            _check_leaf(stmt, held, header_only=False)
+
+    def _check_leaf(stmt, held, header_only: bool) -> None:
+        nodes = [stmt] if not header_only else [
+            stmt.test if isinstance(stmt, (ast.If, ast.While))
+            else stmt.iter]
+        for node in nodes:
+            for mut, receiver, field in _mutations_in(node):
+                lock = lock_for(receiver, field)
+                if lock is None:
+                    continue
+                want = _norm(lock)
+                if want in held or want in held_contracts:
+                    continue
+                if is_init and receiver == "self":
+                    continue
+                if ann.suppressed("guard-unlocked-mutation", mut.lineno):
+                    continue
+                findings.append(Finding(
+                    rule="guard-unlocked-mutation", path=path,
+                    line=mut.lineno, qualname=qual,
+                    message=(f"{receiver}.{field} is guarded-by {lock} "
+                             f"but mutated outside `with {lock}` (add "
+                             f"the with-block, or declare the caller "
+                             f"contract with `# lint: holds[{lock}]`)"),
+                    snippet=ast.unparse(mut)))
+            # dispatch-lock reentry
+            lockfield = dispatch_held(held)
+            if lockfield is not None:
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call) and (
+                            (isinstance(call.func, ast.Attribute)
+                             and call.func.attr in _REGISTRATION_CALLS)
+                            or (isinstance(call.func, ast.Name)
+                                and call.func.id in _REGISTRATION_CALLS)):
+                        if ann.suppressed("guard-dispatch-reentry",
+                                          call.lineno):
+                            continue
+                        findings.append(Finding(
+                            rule="guard-dispatch-reentry", path=path,
+                            line=call.lineno, qualname=qual,
+                            message=(f"callback (de)registration under "
+                                     f"the dispatch lock "
+                                     f"{lockfield!r} — the classic "
+                                     f"dispatch/registration deadlock; "
+                                     f"snapshot under the lock, call "
+                                     f"outside it"),
+                            snippet=ast.unparse(call)))
+
+    walk(fn_node.body, set())

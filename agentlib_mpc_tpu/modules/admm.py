@@ -140,9 +140,9 @@ class NeighborLink:
     the newest ``_INBOX_DEPTH``), since once entries must be dropped the
     oldest iterates are the least useful to the consensus update."""
 
-    variable: AgentVariable
-    status: ParticipantStatus = ParticipantStatus.not_participating
-    _inbox: deque = dataclasses.field(
+    variable: AgentVariable  # guarded-by: self._cv
+    status: ParticipantStatus = ParticipantStatus.not_participating  # guarded-by: self._cv
+    _inbox: deque = dataclasses.field(  # guarded-by: self._cv
         default_factory=lambda: deque(maxlen=_INBOX_DEPTH))
     _cv: threading.Condition = dataclasses.field(
         default_factory=threading.Condition)
@@ -173,12 +173,29 @@ class NeighborLink:
         with self._cv:
             return len(self._inbox)
 
+    def set_status(self, status: ParticipantStatus) -> None:
+        """Status transition from outside the link (the ADMM round
+        thread); broker callback threads transition via :meth:`push`."""
+        with self._cv:
+            self.status = status
+
+    def confirm(self, variable: AgentVariable) -> None:
+        """Accept a popped trajectory as this iteration's contribution."""
+        with self._cv:
+            self.variable = variable
+            self.status = ParticipantStatus.confirmed
+
     def reset(self, status: ParticipantStatus
-              = ParticipantStatus.not_participating) -> None:
-        """Drop all queued trajectories and move to ``status``."""
+              = ParticipantStatus.not_participating,
+              variable: "AgentVariable | None" = None) -> None:
+        """Drop all queued trajectories and move to ``status``
+        (optionally refreshing the registration variable in the same
+        critical section)."""
         with self._cv:
             self._inbox.clear()
             self.status = status
+            if variable is not None:
+                self.variable = variable
 
 
 class ADMMModule(BaseMPC):
@@ -281,8 +298,8 @@ class ADMMModule(BaseMPC):
             inboxes[variable.source] = NeighborLink(variable)
         neighbor = inboxes[variable.source]
         if self._status == ModuleStatus.at_registration:
-            neighbor.reset(ParticipantStatus.not_available)
-            neighbor.variable = variable
+            neighbor.reset(ParticipantStatus.not_available,
+                           variable=variable)
         elif self._status in _ITERATING:
             if not neighbor.push(variable):
                 self.logger.error(
@@ -296,8 +313,8 @@ class ADMMModule(BaseMPC):
 
     def reset_participants_ready(self) -> None:
         for p in self.all_participations():
-            p.status = (ParticipantStatus.available if p.pending
-                        else ParticipantStatus.not_available)
+            p.set_status(ParticipantStatus.available if p.pending
+                         else ParticipantStatus.not_available)
 
     def deregister_all_participants(self) -> None:
         for p in self.all_participations():
@@ -314,8 +331,7 @@ class ADMMModule(BaseMPC):
                 self.iteration_timeout - (_time.time() - start_wall), 0.0)
             var = participant.pop(timeout=remaining if block else None)
             if var is not None:
-                participant.variable = var
-                participant.status = ParticipantStatus.confirmed
+                participant.confirm(var)
             else:
                 participant.reset()
                 self.logger.info(

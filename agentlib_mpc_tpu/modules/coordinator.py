@@ -301,9 +301,14 @@ class ADMMCoordinator(BaseModule):
             config.get("time_out_non_responders", 1.0))
 
         self.status = CoordinatorStatus.sleeping
-        self.agent_dict: Dict[Source, AgentEntry] = {}
-        self._coupling_variables: Dict[str, ConsensusVariable] = {}
-        self._exchange_variables: Dict[str, ExchangeVariable] = {}
+        # the three registration containers: key insert/remove must hold
+        # _registration_lock (per-entry field transitions are the status
+        # machine's business, synchronized by the round protocol itself —
+        # locking the callbacks would starve received_variable while the
+        # round thread holds the lock across a whole round)
+        self.agent_dict: Dict[Source, AgentEntry] = {}  # guarded-by: self._registration_lock
+        self._coupling_variables: Dict[str, ConsensusVariable] = {}  # guarded-by: self._registration_lock
+        self._exchange_variables: Dict[str, ExchangeVariable] = {}  # guarded-by: self._registration_lock
         self.penalty_parameter = self.penalty_factor
         self.received_variable = threading.Event()
         self._thread: "threading.Thread | None" = None
@@ -359,6 +364,8 @@ class ADMMCoordinator(BaseModule):
                 self._register_agent(variable)
 
     def _register_agent(self, variable: AgentVariable) -> None:
+        # lint: holds[self._registration_lock] — only called from
+        # registration_callback inside its with-block
         value = AgentToCoordinator.from_payload(variable.value)
         entry = self.agent_dict[variable.source]
         for alias, traj in value.local_trajectory.items():

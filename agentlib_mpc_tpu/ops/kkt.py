@@ -274,7 +274,9 @@ def kkt_method_available(size: int = 7) -> bool:
         rhs = jnp.asarray(rng.normal(size=(2, n + m)), jnp.float32)
         x = jax.vmap(solve_kkt_ldl)(Kb, rhs)
         res = jnp.max(jnp.abs(jnp.einsum("bij,bj->bi", Kb, x) - rhs))
-        ok = bool(jnp.isfinite(res) and res < 1e-2)
+        # eager probe on CONCRETE arrays (memoized, runs once per padded
+        # size at trace time) — bool() here never sees a tracer
+        ok = bool(jnp.isfinite(res) and res < 1e-2)  # lint: ignore[jit-host-sync]
     except Exception:  # noqa: BLE001 - any compile/runtime failure
         ok = False
     _PROBE_RESULT[key] = ok

@@ -15,6 +15,7 @@ reference's layering (fast path vs interop path).
 from __future__ import annotations
 
 import logging
+import threading
 import time as _time
 from collections import defaultdict
 from typing import Callable, Optional
@@ -55,18 +56,27 @@ class DataBroker:
 
     def __init__(self, agent_id: str):
         self.agent_id = agent_id
-        self._subs: list[tuple[str, Source, Callback]] = []
+        # dispatch lock: held only to snapshot/mutate the subscriber
+        # list, NEVER while user callbacks run — a callback that
+        # (de)registers would deadlock on this non-reentrant lock, and
+        # slow callbacks would serialize every sender. The lint
+        # thread-discipline pass enforces both halves (guarded mutations
+        # + no registration under the lock; docs/static_analysis.md).
+        self._subs_lock = threading.Lock()  # lint: dispatch-lock
+        self._subs: list[tuple[str, Source, Callback]] = []  # guarded-by: self._subs_lock
         self._bus: Optional["BroadcastBus"] = None
         #: aliases already warned about (one dropped-variable warning per
         #: alias per broker — rate limiting, not suppression of the count)
-        self._warned_unmatched: set[str] = set()
+        self._warned_unmatched: set[str] = set()  # guarded-by: self._subs_lock
 
     def register_callback(self, alias: str, source, callback: Callback) -> None:
-        self._subs.append((alias, Source.coerce(source), callback))
+        with self._subs_lock:
+            self._subs.append((alias, Source.coerce(source), callback))
 
     def deregister_callback(self, alias: str, source, callback: Callback) -> None:
         key = (alias, Source.coerce(source), callback)
-        self._subs = [s for s in self._subs if s != key]
+        with self._subs_lock:
+            self._subs = [s for s in self._subs if s != key]
 
     def send_variable(self, var: AgentVariable, from_external: bool = False) -> None:
         """Deliver to local subscribers; forward shared vars to the bus.
@@ -82,7 +92,12 @@ class DataBroker:
         """
         matched = 0
         t0 = _time.perf_counter()
-        for alias, source, cb in list(self._subs):
+        # snapshot under the dispatch lock, call callbacks OUTSIDE it:
+        # callbacks may re-enter (register_callback from a handler, sends
+        # that fan back into this broker) and must not see a held lock
+        with self._subs_lock:
+            subs = list(self._subs)
+        for alias, source, cb in subs:
             if alias == var.alias and source.matches(var.source):
                 cb(var)
                 matched += 1
@@ -102,8 +117,10 @@ class DataBroker:
                 telemetry.recorder().record(rec)
         if not matched and not forwarded and not from_external:
             _UNMATCHED.inc(agent=self.agent_id, alias=var.alias)
-            if var.alias not in self._warned_unmatched:
+            with self._subs_lock:
+                warn = var.alias not in self._warned_unmatched
                 self._warned_unmatched.add(var.alias)
+            if warn:
                 logger.warning(
                     "agent %s: variable alias %r (source %s) matched no "
                     "registered callback and was not forwarded — dropped "

@@ -129,7 +129,7 @@ class MiniBroker:
         self._srv.bind((host, port))
         self._srv.listen()
         self.host, self.port = self._srv.getsockname()
-        self._sessions: list[_Session] = []
+        self._sessions: list[_Session] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.messages_routed = 0
@@ -275,9 +275,9 @@ class MiniMqttClient:
                  reconnect_seed: "int | str | None" = None):
         self.client_id = client_id or f"mini-{id(self):x}"
         self.on_message: Optional[Callable] = None
-        self._sock: Optional[socket.socket] = None
+        self._sock: Optional[socket.socket] = None  # guarded-by: self._wlock
         self._host = self._port = None
-        self._filters: list[str] = []
+        self._filters: list[str] = []  # guarded-by: self._wlock
         self._wlock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -329,13 +329,17 @@ class MiniMqttClient:
             raise ConnectionError(f"CONNACK refused: {ack!r}")
         with self._wlock:
             self._sock = sock
-        for filt in self._filters:
+            filters = list(self._filters)
+        for filt in filters:
             self._send_subscribe(filt)
         self._connected.set()
 
     def subscribe(self, filt: str, qos: int = 0) -> None:
-        if filt not in self._filters:
-            self._filters.append(filt)
+        # _filters is iterated by the reader thread's redial
+        # (_dial re-subscribes); mutate under the write lock
+        with self._wlock:
+            if filt not in self._filters:
+                self._filters.append(filt)
         if self._sock is not None:
             self._send_subscribe(filt)
 

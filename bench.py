@@ -668,6 +668,16 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         "spans": telemetry.recorder().aggregate(),
         "metrics": reg.snapshot(),
     }
+    # lint debt rides along with the perf trajectory: findings per rule
+    # per module (python -m agentlib_mpc_tpu.lint --stats), so a round
+    # that got faster by cutting hygiene corners shows it in the same
+    # artifact that celebrates the speedup
+    try:
+        from agentlib_mpc_tpu.lint import collect_stats
+
+        payload["lint_stats"] = collect_stats()
+    except Exception as exc:  # the bench must never die to the linter
+        payload["lint_stats"] = {"error": repr(exc)}
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1)
     summary = {

@@ -108,8 +108,9 @@ def run_example(until: float = 7200.0, testing: bool = False,
         assert bool(stats["success"].all()), stats
         # the plant was pulled to (or just at) the comfort band
         assert t_final <= T_UPPER + 0.1
-        # warm solves are ms-scale
-        assert float(stats["solve_wall_time"][1:].mean()) < 0.5
+        # warm solves are ms-scale (.iloc: the index is the float time
+        # grid, and label-slicing it with ints is a pandas FutureWarning)
+        assert float(stats["solve_wall_time"].iloc[1:].mean()) < 0.5
     return mas.get_results()
 
 

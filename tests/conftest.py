@@ -33,6 +33,24 @@ def eight_devices():
     return devs[:8]
 
 
+@pytest.fixture
+def compile_profiler():
+    """Telemetry registry with the jax.monitoring compile/retrace hooks
+    installed and the retrace scopes reset — the fixture behind the
+    retrace-budget regression tests (docs/static_analysis.md). Restores
+    the telemetry enabled flag afterwards."""
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    registry = enable_compile_profiling()
+    jax_events.reset_scopes()
+    yield registry
+    telemetry.configure(enabled=was_enabled)
+
+
 def make_tracker_model(lb: float = -5.0, ub: float = 5.0):
     """Shared stateless test model: min (u - a)^2 — analytic ADMM fixed
     points (consensus -> mean(a), exchange -> a_i - mean(a)). Used by the

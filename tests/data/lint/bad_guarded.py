@@ -1,0 +1,26 @@
+"""Golden-file fixture: guarded-field mutation outside its lock and
+callback registration under the dispatch lock."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()  # lint: dispatch-lock
+        self._subs = []  # guarded-by: self._lock
+        self._warned = set()  # guarded-by: self._lock
+
+    def good_add(self, item):
+        with self._lock:
+            self._subs.append(item)
+
+    def bad_add(self, item):
+        self._subs.append(item)          # mutation without the lock
+
+    def bad_replace(self, items):
+        self._subs = list(items)         # rebind without the lock
+
+    def bad_reentry(self, broker, cb):
+        with self._lock:
+            self._warned.add("x")        # fine: lock held
+            broker.register_callback("a", None, cb)   # deadlock shape

@@ -1,0 +1,34 @@
+"""Golden-file fixture: host syncs and tracer branches inside jit.
+
+Every construct below is a known-bad pattern the jit-hygiene passes must
+flag — the test asserts the exact finding fingerprints.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_step(x, y):
+    s = jnp.sum(x)
+    v = float(s)                  # host sync: float() on a tracer
+    print("solving")              # trace-time print
+    w = s.item()                  # host sync: .item()
+    arr = np.asarray(s)           # numpy pulls the tracer to host
+    if s > 0:                     # Python branch on a tracer
+        y = y + 1.0
+    t0 = time.time()              # baked in as a trace-time constant
+    return y + v + w + arr.sum() + t0
+
+
+def helper(a):
+    # reachable from bad_step? no — but reachable from jitted caller below
+    return float(jnp.max(a))      # host sync in a jit-reachable helper
+
+
+@jax.jit
+def calls_helper(x):
+    return helper(x * 2.0)

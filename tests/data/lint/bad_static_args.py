@@ -1,0 +1,16 @@
+"""Golden-file fixture: non-hashable default on a jit static arg —
+raises TypeError at dispatch, and every distinct value recompiles."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def bad_static(x, opts=[1, 2, 3]):
+    return x * len(opts)
+
+
+@functools.partial(jax.jit, static_argnames=("names",))
+def bad_static_names(x, names={"a": 1}):
+    return x + len(names)

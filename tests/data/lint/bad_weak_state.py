@@ -1,0 +1,24 @@
+"""Golden-file fixture: the PR 2 bug class — weak-typed scalar literals
+stored into a carried state pytree by an EAGER state constructor. The
+second ``step(state)`` call sees different avals and the whole fused
+program retraces."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class CarryState(NamedTuple):
+    z: jnp.ndarray
+    rho: jnp.ndarray
+    n_agents: int
+
+
+def init_state(n):
+    z = jnp.full((n, 3), 0.1)        # weak: bare scalar fill, no dtype=
+    rho = jnp.asarray(10.0)          # weak: bare scalar, no dtype=
+    return CarryState(z=z, rho=rho, n_agents=4)
+
+
+def reset_state(state):
+    return state._replace(rho=10.0)  # raw Python scalar into the carry

@@ -1,0 +1,48 @@
+"""Golden-file fixture: idiomatic jit + locking code — the analyzer must
+produce ZERO findings here (the false-positive regression guard)."""
+
+import threading
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Options(NamedTuple):
+    corrector: bool = False
+    samples: int = 8
+
+
+class LoopState(NamedTuple):
+    w: jnp.ndarray
+    it: jnp.ndarray
+
+
+def make_state(n, dtype):
+    # strong-typed fills: dtype pinned, like the fixed init_state
+    return LoopState(w=jnp.full((n,), 0.1, dtype=dtype),
+                     it=jnp.zeros((), jnp.int32))
+
+
+@jax.jit
+def good_step(x, opts: Options = Options()):
+    n = x.shape[0]                     # shapes are static — fine
+    if opts.corrector:                 # static Python option — fine
+        x = x + 1.0
+    y = jnp.where(jnp.sum(x) > 0, x, -x)   # traced select — fine
+    alphas = 0.5 ** jnp.arange(opts.samples, dtype=x.dtype)
+    return y * alphas[:n]
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)

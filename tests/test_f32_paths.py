@@ -2,7 +2,7 @@
 
 The suite runs in f64 (conftest enables x64 for tight tolerances); the
 TPU data plane runs f32. These tests re-trace the hot paths under
-``jax.enable_x64(False)`` and pin the f32-specific behavior the solver
+``jax.experimental.enable_x64(False)`` and pin the f32-specific behavior the solver
 was engineered for (scaling, stall acceptance, barrier floor —
 ``ops/solver.py`` docstring): solves still succeed and land on the f64
 answer to f32-appropriate tolerance.
@@ -24,7 +24,12 @@ from agentlib_mpc_tpu.ops.transcription import transcribe
 
 @pytest.fixture()
 def f32():
-    with jax.enable_x64(False):
+    # jax >= 0.4.3x removed the jax.enable_x64 alias; the context manager
+    # lives in jax.experimental (this fixture errored on every tier-1 run
+    # since the image's jax moved — fixed in the jaxlint PR)
+    from jax.experimental import enable_x64
+
+    with enable_x64(False):
         yield
 
 
@@ -59,7 +64,9 @@ class TestSolverF32:
         assert bool(res32.stats.success)
         obj32 = float(res32.stats.objective)
 
-        with jax.enable_x64(True):
+        from jax.experimental import enable_x64
+
+        with enable_x64(True):
             ocp64 = transcribe(model, ["mDot"], N=8, dt=300.0,
                                method="collocation", collocation_degree=2)
             theta64 = ocp64.default_params(x0=jnp.array([298.16]))

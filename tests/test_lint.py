@@ -1,0 +1,251 @@
+"""The analyzer analyzed: golden-file fixtures with known-bad snippets
+must produce EXACTLY the expected finding fingerprints, a clean file must
+produce none, and re-introducing the PR 2 weak-typed ``init_state``
+literal into the real ``parallel/fused_admm.py`` must be caught by the
+weak-type pass (the static half of the acceptance criterion; the runtime
+half lives in ``test_lint_retrace.py``).
+
+Pure-stdlib tests — no jax import, they run in milliseconds.
+"""
+
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from agentlib_mpc_tpu.lint.cli import main as lint_main
+from agentlib_mpc_tpu.lint.findings import Baseline, SourceAnnotations
+from agentlib_mpc_tpu.lint.retrace_budget import _mini_toml, load_budgets
+from agentlib_mpc_tpu.lint.runner import (
+    collect_findings,
+    collect_stats,
+    package_root,
+)
+
+FIXTURES = Path(__file__).parent / "data" / "lint"
+
+
+def fixture_findings():
+    return collect_findings(root=str(FIXTURES), jit_scope=None)
+
+
+class TestGoldenFiles:
+    """Known-bad snippets -> exact fingerprints (fingerprints hash rule +
+    path + qualname + normalized snippet, so they survive line shifts —
+    if one of these assertions breaks, a RULE changed, not a fixture)."""
+
+    def test_host_sync_fixture(self):
+        got = {f.fingerprint: f.rule for f in fixture_findings()
+               if f.path == "bad_host_sync.py"}
+        assert got == {
+            "9208be6eba8e": "jit-host-sync",      # float(tracer)
+            "5ed9a9ffc96c": "jit-host-sync",      # print()
+            "d1a3d1ba335f": "jit-host-sync",      # .item()
+            "0ad062c117ec": "jit-host-sync",      # np.asarray(tracer)
+            "9619f5a644c4": "jit-tracer-branch",  # if s > 0
+            "ee5bd85551e6": "jit-wall-clock",     # time.time()
+            "6cb6c8085093": "jit-host-sync",      # helper via call edge
+        }
+
+    def test_reachability_flags_helper_not_entry(self):
+        """float(jnp.max(a)) in ``helper`` is flagged because the jitted
+        ``calls_helper`` reaches it through the call edge — the whole
+        point of the reachability set."""
+        helper = [f for f in fixture_findings()
+                  if f.path == "bad_host_sync.py" and f.qualname == "helper"]
+        assert len(helper) == 1
+        assert helper[0].rule == "jit-host-sync"
+
+    def test_guarded_fixture(self):
+        got = {f.fingerprint: f.rule for f in fixture_findings()
+               if f.path == "bad_guarded.py"}
+        assert got == {
+            "c3ccc98adbf5": "guard-unlocked-mutation",   # .append
+            "c9aef804aa43": "guard-unlocked-mutation",   # rebind
+            "3d72f01eb0d2": "guard-dispatch-reentry",    # register under lock
+        }
+
+    def test_weak_state_fixture(self):
+        got = {f.fingerprint: f.rule for f in fixture_findings()
+               if f.path == "bad_weak_state.py"}
+        assert set(got.values()) == {"jit-weak-type"}
+        assert got == {
+            "fa15811a3b67": "jit-weak-type",   # jnp.full no dtype
+            "a8b202a24ffe": "jit-weak-type",   # jnp.asarray no dtype
+            "4b41c655d1ee": "jit-weak-type",   # literal into CarryState
+            "47b8750c5d5e": "jit-weak-type",   # literal into _replace
+        }
+
+    def test_static_args_fixture(self):
+        got = {f.fingerprint: f.qualname for f in fixture_findings()
+               if f.path == "bad_static_args.py"}
+        assert got == {
+            "9be2d30efc9c": "bad_static",         # list default
+            "3316b72dbf22": "bad_static_names",   # dict default
+        }
+
+    def test_clean_file_produces_no_findings(self):
+        assert [f for f in fixture_findings() if f.path == "clean.py"] == []
+
+
+class TestPR2Regression:
+    """Deleting the ``dtype=fdtype`` pin from the REAL fused-ADMM
+    ``init_state`` (the exact PR 2 bug) must light up jit-weak-type."""
+
+    def _scan_with(self, tmp_path, mutate):
+        snap = tmp_path / "pkg"
+        shutil.copytree(package_root(), snap,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+        target = snap / "parallel" / "fused_admm.py"
+        src = target.read_text()
+        target.write_text(mutate(src))
+        return collect_findings(root=str(snap))
+
+    def test_current_tree_is_clean(self, tmp_path):
+        findings = self._scan_with(tmp_path, lambda s: s)
+        assert [f for f in findings
+                if f.path == "parallel/fused_admm.py"
+                and f.rule == "jit-weak-type"] == []
+
+    def test_weak_z_fill_is_caught(self, tmp_path):
+        bugged = "jnp.full((g.n_agents, g.ocp.n_h), 0.1, dtype=fdtype)"
+        assert bugged.replace(", dtype=fdtype", "") != bugged
+        findings = self._scan_with(
+            tmp_path, lambda s: s.replace(bugged, bugged.replace(
+                ", dtype=fdtype", "")))
+        hits = [f for f in findings
+                if f.path == "parallel/fused_admm.py"
+                and f.rule == "jit-weak-type"
+                and "init_state" in f.qualname]
+        assert hits, "re-introduced PR 2 weak-typed z fill was not caught"
+
+
+class TestSuppressionsAndContracts:
+    def test_inline_ignore_suppresses_only_its_rule(self, tmp_path):
+        src = (
+            "import jax, jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    s = jnp.sum(x)\n"
+            "    a = float(s)  # lint: ignore[jit-host-sync]\n"
+            "    b = float(s)\n"
+            "    return a + b\n")
+        (tmp_path / "mod.py").write_text(src)
+        findings = collect_findings(root=str(tmp_path), jit_scope=None)
+        assert len(findings) == 1 and findings[0].line == 6
+
+    def test_standalone_ignore_covers_next_line_only(self, tmp_path):
+        src = (
+            "import jax, jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    # lint: ignore\n"
+            "    a = float(jnp.sum(x))\n"
+            "    return a\n")
+        (tmp_path / "mod.py").write_text(src)
+        assert collect_findings(root=str(tmp_path), jit_scope=None) == []
+
+    def test_holds_contract_discharges_mutation(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []  # guarded-by: self._lock\n"
+            "    def helper(self):\n"
+            "        # lint: holds[self._lock]\n"
+            "        self._items.append(1)\n"
+            "    def bad(self):\n"
+            "        self._items.append(2)\n")
+        (tmp_path / "mod.py").write_text(src)
+        findings = collect_findings(root=str(tmp_path), jit_scope=None)
+        assert [f.qualname for f in findings] == ["C.bad"]
+
+    def test_init_is_exempt(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []  # guarded-by: self._lock\n"
+            "        self._items.append(0)\n")
+        (tmp_path / "mod.py").write_text(src)
+        assert collect_findings(root=str(tmp_path), jit_scope=None) == []
+
+    def test_inline_guard_comment_does_not_bleed_to_next_line(self):
+        ann = SourceAnnotations(
+            "x = 1  # guarded-by: self._lock\n"
+            "y = 2\n")
+        assert ann.guard_at(1) == "self._lock"
+        assert ann.guard_at(2) is None
+
+
+class TestBaselineWorkflow:
+    def test_cli_baseline_roundtrip(self, tmp_path, capsys):
+        baseline = tmp_path / "baseline.json"
+        args = ["--root", str(FIXTURES), "--baseline", str(baseline)]
+        # new findings fail ...
+        assert lint_main(args) == 1
+        # ... writing the baseline makes the same tree pass ...
+        assert lint_main(args + ["--write-baseline"]) == 0
+        assert lint_main(args) == 0
+        data = json.loads(baseline.read_text())
+        assert len(data["findings"]) >= 10
+        assert all("justification" in v for v in data["findings"].values())
+        # ... and a baseline entry for fixed debt is reported stale, not
+        # fatal (prune via --write-baseline)
+        entries = dict(data["findings"])
+        fp = next(iter(entries))
+        entries["feedfacefeed"] = entries.pop(fp)
+        baseline.write_text(json.dumps({"findings": entries}))
+        assert lint_main(args) == 1      # the un-baselined finding is back
+        bl = Baseline.load(baseline)
+        new, old, stale = bl.split(
+            collect_findings(root=str(FIXTURES), jit_scope=None))
+        assert "feedfacefeed" in stale and len(new) == 1
+
+    def test_repo_tree_is_lint_clean(self):
+        """The acceptance bar: the shipped package has zero un-baselined
+        findings (and currently zero baselined ones, too)."""
+        findings = collect_findings()
+        root = Path(package_root()).parent
+        baseline = Baseline.load(root / "lint_baseline.json")
+        new, _old, _stale = baseline.split(findings)
+        assert new == [], "\n".join(f.render() for f in new)
+
+    def test_stats_shape(self):
+        stats = collect_stats(root=str(FIXTURES))
+        assert stats["total"] >= 10
+        assert "jit-host-sync" in stats["per_rule"]
+        assert "bad_guarded.py" in stats["per_module"]
+        assert "clean.py" not in stats["per_module"]
+
+
+class TestBudgetsToml:
+    def test_mini_toml_subset(self):
+        parsed = _mini_toml(
+            '# comment\n[retrace]\nwarmup_rounds = 2\nrounds = 3\n'
+            '[retrace.budgets]\ndefault = 0\n"admm.fused_step" = 1\n')
+        assert parsed["retrace"]["warmup_rounds"] == 2
+        assert parsed["retrace"]["budgets"]["admm.fused_step"] == 1
+
+    def test_checked_in_budgets_parse(self):
+        cfg = load_budgets()
+        assert cfg["retrace"]["budgets"]["default"] == 0
+        assert cfg["retrace"]["rounds"] >= 1
+
+    def test_mini_toml_matches_real_parser_on_checked_in_file(self):
+        root = Path(package_root()).parent
+        path = root / "lint_budgets.toml"
+        if not path.is_file():
+            pytest.skip("no checked-in budgets (installed package)")
+        text = path.read_text()
+        try:
+            import tomli
+        except ModuleNotFoundError:
+            pytest.skip("no reference TOML parser available")
+        assert _mini_toml(text) == tomli.loads(text)

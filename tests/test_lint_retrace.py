@@ -1,0 +1,66 @@
+"""Retrace-budget gate + the PR 2 weak-type regression, live.
+
+The static analyzer (``test_lint.py``) proves the weak-typed
+``init_state`` literal is caught at the AST layer; this file proves the
+*runtime* layer: the fused-ADMM engine must run warm rounds with ZERO
+additional traces/compiles (the "compile once, dispatch forever"
+contract), and a weak-typed carry — the exact PR 2 bug — must trip the
+retrace counters the gate watches.
+
+Uses the ``compile_profiler`` conftest fixture (telemetry +
+``jax.monitoring`` hooks) and the same 4-agent tracker fleet
+``python -m agentlib_mpc_tpu.lint --retrace-budget`` runs in CI.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.lint.retrace_budget import (
+    build_bench_engine,
+    run_gate,
+)
+
+
+class TestRetraceBudgetGate:
+    def test_zero_recompiles_across_three_warm_rounds(self):
+        """The CI gate, in-process: 2 warmup rounds then 3 measured
+        rounds with shift_state between steps (values change, avals must
+        not) — every entry point's trace+compile delta must be zero."""
+        report = run_gate(budgets={"retrace": {
+            "warmup_rounds": 2, "rounds": 3, "n_agents": 4,
+            "budgets": {"default": 0}}}, verbose=False)
+        assert report["violations"] == [], report
+        assert all(delta == 0 for delta in report["deltas"].values()), \
+            report["deltas"]
+
+    def test_weak_typed_init_state_is_caught_by_the_gate(
+            self, compile_profiler):
+        """Re-introduce the PR 2 bug at runtime: replace the strong-typed
+        z warm-start fill with a weak-typed one (``jnp.full(...)`` without
+        dtype). Round 1 traces with weak avals; the engine returns
+        strong-typed arrays, so round 2's carry differs and the whole
+        fused program retraces — which the gate's counters must see."""
+        from agentlib_mpc_tpu.telemetry import jax_events
+
+        engine, state, thetas = build_bench_engine(4)
+        state = state._replace(
+            z=tuple(jnp.full(z.shape, 0.1) for z in state.z))
+        assert all(z.weak_type for z in state.z)
+
+        jax_events.reset_scopes()
+        state, _trajs, _stats = engine.step(state, thetas)
+        after_round1 = compile_profiler.counter(
+            "jax_retraces_total").total()
+        assert not any(getattr(z, "weak_type", False) for z in state.z), \
+            "engine output z should be strong-typed"
+        state, _trajs, _stats = engine.step(state, thetas)
+        after_round2 = compile_profiler.counter(
+            "jax_retraces_total").total()
+        assert after_round2 > after_round1, (
+            "weak-typed carry did not retrace — either jax now "
+            "auto-strengthens (great: delete this engine rebuild cost) "
+            "or the profiling hooks lost the event")
