@@ -39,6 +39,7 @@ from agentlib_mpc_tpu.backends.backend import (
 )
 from agentlib_mpc_tpu.backends.mpc_backend import (
     JAXBackend,
+    attach_stage_partition,
     solver_options_from_config,
 )
 from agentlib_mpc_tpu.ops.admm import consensus_penalty, exchange_penalty
@@ -105,8 +106,8 @@ class ADMMBackend(JAXBackend):
             self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, opt_controls, N=self.N,
                               dt=self.time_step, **trans_kwargs)
-        self.solver_options = solver_options_from_config(
-            self.config.get("solver"))
+        self.solver_options = attach_stage_partition(
+            solver_options_from_config(self.config.get("solver")), self.ocp)
         # inexact warm iterations: ADMM iterations >= 1 re-solve an almost
         # unchanged problem from a full primal/dual/barrier warm start, so
         # a short interior-point budget suffices (config "warm_solver"
@@ -131,6 +132,9 @@ class ADMMBackend(JAXBackend):
         if "dual_inf_tol" not in warm_cfg:
             self.warm_solver_options = self.warm_solver_options._replace(
                 dual_inf_tol=max(self.warm_solver_options.dual_inf_tol, 1.0))
+        # warm re-solves factor the same stage-banded KKT system
+        self.warm_solver_options = attach_stage_partition(
+            self.warm_solver_options, self.ocp)
         self._exo_names = list(self.ocp.exo_names)
         # the module-facing var_ref keeps real controls; the internal
         # collection path needs the extended control list
@@ -335,15 +339,7 @@ class ADMMBackend(JAXBackend):
         wall = _time.perf_counter() - t_start
         self._carry_warm_start(w_next, y_next, z_next, now=now)
 
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-        }
+        stats_row = self.solver_stats_row(stats, now, wall)
         self._record_solve(stats_row)
         controls = list(self.ocp.control_names)
         return {

@@ -17,6 +17,7 @@ from typing import Any, Optional, Type
 
 from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.models.model import Model
+from agentlib_mpc_tpu.ops.solver import kkt_path_name
 
 logger = logging.getLogger(__name__)
 
@@ -156,6 +157,25 @@ class OptimizationBackend:
         returned list behave exactly as before.
         """
         return self._stats_history
+
+    @staticmethod
+    def solver_stats_row(stats, now, wall: float, **extra) -> dict:
+        """One solve's ``stats_history`` row from a ``SolverStats`` — the
+        single place the key schema lives (time, iterations, success,
+        kkt_error, objective, constraint_violation, solve_wall_time,
+        kkt_path), so the five backends cannot drift. ``extra`` appends
+        or overrides (e.g. the MINLP two-phase iteration sum)."""
+        return {
+            "time": float(now),
+            "iterations": int(stats.iterations),
+            "success": bool(stats.success),
+            "kkt_error": float(stats.kkt_error),
+            "objective": float(stats.objective),
+            "constraint_violation": float(stats.constraint_violation),
+            "solve_wall_time": wall,
+            "kkt_path": kkt_path_name(getattr(stats, "kkt_path", -1)),
+            **extra,
+        }
 
     def _record_solve(self, stats_row: dict) -> None:
         """Record one solve: stats row (back-compat history), telemetry

@@ -39,7 +39,10 @@ from agentlib_mpc_tpu.backends.backend import (
     load_model,
     register_backend,
 )
-from agentlib_mpc_tpu.backends.mpc_backend import solver_options_from_config
+from agentlib_mpc_tpu.backends.mpc_backend import (
+    attach_stage_partition,
+    solver_options_from_config,
+)
 from agentlib_mpc_tpu.models.model import Model, ModelEquations
 from agentlib_mpc_tpu.models.objective import SubObjective
 from agentlib_mpc_tpu.models.variables import Var
@@ -150,8 +153,8 @@ class MHEBackend(OptimizationBackend):
         self.ocp = transcribe(self.model, var_ref.estimated_inputs,
                               N=self.N, dt=self.time_step,
                               fix_initial_state=False, **kwargs)
-        self.solver_options = solver_options_from_config(
-            self.config.get("solver"))
+        self.solver_options = attach_stage_partition(
+            solver_options_from_config(self.config.get("solver")), self.ocp)
         self._exo_names = list(self.ocp.exo_names)
         self._resolve_qp_fast_path()
         self._build_step_fn()
@@ -287,15 +290,7 @@ class MHEBackend(OptimizationBackend):
         wall = _time.perf_counter() - t_start
         self._carry_warm_start(w_next, y_next, z_next, now=now)
 
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-        }
+        stats_row = self.solver_stats_row(stats, now, wall)
         self._record_solve(stats_row)
 
         x_traj = np.asarray(traj["x"])

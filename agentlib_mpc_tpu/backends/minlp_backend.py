@@ -35,7 +35,10 @@ from agentlib_mpc_tpu.backends.backend import (
     VariableReference,
     register_backend,
 )
-from agentlib_mpc_tpu.backends.mpc_backend import JAXBackend
+from agentlib_mpc_tpu.backends.mpc_backend import (
+    JAXBackend,
+    attach_stage_partition,
+)
 from agentlib_mpc_tpu.ops.cia import cia_objective, solve_cia, sum_up_rounding
 from agentlib_mpc_tpu.ops.solver import solve_nlp
 from agentlib_mpc_tpu.ops.transcription import transcribe
@@ -93,7 +96,8 @@ class MINLPBackend(JAXBackend):
         fixed_solver_cfg = {"dual_inf_tol": 100.0, "compl_inf_tol": 1e-2,
                             **dict(self.config.get("solver", {}) or {}),
                             **dict(self.config.get("fixed_solver", {}) or {})}
-        self._fixed_options = solver_options_from_config(fixed_solver_cfg)
+        self._fixed_options = attach_stage_partition(
+            solver_options_from_config(fixed_solver_cfg), self.ocp_fixed)
         # exo vector of the fixed program = binaries ∪ relaxed program's exo;
         # map both into its declaration order
         fixed_exo = list(self.ocp_fixed.exo_names)
@@ -251,19 +255,14 @@ class MINLPBackend(JAXBackend):
         if len(ci):
             u0[ci] = np.asarray(u0_c)
         u0[bi] = B[0]
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats_rel.iterations) + int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-            "cia_objective": float(eta),
-            "relaxed_objective": float(stats_rel.objective),
-            "relaxed_success": bool(stats_rel.success),
+        stats_row = self.solver_stats_row(
+            stats, now, wall,
+            iterations=int(stats_rel.iterations) + int(stats.iterations),
+            cia_objective=float(eta),
+            relaxed_objective=float(stats_rel.objective),
+            relaxed_success=bool(stats_rel.success),
             **self._schedule_stats,
-        }
+        )
         self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i])

@@ -229,15 +229,7 @@ class MLBackend(OptimizationBackend):
         wall = _time.perf_counter() - t_start
         self._carry_warm_start(w_next, y_next, z_next, now=now)
 
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-        }
+        stats_row = self.solver_stats_row(stats, now, wall)
         self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},
@@ -389,15 +381,7 @@ class MLADMMBackend(MLBackend):
         wall = _time.perf_counter() - t_start
         self._carry_warm_start(w_next, y_next, z_next, now=now)
 
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-        }
+        stats_row = self.solver_stats_row(stats, now, wall)
         self._record_solve(stats_row)
         controls = list(self.ocp.control_names)
         return {

@@ -58,8 +58,24 @@ def solver_options_from_config(cfg: dict) -> SolverOptions:
     cfg = dict(cfg or {})
     cfg.pop("name", None)  # reference: solver name (ipopt/fatrop/...)
     cfg.pop("options", None)
+    # derived, not config-expressible: the backends attach it from the
+    # transcribed OCP (attach_stage_partition) after transcription
+    cfg.pop("stage_partition", None)
     known = SolverOptions._fields
     return SolverOptions(**{k: v for k, v in cfg.items() if k in known})
+
+
+def attach_stage_partition(options: SolverOptions, ocp) -> SolverOptions:
+    """Wire a transcribed OCP's stage partition into solver options (the
+    fatrop-role plumbing, shared by the MPC/MHE/ADMM/MINLP backends;
+    the fused fleet routes through the same underlying rule):
+    ``kkt_method="auto"`` then routes long-horizon KKT systems to the
+    block-tridiagonal stage sweep, and ``"stage"`` can be forced from
+    config. A config dict cannot express the partition itself — it is
+    derived structure, not a knob."""
+    from agentlib_mpc_tpu.ops.solver import attach_stage_partition as attach
+
+    return attach(options, getattr(ocp, "stage_partition", None))
 
 
 @register_backend("jax", "jax_full", "casadi", "casadi_basic")
@@ -80,8 +96,8 @@ class JAXBackend(OptimizationBackend):
             self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, var_ref.controls, N=self.N,
                               dt=self.time_step, **trans_kwargs)
-        self.solver_options = solver_options_from_config(
-            self.config.get("solver"))
+        self.solver_options = attach_stage_partition(
+            solver_options_from_config(self.config.get("solver")), self.ocp)
         self._exo_names = list(self.ocp.exo_names)
         self._resolve_qp_fast_path()
         self._build_step_fn()
@@ -218,15 +234,7 @@ class JAXBackend(OptimizationBackend):
         wall = _time.perf_counter() - t_start
         self._carry_warm_start(w_next, y_next, z_next, now=now)
 
-        stats_row = {
-            "time": float(now),
-            "iterations": int(stats.iterations),
-            "success": bool(stats.success),
-            "kkt_error": float(stats.kkt_error),
-            "objective": float(stats.objective),
-            "constraint_violation": float(stats.constraint_violation),
-            "solve_wall_time": wall,
-        }
+        stats_row = self.solver_stats_row(stats, now, wall)
         self._record_solve(stats_row)
         return {
             "u0": {n: float(u0[i]) for i, n in enumerate(self.var_ref.controls)},

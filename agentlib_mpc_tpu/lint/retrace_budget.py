@@ -91,10 +91,13 @@ def _mini_toml(text: str) -> dict:
     return out
 
 
-def build_bench_engine(n_agents: int = 4):
+def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto"):
     """The gate's workload: one consensus group of ``n_agents`` trackers
     (min (u - a)^2 coupled on a shared control) — small enough to compile
     in seconds on CPU, structurally identical to the 4-agent bench step.
+    ``kkt_method`` feeds the group's solver options (the checked-in
+    budgets pin ``"stage"`` so the structured stage factorization runs
+    warm under the same zero-recompile contract as the dense paths).
     Returns (engine, state, theta_batches)."""
     import jax.numpy as jnp
 
@@ -124,7 +127,7 @@ def build_bench_engine(n_agents: int = 4):
     group = AgentGroup(
         name="retrace-gate", ocp=ocp, n_agents=n_agents,
         couplings={"shared_u": "u"},
-        solver_options=SolverOptions(max_iter=30))
+        solver_options=SolverOptions(max_iter=30, kkt_method=kkt_method))
     engine = FusedADMM([group], FusedADMMOptions(max_iterations=8, rho=2.0))
     thetas = stack_params([
         ocp.default_params(p=jnp.array([float(i + 1)]))
@@ -149,6 +152,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
     warmup = int(cfg.get("warmup_rounds", 2))
     rounds = int(cfg.get("rounds", 3))
     n_agents = int(cfg.get("n_agents", 4))
+    kkt_method = str(cfg.get("kkt_method", "auto"))
     per_entry = dict(cfg.get("budgets", {}) or {})
     default_budget = int(per_entry.pop("default", 0))
 
@@ -166,7 +170,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
         return totals
 
     try:
-        engine, state, thetas = build_bench_engine(n_agents)
+        engine, state, thetas = build_bench_engine(n_agents, kkt_method)
         for _ in range(max(warmup, 1)):
             state, _trajs, _stats = engine.step(state, thetas)
             state = engine.shift_state(state)
@@ -196,6 +200,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
         "warmup_rounds": warmup,
         "rounds": rounds,
         "n_agents": n_agents,
+        "kkt_method": kkt_method,
         "deltas": dict(sorted(deltas.items())),
         "violations": violations,
     }

@@ -452,6 +452,62 @@ class ADMMModule(BaseMPC):
             return True
         return False
 
+    # -- the shared iteration body (VERDICT r5 weak #6) -----------------------
+
+    def _run_admm_iterations(self, opt_inputs: dict, *, block: bool):
+        """The solve → send → receive → update iteration loop shared by
+        :class:`LocalADMM` and :class:`RealtimeADMM` (the two copies had
+        already drifted once, per git history). A generator: it yields at
+        every synchronization point — the fast-simulation variant re-emits
+        each yield as an env delay to keep the lock-step fleet aligned,
+        the realtime variant just drains them (:meth:`_drain`). ``block``
+        is the receive semantics (realtime blocks with timeouts against a
+        per-iteration wall clock; local polls against the round start).
+        Returns (via ``StopIteration.value``) the last local result."""
+        start_iterations = self.env.now
+        start_wall = _time.time()
+        admm_iter = 0
+        result = None
+        while True:
+            recv_start = _time.time() if block else start_wall
+            self._status = ModuleStatus.optimizing
+            result = self._solve_local(opt_inputs, start_iterations,
+                                       admm_iter)
+            yield
+            self.send_coupling_values(result)
+            yield
+            self._status = ModuleStatus.waiting_for_other_agents
+            self._receive_variables(recv_start, block=block)
+            yield
+            self._status = ModuleStatus.updating
+            self._set_mean_coupling_values()
+            self.update_lambda()
+            self.reset_participants_ready()
+            self._record_iteration(result, admm_iter)
+            yield
+            admm_iter += 1
+            if self._check_termination(admm_iter, start_iterations,
+                                       start_wall):
+                return result
+
+    @staticmethod
+    def _drain(gen):
+        """Run a sync-point generator to completion, returning its result
+        (the realtime variant has no scheduler to hand the yields to)."""
+        while True:
+            try:
+                next(gen)
+            except StopIteration as stop:
+                return stop.value
+
+    def _finish_round(self, result: "dict | None") -> None:
+        """Common round epilogue: release neighbors, then actuate only
+        what the resilience guard clears."""
+        self.deregister_all_participants()
+        decision = self.guarded_actuation(result)
+        if decision.action == "actuate":
+            self._record(result)
+
     # -- results --------------------------------------------------------------
 
     def _record_iteration(self, result: dict, admm_iter: int) -> None:
@@ -522,35 +578,16 @@ class LocalADMM(ADMMModule):
 
             self._set_mean_coupling_values()
             opt_inputs = self.collect_variables_for_optimization()
-            start_iterations = self.env.now
-            start_wall = _time.time()
-            admm_iter = 0
-            result = None
+            iterations = self._run_admm_iterations(opt_inputs, block=False)
             while True:
-                self._status = ModuleStatus.optimizing
-                result = self._solve_local(opt_inputs, start_iterations,
-                                           admm_iter)
-                yield self.sync_delay
-                self.send_coupling_values(result)
-                yield self.sync_delay
-                self._status = ModuleStatus.waiting_for_other_agents
-                self._receive_variables(start_wall, block=False)
-                yield self.sync_delay
-                self._status = ModuleStatus.updating
-                self._set_mean_coupling_values()
-                self.update_lambda()
-                self.reset_participants_ready()
-                self._record_iteration(result, admm_iter)
-                yield self.sync_delay
-                admm_iter += 1
-                if self._check_termination(admm_iter, start_iterations,
-                                           start_wall):
+                try:
+                    next(iterations)
+                except StopIteration as stop:
+                    result = stop.value
                     break
+                yield self.sync_delay
 
-            self.deregister_all_participants()
-            decision = self.guarded_actuation(result)
-            if decision.action == "actuate":
-                self._record(result)
+            self._finish_round(result)
             self._status = ModuleStatus.sleeping
             spent = self.env.now - start_round
             yield max(self.time_step - spent, 0.0)
@@ -623,29 +660,6 @@ class RealtimeADMM(ADMMModule):
 
         self._set_mean_coupling_values()
         opt_inputs = self.collect_variables_for_optimization()
-        start_iterations = self.env.now
-        start_wall = _time.time()
-        admm_iter = 0
-        result = None
-        while True:
-            iter_wall = _time.time()
-            self._status = ModuleStatus.optimizing
-            result = self._solve_local(opt_inputs, start_iterations,
-                                       admm_iter)
-            self.send_coupling_values(result)
-            self._status = ModuleStatus.waiting_for_other_agents
-            self._receive_variables(iter_wall, block=True)
-            self._status = ModuleStatus.updating
-            self._set_mean_coupling_values()
-            self.update_lambda()
-            self.reset_participants_ready()
-            self._record_iteration(result, admm_iter)
-            admm_iter += 1
-            if self._check_termination(admm_iter, start_iterations,
-                                       start_wall):
-                break
-
-        self.deregister_all_participants()
-        decision = self.guarded_actuation(result)
-        if decision.action == "actuate":
-            self._record(result)
+        result = self._drain(
+            self._run_admm_iterations(opt_inputs, block=True))
+        self._finish_round(result)

@@ -39,6 +39,7 @@ solver keeps XLA's LU (CPU LU is fine; see ``solver.SolverOptions.kkt_method``).
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -241,6 +242,32 @@ def _use_pallas() -> bool:
 _PROBE_RESULT: dict = {}
 
 
+def run_probe_outside_trace(fn):
+    """Run ``fn`` eagerly even when the caller sits inside a jit trace.
+
+    The availability probes below execute real device computations on
+    concrete arrays and ``bool()`` the result — but since omnistaging,
+    ANY jax op issued while a trace is active is staged into that trace,
+    so a probe first consulted from inside ``solve_nlp``'s trace would
+    see tracers, raise, and memoize a false negative. JAX trace contexts
+    are thread-local: a fresh thread has a clean (eager) context, so the
+    probe's one-time cost runs there and returns a concrete value."""
+    out: dict = {}
+
+    def _worker():
+        try:
+            out["value"] = fn()
+        except Exception as exc:  # noqa: BLE001 - re-raised in the caller
+            out["error"] = exc
+
+    t = threading.Thread(target=_worker, name="kkt-availability-probe")
+    t.start()
+    t.join()
+    if "error" in out:
+        raise out["error"]
+    return out["value"]
+
+
 def kkt_method_available(size: int = 7) -> bool:
     """Eagerly probe the Pallas LDLᵀ path on the current backend ONCE per
     padded problem size.
@@ -269,14 +296,18 @@ def kkt_method_available(size: int = 7) -> bool:
         W = A @ A.T + 3 * np.eye(n)
         Jg = rng.normal(size=(m, n))
         K = np.block([[W, Jg.T], [Jg, -1e-6 * np.eye(m)]])
-        # batch 2 pads to the full 128-lane tile — the production shape
-        Kb = jnp.asarray(np.stack([K, K]), jnp.float32)
-        rhs = jnp.asarray(rng.normal(size=(2, n + m)), jnp.float32)
-        x = jax.vmap(solve_kkt_ldl)(Kb, rhs)
-        res = jnp.max(jnp.abs(jnp.einsum("bij,bj->bi", Kb, x) - rhs))
-        # eager probe on CONCRETE arrays (memoized, runs once per padded
-        # size at trace time) — bool() here never sees a tracer
-        ok = bool(jnp.isfinite(res) and res < 1e-2)  # lint: ignore[jit-host-sync]
+
+        def _probe():
+            # batch 2 pads to the full 128-lane tile — the production
+            # shape; eager on CONCRETE arrays (run_probe_outside_trace
+            # escapes any ambient trace), so bool() never sees a tracer
+            Kb = jnp.asarray(np.stack([K, K]), jnp.float32)
+            rhs = jnp.asarray(rng.normal(size=(2, n + m)), jnp.float32)
+            x = jax.vmap(solve_kkt_ldl)(Kb, rhs)
+            res = jnp.max(jnp.abs(jnp.einsum("bij,bj->bi", Kb, x) - rhs))
+            return bool(jnp.isfinite(res) and res < 1e-2)  # lint: ignore[jit-host-sync]
+
+        ok = run_probe_outside_trace(_probe)
     except Exception:  # noqa: BLE001 - any compile/runtime failure
         ok = False
     _PROBE_RESULT[key] = ok

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from agentlib_mpc_tpu.ops.solver import (
+    KKT_PATHS,
     NLPFunctions,
     SolverOptions,
     SolverResult,
@@ -46,6 +47,7 @@ from agentlib_mpc_tpu.ops.solver import (
     _factor_kkt,
     _max_step,
     _resolve_kkt,
+    _resolve_method,
     _safe_max,
 )
 
@@ -174,6 +176,13 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
     m_e = nlp.g(w0, theta).shape[0]
     m_h = nlp.h(w0, theta).shape[0]
 
+    # factor path resolved once at trace time (constant structure: the
+    # QP KKT has the same stage-banded form as the NLP solver's, so the
+    # stage sweep drops in here first — no refactor churn)
+    kkt_path = _resolve_method(opts.kkt_method, n + m_e if m_e else n,
+                               opts.stage_partition, opts.stage_min_size)
+    kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
+
     f_raw = lambda w: nlp.f(w, theta)
     g_raw = lambda w: nlp.g(w, theta)
     h_raw = lambda w: nlp.h(w, theta)
@@ -301,7 +310,7 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
             ])
         else:
             K = W
-        factor = _factor_kkt(K, opts.kkt_method)
+        factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(mu_s, mu_L, mu_U):
             """Direction for per-entry complementarity targets (same
@@ -433,6 +442,7 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
         objective=f_val(w) / s_f,
         mu=mu_f,
         constraint_violation=viol_raw,
+        kkt_path=kkt_path_code,
     )
     return SolverResult(
         w=w * d_w,

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.ops import kkt as kkt_ops
+from agentlib_mpc_tpu.ops import stagewise as stage_ops
 
 
 class NLPFunctions(NamedTuple):
@@ -97,8 +98,13 @@ class SolverOptions(NamedTuple):
     scale_variables: bool = True
     #: centrality clip for all dual variables (IPOPT kappa_sigma)
     kappa_sigma: float = 1e10
-    #: KKT linear solver: "auto" → Pallas LDLᵀ on TPU, LU elsewhere;
-    #: "ldl" / "lu" force a path
+    #: KKT linear solver: "auto" → Pallas LDLᵀ where its probe passes
+    #: (TPU); elsewhere the stage-structured block-tridiagonal sweep when
+    #: a :class:`~agentlib_mpc_tpu.ops.stagewise.StagePartition` is
+    #: attached and the system is at least ``stage_min_size`` (the fatrop
+    #: role — O(N·n_s³) instead of O((N·n_s)³) on long horizons, measured
+    #: against dense LU), else LU; "stage" / "ldl" / "lu" force a path
+    #: ("stage" requires a matching ``stage_partition``)
     kkt_method: str = "auto"
     #: evaluate the stacked value+Jacobian for ALL line-search candidates
     #: inside the one batched trial call and select the accepted one,
@@ -117,6 +123,49 @@ class SolverOptions(NamedTuple):
     #: schedule, not step centrality, binds) — available for workloads
     #: with tighter per-iteration budgets (e.g. warm inexact ADMM solves)
     corrector: bool = False
+    #: stage metadata of the transcribed OCP's KKT system — static and
+    #: hashable, auto-attached by the backends / fused fleet from
+    #: ``TranscribedOCP.stage_partition`` (a config cannot express it).
+    #: Required by ``kkt_method="stage"``; consulted by ``"auto"``.
+    stage_partition: "stage_ops.StagePartition | None" = None
+    #: "auto" crossover: smallest KKT dimension routed to the stage
+    #: sweep. Below it the dense factorizations win — the sweep's
+    #: sequential S-stage scan costs more than one small dense factor
+    #: (measured on the N=32/128/256 components table, PERF.md
+    #: "Stage-structured KKT factorization"); forcing
+    #: ``kkt_method="stage"`` ignores this floor.
+    stage_min_size: int = 192
+
+
+def attach_stage_partition(options: SolverOptions,
+                           partition) -> SolverOptions:
+    """Attach a transcribed OCP's stage partition to solver options when
+    they could use it (``kkt_method`` "auto"/"stage" and none attached
+    yet). The ONE place the attach rule lives — the module backends and
+    the fused fleet both route through it, so they cannot drift."""
+    if (partition is not None and options.stage_partition is None
+            and options.kkt_method in ("auto", "stage")):
+        return options._replace(stage_partition=partition)
+    return options
+
+
+#: factor-path codes carried in ``SolverStats.kkt_path`` (resolved at
+#: trace time, baked into the executable as a constant — so every solve
+#: reports which factorization actually ran without a host round-trip)
+KKT_PATHS = ("lu", "ldl", "stage")
+
+
+def kkt_path_name(code) -> "str | None":
+    """Human-readable factor path from a ``SolverStats.kkt_path`` value
+    (possibly batched; the code is a per-trace constant). None when the
+    stats predate the field or carry the -1 default."""
+    import numpy as np
+
+    try:
+        i = int(np.asarray(code).reshape(-1)[0])
+    except (TypeError, ValueError):
+        return None
+    return KKT_PATHS[i] if 0 <= i < len(KKT_PATHS) else None
 
 
 class SolverStats(NamedTuple):
@@ -126,6 +175,9 @@ class SolverStats(NamedTuple):
     objective: jnp.ndarray
     mu: jnp.ndarray
     constraint_violation: jnp.ndarray
+    #: index into :data:`KKT_PATHS` of the factorization that ran (a
+    #: trace-time constant; -1 = unknown/legacy constructor)
+    kkt_path: "jnp.ndarray | int" = -1
 
 
 class SolverResult(NamedTuple):
@@ -153,11 +205,21 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
     succ = np.atleast_1d(np.asarray(stats.success))
     kkt = np.atleast_1d(np.asarray(stats.kkt_error))
     m = telemetry.solver_metrics()
+    path = kkt_path_name(getattr(stats, "kkt_path", -1))
+    if path is not None:
+        # which factorization ran, per solve (a trace-time constant
+        # baked into the stats; its own family so the established
+        # solver_* label sets stay stable for existing dashboards)
+        path_counter = telemetry.counter(
+            "solver_kkt_path_solves_total",
+            "solves by KKT factorization path (lu / ldl / stage)")
     for i in range(iters.shape[0]):
         m["solves"].inc(**labels)
         m["iterations"].observe(float(iters[i]), **labels)
         if not bool(succ[i]):
             m["failures"].inc(**labels)
+        if path is not None:
+            path_counter.inc(kkt_path=path, **labels)
     m["kkt_error"].set(float(np.max(kkt)), **labels)
 
 
@@ -209,25 +271,55 @@ def _resolve_kkt_lu(factor, rhs):
     return x * scale
 
 
-def _resolve_method(method: str, size: int) -> str:
+def _resolve_method(method: str, size: int,
+                    partition=None, stage_min_size: int = 0) -> str:
+    if method == "stage":
+        if partition is None or partition.n_total != size:
+            raise ValueError(
+                f"kkt_method='stage' requires a stage_partition matching "
+                f"the {size}-dim KKT system (got "
+                f"{None if partition is None else partition.n_total}); "
+                f"the backends attach it from TranscribedOCP."
+                f"stage_partition automatically")
+        return "stage"
     if method == "auto":
         # TPU → Pallas LDLᵀ, after a one-time eager probe AT THIS padded
         # size that falls back to LU if the kernel cannot compile/run on
         # this backend at the production tile shape
-        return "ldl" if kkt_ops.kkt_method_available(size) else "lu"
+        dense = "ldl" if kkt_ops.kkt_method_available(size) else "lu"
+        # stage-structured sweep over the DENSE-LU path only: its
+        # ``stage_min_size`` crossover is measured against LU on CPU
+        # (PERF.md round 6). Where the lanes-batched Pallas LDLᵀ is live
+        # (TPU), the sweep's S sequential scan steps are unmeasured
+        # against the tuned one-dispatch kernel, so it stays opt-in
+        # (``kkt_method="stage"``) until silicon says otherwise.
+        if (dense == "lu" and partition is not None
+                and partition.n_total == size
+                and size >= stage_min_size
+                and stage_ops.stage_method_available(partition)):
+            return "stage"
+        return dense
     return method
 
 
-def _factor_kkt(K, method: str):
+def _factor_kkt(K, method: str, partition=None, stage_min_size: int = 0):
     """Factor once; returns a method-tagged factor so the resolve path
     cannot diverge from the factor path."""
-    if _resolve_method(method, K.shape[-1]) == "ldl":
+    resolved = _resolve_method(method, K.shape[-1], partition,
+                               stage_min_size)
+    if resolved == "stage":
+        return ("stage", (stage_ops.factor_kkt_stage(K, partition),
+                          partition))
+    if resolved == "ldl":
         return ("ldl", kkt_ops.factor_kkt_ldl(K))
     return ("lu", _factor_kkt_lu(K))
 
 
 def _resolve_kkt(factor, rhs):
     kind, f = factor  # the factor carries its own method tag
+    if kind == "stage":
+        stage_factor, partition = f
+        return stage_ops.resolve_kkt_stage(stage_factor, rhs, partition)
     if kind == "ldl":
         return kkt_ops.resolve_kkt_ldl(f, rhs)
     return _resolve_kkt_lu(f, rhs)
@@ -331,6 +423,13 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
     f_raw = lambda w: nlp.f(w, theta)
     g_raw = lambda w: nlp.g(w, theta)
     h_raw = lambda w: nlp.h(w, theta)
+
+    # the factor path is a trace-time constant (static options + shapes);
+    # resolving it once here keeps the per-iteration dispatch and the
+    # reported stats from ever disagreeing
+    kkt_path = _resolve_method(opts.kkt_method, n + m_e if m_e else n,
+                               opts.stage_partition, opts.stage_min_size)
+    kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
 
     # ---- automatic scaling ---------------------------------------------------
     if opts.scale_variables:
@@ -469,7 +568,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             ])
         else:
             K = W
-        factor = _factor_kkt(K, opts.kkt_method)
+        factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(rhs_w_k, mu_s, mu_L, mu_U):
             """Direction from the stored factor for (possibly per-entry)
@@ -700,6 +799,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         objective=final.fv / s_f,
         mu=final.mu,
         constraint_violation=viol_raw,
+        kkt_path=kkt_path_code,
     )
     return SolverResult(
         w=w_out, y=y_out, z=z_out,

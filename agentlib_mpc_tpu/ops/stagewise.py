@@ -1,0 +1,343 @@
+"""Stage-structured KKT factorization: block-tridiagonal LDLᵀ over stages.
+
+The fatrop role (Vanroye et al., "FATROP: A fast constrained optimal
+control problem solver"; reference dispatch ``casadi_utils.py:52-61,
+218-237``): an OCP transcribed by collocation or multiple shooting gives
+the interior-point KKT matrix
+
+    K = [[W, Jgᵀ], [Jg, -δ_c I]]
+
+a *stage* structure — every Hessian/Jacobian entry couples variables and
+equality multipliers of at most two ADJACENT horizon intervals (stage
+costs and defects are per-interval; only the continuity/shooting rows and
+the Δu penalty reach one stage ahead). Under the symmetric stage
+permutation exported by :func:`build_stage_partition` the matrix is block
+tridiagonal, so it factors by a Riccati-style block sweep (Rao, Wright &
+Rawlings 1998) in O(N·n_s³) instead of the dense O((N·n_s)³):
+
+    C₀ = D₀,   C_k = D_k − E_k C_{k-1}⁻¹ E_kᵀ   (k = 1..S-1)
+
+with each stage block C_k factored by the same pivot-free quasi-definite
+LDLᵀ as the dense path (``ops/kkt.py``: Vanderbei 1995 — any symmetric
+permutation of a quasi-definite matrix is strongly factorizable, and the
+Schur complement of a quasi-definite block is again quasi-definite). The
+sweep is a ``lax.scan``; under the agent-axis ``vmap`` of the fused fleet
+the per-stage LDLᵀ dispatches to the lanes-batched Pallas kernel on TPU
+exactly like the dense path, so the module is vmap-transparent end to
+end. Symmetric Jacobi equilibration + iterative refinement wrap the sweep
+the same way they wrap the dense factorizations, so f32 accuracy and the
+solver's finite-merit/delta-growth self-healing loop are unchanged.
+
+Measured crossover vs the dense factor's own components table (PERF.md
+"horizon-axis sharding"): the dense factor grows 2.0 → 33.4 → 236 ms for
+N = 32/128/256 (KKT 290/1154/2306) while the stage sweep stays ~linear in
+N — see PERF.md "Stage-structured KKT factorization" for the measured
+table and the default ``SolverOptions.stage_min_size`` rationale.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.ops import kkt as kkt_ops
+
+_HI = jax.lax.Precision.HIGHEST
+
+__all__ = [
+    "StagePartition",
+    "build_stage_partition",
+    "factor_kkt_stage",
+    "resolve_kkt_stage",
+    "solve_kkt_stage",
+    "stage_method_available",
+    "synthetic_stage_kkt",
+]
+
+
+class StagePartition(NamedTuple):
+    """Static stage metadata of a transcribed OCP's KKT system.
+
+    Hashable (plain ints + an int tuple) so it can ride inside the
+    static ``SolverOptions`` without breaking jit caching or the fused
+    fleet's bucket keys. ``perm`` lists, stage by stage, the original
+    KKT index (variable indices < ``n_w``, equality-row ``j`` at
+    ``n_w + j``) each padded slot holds; ``-1`` marks padding slots
+    (stages are padded to one uniform ``block`` size so the sweep is a
+    single ``lax.scan``)."""
+
+    n_stages: int          # S: horizon intervals + the terminal state
+    block: int             # n_s: uniform (padded) stage block size
+    n_w: int               # primal dimension (indices below are variables)
+    n_total: int           # KKT dimension this partition describes
+    perm: tuple            # len S*n_s; original index or -1 (padding)
+
+
+def build_stage_partition(N: int, n_x: int, n_u: int, n_z: int, d: int,
+                          method: str,
+                          fix_initial_state: bool = True) -> StagePartition:
+    """Stage partition for :func:`ops.transcription.transcribe` layouts.
+
+    Mirrors the decision-pytree flattening order (``ravel_pytree`` of a
+    dict sorts keys: u, x, xc, z) and the equality-constraint stacking
+    order of ``g_fn`` (initial pin, then all defects, then continuity
+    for collocation; initial pin then defects for shooting). Stage
+    ``i < N`` holds (u_i, x_i, xc_i, z_i) plus the multipliers of the
+    constraints anchored at interval ``i``; stage ``N`` holds x_N."""
+    if method not in ("collocation", "multiple_shooting"):
+        raise ValueError(f"unknown transcription method {method!r}")
+    is_colloc = method == "collocation"
+    n_xc = d * n_x if is_colloc else 0
+    n_zi = d * n_z if is_colloc else n_z
+    n_def = d * n_x if is_colloc else n_x
+
+    off_u = 0
+    off_x = N * n_u
+    off_xc = off_x + (N + 1) * n_x
+    off_z = off_xc + N * n_xc
+    n_w = off_z + N * n_zi
+
+    base = n_w                       # equality row j sits at KKT index base+j
+    off_init = base
+    n_init = n_x if fix_initial_state else 0
+    off_def = off_init + n_init
+    off_cont = off_def + N * n_def   # collocation only
+    m_e = n_init + N * n_def + (N * n_x if is_colloc else 0)
+    n_total = n_w + m_e
+
+    stages = []
+    for i in range(N):
+        idx = []
+        idx += list(range(off_u + i * n_u, off_u + (i + 1) * n_u))
+        idx += list(range(off_x + i * n_x, off_x + (i + 1) * n_x))
+        idx += list(range(off_xc + i * n_xc, off_xc + (i + 1) * n_xc))
+        idx += list(range(off_z + i * n_zi, off_z + (i + 1) * n_zi))
+        if i == 0:
+            idx += list(range(off_init, off_init + n_init))
+        idx += list(range(off_def + i * n_def, off_def + (i + 1) * n_def))
+        if is_colloc:
+            idx += list(range(off_cont + i * n_x, off_cont + (i + 1) * n_x))
+        stages.append(idx)
+    stages.append(list(range(off_x + N * n_x, off_x + (N + 1) * n_x)))
+
+    block = max(1, max(len(s) for s in stages))
+    perm = []
+    for s in stages:
+        perm += s + [-1] * (block - len(s))
+    used = sorted(p for p in perm if p >= 0)
+    if used != list(range(n_total)):
+        raise AssertionError(
+            "stage partition does not cover the KKT index space — the "
+            "transcription layout and build_stage_partition drifted apart")
+    return StagePartition(n_stages=len(stages), block=block, n_w=n_w,
+                          n_total=n_total, perm=tuple(perm))
+
+
+# --------------------------------------------------------------------------
+# permutation / block plumbing (all index arrays are static numpy)
+# --------------------------------------------------------------------------
+
+def _perm_arrays(p: StagePartition):
+    perm = np.asarray(p.perm, dtype=np.int64)
+    valid = perm >= 0
+    safe = np.where(valid, perm, 0)
+    # inverse map: padded-slot index holding each original KKT index
+    inv = np.empty((p.n_total,), dtype=np.int64)
+    inv[perm[valid]] = np.nonzero(valid)[0]
+    return perm, valid, safe, inv
+
+
+def _stage_blocks(Ks: jnp.ndarray, p: StagePartition):
+    """Permute an (M, M) matrix into stage order and extract the diagonal
+    (S, n_s, n_s) and sub-diagonal (S-1, n_s, n_s) blocks. Padding slots
+    become decoupled identity rows (pivot 1, rhs 0). Entries OUTSIDE the
+    tridiagonal band are dropped unread — the caller certifies bandedness
+    (structurally, via the transcription layout, or by probe)."""
+    _, valid, safe, _ = _perm_arrays(p)
+    S, ns = p.n_stages, p.block
+    Kp = Ks[safe][:, safe]
+    mask = valid[:, None] & valid[None, :]
+    Kp = jnp.where(mask, Kp, jnp.zeros((), Ks.dtype))
+    pad = np.nonzero(~valid)[0]  # unit pivots on the padding diagonal
+    Kp = Kp.at[pad, pad].set(1.0)
+    Kb = Kp.reshape(S, ns, S, ns)
+    D = Kb[np.arange(S), :, np.arange(S), :]
+    E = Kb[np.arange(1, S), :, np.arange(S - 1), :] if S > 1 else \
+        jnp.zeros((0, ns, ns), Ks.dtype)
+    return D, E
+
+
+def _solve_cols(F, B):
+    """Rows of the result solve against the rows of ``B``:
+    out[j] = C⁻¹ B[j]  (so C⁻¹ Bᵀ = outᵀ)."""
+    return jax.vmap(lambda r: kkt_ops.ldl_solve(F, r))(B)
+
+
+def _factor_blocks(D, E):
+    """Riccati-style block sweep: factor every stage Schur complement
+    C_k = D_k − E_k C_{k-1}⁻¹ E_kᵀ with the pivot-free LDLᵀ."""
+    F0 = kkt_ops.ldl_factor(D[0])
+    if D.shape[0] == 1:
+        return F0[None]
+
+    def step(F_prev, DE):
+        Dk, Ek = DE
+        Y = _solve_cols(F_prev, Ek)                   # Yᵀ = C_{k-1}⁻¹ Ekᵀ
+        Ck = Dk - jnp.matmul(Ek, Y.T, precision=_HI)
+        Ck = 0.5 * (Ck + Ck.T)                        # exact symmetry in fp
+        Fk = kkt_ops.ldl_factor(Ck)
+        return Fk, Fk
+
+    _, Fs = jax.lax.scan(step, F0, (D[1:], E))
+    return jnp.concatenate([F0[None], Fs], axis=0)
+
+
+def _solve_blocks(F, E, b):
+    """Forward/backward block substitution with the stored stage factors:
+    y₀ = b₀, y_k = b_k − E_k C_{k-1}⁻¹ y_{k-1};
+    x_S = C_S⁻¹ y_S, x_k = C_k⁻¹ (y_k − E_{k+1}ᵀ x_{k+1})."""
+    if b.shape[0] == 1:
+        return kkt_ops.ldl_solve(F[0], b[0])[None]
+
+    def fwd(y_prev, inp):
+        F_prev, Ek, bk = inp
+        t = kkt_ops.ldl_solve(F_prev, y_prev)
+        return bk - jnp.matmul(Ek, t, precision=_HI), y_prev
+
+    y_last, y_head = jax.lax.scan(fwd, b[0], (F[:-1], E, b[1:]))
+    ys = jnp.concatenate([y_head, y_last[None]], axis=0)
+    x_last = kkt_ops.ldl_solve(F[-1], ys[-1])
+
+    def bwd(x_next, inp):
+        Fk, E_next, yk = inp
+        xk = kkt_ops.ldl_solve(
+            Fk, yk - jnp.matmul(E_next.T, x_next, precision=_HI))
+        return xk, xk
+
+    _, xs = jax.lax.scan(bwd, x_last, (F[:-1], E, ys[:-1]), reverse=True)
+    return jnp.concatenate([xs, x_last[None]], axis=0)
+
+
+def _stage_solve_once(F, E, b, p: StagePartition):
+    _, valid, safe, inv = _perm_arrays(p)
+    bp = jnp.where(jnp.asarray(valid), b[safe], jnp.zeros((), b.dtype))
+    xp = _solve_blocks(F, E, bp.reshape(p.n_stages, p.block)).reshape(-1)
+    return xp[inv]
+
+
+# --------------------------------------------------------------------------
+# public factor / solve API (mirrors kkt.factor_kkt_ldl / resolve_kkt_ldl)
+# --------------------------------------------------------------------------
+
+def factor_kkt_stage(K: jnp.ndarray, partition: StagePartition):
+    """Equilibrate + block-tridiagonal factor once; returns an opaque
+    factor for :func:`resolve_kkt_stage` (predictor/corrector steps
+    re-solve new right-hand sides at one block back-substitution each).
+    Same symmetric Jacobi equilibration as the dense paths, so the scaled
+    matrix stays quasi-definite."""
+    scale = 1.0 / jnp.sqrt(jnp.maximum(jnp.max(jnp.abs(K), axis=-1), 1e-12))
+    Ks = K * scale[:, None] * scale[None, :]
+    D, E = _stage_blocks(Ks, partition)
+    F = _factor_blocks(D, E)
+    return (F, E, Ks, scale)
+
+
+def resolve_kkt_stage(factor, rhs: jnp.ndarray, partition: StagePartition,
+                      refine_steps: int = 2) -> jnp.ndarray:
+    """Solve with a stored stage factor + iterative refinement (f32-safe;
+    the residual matmul runs against the FULL scaled matrix, so dropped
+    out-of-band noise would surface here rather than pass silently)."""
+    F, E, Ks, scale = factor
+    rs = rhs * scale
+    x = _stage_solve_once(F, E, rs, partition)
+    for _ in range(refine_steps):
+        r = rs - jnp.matmul(Ks, x, precision=_HI)
+        x = x + _stage_solve_once(F, E, r, partition)
+    return x * scale
+
+
+def solve_kkt_stage(K: jnp.ndarray, rhs: jnp.ndarray,
+                    partition: StagePartition,
+                    refine_steps: int = 2) -> jnp.ndarray:
+    """Equilibrated block-tridiagonal solve with iterative refinement —
+    drop-in for :func:`kkt.solve_kkt_ldl` when a stage partition exists."""
+    return resolve_kkt_stage(factor_kkt_stage(K, partition), rhs,
+                             partition, refine_steps)
+
+
+# --------------------------------------------------------------------------
+# availability probe (mirrors kkt.kkt_method_available: eager, memoized,
+# at the production partition shape)
+# --------------------------------------------------------------------------
+
+def synthetic_stage_kkt(partition: StagePartition, seed: int = 0,
+                        dtype=None):
+    """Random symmetric quasi-definite matrix with EXACTLY the
+    partition's block-tridiagonal sparsity (in original index order) plus
+    a matching right-hand side — the probe/benchmark workload. Signed
+    diagonal dominance (positive on variable slots, negative on equality
+    slots) makes it quasi-definite and well conditioned."""
+    rng = np.random.default_rng(seed)
+    perm, valid, _safe, _inv = _perm_arrays(partition)
+    S, ns = partition.n_stages, partition.block
+    Kp = np.zeros((S * ns, S * ns))
+    for k in range(S):
+        blk = rng.normal(size=(ns, ns))
+        Kp[k * ns:(k + 1) * ns, k * ns:(k + 1) * ns] = 0.5 * (blk + blk.T)
+        if k:
+            off = 0.3 * rng.normal(size=(ns, ns))
+            Kp[k * ns:(k + 1) * ns, (k - 1) * ns:k * ns] = off
+            Kp[(k - 1) * ns:k * ns, k * ns:(k + 1) * ns] = off.T
+    mask = valid[:, None] & valid[None, :]
+    Kp[~mask] = 0.0
+    dom = 4.0 * ns
+    sign = np.where(perm < partition.n_w, 1.0, -1.0)
+    diag = np.where(valid, sign * dom, 0.0)
+    Kp[np.diag_indices_from(Kp)] += diag
+    M = partition.n_total
+    src = np.nonzero(valid)[0]
+    K = np.zeros((M, M))
+    K[np.ix_(perm[src], perm[src])] = Kp[np.ix_(src, src)]
+    rhs = rng.normal(size=(M,))
+    if dtype is not None:
+        K = K.astype(dtype)
+        rhs = rhs.astype(dtype)
+    return K, rhs
+
+
+_STAGE_PROBE: dict = {}
+
+
+def stage_method_available(partition: StagePartition) -> bool:
+    """Eagerly probe the stage path ONCE per (backend, partition): build a
+    synthetic banded quasi-definite system at the exact production
+    partition shape, run the full factor+refine solve, and check the
+    residual. Safety net in the same spirit as
+    :func:`kkt.kkt_method_available` — the solver's ``kkt_method="auto"``
+    consults this and falls back to the dense paths instead of crashing
+    on an environment where the sweep cannot compile or run."""
+    key = (jax.default_backend(), partition)
+    if key in _STAGE_PROBE:
+        return _STAGE_PROBE[key]
+    try:
+        K, rhs = synthetic_stage_kkt(partition)
+
+        def _probe():
+            # eager on CONCRETE arrays; the first resolution typically
+            # happens while TRACING the solver, so the probe escapes the
+            # ambient trace (thread-local contexts) — bool() below never
+            # sees a tracer
+            Kj = jnp.asarray(K)
+            rj = jnp.asarray(rhs)
+            x = solve_kkt_stage(Kj, rj, partition)
+            res = jnp.max(jnp.abs(Kj @ x - rj))
+            return bool(jnp.isfinite(res) and res < 1e-3)  # lint: ignore[jit-host-sync]
+
+        ok = kkt_ops.run_probe_outside_trace(_probe)
+    except Exception:  # noqa: BLE001 - any compile/runtime failure
+        ok = False
+    _STAGE_PROBE[key] = ok
+    return ok

@@ -39,6 +39,10 @@ from agentlib_mpc_tpu.models.model import Model
 from agentlib_mpc_tpu.ops.collocation import collocation_matrices
 from agentlib_mpc_tpu.ops.integrators import integrate
 from agentlib_mpc_tpu.ops.solver import NLPFunctions
+from agentlib_mpc_tpu.ops.stagewise import (
+    StagePartition,
+    build_stage_partition,
+)
 
 # value used in place of +-inf bounds (interior-point needs finite boxes;
 # gradients of the barrier at this distance underflow harmlessly)
@@ -83,6 +87,12 @@ class TranscribedOCP:
     shift_guess: Callable[[jnp.ndarray, OCPParams], jnp.ndarray]
     trajectories: Callable[[jnp.ndarray, OCPParams], dict]
     default_params: Callable[..., OCPParams]
+    #: stage metadata of the KKT system this transcription produces —
+    #: collocation/shooting couple adjacent intervals only, so the
+    #: interior-point KKT matrix is block tridiagonal under this
+    #: partition (``ops/stagewise.py``); the backends attach it to
+    #: ``SolverOptions.stage_partition`` for the structured factorization
+    stage_partition: "StagePartition | None" = None
 
     @property
     def state_grid(self):
@@ -290,6 +300,15 @@ def transcribe(
     n_g = int(g_fn(w_flat0, theta0).shape[0])
     n_h = int(h_fn(w_flat0, theta0).shape[0])
 
+    # stage metadata for the structured KKT factorization; the covered
+    # index space must match the (n_w + n_g)-dim KKT system exactly or
+    # the layout assumptions above and build_stage_partition drifted
+    stage_partition = build_stage_partition(
+        N=N, n_x=n_x, n_u=n_u, n_z=n_z, d=d, method=method,
+        fix_initial_state=fix_initial_state)
+    assert stage_partition.n_total == n_w + n_g, \
+        (stage_partition.n_total, n_w, n_g)
+
     # ---- bounds --------------------------------------------------------------
     def bounds_fn(theta: OCPParams):
         x_lb = _finite(theta.x_lb, -BIG)
@@ -387,6 +406,7 @@ def transcribe(
         shift_guess=shift_guess_fn,
         trajectories=trajectories_fn,
         default_params=default_params,
+        stage_partition=stage_partition,
     )
 
 

@@ -208,7 +208,12 @@ class FusedADMM:
         when unused. :class:`~agentlib_mpc_tpu.parallel.config_bridge.FusedFleet`
         opts in when built with ``record=True`` (its default) because its
         results/animation API consumes them."""
-        self.groups = tuple(groups)
+        # the consensus/exchange augmentation is quadratic per stage, so a
+        # group's KKT system keeps its OCP's stage-banded structure inside
+        # ADMM — attach each group's TranscribedOCP.stage_partition to its
+        # (cold and warm) solver options, mirroring the module backends'
+        # attach_stage_partition plumbing
+        self.groups = tuple(self._with_stage_partition(g) for g in groups)
         self.options = options
         self.record_locals = bool(record_locals)
         if active is None:
@@ -246,6 +251,22 @@ class FusedADMM:
                 f"coupling and exchange — give the two couplings "
                 f"distinct aliases")
         self._step = jax.jit(self._build_step())
+
+    @staticmethod
+    def _with_stage_partition(g: AgentGroup) -> AgentGroup:
+        from agentlib_mpc_tpu.ops.solver import attach_stage_partition
+
+        part = getattr(g.ocp, "stage_partition", None)
+        if part is None:
+            return g
+
+        def attach(opts):
+            return None if opts is None else attach_stage_partition(opts,
+                                                                    part)
+
+        return dataclasses.replace(
+            g, solver_options=attach(g.solver_options),
+            warm_solver_options=attach(g.warm_solver_options))
 
     # -- state ----------------------------------------------------------------
 

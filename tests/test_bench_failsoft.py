@@ -37,6 +37,70 @@ def _fake_measurement(step_ms=100.0, platform="cpu") -> dict:
 @pytest.fixture(autouse=True)
 def _plain_argv(monkeypatch):
     monkeypatch.setattr(sys, "argv", ["bench.py"])
+    # collapse the bounded tunnel re-probe window: these contract tests
+    # fake a permanently-dead probe and must not wait out real re-probe
+    # sleeps (the retry behavior itself is pinned by TestBoundedReprobe)
+    monkeypatch.setattr(bench, "PROBE_RETRY_WINDOW_S", 0.0)
+
+
+class TestBoundedReprobe:
+    """VERDICT r5 weak #2 / task #1: the driver invocation re-runs the
+    watchdogged platform probe on failure — bounded window, every attempt
+    logged as ``probe_attempts`` in the final JSON line."""
+
+    def test_late_tunnel_revival_is_caught(self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "PROBE_RETRY_INTERVAL_S", 0.01)
+        monkeypatch.setattr(bench, "PROBE_RETRY_WINDOW_S", 5.0)
+        results = iter([None, None, "tpu"])
+        monkeypatch.setattr(bench, "_default_platform",
+                            lambda: next(results))
+
+        def fake_spawn(args, env, timeout):
+            if "--worker" in args:
+                return [dict(_fake_measurement(50.0, "tpu"),
+                             section="headline")]
+            return [_fake_measurement(100.0)]   # the CPU baseline probe
+
+        monkeypatch.setattr(bench, "_spawn", fake_spawn)
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "tpu"
+        assert line["tpu_fallback_to_cpu"] is False
+        assert [a["platform"] for a in line["probe_attempts"]] == \
+            [None, None, "tpu"]
+
+    def test_window_exhaustion_logs_every_attempt(self, monkeypatch,
+                                                  capsys):
+        monkeypatch.setattr(bench, "PROBE_RETRY_INTERVAL_S", 0.01)
+        monkeypatch.setattr(bench, "PROBE_RETRY_WINDOW_S", 0.05)
+        monkeypatch.setattr(bench, "_default_platform", lambda: None)
+        monkeypatch.setattr(bench, "_spawn",
+                            lambda a, e, t: [_fake_measurement()])
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "cpu"
+        # >= 2 real attempts across the window, all failed
+        assert len(line["probe_attempts"]) >= 2
+        assert all(a["platform"] is None for a in line["probe_attempts"])
+
+    def test_clean_cpu_answer_is_never_retried(self, monkeypatch, capsys):
+        """A machine that ANSWERS "cpu" has no tunnel to wait for — one
+        probe, no sleeps (tests and CPU boxes must not pay the window)."""
+        monkeypatch.setattr(bench, "PROBE_RETRY_WINDOW_S", 900.0)
+        calls = []
+
+        def probe():
+            calls.append(1)
+            return "cpu"
+
+        monkeypatch.setattr(bench, "_default_platform", probe)
+        monkeypatch.setattr(bench, "_spawn",
+                            lambda a, e, t: [_fake_measurement()])
+        bench.main()
+        line = _headline_lines(capsys)[-1]
+        assert line["platform"] == "cpu"
+        assert len(calls) == 1
+        assert len(line["probe_attempts"]) == 1
 
 
 class TestFailsoft:

@@ -37,6 +37,21 @@ class TestRetraceBudgetGate:
         assert all(delta == 0 for delta in report["deltas"].values()), \
             report["deltas"]
 
+    def test_zero_recompiles_with_stage_factorization(self):
+        """The checked-in lint_budgets.toml pins kkt_method="stage": the
+        stage-structured KKT sweep (ops/stagewise.py) inside the fused
+        fleet must hold the same zero-recompile steady state as the
+        dense paths it replaces — its scan/permutation plumbing is all
+        static, so one warm trace serves every round."""
+        report = run_gate(budgets={"retrace": {
+            "warmup_rounds": 2, "rounds": 3, "n_agents": 4,
+            "kkt_method": "stage", "budgets": {"default": 0}}},
+            verbose=False)
+        assert report["kkt_method"] == "stage"
+        assert report["violations"] == [], report
+        assert all(delta == 0 for delta in report["deltas"].values()), \
+            report["deltas"]
+
     def test_weak_typed_init_state_is_caught_by_the_gate(
             self, compile_profiler):
         """Re-introduce the PR 2 bug at runtime: replace the strong-typed

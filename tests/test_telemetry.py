@@ -365,7 +365,8 @@ class TestStatsHistoryBackCompat:
     same key schema, same mutability (ISSUE 1 satellite)."""
 
     EXPECTED_KEYS = {"time", "iterations", "success", "kkt_error",
-                     "objective", "constraint_violation", "solve_wall_time"}
+                     "objective", "constraint_violation", "solve_wall_time",
+                     "kkt_path"}
 
     @pytest.fixture(scope="class")
     def backend(self):
@@ -402,6 +403,8 @@ class TestStatsHistoryBackCompat:
         assert isinstance(row["success"], bool)
         assert isinstance(row["kkt_error"], float)
         assert isinstance(row["solve_wall_time"], float)
+        # per-solve factor-path attribution (lu on CPU for this tiny OCP)
+        assert row["kkt_path"] in ("lu", "ldl", "stage")
 
     def test_history_is_mutable_list(self, backend):
         hist = backend.stats_history
