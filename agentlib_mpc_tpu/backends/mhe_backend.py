@@ -162,19 +162,27 @@ class MHEBackend(OptimizationBackend):
 
     def _resolve_qp_fast_path(self) -> None:
         """Linear plant + weighted least-squares tracking = an LQ
-        estimation program (the tracking terms are quadratic in ``w``
-        for any weight, so probing this OCP's own nlp is exact)."""
+        estimation program. Measurements and weights ride in theta, so
+        the jaxpr certificate covers every measurement trajectory the
+        module will ever sample (the probe remains as cross-check)."""
         from agentlib_mpc_tpu.ops.qp import is_lq, resolve_qp_routing
 
+        theta0 = self.ocp.default_params()
+        n = int(self.ocp.initial_guess(theta0).shape[0])
+
+        def certifier():
+            from agentlib_mpc_tpu.lint.jaxpr import certify_lq
+
+            return certify_lq(self.ocp.nlp, theta0, n)
+
         def probe():
-            theta0 = self.ocp.default_params()
-            n = int(self.ocp.initial_guess(theta0).shape[0])
             return is_lq(self.ocp.nlp, theta0, n)
 
         self.uses_qp_fast_path = resolve_qp_routing(
             str((self.config.get("solver") or {})
                 .get("qp_fast_path", "auto")),
-            probe, logger=self.logger, label="the MHE OCP")
+            probe, logger=self.logger, label="the MHE OCP",
+            certifier=certifier)
 
     def _build_step_fn(self) -> None:
         ocp = self.ocp

@@ -110,21 +110,29 @@ class JAXBackend(OptimizationBackend):
         structure-exploiting Mehrotra QP solver — the role qpoases/osqp/
         proxqp play in the reference's solver menu
         (``data_structures/casadi_utils.py:52-61,127-161``). Config key
-        ``solver.qp_fast_path``: ``"auto"`` (default — a one-time
-        structure probe at setup decides), ``"on"`` (force; the caller
-        asserts LQ-ness), ``"off"``."""
+        ``solver.qp_fast_path``: ``"auto"`` (default — the jaxpr-level
+        LQ certificate decides at setup, sound for every theta, with the
+        sampled probe as cross-check/fallback), ``"on"`` (force; the
+        caller asserts LQ-ness), ``"off"``."""
         from agentlib_mpc_tpu.ops.qp import is_lq, resolve_qp_routing
 
+        theta0 = self.ocp.default_params()
+        n = int(self.ocp.initial_guess(theta0).shape[0])
+
+        def certifier():
+            from agentlib_mpc_tpu.lint.jaxpr import certify_lq
+
+            return certify_lq(self.ocp.nlp, theta0, n)
+
         def probe():
-            theta0 = self.ocp.default_params()
-            n = int(self.ocp.initial_guess(theta0).shape[0])
             return is_lq(self.ocp.nlp, theta0, n)
 
         self.uses_qp_fast_path = resolve_qp_routing(
             str((self.config.get("solver") or {})
                 .get("qp_fast_path", "auto")),
             probe, logger=self.logger,
-            label=f"the {type(self).__name__} OCP")
+            label=f"the {type(self).__name__} OCP",
+            certifier=certifier)
 
     def _precompile(self) -> None:
         """Trigger XLA compilation at setup with default inputs so the first

@@ -12,6 +12,10 @@ Modes:
   (edit the ``justification`` fields afterwards!).
 * ``--retrace-budget`` — run the runtime compile-budget gate against
   ``lint_budgets.toml`` (imports jax; the static modes never do).
+* ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
+  structure proof, dtype propagation, cost model) over the example-OCP
+  menu against the ``[jaxpr.expect]`` expectations in
+  ``lint_budgets.toml`` (imports jax, like the retrace gate).
 """
 
 from __future__ import annotations
@@ -36,6 +40,9 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument("--retrace-budget", action="store_true",
                         help="run the runtime compile-budget gate "
                              "(lint_budgets.toml)")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="run the semantic jaxpr certification "
+                             "passes over the example-OCP menu")
     parser.add_argument("--baseline", default=None,
                         help="baseline path (default: "
                              "<repo root>/lint_baseline.json)")
@@ -61,6 +68,27 @@ def main(argv: "list[str] | None" = None) -> int:
             if args.budgets else None
         report = retrace_budget.run_gate(budgets)
         return 1 if report["violations"] else 0
+
+    if args.jaxpr:
+        from agentlib_mpc_tpu.lint.jaxpr.examples import certificate_summary
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        expectations = load_budgets(args.budgets).get(
+            "jaxpr", {}).get("expect", {})
+        summary = certificate_summary(expectations)
+        for r in summary["examples"]:
+            status = "FAIL" if r["failures"] else "ok"
+            print(f"{r['name']}: lq={r['lq']} stage={r['stage_structure']} "
+                  f"dtype-advisories={len(r['dtype_findings'])} [{status}]")
+            for f in r["failures"]:
+                print(f"  FAILED: {f}")
+        if summary["failures"]:
+            print(f"FAILED: {summary['failures']} jaxpr certification "
+                  f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
+            return 1
+        print(f"jaxpr certification OK: {len(summary['examples'])} "
+              f"example OCP(s) proved", file=sys.stderr)
+        return 0
 
     if args.stats:
         print(json.dumps(collect_stats(args.root), indent=1))

@@ -1,0 +1,57 @@
+"""Semantic proofs over closed jaxprs: the layer below the AST passes.
+
+PR 3's ``jaxlint`` analyzes Python source; the questions the framework's
+two riskiest auto-routing decisions hang on — *is this function linear in
+``w``?* (the QP fast path), *does the KKT dependence structure really
+match the attached* :class:`~agentlib_mpc_tpu.ops.stagewise.StagePartition`?
+(the block-tridiagonal sweep) — are not syntactic. They are decidable
+exactly one level down, in the jaxpr, where JAX's tracing design (Frostig
+et al.) gives a complete dataflow IR of the traced function: every
+primitive application, every constant, no Python control flow left.
+
+Four passes over one shared per-primitive interpreter (:mod:`.interp`):
+
+* :func:`certify_lq` (:mod:`.lq`) — a polynomial-degree lattice
+  {const, affine, quadratic, nonpoly} propagated per element through
+  every primitive. Proves LQ structure *for all theta* (theta inputs are
+  symbolic degree-0 values, so a theta-gated nonlinearity keeps both
+  branches in the abstraction) — the sound replacement for the sampled
+  probe ``ops/qp.py:is_lq``, which only sees the default-theta branch.
+* :func:`certify_stage_structure` (:mod:`.structure`) — exact
+  w→(g, h) dependence propagation at stage granularity plus Hessian
+  interaction tracking, checked against the partition's
+  block-tridiagonal band: the transcribe-time *layout* assertion becomes
+  a proof against the actual traced functions.
+* :func:`check_dtypes` (:mod:`.dtypes`) — dtype/weak-type propagation:
+  f64 promotions, weak-type leaks into jaxpr outputs and loop carries,
+  x64-flag-dependent constants. The semantic complement of the AST
+  ``jit-weak-type`` pass.
+* :func:`op_cost` (:mod:`.cost`) — a per-primitive FLOP/bytes cost
+  model for ``bench.py --emit-metrics`` and PERF.md attribution tables.
+
+Soundness boundary: primitives the interpreter cannot see through
+(``pure_callback``, custom AD rules, foreign calls) make a *tainted*
+result opaque — :func:`certify_lq` then returns ``"unknown"`` instead of
+a verdict and the callers fall back to the sampled probe, with the
+fallback recorded as a finding. An opaque primitive whose inputs carry
+no ``w`` dependence is harmless (its output provably does not depend on
+``w`` either, by purity of jaxpr evaluation) and does not degrade the
+certificate.
+
+CLI: ``python -m agentlib_mpc_tpu.lint --jaxpr`` runs all passes over
+the example-OCP menu (:mod:`.examples`) against the expectations in
+``lint_budgets.toml``. See ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+from agentlib_mpc_tpu.lint.jaxpr.cost import CostEstimate, op_cost  # noqa: F401
+from agentlib_mpc_tpu.lint.jaxpr.dtypes import check_dtypes  # noqa: F401
+from agentlib_mpc_tpu.lint.jaxpr.lq import (  # noqa: F401
+    LQCertificate,
+    certify_lq,
+)
+from agentlib_mpc_tpu.lint.jaxpr.structure import (  # noqa: F401
+    StructureCertificate,
+    certify_stage_structure,
+)

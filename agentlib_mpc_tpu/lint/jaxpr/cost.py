@@ -1,0 +1,148 @@
+"""Per-primitive FLOP/bytes cost model over closed jaxprs.
+
+The analytical complement of the measured PERF.md tables: walk the
+jaxpr once, charge each primitive an arithmetic cost (FLOPs) and a
+memory cost (bytes touched = operands read + outputs written), recurse
+into ``pjit``/``cond`` bodies and multiply ``scan`` bodies by their trip
+count. The absolute numbers are a model, not a measurement — their
+value is *attribution* (which primitive family dominates a solve
+iteration, how cost scales with horizon) and regression tracking in
+``bench.py --emit-metrics`` artifacts, where certificates and costs
+ride next to the measured wall-clock phases.
+
+Charging rules: elementwise = output size (transcendentals weighted
+``TRANSCENDENTAL_FLOPS``), ``dot_general`` = 2·batch·M·N·K, reductions
+= input size, data movement = 0 FLOPs but full bytes. ``while`` bodies
+are charged ``WHILE_TRIP_GUESS`` trips (the model cannot know the trip
+count; the guess is reported in the estimate so tables stay honest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+
+import numpy as np
+
+__all__ = ["CostEstimate", "op_cost"]
+
+TRANSCENDENTAL_FLOPS = 8
+WHILE_TRIP_GUESS = 10
+
+_TRANSCENDENTAL = {
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "exp", "exp2", "expm1", "log", "log1p",
+    "sqrt", "rsqrt", "cbrt", "logistic", "erf", "erfc", "erf_inv",
+    "pow", "atan2", "digamma", "lgamma",
+}
+_FREE = {
+    "reshape", "broadcast_in_dim", "squeeze", "transpose", "rev",
+    "slice", "concatenate", "pad", "iota", "copy", "convert_element_type",
+    "gather", "scatter", "dynamic_slice", "dynamic_update_slice",
+    "stop_gradient", "select_n", "split", "expand_dims",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEstimate:
+    flops: int
+    bytes_accessed: int
+    per_primitive_flops: dict
+    per_primitive_bytes: dict
+    notes: tuple = ()
+
+    def top(self, k: int = 5) -> "list[tuple[str, int]]":
+        return Counter(self.per_primitive_flops).most_common(k)
+
+    def as_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes": self.bytes_accessed,
+            "per_primitive_flops": dict(sorted(
+                self.per_primitive_flops.items(),
+                key=lambda kv: -kv[1])),
+            "notes": list(self.notes),
+        }
+
+
+def _nbytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    if aval is None or not hasattr(aval, "dtype"):
+        return 0
+    return int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+
+
+def _out_size(eqn) -> int:
+    return sum(int(np.prod(v.aval.shape, dtype=np.int64))
+               for v in eqn.outvars if hasattr(v.aval, "shape"))
+
+
+def _dot_flops(eqn) -> int:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    a = eqn.invars[0].aval.shape
+    K = int(np.prod([a[d] for d in lc], dtype=np.int64))
+    out = _out_size(eqn)
+    return 2 * out * max(K, 1)
+
+
+def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
+            mult: int = 1) -> None:
+    for eqn in closed.jaxpr.eqns:
+        name = eqn.primitive.name
+        sub = None
+        if name == "pjit":
+            sub, m = eqn.params["jaxpr"], mult
+        elif name == "scan":
+            sub, m = eqn.params["jaxpr"], mult * int(eqn.params["length"])
+        elif name == "while":
+            sub, m = eqn.params["body_jaxpr"], mult * WHILE_TRIP_GUESS
+            notes.add(f"while charged {WHILE_TRIP_GUESS} trips (guess)")
+        elif name == "cond":
+            for br in eqn.params["branches"]:
+                _charge(br, flops, bytes_, notes, mult)
+            continue
+        if sub is not None:
+            _charge(sub, flops, bytes_, notes, m)
+            if name == "while":
+                _charge(eqn.params["cond_jaxpr"], flops, bytes_, notes, m)
+            continue
+        io_bytes = mult * (sum(_nbytes(v) for v in eqn.invars
+                               if hasattr(v, "aval"))
+                           + sum(_nbytes(v) for v in eqn.outvars))
+        bytes_[name] += io_bytes
+        if name in _FREE:
+            continue
+        if name == "dot_general":
+            flops[name] += mult * _dot_flops(eqn)
+        elif name in _TRANSCENDENTAL:
+            flops[name] += mult * TRANSCENDENTAL_FLOPS * _out_size(eqn)
+        elif name.startswith("reduce_") or name in ("cumsum", "argmax",
+                                                    "argmin"):
+            flops[name] += mult * sum(
+                int(np.prod(v.aval.shape, dtype=np.int64))
+                for v in eqn.invars if hasattr(v, "aval")
+                and hasattr(v.aval, "shape"))
+        else:
+            flops[name] += mult * _out_size(eqn)
+
+
+def op_cost(fn_or_jaxpr, *args) -> CostEstimate:
+    """Cost model of ``fn(*args)`` (or of an already-closed jaxpr when
+    called with no ``args`` and a ``ClosedJaxpr`` first argument)."""
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+    flops: Counter = Counter()
+    bytes_: Counter = Counter()
+    notes: "set[str]" = set()
+    _charge(closed, flops, bytes_, notes)
+    return CostEstimate(
+        flops=int(sum(flops.values())),
+        bytes_accessed=int(sum(bytes_.values())),
+        per_primitive_flops=dict(flops),
+        per_primitive_bytes=dict(bytes_),
+        notes=tuple(sorted(notes)),
+    )

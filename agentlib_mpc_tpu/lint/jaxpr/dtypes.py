@@ -1,0 +1,150 @@
+"""Dtype propagation over jaxprs: the semantic ``jit-weak-type`` pass.
+
+The AST pass (``lint/jit_hygiene.py``) flags weak-typed *constructions*
+it can see in source; what it cannot see is what tracing actually
+produced — a weak scalar that survived promotion and leaked into a
+jaxpr output (the retrace bug class: the aval changes between call 1
+and call 2), an f64 that appeared mid-graph under x64, a constant whose
+dtype flips with the x64 flag (so the same source compiles two
+different programs). Those live in the avals, so this pass just walks
+them:
+
+* ``jaxpr-weak-leak`` — a weakly-typed jaxpr output, or a weakly-typed
+  ``scan``/``while`` carry aval anywhere in the graph (carries are the
+  state pytrees that silently recompile fused programs);
+* ``jaxpr-f64-promotion`` — under ``enable_x64``, an equation whose
+  output is 64-bit wide while no input was (a promotion site), or an
+  explicit ``convert_element_type`` to f64;
+* ``jaxpr-x64-constant`` — a jaxpr const whose dtype differs between
+  the x64-off and x64-on traces of the same function.
+
+Findings are plain dicts (rule, where, detail) so the CLI can render
+them next to the AST findings.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["check_dtypes"]
+
+_WIDE = (np.float64, np.complex128, np.int64)
+
+
+def _is_wide(dtype) -> bool:
+    return any(np.issubdtype(dtype, w) for w in _WIDE)
+
+
+def _walk(closed, visit, path="jaxpr"):
+    visit(closed, path)
+    for i, eqn in enumerate(closed.jaxpr.eqns):
+        for key, val in eqn.params.items():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for j, v in enumerate(vals):
+                if hasattr(v, "jaxpr"):
+                    _walk(v, visit,
+                          f"{path}.eqns[{i}]<{eqn.primitive.name}>")
+
+
+def _weak_findings(closed, where: str) -> "list[dict]":
+    out = []
+
+    def visit(c, path):
+        jaxpr = c.jaxpr
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in ("scan", "while"):
+                if eqn.primitive.name == "scan":
+                    n0 = eqn.params["num_consts"]
+                    carries = eqn.invars[n0:n0 + eqn.params["num_carry"]]
+                else:
+                    n0 = (eqn.params["cond_nconsts"]
+                          + eqn.params["body_nconsts"])
+                    carries = eqn.invars[n0:]
+                for v in carries:
+                    if getattr(v.aval, "weak_type", False):
+                        out.append({
+                            "rule": "jaxpr-weak-leak",
+                            "where": where,
+                            "detail": f"weakly-typed {v.aval.dtype} "
+                                      f"{eqn.primitive.name} carry at "
+                                      f"{path} — avals can change "
+                                      f"between calls and retrace",
+                        })
+        if path == "jaxpr":
+            for i, v in enumerate(jaxpr.outvars):
+                if getattr(getattr(v, "aval", None), "weak_type", False):
+                    out.append({
+                        "rule": "jaxpr-weak-leak",
+                        "where": where,
+                        "detail": f"output {i} is weakly-typed "
+                                  f"{v.aval.dtype} — a caller storing it "
+                                  f"in carried state retraces",
+                    })
+
+    _walk(closed, visit)
+    return out
+
+
+def _f64_findings(closed_x64, where: str) -> "list[dict]":
+    out = []
+
+    def visit(c, path):
+        for eqn in c.jaxpr.eqns:
+            outs_wide = [v for v in eqn.outvars
+                         if hasattr(v.aval, "dtype")
+                         and _is_wide(v.aval.dtype)]
+            if not outs_wide:
+                continue
+            ins_wide = any(
+                hasattr(v.aval, "dtype") and _is_wide(v.aval.dtype)
+                for v in eqn.invars if hasattr(v, "aval"))
+            name = eqn.primitive.name
+            if name == "convert_element_type" or not ins_wide:
+                out.append({
+                    "rule": "jaxpr-f64-promotion",
+                    "where": where,
+                    "detail": f"{name} at {path} produces "
+                              f"{outs_wide[0].aval.dtype} from non-wide "
+                              f"inputs under x64 — this costs 2x "
+                              f"bytes/FLOPs on every accelerator path",
+                })
+
+    _walk(closed_x64, visit)
+    return out
+
+
+def check_dtypes(fn, *args: Any, x64_check: bool = True) -> "list[dict]":
+    """Trace ``fn(*args)`` and report dtype findings (see module doc).
+    With ``x64_check`` the function is traced a second time under
+    ``jax.experimental.enable_x64`` to surface promotions and
+    flag-dependent constants that the x64-off trace hides."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    findings = _weak_findings(closed, getattr(fn, "__name__", repr(fn)))
+    if not x64_check:
+        return findings
+    where = getattr(fn, "__name__", repr(fn))
+    try:
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            closed64 = jax.make_jaxpr(fn)(*args)
+    except Exception:  # x64 tracing can fail on f32-pinned code — fine,
+        return findings  # the x64-off findings stand on their own
+    findings.extend(_f64_findings(closed64, where))
+    if len(closed.consts) == len(closed64.consts):
+        for i, (c32, c64) in enumerate(zip(closed.consts, closed64.consts)):
+            d32 = np.asarray(c32).dtype
+            d64 = np.asarray(c64).dtype
+            if d32 != d64:
+                findings.append({
+                    "rule": "jaxpr-x64-constant",
+                    "where": where,
+                    "detail": f"const {i} is {d32} without x64 but {d64} "
+                              f"with it — the flag silently changes the "
+                              f"compiled program",
+                })
+    return findings

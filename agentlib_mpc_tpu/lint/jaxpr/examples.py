@@ -1,0 +1,143 @@
+"""Example-OCP menu the ``--jaxpr`` CLI mode and CI certify against.
+
+One entry per (model, transcription) configuration the framework
+exercises in its examples and tests: collocation at degree 1 and 2,
+multiple shooting, and the MHE-style free-initial-state variant — for a
+provably-LQ model (:class:`~agentlib_mpc_tpu.models.zoo.LinearRCZone`),
+the flagship bilinear model (:class:`~…zoo.OneRoom`) and the
+ADMM-coupled bilinear model (:class:`~…zoo.CooledRoom`). Every entry
+must pass stage-structure certification (the block-tridiagonal sweep
+routes on it) and match its expected LQ verdict (so a certifier
+regression — in either direction — fails CI, not production routing).
+
+Expectations can be overridden per entry from ``lint_budgets.toml``::
+
+    [jaxpr.expect]
+    "LinearRCZone/colloc-d2" = "lq"
+
+Horizon N is deliberately small: stage structure and polynomial degree
+are horizon-independent properties of the transcription rules, and the
+pass cost is linear in the jaxpr size.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+__all__ = ["EXAMPLE_OCPS", "ExampleOCP", "certify_example",
+           "certificate_summary"]
+
+_N = 4
+_DT = 300.0
+
+
+class ExampleOCP(NamedTuple):
+    name: str
+    build: Callable
+    expected_lq: str     # "lq" | "not_lq"
+
+
+def _entry(name, model_cls_name, controls, expected_lq, **kw):
+    def build():
+        from agentlib_mpc_tpu.models import zoo
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+
+        model = getattr(zoo, model_cls_name)()
+        return transcribe(model, controls, N=_N, dt=_DT, **kw)
+
+    return ExampleOCP(name=name, build=build, expected_lq=expected_lq)
+
+
+EXAMPLE_OCPS: "tuple[ExampleOCP, ...]" = (
+    _entry("LinearRCZone/colloc-d1", "LinearRCZone", ["Q"], "lq",
+           method="collocation", collocation_degree=1),
+    _entry("LinearRCZone/colloc-d2", "LinearRCZone", ["Q"], "lq",
+           method="collocation", collocation_degree=2),
+    _entry("LinearRCZone/shooting", "LinearRCZone", ["Q"], "lq",
+           method="multiple_shooting"),
+    _entry("LinearRCZone/colloc-d2-free-x0", "LinearRCZone", ["Q"], "lq",
+           method="collocation", collocation_degree=2,
+           fix_initial_state=False),
+    _entry("LinearRCZone/shooting-free-x0", "LinearRCZone", ["Q"], "lq",
+           method="multiple_shooting", fix_initial_state=False),
+    _entry("OneRoom/colloc-d2", "OneRoom", ["mDot"], "not_lq",
+           method="collocation", collocation_degree=2),
+    _entry("OneRoom/shooting", "OneRoom", ["mDot"], "not_lq",
+           method="multiple_shooting"),
+    _entry("CooledRoom/colloc-d1", "CooledRoom", ["mDot"], "not_lq",
+           method="collocation", collocation_degree=1),
+)
+
+
+def certify_example(example: ExampleOCP,
+                    expected_lq: "str | None" = None) -> dict:
+    """Run all four passes over one example; returns a result dict with
+    ``failures`` naming every broken expectation (empty = pass)."""
+    from agentlib_mpc_tpu.lint.jaxpr import (
+        certify_lq,
+        certify_stage_structure,
+        check_dtypes,
+        op_cost,
+    )
+
+    expected = expected_lq or example.expected_lq
+    ocp = example.build()
+    theta = ocp.default_params()
+    failures: "list[str]" = []
+
+    lq = certify_lq(ocp.nlp, theta, ocp.n_w)
+    if lq.status != expected:
+        failures.append(
+            f"LQ certificate is {lq.describe()}, expected {expected!r}")
+
+    stage = certify_stage_structure(ocp.nlp, theta, ocp.n_w,
+                                    ocp.stage_partition)
+    if not stage.ok:
+        failures.append(f"stage structure: {stage.describe()}")
+
+    # dtype pass: weak-type leaks are hard failures (the retrace bug
+    # class, x64-independent). The f64-promotion / x64-constant findings
+    # are ADVISORY here — the transcription deliberately traces with
+    # default (flag-following) dtypes, so under forced x64 every
+    # arange/constant legitimately widens; the findings still ride in
+    # the result dict for the --emit-metrics artifact and the CLI line.
+    dtype_findings = []
+    import jax.numpy as jnp
+
+    w0 = jnp.zeros((ocp.n_w,))
+    for fname, fn in (("f", ocp.nlp.f), ("g", ocp.nlp.g),
+                      ("h", ocp.nlp.h)):
+        for f in check_dtypes(fn, w0, theta):
+            f = dict(f, where=f"{example.name}:{fname}")
+            dtype_findings.append(f)
+            if f["rule"] == "jaxpr-weak-leak":
+                failures.append(f"{f['rule']} in {fname}: {f['detail']}")
+
+    costs = {fname: op_cost(fn, w0, theta).as_dict()
+             for fname, fn in (("f", ocp.nlp.f), ("g", ocp.nlp.g),
+                               ("h", ocp.nlp.h))}
+    return {
+        "name": example.name,
+        "lq": lq.describe(),
+        "lq_status": lq.status,
+        "expected_lq": expected,
+        "stage_structure": stage.describe(),
+        "stage_ok": stage.ok,
+        "dtype_findings": dtype_findings,
+        "cost": costs,
+        "failures": failures,
+    }
+
+
+def certificate_summary(expectations: "dict | None" = None) -> dict:
+    """All examples certified — the artifact ``bench.py --emit-metrics``
+    embeds next to the measured phases, and the body of the CLI
+    ``--jaxpr`` mode. ``expectations`` overrides per-name expected LQ
+    statuses (``lint_budgets.toml`` ``[jaxpr.expect]``)."""
+    expectations = expectations or {}
+    results = [certify_example(ex, expectations.get(ex.name))
+               for ex in EXAMPLE_OCPS]
+    return {
+        "examples": results,
+        "failures": sum(len(r["failures"]) for r in results),
+    }

@@ -1,0 +1,583 @@
+"""Shared abstract interpreter over closed jaxprs.
+
+One walk, many domains: the LQ-degree pass and the stage-dependence pass
+differ only in the per-element payload they propagate (a polynomial
+degree vs a stage bitmask) and in how arithmetic combines payloads. This
+module owns everything domain-independent:
+
+* the abstract value model — :class:`AVal` couples a per-element payload
+  array with an optional *concrete* value. Literals and jaxpr consts are
+  concrete; any primitive whose inputs are all concrete is evaluated
+  eagerly (plain ``prim.bind``), so index machinery (``iota``,
+  ``arange`` consts, clamp/select index fixups) stays exact instead of
+  smearing dependence through gathers;
+* the per-primitive registry (:data:`RULES`) classifying every primitive
+  as linear / nonlinear / structural / control-flow, with
+  domain-agnostic handling of the structural ones via the *ID trick*:
+  data-movement primitives (slice, reshape, gather, scatter, concat,
+  pad, …) are re-executed on int32 element-id arrays, which yields the
+  exact output→input element mapping for ANY dimension_numbers without
+  re-implementing XLA gather semantics;
+* recursion into higher-order primitives: ``pjit`` inlines, ``scan`` /
+  ``while`` run their bodies to a payload fixpoint (the lattices are
+  finite, so this terminates), ``cond`` joins branches under the
+  predicate rule;
+* the soundness fallback: an unknown or opaque primitive with
+  ``w``-tainted inputs *smears* (output gets the domain's top + the
+  event is recorded on the domain); with untainted inputs its output is
+  provably ``w``-independent (jaxpr evaluation is a pure function of the
+  inputs), so precision survives.
+
+Domains subclass :class:`Domain` and provide the payload algebra; see
+:mod:`.lq` and :mod:`.structure`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["AVal", "Domain", "interpret_closed", "run_nlp_function"]
+
+
+@dataclasses.dataclass
+class AVal:
+    """Abstract value: per-element ``payload`` (numpy array, domain
+    dtype, shaped like the value) plus the concrete value when it is
+    independent of every symbolic input (``None`` otherwise)."""
+
+    payload: np.ndarray
+    const: "np.ndarray | None" = None
+
+    @property
+    def is_const(self) -> bool:
+        return self.const is not None
+
+
+class Domain:
+    """Payload algebra one pass plugs into the shared walk.
+
+    ``zero()`` is the payload of a value with no ``w`` dependence (also
+    used for concrete values and fill/padding). ``is_zero`` must hold
+    for it. The binary/unary hooks receive *broadcast* payload arrays
+    (already shaped like the output) and return the output payload.
+    """
+
+    dtype: Any = object
+
+    def __init__(self):
+        self.notes: list[str] = []
+        self.opaque: list[str] = []   # tainted opaque primitives seen
+
+    # -- payload constructors ------------------------------------------------
+    def zero(self):
+        raise NotImplementedError
+
+    def w_element(self, flat_index: int):
+        """Payload of element ``flat_index`` of the ``w`` input."""
+        raise NotImplementedError
+
+    def zeros(self, shape) -> np.ndarray:
+        out = np.empty(shape, dtype=self.dtype)
+        out[...] = self.zero()
+        return out
+
+    def is_zero(self, payload_arr: np.ndarray) -> bool:
+        z = self.zero()
+        return bool(np.all(payload_arr == z)) if payload_arr.size else True
+
+    # -- algebra -------------------------------------------------------------
+    def join(self, args: "list[np.ndarray]") -> np.ndarray:
+        """Linear combination (add/sub/sum/…): no new nonlinearity."""
+        raise NotImplementedError
+
+    def mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def div(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def int_pow(self, a: np.ndarray, y: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def nonlinear(self, args: "list[np.ndarray]") -> np.ndarray:
+        """Smooth nonlinear op (sin/exp/…, generic pow)."""
+        raise NotImplementedError
+
+    def nonsmooth(self, args: "list[np.ndarray]") -> np.ndarray:
+        """Piecewise-linear / comparison ops (max, min, abs, lt, …)."""
+        raise NotImplementedError
+
+    def select(self, pred: np.ndarray, cases: "list[np.ndarray]"
+               ) -> np.ndarray:
+        """``select_n`` with a symbolic predicate."""
+        raise NotImplementedError
+
+    def top_like(self, shape, args: "list[np.ndarray]") -> np.ndarray:
+        """Smear: conservative payload for an opaque primitive."""
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# primitive classification
+# --------------------------------------------------------------------------
+
+#: value-preserving / linear elementwise & reduction primitives: payload =
+#: elementwise join of the (broadcast) inputs; reductions join along axes
+LINEAR_EW = {
+    "add", "sub", "neg", "add_any", "copy", "real", "imag",
+    "reduce_precision",
+}
+LINEAR_REDUCE = {"reduce_sum": "axes", "cumsum": None, "cumlogsumexp": None}
+
+#: smooth nonlinear elementwise primitives (unary and binary)
+NONLINEAR_EW = {
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "exp", "exp2", "expm1", "log", "log1p",
+    "sqrt", "rsqrt", "cbrt", "logistic", "erf", "erfc", "erf_inv",
+    "pow", "atan2", "rem", "nextafter", "digamma", "lgamma",
+}
+
+#: piecewise / comparison / boolean elementwise primitives
+NONSMOOTH_EW = {
+    "max", "min", "abs", "sign", "floor", "ceil", "round", "clamp",
+    "lt", "le", "gt", "ge", "eq", "ne", "and", "or", "xor", "not",
+    "is_finite", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic",
+}
+
+#: nonlinear reductions
+NONLINEAR_REDUCE = {"reduce_prod"}
+NONSMOOTH_REDUCE = {"reduce_max", "reduce_min", "reduce_and", "reduce_or",
+                    "argmax", "argmin", "reduce_xor"}
+
+#: pure data movement: re-executed on element-id arrays (the ID trick).
+#: value (non-index) operand positions per primitive; ``None`` = all.
+STRUCTURAL: "dict[str, tuple | None]" = {
+    "slice": None,
+    "reshape": None,
+    "broadcast_in_dim": None,
+    "concatenate": None,
+    "squeeze": None,
+    "transpose": None,
+    "rev": None,
+    "expand_dims": None,
+    "gather": (0,),
+    "dynamic_slice": (0,),
+    "dynamic_update_slice": (0, 1),
+    "scatter": (0, 2),
+    "pad": (0, 1),
+    "split": None,
+}
+
+
+#: primitives that may run user host code — never executed during
+#: certification, even on fully concrete inputs
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "custom_call", "ffi_call",
+})
+
+
+def _aval_shape(var) -> tuple:
+    return tuple(var.aval.shape)
+
+
+def _literal_value(v):
+    return np.asarray(v.val)
+
+
+def _broadcast_payloads(domain: Domain, args: "list[AVal]", out_shape):
+    """Broadcast every arg's payload to ``out_shape`` (numpy rules; jax
+    elementwise primitives follow the same ones after their explicit
+    broadcast_in_dim insertions, so ranks already line up)."""
+    outs = []
+    for a in args:
+        p = a.payload
+        if p.shape != tuple(out_shape):
+            p = np.broadcast_to(p, out_shape)
+        outs.append(p)
+    return outs
+
+
+class _Interpreter:
+    def __init__(self, domain: Domain):
+        self.domain = domain
+
+    # -- helpers -------------------------------------------------------------
+    def _concrete_bind(self, prim, args: "list[AVal]", params) -> list:
+        vals = prim.bind(*[jax.numpy.asarray(a.const) for a in args],
+                         **params)
+        if not prim.multiple_results:
+            vals = [vals]
+        return [AVal(self.domain.zeros(np.shape(v)), np.asarray(v))
+                for v in vals]
+
+    def _smear(self, prim_name: str, args: "list[AVal]", out_vars) -> list:
+        """Opaque primitive with tainted inputs: domain top + a record."""
+        payloads = [a.payload for a in args]
+        self.domain.opaque.append(prim_name)
+        return [AVal(np.broadcast_to(
+            self.domain.top_like((), payloads).reshape(()),
+            _aval_shape(v)).copy()) for v in out_vars]
+
+    def _structural(self, eqn, args: "list[AVal]"):
+        """ID trick: run the primitive on int32 element ids; map payloads
+        through the resulting output→input element mapping. Index-like
+        operands must be concrete (else: smear)."""
+        data_pos = STRUCTURAL[eqn.primitive.name]
+        n = len(args)
+        data_pos = tuple(range(n)) if data_pos is None else data_pos
+        id_args, offsets = [], {}
+        next_id = 1                       # id 0 = "not from any operand"
+        for i, a in enumerate(args):
+            if i in data_pos:
+                size = int(np.prod(np.shape(a.payload), dtype=np.int64))
+                ids = (np.arange(size, dtype=np.int32) + next_id).reshape(
+                    np.shape(a.payload))
+                offsets[i] = next_id
+                next_id += size
+                id_args.append(jax.numpy.asarray(ids))
+            else:
+                if not a.is_const:
+                    return None           # symbolic indices: caller smears
+                id_args.append(jax.numpy.asarray(a.const))
+        outs = eqn.primitive.bind(*id_args, **eqn.params)
+        if not eqn.primitive.multiple_results:
+            outs = [outs]
+        flat_payloads = np.concatenate(
+            [np.asarray([self.domain.zero()], dtype=self.domain.dtype)]
+            + [args[i].payload.reshape(-1).astype(self.domain.dtype,
+                                                  copy=False)
+               for i in sorted(offsets)]) \
+            if offsets else np.asarray([self.domain.zero()],
+                                       dtype=self.domain.dtype)
+        results = []
+        for out in outs:
+            src = np.asarray(out).reshape(-1)
+            payload = flat_payloads[src].reshape(np.shape(out))
+            results.append(AVal(payload))
+        return results
+
+    # -- the walk ------------------------------------------------------------
+    def run(self, closed, in_avals: "list[AVal]") -> "list[AVal]":
+        jaxpr = closed.jaxpr
+        env: dict = {}
+
+        def read(v) -> AVal:
+            if isinstance(v, jax.core.Literal):
+                val = _literal_value(v)
+                return AVal(self.domain.zeros(val.shape), val)
+            return env[v]
+
+        def write(v, a: AVal):
+            env[v] = a
+
+        for var, const in zip(jaxpr.constvars, closed.consts):
+            cval = np.asarray(const)
+            write(var, AVal(self.domain.zeros(cval.shape), cval))
+        if len(jaxpr.invars) != len(in_avals):
+            raise ValueError(
+                f"jaxpr expects {len(jaxpr.invars)} inputs, got "
+                f"{len(in_avals)}")
+        for var, a in zip(jaxpr.invars, in_avals):
+            write(var, a)
+
+        for eqn in jaxpr.eqns:
+            args = [read(v) for v in eqn.invars]
+            outs = self.eqn(eqn, args)
+            for var, out in zip(eqn.outvars, outs):
+                write(var, out)
+        return [read(v) for v in jaxpr.outvars]
+
+    def eqn(self, eqn, args: "list[AVal]") -> "list[AVal]":
+        prim = eqn.primitive
+        name = prim.name
+        dom = self.domain
+
+        # anything computable from constants stays exact — including the
+        # whole index universe (iota/arange/clamp/select on indices).
+        # Callbacks are excluded: certification must never execute user
+        # host code; their w-independence is still proven below.
+        if all(a.is_const for a in args) and name not in _CALLBACK_PRIMS:
+            try:
+                return self._concrete_bind(prim, args, eqn.params)
+            except Exception:
+                pass  # fall through to the abstract rules
+
+        out_shapes = [_aval_shape(v) for v in eqn.outvars]
+
+        if name in LINEAR_EW:
+            ps = _broadcast_payloads(dom, args, out_shapes[0])
+            return [AVal(dom.join(ps))]
+        if name in LINEAR_REDUCE:
+            axes_key = LINEAR_REDUCE[name]
+            p = args[0].payload
+            if axes_key is not None:
+                axes = tuple(eqn.params[axes_key])
+                out = p
+                for ax in sorted(axes, reverse=True):
+                    parts = [np.take(out, i, axis=ax)
+                             for i in range(out.shape[ax])]
+                    out = dom.join(parts) if parts else dom.zeros(
+                        out_shapes[0])
+                out = np.broadcast_to(out, out_shapes[0]).copy()
+            else:
+                # cumulative op: every element joins its whole axis
+                # (prefix precision is not worth the complexity)
+                ax = eqn.params.get("axis", 0)
+                parts = [np.take(p, i, axis=ax) for i in range(p.shape[ax])]
+                total = dom.join(parts) if parts else dom.zeros(())
+                out = np.broadcast_to(
+                    np.expand_dims(total, ax), out_shapes[0]).copy()
+            return [AVal(out)]
+        if name == "mul":
+            a, b = _broadcast_payloads(dom, args, out_shapes[0])
+            if args[0].is_const or args[1].is_const:
+                return [AVal(dom.join([a, b]))]
+            return [AVal(dom.mul(a, b))]
+        if name == "div":
+            a, b = _broadcast_payloads(dom, args, out_shapes[0])
+            if args[1].is_const:
+                return [AVal(dom.join([a, b]))]
+            return [AVal(dom.div(a, b))]
+        if name == "integer_pow":
+            return [AVal(dom.int_pow(args[0].payload,
+                                     int(eqn.params["y"])))]
+        if name == "square":
+            # jnp.square lowers to its own primitive on current jax —
+            # it is integer_pow(y=2), NOT a transcendental, or every
+            # quadratic written as jnp.square would refute its own LQ
+            # certificate
+            return [AVal(dom.int_pow(args[0].payload, 2))]
+        if name in NONLINEAR_EW:
+            ps = _broadcast_payloads(dom, args, out_shapes[0])
+            return [AVal(dom.nonlinear(ps))]
+        if name in NONSMOOTH_EW:
+            ps = _broadcast_payloads(dom, args, out_shapes[0])
+            return [AVal(dom.nonsmooth(ps))]
+        if name in NONLINEAR_REDUCE or name in NONSMOOTH_REDUCE:
+            p = args[0].payload
+            parts = [p.reshape(-1)[i:i + 1].reshape(())
+                     for i in range(p.size)]
+            total = dom.join(parts) if parts else dom.zeros(())
+            joined = (dom.nonlinear if name in NONLINEAR_REDUCE
+                      else dom.nonsmooth)([total])
+            return [AVal(np.broadcast_to(joined, out_shapes[0]).copy())]
+        if name == "select_n":
+            pred, cases = args[0], args[1:]
+            case_ps = _broadcast_payloads(dom, cases, out_shapes[0])
+            if pred.is_const:
+                idx = np.broadcast_to(np.asarray(pred.const).astype(np.int64),
+                                      out_shapes[0])
+                stacked = np.stack(case_ps, axis=0)
+                out = np.take_along_axis(
+                    stacked, idx[None, ...], axis=0)[0]
+                return [AVal(np.asarray(out, dtype=dom.dtype))]
+            pred_p = np.broadcast_to(pred.payload, out_shapes[0])
+            return [AVal(dom.select(pred_p, case_ps))]
+        if name == "convert_element_type":
+            # float→float / int→anything is value-preserving (linear);
+            # float→int/bool truncates (nonsmooth)
+            in_float = np.issubdtype(eqn.invars[0].aval.dtype, np.floating)
+            out_float = np.issubdtype(np.dtype(eqn.params["new_dtype"]),
+                                      np.floating)
+            p = args[0].payload
+            if in_float and not out_float:
+                return [AVal(dom.nonsmooth([p]))]
+            return [AVal(dom.join([p]))]
+        if name == "stop_gradient":
+            # AD sees a constant here: no w-dependence survives in any
+            # gradient/Hessian the solvers extract
+            return [AVal(dom.zeros(out_shapes[0]))]
+        if name == "dot_general":
+            return [self._dot_general(eqn, args)]
+        if name == "iota":
+            return self._concrete_bind(prim, args, eqn.params)
+        if name in STRUCTURAL:
+            res = self._structural(eqn, args)
+            if res is not None:
+                return res
+            return self._smear(name, args, eqn.outvars)
+        if name in ("pjit", "closed_call", "core_call"):
+            inner = eqn.params["jaxpr"] if name == "pjit" \
+                else eqn.params["call_jaxpr"]
+            return self.run(inner, args)
+        if name == "cond":
+            return self._cond(eqn, args)
+        if name == "scan":
+            return self._scan(eqn, args)
+        if name == "while":
+            return self._while(eqn, args)
+
+        # opaque: custom AD rules, callbacks, unknown primitives. With no
+        # tainted input the output provably carries no w-dependence.
+        if all(dom.is_zero(a.payload) for a in args):
+            return [AVal(dom.zeros(s)) for s in out_shapes]
+        return self._smear(name, args, eqn.outvars)
+
+    # -- composite rules -----------------------------------------------------
+    def _dot_general(self, eqn, args: "list[AVal]") -> AVal:
+        """Generic dot_general: align both operands to
+        (batch…, M, N, K) index space and fold the contraction with
+        mul+join. Exact per element; the loops run on abstract payloads
+        of CI-sized problems (a few thousand elements)."""
+        dom = self.domain
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        a, b = args
+        ap, bp = a.payload, b.payload
+        la = list(range(ap.ndim))
+        lbd = list(range(bp.ndim))
+        l_free = [d for d in la if d not in lc and d not in lb]
+        r_free = [d for d in lbd if d not in rc and d not in rb]
+        # lhs to (batch, free, contract); rhs to (batch, contract, free)
+        ap_t = np.transpose(ap, list(lb) + l_free + list(lc))
+        bp_t = np.transpose(bp, list(rb) + list(rc) + r_free)
+        Bshape = ap_t.shape[:len(lb)]
+        Mshape = ap_t.shape[len(lb):len(lb) + len(l_free)]
+        Nshape = bp_t.shape[len(rb) + len(rc):]
+        K = int(np.prod(ap_t.shape[len(lb) + len(l_free):], dtype=np.int64))
+        Bsz = int(np.prod(Bshape, dtype=np.int64))
+        Msz = int(np.prod(Mshape, dtype=np.int64))
+        Nsz = int(np.prod(Nshape, dtype=np.int64))
+        ap2 = ap_t.reshape(Bsz, Msz, K)
+        bp2 = bp_t.reshape(Bsz, K, Nsz)
+        one_const = a.is_const or b.is_const
+        out = np.empty((Bsz, Msz, Nsz), dtype=dom.dtype)
+        for bi in range(Bsz):
+            for mi in range(Msz):
+                for ni in range(Nsz):
+                    if K == 0:
+                        out[bi, mi, ni] = dom.zero()
+                        continue
+                    terms = []
+                    for k in range(K):
+                        pa = ap2[bi, mi, k:k + 1].reshape(())
+                        pb = bp2[bi, k, ni:ni + 1].reshape(())
+                        if one_const:
+                            terms.append(dom.join([pa, pb]))
+                        else:
+                            terms.append(dom.mul(pa, pb))
+                    out[bi, mi, ni] = dom.join(terms).reshape(())[()]
+        out = out.reshape(Bshape + Mshape + Nshape)
+        return AVal(out)
+
+    def _cond(self, eqn, args: "list[AVal]") -> "list[AVal]":
+        dom = self.domain
+        pred, ops = args[0], args[1:]
+        branch_outs = [self.run(br, ops)
+                       for br in eqn.params["branches"]]
+        n_out = len(branch_outs[0])
+        outs = []
+        for i in range(n_out):
+            cases = [bo[i].payload for bo in branch_outs]
+            shape = cases[0].shape
+            cases = [np.broadcast_to(c, shape) for c in cases]
+            if pred.is_const:
+                outs.append(AVal(cases[int(np.asarray(pred.const))].copy()))
+            else:
+                p = np.broadcast_to(pred.payload.reshape(
+                    (1,) * len(shape)) if pred.payload.shape == ()
+                    else pred.payload, shape)
+                outs.append(AVal(dom.select(p, cases)))
+        return outs
+
+    def _scan(self, eqn, args: "list[AVal]") -> "list[AVal]":
+        dom = self.domain
+        n_const = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        body = eqn.params["jaxpr"]
+        consts = args[:n_const]
+        carry = args[n_const:n_const + n_carry]
+        xs = args[n_const + n_carry:]
+        # per-iteration xs slice: join over the scan axis (sound for any
+        # iteration order); shape = xs[1:]
+        x_slices = []
+        for x in xs:
+            p = x.payload
+            if p.shape[0:1] == (0,):
+                x_slices.append(AVal(dom.zeros(p.shape[1:])))
+                continue
+            parts = [np.take(p, i, axis=0) for i in range(p.shape[0])]
+            x_slices.append(AVal(dom.join(parts)))
+        carry_p = [c.payload.copy() for c in carry]
+        ys_p = None
+        for _ in range(64):  # finite lattices: fixpoint comes fast
+            ins = (consts
+                   + [AVal(p.copy()) for p in carry_p]
+                   + x_slices)
+            outs = self.run(body, ins)
+            new_carry = [dom.join([carry_p[i], outs[i].payload])
+                         for i in range(n_carry)]
+            ys_p = [o.payload for o in outs[n_carry:]]
+            if all(np.array_equal(new_carry[i], carry_p[i])
+                   for i in range(n_carry)):
+                carry_p = new_carry
+                break
+            carry_p = new_carry
+        else:
+            dom.notes.append("scan fixpoint not reached in 64 iterations")
+        results = [AVal(p) for p in carry_p]
+        for i, v in enumerate(eqn.outvars[n_carry:]):
+            shape = _aval_shape(v)
+            results.append(AVal(np.broadcast_to(ys_p[i], shape).copy()))
+        return results
+
+    def _while(self, eqn, args: "list[AVal]") -> "list[AVal]":
+        dom = self.domain
+        cn, bn = eqn.params["cond_nconsts"], eqn.params["body_nconsts"]
+        cond_consts = args[:cn]
+        body_consts = args[cn:cn + bn]
+        carry = args[cn + bn:]
+        carry_p = [c.payload.copy() for c in carry]
+        for _ in range(64):
+            outs = self.run(eqn.params["body_jaxpr"],
+                            body_consts + [AVal(p.copy())
+                                           for p in carry_p])
+            new_carry = [dom.join([carry_p[i], outs[i].payload])
+                         for i in range(len(carry_p))]
+            if all(np.array_equal(new_carry[i], carry_p[i])
+                   for i in range(len(carry_p))):
+                carry_p = new_carry
+                break
+            carry_p = new_carry
+        else:
+            dom.notes.append("while fixpoint not reached in 64 iterations")
+        # a w-dependent trip count makes every output nonsmooth in w
+        cond_out = self.run(eqn.params["cond_jaxpr"],
+                            cond_consts + [AVal(p.copy())
+                                           for p in carry_p])
+        pred_p = cond_out[0].payload
+        if not dom.is_zero(pred_p):
+            carry_p = [dom.select(np.broadcast_to(pred_p.reshape(
+                (1,) * p.ndim) if pred_p.shape == () else pred_p,
+                p.shape), [p]) for p in carry_p]
+        return [AVal(p) for p in carry_p]
+
+
+def interpret_closed(closed, in_avals: "list[AVal]",
+                     domain: Domain) -> "list[AVal]":
+    """Run ``domain`` over a :class:`jax.core.ClosedJaxpr`."""
+    return _Interpreter(domain).run(closed, in_avals)
+
+
+def run_nlp_function(fn, w_template, theta, domain: Domain
+                     ) -> "list[AVal]":
+    """Trace ``fn(w, theta)`` and interpret it with ``w`` symbolic
+    (element ``i`` seeded from ``domain.w_element(i)``) and every theta
+    leaf a symbolic *constant-in-w* (zero payload, unknown value) — so
+    whatever the pass proves holds for ALL theta, not one sample."""
+    closed = jax.make_jaxpr(fn)(w_template, theta)
+    theta_leaves = jax.tree_util.tree_leaves(theta)
+    n = int(np.prod(np.shape(w_template), dtype=np.int64))
+    w_payload = np.empty(np.shape(w_template), dtype=domain.dtype)
+    flat = w_payload.reshape(-1)
+    for i in range(n):
+        flat[i] = domain.w_element(i)
+    in_avals = [AVal(w_payload)]
+    for leaf in theta_leaves:
+        in_avals.append(AVal(domain.zeros(np.shape(leaf))))
+    return interpret_closed(closed, in_avals, domain)

@@ -13,9 +13,13 @@ For an LQ program all of that is constant structure:
 
 so this module
 
-- certifies the structure ONCE at setup (:func:`is_lq` — probabilistic
-  probe: constant Hessian/Jacobians at random points, exact quadratic
-  model match), and
+- certifies the structure ONCE at setup — primarily by the *sound*
+  jaxpr-level proof :func:`agentlib_mpc_tpu.lint.jaxpr.certify_lq`
+  (a polynomial-degree lattice over the traced functions, valid for
+  ALL theta), with :func:`is_lq` (probabilistic probe: constant
+  Hessian/Jacobians at random points, exact quadratic model match)
+  demoted to a cross-check and to the fallback for problems the
+  interpreter cannot see through (opaque primitives) — and
 - solves with :func:`solve_qp`, a Mehrotra predictor-corrector QP IPM
   that extracts (H, c, A, C) per solve with three AD passes, then runs
   pure linear algebra: no model evaluations, no line search (convex ⇒
@@ -55,12 +59,28 @@ __all__ = ["is_lq", "resolve_qp_routing", "solve_qp"]
 
 
 def resolve_qp_routing(mode: str, probe, logger=None,
-                       label: str = "problem") -> bool:
+                       label: str = "problem", certifier=None) -> bool:
     """Shared auto/on/off routing decision for every QP-fast-path seam
-    (central backend, ADMM backend, MHE backend, fused groups — one
-    definition so mode validation and probe semantics cannot drift).
-    ``probe`` is a zero-arg callable returning the :func:`is_lq` verdict;
-    it only runs for ``"auto"``."""
+    (central backend, MHE backend, ADMM backend, MINLP via the central
+    seam, fused groups — one definition so mode validation, certificate
+    and probe semantics cannot drift).
+
+    ``certifier`` is a zero-arg callable returning an
+    :class:`agentlib_mpc_tpu.lint.jaxpr.LQCertificate`; ``probe`` a
+    zero-arg callable returning the :func:`is_lq` verdict. Neither runs
+    except for ``"auto"``. Routing authority (the VERDICT r5 medium —
+    a theta-gated nonlinearity falsely certified by the default-theta
+    probe — is closed here):
+
+    * certificate ``"lq"`` — proof for all theta; the probe runs as a
+      cross-check only (a probe refutation is concrete evidence of an
+      interpreter bug, so it wins and the fast path stays off);
+    * certificate ``"not_lq"`` — never route; the probe is skipped (it
+      can only produce the false positive the certificate just ruled
+      out);
+    * certificate ``"unknown"`` (opaque primitives) or no certifier —
+      fall back to the sampled probe, loudly.
+    """
     if mode == "on":
         return True
     if mode == "off":
@@ -68,8 +88,47 @@ def resolve_qp_routing(mode: str, probe, logger=None,
     if mode != "auto":
         raise ValueError(
             f"qp_fast_path must be 'auto', 'on' or 'off', got {mode!r}")
+    cert = None
+    if certifier is not None:
+        try:
+            cert = certifier()
+        except Exception:  # noqa: BLE001 — certification must never
+            cert = None    # block a backend setup; the probe still routes
+            if logger is not None:
+                logger.warning(
+                    "LQ certification raised for %s; falling back to the "
+                    "sampled probe", label, exc_info=True)
+    if cert is not None and cert.status == "not_lq":
+        if logger is not None:
+            # INFO like the symmetric "proved" line: skipping the probe
+            # and forcing the NLP path is a consequential routing
+            # decision operators grep for (verify recipe)
+            logger.info(
+                "LQ structure refuted for %s (%s): staying on the "
+                "general NLP path", label, cert.describe())
+        return False
+    if cert is not None and cert.status == "lq":
+        if not bool(probe()):
+            if logger is not None:
+                logger.warning(
+                    "LQ certificate and sampled probe DISAGREE for %s "
+                    "(%s, probe says non-LQ) — not routing to the QP "
+                    "fast path; please report this as a certifier bug",
+                    label, cert.describe())
+            return False
+        if logger is not None:
+            logger.info("LQ structure proved for %s (%s; probe "
+                        "cross-check passed): dispatching to the "
+                        "Mehrotra QP fast path", label, cert.describe())
+        return True
     use = bool(probe())
-    if use and logger is not None:
+    if cert is not None and logger is not None:
+        logger.warning(
+            "LQ certificate inconclusive for %s (%s): routing on the "
+            "sampled probe (%s) — the probe only sees default-theta "
+            "structure", label, cert.describe(),
+            "LQ" if use else "non-LQ")
+    elif use and logger is not None:
         logger.info("LQ structure certified for %s: dispatching to the "
                     "Mehrotra QP fast path", label)
     return use

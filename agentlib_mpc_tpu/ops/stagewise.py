@@ -54,6 +54,7 @@ __all__ = [
     "resolve_kkt_stage",
     "solve_kkt_stage",
     "stage_method_available",
+    "stage_of_index",
     "synthetic_stage_kkt",
 ]
 
@@ -134,6 +135,28 @@ def build_stage_partition(N: int, n_x: int, n_u: int, n_z: int, d: int,
             "transcription layout and build_stage_partition drifted apart")
     return StagePartition(n_stages=len(stages), block=block, n_w=n_w,
                           n_total=n_total, perm=tuple(perm))
+
+
+def stage_of_index(p: StagePartition) -> np.ndarray:
+    """Stage holding each original KKT index (length ``n_total`` int
+    array): the inverse view of ``perm`` at stage granularity. This is
+    the coordinate system of the jaxpr stage-structure certifier
+    (``lint/jaxpr/structure.py``) — entry (i, j) of the KKT matrix may
+    be nonzero only if ``|stage_of[i] − stage_of[j]| ≤ 1``, which is
+    exactly the band :func:`_stage_blocks` keeps."""
+    perm = np.asarray(p.perm, dtype=np.int64)
+    valid = perm >= 0
+    out = np.full((p.n_total,), -1, dtype=np.int64)
+    out[perm[valid]] = np.nonzero(valid)[0] // p.block
+    if np.any(out < 0):
+        # a perm that omits indices (or duplicates one, shadowing
+        # another) is not a partition at all — refuse rather than hand
+        # the certifier garbage stages
+        missing = np.nonzero(out < 0)[0][:5].tolist()
+        raise ValueError(
+            f"stage partition does not cover KKT indices {missing}"
+            f"{'...' if int(np.sum(out < 0)) > 5 else ''}")
+    return out
 
 
 # --------------------------------------------------------------------------

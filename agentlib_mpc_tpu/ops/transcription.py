@@ -102,6 +102,24 @@ class TranscribedOCP:
     def control_grid(self):
         return jnp.arange(self.N) * self.dt
 
+    def certify_stage_structure(self):
+        """Prove (not probe) that this transcription's KKT dependence
+        structure is covered by ``stage_partition``'s block-tridiagonal
+        band — the jaxpr-level upgrade of the transcribe-time layout
+        assertion below (which only checks index *coverage*, not which
+        entries the traced functions actually couple). Runs the
+        dependence pass of :mod:`agentlib_mpc_tpu.lint.jaxpr.structure`
+        against ``nlp``; CI runs it for every example OCP
+        (``python -m agentlib_mpc_tpu.lint --jaxpr``)."""
+        if self.stage_partition is None:
+            raise ValueError("this transcription carries no stage "
+                             "partition to certify against")
+        from agentlib_mpc_tpu.lint.jaxpr import certify_stage_structure
+
+        return certify_stage_structure(
+            self.nlp, self.default_params(), self.n_w,
+            self.stage_partition)
+
 
 def _input_splicer(model: Model, control_names: Sequence[str]):
     """Return (exo_names, splice) where splice(u_ctrl, d_exo) rebuilds the
@@ -302,7 +320,10 @@ def transcribe(
 
     # stage metadata for the structured KKT factorization; the covered
     # index space must match the (n_w + n_g)-dim KKT system exactly or
-    # the layout assumptions above and build_stage_partition drifted
+    # the layout assumptions above and build_stage_partition drifted.
+    # (Coverage is necessary, not sufficient — the per-entry bandedness
+    # proof lives in TranscribedOCP.certify_stage_structure, run for
+    # every example OCP by the CI lint job's --jaxpr step.)
     stage_partition = build_stage_partition(
         N=N, n_x=n_x, n_u=n_u, n_z=n_z, d=d, method=method,
         fix_initial_state=fix_initial_state)

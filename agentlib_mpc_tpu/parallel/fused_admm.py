@@ -404,10 +404,12 @@ class FusedADMM:
 
         # per-group solver routing: LQ groups (linear models — their
         # quadratic ADMM augmentation keeps them LQ) ride the Mehrotra
-        # QP fast path; probed once here, eagerly, per group structure.
-        # Means/multipliers probe at RANDOM values: zeros would hide a
-        # nonlinear coupling map entering only through the linear
-        # penalty terms.
+        # QP fast path; certified once here, eagerly, per group
+        # structure. The jaxpr certificate treats means/multipliers/rho
+        # as symbolic theta (valid for every ADMM iterate); the
+        # cross-check probe samples them at RANDOM values — zeros would
+        # hide a nonlinear coupling map entering only through the
+        # linear penalty terms.
         from agentlib_mpc_tpu.ops.qp import (
             is_lq,
             resolve_qp_routing,
@@ -416,6 +418,17 @@ class FusedADMM:
 
         group_uses_qp = []
         for gi, g in enumerate(groups):
+            def certifier(gi=gi, g=g):
+                from agentlib_mpc_tpu.lint.jaxpr import certify_lq
+
+                theta0 = g.ocp.default_params()
+                aug0 = tuple(
+                    (jnp.zeros((self.T,)), jnp.zeros((self.T,)),
+                     jnp.asarray(1.0))
+                    for _ in range(len(aug_map[gi])))
+                n_w = int(g.ocp.initial_guess(theta0).shape[0])
+                return certify_lq(group_nlps[gi], (theta0, aug0), n_w)
+
             def probe(gi=gi, g=g):
                 theta0 = g.ocp.default_params()
                 key = jax.random.PRNGKey(17 + gi)
@@ -434,7 +447,8 @@ class FusedADMM:
 
             try:
                 group_uses_qp.append(resolve_qp_routing(
-                    g.qp_fast_path, probe, label=f"group {g.name!r}"))
+                    g.qp_fast_path, probe, label=f"group {g.name!r}",
+                    certifier=certifier))
             except ValueError as exc:
                 raise ValueError(f"group {g.name!r}: {exc}") from exc
         self.group_uses_qp = tuple(group_uses_qp)

@@ -305,9 +305,19 @@ class MiniMqttClient:
     def _reset_backoff(self) -> None:
         self._backoff = self._reconnect_base
 
-    # paho-compat no-op (the subset has no auth)
+    # paho-compat stub: the MQTT subset carries no auth fields, so any
+    # credentials handed in are silently dropped on the wire — say so
+    # loudly, and again if the broker then refuses the CONNECT
     def username_pw_set(self, username, password=None) -> None:
-        pass
+        if username is None:          # paho idiom: clear credentials
+            self._credentials_dropped = False
+            return
+        self._credentials_dropped = True
+        logger.warning(
+            "MiniMqttClient has no authentication support: the "
+            "username/password for client %r will NOT be sent to the "
+            "broker (use the paho client for authenticated brokers)",
+            self.client_id)
 
     def connect(self, host: str, port: int = 1883,
                 timeout: float = 5.0) -> None:
@@ -317,16 +327,29 @@ class MiniMqttClient:
     def _dial(self, timeout: float = 5.0) -> None:
         sock = socket.create_connection((self._host, self._port),
                                         timeout=timeout)
-        sock.settimeout(None)
+        # keep the dial timeout in force through the whole MQTT
+        # handshake: a peer that accepts TCP but never sends CONNACK
+        # (half-open proxy, wedged broker) must raise here, not hang
+        # connect() — and with it the reconnect loop — forever. Only the
+        # steady-state reader blocks without a deadline.
         body = (_mqtt_str("MQTT") + bytes([4])          # protocol level 4
                 + bytes([0x02])                          # clean session
                 + struct.pack(">H", 60)                  # keepalive
                 + _mqtt_str(self.client_id))
-        sock.sendall(_packet(CONNECT, 0, body))
-        ptype, _f, ack = _read_packet(sock)
+        try:
+            sock.sendall(_packet(CONNECT, 0, body))
+            ptype, _f, ack = _read_packet(sock)
+        except (OSError, ValueError):
+            sock.close()
+            raise
         if ptype != CONNACK or ack[1] != 0:
             sock.close()
-            raise ConnectionError(f"CONNACK refused: {ack!r}")
+            dropped = (" (note: credentials were set via username_pw_set "
+                       "but this client cannot send them)"
+                       if getattr(self, "_credentials_dropped", False)
+                       else "")
+            raise ConnectionError(f"CONNACK refused: {ack!r}{dropped}")
+        sock.settimeout(None)
         with self._wlock:
             self._sock = sock
             filters = list(self._filters)

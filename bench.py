@@ -683,6 +683,16 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["lint_stats"] = collect_stats()
     except Exception as exc:  # the bench must never die to the linter
         payload["lint_stats"] = {"error": repr(exc)}
+    # jaxpr certificate outcomes (LQ status, stage-structure proof,
+    # dtype advisories, FLOP/bytes cost attribution per example OCP):
+    # the routing decisions a round ran under, recorded next to the
+    # wall-clock they produced
+    try:
+        from agentlib_mpc_tpu.lint.jaxpr.examples import certificate_summary
+
+        payload["jaxpr_certificates"] = certificate_summary()
+    except Exception as exc:
+        payload["jaxpr_certificates"] = {"error": repr(exc)}
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1)
     summary = {

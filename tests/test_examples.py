@@ -125,6 +125,12 @@ def test_bench_emit_metrics_smoke(tmp_path):
         assert name in families
     assert "bench.cold_step" in data["spans"]
     assert "bench.warm_step" in data["spans"]
+    # jaxpr certificate sweep rides in the same artifact (ISSUE 5): the
+    # routing decisions this round ran under, next to its wall-clock
+    certs = data["jaxpr_certificates"]
+    assert certs.get("failures") == 0, certs
+    assert {r["lq_status"] for r in certs["examples"]} == {"lq", "not_lq"}
+    assert all(r["stage_ok"] for r in certs["examples"])
     # the summary line on stdout is a JSON artifact too
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["metric"] == "admm_emit_metrics"

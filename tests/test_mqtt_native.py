@@ -50,6 +50,15 @@ def test_topic_wildcards():
     assert not topic_matches("a/b/c", "a/b")
 
 
+def _delivery_diagnostics(broker, got, *clients):
+    """Failure-message payload for the ordering-sensitive waits: what the
+    broker actually routed and whether the client threads are alive."""
+    threads = {c.client_id: (c._thread is not None and c._thread.is_alive())
+               for c in clients}
+    return (f"got={got!r} messages_routed={broker.messages_routed} "
+            f"n_clients={broker.n_clients} reader_threads_alive={threads}")
+
+
 def test_pubsub_roundtrip_over_tcp(broker):
     got = []
     sub = MiniMqttClient("sub")
@@ -60,12 +69,16 @@ def test_pubsub_roundtrip_over_tcp(broker):
     pub = MiniMqttClient("pub")
     pub.connect(broker.host, broker.port)
     pub.loop_start()
-    assert _wait_for(lambda: broker.n_clients == 2)
+    assert _wait_for(lambda: broker.n_clients == 2, timeout=20.0), \
+        _delivery_diagnostics(broker, got, sub, pub)
 
     pub.publish("/fleet/roomA", b"hello")
     pub.publish("/other/topic", b"filtered out")
     pub.publish("/fleet/roomB", "text payload")
-    assert _wait_for(lambda: len(got) == 2)
+    # generous deadline: under a loaded combined run the broker fan-out
+    # thread can be descheduled well past the old 5 s budget
+    assert _wait_for(lambda: len(got) == 2, timeout=20.0), \
+        _delivery_diagnostics(broker, got, sub, pub)
     assert got[0] == ("/fleet/roomA", b"hello")
     assert got[1] == ("/fleet/roomB", b"text payload")
 
@@ -150,6 +163,70 @@ def test_reconnect_after_drop(broker):
 
     sub.disconnect()
     pub.disconnect()
+
+
+class TestHandshakeHygiene:
+    """ISSUE 5 satellites: the dial timeout must cover the whole MQTT
+    handshake, and silently-dropped credentials must be loud."""
+
+    def test_silent_peer_cannot_wedge_connect(self):
+        """A peer that accepts TCP but never sends CONNACK (half-open
+        proxy, wedged broker) must raise within the dial timeout instead
+        of hanging connect() — and the reconnect loop — forever."""
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            client = MiniMqttClient("wedge")
+            t0 = time.time()
+            with pytest.raises(OSError):
+                client.connect(*srv.getsockname(), timeout=0.5)
+            assert time.time() - t0 < 5.0, \
+                "connect() ignored its timeout through the handshake"
+        finally:
+            srv.close()
+
+    def test_username_pw_set_warns(self, caplog):
+        import logging
+
+        client = MiniMqttClient("auth")
+        with caplog.at_level(logging.WARNING,
+                             logger="agentlib_mpc_tpu.runtime.mqtt_native"):
+            client.username_pw_set("user", "hunter2")
+        assert "NOT be sent" in caplog.text
+        assert "hunter2" not in caplog.text     # never log the secret
+
+    def test_refused_connack_mentions_dropped_credentials(self):
+        """A broker refusing the CONNECT after credentials were set is
+        almost certainly refusing BECAUSE they were dropped — the error
+        must say so."""
+        import socket
+        import struct
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def refuse():
+            sess, _ = srv.accept()
+            sess.recv(1024)                     # swallow the CONNECT
+            # CONNACK, return code 5 = not authorized
+            sess.sendall(bytes([0x20, 0x02, 0x00, 0x05]))
+            sess.close()
+
+        t = threading.Thread(target=refuse, daemon=True)
+        t.start()
+        try:
+            client = MiniMqttClient("auth2")
+            client.username_pw_set("user", "pw")
+            with pytest.raises(ConnectionError, match="credentials"):
+                client.connect(*srv.getsockname(), timeout=2.0)
+        finally:
+            t.join(timeout=5.0)
+            srv.close()
 
 
 class TestReconnectBackoff:
