@@ -251,16 +251,21 @@ class ADMMBackend(JAXBackend):
         theta0 = ocp.default_params()
         n_w = int(ocp.initial_guess(theta0).shape[0])
 
-        def certifier():
-            from agentlib_mpc_tpu.lint.jaxpr import certify_lq
-
-            aug0 = (theta0,
+        def zero_aug():
+            """Zero-valued augmented theta with the exact tuple layout
+            f_aug consumes — ONE definition for the LQ certifier, the
+            derivative-plan certifier and any future pass."""
+            return (theta0,
                     jnp.zeros((len(coup_names), self.N)),
                     jnp.zeros((len(coup_names), self.N)),
                     jnp.zeros((len(ex_names), self.N)),
                     jnp.zeros((len(ex_names), self.N)),
                     jnp.asarray(1.0))
-            return certify_lq(nlp, aug0, n_w)
+
+        def certifier():
+            from agentlib_mpc_tpu.lint.jaxpr import certify_lq
+
+            return certify_lq(nlp, zero_aug(), n_w)
 
         def probe():
             key = jax.random.PRNGKey(17)
@@ -279,6 +284,38 @@ class ADMMBackend(JAXBackend):
             probe, logger=self.logger, label="the augmented ADMM OCP",
             certifier=certifier)
         inner = solve_qp if self.uses_qp_fast_path else solve_nlp
+
+        # stage-sparse derivative plan for the AUGMENTED problem (like
+        # the LQ routing above, certification must see the consensus
+        # penalties — an output-kind coupling pulls the output map into
+        # the objective Hessian): one certifier run through the shared
+        # seam, then reused for the warm option set; a warm-ONLY
+        # sparse/stage configuration still gets its own pass (mirrors
+        # the fused fleet's per-group rule).
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            attach_derivative_plan
+        from agentlib_mpc_tpu.ops.solver import (
+            attach_jacobian_plan,
+            plan_worthwhile,
+        )
+
+        aug0 = zero_aug()
+        cold_wants = plan_worthwhile(self.solver_options,
+                                     ocp.stage_partition)
+        self.solver_options = attach_derivative_plan(
+            self.solver_options, ocp, nlp=nlp, theta=aug0,
+            logger=self.logger, label="the augmented ADMM OCP")
+        plan = self.solver_options.stage_jacobian_plan
+        if plan is not None:
+            self.warm_solver_options = attach_jacobian_plan(
+                self.warm_solver_options, plan)
+        elif not cold_wants:
+            # warm-ONLY sparse/stage configuration; when the COLD pass
+            # already ran and was refuted, don't pay (or log) the
+            # certifier twice for the identical augmented nlp
+            self.warm_solver_options = attach_derivative_plan(
+                self.warm_solver_options, ocp, nlp=nlp, theta=aug0,
+                logger=self.logger, label="the augmented ADMM OCP")
 
         def make_step(opts):
             @jax.jit

@@ -40,6 +40,7 @@ from agentlib_mpc_tpu.backends.backend import (
     register_backend,
 )
 from agentlib_mpc_tpu.backends.mpc_backend import (
+    attach_derivative_plan,
     attach_stage_partition,
     solver_options_from_config,
 )
@@ -153,8 +154,11 @@ class MHEBackend(OptimizationBackend):
         self.ocp = transcribe(self.model, var_ref.estimated_inputs,
                               N=self.N, dt=self.time_step,
                               fix_initial_state=False, **kwargs)
-        self.solver_options = attach_stage_partition(
-            solver_options_from_config(self.config.get("solver")), self.ocp)
+        self.solver_options = attach_derivative_plan(
+            attach_stage_partition(
+                solver_options_from_config(self.config.get("solver")),
+                self.ocp),
+            self.ocp, logger=self.logger, label="the MHE OCP")
         self._exo_names = list(self.ocp.exo_names)
         self._resolve_qp_fast_path()
         self._build_step_fn()

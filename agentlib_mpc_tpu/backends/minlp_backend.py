@@ -96,8 +96,15 @@ class MINLPBackend(JAXBackend):
         fixed_solver_cfg = {"dual_inf_tol": 100.0, "compl_inf_tol": 1e-2,
                             **dict(self.config.get("solver", {}) or {}),
                             **dict(self.config.get("fixed_solver", {}) or {})}
-        self._fixed_options = attach_stage_partition(
-            solver_options_from_config(fixed_solver_cfg), self.ocp_fixed)
+        from agentlib_mpc_tpu.backends.mpc_backend import \
+            attach_derivative_plan
+
+        self._fixed_options = attach_derivative_plan(
+            attach_stage_partition(
+                solver_options_from_config(fixed_solver_cfg),
+                self.ocp_fixed),
+            self.ocp_fixed, logger=self.logger,
+            label="the fixed-binaries MINLP OCP")
         # exo vector of the fixed program = binaries ∪ relaxed program's exo;
         # map both into its declaration order
         fixed_exo = list(self.ocp_fixed.exo_names)

@@ -58,9 +58,11 @@ def solver_options_from_config(cfg: dict) -> SolverOptions:
     cfg = dict(cfg or {})
     cfg.pop("name", None)  # reference: solver name (ipopt/fatrop/...)
     cfg.pop("options", None)
-    # derived, not config-expressible: the backends attach it from the
-    # transcribed OCP (attach_stage_partition) after transcription
+    # derived, not config-expressible: the backends attach these from the
+    # transcribed OCP (attach_stage_partition / attach_derivative_plan)
+    # after transcription
     cfg.pop("stage_partition", None)
+    cfg.pop("stage_jacobian_plan", None)
     known = SolverOptions._fields
     return SolverOptions(**{k: v for k, v in cfg.items() if k in known})
 
@@ -76,6 +78,33 @@ def attach_stage_partition(options: SolverOptions, ocp) -> SolverOptions:
     from agentlib_mpc_tpu.ops.solver import attach_stage_partition as attach
 
     return attach(options, getattr(ocp, "stage_partition", None))
+
+
+def attach_derivative_plan(options: SolverOptions, ocp, nlp=None,
+                           theta=None, logger=None,
+                           label: "str | None" = None) -> SolverOptions:
+    """Wire the stage-sparse derivative plan (``ops/stagejac.py``) into
+    solver options — the derivative-side sibling of
+    :func:`attach_stage_partition`, shared by every backend seam.
+
+    The plan is built from the jaxpr stage-structure certificate of the
+    functions ACTUALLY SOLVED: pass ``nlp``/``theta`` for augmented
+    problems (the ADMM backends certify their consensus-augmented
+    objective, mirroring their LQ routing); by default the OCP's own
+    ``nlp`` is certified. Skipped entirely (no certifier cost) when
+    ``plan_worthwhile`` says the solve could never route sparse —
+    ``jacobian="dense"``, no partition, a problem below the crossover
+    floors, or a platform where "auto" never reaches the stage factor.
+    Thin ocp-aware wrapper over ``stagejac.attach_plan_if_worthwhile``
+    (the one gate+certify+attach seam; the fused fleet calls it
+    directly)."""
+    from agentlib_mpc_tpu.ops import stagejac
+
+    return stagejac.attach_plan_if_worthwhile(
+        options, getattr(ocp, "stage_partition", None),
+        ocp.nlp if nlp is None else nlp,
+        ocp.default_params() if theta is None else theta,
+        ocp.n_w, log=logger, label=label or "the transcribed OCP")
 
 
 @register_backend("jax", "jax_full", "casadi", "casadi_basic")
@@ -96,8 +125,12 @@ class JAXBackend(OptimizationBackend):
             self.config.get("discretization_options"))
         self.ocp = transcribe(self.model, var_ref.controls, N=self.N,
                               dt=self.time_step, **trans_kwargs)
-        self.solver_options = attach_stage_partition(
-            solver_options_from_config(self.config.get("solver")), self.ocp)
+        self.solver_options = attach_derivative_plan(
+            attach_stage_partition(
+                solver_options_from_config(self.config.get("solver")),
+                self.ocp),
+            self.ocp, logger=self.logger,
+            label=f"the {type(self).__name__} OCP")
         self._exo_names = list(self.ocp.exo_names)
         self._resolve_qp_fast_path()
         self._build_step_fn()

@@ -70,11 +70,14 @@ def main(argv: "list[str] | None" = None) -> int:
         return 1 if report["violations"] else 0
 
     if args.jaxpr:
-        from agentlib_mpc_tpu.lint.jaxpr.examples import certificate_summary
+        from agentlib_mpc_tpu.lint.jaxpr.examples import (
+            certificate_summary,
+            eval_jac_growth_summary,
+        )
         from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
 
-        expectations = load_budgets(args.budgets).get(
-            "jaxpr", {}).get("expect", {})
+        budgets = load_budgets(args.budgets).get("jaxpr", {})
+        expectations = budgets.get("expect", {})
         summary = certificate_summary(expectations)
         for r in summary["examples"]:
             status = "FAIL" if r["failures"] else "ok"
@@ -82,12 +85,29 @@ def main(argv: "list[str] | None" = None) -> int:
                   f"dtype-advisories={len(r['dtype_findings'])} [{status}]")
             for f in r["failures"]:
                 print(f"  FAILED: {f}")
-        if summary["failures"]:
-            print(f"FAILED: {summary['failures']} jaxpr certification "
+        # eval+jac cost-growth gate: the stage-sparse derivative pipeline
+        # must stay O(N) on the pinned menu ([jaxpr.eval_jac] budget)
+        growth_cfg = budgets.get("eval_jac", {})
+        growth = eval_jac_growth_summary(
+            horizons=(int(growth_cfg.get("horizon_lo", 4)),
+                      int(growth_cfg.get("horizon_hi", 8))),
+            max_growth=float(growth_cfg.get("max_growth", 2.6)))
+        for r in growth["examples"]:
+            status = "FAIL" if r["failure"] else "ok"
+            print(f"{r['name']}: eval+jac flops growth "
+                  f"sparse={r['sparse_growth']}x dense={r['dense_growth']}x "
+                  f"over N={r['horizons'][0]}->{r['horizons'][1]} "
+                  f"(budget {growth['max_growth']}x) [{status}]")
+            if r["failure"]:
+                print(f"  FAILED: {r['failure']}")
+        total = summary["failures"] + growth["failures"]
+        if total:
+            print(f"FAILED: {total} jaxpr certification "
                   f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
             return 1
         print(f"jaxpr certification OK: {len(summary['examples'])} "
-              f"example OCP(s) proved", file=sys.stderr)
+              f"example OCP(s) proved, eval+jac growth within "
+              f"{growth['max_growth']}x", file=sys.stderr)
         return 0
 
     if args.stats:

@@ -45,7 +45,11 @@ the example-OCP menu (:mod:`.examples`) against the expectations in
 
 from __future__ import annotations
 
-from agentlib_mpc_tpu.lint.jaxpr.cost import CostEstimate, op_cost  # noqa: F401
+from agentlib_mpc_tpu.lint.jaxpr.cost import (  # noqa: F401
+    CostEstimate,
+    compare_eval_jac_cost,
+    op_cost,
+)
 from agentlib_mpc_tpu.lint.jaxpr.dtypes import check_dtypes  # noqa: F401
 from agentlib_mpc_tpu.lint.jaxpr.lq import (  # noqa: F401
     LQCertificate,

@@ -24,7 +24,7 @@ from collections import Counter
 
 import numpy as np
 
-__all__ = ["CostEstimate", "op_cost"]
+__all__ = ["CostEstimate", "compare_eval_jac_cost", "op_cost"]
 
 TRANSCENDENTAL_FLOPS = 8
 WHILE_TRIP_GUESS = 10
@@ -124,6 +124,70 @@ def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
                 and hasattr(v.aval, "shape"))
         else:
             flops[name] += mult * _out_size(eqn)
+
+
+def compare_eval_jac_cost(nlp, theta, n_w: int, plan) -> dict:
+    """Banded-vs-dense FLOP/bytes comparison of ONE derivative
+    evaluation — the analytical crossover evidence behind
+    ``SolverOptions.jacobian="auto"`` and the fusion-target picker the
+    bench artifact embeds (``bench.py --emit-metrics``).
+
+    Costs four closed jaxprs with the same per-primitive model:
+
+    * ``dense``  — the solver's dense path: one vjp linearization pulled
+      back over ALL ``1 + m_e + m_h`` unit cotangents;
+    * ``sparse`` — the stage-sparse path: the same linearization pulled
+      back over the plan's ``1 + 3·e_s + 3·h_s`` compressed cotangents
+      (``ops/stagejac.py``);
+    * ``dense_hessian`` / ``sparse_hessian`` — the Lagrangian-Hessian
+      side: ``n_w`` vs ``3·v_s`` forward seeds through one linearization
+      of the gradient.
+
+    The dense FLOPs grow O(N²) in the horizon (O(N) rows × O(N) per
+    pullback), the sparse ones O(N) (constant seed count) — the property
+    ``python -m agentlib_mpc_tpu.lint --jaxpr`` gates against
+    ``[jaxpr.eval_jac]`` in ``lint_budgets.toml``."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops import stagejac as sjac
+
+    w0 = jnp.zeros((n_w,))
+    fgh = sjac.stacked_fgh(nlp, theta)
+    m = int(fgh(w0).shape[0])
+    eye = jnp.eye(m)
+
+    def dense_eval(w):
+        vals, pullback = jax.vjp(fgh, w)
+        return vals, jax.vmap(lambda ct: pullback(ct)[0])(eye)
+
+    def sparse_eval(w):
+        return sjac.banded_fgh_jac(plan, fgh, w)
+
+    def grad_f(w):
+        return jax.grad(lambda ww: nlp.f(ww, theta))(w)
+
+    def dense_hess(w):
+        _, jvp_fn = jax.linearize(grad_f, w)
+        return jax.vmap(jvp_fn)(jnp.eye(n_w))
+
+    def sparse_hess(w):
+        return sjac.banded_lagrangian_hessian(plan, grad_f, w)
+
+    out = {}
+    for name, fn in (("dense", dense_eval), ("sparse", sparse_eval),
+                     ("dense_hessian", dense_hess),
+                     ("sparse_hessian", sparse_hess)):
+        est = op_cost(fn, w0)
+        out[name] = {"flops": est.flops, "bytes": est.bytes_accessed}
+    out["flops_ratio"] = round(
+        out["dense"]["flops"] / max(out["sparse"]["flops"], 1), 2)
+    out["hessian_flops_ratio"] = round(
+        out["dense_hessian"]["flops"]
+        / max(out["sparse_hessian"]["flops"], 1), 2)
+    out["rows_dense"] = m
+    out["rows_compressed"] = plan.n_ct
+    return out
 
 
 def op_cost(fn_or_jaxpr, *args) -> CostEstimate:

@@ -24,11 +24,16 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple
 
-__all__ = ["EXAMPLE_OCPS", "ExampleOCP", "certify_example",
-           "certificate_summary"]
+__all__ = ["EXAMPLE_OCPS", "ExampleOCP", "build_example",
+           "certify_example", "certificate_summary",
+           "eval_jac_growth_summary"]
 
 _N = 4
 _DT = 300.0
+
+#: per-entry (model class, controls, transcribe kwargs) so the cost-
+#: growth gate can rebuild the SAME configuration at other horizons
+_ENTRY_SPECS: dict = {}
 
 
 class ExampleOCP(NamedTuple):
@@ -37,13 +42,23 @@ class ExampleOCP(NamedTuple):
     expected_lq: str     # "lq" | "not_lq"
 
 
-def _entry(name, model_cls_name, controls, expected_lq, **kw):
-    def build():
-        from agentlib_mpc_tpu.models import zoo
-        from agentlib_mpc_tpu.ops.transcription import transcribe
+def build_example(name: str, N: int = _N):
+    """Build one menu entry's transcription at an arbitrary horizon
+    (stage structure is horizon-independent; the eval+jac cost gate
+    needs two horizons of the same configuration)."""
+    from agentlib_mpc_tpu.models import zoo
+    from agentlib_mpc_tpu.ops.transcription import transcribe
 
-        model = getattr(zoo, model_cls_name)()
-        return transcribe(model, controls, N=_N, dt=_DT, **kw)
+    model_cls_name, controls, kw = _ENTRY_SPECS[name]
+    model = getattr(zoo, model_cls_name)()
+    return transcribe(model, controls, N=N, dt=_DT, **kw)
+
+
+def _entry(name, model_cls_name, controls, expected_lq, **kw):
+    _ENTRY_SPECS[name] = (model_cls_name, list(controls), dict(kw))
+
+    def build():
+        return build_example(name)
 
     return ExampleOCP(name=name, build=build, expected_lq=expected_lq)
 
@@ -127,6 +142,66 @@ def certify_example(example: ExampleOCP,
         "cost": costs,
         "failures": failures,
     }
+
+
+def eval_jac_growth_summary(horizons=(4, 8),
+                            max_growth: float = 2.6) -> dict:
+    """Cost-model growth gate for the stage-sparse derivative pipeline
+    (``ops/stagejac.py``): for every menu entry, model the eval+jac
+    FLOPs at two horizons and assert the SPARSE pipeline grows O(N) —
+    ``flops(2N)/flops(N) ≤ max_growth`` (ideal linear growth at a 2×
+    horizon ratio is 2.0; the budget leaves room for the constant seed
+    overhead at CI sizes) — while recording the dense ratio (~4×,
+    O(N²)) as the contrast. Budgeted via ``[jaxpr.eval_jac]`` in
+    ``lint_budgets.toml``; a sparse pipeline that silently regressed to
+    per-row pullbacks fails CI here, not in production latency."""
+    from agentlib_mpc_tpu.lint.jaxpr.cost import compare_eval_jac_cost
+    from agentlib_mpc_tpu.ops.stagejac import plan_from_certificate
+
+    n_lo, n_hi = sorted(int(n) for n in horizons)
+    ratio_ideal = n_hi / n_lo
+    rows = []
+    failures = 0
+    for ex in EXAMPLE_OCPS:
+        per_h = {}
+        failed = None
+        for N in (n_lo, n_hi):
+            ocp = build_example(ex.name, N)
+            plan = plan_from_certificate(
+                ocp.nlp, ocp.default_params(), ocp.n_w,
+                ocp.stage_partition, label=f"{ex.name} (N={N})")
+            if plan is None:
+                failed = f"stage structure not proved at N={N}"
+                break
+            per_h[N] = compare_eval_jac_cost(
+                ocp.nlp, ocp.default_params(), ocp.n_w, plan)
+        if failed is None:
+            sparse_growth = (per_h[n_hi]["sparse"]["flops"]
+                             / max(per_h[n_lo]["sparse"]["flops"], 1))
+            dense_growth = (per_h[n_hi]["dense"]["flops"]
+                            / max(per_h[n_lo]["dense"]["flops"], 1))
+            if sparse_growth > max_growth:
+                failed = (f"sparse eval+jac FLOPs grew "
+                          f"{sparse_growth:.2f}x from N={n_lo} to "
+                          f"N={n_hi} (budget {max_growth}, linear would "
+                          f"be {ratio_ideal:.1f}x) — the pipeline lost "
+                          f"its O(N) compression")
+        else:
+            sparse_growth = dense_growth = None
+        if failed:
+            failures += 1
+        rows.append({
+            "name": ex.name,
+            "horizons": [n_lo, n_hi],
+            "sparse_growth": (round(sparse_growth, 2)
+                              if sparse_growth else None),
+            "dense_growth": (round(dense_growth, 2)
+                             if dense_growth else None),
+            "cost": per_h,
+            "failure": failed,
+        })
+    return {"examples": rows, "failures": failures,
+            "max_growth": max_growth}
 
 
 def certificate_summary(expectations: "dict | None" = None) -> dict:

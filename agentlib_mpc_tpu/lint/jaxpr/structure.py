@@ -144,13 +144,22 @@ def _mask_stages(mask: int):
 class StructureCertificate:
     """``ok`` iff the traced w→(g, h) dependence graph and the Hessian
     interaction set are covered by the partition's block-tridiagonal
-    band. ``violations`` name each out-of-band coupling."""
+    band. ``violations`` name each out-of-band coupling.
+
+    ``h_row_stages`` records, per inequality row, the SMALLEST stage the
+    row's traced dependence reaches (rows with no ``w`` dependence get
+    stage 0). Only meaningful when ``ok`` — condition 2 then bounds each
+    row's column support to stages ``{s, s+1}``, which is exactly the
+    static metadata the stage-sparse derivative pipeline
+    (:mod:`agentlib_mpc_tpu.ops.stagejac`) needs to compress ``Jh``
+    pullbacks; ``None`` when certification failed before reaching h."""
 
     ok: bool
     n_stages: int
     violations: tuple = ()
     notes: tuple = ()
     opaque: tuple = ()
+    h_row_stages: "tuple | None" = None
 
     def describe(self) -> str:
         if self.ok:
@@ -213,8 +222,10 @@ def certify_stage_structure(nlp, theta, n_w: int,
     h_payload = np.concatenate(
         [np.asarray(o.payload, dtype=object).reshape(-1)
          for o in results["h"]]) if results["h"] else np.zeros(0, object)
+    h_row_stages = []
     for r, mask in enumerate(h_payload.tolist()):
         stages = _mask_stages(mask)
+        h_row_stages.append(stages[0] if stages else 0)
         if stages and stages[-1] - stages[0] > 1:
             violations.append(
                 f"h[{r}] couples stages {stages[0]}..{stages[-1]} "
@@ -239,4 +250,5 @@ def certify_stage_structure(nlp, theta, n_w: int,
         violations=tuple(violations),
         notes=tuple(notes),
         opaque=tuple(opaque),
+        h_row_stages=tuple(h_row_stages),
     )

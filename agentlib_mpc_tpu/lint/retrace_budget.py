@@ -91,12 +91,14 @@ def _mini_toml(text: str) -> dict:
     return out
 
 
-def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto"):
+def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto",
+                       jacobian: str = "auto"):
     """The gate's workload: one consensus group of ``n_agents`` trackers
     (min (u - a)^2 coupled on a shared control) — small enough to compile
     in seconds on CPU, structurally identical to the 4-agent bench step.
-    ``kkt_method`` feeds the group's solver options (the checked-in
-    budgets pin ``"stage"`` so the structured stage factorization runs
+    ``kkt_method``/``jacobian`` feed the group's solver options (the
+    checked-in budgets pin ``"stage"``/``"sparse"`` so the structured
+    stage factorization AND the stage-sparse derivative pipeline run
     warm under the same zero-recompile contract as the dense paths).
     Returns (engine, state, theta_batches)."""
     import jax.numpy as jnp
@@ -127,7 +129,8 @@ def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto"):
     group = AgentGroup(
         name="retrace-gate", ocp=ocp, n_agents=n_agents,
         couplings={"shared_u": "u"},
-        solver_options=SolverOptions(max_iter=30, kkt_method=kkt_method))
+        solver_options=SolverOptions(max_iter=30, kkt_method=kkt_method,
+                                     jacobian=jacobian))
     engine = FusedADMM([group], FusedADMMOptions(max_iterations=8, rho=2.0))
     thetas = stack_params([
         ocp.default_params(p=jnp.array([float(i + 1)]))
@@ -153,6 +156,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
     rounds = int(cfg.get("rounds", 3))
     n_agents = int(cfg.get("n_agents", 4))
     kkt_method = str(cfg.get("kkt_method", "auto"))
+    jacobian = str(cfg.get("jacobian", "auto"))
     per_entry = dict(cfg.get("budgets", {}) or {})
     default_budget = int(per_entry.pop("default", 0))
 
@@ -170,7 +174,8 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
         return totals
 
     try:
-        engine, state, thetas = build_bench_engine(n_agents, kkt_method)
+        engine, state, thetas = build_bench_engine(n_agents, kkt_method,
+                                                   jacobian)
         for _ in range(max(warmup, 1)):
             state, _trajs, _stats = engine.step(state, thetas)
             state = engine.shift_state(state)
@@ -201,6 +206,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
         "rounds": rounds,
         "n_agents": n_agents,
         "kkt_method": kkt_method,
+        "jacobian": jacobian,
         "deltas": dict(sorted(deltas.items())),
         "violations": violations,
     }

@@ -42,7 +42,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from agentlib_mpc_tpu.ops import stagejac as sjac
+from agentlib_mpc_tpu.ops import stagewise as stage_ops
 from agentlib_mpc_tpu.ops.solver import (
+    JAC_PATHS,
     KKT_PATHS,
     NLPFunctions,
     SolverOptions,
@@ -50,8 +53,10 @@ from agentlib_mpc_tpu.ops.solver import (
     SolverStats,
     _factor_kkt,
     _max_step,
+    _resolve_jacobian,
     _resolve_kkt,
     _resolve_method,
+    _row_scaling,
     _safe_max,
 )
 
@@ -235,12 +240,21 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
     m_e = nlp.g(w0, theta).shape[0]
     m_h = nlp.h(w0, theta).shape[0]
 
-    # factor path resolved once at trace time (constant structure: the
-    # QP KKT has the same stage-banded form as the NLP solver's, so the
-    # stage sweep drops in here first — no refactor churn)
-    kkt_path = _resolve_method(opts.kkt_method, n + m_e if m_e else n,
-                               opts.stage_partition, opts.stage_min_size)
+    # derivative pipeline + factor path resolved once at trace time
+    # (constant structure: the QP KKT has the same stage-banded form as
+    # the NLP solver's, so both stage paths drop in here — no refactor
+    # churn). On the sparse path the constant (H, A, C) are extracted
+    # ONCE as banded rows and the dense matrices never exist.
+    kkt_size = n + m_e if m_e else n
+    jac_path = _resolve_jacobian(opts, kkt_size)
+    plan = opts.stage_jacobian_plan if jac_path == "sparse" else None
+    if plan is not None:
+        kkt_path = "stage"
+    else:
+        kkt_path = _resolve_method(opts.kkt_method, kkt_size,
+                                   opts.stage_partition, opts.stage_min_size)
     kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
+    jac_path_code = jnp.asarray(JAC_PATHS.index(jac_path))
 
     f_raw = lambda w: nlp.f(w, theta)
     g_raw = lambda w: nlp.g(w, theta)
@@ -252,20 +266,8 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
     else:
         d_w = jnp.ones((n,), dtype)
     gmax = opts.scaling_grad_max
-    gf0 = jax.grad(f_raw)(w0) * d_w
-    s_f = jnp.minimum(1.0, gmax / jnp.maximum(_safe_max(jnp.abs(gf0)), 1e-8))
-    if m_e:
-        Jg0 = jax.jacrev(g_raw)(w0) * d_w[None, :]
-        s_g = jnp.minimum(1.0, gmax / jnp.maximum(
-            jnp.max(jnp.abs(Jg0), axis=1), 1e-8))
-    else:
-        s_g = jnp.zeros((0,), dtype)
-    if m_h:
-        Jh0 = jax.jacrev(h_raw)(w0) * d_w[None, :]
-        s_h = jnp.minimum(1.0, gmax / jnp.maximum(
-            jnp.max(jnp.abs(Jh0), axis=1), 1e-8))
-    else:
-        s_h = jnp.zeros((0,), dtype)
+    s_f, s_g, s_h = _row_scaling(f_raw, g_raw, h_raw, w0, d_w, gmax,
+                                 dtype, m_e, m_h, plan)
 
     f = lambda w: s_f * f_raw(w * d_w)
     g = lambda w: s_g * g_raw(w * d_w)
@@ -275,30 +277,56 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
 
     # ---- one-time structure extraction (3 AD passes, exact for LQ) ---------
     wz = jnp.zeros((n,), dtype)
-    c = jax.grad(f)(wz)                   # ∇f(0)
-    H = jax.hessian(f)(wz)                # constant
     f0 = f(wz)
-    if m_e:
-        A = jax.jacrev(g)(wz)
-        g0 = g(wz)                        # g(w) = A w + g0
+    if plan is not None:
+        # banded extraction: compressed pullbacks give (c, A, C) as row
+        # windows, compressed forward seeds give H as banded columns —
+        # O(N) storage and FLOPs for all four
+        def fgh_scaled(w):
+            return jnp.concatenate([f(w)[None], g(w), h(w)])
+
+        vals_z, c, A_rows, C_rows = sjac.banded_fgh_jac(plan, fgh_scaled,
+                                                        wz)
+        g0 = vals_z[1:1 + m_e]
+        h0 = vals_z[1 + m_e:]
+        CH = sjac.banded_lagrangian_hessian(plan, jax.grad(f), wz)
+        H_rows = sjac.hessian_rows(plan, CH)
+        h_mv = lambda x: sjac.band_matvec(H_rows, plan.hrow_cols_safe, x)
+        a_mv = lambda x: sjac.band_matvec(A_rows, plan.g_cols_safe, x)
+        a_t_mv = lambda v: sjac.band_rmatvec(A_rows, plan.g_cols_safe,
+                                             v, n)
+        c_mv = lambda x: sjac.band_matvec(C_rows, plan.h_cols_safe, x)
+        c_t_mv = lambda v: sjac.band_rmatvec(C_rows, plan.h_cols_safe,
+                                             v, n)
     else:
-        A = jnp.zeros((0, n), dtype)
-        g0 = jnp.zeros((0,), dtype)
-    if m_h:
-        C = jax.jacrev(h)(wz)
-        h0 = h(wz)                        # h(w) = C w + h0
-    else:
-        C = jnp.zeros((0, n), dtype)
-        h0 = jnp.zeros((0,), dtype)
+        c = jax.grad(f)(wz)                   # ∇f(0)
+        H = jax.hessian(f)(wz)                # constant
+        if m_e:
+            A = jax.jacrev(g)(wz)
+            g0 = g(wz)                        # g(w) = A w + g0
+        else:
+            A = jnp.zeros((0, n), dtype)
+            g0 = jnp.zeros((0,), dtype)
+        if m_h:
+            C = jax.jacrev(h)(wz)
+            h0 = h(wz)                        # h(w) = C w + h0
+        else:
+            C = jnp.zeros((0, n), dtype)
+            h0 = jnp.zeros((0,), dtype)
+        h_mv = lambda x: H @ x
+        a_mv = lambda x: A @ x
+        a_t_mv = lambda v: A.T @ v
+        c_mv = lambda x: C @ x
+        c_t_mv = lambda v: C.T @ v
 
     def f_val(w):
-        return f0 + c @ w + 0.5 * w @ (H @ w)
+        return f0 + c @ w + 0.5 * w @ h_mv(w)
 
     # ---- initial point ------------------------------------------------------
     span = jnp.maximum(ub - lb, 1e-8)
     push = opts.bound_push * jnp.minimum(1.0, span)
     w = jnp.clip(w0 / d_w, lb + push, ub - push)
-    hv = C @ w + h0 if m_h else h0
+    hv = c_mv(w) + h0 if m_h else h0
     s = jnp.maximum(hv, 1e-2) if m_h else h0
     z = jnp.clip(0.1 / s, 1e-8, 1e8) if m_h else s
     if z0 is not None and m_h:
@@ -312,13 +340,13 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
 
     def kkt_error(w, s, y, z, zL, zU):
         """Scaled optimality error at mu=0 (same scaling as solve_nlp)."""
-        r_w = c + H @ w - zL + zU
+        r_w = c + h_mv(w) - zL + zU
         if m_e:
-            r_w = r_w + A.T @ y
+            r_w = r_w + a_t_mv(y)
         if m_h:
-            r_w = r_w - C.T @ z
-        r_g = A @ w + g0 if m_e else g0
-        r_h = (C @ w + h0 - s) if m_h else h0
+            r_w = r_w - c_t_mv(z)
+        r_g = a_mv(w) + g0 if m_e else g0
+        r_h = (c_mv(w) + h0 - s) if m_h else h0
         comp = jnp.concatenate([
             s * z if m_h else h0,
             (w - lb) * zL,
@@ -345,31 +373,41 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
         sigma_L = zL / dL
         sigma_U = zU / dU
 
-        gv = A @ w + g0 if m_e else g0
-        hv = C @ w + h0 if m_h else h0
+        gv = a_mv(w) + g0 if m_e else g0
+        hv = c_mv(w) + h0 if m_h else h0
         r_h = hv - s
-        r_w = c + H @ w - zL + zU
+        r_w = c + h_mv(w) - zL + zU
         if m_e:
-            r_w = r_w + A.T @ y
+            r_w = r_w + a_t_mv(y)
         if m_h:
-            r_w = r_w - C.T @ z
+            r_w = r_w - c_t_mv(z)
 
         # current duality measure
         mu_now = (jnp.sum(s * z) + jnp.sum((w - lb) * zL)
                   + jnp.sum((ub - w) * zU)) / n_comp
 
-        W = H + (opts.delta_init * jnp.ones((n,), dtype)
-                 + sigma_L + sigma_U) * jnp.eye(n, dtype=dtype)
-        if m_h:
-            W = W + C.T @ (sigma_s[:, None] * C)
-        if m_e:
-            K = jnp.block([
-                [W, A.T],
-                [A, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
-            ])
+        if plan is not None:
+            w_diag = opts.delta_init + sigma_L + sigma_U
+            D, E = sjac.assemble_kkt_banded(
+                plan, CH, A_rows, C_rows,
+                sigma_s if m_h else jnp.zeros((0,), dtype), w_diag,
+                opts.delta_c)
+            factor = ("stage_banded",
+                      (stage_ops.factor_kkt_stage_banded(D, E),
+                       plan.partition))
         else:
-            K = W
-        factor = _factor_kkt(K, kkt_path, opts.stage_partition)
+            W = H + (opts.delta_init * jnp.ones((n,), dtype)
+                     + sigma_L + sigma_U) * jnp.eye(n, dtype=dtype)
+            if m_h:
+                W = W + C.T @ (sigma_s[:, None] * C)
+            if m_e:
+                K = jnp.block([
+                    [W, A.T],
+                    [A, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
+                ])
+            else:
+                K = W
+            factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(mu_s, mu_L, mu_U):
             """Direction for per-entry complementarity targets (same
@@ -378,14 +416,14 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
             rhs = -r_w + (mu_L / dL - zL) - (mu_U / dU - zU)
             if m_h:
                 corr = mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * r_h
-                rhs = rhs + C.T @ corr
+                rhs = rhs + c_t_mv(corr)
             if m_e:
                 sol = _resolve_kkt(factor, jnp.concatenate([rhs, -gv]))
                 dw, dy = sol[:n], sol[n:]
             else:
                 dw = _resolve_kkt(factor, rhs)
                 dy = jnp.zeros((0,), dtype)
-            ds = (C @ dw + r_h) if m_h else s
+            ds = (c_mv(dw) + r_h) if m_h else s
             dz = (mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * ds) \
                 if m_h else z
             dzL = mu_L / dL - zL - sigma_L * dw
@@ -485,8 +523,8 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
                     & (compl_f <= opts.compl_inf_tol))
 
     # ---- unscale ------------------------------------------------------------
-    gv_f = A @ w + g0 if m_e else g0
-    hv_f = C @ w + h0 if m_h else h0
+    gv_f = a_mv(w) + g0 if m_e else g0
+    hv_f = c_mv(w) + h0 if m_h else h0
     g_raw_v = gv_f / jnp.maximum(s_g, 1e-12) if m_e else gv_f
     h_raw_v = hv_f / jnp.maximum(s_h, 1e-12) if m_h else hv_f
     viol_raw = jnp.maximum(
@@ -502,6 +540,7 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
         mu=mu_f,
         constraint_violation=viol_raw,
         kkt_path=kkt_path_code,
+        jac_path=jac_path_code,
     )
     return SolverResult(
         w=w * d_w,

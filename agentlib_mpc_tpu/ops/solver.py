@@ -37,6 +37,15 @@ TPU-latency engineering (round 3; measured on v5e, 256 agents, 92² KKT):
   step sizes ``alpha_max * 0.5^k`` in one batched call and picks the
   largest accepted — one model-eval of latency instead of a sequential
   ``while_loop`` of them.
+- **Stage-sparse derivatives (round 8).** Where the jaxpr certificate
+  proves the transcription block-banded (``ops/stagejac.py``), the
+  carried Jacobians become banded row windows computed by compressed
+  pullbacks (O(N) instead of O(N²) FLOPs/storage), the Lagrangian
+  Hessian comes from 3·v_s forward seeds, and the KKT system is
+  assembled directly as block-tridiagonal ``(D, E)`` blocks for the
+  banded stage factorization — the dense KKT matrix never exists on
+  that path (``SolverOptions.jacobian``; measured eval+jac 56× and
+  whole-solve 10.9× at N=256 on CPU, PERF.md).
 
 Returns per-solve stats (iterations, KKT error, success, objective)
 mirroring the reference's ``Results.stats``
@@ -53,6 +62,7 @@ import jax.numpy as jnp
 
 from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.ops import kkt as kkt_ops
+from agentlib_mpc_tpu.ops import stagejac as sjac
 from agentlib_mpc_tpu.ops import stagewise as stage_ops
 
 
@@ -135,6 +145,28 @@ class SolverOptions(NamedTuple):
     #: "Stage-structured KKT factorization"); forcing
     #: ``kkt_method="stage"`` ignores this floor.
     stage_min_size: int = 192
+    #: derivative pipeline: "auto" → stage-sparse eval+jac (compressed
+    #: pullbacks + direct banded KKT assembly, ``ops/stagejac.py``)
+    #: wherever a certificate-backed ``stage_jacobian_plan`` is attached
+    #: AND the stage factorization is the resolved KKT path (the two
+    #: crossovers coincide: PERF.md "Stage-sparse derivative pipeline");
+    #: "dense" forces the dense ``jacrev``/``hessian`` path; "sparse"
+    #: forces the sparse pipeline (requires a plan, forces the banded
+    #: stage factorization regardless of ``stage_min_size``)
+    jacobian: str = "auto"
+    #: extra "auto" floor for the sparse pipeline alone: smallest KKT
+    #: dimension routed to it when the stage factorization already runs.
+    #: Measured (PERF.md round 8, CPU): whole-solve crossover between
+    #: KKT 290 (0.79×, the per-iteration scatter/assembly overhead still
+    #: wins) and 578 (1.54×); 384 splits the gap. Forcing
+    #: ``jacobian="sparse"`` ignores this floor.
+    jacobian_min_size: int = 384
+    #: stage-sparse derivative plan — static, hashable, built from a
+    #: PROVED jaxpr stage-structure certificate only
+    #: (``stagejac.plan_from_certificate``; the backends and the fused
+    #: fleet attach it next to ``stage_partition``). Required by
+    #: ``jacobian="sparse"``; consulted by ``"auto"``.
+    stage_jacobian_plan: "sjac.StageJacobianPlan | None" = None
 
 
 def attach_stage_partition(options: SolverOptions,
@@ -149,23 +181,84 @@ def attach_stage_partition(options: SolverOptions,
     return options
 
 
+def attach_jacobian_plan(options: SolverOptions, plan) -> SolverOptions:
+    """Attach a certificate-backed stage-sparse derivative plan when the
+    options could use it (``jacobian`` "auto"/"sparse" and none attached
+    yet) — the sibling of :func:`attach_stage_partition` for the
+    derivative side of the stage pipeline."""
+    if (plan is not None and options.stage_jacobian_plan is None
+            and options.jacobian in ("auto", "sparse")):
+        return options._replace(stage_jacobian_plan=plan)
+    return options
+
+
+def plan_worthwhile(options: SolverOptions, partition) -> bool:
+    """Should a backend PAY for stage-structure certification at setup?
+    True only when ``_resolve_jacobian`` could actually route sparse:
+    ``jacobian`` not forced dense, no plan attached yet, and — unless
+    the sparse pipeline is forced — the size clears the sparse floor
+    AND the stage factorization is the path ``kkt_method`` would
+    resolve (on "auto" that means the dense alternative would be LU:
+    where the Pallas lanes LDLᵀ is live, auto never reaches stage, so a
+    plan would be dead weight). Keeps the certifier's seconds of
+    abstract interpretation away from every setup that could never use
+    the result (tests, the N=10 bench zones, TPU auto-routing)."""
+    if options is None:
+        return False
+    if options.jacobian == "dense" or options.stage_jacobian_plan is not None:
+        return False
+    if partition is None:
+        return False
+    if options.jacobian == "sparse":
+        return True
+    # remaining checks mirror _resolve_jacobian's "auto" chain exactly
+    if options.fused_ls_jacobian == "on":
+        return False
+    size = partition.n_total
+    if size < options.jacobian_min_size:
+        return False
+    if options.kkt_method == "stage":
+        return True
+    if options.kkt_method != "auto" or size < options.stage_min_size:
+        return False
+    # same conditions _resolve_method applies: auto prefers the Pallas
+    # LDLᵀ where its probe passes, and stage (hence sparse) only where
+    # the dense path would be LU and the sweep's own probe passes
+    return (not kkt_ops.kkt_method_available(size)
+            and stage_ops.stage_method_available(partition))
+
+
 #: factor-path codes carried in ``SolverStats.kkt_path`` (resolved at
 #: trace time, baked into the executable as a constant — so every solve
 #: reports which factorization actually ran without a host round-trip)
 KKT_PATHS = ("lu", "ldl", "stage")
 
 
-def kkt_path_name(code) -> "str | None":
-    """Human-readable factor path from a ``SolverStats.kkt_path`` value
-    (possibly batched; the code is a per-trace constant). None when the
-    stats predate the field or carry the -1 default."""
+#: derivative-pipeline codes carried in ``SolverStats.jac_path`` (trace-
+#: time constant, like ``kkt_path``)
+JAC_PATHS = ("dense", "sparse")
+
+
+def _path_name(code, table) -> "str | None":
+    """Decode a (possibly batched) per-trace-constant path code against
+    ``table``; None when the stats predate the field or carry -1."""
     import numpy as np
 
     try:
         i = int(np.asarray(code).reshape(-1)[0])
     except (TypeError, ValueError):
         return None
-    return KKT_PATHS[i] if 0 <= i < len(KKT_PATHS) else None
+    return table[i] if 0 <= i < len(table) else None
+
+
+def kkt_path_name(code) -> "str | None":
+    """Human-readable factor path from a ``SolverStats.kkt_path`` value."""
+    return _path_name(code, KKT_PATHS)
+
+
+def jac_path_name(code) -> "str | None":
+    """Human-readable derivative path from ``SolverStats.jac_path``."""
+    return _path_name(code, JAC_PATHS)
 
 
 class SolverStats(NamedTuple):
@@ -178,6 +271,9 @@ class SolverStats(NamedTuple):
     #: index into :data:`KKT_PATHS` of the factorization that ran (a
     #: trace-time constant; -1 = unknown/legacy constructor)
     kkt_path: "jnp.ndarray | int" = -1
+    #: index into :data:`JAC_PATHS` of the derivative pipeline that ran
+    #: (trace-time constant; -1 = unknown/legacy constructor)
+    jac_path: "jnp.ndarray | int" = -1
 
 
 class SolverResult(NamedTuple):
@@ -213,6 +309,11 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
         path_counter = telemetry.counter(
             "solver_kkt_path_solves_total",
             "solves by KKT factorization path (lu / ldl / stage)")
+    jpath = jac_path_name(getattr(stats, "jac_path", -1))
+    if jpath is not None:
+        jac_counter = telemetry.counter(
+            "solver_jacobian_path_solves_total",
+            "solves by derivative pipeline (dense / sparse)")
     for i in range(iters.shape[0]):
         m["solves"].inc(**labels)
         m["iterations"].observe(float(iters[i]), **labels)
@@ -220,6 +321,8 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
             m["failures"].inc(**labels)
         if path is not None:
             path_counter.inc(kkt_path=path, **labels)
+        if jpath is not None:
+            jac_counter.inc(jac_path=jpath, **labels)
     m["kkt_error"].set(float(np.max(kkt)), **labels)
 
 
@@ -302,6 +405,58 @@ def _resolve_method(method: str, size: int,
     return method
 
 
+def _resolve_jacobian(opts: SolverOptions, size: int) -> str:
+    """Trace-time routing of the derivative pipeline ("dense"/"sparse").
+
+    Authority chain (the PR 5 pattern): a ``stage_jacobian_plan`` exists
+    ONLY when the jaxpr certificate proved stage structure, so "auto"
+    routes sparse exactly where (a) the proof exists, (b) the stage
+    factorization is the resolved KKT path (the banded assembly feeds
+    it), and (c) the size clears ``jacobian_min_size``. Forcing
+    ``"sparse"`` skips the crossovers but still demands the proof."""
+    jac = opts.jacobian
+    if jac not in ("auto", "dense", "sparse"):
+        raise ValueError(
+            f"jacobian must be 'auto', 'dense' or 'sparse', got {jac!r}")
+    plan = opts.stage_jacobian_plan
+    if (plan is not None and opts.stage_partition is not None
+            and plan.partition != opts.stage_partition):
+        raise ValueError(
+            "stage_jacobian_plan and stage_partition describe different "
+            "partitions — attach both from the same TranscribedOCP")
+    if jac == "dense":
+        return "dense"
+    if jac == "sparse":
+        if plan is None:
+            raise ValueError(
+                "jacobian='sparse' requires a stage_jacobian_plan — the "
+                "backends attach it from a PROVED jaxpr stage-structure "
+                "certificate (stagejac.plan_from_certificate); refuted/"
+                "unknown structure must stay on the dense pipeline")
+        if plan.partition.n_total != size:
+            raise ValueError(
+                f"stage_jacobian_plan covers a {plan.partition.n_total}-"
+                f"dim KKT system; this problem is {size}")
+        if opts.kkt_method not in ("auto", "stage"):
+            raise ValueError(
+                f"jacobian='sparse' assembles the banded stage KKT; "
+                f"kkt_method={opts.kkt_method!r} contradicts it")
+        if opts.fused_ls_jacobian == "on":
+            raise ValueError(
+                "fused_ls_jacobian='on' is incompatible with "
+                "jacobian='sparse' (the fused line search carries dense "
+                "trial Jacobians)")
+        return "sparse"
+    if (plan is None or plan.partition.n_total != size
+            or opts.fused_ls_jacobian == "on"):
+        return "dense"
+    resolved = _resolve_method(opts.kkt_method, size, plan.partition,
+                               opts.stage_min_size)
+    if resolved != "stage" or size < opts.jacobian_min_size:
+        return "dense"
+    return "sparse"
+
+
 def _factor_kkt(K, method: str, partition=None, stage_min_size: int = 0):
     """Factor once; returns a method-tagged factor so the resolve path
     cannot diverge from the factor path."""
@@ -320,11 +475,60 @@ def _resolve_kkt(factor, rhs):
     if kind == "stage":
         stage_factor, partition = f
         return stage_ops.resolve_kkt_stage(stage_factor, rhs, partition)
+    if kind == "stage_banded":
+        # the stage-sparse assembly path: the factor was built from
+        # (D, E) blocks directly, no dense matrix exists to refine
+        # against — refinement runs on the banded matvec (exact, the
+        # certificate proved out-of-band entries structurally zero)
+        banded_factor, partition = f
+        return stage_ops.resolve_kkt_stage_banded(banded_factor, rhs,
+                                                  partition)
     if kind == "ldl":
         return kkt_ops.resolve_kkt_ldl(f, rhs)
     return _resolve_kkt_lu(f, rhs)
 
 
+
+
+def _row_scaling(f_raw, g_raw, h_raw, w0, d_w, gmax, dtype, m_e, m_h,
+                 plan):
+    """Gradient-based row scaling of (f, g, h) at ``w0`` (IPOPT
+    ``nlp_scaling``), shared by the NLP and QP solvers: row maxes from
+    ONE banded eval on the sparse pipeline (O(N)) or from per-row
+    ``jacrev`` on the dense one (O(N²), the status quo). Returns
+    ``(s_f, s_g, s_h)``."""
+    if plan is not None:
+        def raw_fgh(w):
+            return jnp.concatenate([f_raw(w)[None], g_raw(w), h_raw(w)])
+
+        _, gf0_raw, Jg0_rows, Jh0_rows = sjac.banded_fgh_jac(
+            plan, raw_fgh, w0)
+        gf0 = gf0_raw * d_w
+        s_f = jnp.minimum(1.0, gmax / jnp.maximum(
+            _safe_max(jnp.abs(gf0)), 1e-8))
+        s_g = jnp.minimum(1.0, gmax / jnp.maximum(
+            sjac.band_row_absmax(Jg0_rows, plan.g_cols_safe, d_w), 1e-8)) \
+            if m_e else jnp.zeros((0,), dtype)
+        s_h = jnp.minimum(1.0, gmax / jnp.maximum(
+            sjac.band_row_absmax(Jh0_rows, plan.h_cols_safe, d_w), 1e-8)) \
+            if m_h else jnp.zeros((0,), dtype)
+        return s_f, s_g, s_h
+    gf0 = jax.grad(f_raw)(w0) * d_w
+    s_f = jnp.minimum(1.0, gmax / jnp.maximum(
+        _safe_max(jnp.abs(gf0)), 1e-8))
+    if m_e:
+        Jg0 = jax.jacrev(g_raw)(w0) * d_w[None, :]
+        s_g = jnp.minimum(1.0, gmax / jnp.maximum(
+            jnp.max(jnp.abs(Jg0), axis=1), 1e-8))
+    else:
+        s_g = jnp.zeros((0,), dtype)
+    if m_h:
+        Jh0 = jax.jacrev(h_raw)(w0) * d_w[None, :]
+        s_h = jnp.minimum(1.0, gmax / jnp.maximum(
+            jnp.max(jnp.abs(Jh0), axis=1), 1e-8))
+    else:
+        s_h = jnp.zeros((0,), dtype)
+    return s_f, s_g, s_h
 
 
 def _max_step(v, dv, tau):
@@ -412,8 +616,6 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             f"fused_ls_jacobian must be 'auto', 'on' or 'off', got "
             f"{opts.fused_ls_jacobian!r} (booleans are not accepted: use "
             f"the strings)")
-    fused_ls = opts.fused_ls_jacobian == "on" or (
-        opts.fused_ls_jacobian == "auto" and jax.default_backend() == "tpu")
     dtype = w0.dtype
     eps = jnp.finfo(dtype).eps
     n = w0.shape[0]
@@ -424,12 +626,27 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
     g_raw = lambda w: nlp.g(w, theta)
     h_raw = lambda w: nlp.h(w, theta)
 
-    # the factor path is a trace-time constant (static options + shapes);
-    # resolving it once here keeps the per-iteration dispatch and the
-    # reported stats from ever disagreeing
-    kkt_path = _resolve_method(opts.kkt_method, n + m_e if m_e else n,
-                               opts.stage_partition, opts.stage_min_size)
+    # derivative pipeline + factor path are trace-time constants (static
+    # options + shapes); resolving both once here keeps the per-iteration
+    # dispatch and the reported stats from ever disagreeing
+    kkt_size = n + m_e if m_e else n
+    jac_path = _resolve_jacobian(opts, kkt_size)
+    plan = opts.stage_jacobian_plan if jac_path == "sparse" else None
+    # the sparse pipeline assembles the banded stage system directly, so
+    # it IS the stage factor path (forced "sparse" skips the size floor)
+    if plan is not None:
+        kkt_path = "stage"
+    else:
+        kkt_path = _resolve_method(opts.kkt_method, kkt_size,
+                                   opts.stage_partition, opts.stage_min_size)
     kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
+    jac_path_code = jnp.asarray(JAC_PATHS.index(jac_path))
+    # the fused line search carries per-candidate DENSE Jacobians — a
+    # TPU-latency trade the sparse pipeline replaces wholesale
+    fused_ls = jac_path == "dense" and (
+        opts.fused_ls_jacobian == "on" or (
+            opts.fused_ls_jacobian == "auto"
+            and jax.default_backend() == "tpu"))
 
     # ---- automatic scaling ---------------------------------------------------
     if opts.scale_variables:
@@ -437,20 +654,8 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
     else:
         d_w = jnp.ones((n,), dtype)
     gmax = opts.scaling_grad_max
-    gf0 = jax.grad(f_raw)(w0) * d_w
-    s_f = jnp.minimum(1.0, gmax / jnp.maximum(_safe_max(jnp.abs(gf0)), 1e-8))
-    if m_e:
-        Jg0 = jax.jacrev(g_raw)(w0) * d_w[None, :]
-        s_g = jnp.minimum(1.0, gmax / jnp.maximum(
-            jnp.max(jnp.abs(Jg0), axis=1), 1e-8))
-    else:
-        s_g = jnp.zeros((0,), dtype)
-    if m_h:
-        Jh0 = jax.jacrev(h_raw)(w0) * d_w[None, :]
-        s_h = jnp.minimum(1.0, gmax / jnp.maximum(
-            jnp.max(jnp.abs(Jh0), axis=1), 1e-8))
-    else:
-        s_h = jnp.zeros((0,), dtype)
+    s_f, s_g, s_h = _row_scaling(f_raw, g_raw, h_raw, w0, d_w, gmax,
+                                 dtype, m_e, m_h, plan)
 
     f = lambda w: s_f * f_raw(w * d_w)
     g = lambda w: s_g * g_raw(w * d_w)
@@ -462,21 +667,45 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         """Stacked scaled values [f, g..., h...] — one primal pass."""
         return jnp.concatenate([f(w)[None], g(w), h(w)])
 
-    eye_fgh = jnp.eye(1 + m_e + m_h, dtype=dtype)
+    if plan is not None:
+        # carried Jacobians are banded row windows: (m_e, 3 v_s) /
+        # (m_h, 2 v_s) instead of the dense (m, n) — O(N) carry storage
+        def fgh_and_jac(w):
+            vals, gf, Jg_rows, Jh_rows = sjac.banded_fgh_jac(plan, fgh, w)
+            return vals, (gf, Jg_rows, Jh_rows)
 
-    def fgh_and_jac(w):
-        """Values and Jacobian of the stacked residual in ONE primal pass
-        (the vjp pullback is then batched over output rows). This is the
-        only per-point derivative evaluation the loop makes."""
-        vals, pullback = jax.vjp(fgh, w)
-        jac = jax.vmap(lambda ct: pullback(ct)[0])(eye_fgh)
-        return vals, jac
+        def split(vals, jac):
+            fv = vals[0]
+            gv, hv = vals[1:1 + m_e], vals[1 + m_e:]
+            gf, Jg, Jh = jac
+            return fv, gf, gv, Jg, hv, Jh
 
-    def split(vals, jac):
-        fv = vals[0]
-        gv, hv = vals[1:1 + m_e], vals[1 + m_e:]
-        gf, Jg, Jh = jac[0], jac[1:1 + m_e], jac[1 + m_e:]
-        return fv, gf, gv, Jg, hv, Jh
+        jg_t_mv = lambda Jg, v: sjac.band_rmatvec(Jg, plan.g_cols_safe,
+                                                  v, n)
+        jh_t_mv = lambda Jh, v: sjac.band_rmatvec(Jh, plan.h_cols_safe,
+                                                  v, n)
+        jh_mv = lambda Jh, x: sjac.band_matvec(Jh, plan.h_cols_safe, x)
+    else:
+        eye_fgh = jnp.eye(1 + m_e + m_h, dtype=dtype)
+
+        def fgh_and_jac(w):
+            """Values and Jacobian of the stacked residual in ONE primal
+            pass (the vjp pullback is then batched over output rows).
+            This is the only per-point derivative evaluation the loop
+            makes."""
+            vals, pullback = jax.vjp(fgh, w)
+            jac = jax.vmap(lambda ct: pullback(ct)[0])(eye_fgh)
+            return vals, jac
+
+        def split(vals, jac):
+            fv = vals[0]
+            gv, hv = vals[1:1 + m_e], vals[1 + m_e:]
+            gf, Jg, Jh = jac[0], jac[1:1 + m_e], jac[1 + m_e:]
+            return fv, gf, gv, Jg, hv, Jh
+
+        jg_t_mv = lambda Jg, v: Jg.T @ v
+        jh_t_mv = lambda Jh, v: Jh.T @ v
+        jh_mv = lambda Jh, x: Jh @ x
 
     def lagrangian(w, y, z_h):
         val = f(w)
@@ -516,9 +745,9 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         first-order data — pure arithmetic, no model evaluations."""
         r_w = gf - zL + zU
         if m_e:
-            r_w = r_w + Jg.T @ y
+            r_w = r_w + jg_t_mv(Jg, y)
         if m_h:
-            r_w = r_w - Jh.T @ z
+            r_w = r_w - jh_t_mv(Jh, z)
         r_g = gv if m_e else jnp.zeros((0,), dtype)
         r_h = (hv - s) if m_h else jnp.zeros((0,), dtype)
         comp = jnp.concatenate([
@@ -551,24 +780,39 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
 
         r_w = gf - zL + zU
         if m_e:
-            r_w = r_w + Jg.T @ y
+            r_w = r_w + jg_t_mv(Jg, y)
         if m_h:
-            r_w = r_w - Jh.T @ z
+            r_w = r_w - jh_t_mv(Jh, z)
 
-        H = hess_l(w, y, z)
-        W = H + (delta * jnp.ones((n,), dtype) + sigma_L + sigma_U) * \
-            jnp.eye(n, dtype=dtype)
-        if m_h:
-            W = W + Jh.T @ (sigma_s[:, None] * Jh)
-
-        if m_e:
-            K = jnp.block([
-                [W, Jg.T],
-                [Jg, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
-            ])
+        if plan is not None:
+            # compressed Hessian columns (3·v_s forward passes through
+            # one linearization instead of n) assembled STRAIGHT into
+            # the banded block-tridiagonal layout — the dense KKT matrix
+            # never exists on this path
+            CH = sjac.banded_lagrangian_hessian(
+                plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w)
+            w_diag = delta + sigma_L + sigma_U
+            D, E = sjac.assemble_kkt_banded(
+                plan, CH, Jg, Jh, sigma_s if m_h else
+                jnp.zeros((0,), dtype), w_diag, opts.delta_c)
+            factor = ("stage_banded",
+                      (stage_ops.factor_kkt_stage_banded(D, E),
+                       plan.partition))
         else:
-            K = W
-        factor = _factor_kkt(K, kkt_path, opts.stage_partition)
+            H = hess_l(w, y, z)
+            W = H + (delta * jnp.ones((n,), dtype) + sigma_L + sigma_U) * \
+                jnp.eye(n, dtype=dtype)
+            if m_h:
+                W = W + Jh.T @ (sigma_s[:, None] * Jh)
+
+            if m_e:
+                K = jnp.block([
+                    [W, Jg.T],
+                    [Jg, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
+                ])
+            else:
+                K = W
+            factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(rhs_w_k, mu_s, mu_L, mu_U):
             """Direction from the stored factor for (possibly per-entry)
@@ -580,7 +824,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             else:
                 dw_k = _resolve_kkt(factor, rhs_w_k)
                 dy_k = jnp.zeros((0,), dtype)
-            ds_k = (Jh @ dw_k + r_h) if m_h else s
+            ds_k = (jh_mv(Jh, dw_k) + r_h) if m_h else s
             dz_k = (mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * ds_k) \
                 if m_h else z
             dzL_k = mu_L / dL - zL - sigma_L * dw_k
@@ -594,7 +838,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             out = -r_w + (mu_L / dL - zL) - (mu_U / dU - zU)
             if m_h:
                 corr = mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * r_h
-                out = out + Jh.T @ corr
+                out = out + jh_t_mv(Jh, corr)
             return out
 
         # predictor: plain barrier target mu
@@ -800,6 +1044,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         mu=final.mu,
         constraint_violation=viol_raw,
         kkt_path=kkt_path_code,
+        jac_path=jac_path_code,
     )
     return SolverResult(
         w=w_out, y=y_out, z=z_out,

@@ -49,9 +49,12 @@ _HI = jax.lax.Precision.HIGHEST
 
 __all__ = [
     "StagePartition",
+    "band_matvec_blocks",
     "build_stage_partition",
     "factor_kkt_stage",
+    "factor_kkt_stage_banded",
     "resolve_kkt_stage",
+    "resolve_kkt_stage_banded",
     "solve_kkt_stage",
     "stage_method_available",
     "stage_of_index",
@@ -289,6 +292,70 @@ def solve_kkt_stage(K: jnp.ndarray, rhs: jnp.ndarray,
     drop-in for :func:`kkt.solve_kkt_ldl` when a stage partition exists."""
     return resolve_kkt_stage(factor_kkt_stage(K, partition), rhs,
                              partition, refine_steps)
+
+
+# --------------------------------------------------------------------------
+# banded-input factor / solve: the stage-sparse derivative pipeline
+# (ops/stagejac.py) assembles the KKT system directly as (D, E) blocks in
+# stage-permuted layout — the dense (M, M) matrix never exists on that
+# path, so these entry points take the blocks themselves. Refinement runs
+# against the banded matvec: on the certified-sparse path there ARE no
+# out-of-band entries (the jaxpr certificate proved them structurally
+# zero), so the banded residual is the exact residual.
+# --------------------------------------------------------------------------
+
+def band_matvec_blocks(D: jnp.ndarray, E: jnp.ndarray,
+                       x: jnp.ndarray) -> jnp.ndarray:
+    """K @ x for a symmetric block-tridiagonal K given as diagonal blocks
+    ``D`` (S, n_s, n_s) and sub-diagonal blocks ``E`` (S-1, n_s, n_s)
+    (``E[k]`` = block (k+1, k); the super-diagonal is ``E[k]ᵀ``), with
+    ``x`` (S, n_s). O(S·n_s²) instead of the dense O((S·n_s)²)."""
+    y = jnp.einsum("sab,sb->sa", D, x, precision=_HI)
+    if D.shape[0] > 1:
+        y = y.at[1:].add(jnp.einsum("sab,sb->sa", E, x[:-1], precision=_HI))
+        y = y.at[:-1].add(jnp.einsum("sab,sa->sb", E, x[1:], precision=_HI))
+    return y
+
+
+def _band_row_max(D: jnp.ndarray, E: jnp.ndarray) -> jnp.ndarray:
+    """Per-row max |entry| over the whole banded matrix, (S, n_s)."""
+    m = jnp.max(jnp.abs(D), axis=2)
+    if D.shape[0] > 1:
+        m = m.at[1:].set(jnp.maximum(m[1:], jnp.max(jnp.abs(E), axis=2)))
+        m = m.at[:-1].set(jnp.maximum(m[:-1], jnp.max(jnp.abs(E), axis=1)))
+    return m
+
+
+def factor_kkt_stage_banded(D: jnp.ndarray, E: jnp.ndarray):
+    """Equilibrate + block-tridiagonal factor from banded blocks ONLY
+    (the stage-sparse assembly path). Same symmetric Jacobi equilibration
+    as :func:`factor_kkt_stage` — computed from the band, which on the
+    certified path IS the whole matrix — and the same per-stage
+    pivot-free quasi-definite LDLᵀ Schur sweep."""
+    rm = _band_row_max(D, E)
+    scale = 1.0 / jnp.sqrt(jnp.maximum(rm, 1e-12))
+    Ds = D * scale[:, :, None] * scale[:, None, :]
+    Es = E * scale[1:, :, None] * scale[:-1, None, :] if D.shape[0] > 1 \
+        else E
+    F = _factor_blocks(Ds, Es)
+    return (F, Es, Ds, scale)
+
+
+def resolve_kkt_stage_banded(factor, rhs: jnp.ndarray,
+                             partition: StagePartition,
+                             refine_steps: int = 2) -> jnp.ndarray:
+    """Solve with a stored banded stage factor + iterative refinement
+    against the banded matvec (exact on the certified-sparse path).
+    ``rhs`` is in ORIGINAL KKT index order, like :func:`resolve_kkt_stage`."""
+    F, Es, Ds, scale = factor
+    _, valid, safe, inv = _perm_arrays(partition)
+    bp = jnp.where(jnp.asarray(valid), rhs[safe], jnp.zeros((), rhs.dtype))
+    bp = bp.reshape(partition.n_stages, partition.block) * scale
+    x = _solve_blocks(F, Es, bp)
+    for _ in range(refine_steps):
+        r = bp - band_matvec_blocks(Ds, Es, x)
+        x = x + _solve_blocks(F, Es, r)
+    return (x * scale).reshape(-1)[inv]
 
 
 # --------------------------------------------------------------------------

@@ -402,6 +402,54 @@ class FusedADMM:
 
         group_nlps = [make_group_nlp(gi) for gi in range(n_groups)]
 
+        # stage-sparse derivative plan per group, certified on the
+        # AUGMENTED nlp (what the fleet actually solves; the quadratic
+        # consensus/exchange penalties are stage-local, so a banded base
+        # OCP stays banded — but the certificate, not this comment, is
+        # the authority). Attached to cold AND warm options — through
+        # the shared gate+certify+attach seam, certifier run at most
+        # once per group — before any closure captures them, so the
+        # vmapped solves inside the fused while_loop carry banded
+        # Jacobians: the per-agent working-set lever of the LLC-bound
+        # batched KKT path (PERF.md round 6/8).
+        from agentlib_mpc_tpu.ops import stagejac
+        from agentlib_mpc_tpu.ops.solver import (
+            attach_jacobian_plan,
+            plan_worthwhile,
+        )
+
+        planned_groups = []
+        for gi, g in enumerate(groups):
+            part = getattr(g.ocp, "stage_partition", None)
+            theta0 = g.ocp.default_params()
+            aug0 = tuple(
+                (jnp.zeros((self.T,)), jnp.zeros((self.T,)),
+                 jnp.asarray(1.0))
+                for _ in range(len(aug_map[gi])))
+            n_w = int(g.ocp.initial_guess(theta0).shape[0])
+            cold_wants = plan_worthwhile(g.solver_options, part)
+            g_opts = stagejac.attach_plan_if_worthwhile(
+                g.solver_options, part, group_nlps[gi], (theta0, aug0),
+                n_w, label=f"group {g.name!r}")
+            wso = g.warm_solver_options
+            if wso is not None:
+                plan = g_opts.stage_jacobian_plan
+                if plan is not None:
+                    wso = attach_jacobian_plan(wso, plan)
+                elif not cold_wants:
+                    # warm-only configuration; a refuted COLD pass
+                    # already answered for the identical augmented nlp
+                    wso = stagejac.attach_plan_if_worthwhile(
+                        wso, part, group_nlps[gi], (theta0, aug0),
+                        n_w, label=f"group {g.name!r} (warm)")
+            if g_opts is not g.solver_options or \
+                    wso is not g.warm_solver_options:
+                g = dataclasses.replace(
+                    g, solver_options=g_opts, warm_solver_options=wso)
+            planned_groups.append(g)
+        groups = tuple(planned_groups)
+        self.groups = groups
+
         # per-group solver routing: LQ groups (linear models — their
         # quadratic ADMM augmentation keeps them LQ) ride the Mehrotra
         # QP fast path; certified once here, eagerly, per group

@@ -36,6 +36,10 @@ Modes:
                                 # factorization A/B at horizons
                                 # N=32/128/256 (the fatrop role,
                                 # ops/stagewise.py); optional single N
+    python bench.py --jac-ab [N]     # stage-sparse vs dense derivative
+                                # pipeline A/B (eval+jac, Hessian, warm
+                                # solve, per-agent working set) at the
+                                # same horizons (ops/stagejac.py)
     python bench.py --profile [dir] [n]   # XLA profiler trace of the
                                 # warm n-zone step (default 256;
                                 # --profile DIR 1024 = the sub-linearity
@@ -63,6 +67,9 @@ Modes:
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
      "vs_baseline": <cpu_ms / this_ms>}
+(The unqualified metric name is reserved for TPU measurements; any
+other platform publishes as ``admm256_step_ms_<platform>`` so the BENCH
+trajectory never mixes platforms.)
 """
 
 from __future__ import annotations
@@ -693,6 +700,23 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["jaxpr_certificates"] = certificate_summary()
     except Exception as exc:
         payload["jaxpr_certificates"] = {"error": repr(exc)}
+    # banded-vs-dense eval+jac cost comparison (lint/jaxpr cost model):
+    # the analytical crossover evidence behind jacobian="auto", recorded
+    # next to the measured phases (PERF.md round 8; the modeled dense
+    # FLOPs grow O(N²), the sparse ones O(N))
+    try:
+        from agentlib_mpc_tpu.lint.jaxpr.cost import compare_eval_jac_cost
+        from agentlib_mpc_tpu.ops.stagejac import plan_from_certificate
+
+        ocp = zone_ocp()
+        plan = plan_from_certificate(
+            ocp.nlp, ocp.default_params(), ocp.n_w, ocp.stage_partition,
+            label="the bench zone OCP")
+        payload["eval_jac_cost"] = {"error": "stage structure not proved"} \
+            if plan is None else compare_eval_jac_cost(
+                ocp.nlp, ocp.default_params(), ocp.n_w, plan)
+    except Exception as exc:
+        payload["eval_jac_cost"] = {"error": repr(exc)}
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1)
     summary = {
@@ -1104,6 +1128,150 @@ def run_ocp_ab(sizes=(32, 128, 256)) -> list[dict]:
     return rows
 
 
+def run_jac_ab(sizes=(32, 128, 256)) -> list[dict]:
+    """Stage-sparse vs dense derivative pipeline A/B over growing
+    horizons (``ops/stagejac.py``; PERF.md round 8): on the same OneRoom
+    collocation OCPs as ``--ocp-ab``, measure
+
+    (a) eval+jac — the stacked value+Jacobian pass the solver makes once
+        per accepted point: dense ``1+m_e+m_h`` unit-cotangent pullbacks
+        vs the plan's compressed ``1+3e_s+3h_s`` pullbacks, results
+        asserted IDENTICAL (the compression is loss-free);
+    (b) the Lagrangian-Hessian pass: ``n_w`` vs ``3·v_s`` forward seeds;
+    (c) a warm whole-solve through ``solve_nlp`` with each
+        ``jacobian`` setting (both on the stage KKT path, isolating the
+        derivative side), solutions compared; and
+    (d) the per-agent KKT working set: dense (n+m_e)² floats vs the
+        banded S·n_s² blocks — the LLC-pressure lever of the round-6
+        1024-zone attribution.
+
+    The cost-model ratio (``lint.jaxpr.compare_eval_jac_cost``) rides
+    along so the measured and modeled crossovers can be compared."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agentlib_mpc_tpu.lint.jaxpr.cost import compare_eval_jac_cost
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+    from agentlib_mpc_tpu.ops import stagejac
+    from agentlib_mpc_tpu.ops.solver import (
+        SolverOptions,
+        attach_jacobian_plan,
+        attach_stage_partition,
+        solve_nlp,
+    )
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    rows = []
+    for N in sizes:
+        ocp = transcribe(OneRoom(), ["mDot"], N=N, dt=60.0,
+                         method="collocation", collocation_degree=2)
+        part = ocp.stage_partition
+        theta = ocp.default_params()
+        plan = stagejac.plan_from_certificate(ocp.nlp, theta, ocp.n_w,
+                                              part, label=f"OneRoom N={N}")
+        if plan is None:
+            rows.append({"metric": f"jac_ab[N={N}]",
+                         "error": "stage structure not proved"})
+            print(json.dumps(rows[-1]))
+            continue
+        n, m_e, m_h = ocp.n_w, ocp.n_g, ocp.n_h
+        w0 = ocp.initial_guess(theta)
+        lb, ub = ocp.bounds(theta)
+        fgh = stagejac.stacked_fgh(ocp.nlp, theta)
+        eye = jnp.eye(1 + m_e + m_h)
+
+        @jax.jit
+        def eval_dense(w):
+            vals, pullback = jax.vjp(fgh, w)
+            return vals, jax.vmap(lambda ct: pullback(ct)[0])(eye)
+
+        @jax.jit
+        def eval_sparse(w):
+            return stagejac.banded_fgh_jac(plan, fgh, w)
+
+        dense_ms, (vals_d, J_d) = timed_best_ms(eval_dense, w0)
+        sparse_ms, (vals_s, _gf, Jg_rows, Jh_rows) = \
+            timed_best_ms(eval_sparse, w0)
+
+        # loss-free compression check: expand the banded rows and compare
+        def expand(rows_b, cols, m):
+            out = jnp.zeros((m, n))
+            if m == 0:
+                return out
+            r_idx = jnp.broadcast_to(jnp.arange(m)[:, None], cols.shape)
+            return out.at[r_idx.reshape(-1),
+                          jnp.asarray(np.maximum(cols, 0)).reshape(-1)
+                          ].add(rows_b.reshape(-1))
+
+        jac_diff = max(
+            float(jnp.max(jnp.abs(expand(Jg_rows, plan.g_cols, m_e)
+                                  - J_d[1:1 + m_e]))) if m_e else 0.0,
+            float(jnp.max(jnp.abs(expand(Jh_rows, plan.h_cols, m_h)
+                                  - J_d[1 + m_e:]))) if m_h else 0.0)
+
+        def grad_f(w):
+            return jax.grad(lambda ww: ocp.nlp.f(ww, theta))(w)
+
+        @jax.jit
+        def hess_dense(w):
+            _, jvp_fn = jax.linearize(grad_f, w)
+            return jax.vmap(jvp_fn)(jnp.eye(n))
+
+        @jax.jit
+        def hess_sparse(w):
+            return stagejac.banded_lagrangian_hessian(plan, grad_f, w)
+
+        hdense_ms, _ = timed_best_ms(hess_dense, w0)
+        hsparse_ms, _ = timed_best_ms(hess_sparse, w0)
+
+        # warm whole-solve: both on the stage factor path so the A/B
+        # isolates the derivative pipeline
+        solve_ms, sols = {}, {}
+        for label, jac in (("dense", "dense"), ("sparse", "sparse")):
+            opts = attach_jacobian_plan(attach_stage_partition(
+                SolverOptions(tol=1e-4, max_iter=15, kkt_method="stage",
+                              jacobian=jac), part), plan)
+            solve_ms[label], res = timed_best_ms(
+                lambda w, o=opts: solve_nlp(ocp.nlp, w, theta, lb, ub, o),
+                w0)
+            sols[label] = res.w
+        sol_diff = float(jnp.max(jnp.abs(sols["dense"] - sols["sparse"])))
+
+        cost = compare_eval_jac_cost(ocp.nlp, theta, n, plan)
+        dense_kkt_bytes = 4 * part.n_total ** 2
+        banded_kkt_bytes = 4 * plan.kkt_band_entries
+        dense_jac_bytes = 4 * (m_e + m_h) * n
+        banded_jac_bytes = 4 * (m_e * plan.W_g + m_h * plan.W_h)
+        row = {
+            "metric": f"jac_ab[N={N}]",
+            "kkt_size": part.n_total,
+            "rows_dense": 1 + m_e + m_h,
+            "rows_compressed": plan.n_ct,
+            "eval_jac_dense_ms": round(dense_ms, 3),
+            "eval_jac_sparse_ms": round(sparse_ms, 3),
+            "eval_jac_speedup": round(dense_ms / sparse_ms, 2),
+            "max_jac_diff": jac_diff,
+            "hessian_dense_ms": round(hdense_ms, 3),
+            "hessian_sparse_ms": round(hsparse_ms, 3),
+            "hessian_speedup": round(hdense_ms / hsparse_ms, 2),
+            "warm_solve_dense_jac_ms": round(solve_ms["dense"], 2),
+            "warm_solve_sparse_jac_ms": round(solve_ms["sparse"], 2),
+            "warm_solve_speedup": round(
+                solve_ms["dense"] / solve_ms["sparse"], 2),
+            "max_sol_diff": sol_diff,
+            "kkt_bytes_dense": dense_kkt_bytes,
+            "kkt_bytes_banded": banded_kkt_bytes,
+            "jac_carry_bytes_dense": dense_jac_bytes,
+            "jac_carry_bytes_banded": banded_jac_bytes,
+            "cost_model_flops_ratio": cost["flops_ratio"],
+            "platform": jax.devices()[0].platform,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
 def run_evidence() -> None:
     """The whole evidence matrix in ONE child process (VERDICT r4 #1):
     headline, LDL micro, knob A/Bs, QP A/B, scaling curve, the
@@ -1131,6 +1299,7 @@ def run_evidence() -> None:
     section("scaling", run_scaling)
     section("horizon_shard", run_horizon_shard)
     section("ocp_ab", run_ocp_ab)
+    section("jac_ab", run_jac_ab)
 
 
 # --- fail-soft orchestration (round-3 lesson: a wedged TPU tunnel hangs
@@ -1181,19 +1350,60 @@ def _child_main() -> None:
             run_ocp_ab(sizes=(int(sys.argv[idx + 1]),))
         else:
             run_ocp_ab()
+    elif "--jac-ab" in sys.argv:
+        idx = sys.argv.index("--jac-ab")
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            run_jac_ab(sizes=(int(sys.argv[idx + 1]),))
+        else:
+            run_jac_ab()
     elif "--evidence" in sys.argv:
         run_evidence()
     else:
         print(json.dumps(measure()))
 
 
+#: known-noise XLA warning markers filtered from forwarded child stderr:
+#: the XLA:CPU "machine type ... doesn't match ... Compile machine
+#: features: [+64bit,+adx,...] ... may cause SIGILL" blob is a
+#: multi-kilobyte per-child emission on this VM that dominated the
+#: driver-stored BENCH_r05/MULTICHIP_r05 stderr tails and buried the
+#: actual bench lines. Harmless (the persistent compile cache crosses
+#: machine generations by design), known, and useless in an artifact.
+_XLA_NOISE_MARKERS = (
+    "Machine type used for XLA:CPU compilation",
+    "Compile machine features:",
+    "may cause SIGILL",
+    "+prefer-no-gather",
+)
+
+
+def _filter_xla_noise(text: str) -> str:
+    """Drop known-noise XLA machine-feature warning lines before
+    forwarding child stderr (what the driver's ``tail`` capture stores);
+    appends one summary line so the filtering itself is on record."""
+    kept, dropped = [], 0
+    for ln in (text or "").splitlines(keepends=True):
+        if any(marker in ln for marker in _XLA_NOISE_MARKERS):
+            dropped += 1
+            continue
+        kept.append(ln)
+    out = "".join(kept)
+    if dropped:
+        if out and not out.endswith("\n"):
+            out += "\n"
+        out += (f"[bench] filtered {dropped} known-noise XLA "
+                f"machine-feature warning line(s)\n")
+    return out
+
+
 def _spawn(args: list, env: dict, timeout: float) -> list:
-    """Run this script as a child, forward its stderr, return its parsed
-    JSON stdout lines. Raises on rc != 0 or no JSON output. A TIMEOUT
-    salvages whatever JSON the child already flushed (the evidence
-    worker prints+flushes per section, so a late heavy section dying
-    must not discard the completed ones) and raises only when nothing
-    was produced."""
+    """Run this script as a child, forward its stderr (known-noise XLA
+    machine-feature warnings filtered, see :func:`_filter_xla_noise`),
+    return its parsed JSON stdout lines. Raises on rc != 0 or no JSON
+    output. A TIMEOUT salvages whatever JSON the child already flushed
+    (the evidence worker prints+flushes per section, so a late heavy
+    section dying must not discard the completed ones) and raises only
+    when nothing was produced."""
     def parse(out: str) -> list:
         lines = []
         for line in (out or "").strip().splitlines():
@@ -1218,7 +1428,7 @@ def _spawn(args: list, env: dict, timeout: float) -> list:
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=_HERE)
     except subprocess.TimeoutExpired as exc:
-        sys.stderr.write(as_text(exc.stderr))
+        sys.stderr.write(_filter_xla_noise(as_text(exc.stderr)))
         lines = parse(as_text(exc.stdout))
         if lines:
             print(f"[bench] child timed out after {timeout:.0f}s; "
@@ -1226,10 +1436,11 @@ def _spawn(args: list, env: dict, timeout: float) -> list:
                   file=sys.stderr)
             return lines
         raise
-    sys.stderr.write(proc.stderr)
+    sys.stderr.write(_filter_xla_noise(proc.stderr))
     if proc.returncode != 0:
         raise RuntimeError(
-            f"bench child rc={proc.returncode}: {proc.stderr[-500:]}")
+            f"bench child rc={proc.returncode}: "
+            f"{_filter_xla_noise(proc.stderr)[-500:]}")
     lines = parse(proc.stdout)
     if not lines:
         raise RuntimeError("bench child emitted no JSON")
@@ -1335,6 +1546,17 @@ def _measure_failsoft(mode_args: list, cpu_mode_args: "list | None" = None,
     return lines, "cpu", fell_back, attempts
 
 
+def _headline_metric(platform: str) -> str:
+    """Headline metric name, platform-qualified OFF the accelerator
+    (ROADMAP item 2's explicit ask): a CPU-fallback round must never
+    publish its number under the TPU trajectory metric —
+    BENCH_r04/r05 read as a 3.6× regression when they were a platform
+    change. The unqualified name is reserved for the accelerator the
+    trajectory tracks; anything else gets a ``_<platform>`` suffix."""
+    return "admm256_step_ms" if platform == "tpu" \
+        else f"admm256_step_ms_{platform}"
+
+
 def main() -> None:
     if "--probe" in sys.argv or "--worker" in sys.argv:
         _child_main()
@@ -1409,16 +1631,16 @@ def main() -> None:
         return
 
     for mode in ("--scaling", "--ab", "--qp-ab", "--ldl",
-                 "--horizon-shard", "--ocp-ab", "--evidence"):
+                 "--horizon-shard", "--ocp-ab", "--jac-ab", "--evidence"):
         if mode in sys.argv:
             idx = sys.argv.index(mode)
             mode_args = [mode]
             if len(sys.argv) > idx + 1 and not \
                     sys.argv[idx + 1].startswith("-"):
-                # only --ocp-ab takes a positional (horizon N); a value
-                # after any other mode would be silently ignored by the
-                # child, reporting numbers for a different config
-                if mode == "--ocp-ab":
+                # only --ocp-ab/--jac-ab take a positional (horizon N); a
+                # value after any other mode would be silently ignored by
+                # the child, reporting numbers for a different config
+                if mode in ("--ocp-ab", "--jac-ab"):
                     mode_args.append(sys.argv[idx + 1])
                 else:
                     print(f"[bench] {mode} takes no value; ignoring "
@@ -1486,7 +1708,7 @@ def main() -> None:
                       file=sys.stderr)
 
         line = {
-            "metric": "admm256_step_ms",
+            "metric": _headline_metric(platform),
             "value": round(res["step_ms"], 2),
             "unit": "ms",
             "vs_baseline": round(vs_baseline, 2),
@@ -1506,7 +1728,9 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 - the line must always emit
         print(f"[bench] catastrophic failure: {exc}", file=sys.stderr)
         print(json.dumps({
-            "metric": "admm256_step_ms",
+            # platform-qualified like every non-TPU emission: a null
+            # datapoint must not land in the TPU trajectory either
+            "metric": _headline_metric("unavailable"),
             "value": None,
             "unit": "ms",
             "vs_baseline": 0.0,

@@ -120,7 +120,9 @@ class TestFailsoft:
         monkeypatch.setattr(bench, "_spawn", fake_spawn)
         bench.main()
         line = _headline_lines(capsys)[-1]
-        assert line["metric"] == "admm256_step_ms"
+        # platform-qualified headline: a CPU number must never publish
+        # under the TPU trajectory metric (ROADMAP item 2)
+        assert line["metric"] == "admm256_step_ms_cpu"
         assert line["value"] == 100.0
         assert line["platform"] == "cpu"
         assert line["tpu_fallback_to_cpu"] is True
@@ -216,10 +218,37 @@ class TestFailsoft:
         monkeypatch.setattr(bench, "_spawn", dead_spawn)
         bench.main()  # must not raise
         line = _headline_lines(capsys)[-1]
-        assert line["metric"] == "admm256_step_ms"
+        # qualified: a null datapoint must not land in the TPU series
+        assert line["metric"] == "admm256_step_ms_unavailable"
         assert line["value"] is None
         assert line["platform"] == "unavailable"
         assert "error" in line
+
+    def test_headline_metric_is_platform_qualified(self):
+        """The unqualified trajectory name is reserved for TPU; every
+        other platform gets a suffix so the BENCH trajectory never mixes
+        platforms (r04/r05 read as a 3.6x regression when they were a
+        platform change)."""
+        assert bench._headline_metric("tpu") == "admm256_step_ms"
+        assert bench._headline_metric("cpu") == "admm256_step_ms_cpu"
+        assert bench._headline_metric("gpu") == "admm256_step_ms_gpu"
+
+    def test_xla_noise_filter_drops_machine_feature_blob(self):
+        """The multi-kB XLA:CPU machine-feature/SIGILL warning blob must
+        not reach the driver-stored stderr tail; real bench lines and
+        unrelated warnings survive."""
+        noise = ("W0000 Machine type used for XLA:CPU compilation "
+                 "doesn't match the machine type for execution. Compile "
+                 "machine features: [+64bit,+adx,+avx512f] running this "
+                 "code may cause SIGILL\n")
+        keep = "[bench] platform=cpu step=100.0ms\nsome other warning\n"
+        out = bench._filter_xla_noise(noise + keep)
+        assert "Compile machine features" not in out
+        assert "[bench] platform=cpu step=100.0ms" in out
+        assert "some other warning" in out
+        assert "filtered 1 known-noise" in out
+        # clean text passes through untouched (no spurious summary line)
+        assert bench._filter_xla_noise(keep) == keep
 
     def test_scaling_mode_always_emits_json(self, monkeypatch, capsys):
         monkeypatch.setattr(sys, "argv", ["bench.py", "--scaling"])

@@ -52,6 +52,24 @@ class TestRetraceBudgetGate:
         assert all(delta == 0 for delta in report["deltas"].values()), \
             report["deltas"]
 
+    def test_zero_recompiles_with_sparse_jacobian_pipeline(self):
+        """The checked-in lint_budgets.toml now ALSO pins
+        jacobian="sparse": the stage-sparse derivative pipeline
+        (ops/stagejac.py — compressed pullbacks, banded assembly,
+        banded stage factor) must hold the same zero-recompile steady
+        state as the dense jacrev path it replaces; every seed matrix
+        and scatter index is a static constant, so one warm trace
+        serves every round."""
+        report = run_gate(budgets={"retrace": {
+            "warmup_rounds": 2, "rounds": 3, "n_agents": 4,
+            "kkt_method": "stage", "jacobian": "sparse",
+            "budgets": {"default": 0}}},
+            verbose=False)
+        assert report["jacobian"] == "sparse"
+        assert report["violations"] == [], report
+        assert all(delta == 0 for delta in report["deltas"].values()), \
+            report["deltas"]
+
     def test_weak_typed_init_state_is_caught_by_the_gate(
             self, compile_profiler):
         """Re-introduce the PR 2 bug at runtime: replace the strong-typed

@@ -1,0 +1,473 @@
+"""Stage-sparse derivative pipeline tests (``ops/stagejac.py``).
+
+The CasADi-coloring-role coverage: the compressed-pullback eval+jac and
+compressed-seed Hessian must (a) reproduce the dense ``jacrev`` /
+``jax.hessian`` results EXACTLY (the compression is loss-free on a
+certified-banded problem — golden equivalence over the example menu:
+collocation d1/d2, multiple shooting, ± ``fix_initial_state``, linear
+and bilinear models), (b) assemble the SAME banded blocks the dense
+``_stage_blocks`` extraction produces, (c) carry solutions through
+``solve_nlp``/``solve_qp`` that agree with the dense pipeline, (d)
+route on the jaxpr certificate's authority — a refuted certificate
+keeps the dense path, forcing ``jacobian="sparse"`` without a proof
+raises — and (e) stay vmap-transparent for the fused fleet.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.ops import stagejac as sj
+from agentlib_mpc_tpu.ops import stagewise as sw
+from agentlib_mpc_tpu.ops.solver import (
+    JAC_PATHS,
+    KKT_PATHS,
+    NLPFunctions,
+    SolverOptions,
+    attach_jacobian_plan,
+    attach_stage_partition,
+    solve_nlp,
+)
+
+
+def _transcribed(model_cls, controls, N=5, **kw):
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    return transcribe(model_cls(), controls, N=N, dt=60.0, **kw)
+
+
+_PLANS: dict = {}
+
+
+def _plan_for(ocp, key=None):
+    """Certificate-backed plan, memoized per transcription config so the
+    abstract interpreter runs once per configuration, not once per test
+    (the production seams memoize the same way via the plan cache)."""
+    if key is not None and key in _PLANS:
+        return _PLANS[key]
+    plan = sj.plan_from_certificate(ocp.nlp, ocp.default_params(),
+                                    ocp.n_w, ocp.stage_partition)
+    assert plan is not None, "menu entry must certify banded"
+    if key is not None:
+        _PLANS[key] = plan
+    return plan
+
+
+def _expand(rows, cols, m, n):
+    """Banded row windows -> dense (m, n) matrix (test-side inverse)."""
+    out = np.zeros((m, n))
+    rows = np.asarray(rows)
+    for r in range(m):
+        for k, c in enumerate(np.asarray(cols)[r]):
+            if c >= 0:
+                out[r, c] += rows[r, k]
+    return out
+
+
+def _sparse_opts(ocp, plan, **kw):
+    return attach_jacobian_plan(attach_stage_partition(
+        SolverOptions(jacobian="sparse", **kw), ocp.stage_partition), plan)
+
+
+# quick tier: one entry per structural family (collocation with interior
+# states, shooting without); the full menu sweep (d1/d2, shooting,
+# ±fix_initial_state, bilinear CooledRoom) rides the slow tier like the
+# certifier's own menu sweep does
+MENU_QUICK = [
+    ("OneRoom", ["mDot"], dict(method="collocation",
+                               collocation_degree=2)),
+    ("LinearRCZone", ["Q"], dict(method="multiple_shooting",
+                                 fix_initial_state=False)),
+]
+MENU_SLOW = [
+    ("OneRoom", ["mDot"], dict(method="collocation",
+                               collocation_degree=1)),
+    ("OneRoom", ["mDot"], dict(method="multiple_shooting")),
+    ("OneRoom", ["mDot"], dict(method="collocation", collocation_degree=2,
+                               fix_initial_state=False)),
+    ("CooledRoom", ["mDot"], dict(method="collocation",
+                                  collocation_degree=1)),
+]
+MENU = MENU_QUICK + [pytest.param(*e, marks=pytest.mark.slow)
+                     for e in MENU_SLOW]
+
+
+# --------------------------------------------------------------------------
+# golden equivalence: banded eval+jac == dense jacrev on the full menu
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model_name,controls,kw", MENU)
+def test_banded_eval_jac_matches_dense(model_name, controls, kw):
+    from agentlib_mpc_tpu.models import zoo
+
+    ocp = _transcribed(getattr(zoo, model_name), controls, **kw)
+    theta = ocp.default_params()
+    plan = _plan_for(ocp, key=(model_name, str(kw)))
+    n, m_e, m_h = ocp.n_w, ocp.n_g, ocp.n_h
+
+    fgh = sj.stacked_fgh(ocp.nlp, theta)
+    w = ocp.initial_guess(theta) + 0.1 * jax.random.normal(
+        jax.random.PRNGKey(0), (n,))
+
+    @jax.jit
+    def dense(w):
+        vals, pullback = jax.vjp(fgh, w)
+        return vals, jax.vmap(lambda ct: pullback(ct)[0])(
+            jnp.eye(1 + m_e + m_h))
+
+    vals_d, J = dense(w)
+    vals_s, gf, Jg_rows, Jh_rows = jax.jit(
+        lambda w: sj.banded_fgh_jac(plan, fgh, w))(w)
+
+    assert jnp.allclose(vals_d, vals_s)
+    assert jnp.allclose(J[0], gf)
+    # the compression is loss-free: EXACT agreement, not tolerance
+    np.testing.assert_array_equal(
+        _expand(Jg_rows, plan.g_cols, m_e, n), np.asarray(J[1:1 + m_e]))
+    np.testing.assert_array_equal(
+        _expand(Jh_rows, plan.h_cols, m_h, n), np.asarray(J[1 + m_e:]))
+
+
+@pytest.mark.parametrize("model_name,controls,kw", MENU_QUICK[:1]
+                         + [pytest.param(*e, marks=pytest.mark.slow)
+                            for e in MENU_SLOW[:2]])
+def test_banded_hessian_matches_dense(model_name, controls, kw):
+    from agentlib_mpc_tpu.models import zoo
+
+    ocp = _transcribed(getattr(zoo, model_name), controls, **kw)
+    theta = ocp.default_params()
+    plan = _plan_for(ocp, key=(model_name, str(kw)))
+    n, m_e, m_h = ocp.n_w, ocp.n_g, ocp.n_h
+    rng = np.random.default_rng(1)
+    y = jnp.asarray(rng.normal(size=m_e))
+    z = jnp.asarray(np.abs(rng.normal(size=m_h)))
+    w = jnp.asarray(rng.normal(size=n))
+
+    def lagr(ww):
+        val = ocp.nlp.f(ww, theta) + y @ ocp.nlp.g(ww, theta)
+        if m_h:
+            val = val - z @ ocp.nlp.h(ww, theta)
+        return val
+
+    H = jax.jit(jax.hessian(lagr))(w)
+
+    @jax.jit
+    def banded(w):
+        CH = sj.banded_lagrangian_hessian(plan, jax.grad(lagr), w)
+        return sj.hessian_rows(plan, CH)
+
+    H_rows = banded(w)
+    np.testing.assert_allclose(
+        _expand(H_rows, plan.hrow_cols, n, n), np.asarray(H),
+        rtol=0, atol=5e-5 * max(1.0, float(jnp.max(jnp.abs(H)))))
+
+
+def test_assembly_matches_dense_stage_blocks():
+    """assemble_kkt_banded must produce the same (D, E) blocks the dense
+    path's ``_stage_blocks`` extracts from the materialized KKT matrix
+    (up to f32 symmetrization noise)."""
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], method="collocation",
+                       collocation_degree=2)
+    theta = ocp.default_params()
+    p = ocp.stage_partition
+    plan = _plan_for(ocp, key="site1")
+    n, m_e, m_h = ocp.n_w, ocp.n_g, ocp.n_h
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=n))
+    y = jnp.asarray(rng.normal(size=m_e))
+    z = jnp.asarray(np.abs(rng.normal(size=m_h)))
+    sigma_s = jnp.asarray(np.abs(rng.normal(size=m_h)) + 0.1)
+    w_diag = jnp.asarray(np.abs(rng.normal(size=n)) + 1e-4)
+    delta_c = 1e-8
+
+    def lagr(ww):
+        return (ocp.nlp.f(ww, theta) + y @ ocp.nlp.g(ww, theta)
+                - z @ ocp.nlp.h(ww, theta))
+
+    @jax.jit
+    def dense_blocks(w):
+        H = jax.hessian(lagr)(w)
+        Jg = jax.jacrev(lambda ww: ocp.nlp.g(ww, theta))(w)
+        Jh = jax.jacrev(lambda ww: ocp.nlp.h(ww, theta))(w)
+        W = H + jnp.diag(w_diag) + Jh.T @ (sigma_s[:, None] * Jh)
+        K = jnp.block([[W, Jg.T], [Jg, -delta_c * jnp.eye(m_e)]])
+        return sw._stage_blocks(K, p)
+
+    D_ref, E_ref = dense_blocks(w)
+
+    @jax.jit
+    def banded_blocks(w):
+        fgh = sj.stacked_fgh(ocp.nlp, theta)
+        _, _, Jg_rows, Jh_rows = sj.banded_fgh_jac(plan, fgh, w)
+        CH = sj.banded_lagrangian_hessian(plan, jax.grad(lagr), w)
+        return sj.assemble_kkt_banded(plan, CH, Jg_rows, Jh_rows,
+                                      sigma_s, w_diag, delta_c)
+
+    D, E = banded_blocks(w)
+    scale = max(1.0, float(jnp.max(jnp.abs(D_ref))))
+    np.testing.assert_allclose(np.asarray(D), np.asarray(D_ref),
+                               rtol=0, atol=5e-5 * scale)
+    np.testing.assert_allclose(np.asarray(E), np.asarray(E_ref),
+                               rtol=0, atol=5e-5 * scale)
+
+
+def test_banded_factor_solves_like_dense_stage():
+    """factor/resolve_kkt_stage_banded from assembled blocks must agree
+    with the dense-input stage sweep AND satisfy the dense residual."""
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], method="collocation",
+                       collocation_degree=2)
+    p = ocp.stage_partition
+    K, rhs = sw.synthetic_stage_kkt(p, seed=3, dtype=np.float32)
+    Kj, rj = jnp.asarray(K), jnp.asarray(rhs)
+    x_ref = sw.solve_kkt_stage(Kj, rj, p)
+    D, E = sw._stage_blocks(Kj, p)
+    x_b = sw.resolve_kkt_stage_banded(sw.factor_kkt_stage_banded(D, E),
+                                      rj, p)
+    res = float(jnp.max(jnp.abs(Kj @ x_b - rj)))
+    assert res < 1e-3
+    np.testing.assert_allclose(np.asarray(x_b), np.asarray(x_ref),
+                               rtol=0, atol=1e-3)
+
+
+# --------------------------------------------------------------------------
+# end to end: solve_nlp / solve_qp with each pipeline agree
+# --------------------------------------------------------------------------
+
+def test_solve_nlp_sparse_matches_dense():
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=8, method="collocation",
+                       collocation_degree=2)
+    theta = ocp.default_params()
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    plan = _plan_for(ocp, key="site2")
+    base = SolverOptions(tol=1e-4, max_iter=30)
+    opts_d = attach_stage_partition(
+        base._replace(jacobian="dense", kkt_method="stage"),
+        ocp.stage_partition)
+    opts_s = attach_jacobian_plan(attach_stage_partition(
+        base._replace(jacobian="sparse"), ocp.stage_partition), plan)
+    rd = solve_nlp(ocp.nlp, w0, theta, lb, ub, opts_d)
+    rs = solve_nlp(ocp.nlp, w0, theta, lb, ub, opts_s)
+    assert bool(rd.stats.success) and bool(rs.stats.success)
+    assert int(rd.stats.jac_path) == JAC_PATHS.index("dense")
+    assert int(rs.stats.jac_path) == JAC_PATHS.index("sparse")
+    assert int(rs.stats.kkt_path) == KKT_PATHS.index("stage")
+    # same tolerance the stage sweep met in its dense-vs-stage identity
+    assert float(jnp.max(jnp.abs(rd.w - rs.w))) < 1e-5 * (
+        1.0 + float(jnp.max(jnp.abs(rd.w))))
+
+
+def test_solve_qp_sparse_matches_lu():
+    """The QP fast path with the sparse pipeline must reach the same
+    optimum as the production dense-LU QP path (objective + feasibility;
+    the f32 stall points differ slightly between factorizations)."""
+    from agentlib_mpc_tpu.models.zoo import LinearRCZone
+    from agentlib_mpc_tpu.ops.qp import solve_qp
+
+    ocp = _transcribed(LinearRCZone, ["Q"], N=8,
+                       method="collocation", collocation_degree=2)
+    theta = ocp.default_params()
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    plan = _plan_for(ocp, key="site3")
+    base = SolverOptions(tol=1e-4, max_iter=60)
+    r_lu = solve_qp(ocp.nlp, w0, theta, lb, ub,
+                    base._replace(kkt_method="lu"))
+    r_sp = solve_qp(ocp.nlp, w0, theta, lb, ub, _sparse_opts(
+        ocp, plan, tol=1e-4, max_iter=60))
+    assert bool(r_lu.stats.success) and bool(r_sp.stats.success)
+    assert int(r_sp.stats.jac_path) == JAC_PATHS.index("sparse")
+    assert float(r_sp.stats.constraint_violation) < 1e-2
+    obj_lu, obj_sp = float(r_lu.stats.objective), float(r_sp.stats.objective)
+    assert abs(obj_sp - obj_lu) < 5e-3 * max(1.0, abs(obj_lu))
+
+
+@pytest.mark.slow
+def test_vmap_sparse_matches_single_lane():
+    """Fused-fleet transparency: the sparse pipeline under vmap (the
+    agent axis) must equal the per-lane solves exactly."""
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    theta = ocp.default_params()
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    plan = _plan_for(ocp, key="site4")
+    opts = _sparse_opts(ocp, plan, tol=1e-4, max_iter=20)
+    wb = jnp.stack([w0, w0 * 1.01, w0 * 0.98])
+    rb = jax.vmap(lambda w: solve_nlp(ocp.nlp, w, theta, lb, ub, opts))(wb)
+    r0 = solve_nlp(ocp.nlp, wb[0], theta, lb, ub, opts)
+    assert float(jnp.max(jnp.abs(rb.w[0] - r0.w))) == 0.0
+
+
+# --------------------------------------------------------------------------
+# routing: the certificate is the authority
+# --------------------------------------------------------------------------
+
+def _out_of_band_nlp(ocp):
+    """Adversarial wrapper: a first-stage × last-stage objective coupling
+    the certificate must refute (the sparse assembly would DROP it)."""
+    base = ocp.nlp
+
+    def f_bad(w, theta):
+        return base.f(w, theta) + 1e-6 * w[0] * w[-1]
+
+    return NLPFunctions(f=f_bad, g=base.g, h=base.h)
+
+
+def test_refuted_certificate_yields_no_plan_and_dense_routing(caplog):
+    import logging
+
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    theta = ocp.default_params()
+    bad = _out_of_band_nlp(ocp)
+    with caplog.at_level(logging.WARNING,
+                         logger="agentlib_mpc_tpu.ops.stagejac"):
+        plan = sj.plan_from_certificate(bad, theta, ocp.n_w,
+                                        ocp.stage_partition)
+    assert plan is None
+    assert any("not proved" in r.message for r in caplog.records), \
+        "the dense fallback must be loud"
+
+    # jacobian="auto" without a plan: solves, stays dense — even with the
+    # stage factorization forced (banded FACTOR is fine, the dense matrix
+    # still materializes every out-of-band entry)
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    opts = attach_stage_partition(
+        SolverOptions(tol=1e-4, max_iter=20, kkt_method="stage"),
+        ocp.stage_partition)
+    res = solve_nlp(bad, w0, theta, lb, ub, opts)
+    assert int(res.stats.jac_path) == JAC_PATHS.index("dense")
+
+
+def test_forced_sparse_without_plan_raises():
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    theta = ocp.default_params()
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    opts = attach_stage_partition(
+        SolverOptions(jacobian="sparse"), ocp.stage_partition)
+    with pytest.raises(ValueError, match="stage_jacobian_plan"):
+        solve_nlp(ocp.nlp, w0, theta, lb, ub, opts)
+
+
+def test_forced_sparse_contradicting_kkt_method_raises():
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    theta = ocp.default_params()
+    plan = _plan_for(ocp, key="site5")
+    w0 = ocp.initial_guess(theta)
+    lb, ub = ocp.bounds(theta)
+    opts = attach_jacobian_plan(
+        SolverOptions(jacobian="sparse", kkt_method="lu"), plan)
+    with pytest.raises(ValueError, match="contradicts"):
+        solve_nlp(ocp.nlp, w0, theta, lb, ub, opts)
+
+
+def test_auto_routing_is_size_aware():
+    """auto routes sparse exactly where the stage factor path runs: below
+    stage_min_size the whole pipeline stays dense; lowering the floor
+    flips BOTH paths together; jacobian_min_size adds a sparse-only
+    floor on top. Exercised at the trace-time resolver (pure — the
+    end-to-end stats codes are pinned by
+    test_solve_nlp_sparse_matches_dense)."""
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+    from agentlib_mpc_tpu.ops.solver import _resolve_jacobian
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=6, method="collocation",
+                       collocation_degree=2)
+    plan = _plan_for(ocp, key="site6")
+    size = ocp.stage_partition.n_total
+
+    def resolve(**kw):
+        return _resolve_jacobian(attach_jacobian_plan(
+            attach_stage_partition(SolverOptions(**kw),
+                                   ocp.stage_partition), plan), size)
+
+    assert resolve() == "dense"                    # default floor 192
+    assert resolve(stage_min_size=8, jacobian_min_size=8) == "sparse"
+    # the sparse-only floor (default 384, the measured whole-solve
+    # crossover) keeps small stage-factored problems on dense derivatives
+    assert resolve(stage_min_size=8) == "dense"
+    assert resolve(kkt_method="stage", jacobian_min_size=8) == "sparse"
+    # forced sparse ignores every floor
+    assert resolve(jacobian="sparse") == "sparse"
+
+
+def test_plan_cache_and_equality():
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    cert_stages = _plan_for(ocp, key="site7").h_row_stages
+    p1 = sj.build_stage_jacobian_plan(ocp.stage_partition, cert_stages)
+    p2 = sj.build_stage_jacobian_plan(ocp.stage_partition, cert_stages)
+    assert p1 is p2                      # memoized: one object per key
+    assert hash(p1) == hash(p2) and p1 == p2
+
+
+def test_certificate_reports_h_row_stages():
+    from agentlib_mpc_tpu.lint.jaxpr import certify_stage_structure
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=2)
+    cert = certify_stage_structure(ocp.nlp, ocp.default_params(),
+                                   ocp.n_w, ocp.stage_partition)
+    assert cert.ok
+    assert cert.h_row_stages is not None
+    assert len(cert.h_row_stages) == ocp.n_h
+    assert all(0 <= s < ocp.stage_partition.n_stages
+               for s in cert.h_row_stages)
+
+
+def test_backend_attaches_plan_only_when_worthwhile():
+    """plan_worthwhile gates the certifier cost away from small setups:
+    the default config at bench sizes must not build a plan, forcing
+    jacobian='sparse' must."""
+    from agentlib_mpc_tpu.models.zoo import OneRoom
+    from agentlib_mpc_tpu.ops.solver import plan_worthwhile
+
+    ocp = _transcribed(OneRoom, ["mDot"], N=5, method="collocation",
+                       collocation_degree=1)
+    part = ocp.stage_partition
+    assert not plan_worthwhile(SolverOptions(), part)
+    assert plan_worthwhile(SolverOptions(jacobian="sparse"), part)
+    # forced stage below the sparse floor: auto jacobian would still
+    # resolve dense, so the certificate would be dead weight
+    assert not plan_worthwhile(SolverOptions(kkt_method="stage"), part)
+    assert not plan_worthwhile(SolverOptions(jacobian="dense",
+                                             kkt_method="stage"), part)
+    # a REAL above-crossover partition (the worthwhile gate now consults
+    # the stage sweep's availability probe, which a mutated/mock
+    # partition would fail)
+    big = sw.build_stage_partition(N=80, n_x=1, n_u=1, n_z=1, d=1,
+                                   method="collocation")
+    assert big.n_total >= 384
+    assert plan_worthwhile(SolverOptions(kkt_method="stage"), big)
+    # CPU: auto resolves LU -> stage above the crossover, so the plan
+    # pays for itself; where the Pallas LDL probe passes (TPU) auto
+    # never reaches stage and this returns False instead
+    assert plan_worthwhile(SolverOptions(), big)

@@ -366,7 +366,7 @@ class TestStatsHistoryBackCompat:
 
     EXPECTED_KEYS = {"time", "iterations", "success", "kkt_error",
                      "objective", "constraint_violation", "solve_wall_time",
-                     "kkt_path"}
+                     "kkt_path", "jac_path"}
 
     @pytest.fixture(scope="class")
     def backend(self):
@@ -405,6 +405,8 @@ class TestStatsHistoryBackCompat:
         assert isinstance(row["solve_wall_time"], float)
         # per-solve factor-path attribution (lu on CPU for this tiny OCP)
         assert row["kkt_path"] in ("lu", "ldl", "stage")
+        # derivative-pipeline attribution (dense: tiny OCP, no plan)
+        assert row["jac_path"] in ("dense", "sparse")
 
     def test_history_is_mutable_list(self, backend):
         hist = backend.stats_history
