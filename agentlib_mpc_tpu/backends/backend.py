@@ -233,6 +233,25 @@ class OptimizationBackend:
         label."""
         return True, ()
 
+    def problem_fingerprint(self):
+        """Structural fingerprint of the transcribed problem this
+        backend solves — the admission key of the serving dispatch plane
+        (``agentlib_mpc_tpu/serving/``): an agent process asks its
+        backend for this and hands it to
+        :meth:`~agentlib_mpc_tpu.serving.plane.ServingPlane.join`
+        bucketing. Available once ``setup_optimization`` has transcribed
+        the OCP (the JAX backends set ``self.ocp``); raises otherwise.
+        Memoized per OCP object via the serving layer's cache."""
+        ocp = getattr(self, "ocp", None)
+        if ocp is None:
+            raise RuntimeError(
+                "problem_fingerprint() needs a transcribed OCP — call "
+                "setup_optimization first (or this backend type does "
+                "not expose one)")
+        from agentlib_mpc_tpu.serving.fingerprint import tenant_fingerprint
+
+        return tenant_fingerprint(ocp)
+
     # -- durable warm-start state (beyond reference: its warm starts die
     #    with the process, ``casadi_utils.py:94-101``) ------------------------
 

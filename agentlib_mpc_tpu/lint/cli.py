@@ -12,6 +12,10 @@ Modes:
   (edit the ``justification`` fields afterwards!).
 * ``--retrace-budget`` — run the runtime compile-budget gate against
   ``lint_budgets.toml`` (imports jax; the static modes never do).
+* ``--serving-budget`` — run the serving-plane churn gate
+  (``[serving]`` in ``lint_budgets.toml``): zero warm traces/compiles
+  across a scripted join→serve→leave→rejoin sequence, and the rejoin
+  must be a compile-cache hit (imports jax).
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
   structure proof, dtype propagation, cost model) over the example-OCP
   menu against the ``[jaxpr.expect]`` expectations in
@@ -40,6 +44,10 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument("--retrace-budget", action="store_true",
                         help="run the runtime compile-budget gate "
                              "(lint_budgets.toml)")
+    parser.add_argument("--serving-budget", action="store_true",
+                        help="run the serving-plane churn gate: zero "
+                             "warm retraces across join/serve/leave/"
+                             "rejoin, rejoin = compile-cache hit")
     parser.add_argument("--jaxpr", action="store_true",
                         help="run the semantic jaxpr certification "
                              "passes over the example-OCP menu")
@@ -68,6 +76,14 @@ def main(argv: "list[str] | None" = None) -> int:
             if args.budgets else None
         report = retrace_budget.run_gate(budgets)
         return 1 if report["violations"] else 0
+
+    if args.serving_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_serving_gate(budgets)
+        return 1 if report["violations"] or report["failures"] else 0
 
     if args.jaxpr:
         from agentlib_mpc_tpu.lint.jaxpr.examples import (

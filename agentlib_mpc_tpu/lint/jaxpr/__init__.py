@@ -51,6 +51,11 @@ from agentlib_mpc_tpu.lint.jaxpr.cost import (  # noqa: F401
     op_cost,
 )
 from agentlib_mpc_tpu.lint.jaxpr.dtypes import check_dtypes  # noqa: F401
+from agentlib_mpc_tpu.lint.jaxpr.fingerprint import (  # noqa: F401
+    StructuralFingerprint,
+    jaxpr_digest,
+    structural_fingerprint,
+)
 from agentlib_mpc_tpu.lint.jaxpr.lq import (  # noqa: F401
     LQCertificate,
     certify_lq,

@@ -1,0 +1,141 @@
+"""Structural fingerprints: the jaxpr as a provable compile-cache key.
+
+The serving dispatch plane (``agentlib_mpc_tpu/serving/``) admits a
+*dynamic* tenant population onto compiled fused engines. Reusing an
+executable for a new tenant is sound exactly when the tenant's problem
+lowers to the SAME computation graph with only parameter values
+differing — a question PR 5's certifier answered for routing and this
+module turns into a cache key:
+
+* **Identity** — SHA-256 digests of the closed jaxprs of ``f``/``g``/``h``
+  traced at the problem's shapes. Two separately-transcribed OCPs of the
+  same model class produce byte-identical jaxprs (deterministic variable
+  naming, constants embedded), so they fingerprint equal and share one
+  executable; a model whose baked constants differ fingerprints apart
+  even when every *certificate* agrees — the digest, not the structure
+  facts, is the load-bearing equality.
+* **Provable structure facts** — the LQ verdict (:func:`.lq.certify_lq`)
+  and the stage-structure proof (:func:`.structure.certify_stage_structure`),
+  which determine how the engine would ROUTE the problem (QP fast path,
+  banded derivative pipeline). They ride in the fingerprint so two
+  problems that would route differently can never share a cache entry,
+  and so the serving artifact records why an engine was built the way it
+  was.
+
+Cost: one trace of each function plus the two certifier passes
+(measured 0.3–2.4 s per structure, PERF.md round 7) — paid once per
+problem *structure*, which is the entire point: the serving layer
+memoizes by ``TranscribedOCP`` identity and every structurally-identical
+join after the first is a dictionary lookup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import NamedTuple
+
+__all__ = ["StructuralFingerprint", "jaxpr_digest", "structural_fingerprint"]
+
+
+def jaxpr_digest(fn, *example_args) -> str:
+    """SHA-256 (truncated to 16 hex chars) of ``fn``'s closed jaxpr at
+    the example arguments' shapes/dtypes. Constants are embedded in the
+    printed jaxpr, so functions differing only in baked-in numbers
+    digest apart; parameter (argument) values do not enter."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*example_args)
+    return hashlib.sha256(str(jaxpr).encode()).hexdigest()[:16]
+
+
+class StructuralFingerprint(NamedTuple):
+    """Hashable identity + provable structure facts of one NLP.
+
+    Equality of two fingerprints means: identical traced computation
+    graphs (up to parameter values), identical shapes and dtype, and
+    identical certified routing facts — the soundness conditions for
+    reusing a compiled engine across tenants.
+    """
+
+    #: jaxpr digests of (f, g, h) — the load-bearing identity
+    f_digest: str
+    g_digest: str
+    h_digest: str
+    #: (n_w, m_e, m_h): the shape bucket
+    n_w: int
+    m_e: int
+    m_h: int
+    #: canonical dtype string of the decision vector
+    dtype: str
+    #: LQ certificate status ("lq" / "not_lq" / "unknown")
+    lq_status: str
+    #: stage-structure proof outcome (None: no partition supplied)
+    stage_ok: "bool | None" = None
+    #: per-h-row base stages from a PROVED certificate (else None) —
+    #: the defining key of the stage-sparse derivative plan
+    h_row_stages: "tuple | None" = None
+
+    @property
+    def digest(self) -> str:
+        """One stable short hex digest over every field — the string the
+        serving cache counts hits/misses by and artifacts record."""
+        return hashlib.sha256(repr(self).encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        stage = ("banded" if self.stage_ok
+                 else "unproved" if self.stage_ok is not None else "n/a")
+        return (f"{self.digest} (n_w={self.n_w}, m_e={self.m_e}, "
+                f"m_h={self.m_h}, {self.dtype}, lq={self.lq_status}, "
+                f"stage={stage})")
+
+
+def structural_fingerprint(nlp, theta, n_w: int,
+                           partition=None) -> StructuralFingerprint:
+    """Fingerprint one NLP: trace digests + certified structure facts.
+
+    ``nlp`` is an :class:`~agentlib_mpc_tpu.ops.solver.NLPFunctions`
+    triple of ``(w, theta)`` functions; ``theta`` an example parameter
+    pytree (values irrelevant, shapes matter); ``partition`` the
+    OCP's :class:`~agentlib_mpc_tpu.ops.stagewise.StagePartition` when
+    one exists — the stage proof is skipped without it.
+
+    Certifier failures degrade, never raise: an interpreter error maps
+    to ``lq_status="unknown"`` / ``stage_ok=None``, which still yields a
+    valid (more conservative) cache key — two problems whose structure
+    could not be proved share an entry only if their jaxprs are
+    byte-identical anyway.
+    """
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.lint.jaxpr import (
+        certify_lq,
+        certify_stage_structure,
+    )
+
+    w0 = jnp.zeros((n_w,))
+    f_d = jaxpr_digest(nlp.f, w0, theta)
+    g_d = jaxpr_digest(nlp.g, w0, theta)
+    h_d = jaxpr_digest(nlp.h, w0, theta)
+    m_e = int(nlp.g(w0, theta).shape[0])
+    m_h = int(nlp.h(w0, theta).shape[0])
+
+    try:
+        lq_status = certify_lq(nlp, theta, n_w).status
+    except Exception:  # noqa: BLE001 — a certifier bug must not block joins
+        lq_status = "unknown"
+    stage_ok: "bool | None" = None
+    h_row_stages: "tuple | None" = None
+    if partition is not None:
+        try:
+            cert = certify_stage_structure(nlp, theta, n_w, partition)
+            stage_ok = bool(cert.ok)
+            if cert.ok and cert.h_row_stages is not None:
+                h_row_stages = tuple(int(s) for s in cert.h_row_stages)
+        except Exception:  # noqa: BLE001
+            stage_ok = None
+    return StructuralFingerprint(
+        f_digest=f_d, g_digest=g_d, h_digest=h_d,
+        n_w=int(n_w), m_e=m_e, m_h=m_h,
+        dtype=str(w0.dtype),
+        lq_status=lq_status, stage_ok=stage_ok,
+        h_row_stages=h_row_stages)

@@ -91,6 +91,40 @@ def _mini_toml(text: str) -> dict:
     return out
 
 
+def tracker_ocp():
+    """The gate workload's transcribed OCP: a 1-control tracker
+    (min (u - a)^2) on a 4-interval shooting grid — compiles in seconds
+    on CPU, structurally identical to the consensus bench agents.
+    Shared by the fused-engine retrace gate and the serving churn gate."""
+    from agentlib_mpc_tpu.models.model import Model, ModelEquations
+    from agentlib_mpc_tpu.models.objective import SubObjective
+    from agentlib_mpc_tpu.models.variables import control_input, parameter
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+
+    class _Tracker(Model):
+        inputs = [control_input("u", 0.0, lb=-5.0, ub=5.0)]
+        parameters = [parameter("a", 1.0)]
+
+        def setup(self, v):
+            eq = ModelEquations()
+            eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
+            return eq
+
+    return transcribe(_Tracker(), ["u"], N=4, dt=0.5,
+                      method="multiple_shooting")
+
+
+def _compile_snapshot(reg) -> dict:
+    """Per-entry-point (traces + compiles) totals — the quantity both
+    gates budget."""
+    totals: dict = {}
+    for name in ("jax_traces_total", "jax_compiles_total"):
+        for sample in reg.counter(name).samples():
+            entry = sample["labels"].get("entry_point", "(unscoped)")
+            totals[entry] = totals.get(entry, 0) + int(sample["value"])
+    return totals
+
+
 def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto",
                        jacobian: str = "auto"):
     """The gate's workload: one consensus group of ``n_agents`` trackers
@@ -103,11 +137,7 @@ def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto",
     Returns (engine, state, theta_batches)."""
     import jax.numpy as jnp
 
-    from agentlib_mpc_tpu.models.model import Model, ModelEquations
-    from agentlib_mpc_tpu.models.objective import SubObjective
-    from agentlib_mpc_tpu.models.variables import control_input, parameter
     from agentlib_mpc_tpu.ops.solver import SolverOptions
-    from agentlib_mpc_tpu.ops.transcription import transcribe
     from agentlib_mpc_tpu.parallel.fused_admm import (
         AgentGroup,
         FusedADMM,
@@ -115,17 +145,7 @@ def build_bench_engine(n_agents: int = 4, kkt_method: str = "auto",
         stack_params,
     )
 
-    class _Tracker(Model):
-        inputs = [control_input("u", 0.0, lb=-5.0, ub=5.0)]
-        parameters = [parameter("a", 1.0)]
-
-        def setup(self, v):
-            eq = ModelEquations()
-            eq.objective = SubObjective((v.u - v.a) ** 2, name="track")
-            return eq
-
-    ocp = transcribe(_Tracker(), ["u"], N=4, dt=0.5,
-                     method="multiple_shooting")
+    ocp = tracker_ocp()
     group = AgentGroup(
         name="retrace-gate", ocp=ocp, n_agents=n_agents,
         couplings={"shared_u": "u"},
@@ -166,12 +186,7 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
     jax_events.reset_scopes()
 
     def snapshot() -> dict:
-        totals: dict = {}
-        for name in ("jax_traces_total", "jax_compiles_total"):
-            for sample in reg.counter(name).samples():
-                entry = sample["labels"].get("entry_point", "(unscoped)")
-                totals[entry] = totals.get(entry, 0) + int(sample["value"])
-        return totals
+        return _compile_snapshot(reg)
 
     try:
         engine, state, thetas = build_bench_engine(n_agents, kkt_method,
@@ -218,4 +233,137 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
         if not violations:
             print(f"retrace-budget: OK — zero excess compiles across "
                   f"{rounds} rounds ({n_agents} agents)")
+    return report
+
+
+def run_serving_gate(budgets: "dict | None" = None,
+                     verbose: bool = True) -> dict:
+    """``[serving]`` budget gate: the serving plane's churn contract.
+
+    Scripted sequence on the tracker workload:
+
+    1. **warmup** — first tenant joins (cold engine build + warmed
+       step), serves, leaves to an EMPTY bucket (retiring it) and
+       rejoins — so every program the churn can run (fused step, lane
+       splices, state init, bucket re-creation) has traced once;
+    2. **measured churn** — join → serve → join → serve → leave →
+       serve → leave-all (bucket retires) → REJOIN → serve → flush,
+       with the per-entry-point (traces + compiles) delta held to the
+       ``[serving.budgets]`` allowance (default 0: membership is data,
+       never structure);
+    3. **cache assertion** — the rejoin after retirement must come out
+       of the compile cache (``engine_cached`` on the receipt AND a
+       cache-dict hit), or the gate fails regardless of the compile
+       counters.
+    """
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    cfg = (budgets or load_budgets()).get("serving", {})
+    serve_rounds = int(cfg.get("serve_rounds", 1))
+    capacity = int(cfg.get("capacity", 4))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+
+    failures: list = []
+    try:
+        import jax.numpy as jnp
+
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+        from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+
+        ocp = tracker_ocp()
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=capacity,
+            pipelined=True, donate=True)
+
+        def spec(tid, a):
+            return TenantSpec(
+                tenant_id=tid, ocp=ocp,
+                theta=ocp.default_params(p=jnp.array([a])),
+                couplings={"shared_u": "u"},
+                solver_options=SolverOptions(max_iter=30))
+
+        def serve(*tenants):
+            for t in tenants:
+                plane.submit(t)
+            for _ in range(serve_rounds):
+                plane.serve_round()
+            plane.flush()
+
+        # -- warmup: cover every program shape, including retirement --
+        plane.join(spec("w0", 1.0))
+        serve("w0")
+        plane.leave("w0")
+        rec = plane.join(spec("w0", 1.0))
+        if not rec.engine_cached:
+            failures.append("warmup rejoin missed the compile cache")
+        serve("w0")
+        plane.leave("w0")
+
+        before = _compile_snapshot(reg)
+        hits_before = plane.cache.hits
+
+        # -- measured churn: join -> serve -> leave -> rejoin ----------
+        plane.join(spec("t0", 1.0))
+        serve("t0")
+        plane.join(spec("t1", 2.0))
+        serve("t0", "t1")
+        plane.join(spec("t2", 3.0))
+        serve("t0", "t1", "t2")
+        plane.leave("t1")
+        serve("t0", "t2")
+        plane.leave("t0")
+        plane.leave("t2")                 # bucket retires
+        rejoin = plane.join(spec("t1", 2.0))
+        serve("t1")
+        after = _compile_snapshot(reg)
+
+        if not rejoin.engine_cached:
+            failures.append(
+                "rejoin after bucket retirement was NOT a compile-cache "
+                "hit — the engine was rebuilt")
+        if plane.cache.hits <= hits_before:
+            failures.append("cache hit counter did not advance across "
+                            "the churn sequence")
+    finally:
+        telemetry.configure(enabled=was_enabled)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({"entry_point": entry, "observed": delta,
+                               "budget": budget})
+    report = {
+        "serve_rounds": serve_rounds,
+        "capacity": capacity,
+        "deltas": dict(sorted(deltas.items())),
+        "violations": violations,
+        "failures": failures,
+        "cache": {"hits": plane.cache.hits,
+                  "misses": plane.cache.misses},
+    }
+    if verbose:
+        for v in violations:
+            print(f"serving-budget: {v['entry_point']!r} compiled/traced "
+                  f"{v['observed']}x across the churn sequence "
+                  f"(budget {v['budget']}) — membership changes are "
+                  f"retracing")
+        for f in failures:
+            print(f"serving-budget: {f}")
+        if not violations and not failures:
+            print("serving-budget: OK — zero excess compiles across "
+                  "join/serve/leave/rejoin churn; rejoin was a "
+                  "compile-cache hit")
     return report

@@ -76,6 +76,28 @@ def stack_params(thetas: Sequence[OCPParams]) -> OCPParams:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *thetas)
 
 
+_donation_warning_suppressed = False
+
+
+def _suppress_unusable_donation_warning() -> None:
+    """On backends without buffer donation (CPU) jax warns once per
+    executable that the donated buffers were unused — the donation
+    contract is still honored by the caller, so the warning is pure
+    noise there, and ONLY there: on accelerator backends the same
+    warning flags a real donation mismatch (buffers silently not
+    reused) and must stay live, so this is a no-op off-CPU. Installed
+    once per process (repeated ``filterwarnings`` calls would grow the
+    global filter list by one duplicate entry per engine build)."""
+    global _donation_warning_suppressed
+    if _donation_warning_suppressed or jax.default_backend() != "cpu":
+        return
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
+    _donation_warning_suppressed = True
+
+
 @dataclasses.dataclass(frozen=True)
 class AgentGroup:
     """A set of structure-identical agents (one OCP shape, batched params).
@@ -196,10 +218,15 @@ class FusedADMM:
     def __init__(self, groups: Sequence[AgentGroup],
                  options: FusedADMMOptions = FusedADMMOptions(),
                  active: "Sequence[jnp.ndarray] | None" = None,
-                 record_locals: bool = False):
+                 record_locals: bool = False,
+                 donate_state: bool = False):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
-        run the dense math but never influence consensus results.
+        run the dense math but never influence consensus results. The
+        masks are TRACED inputs of the compiled step (not baked-in
+        constants), so membership changes — tenants joining or leaving
+        padded slots in the serving plane — are data, never a retrace;
+        pass a per-call override to :meth:`step`.
         ``record_locals``: carry per-iteration local coupling
         trajectories through the loop for ``IterationStats``
         (analysis/animation data). Off by default: the history buffers
@@ -207,7 +234,16 @@ class FusedADMM:
         while_loop carry, growing memory traffic and compile time even
         when unused. :class:`~agentlib_mpc_tpu.parallel.config_bridge.FusedFleet`
         opts in when built with ``record=True`` (its default) because its
-        results/animation API consumes them."""
+        results/animation API consumes them.
+        ``donate_state``: donate the :class:`FusedState` carry's buffers
+        to the step (``jax.jit`` ``donate_argnums``). The carry is dead
+        after each step in the serving loop — donation lets XLA reuse
+        its memory for the new state instead of allocating a second full
+        copy. Off by default because a donated input is CONSUMED: a
+        caller that re-reads or re-passes the same ``FusedState`` object
+        after the step (tests, exploratory sessions) would hit a
+        deleted-buffer error. The serving dispatcher, which threads the
+        state linearly by construction, turns it on."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -250,7 +286,12 @@ class FusedADMM:
                 f"alias(es) {sorted(both)} are used as both consensus "
                 f"coupling and exchange — give the two couplings "
                 f"distinct aliases")
-        self._step = jax.jit(self._build_step())
+        self.donate_state = bool(donate_state)
+        if self.donate_state:
+            _suppress_unusable_donation_warning()
+        self._step = jax.jit(
+            self._build_step(),
+            donate_argnums=(0,) if self.donate_state else ())
 
     @staticmethod
     def _with_stage_partition(g: AgentGroup) -> AgentGroup:
@@ -579,7 +620,7 @@ class FusedADMM:
             return jnp.all(jnp.isfinite(arr), axis=tuple(range(1, arr.ndim)))
 
         def apply_quarantine(gi, state, theta_batch, streak,
-                             w_b, y_b, z_b, u_b):
+                             w_b, y_b, z_b, u_b, act_gi):
             """Quarantine diverged lanes of one group, inside the jit: a
             non-finite local solution is replaced by the agent's previous
             iterate via ``jnp.where`` (no host round-trip, no retrace), so
@@ -617,10 +658,11 @@ class FusedADMM:
             y_b = jnp.where(jnp.isfinite(y_b), y_b, 0.0)
             z_b = jnp.where(jnp.isfinite(z_b), z_b, 0.1)
             u_b = jnp.where(jnp.isfinite(u_b), u_b, 0.0)
-            n_q = jnp.sum(bad & self.active[gi], dtype=jnp.int32)
+            n_q = jnp.sum(bad & act_gi, dtype=jnp.int32)
             return w_b, y_b, z_b, u_b, streak, n_q
 
-        def step_fn(state: FusedState, theta_batches: tuple):
+        def step_fn(state: FusedState, theta_batches: tuple,
+                    active: tuple):
             max_it = opts.max_iterations
 
             def make_iteration(cold: "bool | None"):
@@ -665,7 +707,7 @@ class FusedADMM:
                         w_b, y_b, z_b, u_b, streak_gi, n_q = \
                             apply_quarantine(gi, state, theta_batches[gi],
                                              q_streak[gi], w_b, y_b, z_b,
-                                             u_b)
+                                             u_b, active[gi])
                         q_streak_new.append(streak_gi)
                         n_quarantined = n_quarantined + n_q
                     else:
@@ -675,7 +717,7 @@ class FusedADMM:
                     z_new.append(z_b)
                     u_groups.append(u_b)
                     # padded lanes may fail to converge without penalty
-                    ok_all = ok_all & jnp.all(ok_b | ~self.active[gi])
+                    ok_all = ok_all & jnp.all(ok_b | ~active[gi])
 
                 residuals = []
                 alias_residuals = {}
@@ -690,7 +732,7 @@ class FusedADMM:
                         [state.lam[alias][slot] for _, _, slot in parts],
                         axis=0)
                     act = jnp.concatenate(
-                        [self.active[gi] for gi, _, _ in parts])
+                        [active[gi] for gi, _, _ in parts])
                     if record:
                         cl_hist[alias] = \
                             cl_hist[alias].at[it].set(locals_)
@@ -722,7 +764,7 @@ class FusedADMM:
                         [state.ex_diff[alias][slot] for _, _, slot in parts],
                         axis=0)
                     act = jnp.concatenate(
-                        [self.active[gi] for gi, _, _ in parts])
+                        [active[gi] for gi, _, _ in parts])
                     if record:
                         ex_hist[alias] = \
                             ex_hist[alias].at[it].set(locals_)
@@ -833,10 +875,16 @@ class FusedADMM:
 
     # -- public API -----------------------------------------------------------
 
-    def step(self, state: FusedState, theta_batches: Sequence[OCPParams]):
+    def step(self, state: FusedState, theta_batches: Sequence[OCPParams],
+             active: "Sequence[jnp.ndarray] | None" = None):
         """Run one full ADMM round (≤ max_iterations, early exit on the
         relative-tolerance criterion). Returns (new_state, per-group
         trajectory pytrees, IterationStats).
+
+        ``active`` overrides the constructor masks for THIS round — the
+        masks are traced inputs of the compiled step, so flipping lanes
+        between rounds (tenant join/leave in the serving plane) reuses
+        the warm executable: same shapes, same avals, zero retraces.
 
         With telemetry enabled, the round runs under an
         ``admm.fused_step`` span (compile latency of the fused program
@@ -844,11 +892,24 @@ class FusedADMM:
         mirrored into the registry (per-iteration residual gauges, round
         counters) — a device→host read of the small stats arrays the
         caller consumes anyway."""
+        if active is None:
+            masks = self.active
+        else:
+            masks = tuple(jnp.asarray(a, bool) for a in active)
+            if len(masks) != len(self.groups):
+                raise ValueError(
+                    f"active has {len(masks)} masks for "
+                    f"{len(self.groups)} groups")
+            for g, a in zip(self.groups, masks):
+                if a.shape != (g.n_agents,):
+                    raise ValueError(
+                        f"active mask of group {g.name!r} has shape "
+                        f"{a.shape}, expected ({g.n_agents},)")
         if not telemetry.enabled():
-            return self._step(state, tuple(theta_batches))
+            return self._step(state, tuple(theta_batches), masks)
         with telemetry.span("admm.fused_step",
                             groups=",".join(g.name for g in self.groups)):
-            out = self._step(state, tuple(theta_batches))
+            out = self._step(state, tuple(theta_batches), masks)
         self._record_round(out[2])
         return out
 

@@ -121,6 +121,18 @@ def fleet_mesh(axis: str = "agents", devices=None) -> Mesh:
     return Mesh(devices, (axis,))
 
 
+def serving_slot_multiple() -> int:
+    """Slot-count granularity for the serving plane's padded groups.
+
+    Capacities that are a multiple of the global device count let
+    :meth:`FusedADMM.shard_args` shard the agent axis instead of
+    replicating it (the :func:`host_local_batch` divisibility rule), so
+    the serving plane rounds every bucket's capacity up to this. On a
+    single-device host this is 1 and the rounding is a no-op.
+    """
+    return max(1, len(jax.devices()))
+
+
 def host_local_batch(n_agents_global: int) -> tuple[int, int]:
     """(start, count) of this process's slice of a global agent batch.
 

@@ -298,3 +298,44 @@ def install_chaos(target, config: "ChaosConfig | dict",
                     controller._restores.append(
                         lambda m=module, o=orig: setattr(m, "optimize", o))
     return controller
+
+
+# -- serving-plane tenant churn (the --serve benchmark's load model) ----------
+
+def churn_schedule(seed: int, n_tenants: int, rounds: int,
+                   p_leave: float = 0.15, p_join: float = 0.3,
+                   min_active: int = 1) -> list:
+    """Deterministic tenant join/leave events for the serving bench.
+
+    Returns ``rounds`` lists of ``("join", tid)`` / ``("leave", tid)``
+    events over a population of ``n_tenants`` tenant ids
+    (``"t000"``...). Same seed → same schedule, the chaos harness's
+    reproducibility contract. Round 0 joins an initial cohort; later
+    rounds flip membership with per-tenant probabilities ``p_join`` (for
+    departed tenants — every such join after the first is a REJOIN, the
+    compile-cache-hit path the acceptance criteria measure) and
+    ``p_leave`` (for active ones, floored at ``min_active`` so the plane
+    always has traffic).
+    """
+    rng = _rng(seed, "serve-churn")
+    ids = [f"t{i:03d}" for i in range(n_tenants)]
+    active: set = set()
+    schedule = []
+    for r in range(rounds):
+        events = []
+        if r == 0:
+            cohort = ids[:max(min_active, (n_tenants + 1) // 2)]
+            events += [("join", t) for t in cohort]
+            active.update(cohort)
+        else:
+            for t in ids:
+                if t in active:
+                    if (len(active) > min_active
+                            and rng.random() < p_leave):
+                        events.append(("leave", t))
+                        active.discard(t)
+                elif rng.random() < p_join:
+                    events.append(("join", t))
+                    active.add(t)
+        schedule.append(events)
+    return schedule

@@ -213,7 +213,12 @@ class MiniBroker:
                 elif ptype == DISCONNECT:
                     break
                 # anything else in the subset is ignored
-        except (ConnectionError, ValueError, OSError) as exc:
+        except (ConnectionError, ValueError, OSError,
+                struct.error, IndexError) as exc:
+            # struct.error/IndexError: malformed frame BODIES (truncated
+            # length fields, short CONNECT) — a hostile or broken client
+            # must cost exactly its own session, never an unhandled
+            # thread death (the malformed-frame fuzz tests pin this)
             logger.debug("mini-mqtt session %s ended: %s", sess.addr, exc)
         finally:
             with self._lock:

@@ -1,0 +1,51 @@
+"""MPC-as-a-service: the serving dispatch plane (ROADMAP item 3).
+
+Everything below the waterline existed — the fused data plane
+(``parallel/fused_admm.py``), telemetry, the guarded-actuation ladder,
+retrace budgets — but fleet membership was frozen at engine build time:
+every structural change recompiled the world and every tenant was wired
+in by hand. This package serves solve traffic for a *dynamic* tenant
+population over the same fused data plane:
+
+* :mod:`.fingerprint` — :class:`TenantSpec` + the structural-fingerprint
+  bucket key (jaxpr digests + certificates + shape bucket + coupling
+  layout + solver options): problem structure as a *provable* compile-
+  cache key, the PR 5 insight cashed in.
+* :mod:`.cache` — :class:`CompileCache`: fingerprint-keyed reuse of
+  built (and warmed) fused engines, with hit/miss counters and measured
+  join latency.
+* :mod:`.slots` — :class:`SlotPlane`: pre-padded agent slots per bucket;
+  tenants admit/evict by flipping traced participation masks, so
+  join/leave never changes an array shape (zero warm retraces, enforced
+  by the ``[serving]`` retrace budget).
+* :mod:`.admission` — :class:`AdmissionQueue`: bounded queue with
+  per-tenant deadlines; overload sheds to the PR 2 degradation ladder
+  instead of growing latency without bound.
+* :mod:`.dispatch` — the donated, depth-1-pipelined dispatch loop:
+  round k+1 is enqueued before round k's ``u0`` rows transfer back.
+* :mod:`.plane` — :class:`ServingPlane`, the front door tying the
+  pieces together (``join`` / ``leave`` / ``submit`` / ``serve_round``).
+
+Benchmark: ``python bench.py --serve SEED [n]`` measures sustained
+solves/sec and p50/p99 round latency under seeded tenant churn. Docs:
+``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+from agentlib_mpc_tpu.serving.admission import (  # noqa: F401
+    AdmissionQueue,
+    SolveRequest,
+)
+from agentlib_mpc_tpu.serving.cache import CompileCache  # noqa: F401
+from agentlib_mpc_tpu.serving.fingerprint import (  # noqa: F401
+    TenantSpec,
+    bucket_key,
+    tenant_fingerprint,
+)
+from agentlib_mpc_tpu.serving.plane import (  # noqa: F401
+    JoinReceipt,
+    RoundResult,
+    ServingPlane,
+)
+from agentlib_mpc_tpu.serving.slots import SlotPlane  # noqa: F401

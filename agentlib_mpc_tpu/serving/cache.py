@@ -1,0 +1,82 @@
+"""Fingerprint-keyed compile cache for fused serving engines.
+
+The most expensive event in the serving plane is building a fused
+engine: jaxpr certification, solver tracing and XLA compilation of the
+whole ADMM round (seconds to tens of seconds — the "compile latency /
+persistent cache" table in PERF.md). The cache makes that a
+once-per-structure cost: a tenant whose problem is structurally
+identical to one already compiled — including a tenant REJOINING after
+an eviction — reuses the warm executable, and the join is a dictionary
+lookup plus a slot splice.
+
+Counters: ``serving_compile_cache_hits_total`` /
+``serving_compile_cache_misses_total`` (labelled by bucket digest), and
+a ``serving_join_build_seconds`` histogram labelled ``cached="yes"/"no"``
+so the cached-vs-cold join-latency A/B is always measured in
+production, not just in the bench.
+"""
+
+from __future__ import annotations
+
+import time
+
+from agentlib_mpc_tpu import telemetry
+
+
+class CompileCache:
+    """Maps hashable engine keys to built (and warmed) engine objects.
+
+    The cache never evicts: an engine is a compiled executable plus
+    static metadata, exactly the artifact worth keeping for the life of
+    the process (the persistent XLA cache plays the cross-process
+    role). ``get_or_build(key, builder)`` returns
+    ``(engine, hit, latency_s)``.
+    """
+
+    def __init__(self):
+        self._entries: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def note_hit(self, label: str = "") -> None:
+        """Count an executable reuse that never had to consult the
+        entry dict — a tenant joining a LIVE bucket whose engine is
+        already serving. Same counter family as lookup hits: the metric
+        is "compiled engines reused", however shallow the path."""
+        self.hits += 1
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_compile_cache_hits_total",
+                "serving engine cache lookups that reused a compiled "
+                "engine").inc(bucket=label or "?")
+
+    def get_or_build(self, key, builder, label: str = ""):
+        t0 = time.perf_counter()
+        engine = self._entries.get(key)
+        hit = engine is not None
+        if not hit:
+            engine = builder()
+            self._entries[key] = engine
+            self.misses += 1
+        else:
+            self.hits += 1
+        latency = time.perf_counter() - t0
+        if telemetry.enabled():
+            name = ("serving_compile_cache_hits_total" if hit
+                    else "serving_compile_cache_misses_total")
+            telemetry.counter(
+                name, "serving engine cache lookups that "
+                + ("reused a compiled engine" if hit
+                   else "had to build (certify + trace + compile)")
+                ).inc(bucket=label or "?")
+            telemetry.histogram(
+                "serving_join_build_seconds",
+                "engine acquisition latency at tenant join, by cache "
+                "outcome").observe(latency, cached="yes" if hit else "no")
+        return engine, hit, latency

@@ -1,0 +1,120 @@
+"""Tenant specs and the structural-fingerprint bucket key.
+
+A tenant is one MPC problem instance: a transcribed OCP plus its
+parameter values, coupling layout and solver configuration. Two tenants
+belong to the same *bucket* — and may share one compiled fused engine —
+exactly when everything that shapes the executable is equal:
+
+* the :class:`~agentlib_mpc_tpu.lint.jaxpr.StructuralFingerprint` of the
+  OCP's NLP (jaxpr digests: same computation graph up to parameter
+  values; certificates: same proved routing facts),
+* the horizon / shape bucket (``bucket_agents`` groups by shape today;
+  the fingerprint subsumes its ``id(ocp)`` key with a *structural* one,
+  so a separately re-transcribed but identical OCP still buckets),
+* the coupling/exchange alias layout,
+* the (cold and warm) solver options and QP-fast-path mode.
+
+Parameter VALUES (theta) never enter the key — they are the vmapped
+axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+
+#: per-OCP-object memo of the (expensive: certifier passes + traces)
+#: structural fingerprint — keyed by object identity like
+#: ``bucket_agents``, holding ``id(ocp) -> (ocp, fingerprint)`` (the
+#: ocp reference keeps the id stable for the cache's lifetime). The
+#: VALUE is structural, so two distinct OCP objects with identical
+#: structure produce EQUAL fingerprints — but each distinct OBJECT pays
+#: the certifier once; transcribe once per model class (the
+#: ``bucket_agents`` contract) to keep this cache one entry per
+#: structure instead of one per tenant
+_FP_MEMO: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's problem definition, as handed to
+    :meth:`~agentlib_mpc_tpu.serving.plane.ServingPlane.join`.
+
+    ``theta`` is the tenant's parameter pytree (one agent row, NOT
+    batched); ``couplings``/``exchanges`` map global aliases to this
+    model's control names exactly like
+    :class:`~agentlib_mpc_tpu.parallel.fused_admm.AgentGroup`.
+    ``deadline_s`` is the tenant's per-request service deadline for the
+    admission queue (None: the plane default applies).
+    """
+
+    tenant_id: str
+    ocp: object                  # TranscribedOCP
+    theta: object                # OCPParams
+    couplings: dict = dataclasses.field(default_factory=dict)
+    exchanges: dict = dataclasses.field(default_factory=dict)
+    solver_options: SolverOptions = SolverOptions()
+    warm_solver_options: "SolverOptions | None" = None
+    qp_fast_path: str = "auto"
+    deadline_s: "float | None" = None
+
+
+class BucketKey(NamedTuple):
+    """Hashable engine-bucket identity (everything but capacity — the
+    :class:`~agentlib_mpc_tpu.serving.cache.CompileCache` key adds the
+    padded slot count and the engine options on top)."""
+
+    structure_digest: str
+    horizon: int
+    couplings: tuple         # sorted (alias, control) pairs
+    exchanges: tuple
+    solver_options: SolverOptions
+    warm_solver_options: "SolverOptions | None"
+    qp_fast_path: str
+
+    @property
+    def digest(self) -> str:
+        import hashlib
+
+        return hashlib.sha256(repr(self).encode()).hexdigest()[:12]
+
+
+def tenant_fingerprint(ocp):
+    """The memoized structural fingerprint of one transcribed OCP.
+
+    First call per OCP *object* pays the certifier (seconds); every
+    later call on the same object is a lookup. A DIFFERENT object of
+    identical structure pays the certifier once too (equality of
+    structure cannot be known without computing its fingerprint) and
+    then fingerprints EQUAL — so it still lands in the same serving
+    bucket; transcribe once per model class to avoid the repeated
+    certification cost. Returns a
+    :class:`~agentlib_mpc_tpu.lint.jaxpr.StructuralFingerprint`.
+    """
+    entry = _FP_MEMO.get(id(ocp))
+    if entry is None:
+        from agentlib_mpc_tpu.lint.jaxpr import structural_fingerprint
+
+        fp = structural_fingerprint(
+            ocp.nlp, ocp.default_params(), ocp.n_w,
+            getattr(ocp, "stage_partition", None))
+        # hold the ocp alongside its fingerprint: the id() key is only
+        # collision-free while the object lives
+        entry = _FP_MEMO[id(ocp)] = (ocp, fp)
+    return entry[1]
+
+
+def bucket_key(spec: TenantSpec) -> BucketKey:
+    """Bucket identity of one tenant spec (see module docstring)."""
+    fp = tenant_fingerprint(spec.ocp)
+    return BucketKey(
+        structure_digest=fp.digest,
+        horizon=int(spec.ocp.N),
+        couplings=tuple(sorted(spec.couplings.items())),
+        exchanges=tuple(sorted(spec.exchanges.items())),
+        solver_options=spec.solver_options,
+        warm_solver_options=spec.warm_solver_options,
+        qp_fast_path=spec.qp_fast_path,
+    )

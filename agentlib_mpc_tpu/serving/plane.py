@@ -1,0 +1,388 @@
+"""The serving front door: join / leave / submit / serve_round.
+
+One :class:`ServingPlane` hosts many structure buckets, each a
+:class:`~agentlib_mpc_tpu.serving.slots.SlotPlane` over a fused engine
+acquired through the fingerprint-keyed
+:class:`~agentlib_mpc_tpu.serving.cache.CompileCache`. The request path:
+
+1. ``join(spec)`` — fingerprint the tenant's problem, find (cache hit)
+   or build (miss: certify + trace + compile + warm) the bucket engine,
+   splice the tenant into a padded slot. A structurally-identical
+   rejoin is a measured cache hit: join latency is the splice, not the
+   compile.
+2. ``submit(tenant_id, theta)`` — enqueue one solve request (bounded
+   queue, per-tenant deadline, coalescing). A shed request walks the
+   tenant's PR 2 degradation ladder immediately and returns the
+   resulting :class:`~agentlib_mpc_tpu.resilience.guard.GuardDecision`.
+3. ``serve_round()`` — drain the queue, splice fresh parameters, run
+   one fused round per touched bucket through the (donated, pipelined)
+   dispatcher, assess every delivered result against the tenant's
+   guard, return per-tenant :class:`RoundResult`\\ s.
+
+Capacity: a full bucket grows to the next
+:func:`~agentlib_mpc_tpu.parallel.multihost.serving_slot_multiple`
+multiple — a new (cached-by-capacity) engine, with sitting tenants
+migrated; their warm starts reset (documented cost of growth, amortized
+by sizing ``initial_capacity``).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+)
+from agentlib_mpc_tpu.resilience.guard import (
+    ActuationGuard,
+    DegradationPolicy,
+)
+from agentlib_mpc_tpu.serving.admission import AdmissionQueue, SolveRequest
+from agentlib_mpc_tpu.serving.cache import CompileCache
+from agentlib_mpc_tpu.serving.dispatch import PipelinedDispatcher
+from agentlib_mpc_tpu.serving.fingerprint import TenantSpec, bucket_key
+from agentlib_mpc_tpu.serving.slots import SlotPlane, tree_repeat, tree_row
+
+logger = logging.getLogger(__name__)
+
+
+class JoinReceipt(NamedTuple):
+    tenant_id: str
+    bucket: str              # bucket digest (artifact/log key)
+    slot: int
+    capacity: int
+    #: the engine came out of the compile cache (a structurally
+    #: identical problem — e.g. this tenant rejoining — was served
+    #: before); False = certify + trace + compile were paid
+    engine_cached: bool
+    #: wall seconds of the whole join (engine acquisition + splice)
+    latency_s: float
+
+
+class RoundResult(NamedTuple):
+    """What the plane tells a tenant's actuator after a round."""
+
+    #: actuate | replay | hold | fallback (guard ladder vocabulary)
+    action: str
+    #: controls to apply (the solve's u0 for ``actuate``, the guard's
+    #: degraded controls otherwise; None = nothing to actuate)
+    controls: "dict | None"
+    healthy: bool
+    reasons: tuple = ()
+    #: raw per-tenant solve stats (None for shed requests)
+    stats: "dict | None" = None
+
+
+class ServingPlane:
+    def __init__(self,
+                 admm_options: FusedADMMOptions = FusedADMMOptions(),
+                 slot_multiple: "int | None" = None,
+                 initial_capacity: "int | None" = None,
+                 pipelined: "bool | str" = "auto",
+                 donate: "bool | str" = "auto",
+                 queue_limit: int = 1024,
+                 default_deadline_s: "float | None" = None,
+                 guard_policy: DegradationPolicy = DegradationPolicy(),
+                 warm_on_build: bool = True):
+        if slot_multiple is None:
+            from agentlib_mpc_tpu.parallel.multihost import (
+                serving_slot_multiple,
+            )
+
+            slot_multiple = serving_slot_multiple()
+        # "auto" resolves by backend (the fused_ls_jacobian pattern): the
+        # depth-1 pipeline + donated carry pay off where the device
+        # executes while the host decodes (accelerators); on CPU the
+        # measured A/B is parity-to-negative — two rounds in flight
+        # double the live state working set while donation is a no-op
+        # (PERF.md round 9) — so the synchronous loop is the default
+        import jax
+
+        on_accel = jax.default_backend() != "cpu"
+        if pipelined == "auto":
+            pipelined = on_accel
+        if donate == "auto":
+            donate = on_accel
+        self.admm_options = admm_options
+        self.slot_multiple = max(1, int(slot_multiple))
+        # every capacity is a slot-multiple so the agent axis can shard
+        # (the serving_slot_multiple contract) — a user-supplied
+        # initial_capacity is rounded UP, never taken verbatim
+        want = (self.slot_multiple if initial_capacity is None
+                else int(initial_capacity))
+        self.initial_capacity = self.slot_multiple * math.ceil(
+            max(want, 1) / self.slot_multiple)
+        self.donate = bool(donate)
+        self.warm_on_build = bool(warm_on_build)
+        self.guard_policy = guard_policy
+        self.cache = CompileCache()
+        self.dispatcher = PipelinedDispatcher(pipelined)
+        self.queue = AdmissionQueue(queue_limit, default_deadline_s)
+        self._buckets: dict = {}          # BucketKey -> SlotPlane
+        self._tenant_bucket: dict = {}    # tenant_id -> BucketKey
+        self._specs: dict = {}            # tenant_id -> TenantSpec
+        self._guards: dict = {}           # tenant_id -> ActuationGuard
+        #: results decoded outside serve_round (growth/leave flushes),
+        #: merged into the next serve_round return
+        self._carryover: dict = {}
+        self.rounds = 0
+
+    # -- membership -----------------------------------------------------------
+
+    def join(self, spec: TenantSpec) -> JoinReceipt:
+        if spec.tenant_id in self._tenant_bucket:
+            raise ValueError(f"tenant {spec.tenant_id!r} already joined")
+        t0 = time.perf_counter()
+        key = bucket_key(spec)
+        bucket = self._buckets.get(key)
+        cached = True
+        if bucket is None:
+            bucket, cached = self._acquire_bucket(key, spec,
+                                                  n_needed=1)
+        elif bucket.free_slots == 0:
+            bucket, cached = self._acquire_bucket(
+                key, spec, n_needed=bucket.n_active + 1,
+                migrate_from=bucket)
+        else:
+            # joining a LIVE bucket: the compiled engine is reused
+            # without even a cache lookup — still a hit in the metric
+            self.cache.note_hit(label=key.digest)
+        slot = bucket.admit(spec.tenant_id, spec.theta)
+        self._tenant_bucket[spec.tenant_id] = key
+        self._specs[spec.tenant_id] = spec
+        self._guards[spec.tenant_id] = ActuationGuard(
+            self.guard_policy, logger_=logger,
+            tenant=spec.tenant_id, bucket=key.digest)
+        if telemetry.enabled():
+            telemetry.serving_metrics()["active"].set(
+                float(bucket.n_active), bucket=key.digest)
+        latency = time.perf_counter() - t0
+        logger.info(
+            "tenant %s joined bucket %s slot %d (%s, %.1f ms)",
+            spec.tenant_id, key.digest, slot,
+            "cached engine" if cached else "cold build", 1e3 * latency)
+        return JoinReceipt(spec.tenant_id, key.digest, slot,
+                           bucket.capacity, cached, latency)
+
+    def leave(self, tenant_id: str) -> None:
+        key = self._tenant_bucket.pop(tenant_id)
+        bucket = self._buckets[key]
+        bucket.evict(tenant_id)
+        self._specs.pop(tenant_id, None)
+        self._guards.pop(tenant_id, None)
+        if telemetry.enabled():
+            telemetry.serving_metrics()["active"].set(
+                float(bucket.n_active), bucket=key.digest)
+        if bucket.n_active == 0:
+            # drain the pipeline, then retire the slot plane — the
+            # ENGINE stays in the compile cache, so a rejoin is a hit
+            self._stash_flush(key)
+            del self._buckets[key]
+
+    def _acquire_bucket(self, key, spec: TenantSpec, n_needed: int,
+                        migrate_from: "SlotPlane | None" = None):
+        """Find-or-build an engine with capacity for ``n_needed`` active
+        tenants (rounded up to the slot multiple); optionally migrate an
+        existing full bucket's tenants into it."""
+        capacity = max(self.initial_capacity,
+                       self.slot_multiple
+                       * math.ceil(n_needed / self.slot_multiple))
+        engine_key = (key, capacity, self._options_key(), self.donate)
+
+        def build():
+            group = AgentGroup(
+                name=f"bucket-{key.digest}",
+                ocp=spec.ocp, n_agents=capacity,
+                couplings=dict(key.couplings),
+                exchanges=dict(key.exchanges),
+                solver_options=key.solver_options,
+                warm_solver_options=key.warm_solver_options,
+                qp_fast_path=key.qp_fast_path)
+            engine = FusedADMM(
+                [group], self.admm_options,
+                active=[jnp.zeros((capacity,), bool)],
+                donate_state=self.donate)
+            if self.warm_on_build:
+                # pay trace+compile NOW so the cold/cached join-latency
+                # split is honest and the first served round is warm.
+                # Throwaway state: with donation its buffers are
+                # consumed by this very step — nothing else holds them.
+                theta_b = tree_repeat(spec.theta, capacity)
+                warm_state = engine.init_state([theta_b])
+                engine.step(warm_state, [theta_b],
+                            active=[jnp.zeros((capacity,), bool)])
+            return engine
+
+        engine, hit, _latency = self.cache.get_or_build(
+            engine_key, build, label=key.digest)
+        bucket = SlotPlane(engine, spec.ocp, spec.theta)
+        if migrate_from is not None:
+            self._stash_flush(key)       # deliver the old plane's round
+            for tenant_id in migrate_from.tenants:
+                slot = migrate_from.slot_of(tenant_id)
+                row = tree_row(migrate_from.theta_batch, slot)
+                bucket.admit(tenant_id, row)
+            logger.info(
+                "bucket %s grew %d -> %d slots (%d tenants migrated, "
+                "warm starts reset)", key.digest, migrate_from.capacity,
+                capacity, len(migrate_from.tenants))
+        self._buckets[key] = bucket
+        return bucket, hit
+
+    def _options_key(self):
+        """Hashable identity of the engine-level options (rho may be a
+        dict)."""
+        opts = self.admm_options
+        rho = opts.rho
+        rho_key = tuple(sorted(rho.items())) if isinstance(rho, dict) \
+            else float(rho)
+        return opts._replace(rho=rho_key)
+
+    # -- request path ---------------------------------------------------------
+
+    def submit(self, tenant_id: str, theta=None,
+               deadline_s: "float | None" = None,
+               now: "float | None" = None):
+        """Enqueue one solve request. Returns None when queued; when the
+        queue sheds it (overload), the tenant's guard ladder is walked
+        immediately and the resulting degraded
+        :class:`~agentlib_mpc_tpu.resilience.guard.GuardDecision` is
+        returned (replay/hold controls, or fallback hand-over)."""
+        if tenant_id not in self._tenant_bucket:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        if deadline_s is None:
+            deadline_s = self._specs[tenant_id].deadline_s
+        if telemetry.enabled():
+            telemetry.serving_metrics()["requests"].inc()
+        ok = self.queue.submit(SolveRequest(
+            tenant_id=tenant_id, theta=theta,
+            submitted_at=time.monotonic() if now is None else now,
+            deadline_s=deadline_s))
+        if ok:
+            return None
+        return self._shed(tenant_id, "shed_overload")
+
+    def _shed(self, tenant_id: str, reason: str):
+        """Walk a shed request through the tenant's degradation ladder
+        (the PR 2 wiring: overload and solver failure degrade through
+        one path)."""
+        guard = self._guards.get(tenant_id)
+        if guard is None:
+            return None
+        return guard.assess({"stats": {"success": True}},
+                            precheck=(False, (reason,)))
+
+    def serve_round(self, now: "float | None" = None) -> dict:
+        """Drain the queue and run one fused round per touched bucket.
+        Returns ``{tenant_id: RoundResult}`` — in pipelined mode these
+        are the results of each bucket's PREVIOUS round (plus any
+        deadline-shed verdicts of this one); call :meth:`flush` to drain
+        the pipeline."""
+        t0 = time.perf_counter()
+        now = time.monotonic() if now is None else now
+        ready, expired = self.queue.drain(now)
+        results: dict = {}
+        for key, res in self._carryover.items():
+            results.update(self._assess_bucket(res))
+        self._carryover.clear()
+        for req in expired:
+            decision = self._shed(req.tenant_id, "shed_deadline")
+            if decision is not None:
+                results[req.tenant_id] = RoundResult(
+                    action=decision.action, controls=decision.controls,
+                    healthy=False, reasons=decision.reasons)
+        touched = []
+        for req in ready:
+            key = self._tenant_bucket.get(req.tenant_id)
+            if key is None:
+                continue                  # left after submitting
+            bucket = self._buckets[key]
+            if req.theta is not None:
+                bucket.update_theta(req.tenant_id, req.theta)
+            if key not in touched:
+                touched.append(key)
+        m = telemetry.serving_metrics() if telemetry.enabled() else None
+        for key in touched:
+            res = self.dispatcher.dispatch(key, self._buckets[key])
+            self.rounds += 1
+            if m is not None:
+                m["rounds"].inc(bucket=key.digest)
+            if res is not None:
+                results.update(self._assess_bucket(res))
+        if m is not None:
+            m["queue_depth"].set(float(len(self.queue)))
+            m["round_seconds"].observe(time.perf_counter() - t0)
+        return results
+
+    def flush(self) -> dict:
+        """Drain the dispatch pipeline: assess and return every
+        in-flight round's results (empty dict when none)."""
+        results: dict = {}
+        for res in self.dispatcher.flush().values():
+            results.update(self._assess_bucket(res))
+        for res in self._carryover.values():
+            results.update(self._assess_bucket(res))
+        self._carryover.clear()
+        return results
+
+    def _stash_flush(self, key) -> None:
+        flushed = self.dispatcher.flush(key)
+        if key in flushed:
+            self._carryover[key] = flushed[key]
+
+    def _assess_bucket(self, decoded: dict) -> dict:
+        """Run each delivered result through its tenant's guard and
+        shape the per-tenant verdicts."""
+        out = {}
+        m = telemetry.serving_metrics() if telemetry.enabled() else None
+        for tenant_id, result in decoded.items():
+            guard = self._guards.get(tenant_id)
+            if guard is None:
+                continue                  # tenant left while in flight
+            spec = self._specs.get(tenant_id)
+            bounds = None
+            if spec is not None:
+                bounds = getattr(spec.ocp, "control_bounds", None)
+            decision = guard.assess(result, bounds)
+            controls = result["u0"] if decision.action == "actuate" \
+                else decision.controls
+            out[tenant_id] = RoundResult(
+                action=decision.action, controls=controls,
+                healthy=decision.healthy, reasons=decision.reasons,
+                stats=result.get("stats"))
+            if m is not None:
+                m["solves"].inc()
+        return out
+
+    # -- introspection --------------------------------------------------------
+
+    @property
+    def tenants(self) -> tuple:
+        """Currently admitted tenant ids."""
+        return tuple(self._tenant_bucket)
+
+    def stats(self) -> dict:
+        return {
+            "tenants": len(self._tenant_bucket),
+            "buckets": {
+                key.digest: {"capacity": b.capacity,
+                             "active": b.n_active,
+                             "rounds": b.rounds_served}
+                for key, b in self._buckets.items()},
+            "cache": {"engines": len(self.cache),
+                      "hits": self.cache.hits,
+                      "misses": self.cache.misses},
+            "queue": {"pending": len(self.queue),
+                      "submitted": self.queue.submitted,
+                      "shed_overload": self.queue.shed_overload,
+                      "shed_deadline": self.queue.shed_deadline},
+            "rounds": self.rounds,
+        }

@@ -1,0 +1,233 @@
+"""Padded tenant slots over one fused engine.
+
+A :class:`SlotPlane` owns ONE single-group
+:class:`~agentlib_mpc_tpu.parallel.fused_admm.FusedADMM` engine built at
+a fixed, pre-padded capacity (``pad_group_to_devices`` rounding: a
+multiple of the device count so the agent axis shards instead of
+replicating). Tenants occupy slots; free slots are padding lanes — they
+solve the uniform dense math but are masked out of every consensus
+mean, multiplier update, residual norm and health flag (the
+``pad_group_to_devices`` contract, now DYNAMIC):
+
+* **join** — take a free slot, splice the tenant's parameters and a
+  fresh warm start into that lane (one jitted lane-splice with a TRACED
+  lane index — no retrace per slot), flip the slot's mask bit on;
+* **leave** — flip the bit off. The lane keeps solving its last
+  parameters as padding; nothing changes shape;
+* **serve** — one fused ADMM round over the whole batch with the
+  current mask as a traced input.
+
+Because capacity, shapes and dtypes never change across join/leave, the
+warm executable serves every membership state of the bucket — the
+``[serving]`` retrace budget pins this at zero warm retraces across a
+scripted join→serve→leave→rejoin churn sequence.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_repeat(tree, n: int):
+    """Stack one agent row into an (n, ...) batch — the padding
+    semantics of ``pad_group_to_devices``: every lane starts as a copy
+    of the seed tenant. ONE definition, shared by the slot plane's
+    theta batch and the plane's engine-warmup batch so the two can
+    never diverge."""
+    return jax.tree.map(
+        lambda leaf: jnp.repeat(jnp.asarray(leaf)[None], n, axis=0), tree)
+
+
+def tree_row(batch, i: int):
+    """Extract agent row ``i`` from a batched pytree (the inverse seam:
+    tenant migration during capacity growth)."""
+    return jax.tree.map(lambda leaf: leaf[i], batch)
+
+
+class RoundHandle(NamedTuple):
+    """An in-flight (possibly not yet materialized) served round."""
+
+    trajs: object            # per-group trajectory pytrees (device)
+    stats: object            # IterationStats (device)
+    #: (tenant_id, slot) snapshot at launch — results are decoded
+    #: against THIS membership, not the one at materialize time
+    served: tuple
+
+
+class SlotPlane:
+    """Slot bookkeeping + lane splicing for one bucket's fused engine.
+
+    ``engine`` must be a single-group :class:`FusedADMM` (the serving
+    plane builds one engine per structure bucket); ``theta0`` seeds the
+    padding lanes' parameters.
+    """
+
+    def __init__(self, engine, ocp, theta0, shift_between_rounds=True):
+        if len(engine.groups) != 1:
+            raise ValueError(
+                "SlotPlane serves single-group engines (one structure "
+                f"bucket per plane); got {len(engine.groups)} groups")
+        self.engine = engine
+        self.ocp = ocp
+        self.capacity = engine.groups[0].n_agents
+        self.shift_between_rounds = bool(shift_between_rounds)
+        #: slot -> tenant_id or None
+        self.slots: list = [None] * self.capacity
+        self._slot_of: dict = {}
+        self.mask = np.zeros((self.capacity,), dtype=bool)
+        # padding lanes repeat the seed tenant's parameters (the
+        # pad_group_to_devices recipe: uniform dense math, masked out)
+        self.theta_batch = tree_repeat(theta0, self.capacity)
+        self.rounds_served = 0
+
+        # jitted lane splices with a TRACED lane index: one trace serves
+        # every slot, so admissions never retrace. The compiled helpers
+        # are cached ON the engine object — a retired bucket's engine
+        # comes back from the compile cache with its warm splice traces,
+        # so a rejoin-after-retirement is trace-free end to end.
+        helpers = engine.__dict__.get("_serving_helpers")
+        if helpers is None:
+            ocp_ = ocp
+
+            def reset_lane(state, lane, theta_row):
+                """Fresh warm start for a newly-admitted tenant's lane:
+                the OCP initial guess, zero equality duals, centered
+                inequality duals, zero multipliers — a recycled slot
+                must not leak the previous tenant's iterate."""
+                w = (state.w[0].at[lane].set(
+                    ocp_.initial_guess(theta_row)),)
+                y = (state.y[0].at[lane].set(0.0),)
+                z = (state.z[0].at[lane].set(0.1),)
+                lam = {a: (pieces[0].at[lane].set(0.0),)
+                       for a, pieces in state.lam.items()}
+                ex_diff = {a: (pieces[0].at[lane].set(0.0),)
+                           for a, pieces in state.ex_diff.items()}
+                return state._replace(w=w, y=y, z=z, lam=lam,
+                                      ex_diff=ex_diff)
+
+            helpers = {
+                "splice_theta": jax.jit(
+                    lambda batch, lane, row: jax.tree.map(
+                        lambda b, r: b.at[lane].set(r), batch, row)),
+                "reset_lane": jax.jit(reset_lane),
+                # the fresh-state TEMPLATE, built once per engine (the
+                # eager init_state cost is paid at the cold build, not
+                # per slot-plane). Later slot planes copy it: every
+                # admitted lane is re-spliced by reset_lane anyway, so
+                # the template's padding values are immaterial — it only
+                # has to be finite and shape-true.
+                "state_template": engine.init_state([self.theta_batch]),
+            }
+            engine.__dict__["_serving_helpers"] = helpers
+        self._splice_theta = helpers["splice_theta"]
+        self._reset_lane = helpers["reset_lane"]
+        # per-plane COPY: with a donated engine the first step consumes
+        # its input state's buffers — the cached template must never be
+        # the object handed to step
+        self.state = jax.tree.map(jnp.copy, helpers["state_template"])
+
+    # -- occupancy ------------------------------------------------------------
+
+    @property
+    def n_active(self) -> int:
+        return int(self.mask.sum())
+
+    @property
+    def free_slots(self) -> int:
+        return self.capacity - self.n_active
+
+    def slot_of(self, tenant_id: str) -> "int | None":
+        return self._slot_of.get(tenant_id)
+
+    @property
+    def tenants(self) -> tuple:
+        return tuple(t for t in self.slots if t is not None)
+
+    # -- membership -----------------------------------------------------------
+
+    def admit(self, tenant_id: str, theta_row) -> int:
+        """Place a tenant into a free slot; returns the slot index.
+        Raises ``ValueError`` when full (the plane grows capacity) or on
+        a duplicate id."""
+        if tenant_id in self._slot_of:
+            raise ValueError(f"tenant {tenant_id!r} already admitted")
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            raise ValueError(
+                f"no free slot (capacity {self.capacity})") from None
+        lane = jnp.asarray(slot, jnp.int32)
+        self.theta_batch = self._splice_theta(self.theta_batch, lane,
+                                              theta_row)
+        self.state = self._reset_lane(self.state, lane, theta_row)
+        self.slots[slot] = tenant_id
+        self._slot_of[tenant_id] = slot
+        self.mask[slot] = True
+        return slot
+
+    def evict(self, tenant_id: str) -> int:
+        """Free a tenant's slot (mask off; the lane becomes padding,
+        keeping its last parameters — shapes never change)."""
+        slot = self._slot_of.pop(tenant_id)
+        self.slots[slot] = None
+        self.mask[slot] = False
+        return slot
+
+    def update_theta(self, tenant_id: str, theta_row) -> None:
+        """Splice a tenant's fresh parameters (its per-request state /
+        disturbance data) into its lane."""
+        slot = self._slot_of[tenant_id]
+        self.theta_batch = self._splice_theta(
+            self.theta_batch, jnp.asarray(slot, jnp.int32), theta_row)
+
+    # -- serving --------------------------------------------------------------
+
+    def launch_round(self) -> RoundHandle:
+        """Enqueue one fused ADMM round for the current membership and
+        return immediately (JAX dispatch is asynchronous; materialize
+        the handle to read results). The state threads linearly through
+        here — with a donated engine the previous state's buffers are
+        consumed by the step, which is why no other reference to it may
+        survive."""
+        served = tuple((t, s) for s, t in enumerate(self.slots)
+                       if t is not None)
+        state, trajs, stats = self.engine.step(
+            self.state, [self.theta_batch],
+            active=[jnp.asarray(self.mask)])
+        self.state = self.engine.shift_state(state) \
+            if self.shift_between_rounds else state
+        self.rounds_served += 1
+        return RoundHandle(trajs=trajs, stats=stats, served=served)
+
+    def materialize(self, handle: RoundHandle) -> dict:
+        """Block on a round's outputs and decode per-tenant results:
+        ``tenant_id -> {"u0": {name: float}, "traj": {"u": row},
+        "stats": {...}}`` — the result-dict shape
+        :func:`~agentlib_mpc_tpu.resilience.guard.check_result`
+        consumes."""
+        u = np.asarray(handle.trajs[0]["u"])      # (capacity, N, n_u)
+        stats = handle.stats
+        converged = bool(stats.converged)
+        names = list(self.ocp.control_names)
+        out = {}
+        for tenant_id, slot in handle.served:
+            u_row = u[slot]
+            out[tenant_id] = {
+                "u0": {nm: float(u_row[0, k])
+                       for k, nm in enumerate(names)},
+                "traj": {"u": u_row},
+                "stats": {
+                    # per-tenant success = this lane produced a finite
+                    # plan (engine-level quarantine substitutes diverged
+                    # lanes); fleet-level convergence rides along for
+                    # observability and the round artifact
+                    "success": bool(np.isfinite(u_row).all()),
+                    "round_converged": converged,
+                    "iterations": int(stats.iterations),
+                },
+            }
+        return out

@@ -53,7 +53,7 @@ __all__ = [
     "SpanRecord", "SpanRecorder", "NOOP_SPAN",
     "metrics", "recorder", "span", "current_span",
     "configure", "enabled", "counter", "gauge", "histogram",
-    "solver_metrics", "install_jax_hooks", "reset",
+    "solver_metrics", "serving_metrics", "install_jax_hooks", "reset",
 ]
 
 
@@ -111,6 +111,34 @@ def solver_metrics(registry: "MetricsRegistry | None" = None) -> dict:
             "solver_solve_seconds", "wall-clock seconds per backend solve"),
         "kkt_error": reg.gauge(
             "solver_kkt_error", "KKT error of the most recent solve"),
+    }
+
+
+def serving_metrics(registry: "MetricsRegistry | None" = None) -> dict:
+    """The serving-plane metric families — one declaration site shared
+    by the dispatch plane (``agentlib_mpc_tpu/serving/``) and the
+    ``bench.py --serve`` artifact, like :func:`solver_metrics` for the
+    solver. Keys: requests, rounds, solves, active, queue_depth,
+    round_seconds. (The cache and admission layers declare their own
+    ``serving_compile_cache_*`` / ``serving_shed_total`` /
+    ``serving_join_build_seconds`` families at their write sites.)"""
+    reg = registry or DEFAULT
+    return {
+        "requests": reg.counter(
+            "serving_requests_total",
+            "solve requests submitted to the serving plane"),
+        "rounds": reg.counter(
+            "serving_rounds_total", "fused rounds dispatched"),
+        "solves": reg.counter(
+            "serving_solves_total", "per-tenant solve results delivered"),
+        "active": reg.gauge(
+            "serving_active_tenants", "admitted tenants per bucket"),
+        "queue_depth": reg.gauge(
+            "serving_queue_depth",
+            "pending solve requests at last drain"),
+        "round_seconds": reg.histogram(
+            "serving_round_seconds",
+            "wall-clock seconds per serve_round call"),
     }
 
 

@@ -127,3 +127,39 @@ def cpu_subprocess_env(base: "dict | None" = None) -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     return env
+
+
+#: known-noise XLA warning markers filtered from forwarded child output:
+#: the XLA:CPU "machine type ... doesn't match ... Compile machine
+#: features: [+64bit,+adx,...] ... may cause SIGILL" blob is a
+#: multi-kilobyte per-child emission on this VM that dominated the
+#: driver-stored BENCH_r05 stderr AND MULTICHIP_r0x output tails and
+#: buried the actual result lines. Harmless (the persistent compile
+#: cache crosses machine generations by design), known, and useless in
+#: an artifact. One definition, used by every child-spawning entry
+#: point (``bench.py`` workers, ``__graft_entry__`` dryrun).
+XLA_NOISE_MARKERS = (
+    "Machine type used for XLA:CPU compilation",
+    "Compile machine features:",
+    "may cause SIGILL",
+    "+prefer-no-gather",
+)
+
+
+def filter_xla_noise(text: str) -> str:
+    """Drop known-noise XLA machine-feature warning lines from captured
+    child output before forwarding/storing it; appends one summary line
+    so the filtering itself is on record."""
+    kept, dropped = [], 0
+    for ln in (text or "").splitlines(keepends=True):
+        if any(marker in ln for marker in XLA_NOISE_MARKERS):
+            dropped += 1
+            continue
+        kept.append(ln)
+    out = "".join(kept)
+    if dropped:
+        if out and not out.endswith("\n"):
+            out += "\n"
+        out += (f"[filtered {dropped} known-noise XLA machine-feature "
+                f"warning line(s)]\n")
+    return out

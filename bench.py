@@ -63,6 +63,14 @@ Modes:
                                 # asserts the quarantine keeps consensus
                                 # state/warm starts finite end-to-end
                                 # (docs/robustness.md); ONE JSON line
+    python bench.py --serve SEED [n]    # serving-plane sustained-
+                                # throughput benchmark: n (default 8)
+                                # LinearRCZone tenants churn through the
+                                # dispatch plane (seeded join/leave,
+                                # per-round solve requests) — solves/sec,
+                                # p50/p99 round latency, sync-vs-
+                                # pipelined dispatch A/B, cold-vs-cached
+                                # join latency (docs/serving.md)
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -821,6 +829,158 @@ def run_chaos(seed: int = 0, n_agents: int = 4) -> dict:
     return out
 
 
+def run_serve(seed: int = 0, n_tenants: int = 8, rounds: int = 40) -> dict:
+    """``--serve SEED [n]``: sustained-throughput benchmark of the
+    serving dispatch plane (``agentlib_mpc_tpu/serving/``) under seeded
+    tenant churn from the chaos harness.
+
+    ``n_tenants`` LinearRCZone tenants (the QP-fast-path workload)
+    join/leave a :class:`ServingPlane` following the deterministic
+    :func:`~agentlib_mpc_tpu.resilience.chaos.churn_schedule`; every
+    active tenant submits one solve request per round with drifting
+    initial state. The SAME schedule runs twice — once through the
+    synchronous dispatch loop and once through the donated, depth-1
+    pipelined one — so the per-round dispatch overhead the pipeline
+    hides is measured in situ, not modeled. Reported: solves/sec and
+    p50/p99 round latency (pipelined plane, the production
+    configuration), the sync-vs-pipelined mean round-time A/B, cold vs
+    cached join latency (the compile-cache story: a structurally
+    identical rejoin must be orders of magnitude cheaper than the first
+    build), compile-cache hit/miss counts, shed counts and warm-phase
+    retraces (must be 0 — churn is data, not structure).
+
+    The headline metric is platform-qualified off the accelerator
+    (``serve_solves_per_sec_<platform>``) exactly like the ADMM
+    trajectory row.
+    """
+    import random as _random
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+    from agentlib_mpc_tpu.resilience.chaos import churn_schedule
+    from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+    from agentlib_mpc_tpu.utils.jax_setup import (
+        enable_compile_profiling,
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    enable_compile_profiling()
+
+    ocp = linear_zone_ocp()
+    schedule = churn_schedule(seed, n_tenants, rounds)
+    rng = _random.Random(f"bench-serve:{seed}")
+    x0_base = {f"t{i:03d}": 294.0 + 6.0 * i / max(n_tenants - 1, 1)
+               for i in range(n_tenants)}
+
+    def theta_for(tid, drift=0.0):
+        return ocp.default_params(
+            x0=jnp.array([x0_base[tid] + drift]),
+            d_traj=jnp.broadcast_to(
+                jnp.array([150.0, 303.15, 295.15]), (HORIZON, 3)))
+
+    def make_spec(tid):
+        return TenantSpec(
+            tenant_id=tid, ocp=ocp, theta=theta_for(tid),
+            couplings={"power": "Q"},
+            solver_options=SolverOptions(**SOLVER_BASE),
+            deadline_s=60.0)
+
+    def run_plane(pipelined: bool) -> dict:
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=5, rho=5e-3),
+            initial_capacity=n_tenants, pipelined=pipelined,
+            donate=pipelined, queue_limit=4 * n_tenants)
+        joins = {"cold": [], "cached": []}
+        walls, delivered = [], 0
+        retrace_counter = telemetry.metrics().counter("jax_retraces_total")
+        retr_mark = None
+        for r, events in enumerate(schedule):
+            for kind, tid in events:
+                if kind == "join":
+                    rec = plane.join(make_spec(tid))
+                    joins["cached" if rec.engine_cached
+                          else "cold"].append(rec.latency_s)
+                elif tid in plane.tenants:
+                    plane.leave(tid)
+            for tid in plane.tenants:
+                plane.submit(tid, theta=theta_for(
+                    tid, drift=rng.uniform(-0.5, 0.5)))
+            t0 = time.perf_counter()
+            res = plane.serve_round()
+            walls.append(time.perf_counter() - t0)
+            delivered += len(res)
+            if r == 0:
+                # membership churn and request traffic beyond this
+                # point are DATA; any retrace would be a regression
+                retr_mark = retrace_counter.total()
+        delivered += len(plane.flush())
+        warm_retraces = retrace_counter.total() - (retr_mark or 0.0)
+        serving_s = float(np.sum(walls))
+        warm_walls = np.asarray(walls[1:] if len(walls) > 1 else walls)
+        return {
+            "plane": plane,
+            "joins": joins,
+            "delivered": delivered,
+            "serving_s": serving_s,
+            "solves_per_sec": delivered / serving_s if serving_s else 0.0,
+            "round_ms_mean": float(1e3 * warm_walls.mean()),
+            "round_ms_p50": float(1e3 * np.percentile(warm_walls, 50)),
+            "round_ms_p99": float(1e3 * np.percentile(warm_walls, 99)),
+            "warm_retraces": int(warm_retraces),
+        }
+
+    sync = run_plane(pipelined=False)
+    piped = run_plane(pipelined=True)
+
+    def join_ms(vals):
+        return round(1e3 * float(np.mean(vals)), 2) if vals else None
+
+    platform = jax.devices()[0].platform
+    metric = "serve_solves_per_sec" if platform == "tpu" \
+        else f"serve_solves_per_sec_{platform}"
+    # the headline is the AUTO-resolved production configuration's
+    # throughput (ServingPlane defaults: sync on CPU — where the
+    # measured pipeline A/B is parity-to-negative — pipelined on
+    # accelerators); both columns always ride along
+    auto = sync if platform == "cpu" else piped
+    stats = auto["plane"].stats()
+    out = {
+        "metric": metric,
+        "value": round(auto["solves_per_sec"], 2),
+        "config": "sync" if platform == "cpu" else "pipelined",
+        "unit": "solves/s",
+        "seed": seed,
+        "n_tenants": n_tenants,
+        "rounds": rounds,
+        "round_ms_p50": round(auto["round_ms_p50"], 2),
+        "round_ms_p99": round(auto["round_ms_p99"], 2),
+        "sync_round_ms_mean": round(sync["round_ms_mean"], 2),
+        "pipelined_round_ms_mean": round(piped["round_ms_mean"], 2),
+        #: what the donated async pipeline saves per round vs the
+        #: synchronous loop, same schedule, same hardware
+        "dispatch_overhead_saved_ms": round(
+            sync["round_ms_mean"] - piped["round_ms_mean"], 2),
+        "sync_solves_per_sec": round(sync["solves_per_sec"], 2),
+        "join_cold_ms": join_ms(auto["joins"]["cold"]),
+        "join_cached_ms": join_ms(auto["joins"]["cached"]),
+        "cache": stats["cache"],
+        "queue": stats["queue"],
+        "warm_retraces": sync["warm_retraces"] + piped["warm_retraces"],
+        "platform": platform,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def run_profile(trace_dir: str = "bench_trace",
                 n_agents: int = N_AGENTS) -> None:
     """Capture an XLA profiler trace of the warm ``n_agents``-zone step
@@ -1300,6 +1460,7 @@ def run_evidence() -> None:
     section("horizon_shard", run_horizon_shard)
     section("ocp_ab", run_ocp_ab)
     section("jac_ab", run_jac_ab)
+    section("serve", run_serve)
 
 
 # --- fail-soft orchestration (round-3 lesson: a wedged TPU tunnel hangs
@@ -1362,38 +1523,16 @@ def _child_main() -> None:
         print(json.dumps(measure()))
 
 
-#: known-noise XLA warning markers filtered from forwarded child stderr:
-#: the XLA:CPU "machine type ... doesn't match ... Compile machine
-#: features: [+64bit,+adx,...] ... may cause SIGILL" blob is a
-#: multi-kilobyte per-child emission on this VM that dominated the
-#: driver-stored BENCH_r05/MULTICHIP_r05 stderr tails and buried the
-#: actual bench lines. Harmless (the persistent compile cache crosses
-#: machine generations by design), known, and useless in an artifact.
-_XLA_NOISE_MARKERS = (
-    "Machine type used for XLA:CPU compilation",
-    "Compile machine features:",
-    "may cause SIGILL",
-    "+prefer-no-gather",
-)
-
-
 def _filter_xla_noise(text: str) -> str:
     """Drop known-noise XLA machine-feature warning lines before
-    forwarding child stderr (what the driver's ``tail`` capture stores);
-    appends one summary line so the filtering itself is on record."""
-    kept, dropped = [], 0
-    for ln in (text or "").splitlines(keepends=True):
-        if any(marker in ln for marker in _XLA_NOISE_MARKERS):
-            dropped += 1
-            continue
-        kept.append(ln)
-    out = "".join(kept)
-    if dropped:
-        if out and not out.endswith("\n"):
-            out += "\n"
-        out += (f"[bench] filtered {dropped} known-noise XLA "
-                f"machine-feature warning line(s)\n")
-    return out
+    forwarding child stderr (what the driver's ``tail`` capture stores).
+    The marker set and filtering live in
+    :func:`agentlib_mpc_tpu.utils.jax_setup.filter_xla_noise` — ONE
+    definition shared with ``__graft_entry__``'s multichip-dryrun child,
+    whose MULTICHIP_r0x output tails the same blob used to dominate."""
+    from agentlib_mpc_tpu.utils.jax_setup import filter_xla_noise
+
+    return filter_xla_noise(text)
 
 
 def _spawn(args: list, env: dict, timeout: float) -> list:
@@ -1580,6 +1719,19 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             runner(n)
             return
+
+    if "--serve" in sys.argv:
+        # serving-plane churn benchmark, in-process like --chaos (pin
+        # JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --serve SEED [n_tenants]
+        idx = sys.argv.index("--serve")
+        seed, n = 0, 8
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_serve(seed, n)
+        return
 
     if "--chaos" in sys.argv:
         # resilience smoke, in-process like --emit-metrics (pin

@@ -109,3 +109,170 @@ class TestFusedEngineF32:
         assert bool(stats.converged)
         np.testing.assert_allclose(
             np.asarray(state.zbar["shared"]), 2.0, atol=5e-3)
+
+
+class TestRobustnessCorpusF32:
+    """VERDICT r5 #4: the degenerate/adversarial corpus of
+    ``test_solver_robustness.py`` re-run in f32 through the general IPM
+    (``qp_fast_path="off"`` semantics — ``solve_nlp`` directly) and the
+    QP path. Parity means the same honest verdicts as f64: solvable
+    degenerate programs succeed (at f32-appropriate tolerance, carried
+    by the dtype-aware convergence targets), infeasible ones still
+    honestly fail. The corpus OPTS request tol=1e-8 — unreachable in
+    f32, so every success here exercises the acceptance machinery."""
+
+    @pytest.fixture(params=["ipm", "qp"])
+    def solver(self, request):
+        from agentlib_mpc_tpu.ops.qp import solve_qp
+        from agentlib_mpc_tpu.ops.solver import solve_nlp
+
+        return solve_nlp if request.param == "ipm" else solve_qp
+
+    def _opts(self):
+        return SolverOptions(tol=1e-8, max_iter=120)
+
+    def test_licq_failure_duplicated_constraints(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        n = 6
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(n, n))
+        Q = M @ M.T + n * np.eye(n)
+        c = rng.normal(size=n)
+        a = rng.normal(size=(1, n))
+        nlp = _qp_nlp(Q, c, np.vstack([a, a, a]), np.array([1.0] * 3))
+        res = solver(nlp, jnp.zeros(n), None, jnp.full(n, -10.0),
+                     jnp.full(n, 10.0), self._opts())
+        assert res.w.dtype == jnp.float32
+        assert bool(res.stats.success)
+        w = np.asarray(res.w)
+        assert abs(float((a @ w)[0]) - 1.0) < 1e-4
+        grad = Q @ w + c + np.vstack([a, a, a]).T @ np.asarray(res.y)
+        assert np.max(np.abs(grad)) < 1e-2
+
+    def test_weakly_active_bound(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        nlp = _qp_nlp(np.eye(3), np.zeros(3))
+        res = solver(nlp, jnp.full(3, 0.5), None,
+                     jnp.asarray([0.0, -1.0, -1.0]), jnp.full(3, 1.0),
+                     self._opts())
+        assert bool(res.stats.success)
+        # f32 barrier floor parks the weakly-active coordinate at
+        # O(sqrt(mu_floor)) ~ 3e-3
+        np.testing.assert_allclose(np.asarray(res.w), np.zeros(3),
+                                   atol=1e-2)
+
+    def test_pinned_at_bound(self, f32, solver):
+        nlp = NLPFunctions(f=lambda w, t: -w[0] + 0.5 * w[1] ** 2,
+                           g=lambda w, t: jnp.zeros((0,)),
+                           h=lambda w, t: jnp.zeros((0,)))
+        res = solver(nlp, jnp.asarray([0.5, 0.5]), None,
+                     jnp.zeros(2), jnp.ones(2), self._opts())
+        assert bool(res.stats.success)
+        assert abs(float(res.w[0]) - 1.0) < 1e-3
+
+    def test_brutal_scaling(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        scales = np.array([1e-4, 1.0, 1e4])
+        Q = np.diag(scales)
+        c = -scales * np.array([1.0, 2.0, 3.0])
+        nlp = _qp_nlp(Q, c)
+        res = solver(nlp, jnp.asarray([0.1, 0.1, 0.1]), None,
+                     jnp.full(3, -10.0), jnp.full(3, 10.0), self._opts())
+        assert bool(res.stats.success)
+        w = np.asarray(res.w)
+        w_star = np.array([1.0, 2.0, 3.0])
+        # in f32 only the stiffest coordinate is position-determined;
+        # the flatter ones are judged by the objective (the corpus's own
+        # rule for the 1e-4-curvature coordinate, one decade further)
+        np.testing.assert_allclose(w[2], w_star[2], rtol=1e-3)
+        f = 0.5 * w @ (Q @ w) + c @ w
+        f_star = 0.5 * w_star @ (Q @ w_star) + c @ w_star
+        assert f - f_star < 1e-2
+
+    def test_contradictory_equalities_not_a_success(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        Aeq = np.array([[1.0, 1.0], [1.0, 1.0]])
+        nlp = _qp_nlp(np.eye(2), np.zeros(2), Aeq, np.array([0.0, 1.0]))
+        res = solver(nlp, jnp.zeros(2), None, jnp.full(2, -5.0),
+                     jnp.full(2, 5.0), self._opts())
+        assert not bool(res.stats.success)
+        assert float(res.stats.constraint_violation) > 0.05
+
+    def test_equality_outside_box_not_a_success(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        nlp = _qp_nlp(np.eye(2), np.zeros(2), np.array([[1.0, 0.0]]),
+                      np.array([3.0]))
+        res = solver(nlp, jnp.zeros(2), None, jnp.full(2, -1.0),
+                     jnp.ones(2), self._opts())
+        assert not bool(res.stats.success)
+        assert float(res.stats.constraint_violation) > 0.5
+
+
+class TestF32ClosedLoopBudget:
+    """The VERDICT r5 #4 repro, pinned: the f32 linear closed loop
+    (LinearRCZone, 13 warm-chained solves, default tolerances) through
+    the GENERAL IPM — the configuration PERF.md round 5 recorded 2/13
+    budget-outs on. The dtype-aware convergence targets + the wedged-mu
+    escape must yield zero budget-outs: every solve succeeds well inside
+    the default budget."""
+
+    def test_linear_closed_loop_ipm_no_budget_outs(self, f32):
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        ocp = transcribe(LinearRCZone(), ["Q"], N=6, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta0 = ocp.default_params()
+        lb, ub = ocp.bounds(theta0)
+        opts = SolverOptions()          # defaults: tol 1e-6, budget 100
+        w = ocp.initial_guess(theta0)
+        y = jnp.zeros((ocp.n_g,))
+        z = jnp.full((ocp.n_h,), 0.1)
+        x0 = jnp.array([293.15])
+        iterations = []
+        for k in range(13):
+            th = ocp.default_params(x0=x0)
+            res = solve_nlp(ocp.nlp, w, th, lb, ub, opts, y0=y, z0=z,
+                            mu0=jnp.asarray(1e-2) if k else None)
+            assert bool(res.stats.success), \
+                f"solve {k} failed: {res.stats}"
+            iterations.append(int(res.stats.iterations))
+            w, y, z = res.w, res.y, res.z
+            x0 = jnp.asarray(ocp.trajectories(res.w, th)["x"][1])
+        assert max(iterations) < opts.max_iter, \
+            f"budget-out: per-solve iterations {iterations}"
+
+    def test_forced_stage_qp_terminates_f32(self, f32):
+        """The CHANGES.md PR 6 known stall, in the dtype it bites in:
+        forced ``kkt_method="stage"`` on the tiny N=8 LinearRCZone QP.
+        At f32 precision the pivot-free stage factor genuinely cannot
+        deliver usable directions at the near-convergence conditioning
+        (even fully Levenberg-regularized), so the honest contract is:
+        the direction-health guard holds the iterate (no runaway — the
+        old bug reported kkt_error 36 after burning the whole budget),
+        the wedge exit bounds the burn well under the budget, the held
+        iterate stays finite and near-feasible, and the verdict is an
+        HONEST failure — never a silent wrong answer. (The f64 variant
+        in test_qp.py::TestForcedStageTinySizes converges and matches
+        LU; f64 is what the suite runs.)"""
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+        from agentlib_mpc_tpu.ops.qp import solve_qp
+
+        ocp = transcribe(LinearRCZone(), ["Q"], N=8, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        lb, ub = ocp.bounds(theta)
+        opts = SolverOptions(tol=1e-6, max_iter=60, kkt_method="stage",
+                             stage_partition=ocp.stage_partition)
+        res = solve_qp(ocp.nlp, ocp.initial_guess(theta), theta, lb, ub,
+                       opts)
+        assert int(res.stats.iterations) < 30      # wedge exit, not budget
+        assert bool(jnp.all(jnp.isfinite(res.w)))
+        assert float(res.stats.kkt_error) < 1.0    # held, no runaway
+        # constraint_violation is RAW (unscaled) units on an O(500 W)
+        # dynamics scale: ~0.02 here is ~5e-5 relative — near-feasible
+        assert float(res.stats.constraint_violation) < 0.1

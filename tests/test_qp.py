@@ -291,3 +291,43 @@ class TestBackendRouting:
             scale = max(1.0, abs(rs["stats"]["objective"]))
             assert abs(rf["stats"]["objective"]
                        - rs["stats"]["objective"]) < 1e-5 * scale
+
+
+class TestForcedStageTinySizes:
+    """The known pre-existing stall (CHANGES.md PR 6): ``solve_qp`` with
+    FORCED ``kkt_method="stage"`` at tiny sizes (N=8 LinearRCZone, KKT
+    dim 74 — far below every auto-routing floor) used to burn its whole
+    budget with the iterate running away once the pivot-free stage LDLᵀ
+    broke down at near-convergence conditioning. The direction-health
+    guard + adaptive Levenberg delta + stall exit must make the forced
+    path terminate quickly with an honest verdict and a solution that
+    matches the LU path."""
+
+    @pytest.mark.parametrize("N", [6, 8])
+    def test_forced_stage_converges_and_matches_lu(self, N):
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+
+        ocp = transcribe(LinearRCZone(), ["Q"], N=N, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        lb, ub = ocp.bounds(theta)
+        w0 = ocp.initial_guess(theta)
+        results = {}
+        for method in ("lu", "stage"):
+            opts = SolverOptions(tol=1e-6, max_iter=60, kkt_method=method,
+                                 stage_partition=ocp.stage_partition)
+            res = solve_qp(ocp.nlp, w0, theta, lb, ub, opts)
+            assert bool(res.stats.success), \
+                f"{method} failed at N={N}: {res.stats}"
+            # the stall exit bounds the burn: a wedged solve must stop
+            # well before a large budget instead of running it out
+            assert int(res.stats.iterations) < 50
+            results[method] = res
+        # same optimum (f64 suite precision: the factorizations agree)
+        np.testing.assert_allclose(
+            np.asarray(results["stage"].w), np.asarray(results["lu"].w),
+            atol=1e-4)
+        obj_lu = float(results["lu"].stats.objective)
+        assert abs(float(results["stage"].stats.objective) - obj_lu) \
+            <= 1e-6 * max(1.0, abs(obj_lu))

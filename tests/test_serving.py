@@ -1,0 +1,355 @@
+"""Serving dispatch plane: fingerprints, cache, slots, admission, churn.
+
+The tentpole contracts (ISSUE 7 / docs/serving.md):
+
+* structural fingerprints are deterministic, equal across separately
+  transcribed identical OCPs, distinct across different models;
+* tenant join/leave flips traced masks inside padded slots — results
+  match an unpadded fleet, and membership churn never retraces
+  (the ``[serving]`` budget gate, run here as a test);
+* a structurally-identical rejoining tenant is a compile-cache hit;
+* the admission queue sheds on overload/deadline into the PR 2
+  degradation ladder (replay → hold → fallback);
+* pipelined dispatch delivers the same results as the synchronous loop,
+  one round later.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+    stack_params,
+)
+from agentlib_mpc_tpu.resilience.guard import DegradationPolicy
+from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+
+ADMM_OPTS = FusedADMMOptions(max_iterations=6, rho=2.0)
+SOLVER_OPTS = SolverOptions(max_iter=30)
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+def make_spec(ocp, tid, a, **kw):
+    return TenantSpec(
+        tenant_id=tid, ocp=ocp,
+        theta=ocp.default_params(p=jnp.array([float(a)])),
+        couplings={"shared_u": "u"},
+        solver_options=SOLVER_OPTS, **kw)
+
+
+@pytest.fixture(scope="module")
+def plane(ocp):
+    """One shared pipelined+donated plane (module-scoped: the cold
+    engine build is the expensive part; tests restore membership)."""
+    return ServingPlane(ADMM_OPTS, slot_multiple=1, initial_capacity=4,
+                        pipelined=True, donate=True)
+
+
+class TestFingerprint:
+    def test_deterministic_and_structural(self, ocp):
+        from agentlib_mpc_tpu.lint.jaxpr import structural_fingerprint
+
+        fp1 = structural_fingerprint(ocp.nlp, ocp.default_params(),
+                                     ocp.n_w, ocp.stage_partition)
+        fp2 = structural_fingerprint(ocp.nlp, ocp.default_params(),
+                                     ocp.n_w, ocp.stage_partition)
+        assert fp1 == fp2 and fp1.digest == fp2.digest
+        # a separately transcribed, structurally identical OCP
+        # fingerprints EQUAL — the rejoin-across-retranscription case
+        ocp_b = tracker_ocp()
+        assert ocp_b is not ocp
+        fp3 = structural_fingerprint(ocp_b.nlp, ocp_b.default_params(),
+                                     ocp_b.n_w, ocp_b.stage_partition)
+        assert fp3 == fp1
+        # a different structure (longer horizon) fingerprints apart
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        other = transcribe(LinearRCZone(), ["Q"], N=4, dt=300.0,
+                           method="multiple_shooting")
+        fp4 = structural_fingerprint(other.nlp, other.default_params(),
+                                     other.n_w, other.stage_partition)
+        assert fp4 != fp1
+
+    def test_bucket_key_separates_solver_config(self, ocp):
+        from agentlib_mpc_tpu.serving import bucket_key
+
+        a = bucket_key(make_spec(ocp, "x", 1.0))
+        b = bucket_key(make_spec(ocp, "y", 2.0))
+        assert a == b          # theta differs, structure doesn't
+        c = bucket_key(TenantSpec(
+            tenant_id="z", ocp=ocp,
+            theta=ocp.default_params(),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=50)))
+        assert c != a          # solver options shape the executable
+
+
+class TestJoinServeLeave:
+    def test_lifecycle_and_cache(self, plane, ocp):
+        r1 = plane.join(make_spec(ocp, "a1", 1.0))
+        assert not r1.engine_cached          # first build is cold
+        r2 = plane.join(make_spec(ocp, "a2", 3.0))
+        assert r2.engine_cached
+        assert r2.latency_s < r1.latency_s / 10
+        # serve until both tenants' results arrive (pipelined: round 1
+        # delivers round 0)
+        for t in ("a1", "a2"):
+            plane.submit(t)
+        plane.serve_round()
+        res = plane.flush()
+        assert set(res) == {"a1", "a2"}
+        for r in res.values():
+            assert r.action == "actuate" and r.healthy
+            assert np.isfinite(r.controls["u"])
+        # consensus across the two active lanes: tracker targets 1 and 3
+        # coupled on one alias pull the shared control toward 2
+        us = [res[t].controls["u"] for t in ("a1", "a2")]
+        assert all(1.0 < u < 3.0 for u in us)
+        plane.leave("a1")
+        plane.leave("a2")
+        assert plane.tenants == ()
+
+    def test_rejoin_after_retirement_is_cache_hit(self, plane, ocp):
+        hits0 = plane.cache.hits
+        rec = plane.join(make_spec(ocp, "a1", 2.0))
+        assert rec.engine_cached and plane.cache.hits > hits0
+        assert rec.latency_s < 5.0           # splice, not compile
+        plane.submit("a1")
+        plane.serve_round()
+        res = plane.flush()
+        assert res["a1"].action == "actuate"
+        # an isolated tenant's consensus tracks its own target (solo
+        # consensus converges linearly in lam; 6 ADMM iterations leave
+        # a ~1.5% bias — the gate here is "right target", not tol)
+        assert abs(res["a1"].controls["u"] - 2.0) < 0.1
+        plane.leave("a1")
+
+    def test_recycled_slot_gets_fresh_warm_start(self, plane, ocp):
+        """A new tenant taking a previously-used slot must not inherit
+        the old tenant's iterate: its solve converges to ITS target."""
+        plane.join(make_spec(ocp, "old", -4.0))
+        plane.submit("old")
+        plane.serve_round()
+        plane.flush()
+        plane.leave("old")
+        rec = plane.join(make_spec(ocp, "new", 4.0))
+        assert rec.slot == 0                 # same recycled slot
+        plane.submit("new")
+        plane.serve_round()
+        res = plane.flush()
+        # a leaked warm start from the old tenant (target -4) would land
+        # far below; a fresh lane tracks the new target
+        assert abs(res["new"].controls["u"] - 4.0) < 0.1
+        plane.leave("new")
+
+
+class TestMaskedEquivalence:
+    def test_padded_plus_mask_equals_unpadded_fleet(self, ocp):
+        """The dynamic-mask contract: a 4-slot engine with 2 active
+        lanes must reproduce the 2-agent engine's consensus results
+        (same semantics pad_group_to_devices promises statically)."""
+        thetas2 = stack_params([
+            ocp.default_params(p=jnp.array([1.0])),
+            ocp.default_params(p=jnp.array([3.0]))])
+        g2 = AgentGroup(name="ref", ocp=ocp, n_agents=2,
+                        couplings={"shared_u": "u"},
+                        solver_options=SOLVER_OPTS)
+        ref = FusedADMM([g2], ADMM_OPTS)
+        sref = ref.init_state([thetas2])
+        sref, trajs_ref, _ = ref.step(sref, [thetas2])
+
+        thetas4 = stack_params([
+            ocp.default_params(p=jnp.array([a]))
+            for a in (1.0, 3.0, 7.0, -7.0)])   # lanes 2/3 are padding
+        g4 = AgentGroup(name="padded", ocp=ocp, n_agents=4,
+                        couplings={"shared_u": "u"},
+                        solver_options=SOLVER_OPTS)
+        padded = FusedADMM([g4], ADMM_OPTS)
+        mask = jnp.asarray([True, True, False, False])
+        sp = padded.init_state([thetas4])
+        sp, trajs_pad, _ = padded.step(sp, [thetas4], active=[mask])
+        np.testing.assert_allclose(
+            np.asarray(trajs_pad[0]["u"][:2]),
+            np.asarray(trajs_ref[0]["u"]), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sp.zbar["shared_u"]),
+            np.asarray(sref.zbar["shared_u"]), atol=1e-5)
+
+    def test_mask_flip_changes_consensus_without_retrace(self, ocp):
+        """Flipping a lane between rounds is data: the consensus mean
+        moves, the trace count does not."""
+        from agentlib_mpc_tpu import telemetry
+        from agentlib_mpc_tpu.utils.jax_setup import (
+            enable_compile_profiling,
+        )
+
+        telemetry.configure(enabled=True)
+        reg = enable_compile_profiling()
+        thetas = stack_params([
+            ocp.default_params(p=jnp.array([a])) for a in (0.0, 4.0)])
+        g = AgentGroup(name="flip", ocp=ocp, n_agents=2,
+                       couplings={"shared_u": "u"},
+                       solver_options=SOLVER_OPTS)
+        eng = FusedADMM([g], ADMM_OPTS)
+        st = eng.init_state([thetas])
+        st, _, _ = eng.step(st, [thetas],
+                            active=[jnp.asarray([True, True])])
+        zb_both = float(st.zbar["shared_u"][0])
+        traces0 = reg.counter("jax_traces_total").total()
+        # fresh state, lane 1 masked off: the consensus mean is lane 0's
+        # own trajectory (target 0), nowhere near the two-lane mean
+        st2 = eng.init_state([thetas])
+        st2, _, _ = eng.step(st2, [thetas],
+                             active=[jnp.asarray([True, False])])
+        zb_solo = float(st2.zbar["shared_u"][0])
+        assert reg.counter("jax_traces_total").total() == traces0
+        assert abs(zb_solo) < 0.2            # solo lane tracks target 0
+        assert zb_both > 1.5                 # both lanes: mean of 0 and 4
+
+
+class TestAdmission:
+    def test_overload_shed_walks_guard_ladder(self, ocp):
+        sp = ServingPlane(ADMM_OPTS, slot_multiple=1, initial_capacity=2,
+                          pipelined=False, donate=False, queue_limit=1,
+                          guard_policy=DegradationPolicy(
+                              replay_steps=1, hold_steps=1))
+        sp.join(make_spec(ocp, "t1", 1.0))
+        sp.join(make_spec(ocp, "t2", 2.0))
+        # serve one healthy round so t2 has a stored plan to replay
+        sp.submit("t1")
+        sp.submit("t2")          # queue_limit=1: second submission shed
+        # a never-served tenant has nothing to replay/hold -> fallback
+        first = sp.submit("t2")
+        assert first is not None and first.action == "fallback"
+        res = sp.serve_round()
+        assert res["t1"].action == "actuate"
+
+    def test_deadline_expiry_sheds_at_drain(self, ocp):
+        sp = ServingPlane(ADMM_OPTS, slot_multiple=1, initial_capacity=1,
+                          pipelined=False, donate=False)
+        sp.join(make_spec(ocp, "t1", 1.0))
+        sp.submit("t1", deadline_s=0.5, now=0.0)
+        res = sp.serve_round(now=10.0)       # way past the deadline
+        assert sp.queue.shed_deadline == 1
+        assert res["t1"].action in ("replay", "hold", "fallback")
+        assert not res["t1"].healthy
+
+    def test_replay_then_recovery_after_shed(self, ocp):
+        """The full PR 2 wiring: healthy round stores a plan, a shed
+        request replays it, the next healthy round re-engages."""
+        sp = ServingPlane(ADMM_OPTS, slot_multiple=1, initial_capacity=1,
+                          pipelined=False, donate=False, queue_limit=4)
+        sp.join(make_spec(ocp, "t1", 2.0))
+        sp.submit("t1")
+        res = sp.serve_round()
+        assert res["t1"].action == "actuate"
+        sp.submit("t1", deadline_s=0.1, now=0.0)
+        res = sp.serve_round(now=5.0)        # expired -> ladder: replay
+        assert res["t1"].action == "replay"
+        assert res["t1"].controls is not None
+        sp.submit("t1")
+        res = sp.serve_round()
+        assert res["t1"].action == "actuate" and res["t1"].healthy
+
+
+class TestChurnGate:
+    def test_serving_budget_gate_is_green(self):
+        """The CI gate as a test: zero warm traces/compiles across the
+        scripted join→serve→leave→rejoin churn, rejoin a cache hit."""
+        from agentlib_mpc_tpu.lint.retrace_budget import run_serving_gate
+
+        report = run_serving_gate(verbose=False)
+        assert report["violations"] == [], report
+        assert report["failures"] == [], report
+        assert report["cache"]["hits"] >= 1
+
+
+class TestChurnSchedule:
+    def test_deterministic_with_rejoins(self):
+        from agentlib_mpc_tpu.resilience.chaos import churn_schedule
+
+        s1 = churn_schedule(7, 6, 30)
+        assert s1 == churn_schedule(7, 6, 30)
+        assert s1 != churn_schedule(8, 6, 30)
+        joins = [t for r in s1 for kind, t in r if kind == "join"]
+        assert len(joins) > len(set(joins)), "no rejoin events in 30 rounds"
+        # membership consistency: never leave an absent tenant, never
+        # join a present one
+        active = set()
+        for r in s1:
+            for kind, t in r:
+                if kind == "join":
+                    assert t not in active
+                    active.add(t)
+                else:
+                    assert t in active
+                    active.discard(t)
+
+
+@pytest.mark.slow
+class TestServeBenchSmoke:
+    def test_bench_serve_smoke(self):
+        """``bench.py --serve`` end to end at reduced scale: the metric
+        row exists, platform-qualified, with the A/B and join columns."""
+        import bench
+
+        out = bench.run_serve(seed=1, n_tenants=2, rounds=6)
+        assert out["metric"].startswith("serve_solves_per_sec")
+        assert out["value"] > 0
+        assert out["warm_retraces"] == 0
+        assert out["join_cold_ms"] is not None
+        assert out["cache"]["misses"] >= 1
+        assert out["round_ms_p99"] >= out["round_ms_p50"]
+
+
+class TestBackendSeam:
+    def test_backend_exposes_problem_fingerprint(self):
+        """The backend-side half of the admission handshake: an agent
+        asks its backend for the structural fingerprint the serving
+        plane buckets by."""
+        from agentlib_mpc_tpu.backends.backend import (
+            VariableReference,
+            create_backend,
+        )
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        backend = create_backend({
+            "type": "jax",
+            "model": {"class": LinearRCZone},
+            "discretization_options": {"collocation_order": 2},
+        })
+        with pytest.raises(RuntimeError):
+            backend.problem_fingerprint()    # no OCP yet
+        backend.setup_optimization(
+            VariableReference(
+                states=["T", "T_slack"], controls=["Q"],
+                inputs=["load", "T_amb", "T_upper"],
+                parameters=["C", "R", "s_T", "r_Q"]),
+            time_step=300.0, prediction_horizon=4)
+        fp = backend.problem_fingerprint()
+        assert fp.digest
+        # memoized: the same backend returns the identical object
+        assert backend.problem_fingerprint() is fp
+
+
+class TestAutoDispatchDefaults:
+    def test_auto_resolves_sync_on_cpu(self, ocp):
+        """pipelined/donate "auto" resolve by backend (the
+        fused_ls_jacobian pattern): sync + undonated on CPU, where the
+        measured A/B is parity-to-negative (PERF.md round 9)."""
+        sp = ServingPlane(ADMM_OPTS, slot_multiple=1)
+        assert sp.dispatcher.pipelined is False
+        assert sp.donate is False
+        sp2 = ServingPlane(ADMM_OPTS, slot_multiple=1, pipelined=True,
+                           donate=True)
+        assert sp2.dispatcher.pipelined is True and sp2.donate is True
