@@ -254,7 +254,14 @@ def run_serving_gate(budgets: "dict | None" = None,
     3. **cache assertion** — the rejoin after retirement must come out
        of the compile cache (``engine_cached`` on the receipt AND a
        cache-dict hit), or the gate fails regardless of the compile
-       counters.
+       counters;
+    4. **health churn** (the ``[serving.health]`` budget) — evict →
+       serve → readmit → serve on a live tenant: an eviction is a mask
+       flip and a re-admission is a fresh-warm-start lane splice, both
+       DATA — the per-entry-point (traces + compiles) delta across the
+       health churn is held to the ``[serving.health.budgets]``
+       allowance (default 0), so the survivability ladder can never
+       reintroduce retrace churn.
     """
     from agentlib_mpc_tpu import telemetry
     from agentlib_mpc_tpu.telemetry import jax_events
@@ -334,6 +341,23 @@ def run_serving_gate(budgets: "dict | None" = None,
         if plane.cache.hits <= hits_before:
             failures.append("cache hit counter did not advance across "
                             "the churn sequence")
+
+        # -- health churn: evict -> serve -> readmit -> serve ----------
+        health_cfg = dict(cfg.get("health", {}) or {})
+        health_budgets = dict(health_cfg.get("budgets", {}) or {})
+        health_default = int(health_budgets.pop("default", 0))
+        plane.join(spec("h0", 1.5))
+        serve("t1", "h0")                 # cover shapes pre-measurement
+        h_before = _compile_snapshot(reg)
+        plane.evict_tenant("h0", reason="gate")
+        serve("t1")                       # bucket serves without h0
+        if not plane.readmit_tenant("h0"):
+            failures.append("health-churn readmission found no free "
+                            "slot — eviction did not release one")
+        serve("t1", "h0")
+        h_after = _compile_snapshot(reg)
+        plane.leave("h0")
+        plane.leave("t1")
     finally:
         telemetry.configure(enabled=was_enabled)
 
@@ -345,10 +369,18 @@ def run_serving_gate(budgets: "dict | None" = None,
         if delta > budget:
             violations.append({"entry_point": entry, "observed": delta,
                                "budget": budget})
+    health_deltas = {k: h_after.get(k, 0) - h_before.get(k, 0)
+                     for k in set(h_before) | set(h_after)}
+    for entry, delta in sorted(health_deltas.items()):
+        budget = int(health_budgets.get(entry, health_default))
+        if delta > budget:
+            violations.append({"entry_point": f"health:{entry}",
+                               "observed": delta, "budget": budget})
     report = {
         "serve_rounds": serve_rounds,
         "capacity": capacity,
         "deltas": dict(sorted(deltas.items())),
+        "health_deltas": dict(sorted(health_deltas.items())),
         "violations": violations,
         "failures": failures,
         "cache": {"hits": plane.cache.hits,
@@ -364,6 +396,6 @@ def run_serving_gate(budgets: "dict | None" = None,
             print(f"serving-budget: {f}")
         if not violations and not failures:
             print("serving-budget: OK — zero excess compiles across "
-                  "join/serve/leave/rejoin churn; rejoin was a "
-                  "compile-cache hit")
+                  "join/serve/leave/rejoin churn (evict/readmit "
+                  "included); rejoin was a compile-cache hit")
     return report

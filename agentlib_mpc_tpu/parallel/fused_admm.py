@@ -209,6 +209,14 @@ class IterationStats(NamedTuple):
     #: active agents, (max_iter,) int32, zero beyond ``iterations``;
     #: None when the engine was built with ``quarantine=False``
     quarantined: "jnp.ndarray | None" = None
+    #: PER-LANE quarantine attribution: one (n_agents,) int32 array per
+    #: group counting how many of this round's iterations each lane was
+    #: quarantined. The quarantine substitutes a sick lane's iterate, so
+    #: its decoded trajectories come back finite — without this signal a
+    #: persistently-NaN tenant in the serving plane is indistinguishable
+    #: from a healthy one (the serving health ledger's whole input).
+    #: None when the engine was built with ``quarantine=False``
+    lane_quarantined: "tuple | None" = None
 
 
 class FusedADMM:
@@ -628,8 +636,10 @@ class FusedADMM:
             quarantined ``quarantine_reset_after`` iterations in a row get
             their warm start reset to the (sanitized) OCP initial guess —
             a fresh attempt can recover where a corrupted iterate cannot.
-            Returns the substituted batches, the updated per-lane streak
-            and the number of quarantined ACTIVE lanes."""
+            Returns the substituted batches, the updated per-lane streak,
+            the per-lane quarantined-this-iteration mask (active lanes
+            only — the serving health ledger's attribution signal) and
+            the number of quarantined ACTIVE lanes."""
             bad = ~(row_finite(w_b) & row_finite(y_b) & row_finite(z_b)
                     & row_finite(u_b))
             u_prev = jax.vmap(
@@ -658,8 +668,9 @@ class FusedADMM:
             y_b = jnp.where(jnp.isfinite(y_b), y_b, 0.0)
             z_b = jnp.where(jnp.isfinite(z_b), z_b, 0.1)
             u_b = jnp.where(jnp.isfinite(u_b), u_b, 0.0)
-            n_q = jnp.sum(bad & act_gi, dtype=jnp.int32)
-            return w_b, y_b, z_b, u_b, streak, n_q
+            q_bad = bad & act_gi
+            n_q = jnp.sum(q_bad, dtype=jnp.int32)
+            return w_b, y_b, z_b, u_b, streak, q_bad, n_q
 
         def step_fn(state: FusedState, theta_batches: tuple,
                     active: tuple):
@@ -673,13 +684,15 @@ class FusedADMM:
               # ``it == 0``, so both phases reuse a single solver trace.
               def iteration(carry):
                 (state, it, _res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist, q_streak, q_hist) = carry
+                 ok_hist, cl_hist, ex_hist, q_streak, q_hist,
+                 q_lane) = carry
                 cl_hist = dict(cl_hist)
                 ex_hist = dict(ex_hist)
 
                 u_groups = []
                 w_new, y_new, z_new = [], [], []
                 q_streak_new = []
+                q_lane_new = []
                 n_quarantined = jnp.asarray(0, jnp.int32)
                 ok_all = jnp.asarray(True)
                 for gi in range(n_groups):
@@ -704,14 +717,17 @@ class FusedADMM:
                         gi, state, theta_batches[gi], solver_opts, mu0,
                         budget)
                     if quarantine:
-                        w_b, y_b, z_b, u_b, streak_gi, n_q = \
+                        w_b, y_b, z_b, u_b, streak_gi, q_bad, n_q = \
                             apply_quarantine(gi, state, theta_batches[gi],
                                              q_streak[gi], w_b, y_b, z_b,
                                              u_b, active[gi])
                         q_streak_new.append(streak_gi)
+                        q_lane_new.append(
+                            q_lane[gi] + q_bad.astype(jnp.int32))
                         n_quarantined = n_quarantined + n_q
                     else:
                         q_streak_new.append(q_streak[gi])
+                        q_lane_new.append(q_lane[gi])
                     w_new.append(w_b)
                     y_new.append(y_b)
                     z_new.append(z_b)
@@ -812,7 +828,8 @@ class FusedADMM:
                 q_hist = q_hist.at[it].set(n_quarantined)
                 return (state, it + 1, res_all, prim_hist, dual_hist,
                         rho_hist, is_conv, ok_hist & ok_all, cl_hist,
-                        ex_hist, tuple(q_streak_new), q_hist)
+                        ex_hist, tuple(q_streak_new), q_hist,
+                        tuple(q_lane_new))
 
               return iteration
 
@@ -836,11 +853,13 @@ class FusedADMM:
             q_streak0 = tuple(jnp.zeros((g.n_agents,), jnp.int32)
                               for g in groups)
             q_hist0 = jnp.zeros((max_it,), jnp.int32)
+            q_lane0 = tuple(jnp.zeros((g.n_agents,), jnp.int32)
+                            for g in groups)
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
                      rho_hist0, jnp.asarray(False),
                      jnp.asarray(True), cl_hist0, ex_hist0,
-                     q_streak0, q_hist0)
+                     q_streak0, q_hist0, q_lane0)
             # two-phase inexact ADMM: iteration 0 runs the full (cold)
             # interior-point budget, subsequent iterations the short warm
             # budget — primal, duals and barrier all carry over
@@ -848,13 +867,13 @@ class FusedADMM:
                 # one body, budgets selected inside by it == 0 (the cond
                 # admits the first iteration unconditionally: done=False)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist, _qs, q_hist) = \
+                 ok_hist, cl_hist, ex_hist, _qs, q_hist, q_lane) = \
                     jax.lax.while_loop(
                         cond, make_iteration(cold=None), carry)
             else:
                 carry = make_iteration(cold=True)(carry)
                 (state, it, res, prim_hist, dual_hist, rho_hist, done,
-                 ok_hist, cl_hist, ex_hist, _qs, q_hist) = \
+                 ok_hist, cl_hist, ex_hist, _qs, q_hist, q_lane) = \
                     jax.lax.while_loop(
                         cond, make_iteration(cold=False), carry)
 
@@ -864,7 +883,8 @@ class FusedADMM:
                 local_solves_ok=ok_hist,
                 coupling_locals=cl_hist if record else None,
                 exchange_locals=ex_hist if record else None,
-                quarantined=q_hist if quarantine else None)
+                quarantined=q_hist if quarantine else None,
+                lane_quarantined=q_lane if quarantine else None)
             trajs = tuple(
                 jax.vmap(lambda w, th, g=g: g.ocp.trajectories(w, th))(
                     state.w[gi], theta_batches[gi])

@@ -12,8 +12,11 @@ reflexes instead of hope:
   from :class:`~agentlib_mpc_tpu.modules.mpc.BaseMPC`.
 - :mod:`.chaos` — deterministic, seeded fault injectors for the
   DataBroker (drop/delay/duplicate/reorder), the backend solve seam
-  (forced failure / NaN poisoning) and ADMM participants (silent
-  mid-round death), so the unhappy paths are *tested*, not hoped for.
+  (forced failure / NaN poisoning), ADMM participants (silent
+  mid-round death) and the serving plane (per-tenant NaN storms,
+  dispatcher stalls, engine-build failures, checkpoint corruption —
+  ``install_serving_chaos``), so the unhappy paths are *tested*, not
+  hoped for.
 
 The fused-ADMM quarantine (non-finite local solutions substituted with
 the agent's previous iterate inside the jitted step) lives with the
@@ -37,10 +40,17 @@ from agentlib_mpc_tpu.resilience.guard import (
 from agentlib_mpc_tpu.resilience.chaos import (
     AdmmDeathRule,
     BrokerRule,
+    ChaosBuildError,
     ChaosConfig,
     ChaosController,
+    ServeBuildFailRule,
+    ServeChaosConfig,
+    ServeNaNStormRule,
+    ServeStallRule,
     SolverRule,
+    corrupt_checkpoint,
     install_chaos,
+    install_serving_chaos,
 )
 
 __all__ = [
@@ -48,4 +58,7 @@ __all__ = [
     "LEVEL_MPC", "LEVEL_REPLAY", "LEVEL_HOLD", "LEVEL_FALLBACK",
     "ChaosConfig", "ChaosController", "BrokerRule", "SolverRule",
     "AdmmDeathRule", "install_chaos",
+    "ServeChaosConfig", "ServeNaNStormRule", "ServeStallRule",
+    "ServeBuildFailRule", "ChaosBuildError", "install_serving_chaos",
+    "corrupt_checkpoint",
 ]

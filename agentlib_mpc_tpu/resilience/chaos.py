@@ -300,6 +300,273 @@ def install_chaos(target, config: "ChaosConfig | dict",
     return controller
 
 
+# -- serving-plane chaos (the --chaos-serve fault model) ----------------------
+
+
+class ChaosBuildError(RuntimeError):
+    """Raised by a chaos-failed engine build (the injected analogue of
+    an XLA compile OOM / backend init failure at tenant join)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeNaNStormRule:
+    """Persistently poison one tenant's submissions: every matching
+    ``submit`` inside the window carries an all-NaN parameter tree —
+    the bad-sensor-feed tenant the health ladder must evict. The fused
+    quarantine keeps the lane's decoded trajectories finite, so the
+    ONLY eviction signal is the per-lane quarantine attribution
+    (``mode="theta"``); ``mode="result"`` poisons the *decoded* result
+    instead (NaN ``u0`` + ``success=False``) to drive the
+    guard-verdict path."""
+
+    tenant: str = "*"
+    start_round: int = 0
+    n_rounds: Optional[int] = None   # None = open-ended
+    mode: str = "theta"              # theta | result
+
+    def matches(self, tenant_id: str) -> bool:
+        return self.tenant in ("*", tenant_id)
+
+    def triggered(self, round_: int) -> bool:
+        if round_ < self.start_round:
+            return False
+        return self.n_rounds is None or \
+            round_ < self.start_round + self.n_rounds
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeStallRule:
+    """Hang one round's device readback for ``duration_s`` — the wedged
+    TPU-tunnel signature (BENCH_r03) the dispatch watchdog must
+    survive. ``call`` indexes the dispatcher's materialize calls."""
+
+    call: int = 0
+    duration_s: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeBuildFailRule:
+    """Fail the Nth (and following ``n_builds - 1``) cold engine
+    build(s) with :class:`ChaosBuildError`."""
+
+    build: int = 0
+    n_builds: int = 1
+
+    def triggered(self, idx: int) -> bool:
+        return self.build <= idx < self.build + self.n_builds
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeChaosConfig:
+    seed: int = 0
+    nan_storm: tuple = ()
+    stall: tuple = ()
+    build_fail: tuple = ()
+
+    @classmethod
+    def from_dict(cls, cfg: dict) -> "ServeChaosConfig":
+        known = {"seed", "nan_storm", "stall", "build_fail"}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serve-chaos option(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}")
+        return cls(
+            seed=int(cfg.get("seed", 0)),
+            nan_storm=tuple(
+                r if isinstance(r, ServeNaNStormRule)
+                else ServeNaNStormRule(**r)
+                for r in cfg.get("nan_storm", ())),
+            stall=tuple(r if isinstance(r, ServeStallRule)
+                        else ServeStallRule(**r)
+                        for r in cfg.get("stall", ())),
+            build_fail=tuple(
+                r if isinstance(r, ServeBuildFailRule)
+                else ServeBuildFailRule(**r)
+                for r in cfg.get("build_fail", ())),
+        )
+
+
+def _nan_tree(tree):
+    import jax
+
+    return jax.tree.map(
+        lambda leaf: np.full_like(np.asarray(leaf, dtype=float), np.nan),
+        tree)
+
+
+class _SlowMaterialize:
+    """SlotPlane proxy whose materialize hangs first — the sleep runs
+    inside the watchdog's worker thread, so a long stall costs one
+    leaked daemon thread exactly like a real dead device."""
+
+    def __init__(self, slot_plane, duration_s: float):
+        self._plane = slot_plane
+        self._duration_s = float(duration_s)
+
+    def materialize(self, handle):
+        import time as _time
+
+        _time.sleep(self._duration_s)
+        return self._plane.materialize(handle)
+
+
+def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
+                          seed: "int | None" = None) -> ChaosController:
+    """Install the serving-scope injectors on a
+    :class:`~agentlib_mpc_tpu.serving.plane.ServingPlane`. Three seams:
+    ``submit`` (NaN storms, windowed by served round), the dispatcher's
+    materialize (stalls + result-mode poison) and the compile cache's
+    builder (engine-build failures — the resulting
+    :class:`ChaosBuildError` propagates out of ``join``, never out of
+    ``serve_round``). Returns a :class:`ChaosController`;
+    ``uninstall()`` restores every seam."""
+    if not isinstance(config, ServeChaosConfig):
+        config = ServeChaosConfig.from_dict(config)
+    if seed is not None:
+        config = dataclasses.replace(config, seed=int(seed))
+    controller = ChaosController(
+        ChaosConfig(seed=config.seed))
+    counters = {"materialize": 0, "build": 0, "round": 0}
+
+    if config.nan_storm:
+        orig_submit = plane.submit
+        orig_serve = plane.serve_round
+
+        def serve_round(*a, **kw):
+            out = orig_serve(*a, **kw)
+            counters["round"] += 1
+            return out
+
+        def submit(tenant_id, theta=None, **kw):
+            r = counters["round"]
+            rule = next((x for x in config.nan_storm
+                         if x.matches(tenant_id) and x.triggered(r)
+                         and x.mode == "theta"), None)
+            if rule is not None:
+                controller.note("serve_nan_theta",
+                                f"{tenant_id}:round{r}")
+                base = theta if theta is not None \
+                    else plane._specs[tenant_id].theta
+                theta = _nan_tree(base)
+            return orig_submit(tenant_id, theta, **kw)
+
+        plane.submit = submit
+        plane.serve_round = serve_round
+        controller._restores.append(
+            lambda: (setattr(plane, "submit", orig_submit),
+                     setattr(plane, "serve_round", orig_serve)))
+
+    result_storms = tuple(r for r in config.nan_storm
+                          if r.mode == "result")
+    if config.stall or result_storms:
+        dispatcher = plane.dispatcher
+        orig_mat = dispatcher._materialize
+
+        def materialize(slot_plane, handle, label=""):
+            idx = counters["materialize"]
+            counters["materialize"] += 1
+            stall = next((x for x in config.stall if x.call == idx),
+                         None)
+            if stall is not None:
+                controller.note("serve_stall", f"call{idx}")
+                slot_plane = _SlowMaterialize(slot_plane,
+                                              stall.duration_s)
+            out = orig_mat(slot_plane, handle, label)
+            if isinstance(out, dict) and result_storms:
+                r = counters["round"]
+                for tenant_id, res in out.items():
+                    rule = next(
+                        (x for x in result_storms
+                         if x.matches(tenant_id) and x.triggered(r)),
+                        None)
+                    if rule is None:
+                        continue
+                    controller.note("serve_nan_result",
+                                    f"{tenant_id}:call{idx}")
+                    res = dict(res)
+                    stats = dict(res.get("stats") or {})
+                    stats["success"] = False
+                    stats["chaos"] = "nan"
+                    res["stats"] = stats
+                    res["u0"] = {n: float("nan")
+                                 for n in res.get("u0", {})}
+                    out[tenant_id] = res
+            return out
+
+        dispatcher._materialize = materialize
+        controller._restores.append(
+            lambda d=dispatcher, o=orig_mat: setattr(
+                d, "_materialize", o))
+
+    if config.build_fail:
+        cache = plane.cache
+        orig_gob = cache.get_or_build
+
+        def get_or_build(key, builder, label=""):
+            def chaotic_builder():
+                idx = counters["build"]
+                counters["build"] += 1
+                rule = next((x for x in config.build_fail
+                             if x.triggered(idx)), None)
+                if rule is not None:
+                    controller.note("serve_build_fail",
+                                    f"build{idx}:{label}")
+                    raise ChaosBuildError(
+                        f"chaos: engine build {idx} for bucket "
+                        f"{label or '?'} failed")
+                return builder()
+            return orig_gob(key, chaotic_builder, label)
+
+        cache.get_or_build = get_or_build
+        controller._restores.append(
+            lambda c=cache, o=orig_gob: setattr(c, "get_or_build", o))
+
+    return controller
+
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> list:
+    """Damage a checkpoint directory — the crash-during-save / bit-rot
+    fault the restore path must REJECT loudly instead of splicing
+    garbage state into live engines. ``truncate`` halves every
+    data-bearing file (orbax's ocdbt layout keeps redundant per-process
+    copies, so damaging one file is silently absorbed — the fault model
+    is a torn filesystem, not a single flipped block);
+    ``drop-manifest`` removes the completeness marker (``manifest.json``
+    for plane checkpoints, orbax's ``_CHECKPOINT_METADATA`` otherwise).
+    Returns the damaged paths."""
+    import os
+
+    if mode == "drop-manifest":
+        for marker in ("manifest.json", "_CHECKPOINT_METADATA"):
+            victim = os.path.join(path, marker)
+            if os.path.isfile(victim):
+                os.remove(victim)
+                return [victim]
+        raise FileNotFoundError(
+            f"no completeness marker under {path}")
+    if mode != "truncate":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    victims = []
+    for root, _dirs, files in os.walk(path):
+        # ocdbt data blocks live under .../d/; everything else is
+        # metadata whose loss orbax reports differently
+        if os.path.basename(root) != "d":
+            continue
+        for f in files:
+            full = os.path.join(root, f)
+            size = os.path.getsize(full)
+            if size > 1:
+                with open(full, "r+b") as fh:
+                    fh.truncate(size // 2)
+                victims.append(full)
+    if not victims:
+        raise FileNotFoundError(f"nothing to corrupt under {path}")
+    logger.warning("chaos: truncated %d data files under %s",
+                   len(victims), path)
+    return victims
+
+
 # -- serving-plane tenant churn (the --serve benchmark's load model) ----------
 
 def churn_schedule(seed: int, n_tenants: int, rounds: int,

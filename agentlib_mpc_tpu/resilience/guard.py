@@ -291,6 +291,42 @@ class ActuationGuard:
                     "solve", action="hold")
         return dict(self._last_controls)
 
+    # -- checkpoint seam ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able ladder state for durable checkpoints (the serving
+        plane persists each tenant's guard so a crash/restart does not
+        reset degradation budgets or the recovery hysteresis)."""
+        return {
+            "level": int(self.level),
+            "unhealthy_streak": int(self._unhealthy_streak),
+            "healthy_streak": int(self._healthy_streak),
+            "last_controls": (None if self._last_controls is None
+                              else dict(self._last_controls)),
+            "plan": (None if self._plan is None
+                     else {n: [float(x) for x in v]
+                           for n, v in self._plan.items()}),
+            "plan_columns": self.plan_columns,
+            "binary_plan_columns": self.binary_plan_columns,
+        }
+
+    def restore(self, snap: dict) -> None:
+        """Inverse of :meth:`snapshot` (tolerates missing keys so older
+        checkpoints restore with defaults)."""
+        snap = snap or {}
+        self.level = int(snap.get("level", LEVEL_MPC))
+        self._unhealthy_streak = int(snap.get("unhealthy_streak", 0))
+        self._healthy_streak = int(snap.get("healthy_streak", 0))
+        last = snap.get("last_controls")
+        self._last_controls = None if last is None else \
+            {n: float(v) for n, v in last.items()}
+        plan = snap.get("plan")
+        self._plan = None if not plan else \
+            {n: np.asarray(v, dtype=float) for n, v in plan.items()}
+        self.plan_columns = snap.get("plan_columns")
+        self.binary_plan_columns = snap.get("binary_plan_columns")
+        self._export_level()
+
     # -- plan memory ----------------------------------------------------------
 
     def _store_plan(self, result: dict) -> None:

@@ -25,9 +25,17 @@ population over the same fused data plane:
   round k+1 is enqueued before round k's ``u0`` rows transfer back.
 * :mod:`.plane` — :class:`ServingPlane`, the front door tying the
   pieces together (``join`` / ``leave`` / ``submit`` / ``serve_round``).
+* :mod:`.health` — :class:`HealthLedger`: the per-tenant
+  quarantine → probation → evict ladder that keeps one sick tenant from
+  degrading its bucket's batch indefinitely.
+* :mod:`.checkpoint` — durable plane snapshots; crash recovery restores
+  buckets through the compile cache (cached-join splices, measured as
+  MTTR), never a cold rebuild against a warm cache.
 
-Benchmark: ``python bench.py --serve SEED [n]`` measures sustained
-solves/sec and p50/p99 round latency under seeded tenant churn. Docs:
+Benchmarks: ``python bench.py --serve SEED [n]`` measures sustained
+solves/sec and p50/p99 round latency under seeded tenant churn;
+``python bench.py --chaos-serve SEED [n]`` measures availability, shed
+rate and crash-restart MTTR under a seeded fault schedule. Docs:
 ``docs/serving.md``.
 """
 
@@ -38,10 +46,20 @@ from agentlib_mpc_tpu.serving.admission import (  # noqa: F401
     SolveRequest,
 )
 from agentlib_mpc_tpu.serving.cache import CompileCache  # noqa: F401
+from agentlib_mpc_tpu.serving.checkpoint import (  # noqa: F401
+    RestoreReport,
+    has_plane_checkpoint,
+    restore_plane,
+    save_plane,
+)
 from agentlib_mpc_tpu.serving.fingerprint import (  # noqa: F401
     TenantSpec,
     bucket_key,
     tenant_fingerprint,
+)
+from agentlib_mpc_tpu.serving.health import (  # noqa: F401
+    HealthLedger,
+    HealthPolicy,
 )
 from agentlib_mpc_tpu.serving.plane import (  # noqa: F401
     JoinReceipt,

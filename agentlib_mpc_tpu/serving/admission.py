@@ -77,6 +77,17 @@ class AdmissionQueue:
         self._pending[request.tenant_id] = request
         return True
 
+    def snapshot(self, now: float) -> list:
+        """JSON-able view of the pending queue — the plane checkpoint's
+        queue carryover. Parameter payloads are NOT persisted (the
+        coalescing contract: the next submission supersedes; a restored
+        request re-solves on its lane's last spliced parameters), only
+        identity, deadline and the age already accrued."""
+        return [{"tenant_id": r.tenant_id,
+                 "deadline_s": r.deadline_s,
+                 "elapsed_s": max(0.0, now - r.submitted_at)}
+                for r in self._pending.values()]
+
     def drain(self, now: float) -> "tuple[list, list]":
         """Empty the queue: ``(ready, expired)``. Expired requests are
         counted and handed back so the plane can walk the tenant's

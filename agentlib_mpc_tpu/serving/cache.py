@@ -10,8 +10,9 @@ an eviction — reuses the warm executable, and the join is a dictionary
 lookup plus a slot splice.
 
 Counters: ``serving_compile_cache_hits_total`` /
-``serving_compile_cache_misses_total`` (labelled by bucket digest), and
-a ``serving_join_build_seconds`` histogram labelled ``cached="yes"/"no"``
+``serving_compile_cache_misses_total`` (labelled by bucket digest),
+``serving_cache_evictions_total`` when an ``max_engines`` bound is set,
+and a ``serving_join_build_seconds`` histogram labelled ``cached="yes"/"no"``
 so the cached-vs-cold join-latency A/B is always measured in
 production, not just in the bench.
 """
@@ -19,6 +20,7 @@ production, not just in the bench.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 from agentlib_mpc_tpu import telemetry
 
@@ -26,17 +28,28 @@ from agentlib_mpc_tpu import telemetry
 class CompileCache:
     """Maps hashable engine keys to built (and warmed) engine objects.
 
-    The cache never evicts: an engine is a compiled executable plus
-    static metadata, exactly the artifact worth keeping for the life of
-    the process (the persistent XLA cache plays the cross-process
-    role). ``get_or_build(key, builder)`` returns
-    ``(engine, hit, latency_s)``.
+    An engine is a compiled executable plus static metadata — the
+    artifact worth keeping for the life of the process (the persistent
+    XLA cache plays the cross-process role), so by default the cache
+    never evicts. A long-lived multi-structure plane can bound it with
+    ``max_engines``: least-recently-USED entries (hits refresh recency)
+    are dropped once the bound is exceeded, counted in
+    ``serving_cache_evictions_total{bucket=}`` — a rejoin of an evicted
+    structure is then a measured cache MISS (cold rebuild). Engines
+    serving a LIVE bucket are referenced by the bucket itself, so LRU
+    eviction only ever costs retired structures their warm rejoin.
+    ``get_or_build(key, builder)`` returns ``(engine, hit, latency_s)``.
     """
 
-    def __init__(self):
-        self._entries: dict = {}
+    def __init__(self, max_engines: "int | None" = None):
+        if max_engines is not None and int(max_engines) < 1:
+            raise ValueError(f"max_engines must be >= 1 or None, "
+                             f"got {max_engines}")
+        self.max_engines = None if max_engines is None else int(max_engines)
+        self._entries: "OrderedDict" = OrderedDict()  # key -> (engine, label)
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -56,15 +69,29 @@ class CompileCache:
                 "serving engine cache lookups that reused a compiled "
                 "engine").inc(bucket=label or "?")
 
+    def _evict_over_bound(self) -> None:
+        while self.max_engines is not None and \
+                len(self._entries) > self.max_engines:
+            _key, (_engine, label) = self._entries.popitem(last=False)
+            self.evictions += 1
+            if telemetry.enabled():
+                telemetry.counter(
+                    "serving_cache_evictions_total",
+                    "compiled serving engines dropped by the LRU bound "
+                    "(max_engines)").inc(bucket=label or "?")
+
     def get_or_build(self, key, builder, label: str = ""):
         t0 = time.perf_counter()
-        engine = self._entries.get(key)
-        hit = engine is not None
+        entry = self._entries.get(key)
+        hit = entry is not None
         if not hit:
             engine = builder()
-            self._entries[key] = engine
+            self._entries[key] = (engine, label)
             self.misses += 1
+            self._evict_over_bound()
         else:
+            engine = entry[0]
+            self._entries.move_to_end(key)       # LRU: a hit is a use
             self.hits += 1
         latency = time.perf_counter() - t0
         if telemetry.enabled():

@@ -1,0 +1,359 @@
+"""Durable :class:`ServingPlane` snapshots: crash recovery as cache splices.
+
+The serving plane used to be entirely in-memory: a process crash lost
+every tenant registration, warm-start iterate, guard ladder position
+and queued request — recovery meant every tenant re-joining cold
+(seconds to tens of seconds of certify + trace + compile each) with
+cold-start iteration counts on top. This module makes the plane
+durable the same way PR 2 made single backends durable
+(``utils/checkpoint.py``), with one crucial difference in the restore
+path: **engines are never stored**. A checkpoint holds only what XLA
+cannot recompute — tenant identity, slot occupancy, warm-start state,
+guard/health ladders, queue carryover — and the restore reconstructs
+every bucket THROUGH the :class:`~agentlib_mpc_tpu.serving.cache.
+CompileCache`/fingerprint path. Against a warm cache (a supervisor
+restart sharing the process cache, or the persistent XLA cache across
+processes) recovery is therefore a cached-join splice per tenant
+(~ms), not a cold compile — the crash-restart MTTR
+``bench.py --chaos-serve`` measures.
+
+On-disk layout (all under one checkpoint directory)::
+
+    <path>/
+      arrays/          # orbax pytree: per-bucket FusedState + theta + mask
+      manifest.json    # everything else; written LAST = completeness marker
+
+Saves are crash-safe with the same temp-dir + rename-swap discipline as
+:func:`utils.checkpoint.save_pytree` (a kill mid-save leaves the
+previous checkpoint recoverable at a ``.old-*`` sibling; a save killed
+during the write leaves a manifest-less temp dir that
+:func:`has_plane_checkpoint` rejects). Restore refuses structural
+drift: a tenant whose spec no longer fingerprints into its recorded
+bucket fails loudly instead of splicing state into the wrong engine.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.utils.checkpoint import (
+    _stale_siblings,
+    load_pytree,
+    save_pytree,
+)
+
+logger = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+ARRAYS = "arrays"
+VERSION = 1
+
+
+class RestoreReport(NamedTuple):
+    """What a crash recovery cost — the MTTR evidence."""
+
+    tenants: tuple            # restored tenant ids, plane order
+    buckets: int
+    #: engines that had to be BUILT during restore (certify + trace +
+    #: compile). 0 against a warm cache — the acceptance bar
+    cold_builds: int
+    #: compile-cache engine reuses during restore (one per tenant)
+    cache_hits: int
+    #: queued requests re-enqueued from the checkpoint's carryover
+    requeued: int
+    #: per-tenant restore wall seconds (engine acquisition for the
+    #: bucket seed, splice bookkeeping for the rest)
+    per_tenant_s: dict
+    #: whole-restore wall seconds: the measured crash-restart MTTR
+    total_s: float
+
+
+def _placeholder_empties(tree):
+    """Zero-size leaves (a problem with no equality constraints has a
+    (n, 0) dual block; a stateless tracker an empty ``x0``) crash
+    orbax's ocdbt writer ("params are missing in checkpoint"). They
+    carry no data, so swap each for a 1-element sentinel of the same
+    dtype on the way out and resynthesize the empty from the template
+    on the way back (:func:`_restore_empties`)."""
+    import jax
+
+    def leaf_out(leaf):
+        arr = jnp.asarray(leaf)
+        return jnp.zeros((1,), arr.dtype) if arr.size == 0 else arr
+
+    return jax.tree.map(leaf_out, tree)
+
+
+def _restore_empties(template, restored):
+    import jax
+
+    def leaf_back(t, r):
+        t = jnp.asarray(t)
+        return jnp.zeros(t.shape, t.dtype) if t.size == 0 else r
+
+    return jax.tree.map(leaf_back, template, restored)
+
+
+def _checkpoint_dir(path: str) -> "str | None":
+    """The directory to restore from: the primary when complete, else
+    the newest complete crash-recovery sibling. None when nothing with
+    a manifest exists."""
+    if os.path.isfile(os.path.join(path, MANIFEST)):
+        return path
+    for candidate in reversed(_stale_siblings(path)):
+        if os.path.isfile(os.path.join(candidate, MANIFEST)):
+            return candidate
+    return None
+
+
+def has_plane_checkpoint(path: str) -> bool:
+    """True when :func:`restore_plane` has something COMPLETE to try:
+    the manifest is written after the array payload, so a save killed
+    mid-write leaves a directory this rejects (the fresh-deployment /
+    crashed-first-save guard)."""
+    return _checkpoint_dir(os.path.abspath(path)) is not None
+
+
+def save_plane(plane, path: str) -> str:
+    """Snapshot a :class:`~agentlib_mpc_tpu.serving.plane.ServingPlane`
+    to ``path`` (a directory), crash-safely. What is captured: per
+    bucket the slot occupancy, warm-start :class:`FusedState`, theta
+    batch and mask; per tenant the guard-ladder and health-ledger
+    positions; the pending admission queue (identity + deadline + age —
+    parameter payloads re-solve on the lane's last splice). In-flight
+    pipelined rounds are NOT drained: the engine state already threaded
+    past them at launch, and their undelivered results die with the
+    process exactly like any crash-window output (the next round's
+    solve supersedes — MPC coalescing semantics).
+
+    Returns the absolute path."""
+    path = os.path.abspath(path)
+    now = time.monotonic()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    buckets, arrays = [], []
+    for key, bucket in plane._buckets.items():
+        if not bucket.tenants:
+            # every member is health-evicted (or the bucket is idle):
+            # its lanes are padding and stale evicted iterates — a
+            # re-admission splices a FRESH warm start anyway, so there
+            # is nothing worth persisting, and the restore (which seeds
+            # each bucket template from a slotted tenant) skips it too
+            continue
+        buckets.append({
+            "digest": key.digest,
+            "capacity": int(bucket.capacity),
+            "slots": list(bucket.slots),
+            "rounds_served": int(bucket.rounds_served),
+        })
+        arrays.append({
+            "state": bucket.state,
+            "theta": bucket.theta_batch,
+            "mask": jnp.asarray(bucket.mask),
+        })
+    manifest = {
+        "version": VERSION,
+        "rounds": int(plane.rounds),
+        "buckets": buckets,
+        "evicted": {tid: key.digest
+                    for tid, key in plane._evicted.items()},
+        "guards": {tid: guard.snapshot()
+                   for tid, guard in plane._guards.items()},
+        "health": (plane._health.snapshot()
+                   if plane._health is not None else None),
+        "queue": plane.queue.snapshot(now),
+    }
+    if arrays:
+        save_pytree(os.path.join(tmp, ARRAYS), _placeholder_empties(arrays))
+    # manifest LAST: its presence is the completeness marker
+    with open(os.path.join(tmp, MANIFEST), "w") as fh:
+        json.dump(manifest, fh)
+
+    if os.path.isdir(path):
+        old = f"{path}.old-{os.getpid()}"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.rename(path, old)
+        os.rename(tmp, path)
+    else:
+        os.rename(tmp, path)
+    for stale in _stale_siblings(path):
+        shutil.rmtree(stale, ignore_errors=True)
+    logger.info("serving plane checkpointed to %s (%d tenants, %d "
+                "buckets, %d queued)", path,
+                len(plane._tenant_bucket), len(buckets),
+                len(manifest["queue"]))
+    return path
+
+
+def restore_plane(plane, path: str, specs) -> RestoreReport:
+    """Restore a checkpointed plane into ``plane`` (which must be
+    empty). ``specs`` supplies the tenants' problem definitions — a
+    dict ``tenant_id -> TenantSpec`` or an iterable of specs; specs
+    hold live OCP objects, which no checkpoint can durably serialize
+    (the caller rebuilds them from config, exactly like every other
+    template-based restore in this repo).
+
+    Buckets are reconstructed through the fingerprint/compile-cache
+    path: against a warm cache every engine acquisition is a hit and
+    the restore cost is slot splices + one pytree load. A tenant whose
+    spec fingerprints into a DIFFERENT bucket than the checkpoint
+    recorded (config drift since the save) fails with ``ValueError``
+    before any state is spliced."""
+    from agentlib_mpc_tpu.serving.admission import SolveRequest
+    from agentlib_mpc_tpu.serving.fingerprint import bucket_key
+    from agentlib_mpc_tpu.serving.health import EVICTED
+
+    t0 = time.perf_counter()
+    path = os.path.abspath(path)
+    src = _checkpoint_dir(path)
+    if src is None:
+        if os.path.isdir(path) or _stale_siblings(path):
+            raise RuntimeError(
+                f"checkpoint at {path} exists but no complete manifest "
+                f"was found (save killed mid-write?) — refusing to "
+                f"restore a half-written plane")
+        raise FileNotFoundError(f"no plane checkpoint at {path}")
+    if plane._tenant_bucket or plane._buckets:
+        raise ValueError("restore_plane needs an EMPTY plane; this one "
+                         f"has {len(plane._tenant_bucket)} tenants")
+    with open(os.path.join(src, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    if int(manifest.get("version", -1)) != VERSION:
+        raise ValueError(
+            f"plane checkpoint version {manifest.get('version')} is not "
+            f"supported (expected {VERSION})")
+
+    if not isinstance(specs, dict):
+        specs = {s.tenant_id: s for s in specs}
+    hits0, misses0 = plane.cache.hits, plane.cache.misses
+    per_tenant_s: dict = {}
+    templates, restored_buckets = [], []
+    for entry in manifest["buckets"]:
+        tenants = [t for t in entry["slots"] if t is not None]
+        if not tenants:
+            # save_plane skips tenant-less buckets; tolerate one in a
+            # hand-edited/older manifest (nothing to seed an engine
+            # from, nothing worth restoring — evicted members rejoin
+            # with fresh warm starts through the cache)
+            continue
+        seed_spec = specs.get(tenants[0])
+        if seed_spec is None:
+            raise KeyError(
+                f"checkpoint names tenant {tenants[0]!r} but specs has "
+                f"no entry for it")
+        key = bucket_key(seed_spec)
+        if key.digest != entry["digest"]:
+            raise ValueError(
+                f"tenant {tenants[0]!r} fingerprints into bucket "
+                f"{key.digest}, but the checkpoint recorded "
+                f"{entry['digest']} — the spec's structure changed "
+                f"since the save; restore into matching config")
+        t_seed = time.perf_counter()
+        bucket, _hit = plane._acquire_bucket(
+            key, seed_spec, n_needed=1, capacity=entry["capacity"])
+        per_tenant_s[tenants[0]] = time.perf_counter() - t_seed
+        for tid in tenants:
+            t_t = time.perf_counter()
+            spec = specs.get(tid)
+            if spec is None:
+                raise KeyError(f"checkpoint names tenant {tid!r} but "
+                               f"specs has no entry for it")
+            if tid != tenants[0]:
+                if bucket_key(spec).digest != entry["digest"]:
+                    raise ValueError(
+                        f"tenant {tid!r} no longer fingerprints into "
+                        f"its recorded bucket {entry['digest']}")
+                plane.cache.note_hit(label=entry["digest"])
+                per_tenant_s[tid] = time.perf_counter() - t_t
+            plane._register_tenant(tid, key, spec)
+        bucket.restore_occupancy(entry["slots"])
+        bucket.rounds_served = int(entry["rounds_served"])
+        templates.append({"state": bucket.state,
+                          "theta": bucket.theta_batch,
+                          "mask": jnp.asarray(bucket.mask)})
+        restored_buckets.append((key, bucket, entry))
+
+    if restored_buckets:
+        restored = load_pytree(os.path.join(src, ARRAYS),
+                               _placeholder_empties(templates))
+        restored = _restore_empties(templates, restored)
+        for (key, bucket, entry), data in zip(restored_buckets, restored):
+            saved_mask = np.asarray(data["mask"])
+            if not np.array_equal(saved_mask, bucket.mask):
+                raise ValueError(
+                    f"bucket {entry['digest']}: restored mask does not "
+                    f"match the manifest occupancy — checkpoint is "
+                    f"internally inconsistent")
+            bucket.state = data["state"]
+            bucket.theta_batch = data["theta"]
+
+    # evicted tenants: registered (spec + guard + ladder position) but
+    # occupying no slot; their re-admission clock resumes where it was
+    for tid, digest in (manifest.get("evicted") or {}).items():
+        spec = specs.get(tid)
+        if spec is None:
+            raise KeyError(f"checkpoint names evicted tenant {tid!r} "
+                           f"but specs has no entry for it")
+        key = bucket_key(spec)
+        if key.digest != digest:
+            raise ValueError(
+                f"evicted tenant {tid!r} no longer fingerprints into "
+                f"its recorded bucket {digest}")
+        if tid not in plane._tenant_bucket:
+            plane._register_tenant(tid, key, spec)
+        plane._evicted[tid] = key
+
+    for tid, snap in (manifest.get("guards") or {}).items():
+        guard = plane._guards.get(tid)
+        if guard is not None:
+            guard.restore(snap)
+    if plane._health is not None and manifest.get("health"):
+        plane._health.restore(manifest["health"])
+        # drift guard: a tenant the ledger says is evicted must be in
+        # the evicted set (older checkpoints could disagree)
+        for tid in plane.tenants:
+            if plane._health.state(tid) == EVICTED \
+                    and tid not in plane._evicted:
+                plane._evicted[tid] = plane._tenant_bucket[tid]
+
+    now = time.monotonic()
+    requeued = 0
+    for entry in manifest.get("queue") or []:
+        if entry["tenant_id"] not in plane._tenant_bucket:
+            continue
+        if plane.queue.submit(SolveRequest(
+                tenant_id=entry["tenant_id"], theta=None,
+                submitted_at=now - float(entry.get("elapsed_s") or 0.0),
+                deadline_s=entry.get("deadline_s"))):
+            requeued += 1
+    plane.rounds = int(manifest.get("rounds") or 0)
+    plane._export_active()
+
+    cold = plane.cache.misses - misses0
+    report = RestoreReport(
+        tenants=plane.tenants,
+        buckets=len(restored_buckets),
+        cold_builds=cold,
+        cache_hits=plane.cache.hits - hits0,
+        requeued=requeued,
+        per_tenant_s=per_tenant_s,
+        total_s=time.perf_counter() - t0,
+    )
+    logger.info(
+        "serving plane restored from %s: %d tenants / %d buckets in "
+        "%.1f ms (%d cold builds, %d cache hits, %d requeued)", src,
+        len(report.tenants), report.buckets, 1e3 * report.total_s,
+        report.cold_builds, report.cache_hits, requeued)
+    return report

@@ -20,42 +20,226 @@ The price is one round of result latency: ``dispatch()`` returns the
 PREVIOUS round's results. An MPC control loop absorbs this naturally
 when the round period exceeds the compute time; latency-critical
 tenants can run a sync plane instead (``ServingPlane(pipelined=False)``).
+
+**Watchdog.** A hung in-flight round — exactly how the TPU tunnel died
+at BENCH_r03: the device never answers and ``block_until_ready`` blocks
+forever — used to wedge the dispatcher with no recovery path. With
+``timeout_s`` set, every materialize runs under a bounded wait; on
+timeout the round is marked FAILED (its tenants get
+``success=False`` results and walk their guard ladders — no exception
+escapes ``serve_round``), the dispatcher permanently falls back to the
+synchronous loop (no second round is ever put behind a stalled one),
+and a bounded device re-probe (the ``bench.py
+_probe_platform_bounded`` pattern) records whether the backend still
+answers. The thread blocked on the dead transfer cannot be cancelled —
+it is leaked as a daemon and costs one idle thread until the device
+returns or the process exits (the documented price of surviving).
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+
+from agentlib_mpc_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+#: bound on the post-stall diagnostic device probe: the probe only
+#: feeds ``last_probe`` and a counter, so it must not double the
+#: stall's blocking time by inheriting the full watchdog budget
+PROBE_TIMEOUT_S = 2.0
+
+
+def probe_device_bounded(timeout_s: float = 5.0) -> "str | None":
+    """Ask the default backend for a trivial round-trip under a bounded
+    wait (the in-process sibling of bench.py's ``_probe_platform_bounded``
+    subprocess probe). Returns the platform name, or None when the
+    device did not answer within ``timeout_s`` — the wedged-tunnel
+    signature."""
+    result: list = []
+
+    def probe() -> None:
+        import jax
+        import jax.numpy as jnp
+
+        jnp.zeros((1,)).block_until_ready()
+        result.append(jax.default_backend())
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="serving-device-probe")
+    t.start()
+    t.join(timeout_s)
+    return result[0] if result else None
+
+
+class RoundTimeout:
+    """Marker for a watchdogged round that never materialized: the
+    affected tenants (the handle's launch-time membership snapshot) and
+    nothing else — the plane turns each into a failed solve result."""
+
+    def __init__(self, served: tuple):
+        self.served = tuple(served)
+
 
 class PipelinedDispatcher:
     """Per-bucket depth-1 pipeline over
-    :class:`~agentlib_mpc_tpu.serving.slots.SlotPlane` rounds."""
+    :class:`~agentlib_mpc_tpu.serving.slots.SlotPlane` rounds, with an
+    optional watchdog (``timeout_s``) on every materialize."""
 
-    def __init__(self, pipelined: bool = True):
+    def __init__(self, pipelined: bool = True,
+                 timeout_s: "float | None" = None):
         self.pipelined = bool(pipelined)
+        self.timeout_s = None if timeout_s is None else float(timeout_s)
         self._inflight: dict = {}
+        #: rounds condemned by a stall in ANOTHER bucket (drained via
+        #: :meth:`drain_failed` — never materialized: the device is
+        #: suspect and each wait would cost a full timeout)
+        self._failed: dict = {}
+        #: rounds the watchdog declared dead
+        self.stalls = 0
+        #: True once a stall forced the permanent sync fallback
+        self.sync_fallback = False
+        #: platform name of the post-stall re-probe (None = no answer)
+        self.last_probe: "str | None" = None
 
-    def dispatch(self, key, slot_plane) -> "dict | None":
+    # -- bounded materialize --------------------------------------------------
+
+    def _materialize(self, slot_plane, handle, label: str = ""):
+        """Materialize one round, bounded by the watchdog when armed.
+        Returns the decoded results dict, or a :class:`RoundTimeout`
+        when the device never answered."""
+        if self.timeout_s is None:
+            return slot_plane.materialize(handle)
+        # a plain DAEMON thread, not a ThreadPoolExecutor: executor
+        # workers are non-daemon and the interpreter JOINS them at
+        # exit, so a truly wedged transfer would hang process shutdown
+        # — the exact failure the watchdog exists to survive
+        box: list = []
+
+        def read() -> None:
+            try:
+                box.append(("ok", slot_plane.materialize(handle)))
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                box.append(("err", exc))
+
+        t = threading.Thread(target=read, daemon=True,
+                             name="serving-materialize")
+        t.start()
+        t.join(self.timeout_s)
+        if not box:
+            return self._stall(label)
+        kind, value = box[0]
+        if kind == "err":
+            # a decode error is not a stall: let the caller see it
+            raise value
+        return value
+
+    def _stall(self, label: str) -> RoundTimeout:
+        self.stalls += 1
+        self.sync_fallback = True
+        was_pipelined = self.pipelined
+        self.pipelined = False
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_watchdog_stalls_total",
+                "in-flight rounds declared dead by the dispatch "
+                "watchdog").inc(bucket=label or "?")
+        # bounded re-probe: is the backend gone, or was it one round?
+        # Capped well below the watchdog budget — it is diagnostic
+        # only and must not double the round's blocking time.
+        self.last_probe = probe_device_bounded(
+            min(self.timeout_s, PROBE_TIMEOUT_S))
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_watchdog_probes_total",
+                "post-stall bounded device probes, by outcome").inc(
+                result=self.last_probe or "dead")
+        logger.error(
+            "serving round stalled past the %.1fs watchdog (bucket %s); "
+            "shedding its tenants, %sfalling back to sync dispatch "
+            "(device re-probe: %s)", self.timeout_s, label or "?",
+            "" if was_pipelined else "already sync — ",
+            self.last_probe or "no answer")
+        return RoundTimeout(served=())
+
+    # -- dispatch -------------------------------------------------------------
+
+    def dispatch(self, key, slot_plane) -> "dict | RoundTimeout | None":
         """Enqueue one round for ``slot_plane``. Synchronous mode
         returns this round's decoded results; pipelined mode returns the
-        previous round's (None on the bucket's first round)."""
+        previous round's (None on the bucket's first round). Either may
+        be a :class:`RoundTimeout` when the watchdog fired."""
+        label = getattr(key, "digest", None) or str(key)
         if not self.pipelined:
-            return slot_plane.materialize(slot_plane.launch_round())
+            handle = slot_plane.launch_round()
+            res = self._materialize(slot_plane, handle, label)
+            if isinstance(res, RoundTimeout):
+                res.served = handle.served
+            return res
         handle = slot_plane.launch_round()       # k+1 in flight ...
         prev = self._inflight.get(key)
         self._inflight[key] = (slot_plane, handle)
         if prev is None:
             return None
         prev_plane, prev_handle = prev
-        return prev_plane.materialize(prev_handle)   # ... while k reads back
+        res = self._materialize(prev_plane, prev_handle, label)
+        if isinstance(res, RoundTimeout):
+            res.served = prev_handle.served
+            # the stall flipped us sync: the round enqueued above would
+            # otherwise sit in flight forever behind a dead device —
+            # drop it and shed ITS tenants too (they re-submit next
+            # period; a bounded loss, never a wedge)
+            dead = self._inflight.pop(key, None)
+            if dead is not None:
+                res.served = tuple(dict.fromkeys(
+                    (*res.served, *dead[1].served)))
+            # ... and OTHER buckets' in-flight rounds must not strand
+            # either: never delivered by the (now sync) dispatch path,
+            # they would surface as stale out-of-order results at the
+            # next flush. Condemn them now; drain_failed sheds them.
+            for k2, (_plane2, handle2) in self._inflight.items():
+                self._failed[k2] = RoundTimeout(served=handle2.served)
+            self._inflight.clear()
+        return res
+
+    def drain_failed(self) -> dict:
+        """Rounds condemned by a stall elsewhere: ``{key:
+        RoundTimeout}``, each to be assessed as a failed round (tenants
+        shed into their ladders). Empties the set."""
+        out, self._failed = self._failed, {}
+        return out
 
     def flush(self, key=None) -> dict:
         """Materialize in-flight rounds (one bucket, or all): the
         drain-the-pipeline call for shutdown and for callers that need
-        results-to-date. Returns ``{key: results}``."""
+        results-to-date. Returns ``{key: results}`` where a watchdogged
+        (or stall-condemned) bucket's value is a :class:`RoundTimeout`.
+        A key with nothing in flight (a retired/unknown bucket) simply
+        yields no entry. Once one bucket stalls inside this drain, the
+        remaining handles are condemned without waiting — each would
+        cost a full timeout against a suspect device."""
         keys = [key] if key is not None else list(self._inflight)
         out = {}
+        stalled = False
         for k in keys:
             entry = self._inflight.pop(k, None)
-            if entry is not None:
-                plane, handle = entry
-                out[k] = plane.materialize(handle)
+            if entry is None:
+                continue
+            plane, handle = entry
+            if stalled:
+                out[k] = RoundTimeout(served=handle.served)
+                continue
+            label = getattr(k, "digest", None) or str(k)
+            res = self._materialize(plane, handle, label)
+            if isinstance(res, RoundTimeout):
+                res.served = handle.served
+                stalled = True
+            out[k] = res
+        if key is None:
+            out.update(self.drain_failed())
+        else:
+            failed = self._failed.pop(key, None)
+            if failed is not None:
+                out[key] = failed
         return out

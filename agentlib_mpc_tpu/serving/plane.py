@@ -24,6 +24,24 @@ Capacity: a full bucket grows to the next
 multiple — a new (cached-by-capacity) engine, with sitting tenants
 migrated; their warm starts reset (documented cost of growth, amortized
 by sizing ``initial_capacity``).
+
+Survivability (the PR 8 layer, ``docs/serving.md`` "Surviving
+failures"):
+
+* ``health_policy=`` arms the per-tenant
+  :class:`~agentlib_mpc_tpu.serving.health.HealthLedger`: a
+  persistently sick tenant (guard-rejected results OR a lane the fused
+  quarantine carries round after round) walks quarantine → eviction
+  (lane masked out; its submissions shed into its guard ladder) →
+  probation re-admission (fresh-warm-start splice, zero retraces).
+* ``watchdog_timeout_s=`` arms the dispatch watchdog: a hung in-flight
+  round times out, its tenants shed into their ladders, and the
+  dispatcher permanently falls back to synchronous dispatch — no
+  exception escapes ``serve_round``.
+* ``save_checkpoint``/``restore_checkpoint`` persist the whole plane
+  (occupancy, warm starts, ladders, queue carryover); restore
+  reconstructs buckets through the compile cache, so crash recovery is
+  cached-join splices, not cold compiles.
 """
 
 from __future__ import annotations
@@ -47,8 +65,9 @@ from agentlib_mpc_tpu.resilience.guard import (
 )
 from agentlib_mpc_tpu.serving.admission import AdmissionQueue, SolveRequest
 from agentlib_mpc_tpu.serving.cache import CompileCache
-from agentlib_mpc_tpu.serving.dispatch import PipelinedDispatcher
+from agentlib_mpc_tpu.serving.dispatch import PipelinedDispatcher, RoundTimeout
 from agentlib_mpc_tpu.serving.fingerprint import TenantSpec, bucket_key
+from agentlib_mpc_tpu.serving.health import HealthLedger, HealthPolicy
 from agentlib_mpc_tpu.serving.slots import SlotPlane, tree_repeat, tree_row
 
 logger = logging.getLogger(__name__)
@@ -91,7 +110,11 @@ class ServingPlane:
                  queue_limit: int = 1024,
                  default_deadline_s: "float | None" = None,
                  guard_policy: DegradationPolicy = DegradationPolicy(),
-                 warm_on_build: bool = True):
+                 warm_on_build: bool = True,
+                 health_policy: "HealthPolicy | None" = None,
+                 watchdog_timeout_s: "float | None" = None,
+                 max_engines: "int | None" = None,
+                 cache: "CompileCache | None" = None):
         if slot_multiple is None:
             from agentlib_mpc_tpu.parallel.multihost import (
                 serving_slot_multiple,
@@ -123,19 +146,42 @@ class ServingPlane:
         self.donate = bool(donate)
         self.warm_on_build = bool(warm_on_build)
         self.guard_policy = guard_policy
-        self.cache = CompileCache()
-        self.dispatcher = PipelinedDispatcher(pipelined)
+        #: pass a shared cache to model a supervisor restart (the
+        #: crash-recovery bench); cross-process the persistent XLA
+        #: cache plays this role
+        self.cache = cache if cache is not None \
+            else CompileCache(max_engines=max_engines)
+        self.dispatcher = PipelinedDispatcher(pipelined,
+                                              timeout_s=watchdog_timeout_s)
         self.queue = AdmissionQueue(queue_limit, default_deadline_s)
+        self._health = None if health_policy is None \
+            else HealthLedger(health_policy)
         self._buckets: dict = {}          # BucketKey -> SlotPlane
         self._tenant_bucket: dict = {}    # tenant_id -> BucketKey
         self._specs: dict = {}            # tenant_id -> TenantSpec
         self._guards: dict = {}           # tenant_id -> ActuationGuard
+        #: health-evicted tenants: registered (spec + guard + ladder)
+        #: but occupying no slot; tenant_id -> BucketKey
+        self._evicted: dict = {}
         #: results decoded outside serve_round (growth/leave flushes),
         #: merged into the next serve_round return
         self._carryover: dict = {}
+        #: tenants whose submission was rejected at the door this round
+        #: (non-finite theta) — consumed into the health ledger at the
+        #: next assessment so a healthy stale-theta lane result cannot
+        #: mask a persistently poisoned feed
+        self._sick_marks: set = set()
         self.rounds = 0
 
     # -- membership -----------------------------------------------------------
+
+    def _register_tenant(self, tenant_id: str, key, spec: TenantSpec,
+                         ) -> None:
+        self._tenant_bucket[tenant_id] = key
+        self._specs[tenant_id] = spec
+        self._guards[tenant_id] = ActuationGuard(
+            self.guard_policy, logger_=logger,
+            tenant=tenant_id, bucket=key.digest)
 
     def join(self, spec: TenantSpec) -> JoinReceipt:
         if spec.tenant_id in self._tenant_bucket:
@@ -156,11 +202,7 @@ class ServingPlane:
             # without even a cache lookup — still a hit in the metric
             self.cache.note_hit(label=key.digest)
         slot = bucket.admit(spec.tenant_id, spec.theta)
-        self._tenant_bucket[spec.tenant_id] = key
-        self._specs[spec.tenant_id] = spec
-        self._guards[spec.tenant_id] = ActuationGuard(
-            self.guard_policy, logger_=logger,
-            tenant=spec.tenant_id, bucket=key.digest)
+        self._register_tenant(spec.tenant_id, key, spec)
         if telemetry.enabled():
             telemetry.serving_metrics()["active"].set(
                 float(bucket.n_active), bucket=key.digest)
@@ -174,27 +216,39 @@ class ServingPlane:
 
     def leave(self, tenant_id: str) -> None:
         key = self._tenant_bucket.pop(tenant_id)
-        bucket = self._buckets[key]
-        bucket.evict(tenant_id)
+        # an evicted tenant holds no slot, and (after a checkpoint
+        # restore) possibly no live bucket either — nothing to evict
+        bucket = self._buckets.get(key)
+        if tenant_id not in self._evicted and bucket is not None:
+            bucket.evict(tenant_id)
+        self._evicted.pop(tenant_id, None)
         self._specs.pop(tenant_id, None)
         self._guards.pop(tenant_id, None)
+        if self._health is not None:
+            self._health.forget(tenant_id)
+        if bucket is None:
+            return
         if telemetry.enabled():
             telemetry.serving_metrics()["active"].set(
                 float(bucket.n_active), bucket=key.digest)
-        if bucket.n_active == 0:
+        if bucket.n_active == 0 and \
+                key not in self._evicted.values():
             # drain the pipeline, then retire the slot plane — the
             # ENGINE stays in the compile cache, so a rejoin is a hit
             self._stash_flush(key)
             del self._buckets[key]
 
     def _acquire_bucket(self, key, spec: TenantSpec, n_needed: int,
-                        migrate_from: "SlotPlane | None" = None):
+                        migrate_from: "SlotPlane | None" = None,
+                        capacity: "int | None" = None):
         """Find-or-build an engine with capacity for ``n_needed`` active
-        tenants (rounded up to the slot multiple); optionally migrate an
-        existing full bucket's tenants into it."""
-        capacity = max(self.initial_capacity,
-                       self.slot_multiple
-                       * math.ceil(n_needed / self.slot_multiple))
+        tenants (rounded up to the slot multiple; an explicit
+        ``capacity`` — the checkpoint-restore path — is taken verbatim);
+        optionally migrate an existing full bucket's tenants into it."""
+        if capacity is None:
+            capacity = max(self.initial_capacity,
+                           self.slot_multiple
+                           * math.ceil(n_needed / self.slot_multiple))
         engine_key = (key, capacity, self._options_key(), self.donate)
 
         def build():
@@ -246,22 +300,132 @@ class ServingPlane:
             else float(rho)
         return opts._replace(rho=rho_key)
 
+    # -- tenant health: evict / readmit ---------------------------------------
+
+    def evict_tenant(self, tenant_id: str, reason: str = "manual") -> None:
+        """Mask a tenant's lane out of its bucket WITHOUT deregistering
+        it: the spec, guard ladder and health row stay, its submissions
+        shed into the ladder, and :meth:`readmit_tenant` (or the health
+        ledger's re-admission window) splices it back fresh. The health
+        ledger calls this on its evict transition; it is public for
+        operator intervention and the ``[serving.health]`` gate."""
+        if tenant_id not in self._tenant_bucket:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        if tenant_id in self._evicted:
+            return
+        key = self._tenant_bucket[tenant_id]
+        bucket = self._buckets[key]
+        bucket.evict(tenant_id)
+        self._evicted[tenant_id] = key
+        if self._health is not None:
+            self._health.force_evict(tenant_id)
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_evictions_total",
+                "tenants masked out of their bucket by the health "
+                "ladder (or operator)").inc(bucket=key.digest,
+                                            reason=reason)
+            telemetry.serving_metrics()["active"].set(
+                float(bucket.n_active), bucket=key.digest)
+        logger.warning("tenant %s evicted from bucket %s (%s); "
+                       "submissions now shed into its guard ladder",
+                       tenant_id, key.digest, reason)
+
+    def readmit_tenant(self, tenant_id: str) -> bool:
+        """Splice an evicted tenant back into its bucket with a FRESH
+        warm start (the recycled-slot contract — a sick iterate must not
+        come back with it). Returns False when its bucket is full (the
+        caller retries later); the engine comes from the live bucket or
+        the compile cache, never a rebuild."""
+        key = self._evicted.get(tenant_id)
+        if key is None:
+            raise KeyError(f"tenant {tenant_id!r} is not evicted")
+        spec = self._specs[tenant_id]
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            # every member was evicted and the last active one left:
+            # the slot plane retired but the ENGINE is cached — this
+            # acquisition is the measured cache-hit rejoin
+            bucket, _hit = self._acquire_bucket(key, spec, n_needed=1)
+        if bucket.free_slots == 0:
+            return False
+        slot = bucket.admit(tenant_id, spec.theta)
+        del self._evicted[tenant_id]
+        if self._health is not None:
+            self._health.readmitted(tenant_id)
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_readmissions_total",
+                "evicted tenants spliced back on probation").inc(
+                bucket=key.digest)
+            telemetry.serving_metrics()["active"].set(
+                float(bucket.n_active), bucket=key.digest)
+        logger.info("tenant %s readmitted to bucket %s slot %d "
+                    "(probation)", tenant_id, key.digest, slot)
+        return True
+
+    def _readmit_due(self) -> None:
+        if self._health is None:
+            return
+        for tenant_id in self._health.tick_evicted():
+            if tenant_id in self._evicted:
+                self.readmit_tenant(tenant_id)
+
     # -- request path ---------------------------------------------------------
+
+    @staticmethod
+    def _theta_valid(theta) -> bool:
+        """NaN-free, not finite: parameter trees legitimately carry
+        ±inf (unbounded state/control bounds ride in theta), so only
+        NaN marks a poisoned feed."""
+        import jax
+        import numpy as np
+
+        try:
+            return not any(
+                bool(np.any(np.isnan(np.asarray(leaf, dtype=float))))
+                for leaf in jax.tree.leaves(theta))
+        except (TypeError, ValueError):
+            return False
 
     def submit(self, tenant_id: str, theta=None,
                deadline_s: "float | None" = None,
                now: "float | None" = None):
         """Enqueue one solve request. Returns None when queued; when the
-        queue sheds it (overload), the tenant's guard ladder is walked
+        queue sheds it (overload, non-finite parameters, or the tenant
+        is health-evicted), the tenant's guard ladder is walked
         immediately and the resulting degraded
-        :class:`~agentlib_mpc_tpu.resilience.guard.GuardDecision` is
-        returned (replay/hold controls, or fallback hand-over)."""
+        :class:`~agentlib_mpc_tpu.resilience.guard.GuardDecision`
+        is returned (replay/hold controls, or fallback hand-over)."""
         if tenant_id not in self._tenant_bucket:
             raise KeyError(f"unknown tenant {tenant_id!r}")
         if deadline_s is None:
             deadline_s = self._specs[tenant_id].deadline_s
         if telemetry.enabled():
             telemetry.serving_metrics()["requests"].inc()
+        if tenant_id in self._evicted:
+            if telemetry.enabled():
+                telemetry.counter(
+                    "serving_shed_total",
+                    "solve requests shed to the degradation ladder"
+                    ).inc(reason="evicted")
+            return self._shed(tenant_id, "shed_evicted")
+        if theta is not None and not self._theta_valid(theta):
+            # validate at the door: a NaN/Inf parameter tree must never
+            # reach a lane splice — quarantine would carry the lane, but
+            # the bad data would sit in theta_batch poisoning every
+            # subsequent round (and on some workloads the solve stays
+            # finite, hiding the fault entirely). Counts as a sick round
+            # on the health ladder: a persistently NaN-ing feed walks
+            # quarantine → evict exactly like an in-solve divergence.
+            if telemetry.enabled():
+                telemetry.counter(
+                    "serving_shed_total",
+                    "solve requests shed to the degradation ladder"
+                    ).inc(reason="nonfinite_theta")
+            if self._health is not None:
+                self._sick_marks.add(tenant_id)
+            return self._shed(tenant_id, "nonfinite_theta")
         ok = self.queue.submit(SolveRequest(
             tenant_id=tenant_id, theta=theta,
             submitted_at=time.monotonic() if now is None else now,
@@ -285,9 +449,12 @@ class ServingPlane:
         Returns ``{tenant_id: RoundResult}`` — in pipelined mode these
         are the results of each bucket's PREVIOUS round (plus any
         deadline-shed verdicts of this one); call :meth:`flush` to drain
-        the pipeline."""
+        the pipeline. Never raises for a watchdogged (hung) round: the
+        affected tenants shed into their ladders and the dispatcher
+        falls back to synchronous dispatch."""
         t0 = time.perf_counter()
         now = time.monotonic() if now is None else now
+        self._readmit_due()
         ready, expired = self.queue.drain(now)
         results: dict = {}
         for key, res in self._carryover.items():
@@ -301,6 +468,16 @@ class ServingPlane:
                     healthy=False, reasons=decision.reasons)
         touched = []
         for req in ready:
+            if req.tenant_id in self._evicted:
+                # evicted after submitting (or a restored carryover
+                # request): walk the ladder instead of solving
+                decision = self._shed(req.tenant_id, "shed_evicted")
+                if decision is not None:
+                    results[req.tenant_id] = RoundResult(
+                        action=decision.action,
+                        controls=decision.controls,
+                        healthy=False, reasons=decision.reasons)
+                continue
             key = self._tenant_bucket.get(req.tenant_id)
             if key is None:
                 continue                  # left after submitting
@@ -317,6 +494,23 @@ class ServingPlane:
                 m["rounds"].inc(bucket=key.digest)
             if res is not None:
                 results.update(self._assess_bucket(res))
+        # rounds condemned by a stall in another bucket: assess as
+        # failures NOW (their tenants shed into their ladders) instead
+        # of leaving stale results to surface out of order at a flush
+        for res in self.dispatcher.drain_failed().values():
+            results.update(self._assess_bucket(res))
+        if self._health is not None and self._sick_marks:
+            # tenants whose only traffic this round was a rejected
+            # (non-finite) submission: score the strike even though no
+            # lane result carried it (a solo sick tenant must still
+            # walk quarantine → evict)
+            for tenant_id in tuple(self._sick_marks):
+                self._sick_marks.discard(tenant_id)
+                if tenant_id not in self._tenant_bucket \
+                        or tenant_id in self._evicted:
+                    continue
+                if self._health.observe(tenant_id, True) == "evict":
+                    self.evict_tenant(tenant_id, reason="health")
         if m is not None:
             m["queue_depth"].set(float(len(self.queue)))
             m["round_seconds"].observe(time.perf_counter() - t0)
@@ -338,10 +532,20 @@ class ServingPlane:
         if key in flushed:
             self._carryover[key] = flushed[key]
 
-    def _assess_bucket(self, decoded: dict) -> dict:
+    def _assess_bucket(self, decoded) -> dict:
         """Run each delivered result through its tenant's guard and
-        shape the per-tenant verdicts."""
+        shape the per-tenant verdicts. A :class:`RoundTimeout` marker
+        (the watchdog declared the round dead) becomes a failed solve
+        for every tenant the round served."""
+        if isinstance(decoded, RoundTimeout):
+            decoded = {
+                tenant_id: {
+                    "u0": {}, "traj": {},
+                    "stats": {"success": False,
+                              "watchdog_timeout": True},
+                } for tenant_id, _slot in decoded.served}
         out = {}
+        evictions = []
         m = telemetry.serving_metrics() if telemetry.enabled() else None
         for tenant_id, result in decoded.items():
             guard = self._guards.get(tenant_id)
@@ -351,7 +555,10 @@ class ServingPlane:
             bounds = None
             if spec is not None:
                 bounds = getattr(spec.ocp, "control_bounds", None)
-            decision = guard.assess(result, bounds)
+            stats = result.get("stats") or {}
+            precheck = ((False, ("watchdog_timeout",))
+                        if stats.get("watchdog_timeout") else None)
+            decision = guard.assess(result, bounds, precheck=precheck)
             controls = result["u0"] if decision.action == "actuate" \
                 else decision.controls
             out[tenant_id] = RoundResult(
@@ -359,19 +566,72 @@ class ServingPlane:
                 healthy=decision.healthy, reasons=decision.reasons,
                 stats=result.get("stats"))
             if m is not None:
-                m["solves"].inc()
+                # labelled by guard action so availability (actuated /
+                # delivered) is computable from telemetry alone
+                m["solves"].inc(action=decision.action)
+            if self._health is not None:
+                sick = self._health.is_sick_result(decision.healthy,
+                                                   stats)
+                if tenant_id in self._sick_marks:
+                    # a rejected (non-finite) submission this round: the
+                    # lane's healthy stale-theta result must not mask it
+                    sick = True
+                    self._sick_marks.discard(tenant_id)
+                if self._health.observe(tenant_id, sick) == "evict":
+                    evictions.append(tenant_id)
+        for tenant_id in evictions:
+            if tenant_id in self._tenant_bucket \
+                    and tenant_id not in self._evicted:
+                self.evict_tenant(tenant_id, reason="health")
         return out
+
+    # -- durability -----------------------------------------------------------
+
+    def save_checkpoint(self, path: str) -> str:
+        """Durable snapshot of the whole plane (crash-safe swap); see
+        :func:`agentlib_mpc_tpu.serving.checkpoint.save_plane`."""
+        from agentlib_mpc_tpu.serving.checkpoint import save_plane
+
+        return save_plane(self, path)
+
+    def restore_checkpoint(self, path: str, specs):
+        """Rebuild a checkpointed plane into this (empty) one through
+        the compile-cache path; returns a
+        :class:`~agentlib_mpc_tpu.serving.checkpoint.RestoreReport`
+        whose ``total_s`` is the measured recovery time (MTTR)."""
+        from agentlib_mpc_tpu.serving.checkpoint import restore_plane
+
+        return restore_plane(self, path, specs)
+
+    def _export_active(self) -> None:
+        if telemetry.enabled():
+            gauge = telemetry.serving_metrics()["active"]
+            for key, bucket in self._buckets.items():
+                gauge.set(float(bucket.n_active), bucket=key.digest)
 
     # -- introspection --------------------------------------------------------
 
     @property
     def tenants(self) -> tuple:
-        """Currently admitted tenant ids."""
+        """Currently admitted tenant ids (health-evicted ones included —
+        they are still the plane's responsibility)."""
         return tuple(self._tenant_bucket)
+
+    @property
+    def evicted_tenants(self) -> tuple:
+        return tuple(self._evicted)
+
+    def health_state(self, tenant_id: str) -> "str | None":
+        """The tenant's health-ladder state, or None when the ledger is
+        disabled."""
+        if self._health is None:
+            return None
+        return self._health.state(tenant_id)
 
     def stats(self) -> dict:
         return {
             "tenants": len(self._tenant_bucket),
+            "evicted": len(self._evicted),
             "buckets": {
                 key.digest: {"capacity": b.capacity,
                              "active": b.n_active,
@@ -379,10 +639,13 @@ class ServingPlane:
                 for key, b in self._buckets.items()},
             "cache": {"engines": len(self.cache),
                       "hits": self.cache.hits,
-                      "misses": self.cache.misses},
+                      "misses": self.cache.misses,
+                      "evictions": self.cache.evictions},
             "queue": {"pending": len(self.queue),
                       "submitted": self.queue.submitted,
                       "shed_overload": self.queue.shed_overload,
                       "shed_deadline": self.queue.shed_deadline},
+            "watchdog": {"stalls": self.dispatcher.stalls,
+                         "sync_fallback": self.dispatcher.sync_fallback},
             "rounds": self.rounds,
         }

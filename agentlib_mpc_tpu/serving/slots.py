@@ -177,6 +177,21 @@ class SlotPlane:
         self.mask[slot] = False
         return slot
 
+    def restore_occupancy(self, slots: "list[str | None]") -> None:
+        """Overwrite the occupancy bookkeeping wholesale — the
+        checkpoint-restore seam. A restored plane must reproduce the
+        SAVED slot layout (gaps included) because the per-lane state
+        arrays restored next to it are indexed by those exact slots;
+        sequential :meth:`admit` calls would compact the gaps away."""
+        if len(slots) != self.capacity:
+            raise ValueError(
+                f"occupancy snapshot has {len(slots)} slots for a "
+                f"capacity-{self.capacity} plane")
+        self.slots = list(slots)
+        self._slot_of = {t: s for s, t in enumerate(slots)
+                         if t is not None}
+        self.mask = np.asarray([t is not None for t in slots], dtype=bool)
+
     def update_theta(self, tenant_id: str, theta_row) -> None:
         """Splice a tenant's fresh parameters (its per-request state /
         disturbance data) into its lane."""
@@ -212,6 +227,14 @@ class SlotPlane:
         u = np.asarray(handle.trajs[0]["u"])      # (capacity, N, n_u)
         stats = handle.stats
         converged = bool(stats.converged)
+        iterations = int(stats.iterations)
+        # per-lane quarantine attribution: the engine substitutes a sick
+        # lane's iterate, so its decoded u comes back FINITE — without
+        # this column a persistently-NaN tenant looks healthy forever
+        # (the serving health ledger consumes it)
+        lane_q = None
+        if stats.lane_quarantined is not None:
+            lane_q = np.asarray(stats.lane_quarantined[0])
         names = list(self.ocp.control_names)
         out = {}
         for tenant_id, slot in handle.served:
@@ -227,7 +250,9 @@ class SlotPlane:
                     # observability and the round artifact
                     "success": bool(np.isfinite(u_row).all()),
                     "round_converged": converged,
-                    "iterations": int(stats.iterations),
+                    "iterations": iterations,
+                    "quarantined_iters": (int(lane_q[slot])
+                                          if lane_q is not None else 0),
                 },
             }
         return out

@@ -119,9 +119,16 @@ def serving_metrics(registry: "MetricsRegistry | None" = None) -> dict:
     by the dispatch plane (``agentlib_mpc_tpu/serving/``) and the
     ``bench.py --serve`` artifact, like :func:`solver_metrics` for the
     solver. Keys: requests, rounds, solves, active, queue_depth,
-    round_seconds. (The cache and admission layers declare their own
-    ``serving_compile_cache_*`` / ``serving_shed_total`` /
-    ``serving_join_build_seconds`` families at their write sites.)"""
+    round_seconds. ``serving_solves_total`` is labelled by the guard
+    ``action`` (actuate/replay/hold/fallback) so availability —
+    actuated ÷ delivered — is computable from telemetry alone. (The
+    cache, admission and survivability layers declare their own
+    families at their write sites: ``serving_compile_cache_*``,
+    ``serving_cache_evictions_total``, ``serving_shed_total``,
+    ``serving_join_build_seconds``, ``serving_health_state``,
+    ``serving_evictions_total``, ``serving_readmissions_total``,
+    ``serving_watchdog_stalls_total``,
+    ``serving_watchdog_probes_total``.)"""
     reg = registry or DEFAULT
     return {
         "requests": reg.counter(

@@ -32,15 +32,30 @@ def _stale_siblings(path: str) -> list:
                   key=os.path.getmtime)
 
 
+#: files orbax writes only once a checkpoint is fully committed — their
+#: presence separates a complete checkpoint directory from the husk a
+#: save killed mid-write leaves behind
+_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "_METADATA", "checkpoint")
+
+
+def _looks_complete(path: str) -> bool:
+    return any(os.path.exists(os.path.join(path, m))
+               for m in _COMMIT_MARKERS)
+
+
 def has_checkpoint(path: str) -> bool:
-    """True when :func:`load_pytree` has something to try at ``path``:
-    the primary checkpoint directory or any crash-recovery sibling
-    (``.old-*`` / ``.tmp-*``). The restore-on-construct guard used by
-    ``BaseMPC``'s auto-checkpointing (``checkpoint_path`` config) —
-    a fresh deployment with no checkpoint yet must start cold instead
-    of raising."""
+    """True when :func:`load_pytree` has something COMPLETE to try at
+    ``path``: the primary checkpoint directory or a crash-recovery
+    sibling (``.old-*`` / ``.tmp-*``) carrying orbax's commit marker.
+    The restore-on-construct guard used by ``BaseMPC``'s
+    auto-checkpointing (``checkpoint_path`` config) — a fresh
+    deployment with no checkpoint yet, or one whose ONLY artifact is a
+    half-written temp dir from a save killed mid-write, must start cold
+    instead of raising."""
     path = os.path.abspath(path)
-    return os.path.isdir(path) or bool(_stale_siblings(path))
+    if os.path.isdir(path) and _looks_complete(path):
+        return True
+    return any(_looks_complete(s) for s in _stale_siblings(path))
 
 
 def save_pytree(path: str, tree: Any) -> str:

@@ -71,6 +71,14 @@ Modes:
                                 # p50/p99 round latency, sync-vs-
                                 # pipelined dispatch A/B, cold-vs-cached
                                 # join latency (docs/serving.md)
+    python bench.py --chaos-serve SEED [n]   # serving survivability:
+                                # n (default 6) tenants across 2 buckets
+                                # under a seeded fault schedule (tenant
+                                # NaN storm, dispatcher stall, process
+                                # crash + checkpoint restore) —
+                                # availability %, shed rate, eviction/
+                                # readmission counts, crash-restart MTTR
+                                # (docs/serving.md "Surviving failures")
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -981,6 +989,196 @@ def run_serve(seed: int = 0, n_tenants: int = 8, rounds: int = 40) -> dict:
     return out
 
 
+def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
+                    rounds: int = 24) -> dict:
+    """``--chaos-serve SEED [n]``: survivability benchmark of the
+    serving plane under a seeded fault schedule (the PR 2 chaos
+    machinery cashed in at the serving layer).
+
+    ``n_tenants`` tracker tenants split across TWO structure buckets
+    (different warm budgets) join a plane armed with the health ladder
+    and the dispatch watchdog, then serve ``rounds`` control rounds
+    while the schedule injects, deterministically from ``seed``:
+
+    1. a **NaN storm** on one victim tenant (every submission inside
+       the window carries an all-NaN parameter tree — the bad-sensor
+       feed): the door rejects each poisoned submission, the victim
+       walks quarantine → eviction, its bucket's other tenants keep
+       actuating, and it re-admits on probation after the window;
+    2. a **dispatcher stall** (one round's readback hangs): the
+       watchdog times the round out, sheds its tenants into their
+       ladders, and the dispatcher continues synchronously;
+    3. a **process crash** mid-run: the plane is checkpointed, dropped,
+       and restored into a fresh plane against the warm compile cache —
+       the restore wall-clock is the reported **MTTR** (cached-join
+       splices; 0 cold builds is the contract).
+
+    Reported: availability (actuated ÷ expected actuations — degraded
+    replay/hold/fallback rounds count as unavailable), shed rate,
+    eviction/readmission/stall counts, crash-restart MTTR (total and
+    per tenant) and the restore's cold-build count. Platform-qualified
+    like every serving metric.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+    from agentlib_mpc_tpu.resilience.chaos import (
+        ServeChaosConfig,
+        ServeNaNStormRule,
+        ServeStallRule,
+        install_serving_chaos,
+    )
+    from agentlib_mpc_tpu.serving import (
+        HealthPolicy,
+        ServingPlane,
+        TenantSpec,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import (
+        enable_compile_profiling,
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    enable_compile_profiling()
+
+    import random as _random
+
+    rng = _random.Random(f"bench-chaos-serve:{seed}")
+    ocp = tracker_ocp()
+    ids = [f"t{i:03d}" for i in range(n_tenants)]
+    # two structure buckets: even tenants run the 30-iteration solver,
+    # odd ones 31 — identical physics, distinct executables, so the
+    # crash restore exercises the multi-bucket path
+    specs = {
+        tid: TenantSpec(
+            tenant_id=tid, ocp=ocp,
+            theta=ocp.default_params(
+                p=jnp.array([float(i - n_tenants // 2)])),
+            couplings={},
+            solver_options=SolverOptions(max_iter=30 + (i % 2)))
+        for i, tid in enumerate(ids)
+    }
+    victim = rng.choice(ids)
+    storm_start = rng.randrange(2, 5)
+    storm_len = rng.randrange(4, 7)
+    stall_call = storm_start + storm_len + rng.randrange(1, 3)
+    crash_round = min(rounds - 4, stall_call + rng.randrange(3, 5))
+    health = HealthPolicy(quarantine_after=1, evict_after=2,
+                          readmit_after=2, probation_rounds=2)
+
+    def build_plane(cache=None):
+        return ServingPlane(
+            FusedADMMOptions(max_iterations=5, rho=2.0),
+            slot_multiple=1, initial_capacity=n_tenants,
+            pipelined=False, donate=False, queue_limit=4 * n_tenants,
+            health_policy=health, watchdog_timeout_s=10.0, cache=cache)
+
+    plane = build_plane()
+    join_cold = []
+    for tid in ids:
+        rec = plane.join(specs[tid])
+        if not rec.engine_cached:
+            join_cold.append(rec.latency_s)
+    chaos = install_serving_chaos(plane, ServeChaosConfig(
+        nan_storm=(ServeNaNStormRule(tenant=victim,
+                                     start_round=storm_start,
+                                     n_rounds=storm_len),),
+        stall=(ServeStallRule(call=stall_call, duration_s=30.0),),
+    ), seed=seed)
+
+    expected = actuated = shed = 0
+    mttr = None
+    restore_report = None
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-serve-ckpt-")
+    try:
+        for r in range(rounds):
+            if r == crash_round:
+                # "crash": checkpoint, drop the plane, restore into a
+                # fresh one against the warm compile cache (the
+                # supervisor-restart model; cross-process the
+                # persistent XLA cache plays the warm-cache role)
+                chaos.uninstall()
+                path = plane.save_checkpoint(
+                    os.path.join(ckpt_dir, "plane"))
+                cache = plane.cache
+                del plane
+                t0 = time.perf_counter()
+                plane = build_plane(cache=cache)
+                restore_report = plane.restore_checkpoint(path, specs)
+                mttr = time.perf_counter() - t0
+            for tid in ids:
+                if tid not in plane.tenants:
+                    continue
+                drift = rng.uniform(-0.2, 0.2)
+                theta = ocp.default_params(p=jnp.array([
+                    float(ids.index(tid) - n_tenants // 2) + drift]))
+                expected += 1
+                decision = plane.submit(tid, theta=theta)
+                if decision is not None:
+                    shed += 1
+            res = plane.serve_round()
+            actuated += sum(1 for v in res.values()
+                            if v.action == "actuate")
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    stats = plane.stats()
+    platform = jax.devices()[0].platform
+    metric = "serve_availability_pct" if platform == "tpu" \
+        else f"serve_availability_pct_{platform}"
+    out = {
+        "metric": metric,
+        "value": round(100.0 * actuated / max(expected, 1), 2),
+        "unit": "%",
+        "seed": seed,
+        "n_tenants": n_tenants,
+        "rounds": rounds,
+        "victim": victim,
+        "storm_rounds": [storm_start, storm_start + storm_len],
+        "stall_call": stall_call,
+        "crash_round": crash_round,
+        "shed_rate_pct": round(100.0 * shed / max(expected, 1), 2),
+        "evictions": int(telemetry.metrics().counter(
+            "serving_evictions_total").total()),
+        "readmissions": int(telemetry.metrics().counter(
+            "serving_readmissions_total").total()),
+        "still_evicted": int(stats["evicted"]),
+        # process-global counter, NOT plane.stats(): the pre-crash
+        # plane's dispatcher (and its stall) died with the "crash"
+        "watchdog_stalls": int(telemetry.metrics().counter(
+            "serving_watchdog_stalls_total").total()),
+        "sync_fallback": stats["watchdog"]["sync_fallback"],
+        "mttr_ms": None if mttr is None else round(1e3 * mttr, 2),
+        "restore_cold_builds": (None if restore_report is None
+                                else restore_report.cold_builds),
+        "restore_cache_hits": (None if restore_report is None
+                               else restore_report.cache_hits),
+        "restore_per_tenant_ms": (
+            None if restore_report is None else
+            {t: round(1e3 * s, 3)
+             for t, s in sorted(restore_report.per_tenant_s.items())}),
+        "join_cold_ms": (round(1e3 * float(np.mean(join_cold)), 2)
+                         if join_cold else None),
+        "cache": stats["cache"],
+        "chaos_events": {k: chaos.count(k)
+                         for k in ("serve_nan_theta", "serve_stall")},
+        "platform": platform,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def run_profile(trace_dir: str = "bench_trace",
                 n_agents: int = N_AGENTS) -> None:
     """Capture an XLA profiler trace of the warm ``n_agents``-zone step
@@ -1731,6 +1929,19 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_serve(seed, n)
+        return
+
+    if "--chaos-serve" in sys.argv:
+        # serving survivability benchmark, in-process like --serve (pin
+        # JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --chaos-serve SEED [n_tenants]
+        idx = sys.argv.index("--chaos-serve")
+        seed, n = 0, 6
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_chaos_serve(seed, n)
         return
 
     if "--chaos" in sys.argv:

@@ -249,6 +249,26 @@ class TestQuarantine:
             assert bool(jnp.all(jnp.isfinite(leaf)))
         assert bool(np.isfinite(np.asarray(trajs[0]["u"])).all())
 
+    def test_lane_quarantined_attributes_the_sick_lane(self,
+                                                       quarantine_setup):
+        """The per-lane attribution (PR 8, the serving health ledger's
+        input): the substitution keeps the victim's decoded trajectory
+        finite, so ``lane_quarantined`` is the only signal naming WHICH
+        lane was carried — it must finger exactly the victim."""
+        engine, state, thetas, _ = quarantine_setup
+        w_bad = state.w[0].at[1].set(jnp.nan)
+        _, trajs, stats = engine.step(
+            state._replace(w=(w_bad,)), [thetas])
+        lane_q = np.asarray(stats.lane_quarantined[0])
+        assert lane_q.shape == (N_AGENTS,)
+        assert lane_q[1] >= 1                    # the victim is named
+        assert (lane_q[[0, 2, 3]] == 0).all()    # nobody else is
+        # the round total and the per-lane attribution agree
+        assert lane_q.sum() == np.asarray(stats.quarantined).sum()
+        # ... while the victim's decoded trajectory is finite — exactly
+        # why the attribution (not the decode) must carry the signal
+        assert bool(np.isfinite(np.asarray(trajs[0]["u"][1])).all())
+
     def test_nan_theta_keeps_the_fleet_finite(self, quarantine_setup):
         """One agent's NaN-poisoned parameters cannot poison the others
         through the consensus mean: means, multipliers and warm starts

@@ -127,6 +127,46 @@ class TestBackendWarmState:
         np.testing.assert_array_equal(restored["a"], tree["a"])
         assert restored["b"] == tree["b"]
 
+    def test_truncated_checkpoint_rejected_not_restored(self, tmp_path):
+        """Satellite: a torn checkpoint (every ocdbt data block
+        truncated — the crash-mid-write / bit-rot model) must be
+        REJECTED by load_pytree, never silently restored as garbage."""
+        from agentlib_mpc_tpu.resilience.chaos import corrupt_checkpoint
+
+        tree = {"a": np.arange(64.0), "b": np.float64(2.5)}
+        path = save_pytree(str(tmp_path / "state"), tree)
+        corrupt_checkpoint(path, mode="truncate")
+        with pytest.raises((ValueError, RuntimeError)):
+            load_pytree(path, tree)
+
+    def test_half_written_tmp_is_not_a_checkpoint(self, tmp_path):
+        """A save killed during the very first orbax write leaves only
+        a marker-less temp dir: has_checkpoint must answer False (cold
+        start), not steer the module into a doomed restore."""
+        import os
+
+        from agentlib_mpc_tpu.utils.checkpoint import has_checkpoint
+
+        path = str(tmp_path / "state")
+        os.makedirs(f"{path}.tmp-1")
+        (tmp_path / "state.tmp-1" / "junk").write_text("not orbax")
+        assert not has_checkpoint(path)
+        # ... while a COMPLETE checkpoint (commit marker present) next
+        # to the same junk tmp still answers True
+        save_pytree(path, {"a": np.arange(3.0)})
+        assert has_checkpoint(path)
+
+    def test_primary_without_commit_marker_is_not_a_checkpoint(
+            self, tmp_path):
+        import os
+
+        from agentlib_mpc_tpu.utils.checkpoint import has_checkpoint
+
+        path = str(tmp_path / "state")
+        os.makedirs(path)
+        (tmp_path / "state" / "partial").write_text("x")
+        assert not has_checkpoint(path)
+
     def test_missing_checkpoint_reports_all_failed_siblings(self, tmp_path):
         """Truly absent -> FileNotFoundError (cold start is correct);
         present-but-unrestorable -> RuntimeError (cold start would

@@ -262,6 +262,122 @@ class TestAdmission:
         assert res["t1"].action == "actuate" and res["t1"].healthy
 
 
+class TestInFlightChurn:
+    def test_tenant_left_while_in_flight_is_dropped(self, plane, ocp):
+        """The bare-continue branch in ``_assess_bucket``: a tenant that
+        leaves between launch and materialize simply vanishes from the
+        results — no KeyError, no ghost verdict — while its bucket
+        peers still deliver."""
+        plane.join(make_spec(ocp, "stay", 1.0))
+        plane.join(make_spec(ocp, "goer", 3.0))
+        plane.submit("stay")
+        plane.submit("goer")
+        plane.serve_round()              # pipelined: round in flight
+        plane.leave("goer")              # leaves while in flight
+        res = plane.flush()
+        assert "goer" not in res
+        assert res["stay"].action == "actuate"
+        plane.leave("stay")
+
+    def test_dispatcher_flush_with_dead_bucket_key(self):
+        from agentlib_mpc_tpu.serving.dispatch import PipelinedDispatcher
+
+        d = PipelinedDispatcher(pipelined=True)
+        assert d.flush("no-such-bucket") == {}
+        assert d.flush() == {}           # nothing in flight at all
+
+
+class TestCacheLRU:
+    def test_bounded_cache_evicts_lru_and_rejoin_is_miss(self):
+        from agentlib_mpc_tpu.serving import CompileCache
+
+        built = []
+
+        def builder(tag):
+            def build():
+                built.append(tag)
+                return f"engine-{tag}"
+            return build
+
+        cache = CompileCache(max_engines=2)
+        cache.get_or_build("A", builder("A"), label="A")
+        cache.get_or_build("B", builder("B"), label="B")
+        # touch A so B is the least recently used
+        _, hit, _ = cache.get_or_build("A", builder("A"), label="A")
+        assert hit
+        cache.get_or_build("C", builder("C"), label="C")   # evicts B
+        assert cache.evictions == 1
+        assert "B" not in cache and "A" in cache and "C" in cache
+        # the eviction -> rejoin-is-miss contract
+        _, hit, _ = cache.get_or_build("B", builder("B"), label="B")
+        assert not hit
+        assert built == ["A", "B", "C", "B"]
+        assert len(cache) == 2            # A was evicted by B's return
+
+    def test_unbounded_cache_never_evicts(self):
+        from agentlib_mpc_tpu.serving import CompileCache
+
+        cache = CompileCache()
+        for i in range(64):
+            cache.get_or_build(i, lambda i=i: i)
+        assert len(cache) == 64 and cache.evictions == 0
+
+    def test_bad_bound_rejected(self):
+        from agentlib_mpc_tpu.serving import CompileCache
+
+        with pytest.raises(ValueError, match="max_engines"):
+            CompileCache(max_engines=0)
+
+    def test_eviction_metric_counted(self):
+        from agentlib_mpc_tpu import telemetry
+        from agentlib_mpc_tpu.serving import CompileCache
+
+        telemetry.configure(enabled=True)
+        try:
+            before = telemetry.metrics().counter(
+                "serving_cache_evictions_total").total()
+            cache = CompileCache(max_engines=1)
+            cache.get_or_build("A", lambda: "a", label="bucketA")
+            cache.get_or_build("B", lambda: "b", label="bucketB")
+            after = telemetry.metrics().counter(
+                "serving_cache_evictions_total").total()
+            assert after - before == 1
+        finally:
+            telemetry.configure(enabled=False)
+
+
+class TestSolvesByAction:
+    def test_solves_counter_labelled_by_guard_action(self, ocp):
+        """Satellite: ``serving_solves_total`` must attribute each
+        delivered result to its guard action — a replayed/held round is
+        not an availability, and telemetry alone must show that."""
+        from agentlib_mpc_tpu import telemetry
+
+        telemetry.configure(enabled=True)
+        try:
+            reg = telemetry.metrics()
+
+            def count(action):
+                return reg.get("serving_solves_total",
+                               action=action) or 0.0
+
+            sp = ServingPlane(ADMM_OPTS, slot_multiple=1,
+                              initial_capacity=1, pipelined=False,
+                              donate=False)
+            sp.join(make_spec(ocp, "t1", 2.0))
+            a0, r0 = count("actuate"), count("replay")
+            sp.submit("t1")
+            sp.serve_round()                  # healthy -> actuate
+            sp.submit("t1", deadline_s=0.1, now=0.0)
+            sp.serve_round(now=5.0)           # expired -> ladder
+            assert count("actuate") == a0 + 1
+            # the deadline shed never reaches the solves counter (no
+            # result was delivered), so replay stays flat ...
+            assert count("replay") == r0
+        finally:
+            telemetry.configure(enabled=False)
+
+
 class TestChurnGate:
     def test_serving_budget_gate_is_green(self):
         """The CI gate as a test: zero warm traces/compiles across the

@@ -1,0 +1,576 @@
+"""Serving survivability: durable plane state, fault isolation, watchdog.
+
+The PR 8 contracts (ISSUE 8 / docs/serving.md "Surviving failures"):
+
+* **crash/restart** — a multi-bucket plane checkpoints, tears down and
+  restores into a fresh plane with every tenant's restore a
+  compile-cache hit (0 cold builds) and the warm-start state restored
+  bitwise; a corrupted checkpoint is rejected loudly, never restored;
+* **fault isolation** — a persistently NaN-ing tenant walks
+  quarantine → eviction within the configured window, its bucket's
+  other tenants' solutions stay bitwise-unaffected vs a no-chaos run,
+  and it re-admits cleanly on probation after the fault window (zero
+  retraces: the ``[serving.health]`` budget gate);
+* **watchdog** — a chaos-stalled in-flight round times out, affected
+  tenants shed into their guard ladders (no exception escapes
+  ``serve_round``), and the dispatcher serves subsequent rounds in
+  sync mode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+from agentlib_mpc_tpu.resilience.chaos import (
+    ServeChaosConfig,
+    ServeNaNStormRule,
+    ServeStallRule,
+    corrupt_checkpoint,
+    install_serving_chaos,
+)
+from agentlib_mpc_tpu.serving import (
+    HealthPolicy,
+    ServingPlane,
+    TenantSpec,
+    has_plane_checkpoint,
+)
+from agentlib_mpc_tpu.serving.health import (
+    EVICTED,
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    HealthLedger,
+)
+
+ADMM_OPTS = FusedADMMOptions(max_iterations=6, rho=2.0)
+
+#: module-shared engine cache: every test plane draws from it, so each
+#: unique bucket structure pays its cold build once per test module
+#: (sharing a cache across planes is exactly the supervisor-restart
+#: model the crash tests exercise)
+_CACHE = None
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+def make_spec(ocp, tid, a, max_iter=30, couplings=None):
+    return TenantSpec(
+        tenant_id=tid, ocp=ocp,
+        theta=ocp.default_params(p=jnp.array([float(a)])),
+        couplings={"shared_u": "u"} if couplings is None else couplings,
+        solver_options=SolverOptions(max_iter=max_iter))
+
+
+def make_plane(**kw):
+    global _CACHE
+    from agentlib_mpc_tpu.serving import CompileCache
+
+    if _CACHE is None:
+        _CACHE = CompileCache()
+    kw.setdefault("cache", _CACHE)
+    kw.setdefault("slot_multiple", 1)
+    kw.setdefault("initial_capacity", 4)
+    kw.setdefault("pipelined", False)
+    kw.setdefault("donate", False)
+    return ServingPlane(ADMM_OPTS, **kw)
+
+
+def state_arrays(plane):
+    return {
+        key.digest: jax.tree.map(np.asarray, bucket.state)
+        for key, bucket in plane._buckets.items()
+    }
+
+
+class TestCrashRestart:
+    """Acceptance: >=4 tenants across >=2 buckets round-trip through a
+    checkpoint with zero cold builds and bitwise warm starts."""
+
+    @pytest.fixture(scope="class")
+    def saved(self, ocp, tmp_path_factory):
+        plane = make_plane()
+        # two structure buckets: max_iter 30 vs 31 shape two distinct
+        # executables over the same OCP
+        specs = {tid: make_spec(ocp, tid, a, max_iter=mi)
+                 for tid, a, mi in [("a", 1.0, 30), ("b", 3.0, 30),
+                                    ("c", 2.0, 31), ("d", -1.0, 31)]}
+        for spec in specs.values():
+            plane.join(spec)
+        for _ in range(2):
+            for tid in plane.tenants:
+                plane.submit(tid)
+            plane.serve_round()
+        plane.submit("a")             # queue carryover
+        path = str(tmp_path_factory.mktemp("ckpt") / "plane")
+        plane.save_checkpoint(path)
+        return {"plane": plane, "specs": specs, "path": path,
+                "states": state_arrays(plane),
+                "slots": {k.digest: list(b.slots)
+                          for k, b in plane._buckets.items()}}
+
+    def test_restore_is_all_cache_hits_with_bitwise_state(self, saved):
+        assert has_plane_checkpoint(saved["path"])
+        # "torn down": the fresh plane only shares the compile cache
+        # (the supervisor-restart model; cross-process the persistent
+        # XLA cache plays this role)
+        fresh = make_plane(cache=saved["plane"].cache)
+        report = fresh.restore_checkpoint(saved["path"], saved["specs"])
+        assert sorted(report.tenants) == ["a", "b", "c", "d"]
+        assert report.buckets == 2
+        assert report.cold_builds == 0          # the acceptance bar
+        assert report.cache_hits == 4           # one reuse per tenant
+        assert report.requeued == 1
+        assert report.total_s > 0
+        assert set(report.per_tenant_s) == {"a", "b", "c", "d"}
+        for key, bucket in fresh._buckets.items():
+            assert list(bucket.slots) == saved["slots"][key.digest]
+            before = saved["states"][key.digest]
+            for x, y in zip(jax.tree.leaves(before),
+                            jax.tree.leaves(bucket.state)):
+                np.testing.assert_array_equal(np.asarray(x),
+                                              np.asarray(y))
+        # the carryover request serves immediately and actuates
+        res = fresh.serve_round()
+        assert res["a"].action == "actuate"
+
+    def test_restore_requires_empty_plane(self, saved, ocp):
+        fresh = make_plane(cache=saved["plane"].cache)
+        fresh.join(make_spec(ocp, "squatter", 0.5))
+        with pytest.raises(ValueError, match="EMPTY"):
+            fresh.restore_checkpoint(saved["path"], saved["specs"])
+
+    def test_restore_rejects_spec_drift(self, saved, ocp):
+        drifted = dict(saved["specs"])
+        drifted["a"] = make_spec(ocp, "a", 1.0, max_iter=77)
+        fresh = make_plane(cache=saved["plane"].cache)
+        with pytest.raises(ValueError, match="fingerprints into"):
+            fresh.restore_checkpoint(saved["path"], drifted)
+
+    def test_missing_spec_rejected(self, saved):
+        partial = {t: s for t, s in saved["specs"].items() if t != "c"}
+        fresh = make_plane(cache=saved["plane"].cache)
+        with pytest.raises(KeyError, match="'c'"):
+            fresh.restore_checkpoint(saved["path"], partial)
+
+    def test_corrupt_arrays_rejected_not_restored(self, saved,
+                                                  tmp_path):
+        import shutil
+
+        copy = str(tmp_path / "plane")
+        shutil.copytree(saved["path"], copy)
+        corrupt_checkpoint(copy, mode="truncate")
+        fresh = make_plane(cache=saved["plane"].cache)
+        with pytest.raises((ValueError, RuntimeError)):
+            fresh.restore_checkpoint(copy, saved["specs"])
+
+    def test_dropped_manifest_means_no_checkpoint(self, saved,
+                                                  tmp_path):
+        import shutil
+
+        copy = str(tmp_path / "plane")
+        shutil.copytree(saved["path"], copy)
+        corrupt_checkpoint(copy, mode="drop-manifest")
+        assert not has_plane_checkpoint(copy)
+        fresh = make_plane(cache=saved["plane"].cache)
+        with pytest.raises(RuntimeError, match="manifest"):
+            fresh.restore_checkpoint(copy, saved["specs"])
+
+    def test_absent_path_is_file_not_found(self, saved, tmp_path):
+        fresh = make_plane(cache=saved["plane"].cache)
+        with pytest.raises(FileNotFoundError):
+            fresh.restore_checkpoint(str(tmp_path / "nope"),
+                                     saved["specs"])
+
+
+class TestHealthLedgerUnit:
+    def test_quarantine_evict_probation_cycle(self):
+        ledger = HealthLedger(HealthPolicy(
+            quarantine_after=2, evict_after=3, readmit_after=2,
+            probation_rounds=2))
+        assert ledger.observe("t", True) is None
+        assert ledger.state("t") == HEALTHY        # 1 strike
+        assert ledger.observe("t", True) is None
+        assert ledger.state("t") == QUARANTINED    # 2 strikes
+        assert ledger.observe("t", True) == "evict"
+        assert ledger.state("t") == EVICTED
+        assert ledger.tick_evicted() == []         # 1 round evicted
+        assert ledger.tick_evicted() == ["t"]      # window open
+        ledger.readmitted("t")
+        assert ledger.state("t") == PROBATION
+        assert ledger.observe("t", False) is None
+        assert ledger.observe("t", False) == "clear"
+        assert ledger.state("t") == HEALTHY
+
+    def test_one_sick_probation_round_reevicts(self):
+        ledger = HealthLedger(HealthPolicy(
+            quarantine_after=1, evict_after=2, readmit_after=1,
+            probation_rounds=3))
+        ledger.force_evict("t")
+        ledger.readmitted("t")
+        assert ledger.observe("t", True) == "evict"
+        assert ledger.state("t") == EVICTED
+
+    def test_healthy_round_resets_strikes(self):
+        ledger = HealthLedger(HealthPolicy(quarantine_after=2,
+                                           evict_after=3))
+        ledger.observe("t", True)
+        ledger.observe("t", False)
+        ledger.observe("t", True)
+        ledger.observe("t", True)
+        assert ledger.state("t") == QUARANTINED    # never reached 3
+        ledger.observe("t", False)
+        assert ledger.state("t") == HEALTHY
+
+    def test_quarantine_carried_lane_is_sick(self):
+        """The engine quarantine substitutes a NaN lane, so its decoded
+        result is finite+healthy — the per-lane attribution must flag
+        it anyway."""
+        ledger = HealthLedger(HealthPolicy())
+        healthy_stats = {"iterations": 6, "quarantined_iters": 0}
+        carried_stats = {"iterations": 6, "quarantined_iters": 6}
+        assert not ledger.is_sick_result(True, healthy_stats)
+        assert ledger.is_sick_result(True, carried_stats)
+        assert ledger.is_sick_result(False, healthy_stats)
+
+    def test_snapshot_roundtrip(self):
+        ledger = HealthLedger(HealthPolicy(quarantine_after=1,
+                                           evict_after=2))
+        ledger.observe("t", True)
+        ledger.force_evict("u")
+        clone = HealthLedger(ledger.policy)
+        clone.restore(ledger.snapshot())
+        assert clone.state("t") == QUARANTINED
+        assert clone.state("u") == EVICTED
+        assert clone.row("t").sick_streak == 1
+
+
+class TestFaultIsolation:
+    """Acceptance: NaN-storm tenant evicted in-window; bucket peers
+    bitwise-unaffected; clean probation re-admission."""
+
+    @pytest.mark.chaos
+    def test_nan_tenant_evicted_peers_bitwise_unaffected(self, ocp):
+        policy = HealthPolicy(quarantine_after=1, evict_after=2,
+                              readmit_after=2, probation_rounds=1)
+        tenants = [("sick", 0.0), ("h1", 1.0), ("h2", -2.0)]
+
+        def run(with_chaos):
+            plane = make_plane(health_policy=policy)
+            for tid, a in tenants:
+                plane.join(make_spec(ocp, tid, a, couplings={}))
+            ctl = None
+            if with_chaos:
+                ctl = install_serving_chaos(plane, ServeChaosConfig(
+                    nan_storm=(ServeNaNStormRule(
+                        tenant="sick", start_round=0, n_rounds=4),)))
+            history = []
+            evicted_at = None
+            for r in range(10):
+                for tid, a in tenants:
+                    if tid in plane.evicted_tenants:
+                        continue
+                    plane.submit(tid, theta=ocp.default_params(
+                        p=jnp.array([a + 0.01 * r])))
+                res = plane.serve_round()
+                history.append({t: np.asarray(v.controls["u"])
+                                if v.action == "actuate"
+                                and v.controls else None
+                                for t, v in res.items()})
+                if evicted_at is None and "sick" in \
+                        plane.evicted_tenants:
+                    evicted_at = r
+            if ctl is not None:
+                ctl.uninstall()
+            return plane, history, evicted_at
+
+        clean_plane, clean_hist, _ = run(with_chaos=False)
+        chaos_plane, chaos_hist, evicted_at = run(with_chaos=True)
+
+        # evicted within the window: 2 sick rounds at evict_after=2
+        assert evicted_at is not None and evicted_at <= 2
+        # ... and re-admitted cleanly after the storm: by the end the
+        # tenant is healthy again and actuating
+        assert "sick" not in chaos_plane.evicted_tenants
+        assert chaos_plane.health_state("sick") in (HEALTHY, PROBATION)
+        assert chaos_hist[-1]["sick"] is not None
+        # bucket peers: bitwise-identical controls in EVERY round
+        for r, (clean, chaos) in enumerate(zip(clean_hist,
+                                               chaos_hist)):
+            for tid in ("h1", "h2"):
+                assert clean[tid] is not None and chaos[tid] is not None
+                assert (clean[tid] == chaos[tid]).all(), (
+                    f"round {r}: {tid} diverged under chaos")
+
+    @pytest.mark.chaos
+    def test_result_mode_storm_walks_guard_verdicts(self, ocp):
+        """The decode-level storm drives eviction through the guard
+        path (NaN u0 + success=False) instead of door rejection."""
+        plane = make_plane(health_policy=HealthPolicy(
+            quarantine_after=1, evict_after=2, readmit_after=8,
+            probation_rounds=1))
+        plane.join(make_spec(ocp, "v", 1.0, couplings={}))
+        plane.join(make_spec(ocp, "w", 2.0, couplings={}))
+        ctl = install_serving_chaos(plane, ServeChaosConfig(
+            nan_storm=(ServeNaNStormRule(tenant="v", mode="result",
+                                         start_round=0, n_rounds=6),)))
+        actions = []
+        for _ in range(4):
+            for tid in ("v", "w"):
+                if tid not in plane.evicted_tenants:
+                    plane.submit(tid)
+            res = plane.serve_round()
+            actions.append({t: r.action for t, r in res.items()})
+        ctl.uninstall()
+        assert "v" in plane.evicted_tenants
+        assert plane.health_state("v") == EVICTED
+        # the victim's unhealthy rounds walked its ladder, peers kept on
+        assert any(a.get("v") in ("replay", "hold", "fallback")
+                   for a in actions)
+        assert all(a.get("w") == "actuate" for a in actions
+                   if "w" in a)
+
+
+class TestWatchdog:
+    @pytest.mark.chaos
+    def test_stalled_round_sheds_and_falls_back_to_sync(self, ocp):
+        plane = make_plane(pipelined=True, donate=True,
+                           watchdog_timeout_s=0.5)
+        plane.join(make_spec(ocp, "a", 1.0))
+        plane.join(make_spec(ocp, "b", 3.0))
+        # materialize call 0 is round 0's readback at round 1
+        ctl = install_serving_chaos(plane, ServeChaosConfig(
+            stall=(ServeStallRule(call=1, duration_s=3.0),)))
+        for t in ("a", "b"):
+            plane.submit(t)
+        plane.serve_round()                 # round 0 in flight
+        for t in ("a", "b"):
+            plane.submit(t)
+        res = plane.serve_round()           # delivers round 0: healthy
+        assert all(r.action == "actuate" for r in res.values())
+        for t in ("a", "b"):
+            plane.submit(t)
+        res = plane.serve_round()           # watchdog fires — NO raise
+        assert set(res) == {"a", "b"}
+        for r in res.values():
+            assert not r.healthy
+            assert r.action in ("replay", "hold", "fallback")
+        assert plane.dispatcher.stalls == 1
+        assert plane.dispatcher.sync_fallback
+        assert plane.dispatcher.pipelined is False
+        # subsequent rounds serve synchronously and recover
+        for t in ("a", "b"):
+            plane.submit(t)
+        res = plane.serve_round()
+        assert all(r.action == "actuate" for r in res.values())
+        assert plane.dispatcher.stalls == 1
+        ctl.uninstall()
+
+    def test_stall_condemns_other_buckets_inflight_rounds(self):
+        """A stall in bucket A must not strand bucket B's in-flight
+        round: it is condemned (RoundTimeout via drain_failed), never
+        surfaced later as a stale out-of-order result."""
+        import time as _time
+
+        from agentlib_mpc_tpu.serving.dispatch import (
+            PipelinedDispatcher,
+            RoundTimeout,
+        )
+
+        class FakeHandle:
+            def __init__(self, served):
+                self.served = served
+
+        class FakePlane:
+            def __init__(self, name, hang=False):
+                self.name = name
+                self.hang = hang
+                self.launched = 0
+
+            def launch_round(self):
+                self.launched += 1
+                return FakeHandle(((f"{self.name}{self.launched}", 0),))
+
+            def materialize(self, handle):
+                if self.hang:
+                    _time.sleep(5.0)
+                return {t: {"u0": {}} for t, _ in handle.served}
+
+        d = PipelinedDispatcher(pipelined=True, timeout_s=0.2)
+        a, b = FakePlane("a", hang=True), FakePlane("b")
+        assert d.dispatch("A", a) is None       # A round 1 in flight
+        assert d.dispatch("B", b) is None       # B round 1 in flight
+        res = d.dispatch("A", a)                # A's readback stalls
+        assert isinstance(res, RoundTimeout)
+        # A's tenants from BOTH the stalled and the just-launched round
+        assert {t for t, _ in res.served} == {"a1", "a2"}
+        # B's stranded round is condemned, not forgotten
+        failed = d.drain_failed()
+        assert set(failed) == {"B"}
+        assert isinstance(failed["B"], RoundTimeout)
+        assert {t for t, _ in failed["B"].served} == {"b1"}
+        assert d.flush() == {}                  # nothing left behind
+        assert d.pipelined is False and d.sync_fallback
+
+    def test_flush_condemns_rest_after_first_stall(self):
+        """One stall inside a multi-bucket flush: the remaining handles
+        are condemned without paying a timeout each."""
+        import time as _time
+
+        from agentlib_mpc_tpu.serving.dispatch import (
+            PipelinedDispatcher,
+            RoundTimeout,
+        )
+
+        class FakeHandle:
+            def __init__(self, served):
+                self.served = served
+
+        class FakePlane:
+            def __init__(self, hang):
+                self.hang = hang
+
+            def launch_round(self):
+                return FakeHandle((("t", 0),))
+
+            def materialize(self, handle):
+                if self.hang:
+                    _time.sleep(5.0)
+                return {"t": {"u0": {}}}
+
+        d = PipelinedDispatcher(pipelined=True, timeout_s=0.2)
+        for k, hang in (("A", True), ("B", True), ("C", True)):
+            plane = FakePlane(hang)
+            d.dispatch(k, plane)
+        t0 = _time.perf_counter()
+        out = d.flush()
+        elapsed = _time.perf_counter() - t0
+        assert set(out) == {"A", "B", "C"}
+        assert all(isinstance(v, RoundTimeout) for v in out.values())
+        # one timeout paid, not three
+        assert elapsed < 2.0
+        assert d.stalls == 1
+
+    def test_leave_of_restored_evicted_tenant_without_bucket(self,
+                                                             ocp):
+        """A checkpoint-restored evicted tenant whose bucket was not
+        persisted (all members evicted at save time) must still leave
+        cleanly."""
+        from agentlib_mpc_tpu.serving import bucket_key
+
+        plane = make_plane(health_policy=HealthPolicy())
+        spec = make_spec(ocp, "ghost", 1.0)
+        key = bucket_key(spec)
+        plane._register_tenant("ghost", key, spec)
+        plane._evicted["ghost"] = key            # no bucket exists
+        plane.leave("ghost")
+        assert "ghost" not in plane.tenants
+        assert "ghost" not in plane.evicted_tenants
+        assert plane._guards == {} and plane._specs == {}
+
+    def test_probe_device_bounded_answers_on_live_backend(self):
+        from agentlib_mpc_tpu.serving.dispatch import (
+            probe_device_bounded,
+        )
+
+        assert probe_device_bounded(timeout_s=30.0) == \
+            jax.default_backend()
+
+
+class TestServeChaosConfig:
+    def test_unknown_option_rejected(self):
+        with pytest.raises(ValueError, match="unknown serve-chaos"):
+            ServeChaosConfig.from_dict({"nan_storms": []})
+
+    def test_from_dict_builds_rules(self):
+        cfg = ServeChaosConfig.from_dict({
+            "seed": 3,
+            "nan_storm": [{"tenant": "x", "start_round": 2,
+                           "n_rounds": 4}],
+            "stall": [{"call": 5, "duration_s": 1.0}],
+            "build_fail": [{"build": 0}],
+        })
+        assert cfg.nan_storm[0].matches("x")
+        assert cfg.nan_storm[0].triggered(2)
+        assert not cfg.nan_storm[0].triggered(6)
+        assert cfg.build_fail[0].triggered(0)
+        assert not cfg.build_fail[0].triggered(1)
+
+    def test_build_fail_propagates_from_join(self, ocp):
+        from agentlib_mpc_tpu.resilience.chaos import ChaosBuildError
+
+        plane = make_plane()
+        from agentlib_mpc_tpu.resilience.chaos import ServeBuildFailRule
+
+        ctl = install_serving_chaos(plane, ServeChaosConfig(
+            build_fail=(ServeBuildFailRule(build=0, n_builds=1),)))
+        with pytest.raises(ChaosBuildError):
+            plane.join(make_spec(ocp, "x", 1.0, max_iter=40))
+        ctl.uninstall()
+        # the failed build left no cache entry: the next join pays a
+        # real build and succeeds
+        rec = plane.join(make_spec(ocp, "x", 1.0, max_iter=40))
+        assert not rec.engine_cached
+        plane.leave("x")
+
+
+class TestGuardSnapshot:
+    def test_roundtrip_preserves_ladder_and_plan(self):
+        from agentlib_mpc_tpu.resilience.guard import (
+            ActuationGuard,
+            DegradationPolicy,
+        )
+
+        guard = ActuationGuard(DegradationPolicy(replay_steps=2))
+        guard.assess({"u0": {"u": 1.5},
+                      "traj": {"u": np.array([[1.5], [1.6], [1.7]])},
+                      "stats": {"success": True}})
+        guard.assess({"u0": {"u": float("nan")},
+                      "stats": {"success": False}})
+        clone = ActuationGuard(guard.policy)
+        clone.restore(guard.snapshot())
+        assert clone.level == guard.level
+        assert clone._unhealthy_streak == guard._unhealthy_streak
+        assert clone._last_controls == guard._last_controls
+        # the restored plan replays the same step next failure
+        d1 = guard.assess({"u0": {"u": 0.0},
+                           "stats": {"success": False}})
+        d2 = clone.assess({"u0": {"u": 0.0},
+                           "stats": {"success": False}})
+        assert d1.action == d2.action == "replay"
+        assert d1.controls == d2.controls
+
+
+@pytest.mark.chaos
+class TestChaosServeBench:
+    def test_chaos_serve_smoke(self):
+        """Fast ``--chaos-serve`` smoke: 2 tenants, reduced rounds —
+        the fault schedule runs, availability is measured, the crash
+        restore is all cache hits."""
+        import bench
+
+        out = bench.run_chaos_serve(seed=1, n_tenants=2, rounds=12)
+        assert out["metric"].startswith("serve_availability_pct")
+        assert 0 < out["value"] <= 100.0
+        assert out["mttr_ms"] is not None and out["mttr_ms"] > 0
+        assert out["restore_cold_builds"] == 0
+        assert out["evictions"] >= 1
+        assert out["chaos_events"]["serve_nan_theta"] >= 1
+
+    @pytest.mark.slow
+    def test_chaos_serve_full(self):
+        """Full-scale run: the stall fires inside the schedule too."""
+        import bench
+
+        out = bench.run_chaos_serve(seed=0, n_tenants=6, rounds=24)
+        assert out["restore_cold_builds"] == 0
+        assert out["watchdog_stalls"] >= 1
+        assert out["readmissions"] >= 1
+        assert out["value"] > 50.0
