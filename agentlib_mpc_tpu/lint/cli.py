@@ -16,6 +16,11 @@ Modes:
   (``[serving]`` in ``lint_budgets.toml``): zero warm traces/compiles
   across a scripted join→serve→leave→rejoin sequence, and the rejoin
   must be a compile-cache hit (imports jax).
+* ``--mesh-budget`` — run the sharded-step gate (``[mesh]``): zero warm
+  traces/compiles across control rounds of a ``shard_map``-sharded
+  fused fleet AND a join→serve→leave churn on a mesh-backed serving
+  plane, on an 8-virtual-device CPU mesh (imports jax; must run in a
+  fresh process so the device count can be requested).
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
   structure proof, dtype propagation, cost model) over the example-OCP
   menu against the ``[jaxpr.expect]`` expectations in
@@ -48,6 +53,10 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="run the serving-plane churn gate: zero "
                              "warm retraces across join/serve/leave/"
                              "rejoin, rejoin = compile-cache hit")
+    parser.add_argument("--mesh-budget", action="store_true",
+                        help="run the sharded-step gate: zero warm "
+                             "retraces of the shard_map fused fleet and "
+                             "the mesh serving churn (8 virtual devices)")
     parser.add_argument("--jaxpr", action="store_true",
                         help="run the semantic jaxpr certification "
                              "passes over the example-OCP menu")
@@ -83,6 +92,14 @@ def main(argv: "list[str] | None" = None) -> int:
         budgets = retrace_budget.load_budgets(args.budgets) \
             if args.budgets else None
         report = retrace_budget.run_serving_gate(budgets)
+        return 1 if report["violations"] or report["failures"] else 0
+
+    if args.mesh_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_mesh_gate(budgets)
         return 1 if report["violations"] or report["failures"] else 0
 
     if args.jaxpr:

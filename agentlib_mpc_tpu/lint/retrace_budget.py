@@ -114,6 +114,35 @@ def tracker_ocp():
                       method="multiple_shooting")
 
 
+def tracker_tenant_spec(ocp, tenant_id: str, a: float):
+    """One tracker tenant of the churn workload — the SINGLE definition
+    of the TenantSpec that ``run_serving_gate``, ``run_mesh_gate`` and
+    the serving-churn tests all script against (a drift here would let
+    the gates and the tests silently measure different workloads)."""
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.serving import TenantSpec
+
+    return TenantSpec(
+        tenant_id=tenant_id, ocp=ocp,
+        theta=ocp.default_params(p=jnp.array([a])),
+        couplings={"shared_u": "u"},
+        solver_options=SolverOptions(max_iter=30))
+
+
+def serve_tenants(plane, *tenants, rounds: int = 1) -> dict:
+    """One churn beat: submit for each tenant, serve ``rounds`` rounds,
+    flush the pipeline; returns the merged per-tenant results."""
+    for t in tenants:
+        plane.submit(t)
+    results: dict = {}
+    for _ in range(max(rounds, 1)):
+        results.update(plane.serve_round())
+    results.update(plane.flush())
+    return results
+
+
 def _compile_snapshot(reg) -> dict:
     """Per-entry-point (traces + compiles) totals — the quantity both
     gates budget."""
@@ -236,6 +265,177 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
     return report
 
 
+class _MeshGateSkipped(Exception):
+    """Internal control flow: the mesh gate's measurement legs were
+    skipped (single-device backend — the failure is already recorded)."""
+
+
+def run_mesh_gate(budgets: "dict | None" = None,
+                  verbose: bool = True) -> dict:
+    """``[mesh]`` budget gate: the sharded step's zero-retrace contract.
+
+    Builds the gate fleet SHARDED over the fleet mesh
+    (``FusedADMM(mesh=fleet_mesh())`` — the explicit ``shard_map`` path
+    with ``psum`` consensus), warms it, and holds the per-entry-point
+    (traces + compiles) delta across ``rounds`` further control steps to
+    the ``[mesh.budgets]`` allowance (default 0): the collectives, the
+    shard-local solves and the per-round ``admm_collective_seconds``
+    probe must all hold the same warm steady state as the single-device
+    step. A second measured leg churns a mesh-backed
+    ``ServingPlane(mesh=...)`` through join → serve → join → serve →
+    leave → serve (the ``[mesh.serving]`` budgets): membership on a
+    SHARDED engine is still data, never structure.
+
+    With no real multi-device backend, the gate requests 8 virtual CPU
+    devices — effective only before backend init, which is how both the
+    CLI (fresh process) and CI run it.
+    """
+    from agentlib_mpc_tpu.utils.jax_setup import request_virtual_devices
+
+    cfg = (budgets or load_budgets()).get("mesh", {})
+    # must precede any backend init to be honored (no-op afterwards)
+    request_virtual_devices(int(cfg.get("devices", 8)))
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    warmup = int(cfg.get("warmup_rounds", 2))
+    rounds = int(cfg.get("rounds", 3))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+    serving_cfg = dict(cfg.get("serving", {}) or {})
+    serving_budgets = dict(serving_cfg.get("budgets", {}) or {})
+    serving_default = int(serving_budgets.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+
+    failures: list = []
+    before = after = s_before = s_after = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel import fleet_mesh
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+            stack_params,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+
+        mesh = fleet_mesh()
+        n_dev = max(1, int(mesh.devices.size))
+        want = int(cfg.get("n_agents", 8))
+        n_agents = n_dev * max(1, -(-want // n_dev))
+        if n_dev < 2:
+            # a foregone exit-1: running the (minutes-long) legs over an
+            # unsharded path would prove nothing — report and stop
+            failures.append(
+                f"mesh gate ran on a single-device backend ({n_dev} "
+                f"device) — the sharded path was NOT exercised; set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                f"(or run the gate in a fresh process)")
+            raise _MeshGateSkipped
+
+        ocp = tracker_ocp()
+        group = AgentGroup(
+            name="mesh-gate", ocp=ocp, n_agents=n_agents,
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30))
+        engine = FusedADMM([group],
+                           FusedADMMOptions(max_iterations=8, rho=2.0),
+                           mesh=mesh)
+        thetas = [stack_params([
+            ocp.default_params(p=jnp.array([float(i + 1)]))
+            for i in range(n_agents)])]
+        state = engine.init_state(thetas)
+        for _ in range(max(warmup, 1)):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+
+        before = _compile_snapshot(reg)
+        for _ in range(rounds):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+        after = _compile_snapshot(reg)
+
+        # -- mesh serving leg: churn on a SHARDED bucket engine --------
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0), mesh=mesh,
+            pipelined=False, donate=False)
+
+        def spec(tid, a):
+            return tracker_tenant_spec(ocp, tid, a)
+
+        def serve(*tenants):
+            serve_tenants(plane, *tenants)
+
+        plane.join(spec("w0", 1.0))      # warmup: cold build + splices
+        serve("w0")
+        plane.leave("w0")
+        plane.join(spec("w0", 1.0))
+        serve("w0")
+        plane.leave("w0")
+        s_before = _compile_snapshot(reg)
+        plane.join(spec("m0", 1.0))
+        serve("m0")
+        plane.join(spec("m1", 2.0))
+        serve("m0", "m1")
+        plane.leave("m0")
+        serve("m1")
+        plane.leave("m1")
+        s_after = _compile_snapshot(reg)
+    except _MeshGateSkipped:
+        pass
+    finally:
+        telemetry.configure(enabled=was_enabled)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({"entry_point": entry, "observed": delta,
+                               "budget": budget})
+    serving_deltas = {k: s_after.get(k, 0) - s_before.get(k, 0)
+                      for k in set(s_before) | set(s_after)}
+    for entry, delta in sorted(serving_deltas.items()):
+        budget = int(serving_budgets.get(entry, serving_default))
+        if delta > budget:
+            violations.append({"entry_point": f"serving:{entry}",
+                               "observed": delta, "budget": budget})
+    report = {
+        "devices": len(jax.devices()),
+        "mesh_devices": n_dev,
+        "warmup_rounds": warmup,
+        "rounds": rounds,
+        "n_agents": n_agents,
+        "deltas": dict(sorted(deltas.items())),
+        "serving_deltas": dict(sorted(serving_deltas.items())),
+        "violations": violations,
+        "failures": failures,
+    }
+    if verbose:
+        for v in violations:
+            print(f"mesh-budget: {v['entry_point']!r} compiled/traced "
+                  f"{v['observed']}x warm (budget {v['budget']}) — the "
+                  f"sharded step is recompiling")
+        for f in failures:
+            print(f"mesh-budget: {f}")
+        if not violations and not failures:
+            print(f"mesh-budget: OK — zero excess compiles across "
+                  f"{rounds} sharded rounds ({n_agents} agents / "
+                  f"{n_dev} devices) and the mesh serving churn")
+    return report
+
+
 def run_serving_gate(budgets: "dict | None" = None,
                      verbose: bool = True) -> dict:
     """``[serving]`` budget gate: the serving plane's churn contract.
@@ -280,11 +480,8 @@ def run_serving_gate(budgets: "dict | None" = None,
 
     failures: list = []
     try:
-        import jax.numpy as jnp
-
-        from agentlib_mpc_tpu.ops.solver import SolverOptions
         from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
-        from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+        from agentlib_mpc_tpu.serving import ServingPlane
 
         ocp = tracker_ocp()
         plane = ServingPlane(
@@ -293,18 +490,10 @@ def run_serving_gate(budgets: "dict | None" = None,
             pipelined=True, donate=True)
 
         def spec(tid, a):
-            return TenantSpec(
-                tenant_id=tid, ocp=ocp,
-                theta=ocp.default_params(p=jnp.array([a])),
-                couplings={"shared_u": "u"},
-                solver_options=SolverOptions(max_iter=30))
+            return tracker_tenant_spec(ocp, tid, a)
 
         def serve(*tenants):
-            for t in tenants:
-                plane.submit(t)
-            for _ in range(serve_rounds):
-                plane.serve_round()
-            plane.flush()
+            serve_tenants(plane, *tenants, rounds=serve_rounds)
 
         # -- warmup: cover every program shape, including retirement --
         plane.join(spec("w0", 1.0))

@@ -29,14 +29,34 @@ means or residuals (``_agents_with_status``, ``admm_coordinator.py:347-351``).
 
 Everything here is jit/vmap-safe and works identically inside a
 ``shard_map``/``pjit`` program where the agent axis is sharded over a device
-mesh — there the ``mean`` lowers to an all-reduce over ICI.
+mesh — there the ``mean`` lowers to an all-reduce over ICI. Inside a
+``shard_map`` body pass ``axis_name=<mesh axis>``: every sum/norm over the
+agent axis then closes over the mesh with a ``lax.psum`` (the consensus
+mean IS the all-reduce), while per-agent outputs (multipliers, diffs) stay
+shard-local. Without ``axis_name`` the reductions are plain single-device
+sums — bit-identical to the pre-mesh behavior.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+from jax import lax
 import jax.numpy as jnp
+
+
+def _axis_sum(x, axis_name):
+    """Close a shard-local partial sum over the mesh axis (identity when
+    unsharded)."""
+    return x if axis_name is None else lax.psum(x, axis_name)
+
+
+def _axis_norm(arr, axis_name):
+    """l2 norm of a flattened array whose agent axis may be sharded:
+    shard-local sum of squares, psum, sqrt — every device gets the global
+    norm."""
+    sq = jnp.sum(arr.reshape(-1) ** 2)
+    return jnp.sqrt(_axis_sum(sq, axis_name))
 
 
 def _active_mask(locals_, active):
@@ -45,13 +65,14 @@ def _active_mask(locals_, active):
     return active.astype(locals_.dtype)
 
 
-def _masked_mean(locals_, active):
-    """Mean over the agent axis counting only active agents."""
+def _masked_mean(locals_, active, axis_name=None):
+    """Mean over the (possibly mesh-sharded) agent axis counting only
+    active agents."""
     m = _active_mask(locals_, active)
     mshape = (-1,) + (1,) * (locals_.ndim - 1)
     w = m.reshape(mshape)
-    count = jnp.maximum(jnp.sum(m), 1.0)
-    return jnp.sum(locals_ * w, axis=0) / count
+    count = jnp.maximum(_axis_sum(jnp.sum(m), axis_name), 1.0)
+    return _axis_sum(jnp.sum(locals_ * w, axis=0), axis_name) / count
 
 
 class ConsensusState(NamedTuple):
@@ -82,15 +103,20 @@ class AdmmResiduals(NamedTuple):
     n_dual: jnp.ndarray
 
 
-def consensus_update(locals_, state: ConsensusState,
-                     active=None) -> tuple[ConsensusState, AdmmResiduals]:
+def consensus_update(locals_, state: ConsensusState, active=None,
+                     axis_name=None) -> tuple[ConsensusState, AdmmResiduals]:
     """One consensus-ADMM global step from the stacked local solutions.
 
     z̄⁺ = mean_i x_i;  λ_i⁺ = λ_i − ρ (z̄⁺ − x_i)
     primal residual = ‖stack_i (z̄⁺ − x_i)‖;  dual = ‖ρ (z̄⁺ − z̄)‖
     (reference: ``admm_datatypes.py:221-267`` and residuals at ``:202-214``).
+
+    With ``axis_name`` the agent axis of ``locals_``/``state.lam`` is the
+    shard-local slice of a mesh-sharded batch: the mean and every
+    agent-axis norm reduce over the mesh via ``psum`` (identical on every
+    device up to reduction order), while ``lam`` stays shard-local.
     """
-    zbar_new = _masked_mean(locals_, active)
+    zbar_new = _masked_mean(locals_, active, axis_name)
     m = _active_mask(locals_, active)
     mshape = (-1,) + (1,) * (locals_.ndim - 1)
     w = m.reshape(mshape)
@@ -99,28 +125,33 @@ def consensus_update(locals_, state: ConsensusState,
     # masked-out agents keep their multiplier
     lam_new = jnp.where(w > 0, lam_new, state.lam)
     res = AdmmResiduals(
-        primal=jnp.linalg.norm(prim_per_agent.reshape(-1)),
+        primal=_axis_norm(prim_per_agent, axis_name),
         dual=jnp.linalg.norm(
             (state.rho * (zbar_new - state.zbar)).reshape(-1)),
         scale_primal=jnp.maximum(
-            jnp.linalg.norm((locals_ * w).reshape(-1)),
+            _axis_norm(locals_ * w, axis_name),
             jnp.linalg.norm(zbar_new.reshape(-1))),
-        scale_dual=jnp.linalg.norm((lam_new * w).reshape(-1)),
-        n_primal=jnp.sum(m) * zbar_new.size,
-        n_dual=jnp.sum(m) * zbar_new.size,
+        scale_dual=_axis_norm(lam_new * w, axis_name),
+        n_primal=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
+        n_dual=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
     )
     return ConsensusState(zbar=zbar_new, lam=lam_new, rho=state.rho), res
 
 
-def exchange_update(locals_, state: ExchangeState,
-                    active=None) -> tuple[ExchangeState, AdmmResiduals]:
+def exchange_update(locals_, state: ExchangeState, active=None,
+                    axis_name=None) -> tuple[ExchangeState, AdmmResiduals]:
     """One exchange-ADMM global step.
 
     mean⁺ = mean_i x_i;  diff_i⁺ = x_i − mean⁺;  λ⁺ = λ + ρ mean⁺
     primal residual = ‖mean⁺‖ (resource balance);  dual = ‖ρ Δmean‖
     (reference: ``admm_datatypes.py:285-331``).
+
+    ``axis_name`` marks the agent axis as a shard-local slice of a
+    mesh-sharded batch (see :func:`consensus_update`); the shared
+    multiplier update then runs on the psum'ed mean, replicated across
+    devices, while ``diff`` stays shard-local.
     """
-    mean_new = _masked_mean(locals_, active)
+    mean_new = _masked_mean(locals_, active, axis_name)
     m = _active_mask(locals_, active)
     w = m.reshape((-1,) + (1,) * (locals_.ndim - 1))
     diff_new = jnp.where(w > 0, locals_ - mean_new[None, ...], state.diff)
@@ -129,11 +160,11 @@ def exchange_update(locals_, state: ExchangeState,
         primal=jnp.linalg.norm(mean_new.reshape(-1)),
         dual=jnp.linalg.norm((state.rho * (mean_new - state.mean)).reshape(-1)),
         scale_primal=jnp.maximum(
-            jnp.linalg.norm((locals_ * w).reshape(-1)),
+            _axis_norm(locals_ * w, axis_name),
             jnp.linalg.norm(mean_new.reshape(-1))),
         scale_dual=jnp.linalg.norm(lam_new.reshape(-1)),
         n_primal=jnp.asarray(mean_new.size, locals_.dtype),
-        n_dual=jnp.sum(m) * mean_new.size,
+        n_dual=_axis_sum(jnp.sum(m), axis_name) * mean_new.size,
     )
     return ExchangeState(mean=mean_new, diff=diff_new, lam=lam_new,
                          rho=state.rho), res

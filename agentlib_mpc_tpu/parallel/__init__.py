@@ -7,6 +7,8 @@ from agentlib_mpc_tpu.parallel.multihost import (
     fleet_mesh,
     host_local_batch,
     initialize_multihost,
+    serving_slot_multiple,
+    shard_multiple,
 )
 
 

@@ -18,10 +18,23 @@ a global alias; each group maps the alias to one of its control inputs —
 the analogue of the reference's AgentVariable alias matching on the broker
 (``data_structures/admm_datatypes.py:26-77``).
 
-On a multi-chip mesh, shard each group's agent axis with
-``jax.sharding.NamedSharding(mesh, P("agents"))`` (see
-``FusedADMM.shard_args``); the coupling means then lower to all-reduces
-over ICI — the reference's broker traffic becomes one collective.
+On a multi-chip mesh there are two execution paths:
+
+* **Explicit sharding (the production path)** — build the engine with
+  ``FusedADMM(groups, options, mesh=multihost.fleet_mesh())``. The whole
+  fused round is a ``shard_map`` over the 1-D agent axis: every group's
+  vmapped augmented solves run shard-local (the LLC-bound batched KKT
+  factor working set is split across devices — the round-6 per-core
+  ceiling, PERF.md), and the one cross-agent dependency — the ADMM
+  consensus/exchange mean — lowers to ``lax.psum`` over the mesh axis
+  inside the fused ``while_loop``: one all-reduce family per ADMM
+  iteration, the reference's whole broker round as a collective. Group
+  sizes must divide the mesh (:func:`pad_group_to_devices` pads uneven
+  fleets; masked lanes are dead weight, never wrong answers).
+* **GSPMD by placement** — shard inputs with :meth:`FusedADMM.shard_args`
+  on a mesh-less engine and let XLA propagate the partitioning through
+  the jitted step. Kept as the fallback seam; the explicit path is what
+  the mesh A/B (``bench.py --mesh-ab``) measures.
 
 Heterogeneous fleets — the pad/bucket strategy (SURVEY §7 hard part
 "vmap across heterogeneous agents"):
@@ -48,6 +61,8 @@ Heterogeneous fleets — the pad/bucket strategy (SURVEY §7 hard part
 from __future__ import annotations
 
 import dataclasses
+import logging
+import time
 from typing import Any, NamedTuple, Sequence
 
 import jax
@@ -69,6 +84,8 @@ from agentlib_mpc_tpu.ops.solver import (
     solve_nlp,
 )
 from agentlib_mpc_tpu.ops.transcription import OCPParams, TranscribedOCP
+
+logger = logging.getLogger(__name__)
 
 
 def stack_params(thetas: Sequence[OCPParams]) -> OCPParams:
@@ -227,7 +244,8 @@ class FusedADMM:
                  options: FusedADMMOptions = FusedADMMOptions(),
                  active: "Sequence[jnp.ndarray] | None" = None,
                  record_locals: bool = False,
-                 donate_state: bool = False):
+                 donate_state: bool = False,
+                 mesh=None):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -251,7 +269,16 @@ class FusedADMM:
         caller that re-reads or re-passes the same ``FusedState`` object
         after the step (tests, exploratory sessions) would hit a
         deleted-buffer error. The serving dispatcher, which threads the
-        state linearly by construction, turns it on."""
+        state linearly by construction, turns it on.
+        ``mesh``: a 1-D ``jax.sharding.Mesh`` (``multihost.fleet_mesh``) —
+        the step becomes an explicit ``shard_map`` over the agent axis
+        with the consensus/exchange means as ``lax.psum`` collectives
+        (module docstring "Explicit sharding"). Every group's
+        ``n_agents`` must divide the mesh device count
+        (:func:`pad_group_to_devices`); ``record_locals`` is
+        incompatible (the per-iteration history buffers are indexed by
+        global participant row, which a shard-local body cannot
+        address)."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -297,9 +324,83 @@ class FusedADMM:
         self.donate_state = bool(donate_state)
         if self.donate_state:
             _suppress_unusable_donation_warning()
-        self._step = jax.jit(
-            self._build_step(),
-            donate_argnums=(0,) if self.donate_state else ())
+        self.mesh = mesh
+        self._collective_probe = None
+        self._compile_step()
+
+    def _compile_step(self) -> None:
+        """(Re)build the compiled step for the current groups — plain
+        jit without a mesh, jit-of-``shard_map`` with one. The one seam
+        :meth:`shard_args`' padding rebuild reuses."""
+        donate = (0,) if self.donate_state else ()
+        if self.mesh is None:
+            self._step = jax.jit(self._build_step(), donate_argnums=donate)
+            return
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"FusedADMM shards the agent axis over a 1-D mesh; got "
+                f"axes {mesh.axis_names} (use multihost.fleet_mesh())")
+        if self.record_locals:
+            raise ValueError(
+                "record_locals is incompatible with mesh execution: the "
+                "per-iteration history buffers index global participant "
+                "rows, which a shard-local body cannot address — build "
+                "the engine without a mesh for analysis/animation runs")
+        axis = mesh.axis_names[0]
+        n_dev = int(mesh.devices.size)
+        for g in self.groups:
+            if g.n_agents % n_dev:
+                raise ValueError(
+                    f"group {g.name!r} has {g.n_agents} agents, not a "
+                    f"multiple of the {n_dev}-device mesh — pad it first "
+                    f"(parallel.fused_admm.pad_group_to_devices; padded "
+                    f"lanes ride the active mask)")
+
+        sh, rep = P(axis), P()
+        state_spec = FusedState(
+            zbar=rep, lam=sh, ex_mean=rep, ex_diff=sh, ex_lam=rep,
+            rho=rep, w=sh, y=sh, z=sh)
+        per_group_sh = tuple(sh for _ in self.groups)
+        stats_spec = IterationStats(
+            iterations=rep, primal_residuals=rep, dual_residuals=rep,
+            penalty=rep, converged=rep, local_solves_ok=rep,
+            coupling_locals=rep, exchange_locals=rep, quarantined=rep,
+            # the per-lane attribution is the ONE sharded stats leaf;
+            # with quarantine off the body returns None there, which a
+            # tuple-of-specs prefix cannot match — use a bare replicated
+            # spec so the empty subtree matches
+            lane_quarantined=(per_group_sh if self.options.quarantine
+                              else rep))
+        step_fn = self._build_step(axis_name=axis, n_shards=n_dev)
+        # check_rep=False: the body's replicated outputs (psum'ed
+        # residuals, means, histories) are replicated by construction,
+        # but the checker cannot see that through while_loop carries
+        sharded = shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(state_spec, per_group_sh, per_group_sh),
+            out_specs=(state_spec, per_group_sh, stats_spec),
+            check_rep=False)
+        self._step = jax.jit(sharded, donate_argnums=donate)
+        # consensus-shaped mesh-collective probe (the shared
+        # multihost.collective_probe builder — compiled and warmed so
+        # the per-round admm_collective_seconds timing never pays, or
+        # miscounts as, a trace). In-graph collective time is not
+        # host-observable; this measures the collective primitive's
+        # round-trip on the real mesh (a mesh-health floor, not the
+        # in-step collectives' own duration).
+        from agentlib_mpc_tpu.parallel.multihost import collective_probe
+
+        self._collective_probe = collective_probe(mesh, self.T)
+        if telemetry.enabled():
+            telemetry.gauge(
+                "fleet_mesh_devices",
+                "devices in the fused fleet's agent-sharding mesh"
+                ).set(float(n_dev))
 
     @staticmethod
     def _with_stage_partition(g: AgentGroup) -> AgentGroup:
@@ -404,12 +505,24 @@ class FusedADMM:
             offs += self.groups[gj].n_agents
         raise KeyError(f"group {gi} does not participate in {alias!r}")
 
-    def _build_step(self):
+    def _build_step(self, axis_name: "str | None" = None,
+                    n_shards: int = 1):
+        """Build the (untransformed) step body. With ``axis_name`` the
+        body is written for a ``shard_map`` context: per-agent batches
+        arrive as 1/``n_shards`` shard-local slices, agent-axis
+        reductions (consensus/exchange means, residual norms, health
+        counts) close over the mesh via ``lax.psum``, and everything
+        else is untouched — one body, both execution paths."""
         groups = self.groups
         opts = self.options
         aliases = self._aliases
         ex_aliases = self._ex_aliases
         n_groups = len(groups)
+
+        def n_loc(g: AgentGroup) -> int:
+            # per-agent batch size as the BODY sees it (shard-local
+            # under shard_map, global otherwise)
+            return g.n_agents // n_shards
 
         # per group: which (alias, kind, u-column) augment its objective
         aug_map = []
@@ -592,7 +705,7 @@ class FusedADMM:
                     # multiplier is shared (admm.py:102-116)
                     glob = state.ex_diff[alias][slot]  # (n_i, T) per agent
                     lam = jnp.broadcast_to(state.ex_lam[alias],
-                                           (g.n_agents, self.T))
+                                           (n_loc(g), self.T))
                 slices.append((glob, lam, state.rho[alias], kind))
 
             inner = solve_qp if group_uses_qp[gi] else solve_nlp
@@ -694,7 +807,7 @@ class FusedADMM:
                 q_streak_new = []
                 q_lane_new = []
                 n_quarantined = jnp.asarray(0, jnp.int32)
-                ok_all = jnp.asarray(True)
+                n_failed = jnp.asarray(0, jnp.int32)
                 for gi in range(n_groups):
                     cold_opts = groups[gi].solver_options
                     warm_mu = (groups[gi].warm_solver_options.mu_init
@@ -732,8 +845,15 @@ class FusedADMM:
                     y_new.append(y_b)
                     z_new.append(z_b)
                     u_groups.append(u_b)
-                    # padded lanes may fail to converge without penalty
-                    ok_all = ok_all & jnp.all(ok_b | ~active[gi])
+                    # padded lanes may fail to converge without penalty;
+                    # counted (not jnp.all'ed) so one psum closes the
+                    # health flag over the mesh
+                    n_failed = n_failed + jnp.sum(
+                        ~(ok_b | ~active[gi]), dtype=jnp.int32)
+                if axis_name is not None:
+                    n_quarantined = jax.lax.psum(n_quarantined, axis_name)
+                    n_failed = jax.lax.psum(n_failed, axis_name)
+                ok_all = n_failed == 0
 
                 residuals = []
                 alias_residuals = {}
@@ -755,15 +875,15 @@ class FusedADMM:
                     cstate = admm_ops.ConsensusState(
                         zbar=state.zbar[alias], lam=lam_stack,
                         rho=state.rho[alias])
-                    cnew, res = admm_ops.consensus_update(locals_, cstate,
-                                                          active=act)
+                    cnew, res = admm_ops.consensus_update(
+                        locals_, cstate, active=act, axis_name=axis_name)
                     residuals.append(res)
                     alias_residuals[alias] = res
                     zbar_new[alias] = cnew.zbar
                     offs = 0
                     pieces = []
                     for gi, _col, _slot in parts:
-                        n_i = groups[gi].n_agents
+                        n_i = n_loc(groups[gi])
                         pieces.append(cnew.lam[offs:offs + n_i])
                         offs += n_i
                     lam_new[alias] = tuple(pieces)
@@ -787,8 +907,8 @@ class FusedADMM:
                     estate = admm_ops.ExchangeState(
                         mean=state.ex_mean[alias], diff=diff_stack,
                         lam=state.ex_lam[alias], rho=state.rho[alias])
-                    enew, res = admm_ops.exchange_update(locals_, estate,
-                                                         active=act)
+                    enew, res = admm_ops.exchange_update(
+                        locals_, estate, active=act, axis_name=axis_name)
                     residuals.append(res)
                     alias_residuals[alias] = res
                     ex_mean_new[alias] = enew.mean
@@ -796,7 +916,7 @@ class FusedADMM:
                     offs = 0
                     pieces = []
                     for gi, _col, _slot in parts:
-                        n_i = groups[gi].n_agents
+                        n_i = n_loc(groups[gi])
                         pieces.append(enew.diff[offs:offs + n_i])
                         offs += n_i
                     ex_diff_new[alias] = tuple(pieces)
@@ -850,10 +970,10 @@ class FusedADMM:
                 if record else {}
             rho_hist0 = {a: jnp.full((max_it,), jnp.nan)
                          for a in (*aliases, *ex_aliases)}
-            q_streak0 = tuple(jnp.zeros((g.n_agents,), jnp.int32)
+            q_streak0 = tuple(jnp.zeros((n_loc(g),), jnp.int32)
                               for g in groups)
             q_hist0 = jnp.zeros((max_it,), jnp.int32)
-            q_lane0 = tuple(jnp.zeros((g.n_agents,), jnp.int32)
+            q_lane0 = tuple(jnp.zeros((n_loc(g),), jnp.int32)
                             for g in groups)
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan),
@@ -930,8 +1050,41 @@ class FusedADMM:
         with telemetry.span("admm.fused_step",
                             groups=",".join(g.name for g in self.groups)):
             out = self._step(state, tuple(theta_batches), masks)
+        # _record_round first: reading the stats blocks until the (async
+        # dispatched) round completes, so the probe below times an IDLE
+        # mesh — probing before would enqueue the pmean behind the
+        # still-running step and record the step's tail as "collective
+        # latency"
         self._record_round(out[2])
+        if self._collective_probe is not None:
+            self._record_collective_probe()
         return out
+
+    def _record_collective_probe(self) -> None:
+        """Per-round mesh-collective observability: time one
+        consensus-shaped ``pmean`` over the engine's mesh (compiled and
+        warmed at engine build — no trace can hide in the timing) and
+        record it as the ``admm.collective`` span plus the
+        ``admm_collective_seconds`` histogram. The in-step collectives'
+        own time is fused into the XLA program and not host-observable;
+        this measures the collective primitive's round-trip on the real
+        mesh — the latency floor a consensus iteration pays, and the
+        first number to move when a mesh link degrades."""
+        probe, x = self._collective_probe
+        with telemetry.span("admm.collective",
+                            devices=str(int(self.mesh.devices.size))):
+            t0 = time.perf_counter()
+            jax.block_until_ready(probe(x))
+            dt = time.perf_counter() - t0
+        telemetry.histogram(
+            "admm_collective_seconds",
+            "measured round-trip of one consensus-shaped pmean over the "
+            "fleet mesh (per served round; a mesh-health probe, not the "
+            "in-step collectives' own duration)").observe(dt)
+        telemetry.gauge(
+            "fleet_mesh_devices",
+            "devices in the fused fleet's agent-sharding mesh"
+            ).set(float(int(self.mesh.devices.size)))
 
     def _record_round(self, stats: IterationStats) -> None:
         """Mirror one round's IterationStats into the telemetry registry."""
@@ -980,48 +1133,105 @@ class FusedADMM:
             buckets=telemetry.ITERATION_BUCKETS
             ).observe(float(n_it), fleet=fleet)
 
+    def _pad_for_mesh(self, n_dev: int, pads: "dict[int, int]",
+                      state: FusedState,
+                      theta_batches: Sequence[OCPParams]):
+        """Grow every non-divisible group's agent axis to the next
+        multiple of ``n_dev``: padded lanes repeat the last agent's
+        parameters/iterates and are mask-extended OFF (the
+        :func:`pad_group_to_devices` contract — dead weight, never
+        wrong answers). Mutates the engine (groups, default masks,
+        recompiled step) and returns the padded (state, thetas)."""
+        total = sum(g.n_agents for g in self.groups)
+        n_pad = sum(pads.values())
+        logger.warning(
+            "fused fleet: group(s) %s do not divide the %d-device mesh; "
+            "padding %d masked lane(s) (%.1f%% compute overhead) instead "
+            "of replicating — the step re-traces once for the padded "
+            "shapes",
+            [g.name for gi, g in enumerate(self.groups) if pads[gi]],
+            n_dev, n_pad, 100.0 * n_pad / max(total, 1))
+
+        def pad_rows(leaf, gi):
+            if not pads[gi]:
+                return leaf
+            return jnp.concatenate(
+                [leaf, jnp.repeat(leaf[-1:], pads[gi], axis=0)], axis=0)
+
+        lam = {a: tuple(
+            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "consensus"), pieces))
+            for a, pieces in state.lam.items()}
+        ex_diff = {a: tuple(
+            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "exchange"), pieces))
+            for a, pieces in state.ex_diff.items()}
+        state = state._replace(
+            w=tuple(pad_rows(state.w[gi], gi)
+                    for gi in range(len(self.groups))),
+            y=tuple(pad_rows(state.y[gi], gi)
+                    for gi in range(len(self.groups))),
+            z=tuple(pad_rows(state.z[gi], gi)
+                    for gi in range(len(self.groups))),
+            lam=lam, ex_diff=ex_diff)
+        theta_batches = tuple(
+            jax.tree.map(lambda leaf, gi=gi: pad_rows(leaf, gi), theta)
+            for gi, theta in enumerate(theta_batches))
+        # the qp routing already resolved per structure (n_agents does
+        # not enter it) — force the cached decisions so the rebuild
+        # never re-certifies
+        uses_qp = getattr(self, "group_uses_qp", None)
+        self.groups = tuple(
+            dataclasses.replace(
+                g, n_agents=g.n_agents + pads[gi],
+                **({} if uses_qp is None else
+                   {"qp_fast_path": "on" if uses_qp[gi] else "off"}))
+            for gi, g in enumerate(self.groups))
+        self.active = tuple(
+            jnp.concatenate([a, jnp.zeros((pads[gi],), bool)])
+            for gi, a in enumerate(self.active))
+        # cached serving helpers are shaped for the old capacity
+        self.__dict__.pop("_serving_helpers", None)
+        self._compile_step()
+        return state, theta_batches
+
     def shard_args(self, mesh, state: FusedState,
                    theta_batches: Sequence[OCPParams]):
         """Place agent-batched leaves on `mesh` sharded over its first axis
         (agents); replicated leaves (means, shared multipliers, rho) go
-        everywhere. Groups whose size does not divide the mesh stay
-        replicated."""
+        everywhere. Groups whose size does not divide the mesh are PADDED
+        to the next shard multiple (masked dead lanes, one logged warning
+        stating the overhead — see :meth:`_pad_for_mesh`) instead of the
+        old silent replication fallback; call ``shard_args`` before the
+        first step so the one padding re-trace replaces, not follows, the
+        cold trace."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         axis = mesh.axis_names[0]
         n_dev = mesh.shape[axis]
+        pads = {gi: (-g.n_agents) % n_dev
+                for gi, g in enumerate(self.groups)}
+        if any(pads.values()):
+            state, theta_batches = self._pad_for_mesh(
+                n_dev, pads, state, theta_batches)
         repl = NamedSharding(mesh, P())
+        sharded = NamedSharding(mesh, P(axis))
 
-        def shard_group(gi, leaf):
-            if groups_divisible[gi]:
-                return jax.device_put(
-                    leaf, NamedSharding(mesh, P(axis)))
-            return jax.device_put(leaf, repl)
+        def shard(leaf):
+            return jax.device_put(leaf, sharded)
 
-        groups_divisible = [g.n_agents % n_dev == 0 for g in self.groups]
-        w = tuple(shard_group(gi, state.w[gi])
-                  for gi in range(len(self.groups)))
-        y = tuple(shard_group(gi, state.y[gi])
-                  for gi in range(len(self.groups)))
-        z = tuple(shard_group(gi, state.z[gi])
-                  for gi in range(len(self.groups)))
-        lam = {a: tuple(
-            shard_group(gi, piece) for (gi, _c, _s), piece in zip(
-                self._group_participations(a, "consensus"), pieces))
-            for a, pieces in state.lam.items()}
-        ex_diff = {a: tuple(
-            shard_group(gi, piece) for (gi, _c, _s), piece in zip(
-                self._group_participations(a, "exchange"), pieces))
-            for a, pieces in state.ex_diff.items()}
         state = state._replace(
-            w=w, y=y, z=z, lam=lam, ex_diff=ex_diff,
+            w=jax.tree.map(shard, state.w),
+            y=jax.tree.map(shard, state.y),
+            z=jax.tree.map(shard, state.z),
+            lam=jax.tree.map(shard, state.lam),
+            ex_diff=jax.tree.map(shard, state.ex_diff),
             zbar=jax.device_put(state.zbar, repl),
             ex_mean=jax.device_put(state.ex_mean, repl),
             ex_lam=jax.device_put(state.ex_lam, repl),
             rho=jax.device_put(state.rho, repl))
-        thetas = tuple(
-            jax.tree.map(lambda leaf, gi=gi: shard_group(gi, leaf), theta)
-            for gi, theta in enumerate(theta_batches))
+        thetas = tuple(jax.tree.map(shard, theta)
+                       for theta in theta_batches)
         return state, thetas
 
 

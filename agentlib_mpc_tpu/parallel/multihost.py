@@ -28,25 +28,42 @@ of the single-controller API:
   pair, not once per chip pair (the "ride ICI, not DCN" rule of the
   scaling playbook).
 
-Typical multi-host launch (same script on every host)::
+Typical multi-host launch (same script on every host; the "Scaling out"
+recipe in docs/API.md)::
 
     from agentlib_mpc_tpu.parallel import multihost
 
     multihost.initialize_multihost()          # reads JAX_COORDINATOR etc.
     mesh = multihost.fleet_mesh()
-    engine = FusedADMM(groups, options)
+    # groups padded to the shard multiple (pad_group_to_devices) so the
+    # agent axis divides the mesh — mesh engines REQUIRE divisibility
+    engine = FusedADMM(groups, options, active=masks, mesh=mesh)
     state, thetas = engine.shard_args(mesh, engine.init_state(thetas),
                                       thetas)
     state, trajs, stats = engine.step(state, thetas)
 
-Every process executes the same jitted step; XLA inserts the cross-host
-collectives. There is no coordinator process in the data plane — the
-ADMM "coordinator" of the reference's star topology becomes a mean
-(all-reduce) inside the program.
+Every process executes the same jitted step. With ``mesh=`` the step is
+an explicit ``shard_map`` over the agent axis: the per-group vmapped
+augmented solves run shard-local and the ADMM consensus/exchange means
+lower to ``lax.psum`` over the mesh axis — one all-reduce family per
+ADMM iteration. Without ``mesh=``, ``shard_args`` placement leaves the
+partitioning to XLA's GSPMD propagation. Either way there is no
+coordinator process in the data plane — the ADMM "coordinator" of the
+reference's star topology becomes a mean (all-reduce) inside the
+program.
+
+**The shard-multiple contract**: every per-agent batch a sharded engine
+touches (group agent axes, serving slot capacities) must be a multiple
+of :func:`shard_multiple` (= the mesh device count). Pad uneven fleets
+with :func:`~agentlib_mpc_tpu.parallel.fused_admm.pad_group_to_devices`
+— padded lanes ride the masks and are dead weight, never wrong answers
+— and build serving capacities at :func:`serving_slot_multiple`
+granularity so a serving bucket can sit on a sharded engine unchanged.
 """
 
 from __future__ import annotations
 
+import math
 import os
 
 import jax
@@ -121,7 +138,45 @@ def fleet_mesh(axis: str = "agents", devices=None) -> Mesh:
     return Mesh(devices, (axis,))
 
 
-def serving_slot_multiple() -> int:
+def collective_probe(mesh: Mesh, horizon: int):
+    """(compiled pmean, input) — one consensus-shaped collective over
+    ``mesh``: a (T,)-trajectory ``pmean`` across the mesh axis, the
+    exact cross-agent dependency of one fused ADMM iteration. ONE
+    builder shared by the engine's per-round ``admm_collective_seconds``
+    probe (``FusedADMM``) and ``bench.py --emit-metrics``'s ``mesh``
+    section, so the two numbers can never drift apart structurally.
+    The returned callable is compiled AND warmed — timing a call never
+    includes a trace."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    axis = mesh.axis_names[0]
+    probe = jax.jit(shard_map(
+        lambda x: jax.lax.pmean(x, axis), mesh=mesh,
+        in_specs=P(axis), out_specs=P(), check_rep=False))
+    x = jnp.zeros((int(mesh.devices.size), max(int(horizon), 1)))
+    jax.block_until_ready(probe(x))
+    return probe, x
+
+
+def shard_multiple(mesh: "Mesh | None" = None) -> int:
+    """Agent-axis granularity a sharded engine requires.
+
+    A ``FusedADMM(..., mesh=mesh)`` engine splits every per-agent batch
+    into equal per-device shards, so group sizes must be a multiple of
+    the mesh device count (``pad_group_to_devices`` pads uneven fleets).
+    Without a mesh this is the global device count — the divisibility
+    rule :meth:`FusedADMM.shard_args` and :func:`host_local_batch` apply
+    to GSPMD placement.
+    """
+    if mesh is not None:
+        return max(1, int(mesh.devices.size))
+    return max(1, len(jax.devices()))
+
+
+def serving_slot_multiple(mesh: "Mesh | None" = None) -> int:
     """Slot-count granularity for the serving plane's padded groups.
 
     Capacities that are a multiple of the global device count let
@@ -129,8 +184,17 @@ def serving_slot_multiple() -> int:
     replicating it (the :func:`host_local_batch` divisibility rule), so
     the serving plane rounds every bucket's capacity up to this. On a
     single-device host this is 1 and the rounding is a no-op.
+
+    With ``mesh`` the multiple is ``lcm(device count, mesh size)``: a
+    serving bucket built at this granularity is splice-compatible with a
+    sharded engine (every capacity divides the mesh) AND with GSPMD
+    placement over the full device set — mesh-backed serving planes
+    (``ServingPlane(mesh=...)``) size their buckets with this.
     """
-    return max(1, len(jax.devices()))
+    base = max(1, len(jax.devices()))
+    if mesh is None:
+        return base
+    return math.lcm(base, shard_multiple(mesh))
 
 
 def host_local_batch(n_agents_global: int) -> tuple[int, int]:

@@ -114,13 +114,28 @@ class ServingPlane:
                  health_policy: "HealthPolicy | None" = None,
                  watchdog_timeout_s: "float | None" = None,
                  max_engines: "int | None" = None,
-                 cache: "CompileCache | None" = None):
+                 cache: "CompileCache | None" = None,
+                 mesh=None):
+        #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
+        #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
+        #: slot capacities are rounded to the mesh-aware
+        #: ``serving_slot_multiple(mesh)`` so joins/leaves stay lane
+        #: splices on the sharded engine — a serving bucket sits on a
+        #: sharded engine unchanged
+        self.mesh = mesh
         if slot_multiple is None:
             from agentlib_mpc_tpu.parallel.multihost import (
                 serving_slot_multiple,
             )
 
-            slot_multiple = serving_slot_multiple()
+            slot_multiple = serving_slot_multiple(mesh)
+        elif mesh is not None and \
+                int(slot_multiple) % max(1, int(mesh.devices.size)):
+            raise ValueError(
+                f"slot_multiple={slot_multiple} is not a multiple of "
+                f"the {int(mesh.devices.size)}-device mesh — sharded "
+                f"bucket capacities must divide the mesh "
+                f"(multihost.serving_slot_multiple(mesh))")
         # "auto" resolves by backend (the fused_ls_jacobian pattern): the
         # depth-1 pipeline + donated carry pay off where the device
         # executes while the host decodes (accelerators); on CPU the
@@ -249,7 +264,8 @@ class ServingPlane:
             capacity = max(self.initial_capacity,
                            self.slot_multiple
                            * math.ceil(n_needed / self.slot_multiple))
-        engine_key = (key, capacity, self._options_key(), self.donate)
+        engine_key = (key, capacity, self._options_key(), self.donate,
+                      self._mesh_key())
 
         def build():
             group = AgentGroup(
@@ -263,7 +279,7 @@ class ServingPlane:
             engine = FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
-                donate_state=self.donate)
+                donate_state=self.donate, mesh=self.mesh)
             if self.warm_on_build:
                 # pay trace+compile NOW so the cold/cached join-latency
                 # split is honest and the first served round is warm.
@@ -299,6 +315,15 @@ class ServingPlane:
         rho_key = tuple(sorted(rho.items())) if isinstance(rho, dict) \
             else float(rho)
         return opts._replace(rho=rho_key)
+
+    def _mesh_key(self):
+        """Hashable mesh identity for the engine cache: a sharded and an
+        unsharded engine of the same structure are DIFFERENT compiled
+        programs and must never alias in the cache."""
+        if self.mesh is None:
+            return None
+        return (self.mesh.axis_names,
+                tuple(d.id for d in self.mesh.devices.flat))
 
     # -- tenant health: evict / readmit ---------------------------------------
 

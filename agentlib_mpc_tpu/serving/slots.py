@@ -21,6 +21,14 @@ Because capacity, shapes and dtypes never change across join/leave, the
 warm executable serves every membership state of the bucket — the
 ``[serving]`` retrace budget pins this at zero warm retraces across a
 scripted join→serve→leave→rejoin churn sequence.
+
+The same contract holds on a device mesh: a ``ServingPlane(mesh=...)``
+builds its bucket engines sharded (``FusedADMM(mesh=...)``) at
+capacities rounded to ``multihost.serving_slot_multiple(mesh)`` — every
+capacity divides the mesh, so the slot plane's lane splices and mask
+flips land on a shard_map'ed step without any shape change, and churn
+stays zero-retrace on the sharded engine too (the ``[mesh]`` budget's
+serving leg pins it).
 """
 
 from __future__ import annotations
@@ -128,7 +136,16 @@ class SlotPlane:
         # per-plane COPY: with a donated engine the first step consumes
         # its input state's buffers — the cached template must never be
         # the object handed to step
-        self.state = jax.tree.map(jnp.copy, helpers["state_template"])
+        state = jax.tree.map(jnp.copy, helpers["state_template"])
+        if getattr(engine, "mesh", None) is not None:
+            # pre-place state and thetas on the engine's mesh so the
+            # FIRST served round already runs the sharded-input
+            # executable — without this the bucket would compile (and
+            # keep) two step variants, one for the unsharded template
+            # inputs and one for everything after round 1
+            state, (self.theta_batch,) = engine.shard_args(
+                engine.mesh, state, [self.theta_batch])
+        self.state = state
 
     # -- occupancy ------------------------------------------------------------
 

@@ -40,6 +40,14 @@ Modes:
                                 # pipeline A/B (eval+jac, Hessian, warm
                                 # solve, per-agent working set) at the
                                 # same horizons (ops/stagejac.py)
+    python bench.py --mesh-ab [zones]   # sharded-vs-single-device A/B
+                                # of the fused fleet: the shard_map
+                                # agent-mesh engine (psum consensus) vs
+                                # the single-device vmap at 256/1024
+                                # zones (optional single size) on an
+                                # 8-device mesh (virtual on CPU) —
+                                # per-zone step cost + consensus
+                                # identity; keys carry a d<n> qualifier
     python bench.py --profile [dir] [n]   # XLA profiler trace of the
                                 # warm n-zone step (default 256;
                                 # --profile DIR 1024 = the sub-linearity
@@ -314,6 +322,13 @@ def measure(n_agents: int = N_AGENTS,
         # per-zone ADMM iterations per second (each step runs ADMM_ITERS)
         "zone_iters_per_sec": n_agents * ADMM_ITERS / (step_ms / 1e3),
         "platform": jax.devices()[0].platform,
+        # devices the compiled step actually spanned — the headline key
+        # gains a _d<n> qualifier when >1 so mesh and single-device
+        # numbers can never conflate in the trajectory (ISSUE 9; the
+        # same honesty rule PR 6 applied to platforms)
+        "n_devices": len(getattr(
+            jax.tree_util.tree_leaves(out)[0].sharding, "device_set",
+            (None,))),
     }
 
 
@@ -585,6 +600,38 @@ def run_sequential_native(n_agents: int = N_AGENTS,
     return out
 
 
+def _mesh_section() -> dict:
+    """Device inventory + a measured consensus-shaped ``pmean``
+    round-trip when more than one device is visible — the same probe a
+    mesh-built :class:`FusedADMM` records per round as
+    ``admm_collective_seconds``. Embedded in ``--emit-metrics`` so every
+    telemetry artifact states what mesh (if any) was available to the
+    run it describes."""
+    import jax
+
+    devs = jax.devices()
+    out = {
+        "devices": len(devs),
+        "platform": devs[0].platform,
+        "fleet_mesh_axis": "agents",
+    }
+    if len(devs) > 1:
+        from agentlib_mpc_tpu.parallel import fleet_mesh
+        from agentlib_mpc_tpu.parallel.multihost import collective_probe
+
+        # the SAME builder FusedADMM's per-round probe uses, so
+        # collective_pmean_us and admm_collective_seconds measure one
+        # structurally identical collective (compiled+warmed inside)
+        probe, x = collective_probe(fleet_mesh(), HORIZON)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(probe(x))
+            times.append(time.perf_counter() - t0)
+        out["collective_pmean_us"] = round(1e6 * min(times), 1)
+    return out
+
+
 def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     """``--emit-metrics PATH``: run the fused ADMM bench step with the
     full telemetry stack on (metrics registry + spans + JAX compile hooks)
@@ -720,6 +767,13 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     # the analytical crossover evidence behind jacobian="auto", recorded
     # next to the measured phases (PERF.md round 8; the modeled dense
     # FLOPs grow O(N²), the sparse ones O(N))
+    # mesh inventory + collective round-trip: which device fabric this
+    # artifact's numbers ran on (single-device and mesh rounds must be
+    # attributable without guessing)
+    try:
+        payload["mesh"] = _mesh_section()
+    except Exception as exc:
+        payload["mesh"] = {"error": repr(exc)}
     try:
         from agentlib_mpc_tpu.lint.jaxpr.cost import compare_eval_jac_cost
         from agentlib_mpc_tpu.ops.stagejac import plan_from_certificate
@@ -747,6 +801,134 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     }
     print(json.dumps(summary))
     return payload
+
+
+def run_mesh_ab(sizes=(256, 1024), device_counts=(1, 8)) -> list[dict]:
+    """``--mesh-ab [zones]``: sharded-vs-single-device A/B of the fused
+    ADMM fleet (ROADMAP item 1 / ISSUE 9 acceptance row).
+
+    For each fleet size, the SAME zone workload runs as (a) the
+    single-device vmapped engine and (b) the explicit ``shard_map``
+    engine over a ``device_counts[i]``-device agent mesh (``psum``
+    consensus). The per-zone warm-step cost is the headline column: the
+    round-6 attribution (PERF.md) pinned the single-core ceiling on LLC
+    pressure from the batched KKT factor working set, which splitting
+    the agent axis across shards divides — the per-zone curve must
+    flatten with devices at 1024+ zones. Also checks consensus identity
+    (max |Δz̄| vs the single-device run) so the A/B can never publish a
+    fast-but-wrong number.
+
+    On CPU the mesh is 8 virtual host devices (the child requests them
+    before backend init); metric keys carry platform AND device count
+    (``mesh_ab[256,d8]``) per the PR 6 honesty rule — mesh and
+    single-device numbers must never conflate in the trajectory.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import (
+        AgentGroup,
+        FusedADMM,
+        FusedADMMOptions,
+        pad_group_to_devices,
+        stack_params,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+    platform = jax.devices()[0].platform
+    n_avail = len(jax.devices())
+    ocp = zone_ocp()
+    cold = SolverOptions(**SOLVER_BASE, mu_init=COLD_MU)
+    warm = cold._replace(max_iter=WARM_BUDGET, mu_init=WARM_MU)
+    admm_opts = FusedADMMOptions(max_iterations=ADMM_ITERS, rho=20.0)
+
+    rows = []
+    for n in sizes:
+        x0s, loads = fleet_inputs(n)
+        thetas = stack_params([
+            ocp.default_params(
+                x0=jnp.array([x0s[i]]),
+                d_traj=jnp.broadcast_to(
+                    jnp.array([loads[i], 290.15, 294.15]), (HORIZON, 3)))
+            for i in range(n)])
+        zbar_ref = None
+        for d in device_counts:
+            if d > n_avail:
+                print(f"[bench] mesh-ab: skipping d={d} "
+                      f"({n_avail} devices available)", file=sys.stderr)
+                continue
+            group = AgentGroup(
+                name="zones", ocp=ocp, n_agents=n,
+                couplings={"mDotCoolAir": "mDot"},
+                solver_options=cold, warm_solver_options=warm)
+            # any size works: pad to the shard multiple (masked dead
+            # lanes) so e.g. --mesh-ab 100 runs on the 8-device mesh
+            # instead of dying on the engine's divisibility check
+            group, thetas_d, mask = pad_group_to_devices(group, thetas, d)
+            mesh = None if d == 1 else Mesh(
+                np.array(jax.devices()[:d]), ("agents",))
+            t0 = time.perf_counter()
+            engine = FusedADMM([group], admm_opts, active=[mask],
+                               mesh=mesh)
+            state = engine.init_state([thetas_d])
+            if mesh is not None:
+                state, (thetas_run,) = engine.shard_args(
+                    mesh, state, [thetas_d])
+            else:
+                thetas_run = thetas_d
+            state, _trajs, stats = engine.step(state, [thetas_run])
+            jax.block_until_ready(state)
+            compile_ms = 1e3 * (time.perf_counter() - t0)
+            times = []
+            for _ in range(2 if n >= 2048 else 3):
+                t0 = time.perf_counter()
+                state, _trajs, stats = engine.step(state, [thetas_run])
+                jax.block_until_ready(state)
+                times.append(time.perf_counter() - t0)
+            step_ms = 1e3 * min(times)
+            zbar = np.asarray(state.zbar["mDotCoolAir"])
+            if d == min(device_counts):
+                zbar_ref = zbar
+            diff = None if zbar_ref is None \
+                else float(np.max(np.abs(zbar - zbar_ref)))
+            # the "never publish a fast-but-wrong number" gate: a
+            # sharded run that disagrees with the single-device
+            # consensus beyond f32 reduction-order noise is marked
+            # broken IN the row (and loudly on stderr) so no consumer
+            # can quote its speed without its wrongness
+            identity_ok = diff is None or diff < 1e-3
+            if not identity_ok:
+                print(f"[bench] mesh-ab n={n} d={d}: consensus DIVERGES "
+                      f"from the single-device run (max |dzbar| = "
+                      f"{diff:.3e}) — row marked identity_ok=false",
+                      file=sys.stderr)
+            row = {
+                "metric": f"mesh_ab[{n},d{d}]",
+                "n_agents": n,
+                "devices": d,
+                "step_ms": round(step_ms, 2),
+                "per_zone_us": round(1e3 * step_ms / n, 2),
+                "compile_ms": round(compile_ms, 0),
+                "iterations": int(stats.iterations),
+                "converged": bool(stats.converged),
+                "zbar_max_abs_diff": diff,
+                "identity_ok": identity_ok,
+                "platform": platform,
+            }
+            rows.append(row)
+            print(json.dumps(row))
+            sys.stdout.flush()
+            print(f"[bench] mesh-ab n={n:5d} d={d}  "
+                  f"step={step_ms:8.1f}ms  "
+                  f"per-zone={row['per_zone_us']:7.1f}us  "
+                  f"compile={compile_ms:.0f}ms", file=sys.stderr)
+            del engine, state
+    return rows
 
 
 def run_chaos(seed: int = 0, n_agents: int = 4) -> dict:
@@ -1659,6 +1841,9 @@ def run_evidence() -> None:
     section("ocp_ab", run_ocp_ab)
     section("jac_ab", run_jac_ab)
     section("serve", run_serve)
+    # one size keeps the matrix inside the worker watchdog; the full
+    # 256-4096 table is the on-demand `--mesh-ab` run (PERF.md round 10)
+    section("mesh_ab", lambda: run_mesh_ab(sizes=(256,)))
 
 
 # --- fail-soft orchestration (round-3 lesson: a wedged TPU tunnel hangs
@@ -1680,10 +1865,12 @@ def _child_main() -> None:
     tunnel; the in-process override is belt-and-braces for direct
     invocations from an unscrubbed shell); ``--worker`` runs on whatever
     the default platform is (TPU under the driver)."""
-    if "--horizon-shard" in sys.argv or "--evidence" in sys.argv:
-        # the sharded-eval validity check needs a multi-device mesh;
-        # on CPU that means virtual host devices, which must be
-        # requested BEFORE backend init (no-op on real multi-chip)
+    if "--horizon-shard" in sys.argv or "--evidence" in sys.argv \
+            or "--mesh-ab" in sys.argv:
+        # the sharded-eval validity check and the mesh A/B need a
+        # multi-device mesh; on CPU that means virtual host devices,
+        # which must be requested BEFORE backend init (no-op on real
+        # multi-chip)
         from agentlib_mpc_tpu.utils.jax_setup import (
             request_virtual_devices,
         )
@@ -1715,6 +1902,12 @@ def _child_main() -> None:
             run_jac_ab(sizes=(int(sys.argv[idx + 1]),))
         else:
             run_jac_ab()
+    elif "--mesh-ab" in sys.argv:
+        idx = sys.argv.index("--mesh-ab")
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            run_mesh_ab(sizes=(int(sys.argv[idx + 1]),))
+        else:
+            run_mesh_ab()
     elif "--evidence" in sys.argv:
         run_evidence()
     else:
@@ -1883,15 +2076,20 @@ def _measure_failsoft(mode_args: list, cpu_mode_args: "list | None" = None,
     return lines, "cpu", fell_back, attempts
 
 
-def _headline_metric(platform: str) -> str:
+def _headline_metric(platform: str, n_devices: int = 1) -> str:
     """Headline metric name, platform-qualified OFF the accelerator
     (ROADMAP item 2's explicit ask): a CPU-fallback round must never
     publish its number under the TPU trajectory metric —
     BENCH_r04/r05 read as a 3.6× regression when they were a platform
     change. The unqualified name is reserved for the accelerator the
-    trajectory tracks; anything else gets a ``_<platform>`` suffix."""
-    return "admm256_step_ms" if platform == "tpu" \
+    trajectory tracks; anything else gets a ``_<platform>`` suffix.
+    A measurement that spanned a device mesh additionally gains a
+    ``_d<n>`` qualifier (``admm256_step_ms_cpu_d8``) — mesh and
+    single-device numbers are different experiments and must never
+    conflate in the trajectory (ISSUE 9, extending the platform rule)."""
+    base = "admm256_step_ms" if platform == "tpu" \
         else f"admm256_step_ms_{platform}"
+    return base if n_devices <= 1 else f"{base}_d{n_devices}"
 
 
 def main() -> None:
@@ -1994,16 +2192,18 @@ def main() -> None:
         return
 
     for mode in ("--scaling", "--ab", "--qp-ab", "--ldl",
-                 "--horizon-shard", "--ocp-ab", "--jac-ab", "--evidence"):
+                 "--horizon-shard", "--ocp-ab", "--jac-ab", "--mesh-ab",
+                 "--evidence"):
         if mode in sys.argv:
             idx = sys.argv.index(mode)
             mode_args = [mode]
             if len(sys.argv) > idx + 1 and not \
                     sys.argv[idx + 1].startswith("-"):
-                # only --ocp-ab/--jac-ab take a positional (horizon N); a
-                # value after any other mode would be silently ignored by
-                # the child, reporting numbers for a different config
-                if mode in ("--ocp-ab", "--jac-ab"):
+                # only --ocp-ab/--jac-ab/--mesh-ab take a positional
+                # (size N); a value after any other mode would be
+                # silently ignored by the child, reporting numbers for a
+                # different config
+                if mode in ("--ocp-ab", "--jac-ab", "--mesh-ab"):
                     mode_args.append(sys.argv[idx + 1])
                 else:
                     print(f"[bench] {mode} takes no value; ignoring "
@@ -2071,7 +2271,8 @@ def main() -> None:
                       file=sys.stderr)
 
         line = {
-            "metric": _headline_metric(platform),
+            "metric": _headline_metric(platform,
+                                       int(res.get("n_devices", 1))),
             "value": round(res["step_ms"], 2),
             "unit": "ms",
             "vs_baseline": round(vs_baseline, 2),

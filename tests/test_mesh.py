@@ -1,0 +1,372 @@
+"""Sharded fused-ADMM fleet (ISSUE 9): ``shard_map`` agent axis + psum
+consensus.
+
+Pins the mesh execution path of :class:`FusedADMM` on the 8-virtual-
+device CPU mesh the conftest provisions: sharded-vs-unsharded identity
+(tracker fleet in tier-1; the example-OCP menu entries — QP fast path
+AND interior-point — in the slow tier, where their engine compiles
+belong), a multi-group fleet with both coupling kinds, the
+non-divisible padding fix in ``shard_args`` (pad + warn, never silently
+replicate), quarantine attribution across shards, the mesh-aware
+serving slot multiple, a mesh-backed ``ServingPlane`` churning at zero
+retraces, and the ``[mesh]`` retrace-budget gate (slow here; the CI
+lint job runs it on every PR).
+
+Multi-group fleets use a 4-device mesh: cross-group concatenation into
+the consensus collective needs every device thread at one rendezvous,
+and on this box an 8-way rendezvous under load intermittently starves
+(the documented ``test_padded_unequal_groups_shard_on_mesh`` flake);
+4 devices exercise identical sharding semantics.
+
+Engine builds dominate this file's cost (Python tracing of the IPM is
+not covered by the persistent XLA cache), so the tracker fleet pair is
+a module fixture shared by the identity / quarantine / telemetry tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel import (
+    fleet_mesh,
+    serving_slot_multiple,
+    shard_multiple,
+)
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+    pad_group_to_devices,
+    stack_params,
+)
+
+from conftest import make_tracker_model  # noqa: E402
+
+SOLVER = SolverOptions(tol=1e-8, max_iter=30)
+OPTS = FusedADMMOptions(max_iterations=20, rho=2.0, abs_tol=1e-6,
+                        rel_tol=1e-5)
+
+Tracker = make_tracker_model(lb=-10.0, ub=10.0)
+
+
+@pytest.fixture(scope="module")
+def tracker_ocp():
+    return transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                      method="multiple_shooting")
+
+
+def tracker_thetas(ocp, targets):
+    return stack_params([
+        ocp.default_params(p=jnp.array([float(t)])) for t in targets])
+
+
+@pytest.fixture(scope="module")
+def tracker_pair(tracker_ocp, eight_devices):
+    """(plain engine, mesh engine, thetas) for the 8-tracker consensus
+    fleet — built ONCE; the identity, quarantine and telemetry tests
+    share the warm executables."""
+    group = AgentGroup(name="trackers", ocp=tracker_ocp, n_agents=8,
+                       couplings={"c": "u"}, solver_options=SOLVER)
+    thetas = tracker_thetas(tracker_ocp, range(8))
+    plain = FusedADMM([group], OPTS)
+    meshed = FusedADMM([group], OPTS, mesh=fleet_mesh())
+    return plain, meshed, thetas
+
+
+class TestShardedIdentity:
+    def test_tracker_mesh_matches_single_device(self, tracker_pair):
+        plain, meshed, thetas = tracker_pair
+        rs, rt, rstat = plain.step(plain.init_state([thetas]), [thetas])
+        ms, mt, mstat = meshed.step(meshed.init_state([thetas]), [thetas])
+        assert bool(mstat.converged) == bool(rstat.converged)
+        assert int(mstat.iterations) == int(rstat.iterations)
+        np.testing.assert_allclose(np.asarray(ms.zbar["c"]),
+                                   np.asarray(rs.zbar["c"]), atol=1e-8)
+        np.testing.assert_allclose(np.asarray(mt[0]["u"]),
+                                   np.asarray(rt[0]["u"]), atol=1e-6)
+        # the analytic consensus fixed point survives the mesh
+        np.testing.assert_allclose(np.asarray(ms.zbar["c"]), 3.5,
+                                   atol=1e-3)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name,control", [
+        ("LinearRCZone/colloc-d1", "Q"),       # LQ: the QP fast path
+        ("OneRoom/shooting", "mDot"),          # bilinear: interior point
+    ])
+    def test_menu_entry_mesh_matches_single_device(self, eight_devices,
+                                                   name, control):
+        """Example-menu identity: the sharded engine must reproduce the
+        single-device fleet on both solver routings (the jaxpr-certified
+        QP fast path and the IPM path)."""
+        from agentlib_mpc_tpu.lint.jaxpr.examples import build_example
+
+        ocp = build_example(name)
+        group = AgentGroup(name=name, ocp=ocp, n_agents=8,
+                           couplings={"shared": control},
+                           solver_options=SolverOptions(max_iter=25))
+        theta0 = ocp.default_params()
+        thetas = stack_params([
+            ocp.default_params(x0=theta0.x0 * (1.0 + 0.002 * i))
+            for i in range(8)])
+        opts = FusedADMMOptions(max_iterations=4, rho=1e-2)
+        ref = FusedADMM([group], opts)
+        rs, rt, rstat = ref.step(ref.init_state([thetas]), [thetas])
+
+        eng = FusedADMM([group], opts, mesh=fleet_mesh())
+        ms, mt, mstat = eng.step(eng.init_state([thetas]), [thetas])
+        assert int(mstat.iterations) == int(rstat.iterations)
+        np.testing.assert_allclose(
+            np.asarray(ms.zbar["shared"]), np.asarray(rs.zbar["shared"]),
+            rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(mt[0]["u"]), np.asarray(rt[0]["u"]),
+            rtol=1e-5, atol=1e-7)
+
+    def test_multi_group_exchange_mesh_matches(self, eight_devices,
+                                               tracker_ocp):
+        """Two structure groups (consensus) + an exchange coupling on a
+        4-device mesh: both collective kinds (psum'ed masked means AND
+        the shared exchange multiplier) reproduce the unsharded fleet."""
+        ga = AgentGroup(name="a", ocp=tracker_ocp, n_agents=4,
+                        couplings={"c": "u"}, solver_options=SOLVER)
+        gb = AgentGroup(name="b", ocp=tracker_ocp, n_agents=4,
+                        exchanges={"bal": "u"}, solver_options=SOLVER)
+        ta = tracker_thetas(tracker_ocp, (0.0, 1.0, 2.0, 3.0))
+        tb = tracker_thetas(tracker_ocp, (4.0, 5.0, 6.0, 7.0))
+        ref = FusedADMM([ga, gb], OPTS)
+        rs, _rt, rstat = ref.step(ref.init_state([ta, tb]), [ta, tb])
+
+        mesh = Mesh(np.array(eight_devices[:4]), ("agents",))
+        eng = FusedADMM([ga, gb], OPTS, mesh=mesh)
+        ms, _mt, mstat = eng.step(eng.init_state([ta, tb]), [ta, tb])
+        assert int(mstat.iterations) == int(rstat.iterations)
+        np.testing.assert_allclose(np.asarray(ms.zbar["c"]),
+                                   np.asarray(rs.zbar["c"]), atol=1e-8)
+        np.testing.assert_allclose(np.asarray(ms.ex_mean["bal"]),
+                                   np.asarray(rs.ex_mean["bal"]),
+                                   atol=1e-8)
+        np.testing.assert_allclose(np.asarray(ms.ex_lam["bal"]),
+                                   np.asarray(rs.ex_lam["bal"]),
+                                   atol=1e-8)
+
+    def test_quarantine_attribution_across_shards(self, tracker_pair):
+        """A NaN-poisoned lane on a NON-zero shard is quarantined, its
+        lane attribution lands at the right global row, and the fleet's
+        carried state stays finite — the psum'ed health counters and the
+        sharded ``lane_quarantined`` out-spec both proven. (Poisons the
+        warm start like test_chaos.py's quarantine pins — a NaN iterate
+        deterministically yields a NaN local solution.)"""
+        _plain, eng, thetas = tracker_pair
+        state = eng.init_state([thetas])
+        state, _t, _s = eng.step(state, [thetas])
+        victim = 6                     # lives on device 6, not device 0
+        state = state._replace(
+            w=(state.w[0].at[victim].set(jnp.nan),))
+        state, trajs, stats = eng.step(state, [thetas])
+        lane_q = np.asarray(stats.lane_quarantined[0])
+        assert lane_q.shape == (8,)
+        assert lane_q[victim] > 0
+        assert (lane_q[[i for i in range(8) if i != victim]] == 0).all()
+        assert int(np.asarray(stats.quarantined).sum()) > 0
+        assert all(bool(jnp.all(jnp.isfinite(leaf)))
+                   for leaf in jax.tree.leaves(state))
+        healthy = np.asarray(trajs[0]["u"])[
+            [i for i in range(8) if i != victim]]
+        assert np.isfinite(healthy).all()
+
+
+class TestShardArgsPadding:
+    def test_non_divisible_group_is_padded_not_replicated(
+            self, eight_devices, tracker_ocp, caplog):
+        """Satellite 1: shard_args on a 6-agent group over the 8-device
+        mesh pads 2 masked lanes (one warning stating the overhead) and
+        actually shards the agent axis; results match the unpadded
+        single-device fleet."""
+        import logging
+
+        targets = range(6)
+        group = AgentGroup(name="six", ocp=tracker_ocp, n_agents=6,
+                           couplings={"c": "u"}, solver_options=SOLVER)
+        thetas = tracker_thetas(tracker_ocp, targets)
+        ref = FusedADMM([group], OPTS)
+        rs, rt, _ = ref.step(ref.init_state([thetas]), [thetas])
+
+        eng = FusedADMM([group], OPTS)
+        with caplog.at_level(logging.WARNING,
+                             logger="agentlib_mpc_tpu.parallel.fused_admm"):
+            st, th = eng.shard_args(fleet_mesh(), eng.init_state([thetas]),
+                                    [thetas])
+        warnings = [r for r in caplog.records if "padding" in r.message]
+        assert len(warnings) == 1
+        assert eng.groups[0].n_agents == 8
+        assert np.asarray(eng.active[0]).tolist() == [True] * 6 + [False] * 2
+        assert not st.w[0].sharding.is_fully_replicated
+        ps, pt, pstat = eng.step(st, th)
+        assert bool(pstat.converged)
+        np.testing.assert_allclose(np.asarray(ps.zbar["c"]),
+                                   np.asarray(rs.zbar["c"]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt[0]["u"])[:6],
+                                   np.asarray(rt[0]["u"]), atol=1e-5)
+
+    def test_mesh_engine_rejects_non_divisible_group(self, eight_devices,
+                                                     tracker_ocp):
+        group = AgentGroup(name="six", ocp=tracker_ocp, n_agents=6,
+                           couplings={"c": "u"}, solver_options=SOLVER)
+        with pytest.raises(ValueError, match="pad_group_to_devices"):
+            FusedADMM([group], OPTS, mesh=fleet_mesh())
+
+    def test_mesh_engine_rejects_record_locals(self, eight_devices,
+                                               tracker_ocp):
+        group = AgentGroup(name="t", ocp=tracker_ocp, n_agents=8,
+                           couplings={"c": "u"}, solver_options=SOLVER)
+        with pytest.raises(ValueError, match="record_locals"):
+            FusedADMM([group], OPTS, mesh=fleet_mesh(),
+                      record_locals=True)
+
+    @pytest.mark.slow
+    def test_padded_group_on_mesh_engine(self, eight_devices,
+                                         tracker_ocp):
+        """The pad_group_to_devices -> mesh-engine recipe (the module
+        docstring launch sequence): a 6-agent fleet padded to 8 runs the
+        shard_map path and matches the unpadded single-device result.
+        Built with ``quarantine=False`` to ALSO pin that a mesh engine
+        without the quarantine stats (``lane_quarantined=None``) still
+        compiles and steps — the out-specs must match the None subtree."""
+        no_q = OPTS._replace(quarantine=False)
+        group = AgentGroup(name="six", ocp=tracker_ocp, n_agents=6,
+                           couplings={"c": "u"}, solver_options=SOLVER)
+        thetas = tracker_thetas(tracker_ocp, range(6))
+        ref = FusedADMM([group], no_q)
+        rs, _rt, _ = ref.step(ref.init_state([thetas]), [thetas])
+
+        padded, thetas_p, mask = pad_group_to_devices(group, thetas, 8)
+        eng = FusedADMM([padded], no_q, active=[mask], mesh=fleet_mesh())
+        ms, _mt, mstat = eng.step(eng.init_state([thetas_p]), [thetas_p])
+        assert bool(mstat.converged)
+        assert mstat.lane_quarantined is None
+        np.testing.assert_allclose(np.asarray(ms.zbar["c"]),
+                                   np.asarray(rs.zbar["c"]), atol=1e-6)
+
+
+class TestMeshServing:
+    def test_serving_slot_multiple_is_mesh_aware(self, eight_devices):
+        n_dev = len(jax.devices())
+        assert serving_slot_multiple() == n_dev
+        mesh4 = Mesh(np.array(eight_devices[:4]), ("agents",))
+        assert shard_multiple(mesh4) == 4
+        # lcm(device count, mesh size): capacities built at this
+        # granularity divide BOTH the mesh and the full device set
+        assert serving_slot_multiple(mesh4) == np.lcm(n_dev, 4)
+        assert serving_slot_multiple(fleet_mesh()) == np.lcm(n_dev, n_dev)
+
+    def test_serving_plane_on_mesh_churn_zero_retraces(
+            self, eight_devices, compile_profiler):
+        """Satellite 2 acceptance: join/serve/leave tenants on a
+        forced-8-device mesh at zero retraces — membership on a SHARDED
+        bucket engine is still data, never structure."""
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            _compile_snapshot,
+            serve_tenants,
+            tracker_ocp,
+            tracker_tenant_spec,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+
+        ocp = tracker_ocp()
+        plane = ServingPlane(FusedADMMOptions(max_iterations=6, rho=2.0),
+                             mesh=fleet_mesh(), pipelined=False,
+                             donate=False)
+
+        def spec(tid, a):
+            return tracker_tenant_spec(ocp, tid, a)
+
+        def serve(*tenants):
+            return serve_tenants(plane, *tenants)
+
+        # bucket capacity honors the mesh multiple
+        rec = plane.join(spec("w0", 1.0))
+        assert rec.capacity % len(jax.devices()) == 0
+        serve("w0")
+        serve("w0")                    # second round: steady state
+        before = _compile_snapshot(compile_profiler)
+        plane.join(spec("t1", 3.0))
+        res = serve("w0", "t1")
+        assert res["w0"].action == "actuate"
+        assert res["t1"].action == "actuate"
+        # consensus pulls both tenants toward the shared mean
+        assert abs(res["w0"].controls["u"] - res["t1"].controls["u"]) < 0.5
+        plane.leave("t1")
+        res = serve("w0")
+        assert res["w0"].action == "actuate"
+        after = _compile_snapshot(compile_profiler)
+        deltas = {k: after.get(k, 0) - before.get(k, 0)
+                  for k in set(before) | set(after)}
+        assert all(v == 0 for v in deltas.values()), deltas
+
+    @pytest.mark.slow
+    def test_mesh_gate_passes(self, eight_devices):
+        """The ``[mesh]`` budget gate (lint_budgets.toml) holds: zero
+        warm retraces of the sharded step and the mesh serving churn —
+        the CI lint job runs the real gate on every PR; this pins it in
+        the test suite too."""
+        from agentlib_mpc_tpu.lint.retrace_budget import run_mesh_gate
+
+        report = run_mesh_gate(budgets={"mesh": {
+            "warmup_rounds": 2, "rounds": 2, "n_agents": 8,
+            "devices": 8,
+            "budgets": {"default": 0, "admm.fused_step": 0},
+            "serving": {"budgets": {"default": 0}},
+        }}, verbose=False)
+        assert report["violations"] == [], report
+        assert report["failures"] == [], report
+        assert report["mesh_devices"] >= 2
+
+
+class TestMeshTelemetry:
+    def test_collective_probe_and_gauge_recorded(self, compile_profiler,
+                                                 tracker_pair):
+        """Satellite 3: a mesh engine's round records the
+        ``fleet_mesh_devices`` gauge and the ``admm_collective_seconds``
+        histogram (the per-round consensus-shaped pmean probe)."""
+        from agentlib_mpc_tpu import telemetry
+
+        _plain, eng, thetas = tracker_pair
+        eng.step(eng.init_state([thetas]), [thetas])
+        reg = telemetry.metrics()
+        assert reg.get("fleet_mesh_devices") == float(len(jax.devices()))
+        samples = reg.histogram("admm_collective_seconds").samples()
+        assert samples and samples[0]["count"] >= 1
+
+
+@pytest.mark.slow
+def test_mesh_ab_smoke(eight_devices):
+    """``bench.py --mesh-ab 256`` end to end (the acceptance row's
+    machinery): both device counts produce rows, the sharded run agrees
+    with the single-device consensus, and keys carry the d<n>
+    qualifier."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    from agentlib_mpc_tpu.utils.jax_setup import cpu_subprocess_env
+
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    proc = subprocess.run(
+        [_sys.executable, bench, "--worker", "--mesh-ab", "256"],
+        capture_output=True, text=True, timeout=3000,
+        env=cpu_subprocess_env(), cwd=os.path.dirname(bench))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    by_dev = {r["devices"]: r for r in rows}
+    assert set(by_dev) == {1, 8}
+    assert by_dev[8]["metric"] == "mesh_ab[256,d8]"
+    assert by_dev[8]["zbar_max_abs_diff"] < 1e-3
+    assert by_dev[8]["identity_ok"] and by_dev[1]["identity_ok"]
+    assert by_dev[8]["converged"] and by_dev[1]["converged"]
