@@ -286,6 +286,17 @@ def run_mesh_gate(budgets: "dict | None" = None,
     leave → serve (the ``[mesh.serving]`` budgets): membership on a
     SHARDED engine is still data, never structure.
 
+    A third measured leg (the ``[mesh.survive]`` budgets, ISSUE 10)
+    scripts the survivability churn on a
+    :class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`:
+    after a warmup cycle that builds BOTH layouts (full mesh and the
+    one-device-down degraded mesh — the one legitimate degraded-mesh
+    rebuild), a full degrade → serve → re-admit → serve cycle is held
+    to ZERO traces/compiles: layouts are cached per surviving-device
+    set, state pad/slice/placement are shape-stable data movement, and
+    re-admission reinstates the cached full-mesh engine — shard loss
+    must never reintroduce retrace churn beyond that first rebuild.
+
     With no real multi-device backend, the gate requests 8 virtual CPU
     devices — effective only before backend init, which is how both the
     CLI (fresh process) and CI run it.
@@ -307,6 +318,9 @@ def run_mesh_gate(budgets: "dict | None" = None,
     serving_cfg = dict(cfg.get("serving", {}) or {})
     serving_budgets = dict(serving_cfg.get("budgets", {}) or {})
     serving_default = int(serving_budgets.pop("default", 0))
+    survive_cfg = dict(cfg.get("survive", {}) or {})
+    survive_budgets = dict(survive_cfg.get("budgets", {}) or {})
+    survive_default = int(survive_budgets.pop("default", 0))
 
     was_enabled = telemetry.enabled()
     telemetry.configure(enabled=True)
@@ -315,6 +329,7 @@ def run_mesh_gate(budgets: "dict | None" = None,
 
     failures: list = []
     before = after = s_before = s_after = {}
+    v_before = v_after = {}
     try:
         import jax
         import jax.numpy as jnp
@@ -391,6 +406,37 @@ def run_mesh_gate(budgets: "dict | None" = None,
         serve("m1")
         plane.leave("m1")
         s_after = _compile_snapshot(reg)
+
+        # -- survive leg: degrade -> serve -> readmit at 0 retraces ----
+        from agentlib_mpc_tpu.parallel.survival import FleetSupervisor
+
+        sup = FleetSupervisor(
+            [group], FusedADMMOptions(max_iterations=8, rho=2.0),
+            mesh=mesh, watchdog_timeout_s=120.0, readmit_after=1,
+            probation_rounds=1)
+        sv_state = sup.init_state(thetas)
+        dead = sup.full_mesh.devices.flat[-1].id
+        # warmup cycle: builds the full AND the degraded layout (the
+        # one legitimate degraded-mesh rebuild) and exercises every
+        # pad/slice/placement shape the measured cycle repeats
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+        sup.force_degrade([dead])
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+        sup.force_readmit()
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+
+        v_before = _compile_snapshot(reg)
+        sup.force_degrade([dead])
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+        sup.force_readmit()
+        sv_state, _t, _s = sup.step(sv_state, thetas)
+        v_after = _compile_snapshot(reg)
+        if sup.stats()["layouts_built"] != 2:
+            failures.append(
+                f"survive leg built {sup.stats()['layouts_built']} "
+                f"layouts — the repeat degrade/readmit cycle must reuse "
+                f"the 2 warmed engines, not rebuild")
     except _MeshGateSkipped:
         pass
     finally:
@@ -411,6 +457,13 @@ def run_mesh_gate(budgets: "dict | None" = None,
         if delta > budget:
             violations.append({"entry_point": f"serving:{entry}",
                                "observed": delta, "budget": budget})
+    survive_deltas = {k: v_after.get(k, 0) - v_before.get(k, 0)
+                      for k in set(v_before) | set(v_after)}
+    for entry, delta in sorted(survive_deltas.items()):
+        budget = int(survive_budgets.get(entry, survive_default))
+        if delta > budget:
+            violations.append({"entry_point": f"survive:{entry}",
+                               "observed": delta, "budget": budget})
     report = {
         "devices": len(jax.devices()),
         "mesh_devices": n_dev,
@@ -419,6 +472,7 @@ def run_mesh_gate(budgets: "dict | None" = None,
         "n_agents": n_agents,
         "deltas": dict(sorted(deltas.items())),
         "serving_deltas": dict(sorted(serving_deltas.items())),
+        "survive_deltas": dict(sorted(survive_deltas.items())),
         "violations": violations,
         "failures": failures,
     }
@@ -432,7 +486,8 @@ def run_mesh_gate(budgets: "dict | None" = None,
         if not violations and not failures:
             print(f"mesh-budget: OK — zero excess compiles across "
                   f"{rounds} sharded rounds ({n_agents} agents / "
-                  f"{n_dev} devices) and the mesh serving churn")
+                  f"{n_dev} devices), the mesh serving churn and the "
+                  f"degrade -> serve -> re-admit survive cycle")
     return report
 
 

@@ -4,19 +4,28 @@ from agentlib_mpc_tpu.parallel.fused_admm import (
     FusedADMMOptions,
 )
 from agentlib_mpc_tpu.parallel.multihost import (
+    MeshRoundTimeout,
+    ShardProbeReport,
     fleet_mesh,
     host_local_batch,
     initialize_multihost,
+    probe_mesh_devices,
     serving_slot_multiple,
     shard_multiple,
+    surviving_mesh,
 )
 
 
 def __getattr__(name):
     # config_bridge pulls in the backend layer; import lazily so
-    # `parallel` stays light for solver-only users
+    # `parallel` stays light for solver-only users. FleetSupervisor
+    # likewise: the survival layer is only paid for when used.
     if name == "FusedFleet":
         from agentlib_mpc_tpu.parallel.config_bridge import FusedFleet
 
         return FusedFleet
+    if name == "FleetSupervisor":
+        from agentlib_mpc_tpu.parallel.survival import FleetSupervisor
+
+        return FleetSupervisor
     raise AttributeError(name)

@@ -245,7 +245,8 @@ class FusedADMM:
                  active: "Sequence[jnp.ndarray] | None" = None,
                  record_locals: bool = False,
                  donate_state: bool = False,
-                 mesh=None):
+                 mesh=None,
+                 watchdog_timeout_s: "float | None" = None):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -278,7 +279,20 @@ class FusedADMM:
         (:func:`pad_group_to_devices`); ``record_locals`` is
         incompatible (the per-iteration history buffers are indexed by
         global participant row, which a shard-local body cannot
-        address)."""
+        address).
+        ``watchdog_timeout_s``: arm the COLLECTIVE watchdog — every
+        :meth:`step` dispatch+sync runs under a bounded wait (the PR 8
+        materialize-watchdog pattern one layer down). A round that blows
+        the budget condemns the mesh: the engine runs a bounded
+        per-device re-probe (``multihost.probe_mesh_devices``), records
+        which shards answered (``self.shard_report``), flips
+        ``self.mesh_condemned`` and raises
+        :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
+        — the signal the degraded-mesh fallback
+        (:class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`)
+        consumes. Incompatible with ``donate_state`` (a retry needs the
+        input state's buffers alive). One sick or hung shard can no
+        longer wedge every agent in the fleet behind a dead ``psum``."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -325,6 +339,21 @@ class FusedADMM:
         if self.donate_state:
             _suppress_unusable_donation_warning()
         self.mesh = mesh
+        self.watchdog_timeout_s = (None if watchdog_timeout_s is None
+                                   else float(watchdog_timeout_s))
+        if self.watchdog_timeout_s is not None and self.donate_state:
+            raise ValueError(
+                "watchdog_timeout_s is incompatible with donate_state: "
+                "a watchdogged round may be retried on a degraded mesh "
+                "from the SAME input state, which donation would have "
+                "consumed")
+        #: True once a round blew the collective-watchdog budget — the
+        #: engine's compiled step may be wedged behind a dead collective
+        self.mesh_condemned = False
+        #: the last post-condemnation per-device probe (None until a
+        #: round times out)
+        self.shard_report = None
+        self._watchdog_reader = None
         self._collective_probe = None
         self._compile_step()
 
@@ -1045,6 +1074,9 @@ class FusedADMM:
                     raise ValueError(
                         f"active mask of group {g.name!r} has shape "
                         f"{a.shape}, expected ({g.n_agents},)")
+        if self.watchdog_timeout_s is not None:
+            return self._step_watchdogged(state, tuple(theta_batches),
+                                          masks)
         if not telemetry.enabled():
             return self._step(state, tuple(theta_batches), masks)
         with telemetry.span("admm.fused_step",
@@ -1059,6 +1091,83 @@ class FusedADMM:
         if self._collective_probe is not None:
             self._record_collective_probe()
         return out
+
+    def _step_watchdogged(self, state, theta_batches: tuple, masks: tuple):
+        """One round under the collective watchdog: dispatch AND sync
+        run on a bounded daemon reader (the PR 8 materialize-watchdog
+        pattern — a wedged collective cannot be cancelled, only
+        abandoned). On timeout the mesh is condemned, a bounded
+        per-device re-probe records which shards answered, and
+        :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
+        carries the report out to the degraded-mesh fallback."""
+        from agentlib_mpc_tpu.parallel.multihost import (
+            MESH_PROBE_TIMEOUT_S,
+            MeshRoundTimeout,
+            probe_mesh_devices,
+        )
+
+        if self._watchdog_reader is None:
+            from agentlib_mpc_tpu.utils.watchdog import BoundedReader
+
+            self._watchdog_reader = BoundedReader(name="mesh-round-reader")
+
+        def dispatch():
+            if telemetry.enabled():
+                with telemetry.span(
+                        "admm.fused_step",
+                        groups=",".join(g.name for g in self.groups)):
+                    out = self._step(state, theta_batches, masks)
+            else:
+                out = self._step(state, theta_batches, masks)
+            jax.block_until_ready(out)
+            return out
+
+        kind, value = self._watchdog_reader.run(dispatch,
+                                                self.watchdog_timeout_s)
+        if kind == "err":
+            raise value
+        if kind in ("timeout", "saturated"):
+            self.mesh_condemned = True
+            if telemetry.enabled():
+                telemetry.counter(
+                    "mesh_watchdog_stalls_total",
+                    "mesh-dispatched fused rounds that blew the "
+                    "collective-watchdog budget").inc(
+                    outcome=kind)
+            probe = None
+            if self.mesh is not None:
+                probe = probe_mesh_devices(
+                    self.mesh, min(self.watchdog_timeout_s,
+                                   MESH_PROBE_TIMEOUT_S))
+                self.shard_report = probe
+                if telemetry.enabled():
+                    telemetry.gauge(
+                        "mesh_shards_answering",
+                        "mesh devices that answered the bounded "
+                        "post-condemnation probe").set(
+                        float(len(probe.answered)))
+                logger.error(
+                    "fused round blew the %.1fs collective watchdog; "
+                    "mesh condemned — per-device probe: %d/%d shards "
+                    "answered (dead: %s)", self.watchdog_timeout_s,
+                    len(probe.answered),
+                    len(probe.answered) + len(probe.dead),
+                    list(probe.dead) or "none")
+            else:
+                logger.error(
+                    "fused round blew the %.1fs watchdog on a mesh-less "
+                    "engine; no shards to probe", self.watchdog_timeout_s)
+            raise MeshRoundTimeout(
+                f"fused round did not complete within the "
+                f"{self.watchdog_timeout_s:.1f}s collective-watchdog "
+                f"budget" + ("" if kind == "timeout" else
+                             " (watchdog reader leak cap reached — the "
+                             "mesh is already known-dead)"), probe=probe)
+        if telemetry.enabled():
+            self._record_round(value[2])
+            if self._collective_probe is not None:
+                self._record_collective_probe()
+        return value
 
     def _record_collective_probe(self) -> None:
         """Per-round mesh-collective observability: time one
@@ -1133,6 +1242,50 @@ class FusedADMM:
             buckets=telemetry.ITERATION_BUCKETS
             ).observe(float(n_it), fleet=fleet)
 
+    def pad_state_rows(self, pads: "dict[int, int]",
+                       state: "FusedState | None",
+                       theta_batches: Sequence[OCPParams]):
+        """Pure row padding of a (state, thetas) pair: grow each group's
+        agent axis by ``pads[gi]`` lanes repeating the last agent's
+        parameters/iterates (the :func:`pad_group_to_devices` contract —
+        padded lanes are masked dead weight, never wrong answers). Does
+        NOT touch the engine; the caller owns masks and rebuilds. Shared
+        by :meth:`shard_args`' in-place padding rebuild and the
+        degraded-mesh fallback's re-pad onto a smaller surviving mesh
+        (:class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`).
+        ``state=None`` pads the theta batches alone (the supervisor's
+        ``init_state`` seam) — ONE padding convention, not two."""
+
+        def pad_rows(leaf, gi):
+            if not pads.get(gi):
+                return leaf
+            return jnp.concatenate(
+                [leaf, jnp.repeat(leaf[-1:], pads[gi], axis=0)], axis=0)
+
+        theta_batches = tuple(
+            jax.tree.map(lambda leaf, gi=gi: pad_rows(leaf, gi), theta)
+            for gi, theta in enumerate(theta_batches))
+        if state is None:
+            return None, theta_batches
+
+        lam = {a: tuple(
+            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "consensus"), pieces))
+            for a, pieces in state.lam.items()}
+        ex_diff = {a: tuple(
+            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
+                self._group_participations(a, "exchange"), pieces))
+            for a, pieces in state.ex_diff.items()}
+        state = state._replace(
+            w=tuple(pad_rows(state.w[gi], gi)
+                    for gi in range(len(self.groups))),
+            y=tuple(pad_rows(state.y[gi], gi)
+                    for gi in range(len(self.groups))),
+            z=tuple(pad_rows(state.z[gi], gi)
+                    for gi in range(len(self.groups))),
+            lam=lam, ex_diff=ex_diff)
+        return state, theta_batches
+
     def _pad_for_mesh(self, n_dev: int, pads: "dict[int, int]",
                       state: FusedState,
                       theta_batches: Sequence[OCPParams]):
@@ -1152,31 +1305,8 @@ class FusedADMM:
             [g.name for gi, g in enumerate(self.groups) if pads[gi]],
             n_dev, n_pad, 100.0 * n_pad / max(total, 1))
 
-        def pad_rows(leaf, gi):
-            if not pads[gi]:
-                return leaf
-            return jnp.concatenate(
-                [leaf, jnp.repeat(leaf[-1:], pads[gi], axis=0)], axis=0)
-
-        lam = {a: tuple(
-            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
-                self._group_participations(a, "consensus"), pieces))
-            for a, pieces in state.lam.items()}
-        ex_diff = {a: tuple(
-            pad_rows(piece, gi) for (gi, _c, _s), piece in zip(
-                self._group_participations(a, "exchange"), pieces))
-            for a, pieces in state.ex_diff.items()}
-        state = state._replace(
-            w=tuple(pad_rows(state.w[gi], gi)
-                    for gi in range(len(self.groups))),
-            y=tuple(pad_rows(state.y[gi], gi)
-                    for gi in range(len(self.groups))),
-            z=tuple(pad_rows(state.z[gi], gi)
-                    for gi in range(len(self.groups))),
-            lam=lam, ex_diff=ex_diff)
-        theta_batches = tuple(
-            jax.tree.map(lambda leaf, gi=gi: pad_rows(leaf, gi), theta)
-            for gi, theta in enumerate(theta_batches))
+        state, theta_batches = self.pad_state_rows(pads, state,
+                                                   theta_batches)
         # the qp routing already resolved per structure (n_agents does
         # not enter it) — force the cached decisions so the rebuild
         # never re-certifies
@@ -1194,6 +1324,18 @@ class FusedADMM:
         self.__dict__.pop("_serving_helpers", None)
         self._compile_step()
         return state, theta_batches
+
+    def routed_groups(self) -> tuple:
+        """This engine's groups with the resolved qp routing FORCED
+        (``qp_fast_path`` "on"/"off" instead of "auto") and the derived
+        solver options (stage partitions, jacobian plans) attached —
+        the groups to hand a sibling engine build (degraded-mesh
+        rebuild, warm restore) so it never re-certifies."""
+        uses_qp = getattr(self, "group_uses_qp",
+                          tuple(False for _ in self.groups))
+        return tuple(
+            dataclasses.replace(g, qp_fast_path="on" if use else "off")
+            for g, use in zip(self.groups, uses_qp))
 
     def shard_args(self, mesh, state: FusedState,
                    theta_batches: Sequence[OCPParams]):

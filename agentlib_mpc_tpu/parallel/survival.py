@@ -1,0 +1,610 @@
+"""Elastic degraded-mesh execution: the fused fleet survives shard loss.
+
+PR 9 moved the fused ADMM fleet onto a ``shard_map`` device mesh; that
+made ONE sick or hung shard a fleet-wide outage — the ``lax.psum``
+consensus collective blocks every agent behind the dead participant.
+:class:`FleetSupervisor` is the recovery ladder above the engine,
+mirroring the PR 8 serving-health ladder at DEVICE granularity:
+
+1. **Detect** — every round runs under the engine's collective
+   watchdog (``FusedADMM(watchdog_timeout_s=...)``). A blown budget
+   condemns the mesh and surfaces a
+   :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
+   carrying the bounded per-device probe.
+2. **Degrade** — the supervisor re-probes through its own (chaos-
+   injectable) seam, marks the dead shards' lanes, and rebuilds the
+   fleet on the surviving-device mesh through the existing pad path:
+   the warm ``FusedState``/theta/masks carry over shard-aligned
+   (:meth:`FusedADMM.pad_state_rows` + ``shard_args`` placement), dead
+   lanes are masked out (their last-known iterates ride as padding —
+   dead weight, never wrong answers), and the carried consensus leaves
+   are asserted BITWISE against the pre-failure iterate before any
+   degraded round runs. The qp routing and derivative plans recorded by
+   the full-mesh engine are forced onto the rebuild
+   (:meth:`FusedADMM.routed_groups`), so a degrade never re-certifies.
+3. **Serve degraded** — the round that timed out is RETRIED from the
+   pre-failure state on the degraded mesh (which is why the supervisor
+   rejects donated engines); surviving agents keep actuating.
+4. **Re-admit** — after ``readmit_after`` consecutive healthy degraded
+   rounds the supervisor probes the FULL mesh; when every device
+   answers it reshards back: state sliced back to the base layout, the
+   lost lanes re-spliced with FRESH warm starts (the recycled-slot
+   contract — a lane that died mid-iterate must not resume from it),
+   and the cached full-mesh engine reinstated (zero new compiles).
+   Re-admission opens a **probation** window: a timeout inside it
+   re-degrades immediately AND doubles the healthy-round requirement
+   (hysteresis — a flapping device must prove itself, one lucky round
+   must not bounce the fleet back onto it).
+
+Engines are cached per surviving-device set, so a repeat degrade to the
+same topology — and every re-admission — is executable reuse, never a
+recompile (the ``[mesh.survive]`` retrace budget pins this: zero
+traces/compiles beyond the one legitimate degraded-mesh rebuild).
+
+The supervisor's API is layout-stable: :meth:`step` takes and returns
+state/thetas/trajectories in the BASE (caller) layout regardless of the
+mesh currently serving — padding and slicing are internal, so the
+control loop upstairs never sees the degradation except through
+``stats``/telemetry (``mesh_devices_active``, ``mesh_degrade_total``,
+``mesh_readmit_total``, ``mesh_shard_loss_recovery_seconds``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.parallel import multihost
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    FusedADMM,
+    FusedADMMOptions,
+)
+from agentlib_mpc_tpu.parallel.multihost import MeshRoundTimeout
+
+logger = logging.getLogger(__name__)
+
+#: transient (all-shards-answer) retries per round before the
+#: supervisor concludes the mesh is lying and escalates
+MAX_TRANSIENT_RETRIES = 2
+
+
+class _Layout(NamedTuple):
+    """One mesh configuration's serving machinery."""
+
+    device_ids: tuple        # surviving device ids, full-mesh order
+    mesh: object             # the (possibly degraded) 1-D mesh
+    engine: FusedADMM
+    pads: dict               # group index -> rows added over BASE
+
+
+class FleetSupervisor:
+    """Run a fused fleet with shard-loss survival (module docstring).
+
+    ``groups``/``options``/``active`` are the base fleet exactly as
+    :class:`FusedADMM` takes them; ``mesh`` defaults to
+    :func:`~agentlib_mpc_tpu.parallel.multihost.fleet_mesh`. Group
+    sizes need NOT divide any mesh — every layout pads through
+    :meth:`FusedADMM.pad_state_rows` (masked dead lanes).
+    """
+
+    def __init__(self, groups, options: FusedADMMOptions = FusedADMMOptions(),
+                 mesh=None, active=None,
+                 watchdog_timeout_s: float = 30.0,
+                 probe_timeout_s: float = multihost.MESH_PROBE_TIMEOUT_S,
+                 readmit_after: int = 2,
+                 probation_rounds: int = 2,
+                 warmup_budget_s: float = 600.0):
+        self.full_mesh = multihost.fleet_mesh() if mesh is None else mesh
+        self.options = options
+        self.watchdog_timeout_s = float(watchdog_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        #: extra watchdog allowance for a layout's FIRST round: a fresh
+        #: (full or degraded) engine's trace+compile rides inside that
+        #: round's bounded wait, and must not read as a collective
+        #: stall — the steady-state budget applies from round two
+        self.warmup_budget_s = float(warmup_budget_s)
+        self.readmit_after = max(1, int(readmit_after))
+        self.probation_rounds = max(0, int(probation_rounds))
+        self.base_groups = tuple(groups)
+        if active is None:
+            active = [jnp.ones((g.n_agents,), bool)
+                      for g in self.base_groups]
+        self.base_active = tuple(jnp.asarray(a, bool) for a in active)
+        #: chaos-injectable probe seam (the device-loss injector wraps
+        #: this to keep a "dead" virtual device from answering)
+        self._probe = lambda m: multihost.probe_mesh_devices(
+            m, self.probe_timeout_s)
+        self._layouts: dict = {}
+        self._full_ids = tuple(d.id for d in self.full_mesh.devices.flat)
+        #: base-layout lanes lost to dead shards, one bool array/group
+        self.dead_lanes = tuple(
+            np.zeros((g.n_agents,), bool) for g in self.base_groups)
+        self.dead_devices: tuple = ()
+        self._current = self._layout_for(self._full_ids)
+        #: participation/structure reference (group layout identical in
+        #: every padded variant)
+        self._ref = self._current.engine
+        # survivability bookkeeping
+        self.degraded = False
+        self._healthy_degraded_rounds = 0
+        self._readmit_needed = self.readmit_after
+        self._probation_left = 0
+        self._reset_lanes_pending = False
+        self.rounds = 0
+        self.degraded_rounds = 0
+        self.last_mttr_s: "float | None" = None
+        self._consensus_snapshot = None
+        self._verify_carry = False
+        self._export_gauges()
+
+    # -- layouts --------------------------------------------------------------
+
+    def _layout_for(self, device_ids) -> _Layout:
+        key = tuple(device_ids)
+        layout = self._layouts.get(key)
+        if layout is not None:
+            return layout
+        mesh = multihost.surviving_mesh(self.full_mesh, key)
+        n_dev = len(key)
+        pads = {gi: (-g.n_agents) % n_dev
+                for gi, g in enumerate(self.base_groups)}
+        if not self._layouts:
+            groups = self.base_groups          # first build certifies
+        else:
+            # siblings inherit the full engine's resolved routing and
+            # attached plans — a degrade must never re-certify
+            groups = self._ref.routed_groups()
+        groups = tuple(
+            dataclasses.replace(g, n_agents=self.base_groups[gi].n_agents
+                                + pads[gi])
+            for gi, g in enumerate(groups))
+        engine = FusedADMM(groups, self.options, mesh=mesh,
+                           watchdog_timeout_s=self.watchdog_timeout_s)
+        layout = _Layout(device_ids=key, mesh=mesh, engine=engine,
+                         pads=pads)
+        self._layouts[key] = layout
+        return layout
+
+    @property
+    def engine(self) -> FusedADMM:
+        """The engine currently serving (full or degraded mesh)."""
+        return self._current.engine
+
+    @property
+    def mesh_devices(self) -> int:
+        return len(self._current.device_ids)
+
+    # -- layout-stable state plumbing -----------------------------------------
+
+    def init_state(self, theta_batches):
+        """Fresh fleet state in the BASE layout. The full engine's lane
+        count may exceed the base group sizes (non-divisible groups pad
+        to the mesh), so the template is built at full-layout width and
+        sliced back — a mixed-width state (theta-derived leaves at base
+        width, zero-filled leaves at engine width) must never exist."""
+        full = self._layouts[self._full_ids]
+        _none, padded = self._ref.pad_state_rows(
+            full.pads, None, tuple(theta_batches))
+        state = full.engine.init_state(padded)
+        if not any(full.pads.values()):
+            return state
+        return self._slice_state(state)
+
+    def shift_state(self, state):
+        return self._ref.shift_state(state)
+
+    def _layout_masks(self, layout: _Layout, base_masks) -> tuple:
+        out = []
+        for gi, mask in enumerate(base_masks):
+            alive = jnp.asarray(mask, bool) & jnp.asarray(
+                ~self.dead_lanes[gi])
+            if layout.pads.get(gi):
+                alive = jnp.concatenate(
+                    [alive, jnp.zeros((layout.pads[gi],), bool)])
+            out.append(alive)
+        return tuple(out)
+
+    def _slice_state(self, state):
+        """State back to the base layout: drop each group's padding
+        rows."""
+        counts = {gi: g.n_agents for gi, g in enumerate(self.base_groups)}
+
+        def sl(leaf, gi):
+            return leaf[:counts[gi]]
+
+        lam = {a: tuple(
+            sl(piece, gi) for (gi, _c, _s), piece in zip(
+                self._ref._group_participations(a, "consensus"), pieces))
+            for a, pieces in state.lam.items()}
+        ex_diff = {a: tuple(
+            sl(piece, gi) for (gi, _c, _s), piece in zip(
+                self._ref._group_participations(a, "exchange"), pieces))
+            for a, pieces in state.ex_diff.items()}
+        return state._replace(
+            w=tuple(sl(state.w[gi], gi) for gi in counts),
+            y=tuple(sl(state.y[gi], gi) for gi in counts),
+            z=tuple(sl(state.z[gi], gi) for gi in counts),
+            lam=lam, ex_diff=ex_diff)
+
+    def _slice_rows(self, state, trajs, stats):
+        """Round outputs back to the base layout."""
+        counts = {gi: g.n_agents for gi, g in enumerate(self.base_groups)}
+
+        def sl(leaf, gi):
+            return leaf[:counts[gi]]
+
+        state = self._slice_state(state)
+        trajs = tuple(
+            jax.tree.map(lambda leaf, gi=gi: sl(leaf, gi), trajs[gi])
+            for gi in counts)
+        if stats.lane_quarantined is not None:
+            stats = stats._replace(lane_quarantined=tuple(
+                sl(stats.lane_quarantined[gi], gi) for gi in counts))
+        return state, trajs, stats
+
+    def _consensus_host(self, state) -> dict:
+        out = {}
+        for kind in ("zbar", "ex_mean", "ex_lam", "rho"):
+            for alias, leaf in getattr(state, kind).items():
+                out[(kind, alias)] = np.asarray(leaf)
+        return out
+
+    def _recenter_consensus_multipliers(self, state, masks):
+        """Restore the sum-of-active-multipliers = 0 invariant.
+
+        The consensus dual update CONSERVES the active multiplier sum
+        (``zbar`` is the masked mean, so the per-round increments cancel
+        across active lanes) — which means any change to the active set
+        leaves a stale sum behind: masking lanes out strands their share
+        of the balance with the survivors, and re-admitting a lane with
+        a zeroed multiplier removes its share outright. Either way the
+        fleet converges — confidently, with tiny residuals — to a
+        consensus biased by exactly ``mean_active(lam)/rho``, forever
+        (observed: a 6-tracker fleet re-admitting one lane settled
+        1/(n·rho) off the true mean and called it converged).
+        Re-centering at every membership transition keeps the degraded
+        AND the recovered equilibrium unbiased."""
+        lam = {a: list(p) for a, p in state.lam.items()}
+        for a, pieces in lam.items():
+            parts = self._ref._group_participations(a, "consensus")
+            tot = 0.0
+            cnt = 0.0
+            for slot, (gj, _c, _s) in enumerate(parts):
+                m = jnp.asarray(masks[gj], bool)
+                tot = tot + jnp.sum(
+                    jnp.where(m[:, None], pieces[slot], 0.0), axis=0)
+                cnt = cnt + jnp.sum(m)
+            mean = tot / jnp.maximum(cnt, 1)
+            for slot, (gj, _c, _s) in enumerate(parts):
+                m = jnp.asarray(masks[gj], bool)
+                pieces[slot] = jnp.where(
+                    m[:, None], pieces[slot] - mean[None, :],
+                    pieces[slot])
+        return state._replace(lam={a: tuple(p) for a, p in lam.items()})
+
+    def _reset_dead_lane_starts(self, state, theta_batches):
+        """Fresh warm starts for the lanes a dead shard carried — the
+        recycled-slot contract at device granularity: a lane that died
+        mid-iterate re-enters on the (sanitized) OCP initial guess and
+        zeroed multipliers, never its stale pre-failure iterate."""
+        w, y, z = list(state.w), list(state.y), list(state.z)
+        lam = {a: list(p) for a, p in state.lam.items()}
+        ex_diff = {a: list(p) for a, p in state.ex_diff.items()}
+        for gi, g in enumerate(self.base_groups):
+            dead = jnp.asarray(self.dead_lanes[gi])
+            if not bool(np.any(self.dead_lanes[gi])):
+                continue
+            w_init = jax.vmap(g.ocp.initial_guess)(theta_batches[gi])
+            w_init = jnp.where(jnp.isfinite(w_init), w_init, 0.0)
+            w[gi] = jnp.where(dead[:, None], w_init, w[gi])
+            y[gi] = jnp.where(dead[:, None], 0.0, y[gi])
+            z[gi] = jnp.where(dead[:, None], 0.1, z[gi])
+            for a, pieces in lam.items():
+                for slot, (gj, _c, _s) in enumerate(
+                        self._ref._group_participations(a, "consensus")):
+                    if gj == gi:
+                        pieces[slot] = jnp.where(dead[:, None], 0.0,
+                                                 pieces[slot])
+            for a, pieces in ex_diff.items():
+                for slot, (gj, _c, _s) in enumerate(
+                        self._ref._group_participations(a, "exchange")):
+                    if gj == gi:
+                        pieces[slot] = jnp.where(dead[:, None], 0.0,
+                                                 pieces[slot])
+        return state._replace(
+            w=tuple(w), y=tuple(y), z=tuple(z),
+            lam={a: tuple(p) for a, p in lam.items()},
+            ex_diff={a: tuple(p) for a, p in ex_diff.items()})
+
+    # -- the survivable round -------------------------------------------------
+
+    def step(self, state, theta_batches: Sequence, active=None):
+        """One fused round in the BASE layout, surviving shard loss.
+
+        Same signature and return contract as :meth:`FusedADMM.step`;
+        on a collective timeout the round is retried on the degraded
+        mesh from this very ``state`` (the pre-failure iterate), so the
+        caller's loop never sees the failure — only the stats and the
+        telemetry do."""
+        base_masks = (self.base_active if active is None
+                      else tuple(jnp.asarray(a, bool) for a in active))
+        theta_batches = tuple(theta_batches)
+        self._maybe_readmit()
+        if self._reset_lanes_pending:
+            state = self._reset_dead_lane_starts(state, theta_batches)
+            self.dead_lanes = tuple(
+                np.zeros((g.n_agents,), bool) for g in self.base_groups)
+            self._reset_lanes_pending = False
+            # the zeroed multipliers changed the active sum the dual
+            # update conserves — re-center or the recovered fleet
+            # settles mean(lam)/rho off the true consensus, forever
+            state = self._recenter_consensus_multipliers(state,
+                                                         base_masks)
+        # the pre-failure iterate's consensus fingerprint: what a
+        # degraded-mesh carry-over must reproduce bitwise
+        self._consensus_snapshot = self._consensus_host(state)
+        transient = 0
+        t_detect = None
+        while True:
+            layout = self._current
+            try:
+                out = self._run_layout(layout, state, theta_batches,
+                                       base_masks)
+                break
+            except MeshRoundTimeout:
+                if t_detect is None:
+                    t_detect = time.perf_counter()
+                report = self._probe(layout.mesh)
+                if not report.answered:
+                    raise RuntimeError(
+                        "no mesh device answered the post-condemnation "
+                        "probe — the whole mesh is unreachable; escalate "
+                        "to checkpoint restore "
+                        "(docs/robustness.md, 'Surviving shard loss')"
+                    ) from None
+                if report.dead:
+                    self._degrade(report)
+                    continue
+                transient += 1
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "mesh_round_retries_total",
+                        "condemned rounds retried on the same mesh "
+                        "(every shard answered the probe)").inc(
+                        reason="transient")
+                if transient > MAX_TRANSIENT_RETRIES:
+                    raise RuntimeError(
+                        f"fused round timed out {transient} times while "
+                        f"every shard answers the probe — the collective "
+                        f"is wedged without an attributable dead device; "
+                        f"raise watchdog_timeout_s or escalate to "
+                        f"checkpoint restore") from None
+                logger.warning(
+                    "condemned round retried on the same %d-device mesh "
+                    "(all shards answered the probe; attempt %d/%d)",
+                    len(layout.device_ids), transient,
+                    MAX_TRANSIENT_RETRIES)
+                layout.engine.mesh_condemned = False
+        if t_detect is not None:
+            self.last_mttr_s = time.perf_counter() - t_detect
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "mesh_shard_loss_recovery_seconds",
+                    "wall seconds from a condemned collective to the "
+                    "first completed (possibly degraded) round"
+                    ).observe(self.last_mttr_s)
+        self.rounds += 1
+        if self.degraded:
+            self.degraded_rounds += 1
+            self._healthy_degraded_rounds += 1
+        if self._probation_left > 0:
+            self._probation_left -= 1
+            if self._probation_left == 0:
+                # probation served: the full mesh proved itself
+                self._readmit_needed = self.readmit_after
+        state_out, trajs, stats = out
+        self._consensus_snapshot = self._consensus_host(state_out)
+        return state_out, trajs, stats
+
+    def _run_layout(self, layout: _Layout, state, theta_batches,
+                    base_masks):
+        if any(layout.pads.values()):
+            state, theta_batches = self._ref.pad_state_rows(
+                layout.pads, state, theta_batches)
+        # placement on the layout's mesh (shard_args with pre-padded
+        # inputs is pure placement: pads resolve to zero)
+        state, theta_batches = layout.engine.shard_args(
+            layout.mesh, state, theta_batches)
+        if self._verify_carry:
+            # the degraded carry-over must reproduce the pre-failure
+            # consensus iterate BITWISE after pad + placement — a carry
+            # that cannot is corrupted and must not resume
+            carried = self._consensus_host(state)
+            for key, ref in (self._consensus_snapshot or {}).items():
+                if not np.array_equal(carried[key], ref):
+                    kind, alias = key
+                    raise RuntimeError(
+                        f"degraded-mesh carry-over drifted from the "
+                        f"pre-failure iterate at {kind}[{alias}] — "
+                        f"refusing to resume from a corrupted carry")
+            self._verify_carry = False
+            # the dead lanes just left the active set, stranding their
+            # share of the conserved multiplier sum with the survivors
+            # — re-center so the DEGRADED equilibrium is the survivors'
+            # true consensus, not a biased one
+            state = self._recenter_consensus_multipliers(
+                state, self._layout_masks(layout, base_masks))
+        masks = self._layout_masks(layout, base_masks)
+        engine = layout.engine
+        if not getattr(engine, "_supervisor_warmed", False):
+            # first round of a fresh layout: trace+compile rides inside
+            # the bounded wait — give it the warmup allowance so a
+            # legitimate compile never reads as a collective stall
+            budget = engine.watchdog_timeout_s
+            engine.watchdog_timeout_s = budget + self.warmup_budget_s
+            try:
+                out = engine.step(state, theta_batches, active=masks)
+            finally:
+                engine.watchdog_timeout_s = budget
+            engine._supervisor_warmed = True
+        else:
+            out = engine.step(state, theta_batches, active=masks)
+        return self._slice_rows(*out)
+
+    # -- degrade / re-admit ---------------------------------------------------
+
+    def _mark_dead_lanes(self, dead_ids) -> None:
+        """Base-layout lanes hosted by the dead shards, derived from
+        the CURRENT layout's contiguous row assignment — on a cascading
+        loss the failure happens on an already-degraded mesh whose
+        rows-per-device and device positions differ from the full
+        layout's, and the lanes to mask are the ones the dying shard
+        actually hosted there (padding rows it hosted mask nothing)."""
+        layout = self._current
+        n_dev = len(layout.device_ids)
+        positions = [i for i, did in enumerate(layout.device_ids)
+                     if did in set(dead_ids)]
+        for gi, g in enumerate(self.base_groups):
+            n_rows = g.n_agents + layout.pads.get(gi, 0)
+            rpd = n_rows // n_dev
+            for p in positions:
+                lo, hi = p * rpd, (p + 1) * rpd
+                self.dead_lanes[gi][lo:min(hi, g.n_agents)] = True
+
+    def _degrade(self, report) -> None:
+        """Shard loss: rebuild on the surviving mesh, carry the warm
+        state over shard-aligned, mask the dead lanes."""
+        dead = tuple(report.dead)
+        alive = tuple(did for did in self._current.device_ids
+                      if did not in set(dead))
+        if not alive:
+            raise RuntimeError("every device of the current mesh is "
+                               "dead — escalate to checkpoint restore")
+        self._mark_dead_lanes(dead)
+        self.dead_devices = tuple(dict.fromkeys(
+            (*self.dead_devices, *dead)))
+        # consensus identity against the pre-failure iterate: the
+        # replicated leaves are host-snapshotted at round start; a
+        # carry that cannot reproduce them bitwise must not resume
+        snap = self._consensus_snapshot
+        if snap is not None:
+            for (kind, alias), ref in snap.items():
+                if not np.all(np.isfinite(ref)):
+                    raise RuntimeError(
+                        f"pre-failure consensus iterate {kind}[{alias}] "
+                        f"is non-finite — refusing to carry a corrupted "
+                        f"state onto the degraded mesh")
+        was = len(self._current.device_ids)
+        t0 = time.perf_counter()
+        self._current = self._layout_for(alive)
+        build_s = time.perf_counter() - t0
+        self.degraded = True
+        self._verify_carry = True
+        self._healthy_degraded_rounds = 0
+        if self._probation_left > 0:
+            # relapse during probation: hysteresis — the next
+            # re-admission needs twice the proof
+            self._readmit_needed = max(
+                self._readmit_needed * 2, self.readmit_after)
+            self._probation_left = 0
+        if telemetry.enabled():
+            telemetry.counter(
+                "mesh_degrade_total",
+                "degraded-mesh fallbacks (shard loss absorbed)").inc()
+        self._export_gauges()
+        logger.warning(
+            "fleet degraded %d -> %d devices (dead: %s; engine %s in "
+            "%.2fs); %d lane(s) masked until re-admission",
+            was, len(alive), list(dead),
+            "reused" if build_s < 0.05 else "built", build_s,
+            int(sum(int(d.sum()) for d in self.dead_lanes)))
+
+    def _maybe_readmit(self) -> None:
+        if not self.degraded:
+            return
+        if self._healthy_degraded_rounds < self._readmit_needed:
+            return
+        report = self._probe(self.full_mesh)
+        if not report.all_answered:
+            # restart the hysteresis clock: probing a still-dead device
+            # costs the probe deadline AND leaks one wedged probe
+            # thread per dead device on real hardware (the block is
+            # uncancellable) — once per readmit window is the bounded
+            # rate, once per round would not be
+            self._healthy_degraded_rounds = 0
+            logger.info(
+                "re-admission probe: %d device(s) still dead (%s) — "
+                "staying on the degraded mesh; next probe after %d "
+                "more healthy rounds", len(report.dead),
+                list(report.dead), self._readmit_needed)
+            return
+        full = self._layouts[self._full_ids]
+        full.engine.mesh_condemned = False
+        self._current = full
+        self.degraded = False
+        self._healthy_degraded_rounds = 0
+        self._reset_lanes_pending = True
+        self._probation_left = self.probation_rounds
+        self.dead_devices = ()
+        if telemetry.enabled():
+            telemetry.counter(
+                "mesh_readmit_total",
+                "full-mesh re-admissions after degraded service").inc()
+        self._export_gauges()
+        logger.warning(
+            "full %d-device mesh re-admitted on probation (%d rounds); "
+            "lost lanes re-enter with fresh warm starts",
+            len(self._full_ids), self.probation_rounds)
+
+    # -- operator / gate hooks ------------------------------------------------
+
+    def force_degrade(self, dead_device_ids) -> None:
+        """Operator/gate entry: degrade as if ``dead_device_ids`` had
+        failed a probe (no round needs to time out first)."""
+        self._degrade(multihost.ShardProbeReport(
+            answered=tuple(d for d in self._current.device_ids
+                           if d not in set(dead_device_ids)),
+            dead=tuple(dead_device_ids), latency_s={}))
+
+    def force_readmit(self) -> None:
+        """Operator/gate entry: reshard back to the full mesh now,
+        bypassing the hysteresis clock (the probe still runs via
+        :meth:`_maybe_readmit` on the next step for the honest path;
+        this one trusts the operator)."""
+        self._healthy_degraded_rounds = self._readmit_needed
+        probe, self._probe = self._probe, lambda m: \
+            multihost.ShardProbeReport(
+                answered=tuple(d.id for d in m.devices.flat),
+                dead=(), latency_s={})
+        try:
+            self._maybe_readmit()
+        finally:
+            self._probe = probe
+
+    def _export_gauges(self) -> None:
+        if telemetry.enabled():
+            telemetry.gauge(
+                "mesh_devices_active",
+                "devices in the mesh currently serving the fleet").set(
+                float(len(self._current.device_ids)))
+
+    def stats(self) -> dict:
+        return {
+            "devices_full": len(self._full_ids),
+            "devices_active": len(self._current.device_ids),
+            "degraded": self.degraded,
+            "dead_devices": list(self.dead_devices),
+            "dead_lanes": int(sum(int(d.sum()) for d in self.dead_lanes)),
+            "rounds": self.rounds,
+            "degraded_rounds": self.degraded_rounds,
+            "layouts_built": len(self._layouts),
+            "last_mttr_s": self.last_mttr_s,
+            "probation_left": self._probation_left,
+        }

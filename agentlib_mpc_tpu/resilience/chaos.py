@@ -29,6 +29,14 @@ Injections are counted in ``chaos_injections_total{kind=...}`` and
 logged on the returned :class:`ChaosController` (``.events``), which
 also restores every seam on ``uninstall()``. Config reference:
 ``docs/robustness.md``.
+
+Two further scopes live below: the serving plane
+(:func:`install_serving_chaos` — NaN storms, dispatcher stalls, build
+failures, checkpoint corruption; the ``--chaos-serve`` fault model) and
+the device mesh (:func:`install_mesh_chaos` — collective stalls,
+simulated device loss with revival, shard-local NaN storms on a
+:class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`; the
+``--chaos-mesh`` fault model).
 """
 
 from __future__ import annotations
@@ -503,7 +511,7 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
         cache = plane.cache
         orig_gob = cache.get_or_build
 
-        def get_or_build(key, builder, label=""):
+        def get_or_build(key, builder, label="", restorer=None):
             def chaotic_builder():
                 idx = counters["build"]
                 counters["build"] += 1
@@ -516,7 +524,8 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
                         f"chaos: engine build {idx} for bucket "
                         f"{label or '?'} failed")
                 return builder()
-            return orig_gob(key, chaotic_builder, label)
+            return orig_gob(key, chaotic_builder, label,
+                            restorer=restorer)
 
         cache.get_or_build = get_or_build
         controller._restores.append(
@@ -565,6 +574,230 @@ def corrupt_checkpoint(path: str, mode: str = "truncate") -> list:
     logger.warning("chaos: truncated %d data files under %s",
                    len(victims), path)
     return victims
+
+
+# -- mesh-scope chaos (the --chaos-mesh fault model, ISSUE 10) ----------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshStallRule:
+    """Hang one fused round's dispatch for ``duration_s`` — the
+    collective-stall signature (a hung psum participant) the engine's
+    collective watchdog must condemn. The sleep runs inside the
+    watchdog's reader thread, so a long stall costs one leaked daemon
+    thread exactly like a real wedged collective. With every shard
+    still answering the probe, the supervisor retries the round on the
+    SAME mesh (the transient path)."""
+
+    round: int = 0
+    duration_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshDeviceLossRule:
+    """Simulated device loss: from ``die_at_round`` every round whose
+    serving mesh still contains the device hangs (collective wedged
+    behind the dead participant), and the device stops answering the
+    supervisor's per-device probe — so the first condemned round
+    degrades the fleet onto the survivors. ``revive_at_round`` brings
+    the device back (it answers probes again; the supervisor's
+    hysteretic re-admission reshards to the full mesh); None = stays
+    dead."""
+
+    device_index: int = 0        # position in the supervisor's FULL mesh
+    die_at_round: int = 0
+    revive_at_round: Optional[int] = None
+
+    def dead(self, round_: int) -> bool:
+        if round_ < self.die_at_round:
+            return False
+        return self.revive_at_round is None or \
+            round_ < self.revive_at_round
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshNaNStormRule:
+    """Shard-local NaN storm: every round inside the window, the theta
+    rows of the lanes hosted by one shard are NaN-poisoned — the
+    bad-sensor-feed failure at device granularity. The fused
+    quarantine must contain it (substituted iterates, masked means):
+    the OTHER shards' agents keep producing finite controls and the
+    consensus state stays finite."""
+
+    device_index: int = 0
+    start_round: int = 0
+    n_rounds: Optional[int] = 1
+
+    def triggered(self, round_: int) -> bool:
+        if round_ < self.start_round:
+            return False
+        return self.n_rounds is None or \
+            round_ < self.start_round + self.n_rounds
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshChaosConfig:
+    seed: int = 0
+    stall: tuple = ()
+    device_loss: tuple = ()
+    nan_storm: tuple = ()
+
+    @classmethod
+    def from_dict(cls, cfg: dict) -> "MeshChaosConfig":
+        known = {"seed", "stall", "device_loss", "nan_storm"}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown mesh-chaos option(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}")
+        return cls(
+            seed=int(cfg.get("seed", 0)),
+            stall=tuple(r if isinstance(r, MeshStallRule)
+                        else MeshStallRule(**r)
+                        for r in cfg.get("stall", ())),
+            device_loss=tuple(
+                r if isinstance(r, MeshDeviceLossRule)
+                else MeshDeviceLossRule(**r)
+                for r in cfg.get("device_loss", ())),
+            nan_storm=tuple(
+                r if isinstance(r, MeshNaNStormRule)
+                else MeshNaNStormRule(**r)
+                for r in cfg.get("nan_storm", ())),
+        )
+
+
+def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
+                       seed: "int | None" = None) -> ChaosController:
+    """Install the mesh-scope injectors on a
+    :class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`.
+
+    Two seams: the supervisor's per-round dispatch (stalls, device-loss
+    hangs, shard-local theta poisoning — injected by wrapping each
+    engine's ``_step`` for exactly one watchdogged dispatch) and the
+    supervisor's ``_probe`` (a "dead" device is dropped from the
+    answered set while its loss rule is active, so degradation and
+    re-admission follow the probe exactly like a real device loss).
+    Rounds are counted at the supervisor's ``step`` granularity.
+    """
+    import time as _time
+
+    if not isinstance(config, MeshChaosConfig):
+        config = MeshChaosConfig.from_dict(config)
+    if seed is not None:
+        config = dataclasses.replace(config, seed=int(seed))
+    controller = ChaosController(ChaosConfig(seed=config.seed))
+    counters = {"round": 0}
+    fired_stalls: set = set()
+    full_ids = supervisor._full_ids
+
+    def dead_ids_now() -> set:
+        r = counters["round"]
+        out = set()
+        for rule in config.device_loss:
+            if rule.dead(r):
+                out.add(full_ids[rule.device_index])
+        return out
+
+    orig_probe = supervisor._probe
+
+    def probe(mesh):
+        report = orig_probe(mesh)
+        dead = dead_ids_now()
+        if not dead:
+            return report
+        answered = tuple(d for d in report.answered if d not in dead)
+        newly_dead = tuple(d for d in report.answered if d in dead)
+        if newly_dead:
+            controller.note("mesh_probe_dead",
+                            f"devices{list(newly_dead)}")
+        return report._replace(
+            answered=answered,
+            dead=tuple((*report.dead, *newly_dead)),
+            latency_s={k: v for k, v in report.latency_s.items()
+                       if k not in dead})
+
+    supervisor._probe = probe
+    controller._restores.append(
+        lambda: setattr(supervisor, "_probe", orig_probe))
+
+    orig_run = supervisor._run_layout
+
+    def run_layout(layout, state, theta_batches, base_masks):
+        r = counters["round"]
+        # shard-local NaN storm: poison the theta rows the target
+        # shard hosts (base-layout rows via the supervisor's own
+        # full-mesh row assignment)
+        for rule in config.nan_storm:
+            if not rule.triggered(r):
+                continue
+            controller.note("mesh_nan_theta",
+                            f"device{rule.device_index}:round{r}")
+            full = supervisor._layouts[full_ids]
+            n_dev = len(full_ids)
+            poisoned = []
+            for gi, g in enumerate(supervisor.base_groups):
+                n_full = g.n_agents + full.pads.get(gi, 0)
+                rpd = n_full // n_dev
+                lo = rule.device_index * rpd
+                hi = min((rule.device_index + 1) * rpd, g.n_agents)
+
+                def poison(leaf, lo=lo, hi=hi):
+                    if hi <= lo:
+                        return leaf
+                    arr = np.asarray(leaf, dtype=float).copy()
+                    arr[lo:hi] = np.nan
+                    return arr
+
+                import jax as _jax
+
+                poisoned.append(_jax.tree.map(poison, theta_batches[gi]))
+            theta_batches = tuple(poisoned)
+        # stall / device-loss hang: wrap THIS dispatch of the layout's
+        # engine so the sleep lands inside the collective watchdog's
+        # reader thread
+        hang_s = None
+        # a stall fires ONCE: the supervisor's transient retry of the
+        # same round (all shards answer the probe) must then succeed
+        stall = next((i for i, x in enumerate(config.stall)
+                      if x.round == r and i not in fired_stalls), None)
+        if stall is not None:
+            fired_stalls.add(stall)
+            hang_s = float(config.stall[stall].duration_s)
+            controller.note("mesh_stall", f"round{r}")
+        if hang_s is None:
+            dead = dead_ids_now()
+            if dead & set(layout.device_ids):
+                hang_s = supervisor.watchdog_timeout_s * 10
+                controller.note("mesh_device_hang",
+                                f"round{r}:{sorted(dead)}")
+        if hang_s is None:
+            return orig_run(layout, state, theta_batches, base_masks)
+        engine = layout.engine
+        orig_step = engine._step
+
+        def slow_step(*args, _orig=orig_step, _s=hang_s):
+            _time.sleep(_s)
+            return _orig(*args)
+
+        engine._step = slow_step
+        try:
+            return orig_run(layout, state, theta_batches, base_masks)
+        finally:
+            engine._step = orig_step
+
+    def step(state, theta_batches, active=None):
+        try:
+            return orig_step_sup(state, theta_batches, active)
+        finally:
+            counters["round"] += 1
+
+    orig_step_sup = supervisor.step
+    supervisor._run_layout = run_layout
+    supervisor.step = step
+    controller._restores.append(
+        lambda: (setattr(supervisor, "_run_layout", orig_run),
+                 setattr(supervisor, "step", orig_step_sup)))
+    return controller
 
 
 # -- serving-plane tenant churn (the --serve benchmark's load model) ----------

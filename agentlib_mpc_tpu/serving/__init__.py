@@ -30,7 +30,15 @@ population over the same fused data plane:
   degrading its bucket's batch indefinitely.
 * :mod:`.checkpoint` — durable plane snapshots; crash recovery restores
   buckets through the compile cache (cached-join splices, measured as
-  MTTR), never a cold rebuild against a warm cache.
+  MTTR), never a cold rebuild against a warm cache. The manifest stamps
+  the device topology (mesh size + slot multiple); restoring onto a
+  different topology fails loudly with a reshard recipe.
+* :mod:`.store` — :class:`EngineStore`: the cross-process tier of the
+  compile cache. Cold builds export their compiled step (portable
+  StableHLO); a FRESH process revives the engine from disk — no
+  certification, no solver tracing, one persistent-cache-covered XLA
+  compile — so crash-restart MTTR survives real process death
+  (``ServingPlane(engine_store=True)``).
 
 Benchmarks: ``python bench.py --serve SEED [n]`` measures sustained
 solves/sec and p50/p99 round latency under seeded tenant churn;
@@ -49,6 +57,7 @@ from agentlib_mpc_tpu.serving.cache import CompileCache  # noqa: F401
 from agentlib_mpc_tpu.serving.checkpoint import (  # noqa: F401
     RestoreReport,
     has_plane_checkpoint,
+    plane_checkpoint_topology,
     restore_plane,
     save_plane,
 )
@@ -67,3 +76,4 @@ from agentlib_mpc_tpu.serving.plane import (  # noqa: F401
     ServingPlane,
 )
 from agentlib_mpc_tpu.serving.slots import SlotPlane  # noqa: F401
+from agentlib_mpc_tpu.serving.store import EngineStore  # noqa: F401

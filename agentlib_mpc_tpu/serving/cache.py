@@ -50,6 +50,11 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: engines revived from a cross-process artifact (the on-disk
+        #: engine store) instead of built — neither a hit (no warm
+        #: executable existed in THIS process) nor a cold build (no
+        #: certify/trace was paid)
+        self.persistent_restores = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -80,14 +85,35 @@ class CompileCache:
                     "compiled serving engines dropped by the LRU bound "
                     "(max_engines)").inc(bucket=label or "?")
 
-    def get_or_build(self, key, builder, label: str = ""):
+    def get_or_build(self, key, builder, label: str = "",
+                     restorer=None):
+        """``restorer``: optional zero-arg callable tried BEFORE
+        ``builder`` on an entry miss — the cross-process warm-restore
+        tier (deserialize an engine-store artifact instead of
+        certify+trace+compile). Returns None to decline, in which case
+        the cold ``builder`` runs and counts as a miss; a revived
+        engine counts in ``persistent_restores`` and
+        ``serving_compile_cache_persistent_restores_total`` instead."""
         t0 = time.perf_counter()
         entry = self._entries.get(key)
         hit = entry is not None
+        restored = False
         if not hit:
-            engine = builder()
+            engine = None
+            if restorer is not None:
+                engine = restorer()
+                restored = engine is not None
+            if restored:
+                self.persistent_restores += 1
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "serving_compile_cache_persistent_restores_total",
+                        "engines revived from the on-disk export store "
+                        "(no certify/trace paid)").inc(bucket=label or "?")
+            else:
+                engine = builder()
+                self.misses += 1
             self._entries[key] = (engine, label)
-            self.misses += 1
             self._evict_over_bound()
         else:
             engine = entry[0]
@@ -95,15 +121,18 @@ class CompileCache:
             self.hits += 1
         latency = time.perf_counter() - t0
         if telemetry.enabled():
-            name = ("serving_compile_cache_hits_total" if hit
-                    else "serving_compile_cache_misses_total")
-            telemetry.counter(
-                name, "serving engine cache lookups that "
-                + ("reused a compiled engine" if hit
-                   else "had to build (certify + trace + compile)")
-                ).inc(bucket=label or "?")
+            if not restored:
+                name = ("serving_compile_cache_hits_total" if hit
+                        else "serving_compile_cache_misses_total")
+                telemetry.counter(
+                    name, "serving engine cache lookups that "
+                    + ("reused a compiled engine" if hit
+                       else "had to build (certify + trace + compile)")
+                    ).inc(bucket=label or "?")
             telemetry.histogram(
                 "serving_join_build_seconds",
                 "engine acquisition latency at tenant join, by cache "
-                "outcome").observe(latency, cached="yes" if hit else "no")
+                "outcome").observe(
+                latency, cached=("restored" if restored
+                                 else "yes" if hit else "no"))
         return engine, hit, latency

@@ -74,6 +74,9 @@ class RestoreReport(NamedTuple):
     per_tenant_s: dict
     #: whole-restore wall seconds: the measured crash-restart MTTR
     total_s: float
+    #: engines revived from the cross-process export store (no
+    #: certify/trace paid — the fresh-process warm-restore tier)
+    persistent_restores: int = 0
 
 
 def _placeholder_empties(tree):
@@ -118,8 +121,26 @@ def has_plane_checkpoint(path: str) -> bool:
     """True when :func:`restore_plane` has something COMPLETE to try:
     the manifest is written after the array payload, so a save killed
     mid-write leaves a directory this rejects (the fresh-deployment /
-    crashed-first-save guard)."""
+    crashed-first-save guard). Completeness only — device-topology
+    compatibility is :func:`restore_plane`'s loud check (read it ahead
+    of time with :func:`plane_checkpoint_topology` when the supervisor
+    must decide restore-vs-rejoin before building a plane)."""
     return _checkpoint_dir(os.path.abspath(path)) is not None
+
+
+def plane_checkpoint_topology(path: str) -> "dict | None":
+    """The device topology a complete checkpoint was saved under
+    (``{"mesh_devices", "mesh_axis", "slot_multiple",
+    "backend_devices"}``), or None when the checkpoint is absent or
+    predates topology stamping. Lets a restarting supervisor pick a
+    matching plane config — or decide to re-join tenants fresh —
+    WITHOUT tripping :func:`restore_plane`'s drift rejection."""
+    src = _checkpoint_dir(os.path.abspath(path))
+    if src is None:
+        return None
+    with open(os.path.join(src, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    return manifest.get("topology")
 
 
 def save_plane(plane, path: str) -> str:
@@ -162,9 +183,23 @@ def save_plane(plane, path: str) -> str:
             "theta": bucket.theta_batch,
             "mask": jnp.asarray(bucket.mask),
         })
+    import jax
+
     manifest = {
         "version": VERSION,
         "rounds": int(plane.rounds),
+        # device topology the slot layouts were padded for: a restore
+        # on a different mesh/slot-multiple would splice misaligned
+        # lanes — restore_plane rejects the drift LOUDLY (ISSUE 10
+        # satellite; the old manifest ignored topology entirely)
+        "topology": {
+            "slot_multiple": int(plane.slot_multiple),
+            "mesh_devices": (None if plane.mesh is None
+                             else int(plane.mesh.devices.size)),
+            "mesh_axis": (None if plane.mesh is None
+                          else str(plane.mesh.axis_names[0])),
+            "backend_devices": len(jax.devices()),
+        },
         "buckets": buckets,
         "evicted": {tid: key.digest
                     for tid, key in plane._evicted.items()},
@@ -235,9 +270,37 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
             f"plane checkpoint version {manifest.get('version')} is not "
             f"supported (expected {VERSION})")
 
+    topo = manifest.get("topology")
+    if topo is None:
+        logger.warning(
+            "plane checkpoint at %s predates topology stamping — "
+            "restoring WITHOUT the mesh/slot-multiple drift check", src)
+    else:
+        want_mesh = None if plane.mesh is None \
+            else int(plane.mesh.devices.size)
+        saved_mesh = topo.get("mesh_devices")
+        saved_mult = int(topo.get("slot_multiple", 0))
+        if saved_mesh != want_mesh or saved_mult != plane.slot_multiple:
+            raise ValueError(
+                f"checkpoint topology mismatch: saved on "
+                f"mesh_devices={saved_mesh} / "
+                f"slot_multiple={saved_mult}, restoring into "
+                f"mesh_devices={want_mesh} / "
+                f"slot_multiple={plane.slot_multiple} — slot layouts "
+                f"(and any sharded executables) would misalign. Either "
+                f"(a) restore into a plane built on the recorded "
+                f"topology (ServingPlane(mesh=<{saved_mesh}-device "
+                f"mesh>) / slot_multiple={saved_mult}), or (b) RESHARD: "
+                f"start an empty plane on the new mesh and re-join "
+                f"every tenant from its spec — capacities re-pad to "
+                f"serving_slot_multiple(mesh) and warm starts reset "
+                f"(the documented cost of changing topology; "
+                f"docs/serving.md 'Cross-process restore')")
+
     if not isinstance(specs, dict):
         specs = {s.tenant_id: s for s in specs}
     hits0, misses0 = plane.cache.hits, plane.cache.misses
+    restores0 = plane.cache.persistent_restores
     per_tenant_s: dict = {}
     templates, restored_buckets = [], []
     for entry in manifest["buckets"]:
@@ -350,10 +413,13 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
         requeued=requeued,
         per_tenant_s=per_tenant_s,
         total_s=time.perf_counter() - t0,
+        persistent_restores=plane.cache.persistent_restores - restores0,
     )
     logger.info(
         "serving plane restored from %s: %d tenants / %d buckets in "
-        "%.1f ms (%d cold builds, %d cache hits, %d requeued)", src,
+        "%.1f ms (%d cold builds, %d cache hits, %d store revivals, "
+        "%d requeued)", src,
         len(report.tenants), report.buckets, 1e3 * report.total_s,
-        report.cold_builds, report.cache_hits, requeued)
+        report.cold_builds, report.cache_hits,
+        report.persistent_restores, requeued)
     return report

@@ -32,8 +32,14 @@ synchronous loop (no second round is ever put behind a stalled one),
 and a bounded device re-probe (the ``bench.py
 _probe_platform_bounded`` pattern) records whether the backend still
 answers. The thread blocked on the dead transfer cannot be cancelled —
-it is leaked as a daemon and costs one idle thread until the device
-returns or the process exits (the documented price of surviving).
+it is leaked as a daemon until the device returns or the process exits
+(the documented price of surviving), but the leakage is BOUNDED: reads
+run on a :class:`~agentlib_mpc_tpu.utils.watchdog.BoundedReader` that
+reuses one persistent worker while the device answers, caps the number
+of concurrently-wedged threads, refuses further reads at the cap
+WITHOUT waiting out the timeout (the device is already known-dead),
+and exports the wedged count as the
+``dispatch_watchdog_threads_leaked`` gauge.
 """
 
 from __future__ import annotations
@@ -42,6 +48,7 @@ import logging
 import threading
 
 from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.utils.watchdog import BoundedReader
 
 logger = logging.getLogger(__name__)
 
@@ -88,9 +95,17 @@ class PipelinedDispatcher:
     optional watchdog (``timeout_s``) on every materialize."""
 
     def __init__(self, pipelined: bool = True,
-                 timeout_s: "float | None" = None):
+                 timeout_s: "float | None" = None,
+                 max_leaked_readers: "int | None" = None):
         self.pipelined = bool(pipelined)
         self.timeout_s = None if timeout_s is None else float(timeout_s)
+        from agentlib_mpc_tpu.utils import watchdog as _watchdog
+
+        self._reader = BoundedReader(
+            name="serving-materialize",
+            max_leaked=(_watchdog.MAX_LEAKED_READERS
+                        if max_leaked_readers is None
+                        else max_leaked_readers))
         self._inflight: dict = {}
         #: rounds condemned by a stall in ANOTHER bucket (drained via
         #: :meth:`drain_failed` — never materialized: the device is
@@ -111,31 +126,25 @@ class PipelinedDispatcher:
         when the device never answered."""
         if self.timeout_s is None:
             return slot_plane.materialize(handle)
-        # a plain DAEMON thread, not a ThreadPoolExecutor: executor
-        # workers are non-daemon and the interpreter JOINS them at
-        # exit, so a truly wedged transfer would hang process shutdown
-        # — the exact failure the watchdog exists to survive
-        box: list = []
-
-        def read() -> None:
-            try:
-                box.append(("ok", slot_plane.materialize(handle)))
-            except BaseException as exc:  # noqa: BLE001 - re-raised below
-                box.append(("err", exc))
-
-        t = threading.Thread(target=read, daemon=True,
-                             name="serving-materialize")
-        t.start()
-        t.join(self.timeout_s)
-        if not box:
-            return self._stall(label)
-        kind, value = box[0]
+        # daemon workers via BoundedReader, not a ThreadPoolExecutor:
+        # executor workers are non-daemon and the interpreter JOINS
+        # them at exit, so a truly wedged transfer would hang process
+        # shutdown — the exact failure the watchdog exists to survive.
+        # The reader reuses one worker while reads complete, caps the
+        # wedged-thread leak, and at the cap refuses the read without
+        # burning another full timeout against a known-dead device.
+        kind, value = self._reader.run(
+            lambda: slot_plane.materialize(handle), self.timeout_s)
         if kind == "err":
             # a decode error is not a stall: let the caller see it
             raise value
+        if kind == "timeout":
+            return self._stall(label)
+        if kind == "saturated":
+            return self._stall(label, waited=False)
         return value
 
-    def _stall(self, label: str) -> RoundTimeout:
+    def _stall(self, label: str, waited: bool = True) -> RoundTimeout:
         self.stalls += 1
         self.sync_fallback = True
         was_pipelined = self.pipelined
@@ -145,6 +154,14 @@ class PipelinedDispatcher:
                 "serving_watchdog_stalls_total",
                 "in-flight rounds declared dead by the dispatch "
                 "watchdog").inc(bucket=label or "?")
+        if not waited:
+            # the leak cap refused the read outright — the device is
+            # already known-dead; a re-probe would just leak one more
+            logger.error(
+                "serving round refused at the watchdog leak cap "
+                "(%d wedged readers, bucket %s); shedding its tenants "
+                "without waiting", self._reader.max_leaked, label or "?")
+            return RoundTimeout(served=())
         # bounded re-probe: is the backend gone, or was it one round?
         # Capped well below the watchdog budget — it is diagnostic
         # only and must not double the round's blocking time.

@@ -115,7 +115,8 @@ class ServingPlane:
                  watchdog_timeout_s: "float | None" = None,
                  max_engines: "int | None" = None,
                  cache: "CompileCache | None" = None,
-                 mesh=None):
+                 mesh=None,
+                 engine_store=None):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -166,6 +167,25 @@ class ServingPlane:
         #: cache plays this role
         self.cache = cache if cache is not None \
             else CompileCache(max_engines=max_engines)
+        #: cross-process warm-restore tier: ``True`` enables the
+        #: default on-disk store (next to the persistent XLA cache), a
+        #: path/EngineStore selects one explicitly. Cold builds export
+        #: their compiled step (portable StableHLO) into the store; a
+        #: FRESH process's engine acquisition then revives the engine —
+        #: no certification, no solver tracing, one persistent-cache-
+        #: covered XLA compile — so ``restore_checkpoint`` after real
+        #: process death is cache-hit splices, not cold builds
+        #: (docs/serving.md "Cross-process restore")
+        from agentlib_mpc_tpu.serving.store import EngineStore
+
+        if engine_store is None or engine_store is False:
+            self.engine_store = None
+        elif isinstance(engine_store, EngineStore):
+            self.engine_store = engine_store
+        elif engine_store is True or engine_store == "auto":
+            self.engine_store = EngineStore()
+        else:
+            self.engine_store = EngineStore(str(engine_store))
         self.dispatcher = PipelinedDispatcher(pipelined,
                                               timeout_s=watchdog_timeout_s)
         self.queue = AdmissionQueue(queue_limit, default_deadline_s)
@@ -267,7 +287,7 @@ class ServingPlane:
         engine_key = (key, capacity, self._options_key(), self.donate,
                       self._mesh_key())
 
-        def build():
+        def make_engine(qp_fast_path: str):
             group = AgentGroup(
                 name=f"bucket-{key.digest}",
                 ocp=spec.ocp, n_agents=capacity,
@@ -275,24 +295,98 @@ class ServingPlane:
                 exchanges=dict(key.exchanges),
                 solver_options=key.solver_options,
                 warm_solver_options=key.warm_solver_options,
-                qp_fast_path=key.qp_fast_path)
-            engine = FusedADMM(
+                qp_fast_path=qp_fast_path)
+            return FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
                 donate_state=self.donate, mesh=self.mesh)
-            if self.warm_on_build:
+
+        def warm_args(engine):
+            # throwaway template inputs, mesh-placed for sharded
+            # engines so the warmed executable is the serving one
+            theta_b = tree_repeat(spec.theta, capacity)
+            state = engine.init_state([theta_b])
+            if self.mesh is not None:
+                state, (theta_b,) = engine.shard_args(
+                    self.mesh, state, [theta_b])
+            return state, [theta_b], [jnp.zeros((capacity,), bool)]
+
+        def build():
+            engine = make_engine(key.qp_fast_path)
+            if self.warm_on_build or self.engine_store is not None:
                 # pay trace+compile NOW so the cold/cached join-latency
                 # split is honest and the first served round is warm.
                 # Throwaway state: with donation its buffers are
                 # consumed by this very step — nothing else holds them.
-                theta_b = tree_repeat(spec.theta, capacity)
-                warm_state = engine.init_state([theta_b])
-                engine.step(warm_state, [theta_b],
-                            active=[jnp.zeros((capacity,), bool)])
+                state, thetas, masks = warm_args(engine)
+                engine.step(state, thetas, active=masks)
+            if self.engine_store is not None:
+                # persist the compiled step for cross-process revival;
+                # export failure must never fail a join (the store is
+                # an accelerator, not a dependency)
+                try:
+                    from agentlib_mpc_tpu.parallel.export import (
+                        export_fused_step,
+                        prewarm_exported,
+                    )
+
+                    state, thetas, masks = warm_args(engine)
+                    blob = export_fused_step(engine, state, thetas,
+                                             active=masks)
+                    # seed the persistent XLA cache with the exported
+                    # twin's program: the first crash restart then
+                    # compiles from disk instead of from scratch
+                    prewarm_exported(blob, state, thetas, masks)
+                    self.engine_store.save(store_digest, blob, {
+                        "bucket": key.digest,
+                        "capacity": int(capacity),
+                        "donate": bool(self.donate),
+                        "mesh_devices": (None if self.mesh is None else
+                                         int(self.mesh.devices.size)),
+                        "qp_fast_path": ("on" if engine.group_uses_qp[0]
+                                         else "off"),
+                    })
+                except Exception:  # noqa: BLE001 - store is best-effort
+                    logger.warning(
+                        "engine export to the store failed for bucket "
+                        "%s; crash restarts will rebuild cold",
+                        key.digest, exc_info=True)
             return engine
 
+        def restore_from_store():
+            loaded = self.engine_store.load(store_digest)
+            if loaded is None:
+                return None
+            blob, meta = loaded
+            try:
+                from agentlib_mpc_tpu.parallel.export import (
+                    install_exported_step,
+                )
+
+                engine = make_engine(meta.get("qp_fast_path", "off"))
+                install_exported_step(
+                    engine, blob,
+                    warm_args=warm_args(engine) if self.warm_on_build
+                    else None)
+                logger.info(
+                    "bucket %s revived from the engine store "
+                    "(no certify/trace paid)", key.digest)
+                return engine
+            except Exception:  # noqa: BLE001 - fall back to cold build
+                logger.warning(
+                    "engine-store revival failed for bucket %s; "
+                    "building cold", key.digest, exc_info=True)
+                return None
+
+        store_digest = None
+        restorer = None
+        if self.engine_store is not None:
+            from agentlib_mpc_tpu.serving.store import EngineStore
+
+            store_digest = EngineStore.digest(engine_key)
+            restorer = restore_from_store
         engine, hit, _latency = self.cache.get_or_build(
-            engine_key, build, label=key.digest)
+            engine_key, build, label=key.digest, restorer=restorer)
         bucket = SlotPlane(engine, spec.ocp, spec.theta)
         if migrate_from is not None:
             self._stash_flush(key)       # deliver the old plane's round

@@ -1,0 +1,120 @@
+"""On-disk engine store: the cross-process rung of the compile cache.
+
+The in-process :class:`~agentlib_mpc_tpu.serving.cache.CompileCache`
+dies with the process; the persistent XLA cache survives but only
+covers the XLA-compile rung of a cold build — certification and solver
+tracing (seconds each) were still paid on every crash restart. The
+store persists what those rungs produce: the engine's exported step
+(portable StableHLO, :mod:`agentlib_mpc_tpu.parallel.export`) plus a
+small metadata record (resolved qp routing, capacity, mesh identity,
+donate flag). A fresh process then *revives* the engine — constructs
+the cheap Python object with certification forced off, installs the
+deserialized step, and pays one persistent-cache-covered XLA compile —
+instead of rebuilding it.
+
+Layout (under ``root``, default ``<repo>/.jax_cache/engine_store``)::
+
+    <digest>.stablehlo   # the exported step
+    <digest>.json        # metadata; written LAST = completeness marker
+
+``digest`` hashes the same identity tuple the in-process cache keys on
+(bucket fingerprint, capacity, engine options, donate, mesh), so the
+two tiers can never alias different programs. Writes are atomic
+(tmp + rename) and the JSON lands last — a crash mid-save leaves an
+artifact :meth:`load` ignores.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+
+
+def default_store_dir() -> str:
+    """Sibling of the persistent XLA cache, so the two cross-process
+    tiers live (and get cleaned) together."""
+    from agentlib_mpc_tpu.utils.jax_setup import _default_cache_dir
+
+    return os.path.join(_default_cache_dir(), "engine_store")
+
+
+class EngineStore:
+    """Persist/revive exported fused-step artifacts by engine identity."""
+
+    def __init__(self, root: "str | None" = None):
+        self.root = os.path.abspath(root or default_store_dir())
+        os.makedirs(self.root, exist_ok=True)
+        self.saves = 0
+        self.loads = 0
+
+    @staticmethod
+    def digest(engine_key) -> str:
+        """Stable cross-process digest of the in-process engine key
+        (BucketKey digest + capacity + options + donate + mesh). The
+        BucketKey's own digest is the jaxpr structural fingerprint, so
+        two processes transcribing the same problem agree here."""
+        key, capacity, options_key, donate, mesh_key = engine_key
+        ident = "|".join([
+            f"v{FORMAT_VERSION}",
+            getattr(key, "digest", str(key)),
+            f"cap={int(capacity)}",
+            f"opts={options_key!r}",
+            f"donate={bool(donate)}",
+            f"mesh={mesh_key!r}",
+        ])
+        return hashlib.sha256(ident.encode()).hexdigest()[:24]
+
+    def _paths(self, digest: str) -> tuple:
+        return (os.path.join(self.root, f"{digest}.stablehlo"),
+                os.path.join(self.root, f"{digest}.json"))
+
+    def has(self, digest: str) -> bool:
+        blob, meta = self._paths(digest)
+        return os.path.isfile(blob) and os.path.isfile(meta)
+
+    def save(self, digest: str, blob: bytes, meta: dict) -> None:
+        """Atomic write; the JSON is the completeness marker (written
+        last — :meth:`has` requires both files)."""
+        blob_path, meta_path = self._paths(digest)
+        meta = dict(meta, format_version=FORMAT_VERSION)
+        tmp = f"{blob_path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, blob_path)
+        tmp = f"{meta_path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh)
+        os.replace(tmp, meta_path)
+        self.saves += 1
+        logger.info("engine store: saved %s (%d kB)", digest,
+                    len(blob) // 1024)
+
+    def load(self, digest: str) -> "tuple[bytes, dict] | None":
+        """(blob, meta) or None — None covers absent, half-written and
+        format-drifted artifacts (all of which mean 'build cold')."""
+        blob_path, meta_path = self._paths(digest)
+        if not (os.path.isfile(blob_path) and os.path.isfile(meta_path)):
+            return None
+        try:
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+            if int(meta.get("format_version", -1)) != FORMAT_VERSION:
+                logger.warning(
+                    "engine store: %s has format %s (want %d) — "
+                    "ignoring", digest, meta.get("format_version"),
+                    FORMAT_VERSION)
+                return None
+            with open(blob_path, "rb") as fh:
+                blob = fh.read()
+        except (OSError, ValueError) as exc:
+            logger.warning("engine store: %s unreadable (%s) — ignoring",
+                           digest, exc)
+            return None
+        self.loads += 1
+        return blob, meta

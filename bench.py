@@ -87,6 +87,20 @@ Modes:
                                 # availability %, shed rate, eviction/
                                 # readmission counts, crash-restart MTTR
                                 # (docs/serving.md "Surviving failures")
+    python bench.py --chaos-mesh SEED [n]    # SHARDED-fleet
+                                # survivability: n (default 8) trackers
+                                # under a FleetSupervisor on the
+                                # 8-virtual-device mesh with a seeded
+                                # shard NaN storm, collective stall and
+                                # device loss + revival — availability
+                                # %, degraded-mode rounds, shard-loss
+                                # MTTR, and a CHILD-process checkpoint
+                                # restore against the engine store =
+                                # real cross-process restart MTTR
+                                # (docs/robustness.md "Surviving shard
+                                # loss"); degraded rounds publish
+                                # _d<k>_degraded keys, never the
+                                # full-mesh headline
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -1361,6 +1375,286 @@ def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
     return out
 
 
+def _restore_bench_specs(n_tenants: int):
+    """ONE deterministic TenantSpec construction shared by the
+    --chaos-mesh parent (checkpoint save) and the --restore-mttr child
+    (fresh-process restore): the two processes must fingerprint into
+    identical buckets or the restore drift-check rightly refuses."""
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.serving import TenantSpec
+
+    ocp = tracker_ocp()
+    return {
+        f"m{i:02d}": TenantSpec(
+            tenant_id=f"m{i:02d}", ocp=ocp,
+            theta=ocp.default_params(p=jnp.array([float(i + 1)])),
+            couplings={},
+            solver_options=SolverOptions(max_iter=30))
+        for i in range(n_tenants)
+    }
+
+
+def _restore_bench_plane(n_tenants: int, store_dir: str, cache=None):
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+    from agentlib_mpc_tpu.serving import ServingPlane
+
+    return ServingPlane(
+        FusedADMMOptions(max_iterations=5, rho=2.0),
+        slot_multiple=1, initial_capacity=n_tenants,
+        pipelined=False, donate=False, cache=cache,
+        engine_store=store_dir)
+
+
+def run_restore_mttr(ckpt_dir: str, store_dir: str,
+                     n_tenants: int = 2) -> dict:
+    """``--restore-mttr`` (worker): restore a serving-plane checkpoint
+    in THIS (fresh) process against the on-disk engine store + the
+    persistent XLA cache — the real cross-process crash-restart MTTR,
+    process death included. Run by ``--chaos-mesh`` as a child; the
+    parent embeds the JSON line."""
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+    specs = _restore_bench_specs(n_tenants)
+    t0 = time.perf_counter()
+    plane = _restore_bench_plane(n_tenants, store_dir)
+    report = plane.restore_checkpoint(ckpt_dir, specs)
+    mttr_s = time.perf_counter() - t0
+    res = {tid: r.action for tid, r in _serve_once(plane, specs).items()}
+    out = {
+        "metric": "restore_mttr_ms",
+        "value": round(1e3 * mttr_s, 2),
+        "unit": "ms",
+        "restore_total_ms": round(1e3 * report.total_s, 2),
+        "cold_builds": report.cold_builds,
+        "persistent_restores": report.persistent_restores,
+        "cache_hits": report.cache_hits,
+        "tenants": len(report.tenants),
+        "post_restore_actions": res,
+    }
+    print(json.dumps(out))
+    return out
+
+
+def _serve_once(plane, specs) -> dict:
+    for tid in specs:
+        if tid in plane.tenants:
+            plane.submit(tid)
+    results = plane.serve_round()
+    results.update(plane.flush())
+    return results
+
+
+def run_chaos_mesh(seed: int = 0, n_agents: int = 8,
+                   rounds: int = 12) -> dict:
+    """``--chaos-mesh SEED [n]``: survivability benchmark of the
+    SHARDED fused fleet (ISSUE 10 — the PR 8 chaos discipline applied
+    to the newest layer). An ``n_agents`` tracker consensus fleet runs
+    under a :class:`FleetSupervisor` on the 8-virtual-device mesh while
+    the seeded schedule injects, deterministically:
+
+    1. a **shard-local NaN storm** (one shard's theta rows poisoned for
+       a window — the fused quarantine must contain it: every other
+       shard's controls stay finite);
+    2. a **collective stall** (one round's dispatch hangs — the
+       collective watchdog condemns it; with every shard answering the
+       probe, the round retries on the SAME mesh);
+    3. a **device loss with revival** (rounds hang while the dead
+       device is meshed and it stops answering probes — the fleet
+       degrades onto the survivors, serves degraded rounds, and the
+       hysteretic re-admission reshards back after revival).
+
+    Reported: availability % (finite actuations ÷ expected, masked
+    dead-shard lanes counted unavailable), degraded-mode round count,
+    shard-loss MTTR (condemnation → first completed degraded round),
+    per-round step cost split into full-mesh and degraded keys (the
+    honesty satellite: degraded rounds publish ``_d<k>_degraded``,
+    NEVER the headline ``_d<n>`` key), and the cross-process restart
+    MTTR measured in a CHILD process restoring a plane checkpoint
+    against the engine store + persistent XLA cache (real process
+    death). Platform- and device-qualified like every mesh metric.
+    """
+    import random as _random
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel import fleet_mesh
+    from agentlib_mpc_tpu.parallel.fused_admm import (
+        AgentGroup,
+        FusedADMMOptions,
+        stack_params,
+    )
+    from agentlib_mpc_tpu.parallel.survival import FleetSupervisor
+    from agentlib_mpc_tpu.resilience.chaos import (
+        MeshChaosConfig,
+        MeshDeviceLossRule,
+        MeshNaNStormRule,
+        MeshStallRule,
+        install_mesh_chaos,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import (
+        cpu_subprocess_env,
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        # nothing to degrade on a 1-device backend (the virtual-device
+        # request is a no-op once the backend is up, and real
+        # single-chip boxes have no shards to lose) — say so loudly
+        # instead of dying in the schedule randomization
+        out = {
+            "metric": f"chaos_mesh_availability_pct_{platform}_d1",
+            "value": None, "unit": "%", "platform": platform,
+            "error": (f"chaos-mesh needs >= 2 devices, got {n_dev}; "
+                      f"run in a fresh process (the 8-virtual-device "
+                      f"request must precede backend init) or on a "
+                      f"multi-chip mesh"),
+        }
+        print(json.dumps(out))
+        return out
+    rng = _random.Random(f"bench-chaos-mesh:{seed}")
+
+    ocp = tracker_ocp()
+    group = AgentGroup(name="chaos-mesh", ocp=ocp, n_agents=n_agents,
+                       couplings={"shared_u": "u"},
+                       solver_options=SolverOptions(max_iter=30))
+    thetas = [stack_params([
+        ocp.default_params(p=jnp.array([float(i + 1)]))
+        for i in range(n_agents)])]
+    sup = FleetSupervisor(
+        [group], FusedADMMOptions(max_iterations=8, rho=2.0),
+        mesh=fleet_mesh(), watchdog_timeout_s=10.0,
+        readmit_after=1, probation_rounds=1)
+
+    storm_round = rng.randrange(1, 3)
+    stall_round = storm_round + 1
+    die_round = stall_round + rng.randrange(1, 3)
+    revive_round = die_round + rng.randrange(2, 4)
+    victim_dev = rng.randrange(1, n_dev)
+    chaos = install_mesh_chaos(sup, MeshChaosConfig(
+        nan_storm=(MeshNaNStormRule(device_index=victim_dev,
+                                    start_round=storm_round,
+                                    n_rounds=1),),
+        stall=(MeshStallRule(round=stall_round, duration_s=30.0),),
+        device_loss=(MeshDeviceLossRule(device_index=victim_dev,
+                                        die_at_round=die_round,
+                                        revive_at_round=revive_round),),
+    ), seed=seed)
+
+    expected = available = 0
+    full_times, degraded_times = [], []
+    shard_loss_mttr = None
+    was_degraded = False
+    state = sup.init_state(thetas)
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        state, trajs, _stats = sup.step(state, thetas)
+        dt = time.perf_counter() - t0
+        just_degraded = sup.degraded and not was_degraded
+        if just_degraded and shard_loss_mttr is None:
+            # condemnation -> first completed DEGRADED round (probe +
+            # rebuild + compile + round); a transient-stall retry's
+            # recovery is not a shard loss and must not claim this key
+            shard_loss_mttr = sup.last_mttr_s
+        was_degraded = sup.degraded
+        u = np.asarray(trajs[0]["u"])
+        alive = ~np.asarray(sup.dead_lanes[0])
+        expected += n_agents
+        available += int((np.isfinite(u).all(axis=tuple(
+            range(1, u.ndim))) & alive).sum())
+        # honesty satellite: a degraded-mode round must NEVER land in
+        # the full-mesh key — the two are different experiments. The
+        # round that absorbed the rebuild is the MTTR row, not a step
+        # sample.
+        if just_degraded:
+            continue
+        (degraded_times if sup.degraded else full_times).append(dt)
+    chaos.uninstall()
+
+    # cross-process restart MTTR: checkpoint a store-backed serving
+    # plane here, restore it in a CHILD process (real process death —
+    # only the on-disk engine store + persistent XLA cache survive)
+    n_tenants = 2
+    tmp = tempfile.mkdtemp(prefix="chaos-mesh-")
+    restore = None
+    try:
+        store_dir = os.path.join(tmp, "engine_store")
+        ckpt_dir = os.path.join(tmp, "plane")
+        plane = _restore_bench_plane(n_tenants, store_dir)
+        specs = _restore_bench_specs(n_tenants)
+        for tid in specs:
+            plane.join(specs[tid])
+        _serve_once(plane, specs)
+        plane.save_checkpoint(ckpt_dir)
+        try:
+            lines = _spawn(
+                ["--worker", "--restore-mttr", ckpt_dir, store_dir,
+                 str(n_tenants)],
+                cpu_subprocess_env() if platform == "cpu"
+                else dict(os.environ), WORKER_TIMEOUT_S)
+            restore = lines[-1]
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            print(f"[bench] chaos-mesh: child restore failed: {exc}",
+                  file=sys.stderr)
+            restore = {"error": str(exc)[:300]}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def q(base: str, devices: int, degraded: bool = False) -> str:
+        # the ONE qualifier rule (platform / _d<n> / _degraded), shared
+        # with the headline metric so the conventions cannot drift
+        return _qualified_metric(base, platform, devices, degraded)
+
+    stats = sup.stats()
+    out = {
+        "metric": q("chaos_mesh_availability_pct", n_dev),
+        "value": round(100.0 * available / max(expected, 1), 2),
+        "unit": "%",
+        "seed": seed,
+        "n_agents": n_agents,
+        "rounds": rounds,
+        "devices": n_dev,
+        "schedule": {"storm_round": storm_round,
+                     "stall_round": stall_round,
+                     "die_round": die_round,
+                     "revive_round": revive_round,
+                     "victim_device": victim_dev},
+        "degraded_rounds": stats["degraded_rounds"],
+        "layouts_built": stats["layouts_built"],
+        "shard_loss_mttr_ms": (None if shard_loss_mttr is None
+                               else round(1e3 * shard_loss_mttr, 2)),
+        q("chaos_mesh_step_ms", n_dev): (
+            round(1e3 * float(np.median(full_times)), 2)
+            if full_times else None),
+        q("chaos_mesh_step_ms", n_dev - 1, degraded=True): (
+            round(1e3 * float(np.median(degraded_times)), 2)
+            if degraded_times else None),
+        "restart": restore,
+        "chaos_events": {k: chaos.count(k) for k in (
+            "mesh_nan_theta", "mesh_stall", "mesh_device_hang",
+            "mesh_probe_dead")},
+        "platform": platform,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def run_profile(trace_dir: str = "bench_trace",
                 n_agents: int = N_AGENTS) -> None:
     """Capture an XLA profiler trace of the warm ``n_agents``-zone step
@@ -1908,6 +2202,10 @@ def _child_main() -> None:
             run_mesh_ab(sizes=(int(sys.argv[idx + 1]),))
         else:
             run_mesh_ab()
+    elif "--restore-mttr" in sys.argv:
+        idx = sys.argv.index("--restore-mttr")
+        n = int(sys.argv[idx + 3]) if len(sys.argv) > idx + 3 else 2
+        run_restore_mttr(sys.argv[idx + 1], sys.argv[idx + 2], n)
     elif "--evidence" in sys.argv:
         run_evidence()
     else:
@@ -2076,20 +2374,30 @@ def _measure_failsoft(mode_args: list, cpu_mode_args: "list | None" = None,
     return lines, "cpu", fell_back, attempts
 
 
-def _headline_metric(platform: str, n_devices: int = 1) -> str:
-    """Headline metric name, platform-qualified OFF the accelerator
-    (ROADMAP item 2's explicit ask): a CPU-fallback round must never
-    publish its number under the TPU trajectory metric —
+def _qualified_metric(base: str, platform: str, n_devices: int = 1,
+                      degraded: bool = False) -> str:
+    """The ONE metric-qualification rule (used by the headline and by
+    ``--chaos-mesh``): unqualified names are reserved for TPU; any
+    other platform gets a ``_<platform>`` suffix (ROADMAP item 2 —
     BENCH_r04/r05 read as a 3.6× regression when they were a platform
-    change. The unqualified name is reserved for the accelerator the
-    trajectory tracks; anything else gets a ``_<platform>`` suffix.
-    A measurement that spanned a device mesh additionally gains a
-    ``_d<n>`` qualifier (``admm256_step_ms_cpu_d8``) — mesh and
-    single-device numbers are different experiments and must never
-    conflate in the trajectory (ISSUE 9, extending the platform rule)."""
-    base = "admm256_step_ms" if platform == "tpu" \
-        else f"admm256_step_ms_{platform}"
-    return base if n_devices <= 1 else f"{base}_d{n_devices}"
+    change); a measurement that spanned a device mesh gains ``_d<n>``
+    (ISSUE 9 — mesh and single-device numbers are different
+    experiments); a round served on a DEGRADED mesh (shard loss
+    absorbed by the FleetSupervisor) gains ``_degraded`` (ISSUE 10 —
+    a 7-device fallback round must never read as the 8-device steady
+    state's regression, or its improvement)."""
+    name = base if platform == "tpu" else f"{base}_{platform}"
+    if n_devices > 1:
+        name = f"{name}_d{n_devices}"
+    return f"{name}_degraded" if degraded else name
+
+
+def _headline_metric(platform: str, n_devices: int = 1,
+                     degraded: bool = False) -> str:
+    """Headline metric name under the shared qualification rule
+    (:func:`_qualified_metric`)."""
+    return _qualified_metric("admm256_step_ms", platform, n_devices,
+                             degraded)
 
 
 def main() -> None:
@@ -2127,6 +2435,25 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_serve(seed, n)
+        return
+
+    if "--chaos-mesh" in sys.argv:
+        # mesh survivability benchmark, in-process like --chaos-serve;
+        # the 8-virtual-device mesh must be requested BEFORE backend
+        # init (no-op on real multi-chip):
+        #   python bench.py --chaos-mesh SEED [n_agents]
+        from agentlib_mpc_tpu.utils.jax_setup import (
+            request_virtual_devices,
+        )
+
+        request_virtual_devices(8)
+        idx = sys.argv.index("--chaos-mesh")
+        seed, n = 0, 8
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_chaos_mesh(seed, n)
         return
 
     if "--chaos-serve" in sys.argv:

@@ -574,3 +574,81 @@ class TestChaosServeBench:
         assert out["watchdog_stalls"] >= 1
         assert out["readmissions"] >= 1
         assert out["value"] > 50.0
+
+
+class TestCrossProcessRestore:
+    """ISSUE 10: topology-stamped checkpoints + the on-disk engine
+    store — crash recovery must survive REAL process death, and a
+    restore onto a different device topology must fail loudly with a
+    reshard recipe instead of splicing misaligned slots."""
+
+    def test_topology_drift_rejected_with_reshard_recipe(
+            self, ocp, tmp_path):
+        from agentlib_mpc_tpu.serving import plane_checkpoint_topology
+
+        plane = make_plane()
+        spec = make_spec(ocp, "topo", 1.0)
+        plane.join(spec)
+        path = str(tmp_path / "plane")
+        plane.save_checkpoint(path)
+        assert has_plane_checkpoint(path)
+        topo = plane_checkpoint_topology(path)
+        assert topo["slot_multiple"] == 1
+        assert topo["mesh_devices"] is None
+        # a plane padded for a different slot multiple must NOT splice
+        drifted = make_plane(slot_multiple=2)
+        with pytest.raises(ValueError, match="RESHARD"):
+            drifted.restore_checkpoint(path, {"topo": spec})
+        assert not drifted.tenants          # nothing was spliced
+        # the checkpoint itself is intact: a matching plane restores
+        ok = make_plane()
+        report = ok.restore_checkpoint(path, {"topo": spec})
+        assert report.tenants == ("topo",)
+
+    def test_engine_store_revival_survives_process_death(
+            self, ocp, tmp_path):
+        """The cross-process acceptance row, emulated in-process by
+        dropping the ENTIRE in-memory compile cache: the fresh plane's
+        restore revives its bucket engine from the on-disk export
+        store (certify/trace never re-run — 0 cold builds, >=1
+        persistent restore), warm starts come back bitwise, and the
+        revived engine serves. The true two-process variant is
+        ``bench.py --chaos-mesh``'s --restore-mttr child."""
+        from agentlib_mpc_tpu.serving import CompileCache, EngineStore
+
+        store = EngineStore(str(tmp_path / "store"))
+        # max_iter=37: a structure no other test builds, so THIS join
+        # is the cold build that exports into the store
+        spec = make_spec(ocp, "phoenix", 2.0, max_iter=37)
+        plane = ServingPlane(ADMM_OPTS, slot_multiple=1,
+                             initial_capacity=2, pipelined=False,
+                             donate=False, cache=CompileCache(),
+                             engine_store=store)
+        plane.join(spec)
+        assert store.saves == 1
+        for _ in range(2):
+            plane.submit("phoenix")
+            plane.serve_round()
+        path = str(tmp_path / "plane")
+        plane.save_checkpoint(path)
+        saved_states = state_arrays(plane)
+
+        fresh = ServingPlane(ADMM_OPTS, slot_multiple=1,
+                             initial_capacity=2, pipelined=False,
+                             donate=False, cache=CompileCache(),
+                             engine_store=store)
+        report = fresh.restore_checkpoint(path, {"phoenix": spec})
+        assert report.cold_builds == 0
+        assert report.persistent_restores == 1
+        assert report.cache_hits == 0
+        # warm starts bitwise through process death
+        for digest, saved in saved_states.items():
+            restored = state_arrays(fresh)[digest]
+            for a, b in zip(jax.tree.leaves(saved),
+                            jax.tree.leaves(restored)):
+                np.testing.assert_array_equal(a, b)
+        engine = next(iter(fresh._buckets.values())).engine
+        assert getattr(engine, "step_restored_from_export", False)
+        fresh.submit("phoenix")
+        res = fresh.serve_round()
+        assert res["phoenix"].action == "actuate"
