@@ -133,14 +133,38 @@ def main(argv: "list[str] | None" = None) -> int:
                   f"(budget {growth['max_growth']}x) [{status}]")
             if r["failure"]:
                 print(f"  FAILED: {r['failure']}")
-        total = summary["failures"] + growth["failures"]
+        # collectives gate: certify the mesh fleets' collective
+        # schedules and pin the fused round's ONE psum family against
+        # [jaxpr.collectives] (CI runs this under the 8-virtual-device
+        # env pin; a 1-device mesh still traces the full schedule)
+        from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+            collectives_gate_summary,
+        )
+
+        coll = collectives_gate_summary({"jaxpr": budgets})
+        for r in coll["fleets"]:
+            if "error" in r:
+                print(f"{r['name']}: collective certification ERROR "
+                      f"[FAIL]\n  {r['error']}")
+                continue
+            status = "FAIL" if r["violations"] else "ok"
+            cert = r["certificate"]
+            print(f"{r['name']}: collectives {cert['status']} "
+                  f"families={cert['families']} digest={r['digest']} "
+                  f"comm={r['collective_bytes_per_round']}B/round "
+                  f"[{status}]")
+            for v in r["violations"]:
+                print(f"  FAILED: {v}")
+        total = summary["failures"] + growth["failures"] \
+            + coll["failures"]
         if total:
             print(f"FAILED: {total} jaxpr certification "
                   f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
             return 1
         print(f"jaxpr certification OK: {len(summary['examples'])} "
               f"example OCP(s) proved, eval+jac growth within "
-              f"{growth['max_growth']}x", file=sys.stderr)
+              f"{growth['max_growth']}x, collective schedules proved "
+              f"over {coll['devices']} device(s)", file=sys.stderr)
         return 0
 
     if args.stats:

@@ -9,7 +9,7 @@ exactly one level down, in the jaxpr, where JAX's tracing design (Frostig
 et al.) gives a complete dataflow IR of the traced function: every
 primitive application, every constant, no Python control flow left.
 
-Four passes over one shared per-primitive interpreter (:mod:`.interp`):
+Five passes over one shared per-primitive interpreter (:mod:`.interp`):
 
 * :func:`certify_lq` (:mod:`.lq`) — a polynomial-degree lattice
   {const, affine, quadratic, nonpoly} propagated per element through
@@ -27,7 +27,17 @@ Four passes over one shared per-primitive interpreter (:mod:`.interp`):
   x64-flag-dependent constants. The semantic complement of the AST
   ``jit-weak-type`` pass.
 * :func:`op_cost` (:mod:`.cost`) — a per-primitive FLOP/bytes cost
-  model for ``bench.py --emit-metrics`` and PERF.md attribution tables.
+  model for ``bench.py --emit-metrics`` and PERF.md attribution tables,
+  with a comm column (``collective_bytes`` = payload × axis size ×
+  loop trips) for the mesh program's cross-device traffic.
+* :func:`certify_collectives` (:mod:`.collectives`) — a replication
+  lattice (replicated ⊑ shard-varying, seeded by ``shard_map``
+  in-specs, collectives rejoining replicated) proving every ``psum``
+  of a mesh program sits on shard-uniform control flow, and emitting
+  the ordered collective schedule whose digest the engine store, the
+  plane checkpoint and the degraded-mesh rebuild assert against. A
+  shard-varying ``while`` predicate over a collective — the silent
+  cross-host pod hang — is refuted at build time, naming the eqn.
 
 Soundness boundary: primitives the interpreter cannot see through
 (``pure_callback``, custom AD rules, foreign calls) make a *tainted*
@@ -45,6 +55,12 @@ the example-OCP menu (:mod:`.examples`) against the expectations in
 
 from __future__ import annotations
 
+from agentlib_mpc_tpu.lint.jaxpr.collectives import (  # noqa: F401
+    CollectiveCertificate,
+    CollectiveOp,
+    certify_collectives,
+    check_collective_budget,
+)
 from agentlib_mpc_tpu.lint.jaxpr.cost import (  # noqa: F401
     CostEstimate,
     compare_eval_jac_cost,

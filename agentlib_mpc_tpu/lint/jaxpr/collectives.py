@@ -1,0 +1,820 @@
+"""SPMD collective certification: prove the mesh program's schedule.
+
+On a single host, a shard-varying branch around a ``lax.psum`` is a
+wedged round the collective watchdog condemns in-process (PR 10). On a
+multi-process pod the same bug changes failure class: shards that
+disagree about whether — or how often — to enter a collective leave
+every process blocked inside a different all-reduce, and **no single
+process can observe the hang**. The only safe place to catch it is
+before dispatch, statically, in the jaxpr.
+
+This is the fourth certifier pass on the PR 5 interpreter stack: a
+**replication lattice** over the ``shard_map`` body —
+
+* ``REPLICATED`` ⊑ ``VARYING``: every value is either provably
+  identical on all shards of the mesh axis, or possibly shard-varying;
+* seeded by the ``shard_map`` in-specs (sharded inputs start
+  ``VARYING``, replicated ones ``REPLICATED``);
+* every non-collective primitive is a *pure shard-local function of its
+  inputs* (the jaxpr has no other communication channel), so one
+  generic join rule is sound for all of them: any ``VARYING`` input
+  taints the output;
+* collective outputs **rejoin**: a ``psum``/``pmean``/``all_gather``
+  result is by construction identical on every shard, so the lattice
+  steps back down — the re-replication that makes "psum then branch on
+  the residual" provable;
+* ``scan``/``while`` run their bodies to a payload fixpoint, ``cond``
+  joins branches (the shared-interpreter recursion pattern,
+  :mod:`.interp`).
+
+The walk produces a :class:`CollectiveCertificate`: the **ordered
+schedule** of collectives (primitive, axis names, payload
+shape/dtype/bytes, loop position) plus a proof that every collective
+sits on **shard-uniform control flow** — every ``while_loop`` predicate
+and ``cond`` index dominating a collective derives from ``REPLICATED``
+values. A shard-varying predicate over a collective is a *refutation*
+naming the offending equation (the PR 5 loud-refutation pattern); a
+replicated out-spec claimed over a shard-varying value (the
+``check_rep=False`` blind spot — e.g. a consensus mean whose
+``axis_name`` was dropped) refutes too. ``pure_callback`` and friends
+are never executed and degrade the verdict to an honest ``"unknown"``.
+
+Consumers (the mesh seams):
+
+* :meth:`FusedADMM._compile_step` certifies the fused round at build
+  time — a refuted schedule refuses to dispatch on a multi-process
+  mesh and warns loudly on a single host;
+* the schedule digest (mesh-size independent for the fused round: the
+  psum payloads are post-reduction shapes) joins the engine-store
+  manifest and the plane-checkpoint topology stamp, and
+  :class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor` asserts
+  degraded-mesh rebuilds issue the **identical** schedule — a rebuild
+  that would issue a different all-reduce sequence than its surviving
+  peers is exactly the pod-hang refused here;
+* ``python -m agentlib_mpc_tpu.lint --jaxpr`` pins the fused round's
+  schedule against ``[jaxpr.collectives]`` in ``lint_budgets.toml``
+  (one psum family per ADMM iteration, nothing deeper).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+
+import numpy as np
+
+from agentlib_mpc_tpu.lint.jaxpr.interp import (
+    CALLBACK_PRIMS,
+    COLLECTIVE_PRIMS,
+    collective_axes,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CollectiveCertificate",
+    "CollectiveOp",
+    "REPLICATED",
+    "VARYING",
+    "certify_collectives",
+    "check_collective_budget",
+    "collectives_gate_summary",
+]
+
+#: the two-point replication lattice
+REPLICATED = 0
+VARYING = 1
+
+#: call-like primitives whose single sub-jaxpr is inlined transparently
+_CALL_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+    "remat2": "jaxpr",
+}
+
+
+def _source_of(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<unknown>"
+
+
+def _as_jaxpr(obj):
+    """(jaxpr, consts) from a ClosedJaxpr or an open Jaxpr param."""
+    if hasattr(obj, "jaxpr"):          # ClosedJaxpr
+        return obj.jaxpr, list(obj.consts)
+    return obj, []
+
+
+def _contains_collective(obj, _seen=None) -> bool:
+    """Syntactic scan: does this (Closed)Jaxpr bind any collective or
+    callback primitive anywhere? Used to decide whether an unknown
+    primitive's sub-jaxprs can be skipped with the pure-join rule."""
+    jaxpr, _ = _as_jaxpr(obj)
+    _seen = set() if _seen is None else _seen
+    if id(jaxpr) in _seen:
+        return False
+    _seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS or name in CALLBACK_PRIMS \
+                or name == "axis_index":
+            return True
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    if _contains_collective(sub, _seen):
+                        return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One scheduled collective: what crosses the mesh, where, how often.
+
+    ``loop_path`` is the nesting position, outermost first — e.g.
+    ``("while",)`` for the fused round's per-iteration consensus psums,
+    ``("while", "while")`` for a (forbidden) collective inside the inner
+    solver loop, ``("scan[8]",)`` under a static-length scan.
+    ``multiplicity`` multiplies the static scan lengths on the path;
+    ``bounded`` is False when a ``while`` frame makes the trip count
+    data-dependent (``trips="unbounded"`` — budget it at the caller,
+    e.g. with the ADMM ``max_iterations``)."""
+
+    primitive: str
+    axes: tuple
+    shapes: tuple            # one entry per operand, shard-local
+    dtypes: tuple
+    bytes_payload: int       # sum over operands, one issue
+    loop_path: tuple
+    multiplicity: int        # product of static scan lengths on the path
+    bounded: bool            # False when a while frame is on the path
+    source: str = ""
+
+    @property
+    def family(self) -> str:
+        """The schedule-identity family key: loop depth + primitive +
+        axis names (the grouping XLA can fuse into one all-reduce
+        phase; payload shapes ride in the digest, not the family)."""
+        return f"{len(self.loop_path)}:{self.primitive}@" \
+               f"{','.join(self.axes)}"
+
+    def describe(self) -> str:
+        loop = "/".join(self.loop_path) or "top"
+        return (f"{self.primitive}@{','.join(self.axes)} "
+                f"{'x'.join(str(s) for s in self.shapes) or '()'} "
+                f"[{loop}] ({self.source})")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCertificate:
+    """Outcome of :func:`certify_collectives`.
+
+    ``status``:
+
+    * ``"proved"`` — every collective sits on shard-uniform control
+      flow and every replicated out-spec covers a provably replicated
+      value; the ``schedule`` is the program's collective schedule;
+    * ``"refuted"`` — a divergence hazard exists; ``refutations`` name
+      each offending equation (dispatching this program on a
+      multi-process mesh risks a silent cross-host hang);
+    * ``"unknown"`` — an opaque primitive (``pure_callback`` & friends,
+      never executed) blocks the proof.
+    """
+
+    status: str
+    schedule: tuple = ()            # ordered CollectiveOp entries
+    refutations: tuple = ()
+    opaque: tuple = ()
+    notes: tuple = ()
+    axis_sizes: "dict | None" = None   # axis name -> mesh size
+
+    @property
+    def proved(self) -> bool:
+        return self.status == "proved"
+
+    @property
+    def schedule_digest(self) -> "str | None":
+        """Mesh-size-independent identity of the collective schedule:
+        primitive, axes (names, not sizes), operand shapes/dtypes and
+        loop position per entry, in program order. Two engines with
+        equal digests issue the same collective sequence — the
+        degraded-rebuild / cross-process-restore compatibility check.
+        None unless proved (an unproved schedule is not an identity)."""
+        if self.status != "proved":
+            return None
+        ident = "|".join(
+            f"{op.loop_path}:{op.primitive}@{op.axes}"
+            f":{op.shapes}:{op.dtypes}:x{op.multiplicity}"
+            f":{'b' if op.bounded else 'u'}"
+            for op in self.schedule)
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def families(self) -> "dict[str, list]":
+        """Schedule grouped by :attr:`CollectiveOp.family`, order kept."""
+        out: "dict[str, list]" = {}
+        for op in self.schedule:
+            out.setdefault(op.family, []).append(op)
+        return out
+
+    def comm_bytes(self, while_trips: int = 1) -> int:
+        """Modeled bytes moved across the mesh per execution: payload ×
+        axis size × loop trips, with every unbounded ``while`` frame on
+        a path charged ``while_trips`` (pass the loop's real budget,
+        e.g. the ADMM ``max_iterations`` — the cost model's
+        ``trips="unbounded"`` contract)."""
+        sizes = self.axis_sizes or {}
+        total = 0
+        for op in self.schedule:
+            axis_factor = 1
+            for a in op.axes:
+                axis_factor *= int(sizes.get(a, 1))
+            trips = op.multiplicity
+            if not op.bounded:
+                n_while = sum(1 for f in op.loop_path if f == "while")
+                trips *= max(int(while_trips), 1) ** max(n_while, 1)
+            total += op.bytes_payload * axis_factor * trips
+        return int(total)
+
+    def describe(self) -> str:
+        if self.status == "proved":
+            fams = self.families()
+            return (f"proved: {len(self.schedule)} collective(s) in "
+                    f"{len(fams)} family(ies) "
+                    f"[{'; '.join(sorted(fams))}]")
+        if self.status == "refuted":
+            head = "; ".join(self.refutations[:2])
+            more = (f" (+{len(self.refutations) - 2} more)"
+                    if len(self.refutations) > 2 else "")
+            return f"REFUTED: {head}{more}"
+        return ("unknown: opaque primitive(s) "
+                f"{','.join(sorted(set(self.opaque)))} block the proof")
+
+    def as_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "schedule": [op.describe() for op in self.schedule],
+            "families": {k: len(v) for k, v in self.families().items()},
+            "digest": self.schedule_digest,
+            "refutations": list(self.refutations),
+            "opaque": sorted(set(self.opaque)),
+            "notes": list(self.notes),
+            "axis_sizes": dict(self.axis_sizes or {}),
+        }
+
+
+class _Frame:
+    """One enclosing control-flow construct on the walker's stack."""
+
+    __slots__ = ("kind", "varying_pred", "trips", "source")
+
+    def __init__(self, kind, varying_pred, trips, source):
+        self.kind = kind                  # "while" | "scan" | "cond"
+        self.varying_pred = varying_pred  # predicate shard-varying?
+        self.trips = trips                # static length, or None (while)
+        self.source = source
+
+
+class _Walker:
+    """Scalar replication lattice over a (Closed)Jaxpr.
+
+    One int payload per value — ``REPLICATED``/``VARYING`` — because
+    replication is a whole-value property here: the fused round's
+    predicates are scalars and its collectives reduce whole arrays.
+    (Element-level precision, the shared interpreter's strength, buys
+    nothing on this lattice and would cost the walk its speed — the
+    fused round is ~2k equations walked multiple times per fixpoint.)
+    """
+
+    def __init__(self, allowed_axes=None):
+        self.schedule: list = []
+        self.refutations: list = []
+        self.opaque: list = []
+        self.notes: list = []
+        self.axis_sizes: dict = {}
+        self.allowed_axes = (None if allowed_axes is None
+                             else tuple(allowed_axes))
+        self.frames: "list[_Frame]" = []
+        self.recording = True
+        self._inside_shard_map = False
+        #: axis names of the ENCLOSING shard_map's mesh — a collective
+        #: rejoins REPLICATED only when its named axes cover ALL of
+        #: them (a psum over a subset of a 2-D mesh's axes still
+        #: varies over the remaining axes)
+        self._mesh_axes: "tuple | None" = None
+        #: per-walk memo for the syntactic sub-jaxpr collective scan
+        #: (fixpoint passes revisit the same equations several times)
+        self._contains_memo: "dict[int, bool]" = {}
+
+    # -- helpers --------------------------------------------------------------
+
+    def _note(self, msg: str) -> None:
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def _loop_path(self) -> tuple:
+        out = []
+        for f in self.frames:
+            out.append(f.kind if f.trips is None
+                       else f"{f.kind}[{f.trips}]")
+        return tuple(out)
+
+    def _record_collective(self, eqn, in_join: int) -> int:
+        """Handle one collective eqn: uniformity check, schedule entry,
+        output payload. ``in_join`` is the join of the operand payloads
+        — the output when the collective does NOT re-replicate (a
+        collective of provably replicated operands stays replicated
+        even without rejoining)."""
+        name = eqn.primitive.name
+        axes = collective_axes(eqn)
+        src = _source_of(eqn)
+        if self.recording:
+            for f in self.frames:
+                if f.varying_pred:
+                    self.refutations.append(
+                        f"collective {name}@{','.join(axes)} at {src} is "
+                        f"dominated by a SHARD-VARYING {f.kind} "
+                        f"predicate ({f.source}): shards would disagree "
+                        f"about entering the collective — a silent "
+                        f"cross-host hang on a multi-process mesh")
+                    break
+            if self.allowed_axes is not None:
+                bad = [a for a in axes if a not in self.allowed_axes]
+                if bad:
+                    self.refutations.append(
+                        f"collective {name} at {src} communicates over "
+                        f"unexpected axis(es) {bad} (mesh axes: "
+                        f"{list(self.allowed_axes)})")
+            if axes:            # positional-axis psums are shard-local
+                shapes, dtypes, nbytes = [], [], 0
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is None or not hasattr(aval, "shape"):
+                        continue
+                    shapes.append(tuple(aval.shape))
+                    dtypes.append(str(aval.dtype))
+                    nbytes += int(np.prod(aval.shape, dtype=np.int64)
+                                  ) * aval.dtype.itemsize
+                mult = 1
+                bounded = True
+                for f in self.frames:
+                    if f.trips is None:
+                        bounded = False
+                    else:
+                        mult *= int(f.trips)
+                self.schedule.append(CollectiveOp(
+                    primitive=name, axes=axes, shapes=tuple(shapes),
+                    dtypes=tuple(dtypes), bytes_payload=nbytes,
+                    loop_path=self._loop_path(), multiplicity=mult,
+                    bounded=bounded, source=src))
+        if not COLLECTIVE_PRIMS[name][1]:
+            # non-rejoining collective (ppermute/all_to_all/…): even a
+            # replicated operand can come out shard-varying (all_to_all
+            # hands each shard a DIFFERENT slice) — stay conservative
+            return VARYING
+        if eqn.params.get("axis_index_groups") is not None:
+            # a grouped all-reduce replicates only WITHIN each group —
+            # across the mesh the result still varies by group
+            if self.recording:
+                self._note(f"{name} with axis_index_groups at {src}: "
+                           f"replicated only within each group")
+            return VARYING
+        mesh_axes = self._mesh_axes or ()
+        if mesh_axes and not set(axes) >= set(mesh_axes):
+            # a psum over a SUBSET of the mesh axes re-replicates only
+            # along those axes — the result still varies over the
+            # remaining ones, and the scalar lattice cannot represent
+            # "varies only over b", so the output keeps the operand
+            # payload (a reduction of provably replicated operands is
+            # replicated regardless of coverage; a full-coverage
+            # collective rejoins unconditionally)
+            if self.recording:
+                self._note(
+                    f"{name}@{','.join(axes)} at {src} reduces over a "
+                    f"subset of the mesh axes {list(mesh_axes)}: the "
+                    f"result may still vary over the remaining axes")
+            return max(in_join, REPLICATED)
+        return REPLICATED
+
+    # -- the walk -------------------------------------------------------------
+
+    def run(self, obj, in_payloads: "list[int]") -> "list[int]":
+        jaxpr, consts = _as_jaxpr(obj)
+        env: dict = {}
+        for var, _c in zip(jaxpr.constvars, consts):
+            env[var] = REPLICATED
+        if len(jaxpr.invars) != len(in_payloads):
+            raise ValueError(
+                f"jaxpr expects {len(jaxpr.invars)} inputs, got "
+                f"{len(in_payloads)}")
+        for var, p in zip(jaxpr.invars, in_payloads):
+            env[var] = p
+
+        def read(v) -> int:
+            if type(v).__name__ == "Literal":
+                return REPLICATED
+            return env.get(v, REPLICATED)
+
+        for eqn in jaxpr.eqns:
+            args = [read(v) for v in eqn.invars]
+            outs = self.eqn(eqn, args)
+            for var, p in zip(eqn.outvars, outs):
+                env[var] = p
+        return [read(v) for v in jaxpr.outvars]
+
+    def eqn(self, eqn, args: "list[int]") -> "list[int]":
+        name = eqn.primitive.name
+        n_out = len(eqn.outvars)
+
+        if name == "shard_map":
+            return self._shard_map(eqn, args)
+        if name in COLLECTIVE_PRIMS:
+            if not collective_axes(eqn):
+                # purely positional axes (a vmapped reduction): no
+                # cross-shard traffic — an ordinary pure reduction
+                p = max(args, default=REPLICATED)
+            else:
+                p = self._record_collective(
+                    eqn, max(args, default=REPLICATED))
+            return [p] * n_out
+        if name == "axis_index":
+            # each shard sees its own index: varying by definition, but
+            # no data crosses the mesh — not a schedule entry
+            return [VARYING] * n_out
+        if name in CALLBACK_PRIMS:
+            # never executed; the host function is outside the proof
+            if self.recording:
+                self.opaque.append(name)
+            return [VARYING] * n_out
+        if name in _CALL_PRIMS:
+            sub = eqn.params.get(_CALL_PRIMS[name])
+            sub_jaxpr, _ = _as_jaxpr(sub)
+            if sub is not None and len(sub_jaxpr.invars) == len(args):
+                return self.run(sub, args)
+            # arity mismatch (wrapper consts): conservative fallthrough
+        if name == "scan":
+            return self._scan(eqn, args)
+        if name == "while":
+            return self._while(eqn, args)
+        if name == "cond":
+            return self._cond(eqn, args)
+
+        # generic rule: every remaining primitive is a pure shard-local
+        # function of its inputs — join. Sub-jaxprs (custom_linear_solve
+        # etc.) are covered by the same argument UNLESS they hide a
+        # collective, which the syntactic scan rules out.
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if not (hasattr(sub, "eqns") or hasattr(sub, "jaxpr")):
+                    continue
+                hides = self._contains_memo.get(id(sub))
+                if hides is None:
+                    hides = _contains_collective(sub)
+                    self._contains_memo[id(sub)] = hides
+                if hides:
+                    if self.recording:
+                        self.opaque.append(name)
+                        self._note(
+                            f"opaque primitive {name} at "
+                            f"{_source_of(eqn)} carries a sub-jaxpr "
+                            f"with collectives — schedule not provable "
+                            f"through it")
+                    return [VARYING] * n_out
+        p = max(args, default=REPLICATED)
+        return [p] * n_out
+
+    # -- composite rules ------------------------------------------------------
+
+    def _shard_map(self, eqn, args: "list[int]") -> "list[int]":
+        if self._inside_shard_map:
+            # a nested shard_map invalidates the outer shard-local
+            # view: its in-spec seeding ignores the outer payloads, so
+            # walking it could launder shard-VARYING values back to
+            # REPLICATED. Honest "unknown" — the region is opaque to
+            # the lattice and is not walked (its collectives cannot be
+            # soundly scheduled either)
+            if self.recording:
+                self.opaque.append("shard_map")
+                self._note(
+                    f"nested shard_map at {_source_of(eqn)}: inner "
+                    f"region is opaque to the replication lattice — "
+                    f"schedule not provable through it")
+            return [VARYING] * len(eqn.outvars)
+        mesh = eqn.params["mesh"]
+        try:
+            self.axis_sizes.update(
+                {str(k): int(v) for k, v in dict(mesh.shape).items()})
+        except Exception:  # noqa: BLE001 — AbstractMesh variants
+            pass
+        if self.allowed_axes is None:
+            self.allowed_axes = tuple(
+                str(a) for a in getattr(mesh, "axis_names", ()))
+        in_names = eqn.params["in_names"]
+        seeds = [VARYING if names else REPLICATED for names in in_names]
+        self._inside_shard_map = True
+        self._mesh_axes = tuple(
+            str(a) for a in getattr(mesh, "axis_names", ()))
+        try:
+            outs = self.run(eqn.params["jaxpr"], seeds)
+        finally:
+            self._inside_shard_map = False
+            self._mesh_axes = None
+        out_names = eqn.params["out_names"]
+        if self.recording and not eqn.params.get("check_rep", False):
+            for i, (p, names) in enumerate(zip(outs, out_names)):
+                if not names and p == VARYING:
+                    self.refutations.append(
+                        f"shard_map output {i} has a REPLICATED "
+                        f"out-spec but its value is shard-varying "
+                        f"({_source_of(eqn)}) — with check_rep=False "
+                        f"each shard would return a DIFFERENT value as "
+                        f"'the' result (e.g. a consensus mean whose "
+                        f"axis_name was dropped)")
+        # outside the shard_map the results are global values again
+        return [REPLICATED] * len(eqn.outvars)
+
+    def _scan(self, eqn, args: "list[int]") -> "list[int]":
+        n_const = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        body = eqn.params["jaxpr"]
+        length = int(eqn.params["length"])
+        consts = args[:n_const]
+        carry = list(args[n_const:n_const + n_carry])
+        xs = args[n_const + n_carry:]
+
+        was = self.recording
+        self.recording = False
+        try:
+            # lattice height 1 per carry, but VARYING can walk a
+            # cross-iteration carry CHAIN (c[i] fed from c[i-1]) one
+            # link per pass — the product lattice needs up to
+            # len(carry)+1 passes, not a fixed small cap
+            for _ in range(len(carry) + 1):
+                outs = self.run(body, consts + carry + xs)
+                new_carry = [max(c, o) for c, o in
+                             zip(carry, outs[:n_carry])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+        finally:
+            self.recording = was
+        if self.recording:
+            self.frames.append(_Frame("scan", False, length,
+                                      _source_of(eqn)))
+            try:
+                outs = self.run(body, consts + carry + xs)
+            finally:
+                self.frames.pop()
+        return carry + list(outs[n_carry:])
+
+    def _while(self, eqn, args: "list[int]") -> "list[int]":
+        cn, bn = eqn.params["cond_nconsts"], eqn.params["body_nconsts"]
+        cond_consts = args[:cn]
+        body_consts = args[cn:cn + bn]
+        carry = list(args[cn + bn:])
+
+        was = self.recording
+        self.recording = False
+        try:
+            # see _scan: a carry chain propagates VARYING one link per
+            # pass, so the fixpoint needs up to len(carry)+1 passes
+            for _ in range(len(carry) + 1):
+                outs = self.run(eqn.params["body_jaxpr"],
+                                body_consts + carry)
+                new_carry = [max(c, o) for c, o in zip(carry, outs)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            pred = max(self.run(eqn.params["cond_jaxpr"],
+                                cond_consts + carry), default=REPLICATED)
+        finally:
+            self.recording = was
+        varying_pred = pred == VARYING
+        if self.recording:
+            frame = _Frame("while", varying_pred, None, _source_of(eqn))
+            self.frames.append(frame)
+            try:
+                # the predicate runs once per trip too — its collectives
+                # (if any) are part of the per-iteration schedule
+                self.run(eqn.params["cond_jaxpr"], cond_consts + carry)
+                self.run(eqn.params["body_jaxpr"], body_consts + carry)
+            finally:
+                self.frames.pop()
+        if varying_pred:
+            # shards exit at different trip counts: every carried value
+            # is shard-varying after the loop
+            carry = [VARYING] * len(carry)
+        return carry
+
+    def _cond(self, eqn, args: "list[int]") -> "list[int]":
+        pred, ops = args[0], args[1:]
+        branches = eqn.params["branches"]
+        varying_pred = pred == VARYING
+        if self.recording:
+            frame = _Frame("cond", varying_pred, 1, _source_of(eqn))
+            self.frames.append(frame)
+            try:
+                branch_outs = [self.run(br, list(ops)) for br in branches]
+            finally:
+                self.frames.pop()
+        else:
+            branch_outs = [self.run(br, list(ops)) for br in branches]
+        outs = [max(vals) for vals in zip(*branch_outs)] \
+            if branch_outs and branch_outs[0] else []
+        if varying_pred:
+            outs = [VARYING] * len(outs)
+        return outs
+
+
+def certify_collectives(fn_or_jaxpr, *args,
+                        allowed_axes=None) -> CollectiveCertificate:
+    """Certify the collective schedule of a traced mesh program.
+
+    ``fn_or_jaxpr``: a ``ClosedJaxpr`` (pass no ``args``) or a callable
+    traced as ``jax.make_jaxpr(fn)(*args)`` — typically the
+    jit-of-``shard_map`` step of a fused engine, traced on shape
+    templates. ``allowed_axes`` restricts the axis names collectives may
+    communicate over (defaults to the mesh axes of the first
+    ``shard_map`` encountered); a collective over any other axis
+    refutes.
+
+    Never executes user code: callbacks degrade the verdict to
+    ``"unknown"``, exactly like the LQ pass (``ops/qp.py`` routing
+    falls back to the probe there; here the caller falls back to the
+    watchdog as the only line of defense, loudly)."""
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+    walker = _Walker(allowed_axes=allowed_axes)
+    try:
+        walker.run(closed, [REPLICATED] * len(closed.jaxpr.invars))
+    except Exception as exc:  # noqa: BLE001 — certification must not
+        # kill an engine build; an uninterpretable program is "unknown"
+        return CollectiveCertificate(
+            status="unknown",
+            opaque=("interpreter-error",),
+            notes=(f"interpreter error: {exc!r}",))
+    if walker.refutations:
+        status = "refuted"
+    elif walker.opaque:
+        status = "unknown"
+    else:
+        status = "proved"
+    return CollectiveCertificate(
+        status=status,
+        schedule=tuple(walker.schedule),
+        refutations=tuple(walker.refutations),
+        opaque=tuple(walker.opaque),
+        notes=tuple(walker.notes),
+        axis_sizes=dict(walker.axis_sizes),
+    )
+
+
+def check_collective_budget(cert: CollectiveCertificate,
+                            cfg: dict) -> "list[str]":
+    """Compare a certificate against the ``[jaxpr.collectives]`` budget.
+
+    Keys (all optional):
+
+    * ``axes`` — list of axis names every collective must ride;
+    * ``max_loop_depth`` — deepest loop nesting a collective may sit at
+      (1 = the ADMM iteration ``while``; a psum inside the inner solver
+      loop would be an all-reduce per interior-point iteration);
+    * ``iteration_psums`` — exact number of ``psum`` issues inside the
+      depth-1 loop: the ONE consensus family, pinned. A regression that
+      slips a second all-reduce family in changes this count and fails
+      the lint job naming every member of the family (the injected eqn
+      among them), not a future pod run.
+
+    Returns violation strings (empty = within budget)."""
+    out = []
+    if not cert.proved:
+        out.append(f"schedule not proved: {cert.describe()}")
+        return out
+    axes = cfg.get("axes")
+    if axes is not None:
+        allowed = set(axes if isinstance(axes, (list, tuple)) else [axes])
+        for op in cert.schedule:
+            bad = [a for a in op.axes if a not in allowed]
+            if bad:
+                out.append(f"collective over unexpected axis(es) {bad}: "
+                           f"{op.describe()}")
+    max_depth = cfg.get("max_loop_depth")
+    if max_depth is not None:
+        for op in cert.schedule:
+            if len(op.loop_path) > int(max_depth):
+                out.append(
+                    f"collective at loop depth {len(op.loop_path)} "
+                    f"(budget {max_depth}) — an all-reduce inside the "
+                    f"inner loop: {op.describe()}")
+    want = cfg.get("iteration_psums")
+    if want is not None:
+        fam = [op for op in cert.schedule
+               if op.primitive == "psum" and len(op.loop_path) == 1]
+        if len(fam) != int(want):
+            members = "\n  ".join(op.describe() for op in fam)
+            out.append(
+                f"the iteration-loop psum family has {len(fam)} "
+                f"issue(s), budget pins {want} — a collective was "
+                f"added to (or dropped from) the fused round's "
+                f"per-iteration schedule. Family members:\n  {members}")
+    return out
+
+
+def collectives_gate_summary(budgets: "dict | None" = None) -> dict:
+    """The ``--jaxpr`` CLI's collectives leg: build the gate's mesh
+    fleets (the tracker consensus fleet the retrace gate uses, plus one
+    LQ menu fleet so the QP-routed solve body is covered), certify each
+    fused round, and hold the tracker schedule to
+    ``[jaxpr.collectives]``. Runs on however many devices the process
+    has (a 1-device mesh still traces the full psum schedule); CI pins
+    8 virtual devices. Also the ``collective_certificates`` section of
+    ``bench.py --emit-metrics``."""
+    import jax
+
+    from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+    cfg = (budgets if budgets is not None else load_budgets()).get(
+        "jaxpr", {}).get("collectives", {})
+    n_dev = len(jax.devices())
+    rows = []
+    failures = 0
+
+    def one_fleet(name, build_engine, pin: bool):
+        nonlocal failures
+        try:
+            engine = build_engine()
+            cert = engine.collective_certificate
+            if cert is None:
+                raise RuntimeError("engine carries no certificate")
+            violations = check_collective_budget(cert, cfg) if pin else \
+                ([] if cert.proved else [cert.describe()])
+            comm = cert.comm_bytes(
+                while_trips=engine.options.max_iterations)
+        except Exception as exc:  # noqa: BLE001 — report, don't crash CI
+            rows.append({"name": name, "error": repr(exc)})
+            failures += 1
+            return
+        if violations:
+            failures += len(violations)
+        rows.append({
+            "name": name,
+            "certificate": cert.as_dict(),
+            "digest": cert.schedule_digest,
+            "collective_bytes_per_round": comm,
+            "violations": violations,
+        })
+
+    def tracker_fleet():
+        from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel import multihost
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        ocp = tracker_ocp()
+        group = AgentGroup(
+            name="collectives-gate", ocp=ocp, n_agents=max(n_dev, 2),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30))
+        return FusedADMM([group],
+                         FusedADMMOptions(max_iterations=8, rho=2.0),
+                         mesh=multihost.fleet_mesh())
+
+    def menu_fleet():
+        from agentlib_mpc_tpu.lint.jaxpr.examples import build_example
+        from agentlib_mpc_tpu.parallel import multihost
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        ocp = build_example("LinearRCZone/colloc-d1")
+        group = AgentGroup(
+            name="menu-lq-fleet", ocp=ocp, n_agents=max(n_dev, 2),
+            couplings={"Q_shared": "Q"})
+        return FusedADMM([group],
+                         FusedADMMOptions(max_iterations=8, rho=2.0),
+                         mesh=multihost.fleet_mesh())
+
+    one_fleet("tracker-consensus-fleet", tracker_fleet, pin=True)
+    one_fleet("LinearRCZone-consensus-fleet", menu_fleet, pin=False)
+    return {"fleets": rows, "failures": failures, "devices": n_dev,
+            "budget": dict(cfg)}

@@ -13,8 +13,20 @@ ride next to the measured wall-clock phases.
 Charging rules: elementwise = output size (transcendentals weighted
 ``TRANSCENDENTAL_FLOPS``), ``dot_general`` = 2·batch·M·N·K, reductions
 = input size, data movement = 0 FLOPs but full bytes. ``while`` bodies
-are charged ``WHILE_TRIP_GUESS`` trips (the model cannot know the trip
-count; the guess is reported in the estimate so tables stay honest).
+have an *unknown* trip count — the model charges the caller-supplied
+``while_trips`` budget (e.g. the ADMM ``max_iter``) and, when none is
+given, falls back to ``WHILE_TRIP_GUESS`` with an explicit
+``trips="unbounded"`` qualifier in the notes, so an estimate dominated
+by a while loop can never silently undercount.
+
+Collectives (``psum``/``all_gather``/… — the :data:`~agentlib_mpc_tpu.
+lint.jaxpr.interp.COLLECTIVE_PRIMS` table) are charged a separate
+**comm cost**: ``collective_bytes`` = payload bytes × mesh axis size ×
+loop trips — the analytical comms column next to the FLOP column, so
+fusion-target picking (ROADMAP item 2) can weigh compute against
+cross-device traffic without running a mesh. Axis sizes come from the
+``axis_sizes`` argument (a collective over an axis the caller did not
+size is charged factor 1 and noted).
 """
 
 from __future__ import annotations
@@ -23,6 +35,11 @@ import dataclasses
 from collections import Counter
 
 import numpy as np
+
+from agentlib_mpc_tpu.lint.jaxpr.interp import (
+    COLLECTIVE_PRIMS,
+    collective_axes,
+)
 
 __all__ = ["CostEstimate", "compare_eval_jac_cost", "op_cost"]
 
@@ -50,6 +67,11 @@ class CostEstimate:
     per_primitive_flops: dict
     per_primitive_bytes: dict
     notes: tuple = ()
+    #: modeled cross-device traffic: payload bytes × axis size × trips
+    #: per collective primitive (0 for single-device programs)
+    collective_bytes: int = 0
+    per_primitive_collective_bytes: dict = dataclasses.field(
+        default_factory=dict)
 
     def top(self, k: int = 5) -> "list[tuple[str, int]]":
         return Counter(self.per_primitive_flops).most_common(k)
@@ -58,8 +80,12 @@ class CostEstimate:
         return {
             "flops": self.flops,
             "bytes": self.bytes_accessed,
+            "collective_bytes": self.collective_bytes,
             "per_primitive_flops": dict(sorted(
                 self.per_primitive_flops.items(),
+                key=lambda kv: -kv[1])),
+            "per_primitive_collective_bytes": dict(sorted(
+                self.per_primitive_collective_bytes.items(),
                 key=lambda kv: -kv[1])),
             "notes": list(self.notes),
         }
@@ -86,30 +112,89 @@ def _dot_flops(eqn) -> int:
 
 
 def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
-            mult: int = 1) -> None:
-    for eqn in closed.jaxpr.eqns:
+            mult: int = 1, comm: "Counter | None" = None,
+            axis_sizes: "dict | None" = None,
+            while_trips: "int | None" = None) -> None:
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    comm = Counter() if comm is None else comm
+    for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         sub = None
         if name == "pjit":
             sub, m = eqn.params["jaxpr"], mult
+        elif name == "shard_map":
+            # the mesh program body: collectives live here; axis sizes
+            # come from THIS eqn's own mesh unless the caller overrode
+            # — scoped to the recursion, never latched onto siblings
+            # (a second shard_map over a different mesh must not be
+            # costed with the first one's sizes)
+            sm_axes = axis_sizes
+            if sm_axes is None:
+                try:
+                    sm_axes = {
+                        str(k): int(v) for k, v in
+                        dict(eqn.params["mesh"].shape).items()}
+                except Exception:  # noqa: BLE001 — AbstractMesh variants
+                    sm_axes = None
+            _charge(eqn.params["jaxpr"], flops, bytes_, notes, mult,
+                    comm, sm_axes, while_trips)
+            continue
         elif name == "scan":
             sub, m = eqn.params["jaxpr"], mult * int(eqn.params["length"])
         elif name == "while":
-            sub, m = eqn.params["body_jaxpr"], mult * WHILE_TRIP_GUESS
-            notes.add(f"while charged {WHILE_TRIP_GUESS} trips (guess)")
+            if while_trips is not None:
+                trips = int(while_trips)
+                notes.add(f"while charged the caller's {trips}-trip "
+                          f"budget")
+            else:
+                trips = WHILE_TRIP_GUESS
+                notes.add(
+                    f'while trips="unbounded" — charged the '
+                    f'{WHILE_TRIP_GUESS}-trip guess; pass '
+                    f'while_trips=<budget> (e.g. the ADMM max_iter) '
+                    f'for a bounded estimate')
+            sub, m = eqn.params["body_jaxpr"], mult * trips
         elif name == "cond":
             for br in eqn.params["branches"]:
-                _charge(br, flops, bytes_, notes, mult)
+                _charge(br, flops, bytes_, notes, mult, comm,
+                        axis_sizes, while_trips)
             continue
         if sub is not None:
-            _charge(sub, flops, bytes_, notes, m)
+            _charge(sub, flops, bytes_, notes, m, comm, axis_sizes,
+                    while_trips)
             if name == "while":
-                _charge(eqn.params["cond_jaxpr"], flops, bytes_, notes, m)
+                _charge(eqn.params["cond_jaxpr"], flops, bytes_, notes,
+                        m, comm, axis_sizes, while_trips)
             continue
         io_bytes = mult * (sum(_nbytes(v) for v in eqn.invars
                                if hasattr(v, "aval"))
                            + sum(_nbytes(v) for v in eqn.outvars))
         bytes_[name] += io_bytes
+        if name in COLLECTIVE_PRIMS:
+            # comm cost: bytes moved x axis size x loop trips (the
+            # zero-FLOP row collectives used to hide in)
+            axes = collective_axes(eqn)
+            if not axes:
+                # purely positional axes (a vmapped shard-local
+                # reduction): no cross-device traffic — charge it as
+                # the reduction it lowers to, not as comm
+                flops[name] += mult * sum(
+                    int(np.prod(v.aval.shape, dtype=np.int64))
+                    for v in eqn.invars if hasattr(v, "aval")
+                    and hasattr(v.aval, "shape"))
+                continue
+            factor = 1
+            for a in axes:
+                size = (axis_sizes or {}).get(a)
+                if size is None:
+                    notes.add(f"collective axis {a!r} has no known "
+                              f"size — charged factor 1")
+                else:
+                    factor *= int(size)
+            payload = mult * factor * sum(
+                _nbytes(v) for v in eqn.invars if hasattr(v, "aval"))
+            comm[name] += payload
+            continue
         if name in _FREE:
             continue
         if name == "dot_general":
@@ -190,9 +275,18 @@ def compare_eval_jac_cost(nlp, theta, n_w: int, plan) -> dict:
     return out
 
 
-def op_cost(fn_or_jaxpr, *args) -> CostEstimate:
+def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
+            while_trips: "int | None" = None) -> CostEstimate:
     """Cost model of ``fn(*args)`` (or of an already-closed jaxpr when
-    called with no ``args`` and a ``ClosedJaxpr`` first argument)."""
+    called with no ``args`` and a ``ClosedJaxpr`` first argument).
+
+    ``while_trips``: trip budget for every ``while`` body (the ADMM
+    ``max_iter``, a solver budget, …). Without it the loop is
+    ``trips="unbounded"`` — the estimate charges a flat guess and says
+    so in the notes instead of silently undercounting the dominant
+    loop. ``axis_sizes`` (axis name → mesh size) scales the
+    ``collective_bytes`` comm column; programs containing a
+    ``shard_map`` default to that eqn's own mesh shape."""
     if hasattr(fn_or_jaxpr, "jaxpr") and not args:
         closed = fn_or_jaxpr
     else:
@@ -201,12 +295,16 @@ def op_cost(fn_or_jaxpr, *args) -> CostEstimate:
         closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
     flops: Counter = Counter()
     bytes_: Counter = Counter()
+    comm: Counter = Counter()
     notes: "set[str]" = set()
-    _charge(closed, flops, bytes_, notes)
+    _charge(closed, flops, bytes_, notes, comm=comm,
+            axis_sizes=axis_sizes, while_trips=while_trips)
     return CostEstimate(
         flops=int(sum(flops.values())),
         bytes_accessed=int(sum(bytes_.values())),
         per_primitive_flops=dict(flops),
         per_primitive_bytes=dict(bytes_),
         notes=tuple(sorted(notes)),
+        collective_bytes=int(sum(comm.values())),
+        per_primitive_collective_bytes=dict(comm),
     )

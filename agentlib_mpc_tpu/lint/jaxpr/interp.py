@@ -40,7 +40,8 @@ from typing import Any, Sequence
 import jax
 import numpy as np
 
-__all__ = ["AVal", "Domain", "interpret_closed", "run_nlp_function"]
+__all__ = ["AVal", "CALLBACK_PRIMS", "COLLECTIVE_PRIMS", "Domain",
+           "collective_axes", "interpret_closed", "run_nlp_function"]
 
 
 @dataclasses.dataclass
@@ -180,6 +181,41 @@ _CALLBACK_PRIMS = frozenset({
     "pure_callback", "io_callback", "debug_callback", "callback",
     "custom_call", "ffi_call",
 })
+#: public alias (the collectives pass and the cost model share it)
+CALLBACK_PRIMS = _CALLBACK_PRIMS
+
+
+#: cross-shard communication primitives: the one primitive family that
+#: moves data BETWEEN mesh shards. Everything else in a jaxpr is a pure
+#: shard-local function of its inputs, which is what makes the
+#: replication lattice of :mod:`.collectives` sound with a single
+#: generic join rule. Value per name: ``(axes_param, rejoins)`` —
+#: ``axes_param`` is the eqn-param key holding the named axes,
+#: ``rejoins`` is True when the output is provably identical on every
+#: shard of the reduced axes (an all-reduce/all-gather re-replicates;
+#: a permute/scatter stays shard-varying).
+COLLECTIVE_PRIMS: "dict[str, tuple[str, bool]]" = {
+    "psum": ("axes", True),
+    "pmax": ("axes", True),
+    "pmin": ("axes", True),
+    "all_gather": ("axis_name", True),
+    "all_to_all": ("axis_name", False),
+    "ppermute": ("axis_name", False),
+    "pshuffle": ("axis_name", False),
+    "psum_scatter": ("axis_name", False),
+    "reduce_scatter": ("axis_name", False),
+}
+
+
+def collective_axes(eqn) -> tuple:
+    """The NAMED axes a collective eqn communicates over (positional
+    integer axes — a vmapped psum over a local batch axis — are not
+    cross-shard traffic and are filtered out)."""
+    param = COLLECTIVE_PRIMS[eqn.primitive.name][0]
+    axes = eqn.params.get(param, ())
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
 
 
 def _aval_shape(var) -> tuple:

@@ -80,15 +80,25 @@ def _mini_toml(text: str) -> dict:
         key, val = line.split("=", 1)
         key = key.strip().strip('"').strip("'")
         val = val.strip()
-        if val in ("true", "false"):
-            table[key] = val == "true"
-        elif re.fullmatch(r"-?\d+", val):
-            table[key] = int(val)
-        elif re.fullmatch(r"-?\d*\.\d+(e-?\d+)?", val):
-            table[key] = float(val)
-        else:
-            table[key] = val.strip('"').strip("'")
+        table[key] = _mini_toml_value(val)
     return out
+
+
+def _mini_toml_value(val: str):
+    if val.startswith("[") and val.endswith("]"):
+        # flat arrays of scalars (e.g. the [jaxpr.collectives] axes
+        # list) — no nesting, which is all this file uses
+        inner = val[1:-1].strip()
+        if not inner:
+            return []
+        return [_mini_toml_value(p.strip()) for p in inner.split(",")]
+    if val in ("true", "false"):
+        return val == "true"
+    if re.fullmatch(r"-?\d+", val):
+        return int(val)
+    if re.fullmatch(r"-?\d*\.\d+(e-?\d+)?", val):
+        return float(val)
+    return val.strip('"').strip("'")
 
 
 def tracker_ocp():

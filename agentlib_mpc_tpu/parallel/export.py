@@ -25,7 +25,13 @@ Sharded engines export too: a ``shard_map``-over-mesh step serializes
 with its sharding annotations and must be revived in a process with the
 SAME device count (``Exported.nr_devices``); the engine store keys
 artifacts by mesh identity so a different-size mesh can never splice a
-mismatched module.
+mismatched module. The store's metadata additionally records the
+engine's certified **collective schedule digest**
+(:mod:`agentlib_mpc_tpu.lint.jaxpr.collectives`): revival constructs
+the engine with ``collective_certify="off"`` — the exported program IS
+the certified one, so restores stay trace-free — and stamps the
+recorded digest onto it, keeping the checkpoint/supervisor schedule-
+identity checks working across process boundaries.
 
 Two sharp edges this module owns so callers cannot hit them:
 

@@ -95,6 +95,17 @@ def stack_params(thetas: Sequence[OCPParams]) -> OCPParams:
 
 _donation_warning_suppressed = False
 
+#: collective certificates memoized per engine structure — a repeat
+#: build of the same fused round (supervisor layout cache misses,
+#: serving capacity growth to a seen size, tests) re-traces nothing.
+#: Values are ``(cert, ocps)``: the entry PINS the group OCP objects
+#: so the ``id(ocp)`` component of its key can never be recycled by a
+#: later, structurally different OCP allocated at the same address.
+#: Bounded (oldest-out) so long-lived serving churn cannot leak OCPs
+#: without limit — an evicted structure just pays one re-trace.
+_COLLECTIVE_CERT_MEMO: dict = {}
+_COLLECTIVE_CERT_MEMO_MAX = 32
+
 
 def _suppress_unusable_donation_warning() -> None:
     """On backends without buffer donation (CPU) jax warns once per
@@ -246,7 +257,8 @@ class FusedADMM:
                  record_locals: bool = False,
                  donate_state: bool = False,
                  mesh=None,
-                 watchdog_timeout_s: "float | None" = None):
+                 watchdog_timeout_s: "float | None" = None,
+                 collective_certify: str = "auto"):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -292,7 +304,18 @@ class FusedADMM:
         (:class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`)
         consumes. Incompatible with ``donate_state`` (a retry needs the
         input state's buffers alive). One sick or hung shard can no
-        longer wedge every agent in the fleet behind a dead ``psum``."""
+        longer wedge every agent in the fleet behind a dead ``psum``.
+        ``collective_certify``: mesh engines statically certify their
+        collective schedule at build time
+        (:mod:`agentlib_mpc_tpu.lint.jaxpr.collectives` — every
+        ``psum`` proved to sit on shard-uniform control flow, the
+        ordered schedule digested for degraded-rebuild/restore identity
+        checks). ``"auto"`` certifies and refuses a REFUTED schedule
+        only on a multi-process mesh (single-host gets a loud warning —
+        the watchdog still bounds the damage there); ``"require"``
+        refuses anything not proved; ``"off"`` skips (the engine-store
+        revival path, which trusts the exported artifact's recorded
+        digest instead of re-tracing)."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -347,6 +370,20 @@ class FusedADMM:
                 "a watchdogged round may be retried on a degraded mesh "
                 "from the SAME input state, which donation would have "
                 "consumed")
+        if collective_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"collective_certify must be 'auto', 'require' or "
+                f"'off', got {collective_certify!r}")
+        self.collective_certify = collective_certify
+        #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.
+        #: collectives.CollectiveCertificate` of the fused round (mesh
+        #: engines only; None for single-device engines and
+        #: ``collective_certify="off"``)
+        self.collective_certificate = None
+        #: mesh-size-independent digest of the proved schedule — the
+        #: identity the engine store, the plane checkpoint and the
+        #: degraded-mesh rebuild assert against
+        self.collective_schedule_digest = None
         #: True once a round blew the collective-watchdog budget — the
         #: engine's compiled step may be wedged behind a dead collective
         self.mesh_condemned = False
@@ -415,6 +452,13 @@ class FusedADMM:
             out_specs=(state_spec, per_group_sh, stats_spec),
             check_rep=False)
         self._step = jax.jit(sharded, donate_argnums=donate)
+        # static collective certification (ISSUE 11): prove every psum
+        # of the fused round sits on shard-uniform control flow BEFORE
+        # this program can ever wedge a pod behind a divergent
+        # collective, and pin the schedule identity the degraded-mesh
+        # rebuild and the cross-process restore assert against
+        if self.collective_certify != "off":
+            self._certify_collective_schedule(sharded, axis, n_dev)
         # consensus-shaped mesh-collective probe (the shared
         # multihost.collective_probe builder — compiled and warmed so
         # the per-round admm_collective_seconds timing never pays, or
@@ -430,6 +474,102 @@ class FusedADMM:
                 "fleet_mesh_devices",
                 "devices in the fused fleet's agent-sharding mesh"
                 ).set(float(n_dev))
+
+    def _collective_cert_key(self, axis: str, n_dev: int):
+        """Structural identity of the traced mesh step — what the
+        collective-certificate memo keys on. Two engines with equal
+        keys trace the identical program (same groups, options, shard
+        count), so the certificate transfers without a re-trace."""
+        opts = self.options
+        rho = opts.rho
+        rho_key = tuple(sorted(rho.items())) if isinstance(rho, dict) \
+            else float(rho)
+        groups_key = tuple(
+            (id(g.ocp), g.n_agents,
+             tuple(sorted(g.couplings.items())),
+             tuple(sorted(g.exchanges.items())),
+             g.solver_options, g.warm_solver_options, g.qp_fast_path)
+            for g in self.groups)
+        return (groups_key, opts._replace(rho=rho_key),
+                self.record_locals, axis, n_dev)
+
+    def _certify_collective_schedule(self, sharded, axis: str,
+                                     n_dev: int) -> None:
+        """Trace the sharded step on shape templates and certify its
+        collective schedule (:func:`~agentlib_mpc_tpu.lint.jaxpr.
+        collectives.certify_collectives`). Refutation policy per
+        ``collective_certify`` (constructor docstring); memoized per
+        engine structure so layout caches and repeat builds never pay
+        the trace twice."""
+        from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+            certify_collectives,
+        )
+
+        key = self._collective_cert_key(axis, n_dev)
+        hit = _COLLECTIVE_CERT_MEMO.get(key)
+        cert = hit[0] if hit is not None else None
+        if cert is None:
+            import numpy as np
+
+            def sds(leaf, n):
+                arr = jnp.asarray(leaf) if not hasattr(leaf, "dtype") \
+                    else leaf
+                return jax.ShapeDtypeStruct((n,) + tuple(np.shape(arr)),
+                                            arr.dtype)
+
+            theta_tmpls = tuple(
+                jax.tree.map(lambda leaf, n=g.n_agents: sds(leaf, n),
+                             g.ocp.default_params())
+                for g in self.groups)
+            state_tmpl = jax.eval_shape(
+                lambda ths: self.init_state(ths), theta_tmpls)
+            masks_tmpl = tuple(
+                jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
+                for g in self.groups)
+            closed = jax.make_jaxpr(sharded)(state_tmpl, theta_tmpls,
+                                             masks_tmpl)
+            cert = certify_collectives(closed, allowed_axes=(axis,))
+            while len(_COLLECTIVE_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
+                _COLLECTIVE_CERT_MEMO.pop(
+                    next(iter(_COLLECTIVE_CERT_MEMO)))
+            _COLLECTIVE_CERT_MEMO[key] = (
+                cert, tuple(g.ocp for g in self.groups))
+        self.collective_certificate = cert
+        self.collective_schedule_digest = cert.schedule_digest
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"fused round's collective schedule REFUTED — "
+                   f"dispatching it on a multi-process mesh risks a "
+                   f"silent cross-host hang no process can observe:"
+                   f"\n  {detail}")
+            if self.collective_certify == "require" or \
+                    jax.process_count() > 1:
+                raise ValueError(msg + "\n(fix the divergence, or build "
+                                 "with collective_certify='off' on a "
+                                 "single host to debug under the "
+                                 "watchdog)")
+            logger.warning(
+                "%s\n(single-host mesh: proceeding — the collective "
+                "watchdog is the only remaining line of defense)", msg)
+        elif cert.status == "unknown":
+            if self.collective_certify == "require":
+                raise ValueError(
+                    f"fused round's collective schedule is UNPROVABLE "
+                    f"({cert.describe()}) and collective_certify="
+                    f"'require' was set")
+            logger.info("collective schedule not provable (%s) — the "
+                        "watchdog remains the only divergence defense",
+                        cert.describe())
+        else:
+            logger.info("collective schedule proved: %s (digest %s)",
+                        cert.describe(), cert.schedule_digest)
+            if telemetry.enabled():
+                telemetry.gauge(
+                    "admm_collective_bytes_round",
+                    "modeled bytes crossing the mesh per fused round "
+                    "(certified schedule x axis size x ADMM iteration "
+                    "budget)").set(float(cert.comm_bytes(
+                        while_trips=self.options.max_iterations)))
 
     @staticmethod
     def _with_stage_partition(g: AgentGroup) -> AgentGroup:

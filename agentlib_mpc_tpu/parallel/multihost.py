@@ -46,7 +46,12 @@ Every process executes the same jitted step. With ``mesh=`` the step is
 an explicit ``shard_map`` over the agent axis: the per-group vmapped
 augmented solves run shard-local and the ADMM consensus/exchange means
 lower to ``lax.psum`` over the mesh axis — one all-reduce family per
-ADMM iteration. Without ``mesh=``, ``shard_args`` placement leaves the
+ADMM iteration, an invariant that is statically PROVED (not assumed) at
+engine build: on a multi-process mesh a fused round whose collective
+schedule refutes — a shard-varying exit predicate over a psum is a
+silent cross-host hang no process can observe — refuses to dispatch
+(:mod:`agentlib_mpc_tpu.lint.jaxpr.collectives`; docs/DISTRIBUTED.md
+"Certify before you pod"). Without ``mesh=``, ``shard_args`` placement leaves the
 partitioning to XLA's GSPMD propagation. Either way there is no
 coordinator process in the data plane — the ADMM "coordinator" of the
 reference's star topology becomes a mean (all-reduce) inside the

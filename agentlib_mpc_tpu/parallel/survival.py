@@ -21,7 +21,12 @@ mirroring the PR 8 serving-health ladder at DEVICE granularity:
    are asserted BITWISE against the pre-failure iterate before any
    degraded round runs. The qp routing and derivative plans recorded by
    the full-mesh engine are forced onto the rebuild
-   (:meth:`FusedADMM.routed_groups`), so a degrade never re-certifies.
+   (:meth:`FusedADMM.routed_groups`), so a degrade never re-certifies
+   LQ/stage structure — but its **collective schedule** IS re-certified
+   and asserted identical (modulo mesh size) to the full engine's
+   (:mod:`agentlib_mpc_tpu.lint.jaxpr.collectives`): a rebuild that
+   would issue a different all-reduce sequence than the surviving
+   peers is refused statically, before it can hang a pod.
 3. **Serve degraded** — the round that timed out is RETRIED from the
    pre-failure state on the degraded mesh (which is why the supervisor
    rejects donated engines); surviving agents keep actuating.
@@ -167,6 +172,32 @@ class FleetSupervisor:
             for gi, g in enumerate(groups))
         engine = FusedADMM(groups, self.options, mesh=mesh,
                            watchdog_timeout_s=self.watchdog_timeout_s)
+        if self._layouts:
+            # static schedule-identity gate (ISSUE 11): a degraded
+            # rebuild that would issue a DIFFERENT collective sequence
+            # than its surviving full-mesh peers is exactly the
+            # cross-host hang a pod cannot observe — refuse it here,
+            # before any round dispatches, not after a watchdog fires
+            ref_digest = self._ref.collective_schedule_digest
+            new_digest = engine.collective_schedule_digest
+            if ref_digest is not None and new_digest is not None \
+                    and new_digest != ref_digest:
+                raise RuntimeError(
+                    f"degraded-mesh rebuild on {len(key)} device(s) "
+                    f"certifies a DIFFERENT collective schedule than "
+                    f"the full engine (digest {new_digest} vs "
+                    f"{ref_digest}) — its all-reduce sequence would "
+                    f"diverge from the surviving peers'; refusing the "
+                    f"rebuild (full schedule: "
+                    f"{self._ref.collective_certificate.describe()}; "
+                    f"rebuilt: {engine.collective_certificate.describe()})")
+            if ref_digest is not None and new_digest is None:
+                logger.warning(
+                    "degraded-mesh rebuild carries no proved collective "
+                    "schedule (%s) — identity vs the full engine cannot "
+                    "be asserted statically",
+                    engine.collective_certificate.describe()
+                    if engine.collective_certificate else "not certified")
         layout = _Layout(device_ids=key, mesh=mesh, engine=engine,
                          pads=pads)
         self._layouts[key] = layout
@@ -607,4 +638,6 @@ class FleetSupervisor:
             "layouts_built": len(self._layouts),
             "last_mttr_s": self.last_mttr_s,
             "probation_left": self._probation_left,
+            "collective_schedule_digest":
+                self._current.engine.collective_schedule_digest,
         }

@@ -177,6 +177,11 @@ def save_plane(plane, path: str) -> str:
             "capacity": int(bucket.capacity),
             "slots": list(bucket.slots),
             "rounds_served": int(bucket.rounds_served),
+            # the collective schedule the bucket's engine certified
+            # (mesh engines only): a restore whose rebuilt engine would
+            # issue a different all-reduce sequence must be refused —
+            # on a pod that drift is a silent cross-host hang
+            "collective_digest": bucket.engine.collective_schedule_digest,
         })
         arrays.append({
             "state": bucket.state,
@@ -327,6 +332,19 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
         bucket, _hit = plane._acquire_bucket(
             key, seed_spec, n_needed=1, capacity=entry["capacity"])
         per_tenant_s[tenants[0]] = time.perf_counter() - t_seed
+        saved_sched = entry.get("collective_digest")
+        live_sched = bucket.engine.collective_schedule_digest
+        if saved_sched is not None and live_sched is not None \
+                and saved_sched != live_sched:
+            raise ValueError(
+                f"bucket {entry['digest']}: the checkpoint was saved "
+                f"under collective schedule {saved_sched}, but this "
+                f"process's engine certifies {live_sched} — the "
+                f"restored plane would issue a different all-reduce "
+                f"sequence than the one the checkpoint's peers ran "
+                f"(on a multi-process mesh that is a silent cross-"
+                f"host hang). Restore with the matching code/mesh, or "
+                f"re-join tenants fresh")
         for tid in tenants:
             t_t = time.perf_counter()
             spec = specs.get(tid)

@@ -287,7 +287,8 @@ class ServingPlane:
         engine_key = (key, capacity, self._options_key(), self.donate,
                       self._mesh_key())
 
-        def make_engine(qp_fast_path: str):
+        def make_engine(qp_fast_path: str,
+                        collective_certify: str = "auto"):
             group = AgentGroup(
                 name=f"bucket-{key.digest}",
                 ocp=spec.ocp, n_agents=capacity,
@@ -299,7 +300,8 @@ class ServingPlane:
             return FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
-                donate_state=self.donate, mesh=self.mesh)
+                donate_state=self.donate, mesh=self.mesh,
+                collective_certify=collective_certify)
 
         def warm_args(engine):
             # throwaway template inputs, mesh-placed for sharded
@@ -345,6 +347,14 @@ class ServingPlane:
                                          int(self.mesh.devices.size)),
                         "qp_fast_path": ("on" if engine.group_uses_qp[0]
                                          else "off"),
+                        # the certified collective schedule this blob's
+                        # program issues — the revival path trusts it
+                        # (no re-trace) and a restore into a process
+                        # whose fresh build would certify DIFFERENTLY
+                        # is refused (a schedule drift across processes
+                        # is the pod-hang class, ISSUE 11)
+                        "collective_digest":
+                            engine.collective_schedule_digest,
                     })
                 except Exception:  # noqa: BLE001 - store is best-effort
                     logger.warning(
@@ -363,7 +373,15 @@ class ServingPlane:
                     install_exported_step,
                 )
 
-                engine = make_engine(meta.get("qp_fast_path", "off"))
+                # certification off: revival must stay trace-free. The
+                # artifact records the schedule its program was
+                # certified with at export; the engine carries that
+                # digest so checkpoint/supervisor identity checks keep
+                # working against revived engines.
+                engine = make_engine(meta.get("qp_fast_path", "off"),
+                                     collective_certify="off")
+                engine.collective_schedule_digest = \
+                    meta.get("collective_digest")
                 install_exported_step(
                     engine, blob,
                     warm_args=warm_args(engine) if self.warm_on_build

@@ -777,6 +777,19 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["jaxpr_certificates"] = certificate_summary()
     except Exception as exc:
         payload["jaxpr_certificates"] = {"error": repr(exc)}
+    # collective-schedule certificates of the mesh fleets (ISSUE 11):
+    # the proved psum schedule, its mesh-independent digest and the
+    # modeled per-round collective_bytes (payload x axis size x ADMM
+    # iteration budget) — the comms column fusion-target picking weighs
+    # against eval_jac_cost's compute column
+    try:
+        from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+            collectives_gate_summary,
+        )
+
+        payload["collective_certificates"] = collectives_gate_summary()
+    except Exception as exc:
+        payload["collective_certificates"] = {"error": repr(exc)}
     # banded-vs-dense eval+jac cost comparison (lint/jaxpr cost model):
     # the analytical crossover evidence behind jacobian="auto", recorded
     # next to the measured phases (PERF.md round 8; the modeled dense

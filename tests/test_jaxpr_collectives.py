@@ -1,0 +1,671 @@
+"""SPMD collective certifier (ISSUE 11): the adversarial corpus.
+
+The replication-lattice pass must prove the shard-uniformity of the
+fused round's collective schedule, refute the divergence hazards a pod
+cannot observe at runtime (shard-varying while-exits and branch
+indices over a psum, dropped axis_names behind ``check_rep=False``
+out-specs, collectives over the wrong mesh axis), stay honest about
+callbacks (``unknown``, never executed), and pin the PR 9 "ONE psum
+family per ADMM iteration" invariant against ``[jaxpr.collectives]``
+— including the mutation direction: an injected second all-reduce
+family must be refuted with the offending equation named (the
+static-analysis analogue of PR 3's source-surgery test).
+
+Small shard_map programs trace in milliseconds; the two engine-backed
+classes (schedule pin, degraded-mesh identity) share module fixtures
+the way every mesh test module does — engine builds dominate the cost.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+    CollectiveCertificate,
+    certify_collectives,
+    check_collective_budget,
+)
+from agentlib_mpc_tpu.lint.jaxpr.cost import op_cost
+from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel import fleet_mesh
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+    stack_params,
+)
+from agentlib_mpc_tpu.parallel.survival import FleetSupervisor
+
+from conftest import make_tracker_model  # noqa: E402
+
+
+def _mesh(n=4, axis="a"):
+    return Mesh(np.array(jax.devices("cpu")[:n]), (axis,))
+
+
+def _certify(body, mesh, in_specs, out_specs, x, **kw):
+    sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False)
+    return certify_collectives(sm, x, **kw)
+
+
+class TestReplicationLattice:
+    """The corpus on hand-written shard_map programs."""
+
+    def test_uniform_psum_schedule_proved(self):
+        mesh = _mesh()
+
+        def body(x):
+            return lax.psum(jnp.sum(x), "a")
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 3)))
+        assert cert.proved
+        assert len(cert.schedule) == 1
+        op = cert.schedule[0]
+        assert op.primitive == "psum" and op.axes == ("a",)
+        assert op.loop_path == ()
+        assert cert.schedule_digest is not None
+        assert cert.axis_sizes == {"a": 4}
+
+    def test_divergent_while_exit_refuted_naming_eqn(self):
+        """A while_loop whose exit predicate is shard-varying,
+        dominating a psum: shards would disagree about entering the
+        collective — the silent pod hang, refuted by name."""
+        mesh = _mesh()
+
+        def body(x):
+            def cond(c):
+                v, _ = c
+                return jnp.sum(v) < 10.0        # shard-local: VARYING
+
+            def step(c):
+                v, acc = c
+                return v + 1.0, acc + lax.psum(jnp.sum(v), "a")
+
+            _, acc = lax.while_loop(cond, step, (x, 0.0))
+            return acc
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.zeros((8, 2)))
+        assert cert.status == "refuted"
+        msg = " ".join(cert.refutations)
+        assert "psum" in msg and "while" in msg.lower()
+        assert "SHARD-VARYING" in msg
+        # the offending eqn is named by source position
+        assert "test_jaxpr_collectives" in msg
+
+    def test_psum_then_branch_proved(self):
+        """The predicate is re-replicated BY the collective before the
+        loop consumes it — exactly the fused round's Boyd exit shape
+        (psum'ed residuals feed the while predicate)."""
+        mesh = _mesh()
+
+        def body(x):
+            r = lax.psum(jnp.sum(x), "a")       # rejoins REPLICATED
+
+            def cond(c):
+                v, _ = c
+                return v < 10.0                  # replicated predicate
+
+            def step(c):
+                v, s = c
+                return v + 1.0, s + lax.psum(v, "a")
+
+            out = lax.while_loop(cond, step, (r, 0.0))
+            return out[1]
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.proved, cert.refutations
+        paths = [op.loop_path for op in cert.schedule]
+        assert () in paths and ("while",) in paths
+
+    def test_varying_cond_over_collective_refuted(self):
+        mesh = _mesh()
+
+        def body(x):
+            pred = jnp.sum(x) > 0.0              # shard-varying index
+            return lax.cond(pred,
+                            lambda v: lax.psum(jnp.sum(v), "a"),
+                            lambda v: jnp.sum(v), x)
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.status == "refuted"
+        assert any("cond" in r for r in cert.refutations)
+
+    def test_missing_axis_name_refuted(self):
+        """A consensus mean whose axis_name was dropped: each shard
+        computes a LOCAL mean but the out-spec claims it replicated —
+        with check_rep=False only this pass catches it."""
+        mesh = _mesh()
+
+        def body(x):
+            return jnp.mean(x, axis=0)           # no psum: shard-local
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.status == "refuted"
+        msg = " ".join(cert.refutations)
+        assert "REPLICATED" in msg and "out-spec" in msg
+
+    def test_mismatched_axis_name_refuted(self):
+        """On a 2-axis mesh, a psum over the wrong axis is refuted
+        against the expected axis set."""
+        devs = np.array(jax.devices("cpu")[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("a", "b"))
+
+        def body(x):
+            return lax.psum(jnp.sum(x), "b")
+
+        sm = shard_map(body, mesh=mesh, in_specs=P("a", "b"),
+                       out_specs=P(), check_rep=False)
+        cert = certify_collectives(sm, jnp.ones((4, 4)),
+                                   allowed_axes=("a",))
+        assert cert.status == "refuted"
+        assert any("unexpected axis" in r and "'b'" in r
+                   for r in cert.refutations)
+
+    def test_partial_axis_psum_on_2d_mesh_does_not_rejoin(self):
+        """On a 2-axis mesh a psum over ONE axis re-replicates only
+        along that axis — the result still varies over the other, so a
+        while predicate derived from it is shard-varying (refuted).
+        The same program with the psum over BOTH axes is proved: the
+        coverage rule must not cost full-coverage precision."""
+        devs = np.array(jax.devices("cpu")[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("a", "b"))
+
+        def make(reduce_axes):
+            def body(x):
+                r = lax.psum(jnp.sum(x), reduce_axes)
+
+                def cond(c):
+                    return c[0] < 10.0
+
+                def step(c):
+                    v, s = c
+                    return v + 1.0, s + lax.psum(v, ("a", "b"))
+
+                return lax.while_loop(cond, step, (r, 0.0))[1]
+
+            return shard_map(body, mesh=mesh, in_specs=P("a", "b"),
+                             out_specs=P(), check_rep=False)
+
+        partial = certify_collectives(make("a"), jnp.zeros((4, 4)))
+        assert partial.status == "refuted"
+        assert any("SHARD-VARYING" in r for r in partial.refutations)
+        assert any("subset of the mesh axes" in n for n in partial.notes)
+
+        full = certify_collectives(make(("a", "b")), jnp.zeros((4, 4)))
+        assert full.proved
+
+    def test_nested_shard_map_opaque_unknown(self):
+        """A nested shard_map's in-spec seeding ignores the outer
+        shard-local payloads, so walking it could launder VARYING back
+        to REPLICATED — the region must be opaque: honest "unknown",
+        never a clean certificate."""
+        mesh = _mesh()
+
+        def inner(v):
+            return v * 2.0
+
+        def body(x):
+            y = shard_map(inner, mesh=mesh, in_specs=P(),
+                          out_specs=P(), check_rep=False)(jnp.sum(x))
+
+            def cond(c):
+                return c[0] < 10.0
+
+            def step(c):
+                v, s = c
+                return v + 1.0, s + lax.psum(v, "a")
+
+            return lax.while_loop(cond, step, (y, 0.0))[1]
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.zeros((8, 2)))
+        assert cert.status != "proved"
+        assert "shard_map" in cert.opaque
+        assert any("nested shard_map" in n for n in cert.notes)
+        assert cert.schedule_digest is None
+
+    def test_pure_callback_unknown_never_executed(self):
+        """Callbacks degrade the verdict to an honest unknown; the host
+        function is NEVER executed during certification."""
+        mesh = _mesh()
+        calls = []
+
+        def hostile(x):
+            calls.append(1)
+            raise AssertionError("certification executed a callback")
+
+        def body(x):
+            y = jax.pure_callback(
+                hostile, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return lax.psum(jnp.sum(y), "a")
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.status == "unknown"
+        assert "pure_callback" in cert.opaque
+        assert calls == []
+        assert cert.schedule_digest is None  # an unproved schedule has
+        # no identity to assert restores/rebuilds against
+
+    def test_scan_multiplicity_recorded(self):
+        mesh = _mesh()
+
+        def body(x):
+            def step(c, _):
+                return c + lax.psum(jnp.sum(x), "a"), None
+
+            out, _ = lax.scan(step, 0.0, None, length=5)
+            return out
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.proved
+        (op,) = cert.schedule
+        assert op.loop_path == ("scan[5]",) and op.multiplicity == 5
+        assert op.bounded
+
+    def test_varying_predicate_through_long_carry_chain_refuted(self):
+        """VARYING walks an iteration-to-iteration carry chain one
+        link per fixpoint pass — a fixed small pass cap would converge
+        early and PROVE this genuinely divergent loop (the exact
+        silent-pod-hang class), so the fixpoint must be bounded by the
+        carry count, not a constant."""
+        mesh = _mesh()
+
+        def body(x):
+            def cond(c):
+                return c[0] < 10.0           # reads the END of the chain
+
+            def step(c):
+                c0, c1, c2, c3, c4, c5, acc = c
+                # 6-link shift chain: the shard-local seed reaches the
+                # predicate's carry only on the 6th pass
+                return (c1, c2, c3, c4, c5, jnp.sum(x),
+                        acc + lax.psum(jnp.sum(x), "a"))
+
+            out = lax.while_loop(cond, step, (0.0,) * 7)
+            return out[-1]
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.zeros((8, 2)))
+        assert cert.status == "refuted"
+        assert any("SHARD-VARYING" in r for r in cert.refutations)
+
+    def test_comm_bytes_scale_with_axis_and_trips(self):
+        mesh = _mesh()
+
+        def body(x):
+            def cond(c):
+                return c[0] < 10.0
+
+            def step(c):
+                v, s = c
+                return v + 1.0, s + lax.psum(jnp.sum(x), "a")
+
+            seed = lax.psum(0.0, "a")
+            return lax.while_loop(cond, step, (seed, 0.0))[1]
+
+        cert = _certify(body, mesh, P("a"), P(), jnp.ones((8, 2)))
+        assert cert.proved
+        # the loop-invariant seed psum folds at trace time; what
+        # remains is the per-trip psum: payload x axis size x trips
+        # (x64 follows the ambient flag — read the recorded payload)
+        ops = [op for op in cert.schedule if not op.bounded]
+        assert ops, "the in-loop psum must be on the schedule"
+        per_trip = sum(op.bytes_payload for op in ops) * 4
+        fixed = cert.comm_bytes(while_trips=1) - per_trip
+        assert cert.comm_bytes(while_trips=10) == fixed + 10 * per_trip
+        assert cert.comm_bytes(while_trips=10) > \
+            cert.comm_bytes(while_trips=1)
+
+
+class TestCostModelCommRows:
+    """Satellites: collectives get a comm-cost column, while loops an
+    explicit trips qualifier."""
+
+    def test_collective_bytes_counted(self):
+        mesh = _mesh()
+
+        def body(x):
+            return lax.psum(x, "a")              # (2,) f32 payload
+
+        sm = shard_map(body, mesh=mesh, in_specs=P(None, "a"),
+                       out_specs=P(None, "a"), check_rep=False)
+        est = op_cost(sm, jnp.ones((2, 8)))
+        # bytes moved x axis size: the shard-local (2,2) f32 payload...
+        # shapes aside, the row must be non-zero and attributed to psum
+        assert est.collective_bytes > 0
+        assert "psum" in est.per_primitive_collective_bytes
+        # ... and scaled by the 4-device axis read from the mesh eqn
+        assert est.collective_bytes == \
+            est.per_primitive_collective_bytes["psum"]
+        base = op_cost(sm, jnp.ones((2, 8)),
+                       axis_sizes={"a": 1}).collective_bytes
+        assert est.collective_bytes == 4 * base
+
+    def test_positional_axis_psum_not_charged_as_comm(self):
+        """A vmapped psum over a positional batch axis is a
+        shard-local reduction — zero cross-device traffic — so it must
+        not inflate collective_bytes; it is charged as the reduction
+        it lowers to."""
+        fn = jax.vmap(lambda x: lax.psum(x, "b"), axis_name="b")
+        est = op_cost(fn, jnp.arange(8.0))
+        assert est.collective_bytes == 0
+        assert est.per_primitive_collective_bytes == {}
+        assert est.per_primitive_flops.get("psum", 0) > 0
+
+    def test_while_unbounded_qualifier_and_budget(self):
+        def fn(x):
+            def cond(c):
+                return c[0] < 10.0
+
+            def step(c):
+                return c[0] + 1.0, c[1] + jnp.sum(x)
+
+            return lax.while_loop(cond, step, (0.0, 0.0))[1]
+
+        est = op_cost(fn, jnp.ones((4,)))
+        assert any('trips="unbounded"' in n for n in est.notes)
+        budgeted = op_cost(fn, jnp.ones((4,)), while_trips=25)
+        assert any("25-trip budget" in n for n in budgeted.notes)
+        assert budgeted.flops > est.flops      # 25 > the 10-trip guess
+        assert not any('unbounded' in n for n in budgeted.notes)
+
+
+OPTS = FusedADMMOptions(max_iterations=8, rho=2.0)
+SOLVER = SolverOptions(max_iter=25)
+
+Tracker = make_tracker_model()
+
+
+def _tracker_fleet(n_agents, mesh, **engine_kw):
+    ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                     method="multiple_shooting")
+    group = AgentGroup(name="fleet", ocp=ocp, n_agents=n_agents,
+                       couplings={"shared_u": "u"},
+                       solver_options=SOLVER,
+                       # the solver-routing certification (LQ probe) is
+                       # irrelevant to the collective schedule — skip it
+                       # so these engine builds stay cheap
+                       qp_fast_path="off")
+    thetas = stack_params([
+        ocp.default_params(p=jnp.array([float(i + 1)]))
+        for i in range(n_agents)])
+    engine = FusedADMM([group], OPTS, mesh=mesh, **engine_kw)
+    return engine, thetas
+
+
+class TestFusedRoundSchedule:
+    """The engine seam: build-time certification, the budget pin, the
+    mutation direction, and degraded-mesh schedule identity."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, eight_devices):
+        mesh = fleet_mesh(devices=eight_devices)
+        engine, thetas = _tracker_fleet(8, mesh)
+        return engine, thetas
+
+    def test_mesh_engine_certifies_at_build(self, fleet):
+        engine, _thetas = fleet
+        cert = engine.collective_certificate
+        assert isinstance(cert, CollectiveCertificate)
+        assert cert.proved, cert.refutations
+        assert engine.collective_schedule_digest == cert.schedule_digest
+        fams = cert.families()
+        # PR 9's prose invariant, now a proof: ONE psum family, riding
+        # the agents axis, inside the iteration while_loop — nothing
+        # deeper (no all-reduce per interior-point iteration), nothing
+        # else
+        assert set(fams) == {"1:psum@agents"}
+        assert all(op.loop_path == ("while",) for op in cert.schedule)
+
+    def test_gate_matches_checked_in_budget(self, fleet, eight_devices):
+        """The [jaxpr.collectives] pin holds for the real engine — the
+        gate-as-test pattern (a budget drifting from the code fails
+        here, not in a postponed CI surprise)."""
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        engine, _ = fleet
+        cfg = load_budgets().get("jaxpr", {}).get("collectives", {})
+        assert cfg, "[jaxpr.collectives] missing from lint_budgets.toml"
+        violations = check_collective_budget(
+            engine.collective_certificate, cfg)
+        assert violations == []
+
+    def test_injected_second_family_refuted_by_budget(
+            self, eight_devices, monkeypatch):
+        """Mutation test (the static analogue of PR 3's source-surgery
+        test): a second all-reduce family slipped into the consensus
+        update must fail the [jaxpr.collectives] check with the
+        offending equations named by source."""
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        real = admm_ops.consensus_update
+
+        def sabotaged(locals_, state, active=None, axis_name=None):
+            new_state, res = real(locals_, state, active=active,
+                                  axis_name=axis_name)
+            # the regression: an extra all-reduce smuggled into the
+            # round (folded into the residual so it cannot be DCE'd)
+            extra = lax.psum(jnp.sum(locals_ ** 3), axis_name)
+            return new_state, res._replace(
+                primal=res.primal + 0.0 * extra)
+
+        monkeypatch.setattr(admm_ops, "consensus_update", sabotaged)
+        mesh = fleet_mesh(devices=eight_devices)
+        engine, _ = _tracker_fleet(8, mesh)
+        cert = engine.collective_certificate
+        assert cert.proved          # uniform control flow — the hazard
+        # here is the SCHEDULE drift, which the budget pin catches:
+        cfg = load_budgets().get("jaxpr", {}).get("collectives", {})
+        violations = check_collective_budget(cert, cfg)
+        assert violations, "the injected psum family went unnoticed"
+        msg = " ".join(violations)
+        assert "psum family" in msg
+        # ... naming the offending eqn: the injected psum's source is
+        # THIS file (every family member is listed, the mutation among
+        # them)
+        assert "test_jaxpr_collectives" in msg
+
+    def test_dropped_axis_name_refutes_engine_build(
+            self, eight_devices, monkeypatch, caplog):
+        """The engine-level missing-axis_name case: a consensus mean
+        computed shard-locally (axis_name dropped) flows into a
+        replicated out-spec — each shard would carry a DIFFERENT
+        'consensus'. Single-host the build warns loudly and proceeds
+        (the watchdog still bounds it); collective_certify='require'
+        refuses outright — the policy a pod launch script should set."""
+        import logging
+
+        real = admm_ops._masked_mean
+
+        def dropped(locals_, active, axis_name=None):
+            return real(locals_, active, None)   # the regression
+
+        monkeypatch.setattr(admm_ops, "_masked_mean", dropped)
+        mesh = fleet_mesh(devices=eight_devices)
+        # ONE transcription for both builds: the second hits the
+        # certificate memo (same structural key), so the require-policy
+        # check never pays a second trace
+        ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        group = AgentGroup(name="fleet", ocp=ocp, n_agents=8,
+                           couplings={"shared_u": "u"},
+                           solver_options=SOLVER, qp_fast_path="off")
+        with caplog.at_level(logging.WARNING,
+                             logger="agentlib_mpc_tpu.parallel.fused_admm"):
+            engine = FusedADMM([group], OPTS, mesh=mesh)
+        cert = engine.collective_certificate
+        assert cert.status == "refuted"
+        assert any("shard-varying" in r for r in cert.refutations)
+        assert engine.collective_schedule_digest is None
+        assert any("REFUTED" in rec.message for rec in caplog.records)
+        with pytest.raises(ValueError, match="REFUTED"):
+            FusedADMM([group], OPTS, mesh=mesh,
+                      collective_certify="require")
+
+    def test_degraded_rebuild_schedule_identity_and_drift_refusal(
+            self, eight_devices, monkeypatch):
+        """The ISSUE acceptance row, both directions on ONE supervisor
+        (engine builds dominate; a second supervisor would double the
+        cost for no coverage): (a) the FleetSupervisor's degraded
+        rebuild certifies the IDENTICAL schedule (modulo mesh size) as
+        the full engine; (b) a rebuild that WOULD issue a different
+        all-reduce sequence — consensus update sabotaged between the
+        full build and a further degrade — is refused statically,
+        before any round dispatches."""
+        ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        group = AgentGroup(name="fleet", ocp=ocp, n_agents=8,
+                           couplings={"shared_u": "u"},
+                           solver_options=SOLVER, qp_fast_path="off")
+        sup = FleetSupervisor(
+            [group], OPTS, mesh=fleet_mesh(devices=eight_devices),
+            watchdog_timeout_s=60.0)
+        full_digest = sup.engine.collective_schedule_digest
+        assert full_digest is not None
+        sup.force_degrade([eight_devices[-1].id])
+        degraded = sup.engine
+        assert degraded is not sup._layouts[sup._full_ids].engine
+        # _layout_for would have raised on a mismatch; the degraded
+        # engine re-certified and agrees modulo mesh size
+        assert degraded.collective_schedule_digest == full_digest
+        assert sup.stats()["collective_schedule_digest"] == full_digest
+
+        # (b) sabotage AFTER the engines above built: the next
+        # degraded sibling traces an extra psum — schedule drift
+        # between peers, exactly what a pod cannot survive
+        real = admm_ops.consensus_update
+
+        def drifted(locals_, state, active=None, axis_name=None):
+            new_state, res = real(locals_, state, active=active,
+                                  axis_name=axis_name)
+            extra = lax.psum(jnp.sum(locals_ ** 3), axis_name)
+            return new_state, res._replace(
+                primal=res.primal + 0.0 * extra)
+
+        monkeypatch.setattr(admm_ops, "consensus_update", drifted)
+        with pytest.raises(RuntimeError, match="DIFFERENT collective"):
+            sup.force_degrade([eight_devices[-2].id])
+
+
+class TestScheduleStamps:
+    """The digest rides the engine-store manifest and the plane
+    checkpoint, and both restore paths verify it (the ISSUE acceptance
+    row's carry/verify half). Export/revival mechanics are stubbed —
+    they have their own coverage in test_serving_survivability; what
+    is under test here is the digest plumbing."""
+
+    @pytest.fixture(scope="class")
+    def mesh_plane(self, eight_devices):
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            tracker_tenant_spec,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+
+        mesh = fleet_mesh(devices=eight_devices)
+        ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        plane = ServingPlane(admm_options=OPTS, mesh=mesh,
+                             warm_on_build=False)
+        spec = tracker_tenant_spec(ocp, "t0", 1.0)
+        plane.join(spec)
+        return plane, ocp
+
+    def test_checkpoint_carries_and_verifies_digest(
+            self, mesh_plane, tmp_path):
+        import json
+
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            tracker_tenant_spec,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+        from agentlib_mpc_tpu.serving.checkpoint import (
+            restore_plane,
+            save_plane,
+        )
+
+        plane, ocp = mesh_plane
+        bucket = next(iter(plane._buckets.values()))
+        digest = bucket.engine.collective_schedule_digest
+        assert digest is not None
+        path = str(tmp_path / "ckpt")
+        save_plane(plane, path)
+        with open(f"{path}/manifest.json") as fh:
+            manifest = json.load(fh)
+        assert manifest["buckets"][0]["collective_digest"] == digest
+
+        # clean restore: rebuilt engine certifies the same schedule
+        # (the saver's CompileCache is shared, so both restores are
+        # cache hits — the digest check, not the build, is under test)
+        spec = tracker_tenant_spec(ocp, "t0", 1.0)
+        fresh = ServingPlane(admm_options=OPTS, mesh=plane.mesh,
+                             warm_on_build=False, cache=plane.cache)
+        report = restore_plane(fresh, path, [spec])
+        assert report.tenants == ("t0",)
+
+        # drifted stamp: the restore must refuse BEFORE splicing state
+        manifest["buckets"][0]["collective_digest"] = "deadbeef0000"
+        with open(f"{path}/manifest.json", "w") as fh:
+            json.dump(manifest, fh)
+        fresh2 = ServingPlane(admm_options=OPTS, mesh=plane.mesh,
+                              warm_on_build=False, cache=plane.cache)
+        with pytest.raises(ValueError, match="collective schedule"):
+            restore_plane(fresh2, path, [spec])
+
+    def test_engine_store_meta_carries_digest_and_revival_trusts_it(
+            self, mesh_plane, tmp_path, monkeypatch):
+        import json
+
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            tracker_tenant_spec,
+        )
+        from agentlib_mpc_tpu.parallel import export as export_mod
+        from agentlib_mpc_tpu.serving import ServingPlane
+        from agentlib_mpc_tpu.serving.cache import CompileCache
+
+        plane, ocp = mesh_plane
+        digest = next(iter(
+            plane._buckets.values())).engine.collective_schedule_digest
+        # stub the expensive export/prewarm/install mechanics: the
+        # digest plumbing around them is what this test pins
+        monkeypatch.setattr(export_mod, "export_fused_step",
+                            lambda *a, **k: b"blob")
+        monkeypatch.setattr(export_mod, "prewarm_exported",
+                            lambda *a, **k: None)
+        monkeypatch.setattr(export_mod, "install_exported_step",
+                            lambda engine, blob, warm_args=None: None)
+        # ... and the pre-export warmup step (a real compile this
+        # plumbing test has no use for)
+        monkeypatch.setattr(
+            FusedADMM, "step",
+            lambda self, state, thetas, active=None: (state, (), None))
+
+        spec = tracker_tenant_spec(ocp, "t0", 1.0)
+        store_root = str(tmp_path / "estore")
+        saver = ServingPlane(admm_options=OPTS, mesh=plane.mesh,
+                             warm_on_build=False,
+                             engine_store=store_root)
+        saver.join(spec)
+        metas = [p for p in (tmp_path / "estore").iterdir()
+                 if p.suffix == ".json"]
+        assert len(metas) == 1
+        meta = json.loads(metas[0].read_text())
+        assert meta["collective_digest"] == digest
+
+        # a FRESH process (empty CompileCache) revives: certification
+        # is skipped (trace-free restore) and the engine carries the
+        # artifact's recorded digest
+        reviver = ServingPlane(admm_options=OPTS, mesh=plane.mesh,
+                               warm_on_build=False,
+                               engine_store=store_root,
+                               cache=CompileCache())
+        receipt = reviver.join(tracker_tenant_spec(ocp, "t1", 2.0))
+        assert not receipt.engine_cached
+        assert reviver.cache.persistent_restores == 1
+        engine = next(iter(reviver._buckets.values())).engine
+        assert engine.collective_certificate is None
+        assert engine.collective_schedule_digest == digest
