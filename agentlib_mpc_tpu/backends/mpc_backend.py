@@ -282,3 +282,62 @@ class JAXBackend(OptimizationBackend):
             "traj": {k: np.asarray(v) for k, v in traj.items()},
             "stats": stats_row,
         }
+
+
+# -- scenario-tree robust solve (ISSUE 12 backend seam) -----------------------
+
+_SCENARIO_ENGINES: dict = {}
+_SCENARIO_ENGINES_MAX = 8
+
+
+def scenario_engine(ocp, tree, solver_options: SolverOptions,
+                    fleet_options=None):
+    """One cached single-agent scenario engine per (OCP, tree, options)
+    structure: the backend-level entry to scenario-tree robust MPC. A
+    single agent with no consensus aliases leaves exactly the
+    non-anticipativity coupling — the robust solve proper — so a
+    backend can evaluate S disturbance branches in one fused call
+    instead of the reference's S serial solves. Engines are memoized
+    (bounded, oldest-out) because a ScenarioFleet build pays a solver
+    trace; steady-state calls reuse the compiled round."""
+    from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+    from agentlib_mpc_tpu.scenario import (
+        ScenarioFleet,
+        ScenarioFleetOptions,
+    )
+
+    fleet_options = fleet_options or ScenarioFleetOptions()
+    key = (id(ocp), tree, solver_options, fleet_options)
+    hit = _SCENARIO_ENGINES.get(key)
+    if hit is not None:
+        return hit[0]
+    group = AgentGroup(name="scenario-backend", ocp=ocp, n_agents=1,
+                       solver_options=solver_options)
+    fleet = ScenarioFleet(group, tree, fleet_options)
+    while len(_SCENARIO_ENGINES) >= _SCENARIO_ENGINES_MAX:
+        _SCENARIO_ENGINES.pop(next(iter(_SCENARIO_ENGINES)))
+    # pin the ocp so a recycled id() can never alias a different
+    # structure (the FusedADMM certificate-memo pattern)
+    _SCENARIO_ENGINES[key] = (fleet, ocp)
+    return fleet
+
+
+def robust_scenario_controls(ocp, theta, tree,
+                             solver_options: SolverOptions = SolverOptions(),
+                             fleet_options=None, state=None):
+    """Solve one agent's scenario tree and return the robust controls:
+    ``(u0 (n_u,), state, stats)`` where ``u0`` is the
+    non-anticipativity projection's first-interval group mean —
+    identical across every branch by construction, the scenario-tree
+    analogue of the nominal backend's ``u[0]``. ``theta`` is a
+    scenario-stacked (S, ...) OCPParams batch
+    (:func:`agentlib_mpc_tpu.scenario.generate.ensemble_thetas` builds
+    it from a nominal theta + seed); pass the returned ``state`` back
+    in for warm-started re-solves."""
+    fleet = scenario_engine(ocp, tree, solver_options, fleet_options)
+    theta_batch = jax.tree.map(lambda leaf: leaf[None], theta)
+    if state is None:
+        state = fleet.init_state(theta_batch)
+    state, _trajs, stats = fleet.step(state, theta_batch)
+    u0 = np.asarray(fleet.actuated_u0(state))[0, 0]
+    return u0, fleet.shift_state(state), stats

@@ -21,6 +21,8 @@ Modes:
   fused fleet AND a join→serve→leave churn on a mesh-backed serving
   plane, on an 8-virtual-device CPU mesh (imports jax; must run in a
   fresh process so the device count can be requested).
+* ``--scenario-budget`` — run the scenario-fleet gate (``[scenario]``):
+  zero warm retraces of the 2-D (agents × scenarios) robust round
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
   structure proof, dtype propagation, cost model) over the example-OCP
   menu against the ``[jaxpr.expect]`` expectations in
@@ -57,6 +59,10 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="run the sharded-step gate: zero warm "
                              "retraces of the shard_map fused fleet and "
                              "the mesh serving churn (8 virtual devices)")
+    parser.add_argument("--scenario-budget", action="store_true",
+                        help="run the scenario-fleet gate: zero warm "
+                             "retraces of the 2-D (agents x scenarios) "
+                             "fused robust round (8 virtual devices)")
     parser.add_argument("--jaxpr", action="store_true",
                         help="run the semantic jaxpr certification "
                              "passes over the example-OCP menu")
@@ -100,6 +106,14 @@ def main(argv: "list[str] | None" = None) -> int:
         budgets = retrace_budget.load_budgets(args.budgets) \
             if args.budgets else None
         report = retrace_budget.run_mesh_gate(budgets)
+        return 1 if report["violations"] or report["failures"] else 0
+
+    if args.scenario_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_scenario_gate(budgets)
         return 1 if report["violations"] or report["failures"] else 0
 
     if args.jaxpr:
@@ -146,6 +160,9 @@ def main(argv: "list[str] | None" = None) -> int:
             if "error" in r:
                 print(f"{r['name']}: collective certification ERROR "
                       f"[FAIL]\n  {r['error']}")
+                continue
+            if "skipped" in r:
+                print(f"{r['name']}: SKIPPED — {r['skipped']}")
                 continue
             status = "FAIL" if r["violations"] else "ok"
             cert = r["certificate"]

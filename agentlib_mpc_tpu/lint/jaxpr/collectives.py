@@ -11,18 +11,26 @@ before dispatch, statically, in the jaxpr.
 This is the fourth certifier pass on the PR 5 interpreter stack: a
 **replication lattice** over the ``shard_map`` body —
 
-* ``REPLICATED`` ⊑ ``VARYING``: every value is either provably
-  identical on all shards of the mesh axis, or possibly shard-varying;
-* seeded by the ``shard_map`` in-specs (sharded inputs start
-  ``VARYING``, replicated ones ``REPLICATED``);
+* every value's payload is the SET of mesh axes it may vary over:
+  ``REPLICATED`` (the empty set) means provably identical on every
+  shard of the mesh; a non-empty set names the axes along which shards
+  may disagree (``VARYING`` is the conservative top). Per-axis
+  precision is what makes a TWO-axis mesh provable: a ``psum`` over
+  ``"agents"`` re-replicates along agents while the value still varies
+  over ``"scenarios"``, and the follow-up ``psum`` over
+  ``"scenarios"`` closes the set — the scenario fleet's nested
+  residual reduction (ISSUE 12) proves instead of refuting;
+* seeded by the ``shard_map`` in-specs (an input starts varying over
+  exactly the axes its spec shards it over — sharded over a subset of
+  a 2-D mesh means replicated along the rest);
 * every non-collective primitive is a *pure shard-local function of its
   inputs* (the jaxpr has no other communication channel), so one
   generic join rule is sound for all of them: any ``VARYING`` input
   taints the output;
 * collective outputs **rejoin**: a ``psum``/``pmean``/``all_gather``
-  result is by construction identical on every shard, so the lattice
-  steps back down — the re-replication that makes "psum then branch on
-  the residual" provable;
+  result is by construction identical on every shard of the reduced
+  axes, so those axes leave the varying set — the re-replication that
+  makes "psum then branch on the residual" provable;
 * ``scan``/``while`` run their bodies to a payload fixpoint, ``cond``
   joins branches (the shared-interpreter recursion pattern,
   :mod:`.interp`).
@@ -82,9 +90,21 @@ __all__ = [
     "collectives_gate_summary",
 ]
 
-#: the two-point replication lattice
-REPLICATED = 0
-VARYING = 1
+#: the replication lattice: a payload is the frozenset of mesh axis
+#: names a value may VARY over. ``REPLICATED`` (empty) = provably
+#: identical on every shard; ``VARYING`` is the conservative top — the
+#: ``"*"`` sentinel ("varies over axes the walker cannot name") that
+#: only a full-mesh-coverage collective can clear. Joins are unions;
+#: ordering is set inclusion.
+REPLICATED = frozenset()
+VARYING = frozenset({"*"})
+
+
+def _join(args) -> frozenset:
+    out = REPLICATED
+    for a in args:
+        out = out | a
+    return out
 
 #: call-like primitives whose single sub-jaxpr is inlined transparently
 _CALL_PRIMS = {
@@ -286,14 +306,18 @@ class _Frame:
 
 
 class _Walker:
-    """Scalar replication lattice over a (Closed)Jaxpr.
+    """Per-axis replication lattice over a (Closed)Jaxpr.
 
-    One int payload per value — ``REPLICATED``/``VARYING`` — because
-    replication is a whole-value property here: the fused round's
-    predicates are scalars and its collectives reduce whole arrays.
-    (Element-level precision, the shared interpreter's strength, buys
-    nothing on this lattice and would cost the walk its speed — the
-    fused round is ~2k equations walked multiple times per fixpoint.)
+    One frozenset payload per value — the mesh axes it may vary over —
+    because replication is a whole-value property here: the fused
+    round's predicates are scalars and its collectives reduce whole
+    arrays. (Element-level precision, the shared interpreter's
+    strength, buys nothing on this lattice and would cost the walk its
+    speed — the fused round is ~2k equations walked multiple times per
+    fixpoint.) Axis granularity, by contrast, is load-bearing: the 2-D
+    (agents × scenarios) fused round closes its residuals with one
+    psum per axis, and only a lattice that can say "still varies over
+    scenarios" can follow the first psum without giving up.
     """
 
     def __init__(self, allowed_axes=None):
@@ -329,7 +353,15 @@ class _Walker:
                        else f"{f.kind}[{f.trips}]")
         return tuple(out)
 
-    def _record_collective(self, eqn, in_join: int) -> int:
+    def _varying_all(self) -> frozenset:
+        """The local top: varies over every axis of the enclosing mesh
+        (plus the ``"*"`` sentinel outside any shard_map, where the
+        axes are unknowable)."""
+        if self._mesh_axes:
+            return frozenset(self._mesh_axes)
+        return VARYING
+
+    def _record_collective(self, eqn, in_join: frozenset) -> frozenset:
         """Handle one collective eqn: uniformity check, schedule entry,
         output payload. ``in_join`` is the join of the operand payloads
         — the output when the collective does NOT re-replicate (a
@@ -381,34 +413,35 @@ class _Walker:
             # non-rejoining collective (ppermute/all_to_all/…): even a
             # replicated operand can come out shard-varying (all_to_all
             # hands each shard a DIFFERENT slice) — stay conservative
-            return VARYING
+            return self._varying_all()
         if eqn.params.get("axis_index_groups") is not None:
             # a grouped all-reduce replicates only WITHIN each group —
-            # across the mesh the result still varies by group
+            # across the reduced axes the result still varies by group
             if self.recording:
                 self._note(f"{name} with axis_index_groups at {src}: "
                            f"replicated only within each group")
-            return VARYING
+            return in_join | frozenset(axes) if in_join else REPLICATED
         mesh_axes = self._mesh_axes or ()
-        if mesh_axes and not set(axes) >= set(mesh_axes):
-            # a psum over a SUBSET of the mesh axes re-replicates only
-            # along those axes — the result still varies over the
-            # remaining ones, and the scalar lattice cannot represent
-            # "varies only over b", so the output keeps the operand
-            # payload (a reduction of provably replicated operands is
-            # replicated regardless of coverage; a full-coverage
-            # collective rejoins unconditionally)
-            if self.recording:
-                self._note(
-                    f"{name}@{','.join(axes)} at {src} reduces over a "
-                    f"subset of the mesh axes {list(mesh_axes)}: the "
-                    f"result may still vary over the remaining axes")
-            return max(in_join, REPLICATED)
-        return REPLICATED
+        if mesh_axes and set(axes) >= set(mesh_axes):
+            # full mesh coverage re-replicates unconditionally — even a
+            # payload carrying the "*" sentinel is summed across every
+            # shard there is
+            return REPLICATED
+        out = in_join - frozenset(axes)
+        if out and self.recording:
+            # re-replicated along the reduced axes only; the per-axis
+            # lattice carries the remainder exactly (the 2-D fused
+            # round's first residual psum lands here, and the second —
+            # over the remaining axis — closes the set)
+            self._note(
+                f"{name}@{','.join(axes)} at {src} reduces over a "
+                f"subset of the mesh axes {list(mesh_axes)}: the "
+                f"result still varies over {sorted(out)}")
+        return out
 
     # -- the walk -------------------------------------------------------------
 
-    def run(self, obj, in_payloads: "list[int]") -> "list[int]":
+    def run(self, obj, in_payloads: "list[frozenset]") -> "list[frozenset]":
         jaxpr, consts = _as_jaxpr(obj)
         env: dict = {}
         for var, _c in zip(jaxpr.constvars, consts):
@@ -420,7 +453,7 @@ class _Walker:
         for var, p in zip(jaxpr.invars, in_payloads):
             env[var] = p
 
-        def read(v) -> int:
+        def read(v) -> frozenset:
             if type(v).__name__ == "Literal":
                 return REPLICATED
             return env.get(v, REPLICATED)
@@ -432,7 +465,7 @@ class _Walker:
                 env[var] = p
         return [read(v) for v in jaxpr.outvars]
 
-    def eqn(self, eqn, args: "list[int]") -> "list[int]":
+    def eqn(self, eqn, args: "list[frozenset]") -> "list[frozenset]":
         name = eqn.primitive.name
         n_out = len(eqn.outvars)
 
@@ -442,20 +475,24 @@ class _Walker:
             if not collective_axes(eqn):
                 # purely positional axes (a vmapped reduction): no
                 # cross-shard traffic — an ordinary pure reduction
-                p = max(args, default=REPLICATED)
+                p = _join(args)
             else:
-                p = self._record_collective(
-                    eqn, max(args, default=REPLICATED))
+                p = self._record_collective(eqn, _join(args))
             return [p] * n_out
         if name == "axis_index":
-            # each shard sees its own index: varying by definition, but
-            # no data crosses the mesh — not a schedule entry
-            return [VARYING] * n_out
+            # each shard sees its own index along the named axis:
+            # varying there by definition, but no data crosses the
+            # mesh — not a schedule entry
+            ax = eqn.params.get("axis_name", ())
+            if not isinstance(ax, (tuple, list)):
+                ax = (ax,)
+            named = frozenset(a for a in ax if isinstance(a, str))
+            return [named or self._varying_all()] * n_out
         if name in CALLBACK_PRIMS:
             # never executed; the host function is outside the proof
             if self.recording:
                 self.opaque.append(name)
-            return [VARYING] * n_out
+            return [self._varying_all()] * n_out
         if name in _CALL_PRIMS:
             sub = eqn.params.get(_CALL_PRIMS[name])
             sub_jaxpr, _ = _as_jaxpr(sub)
@@ -489,13 +526,13 @@ class _Walker:
                             f"{_source_of(eqn)} carries a sub-jaxpr "
                             f"with collectives — schedule not provable "
                             f"through it")
-                    return [VARYING] * n_out
-        p = max(args, default=REPLICATED)
+                    return [self._varying_all()] * n_out
+        p = _join(args)
         return [p] * n_out
 
     # -- composite rules ------------------------------------------------------
 
-    def _shard_map(self, eqn, args: "list[int]") -> "list[int]":
+    def _shard_map(self, eqn, args: "list[frozenset]") -> "list[frozenset]":
         if self._inside_shard_map:
             # a nested shard_map invalidates the outer shard-local
             # view: its in-spec seeding ignores the outer payloads, so
@@ -509,7 +546,7 @@ class _Walker:
                     f"nested shard_map at {_source_of(eqn)}: inner "
                     f"region is opaque to the replication lattice — "
                     f"schedule not provable through it")
-            return [VARYING] * len(eqn.outvars)
+            return [self._varying_all()] * len(eqn.outvars)
         mesh = eqn.params["mesh"]
         try:
             self.axis_sizes.update(
@@ -520,7 +557,22 @@ class _Walker:
             self.allowed_axes = tuple(
                 str(a) for a in getattr(mesh, "axis_names", ()))
         in_names = eqn.params["in_names"]
-        seeds = [VARYING if names else REPLICATED for names in in_names]
+
+        def spec_axes(names) -> frozenset:
+            # an in-spec shards its input over exactly the axes it
+            # names; along every other mesh axis the input is
+            # replicated — the per-axis seeding a 2-D mesh needs
+            out: set = set()
+            vals = names.values() if hasattr(names, "values") else names
+            for v in vals:
+                if isinstance(v, (tuple, list)):
+                    out.update(str(a) for a in v)
+                else:
+                    out.add(str(v))
+            return frozenset(out)
+
+        seeds = [spec_axes(names) if names else REPLICATED
+                 for names in in_names]
         self._inside_shard_map = True
         self._mesh_axes = tuple(
             str(a) for a in getattr(mesh, "axis_names", ()))
@@ -532,18 +584,25 @@ class _Walker:
         out_names = eqn.params["out_names"]
         if self.recording and not eqn.params.get("check_rep", False):
             for i, (p, names) in enumerate(zip(outs, out_names)):
-                if not names and p == VARYING:
+                if not names and p:
                     self.refutations.append(
                         f"shard_map output {i} has a REPLICATED "
                         f"out-spec but its value is shard-varying "
-                        f"({_source_of(eqn)}) — with check_rep=False "
-                        f"each shard would return a DIFFERENT value as "
-                        f"'the' result (e.g. a consensus mean whose "
-                        f"axis_name was dropped)")
+                        f"over {sorted(p)} ({_source_of(eqn)}) — with "
+                        f"check_rep=False each shard would return a "
+                        f"DIFFERENT value as 'the' result (e.g. a "
+                        f"consensus mean whose axis_name was dropped)")
         # outside the shard_map the results are global values again
         return [REPLICATED] * len(eqn.outvars)
 
-    def _scan(self, eqn, args: "list[int]") -> "list[int]":
+    def _fixpoint_passes(self, n_carry: int) -> int:
+        """Upper bound on fixpoint passes: every non-final pass grows at
+        least one carry's varying set by one axis, and each carry can
+        grow at most (mesh axes + the "*" sentinel) times."""
+        height = len(self._mesh_axes or ()) + 2
+        return n_carry * height + 1
+
+    def _scan(self, eqn, args: "list[frozenset]") -> "list[frozenset]":
         n_const = eqn.params["num_consts"]
         n_carry = eqn.params["num_carry"]
         body = eqn.params["jaxpr"]
@@ -555,13 +614,12 @@ class _Walker:
         was = self.recording
         self.recording = False
         try:
-            # lattice height 1 per carry, but VARYING can walk a
-            # cross-iteration carry CHAIN (c[i] fed from c[i-1]) one
-            # link per pass — the product lattice needs up to
-            # len(carry)+1 passes, not a fixed small cap
-            for _ in range(len(carry) + 1):
+            # a varying axis can walk a cross-iteration carry CHAIN
+            # (c[i] fed from c[i-1]) one link per pass — bound the
+            # product-lattice fixpoint by carries x lattice height
+            for _ in range(self._fixpoint_passes(len(carry))):
                 outs = self.run(body, consts + carry + xs)
-                new_carry = [max(c, o) for c, o in
+                new_carry = [c | o for c, o in
                              zip(carry, outs[:n_carry])]
                 if new_carry == carry:
                     break
@@ -577,7 +635,7 @@ class _Walker:
                 self.frames.pop()
         return carry + list(outs[n_carry:])
 
-    def _while(self, eqn, args: "list[int]") -> "list[int]":
+    def _while(self, eqn, args: "list[frozenset]") -> "list[frozenset]":
         cn, bn = eqn.params["cond_nconsts"], eqn.params["body_nconsts"]
         cond_consts = args[:cn]
         body_consts = args[cn:cn + bn]
@@ -586,20 +644,20 @@ class _Walker:
         was = self.recording
         self.recording = False
         try:
-            # see _scan: a carry chain propagates VARYING one link per
-            # pass, so the fixpoint needs up to len(carry)+1 passes
-            for _ in range(len(carry) + 1):
+            # see _scan: a carry chain propagates a varying axis one
+            # link per pass — same product-lattice pass bound
+            for _ in range(self._fixpoint_passes(len(carry))):
                 outs = self.run(eqn.params["body_jaxpr"],
                                 body_consts + carry)
-                new_carry = [max(c, o) for c, o in zip(carry, outs)]
+                new_carry = [c | o for c, o in zip(carry, outs)]
                 if new_carry == carry:
                     break
                 carry = new_carry
-            pred = max(self.run(eqn.params["cond_jaxpr"],
-                                cond_consts + carry), default=REPLICATED)
+            pred = _join(self.run(eqn.params["cond_jaxpr"],
+                                  cond_consts + carry))
         finally:
             self.recording = was
-        varying_pred = pred == VARYING
+        varying_pred = bool(pred)
         if self.recording:
             frame = _Frame("while", varying_pred, None, _source_of(eqn))
             self.frames.append(frame)
@@ -611,15 +669,16 @@ class _Walker:
             finally:
                 self.frames.pop()
         if varying_pred:
-            # shards exit at different trip counts: every carried value
-            # is shard-varying after the loop
-            carry = [VARYING] * len(carry)
+            # shards along the predicate's varying axes exit at
+            # different trip counts: every carried value picks those
+            # axes up after the loop
+            carry = [c | pred for c in carry]
         return carry
 
-    def _cond(self, eqn, args: "list[int]") -> "list[int]":
+    def _cond(self, eqn, args: "list[frozenset]") -> "list[frozenset]":
         pred, ops = args[0], args[1:]
         branches = eqn.params["branches"]
-        varying_pred = pred == VARYING
+        varying_pred = bool(pred)
         if self.recording:
             frame = _Frame("cond", varying_pred, 1, _source_of(eqn))
             self.frames.append(frame)
@@ -629,10 +688,10 @@ class _Walker:
                 self.frames.pop()
         else:
             branch_outs = [self.run(br, list(ops)) for br in branches]
-        outs = [max(vals) for vals in zip(*branch_outs)] \
+        outs = [_join(vals) for vals in zip(*branch_outs)] \
             if branch_outs and branch_outs[0] else []
         if varying_pred:
-            outs = [VARYING] * len(outs)
+            outs = [o | pred for o in outs]
         return outs
 
 
@@ -698,6 +757,12 @@ def check_collective_budget(cert: CollectiveCertificate,
       slips a second all-reduce family in changes this count and fails
       the lint job naming every member of the family (the injected eqn
       among them), not a future pod run.
+    * ``iteration_psum_families`` — per-axes pins for multi-family
+      rounds (the 2-D scenario fleet): a dict mapping an axes key
+      (axis names joined by ``","``) to that family's exact depth-1
+      psum issue count. Every depth-1 psum family must be named —
+      an UNBUDGETED family (an injected third axes combination) is a
+      violation naming its members, exactly like a count drift.
 
     Returns violation strings (empty = within budget)."""
     out = []
@@ -731,6 +796,27 @@ def check_collective_budget(cert: CollectiveCertificate,
                 f"issue(s), budget pins {want} — a collective was "
                 f"added to (or dropped from) the fused round's "
                 f"per-iteration schedule. Family members:\n  {members}")
+    fams_cfg = cfg.get("iteration_psum_families")
+    if fams_cfg is not None:
+        by_axes: "dict[str, list]" = {}
+        for op in cert.schedule:
+            if op.primitive == "psum" and len(op.loop_path) == 1:
+                by_axes.setdefault(",".join(op.axes), []).append(op)
+        for axes_key, want_n in sorted(dict(fams_cfg).items()):
+            have = by_axes.pop(axes_key, [])
+            if len(have) != int(want_n):
+                members = "\n  ".join(op.describe() for op in have)
+                out.append(
+                    f"the iteration-loop psum family over axes "
+                    f"[{axes_key}] has {len(have)} issue(s), budget "
+                    f"pins {want_n}. Family members:\n  {members}")
+        for axes_key, ops in sorted(by_axes.items()):
+            members = "\n  ".join(op.describe() for op in ops)
+            out.append(
+                f"UNBUDGETED iteration-loop psum family over axes "
+                f"[{axes_key}] ({len(ops)} issue(s)) — a collective "
+                f"family was injected into the fused round's "
+                f"per-iteration schedule. Family members:\n  {members}")
     return out
 
 
@@ -753,15 +839,16 @@ def collectives_gate_summary(budgets: "dict | None" = None) -> dict:
     rows = []
     failures = 0
 
-    def one_fleet(name, build_engine, pin: bool):
+    def one_fleet(name, build_engine, pin: bool, budget_cfg=None):
         nonlocal failures
         try:
             engine = build_engine()
             cert = engine.collective_certificate
             if cert is None:
                 raise RuntimeError("engine carries no certificate")
-            violations = check_collective_budget(cert, cfg) if pin else \
-                ([] if cert.proved else [cert.describe()])
+            pin_cfg = cfg if budget_cfg is None else budget_cfg
+            violations = check_collective_budget(cert, pin_cfg) if pin \
+                else ([] if cert.proved else [cert.describe()])
             comm = cert.comm_bytes(
                 while_trips=engine.options.max_iterations)
         except Exception as exc:  # noqa: BLE001 — report, don't crash CI
@@ -814,7 +901,45 @@ def collectives_gate_summary(budgets: "dict | None" = None) -> dict:
                          FusedADMMOptions(max_iterations=8, rho=2.0),
                          mesh=multihost.fleet_mesh())
 
+    def scenario_fleet():
+        from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+        from agentlib_mpc_tpu.parallel.multihost import scenario_mesh
+        from agentlib_mpc_tpu.scenario import (
+            ScenarioFleet,
+            ScenarioFleetOptions,
+            fan_tree,
+        )
+
+        ocp = tracker_ocp()
+        group = AgentGroup(
+            name="scenario-gate", ocp=ocp, n_agents=max(n_dev // 2, 2),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30))
+        return ScenarioFleet(
+            group, fan_tree(4, robust_horizon=1),
+            ScenarioFleetOptions(max_iterations=8, rho=2.0, rho_na=2.0),
+            mesh=scenario_mesh(2))
+
     one_fleet("tracker-consensus-fleet", tracker_fleet, pin=True)
     one_fleet("LinearRCZone-consensus-fleet", menu_fleet, pin=False)
+    # the 2-D (agents x scenarios) robust round: the second psum family
+    # (ISSUE 12), pinned per axes against [jaxpr.collectives.scenario].
+    # Needs a 2-D mesh — on a host without enough devices the leg is
+    # SKIPPED with a note, not failed: the 1-D gates above still prove
+    # their full schedules (CI pins 8 virtual devices, so the leg
+    # always runs there)
+    scen_cfg = dict(cfg.get("scenario", {}) or {})
+    if n_dev >= 4 and n_dev % 2 == 0:
+        one_fleet("tracker-scenario-fleet", scenario_fleet,
+                  pin=bool(scen_cfg), budget_cfg=scen_cfg)
+    else:
+        rows.append({
+            "name": "tracker-scenario-fleet",
+            "skipped": f"needs a 2-D (agents x scenarios) mesh; "
+                       f"{n_dev} device(s) visible — set XLA_FLAGS="
+                       f"--xla_force_host_platform_device_count=8 "
+                       f"like CI does"})
     return {"fleets": rows, "failures": failures, "devices": n_dev,
             "budget": dict(cfg)}

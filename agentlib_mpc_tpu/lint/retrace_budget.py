@@ -501,6 +501,133 @@ def run_mesh_gate(budgets: "dict | None" = None,
     return report
 
 
+def run_scenario_gate(budgets: "dict | None" = None,
+                      verbose: bool = True) -> dict:
+    """``[scenario]`` budget gate: the scenario fleet's zero-retrace
+    contract (ISSUE 12 CI satellite).
+
+    Builds the tracker workload as a :class:`~agentlib_mpc_tpu.
+    scenario.fleet.ScenarioFleet` SHARDED over the 2-D
+    (agents × scenarios) mesh, warms it, then holds the per-entry-point
+    (traces + compiles) delta across ``rounds`` further
+    scenario-count-stable control steps to the ``[scenario.budgets]``
+    allowance (default 0): the vmapped branch solves, the
+    non-anticipativity psums and the per-round telemetry must hold the
+    same warm steady state as every other fused path — batching a
+    third axis must never reintroduce retrace churn. Like the mesh
+    gate, the 8 virtual CPU devices must be requested before backend
+    init (fresh process: the CLI and CI both do)."""
+    from agentlib_mpc_tpu.utils.jax_setup import request_virtual_devices
+
+    cfg = (budgets or load_budgets()).get("scenario", {})
+    request_virtual_devices(int(cfg.get("devices", 8)))
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    warmup = int(cfg.get("warmup_rounds", 2))
+    rounds = int(cfg.get("rounds", 3))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+
+    failures: list = []
+    before = after = {}
+    n_scenarios = 0
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+        from agentlib_mpc_tpu.parallel.multihost import scenario_mesh
+        from agentlib_mpc_tpu.scenario import (
+            ScenarioFleet,
+            ScenarioFleetOptions,
+            ensemble_thetas,
+            fan_tree,
+        )
+
+        n_dev = len(jax.devices())
+        n_shards = int(cfg.get("scenario_shards", 2))
+        if n_dev < 2 * n_shards or n_dev % n_shards:
+            failures.append(
+                f"scenario gate ran on {n_dev} device(s) — the 2-D "
+                f"sharded path was NOT exercised; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=8 (or run the "
+                f"gate in a fresh process)")
+            raise _MeshGateSkipped
+        mesh = scenario_mesh(n_shards)
+        n_agents = int(mesh.shape["agents"]) * max(
+            1, int(cfg.get("n_agents", 4)) // int(mesh.shape["agents"]))
+        n_scenarios = n_shards * max(
+            1, int(cfg.get("n_scenarios", 4)) // n_shards)
+
+        ocp = tracker_ocp()
+        tree = fan_tree(n_scenarios, robust_horizon=1)
+        group = AgentGroup(
+            name="scenario-gate", ocp=ocp, n_agents=n_agents,
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30))
+        fleet = ScenarioFleet(
+            group, tree,
+            ScenarioFleetOptions(max_iterations=8, rho=2.0, rho_na=2.0),
+            mesh=mesh)
+        thetas = jax.tree.map(lambda *xs: jnp.stack(xs), *[
+            ensemble_thetas(ocp.default_params(p=jnp.array([float(i + 1)])),
+                            tree, seed=i)
+            for i in range(n_agents)])
+        state = fleet.init_state(thetas)
+        state, thetas = fleet.shard_args(mesh, state, thetas)
+        for _ in range(max(warmup, 1)):
+            state, _trajs, _stats = fleet.step(state, thetas)
+            state = fleet.shift_state(state)
+
+        before = _compile_snapshot(reg)
+        for _ in range(rounds):
+            state, _trajs, _stats = fleet.step(state, thetas)
+            state = fleet.shift_state(state)
+        after = _compile_snapshot(reg)
+    except _MeshGateSkipped:
+        pass
+    finally:
+        telemetry.configure(enabled=was_enabled)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({"entry_point": entry, "observed": delta,
+                               "budget": budget})
+    report = {
+        "warmup_rounds": warmup,
+        "rounds": rounds,
+        "n_scenarios": n_scenarios,
+        "deltas": dict(sorted(deltas.items())),
+        "violations": violations,
+        "failures": failures,
+    }
+    if verbose:
+        for v in violations:
+            print(f"scenario-budget: {v['entry_point']!r} "
+                  f"compiled/traced {v['observed']}x warm (budget "
+                  f"{v['budget']}) — the scenario round is recompiling")
+        for f in failures:
+            print(f"scenario-budget: {f}")
+        if not violations and not failures:
+            print(f"scenario-budget: OK — zero excess compiles across "
+                  f"{rounds} scenario-count-stable rounds "
+                  f"({n_scenarios} scenarios)")
+    return report
+
+
 def run_serving_gate(budgets: "dict | None" = None,
                      verbose: bool = True) -> dict:
     """``[serving]`` budget gate: the serving plane's churn contract.

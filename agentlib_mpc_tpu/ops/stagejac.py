@@ -61,6 +61,10 @@ __all__ = [
     "hessian_rows",
     "plan_from_certificate",
     "stacked_fgh",
+    "tree_assemble_kkt_banded",
+    "tree_banded_fgh_jac",
+    "tree_banded_lagrangian_hessian",
+    "tree_plan_from_certificate",
 ]
 
 logger = logging.getLogger(__name__)
@@ -512,3 +516,86 @@ def assemble_kkt_banded(plan: StageJacobianPlan, CH: jnp.ndarray,
     # quasi-definite sweep sees an exactly symmetric block
     D = 0.5 * (D + jnp.swapaxes(D, 1, 2))
     return D, E
+
+
+# --------------------------------------------------------------------------
+# tree-banded seeds (ISSUE 12): the scenario axis of a tree-structured
+# OCP. Every branch of a scenario tree evaluates the SAME traced
+# residual structure (branches differ in disturbance VALUES, which are
+# theta, not structure), so one proved flat certificate — hence one
+# compressed seed set — serves the whole tree: the tree-banded
+# VJP/forward seeds are the flat plan's seeds vmapped over the scenario
+# axis. The degenerate single-scenario batch routes through the flat
+# entry points unwrapped, so the tree path can never silently diverge
+# from the proven flat pipeline.
+# --------------------------------------------------------------------------
+
+def _theta_row(theta_batch, s: int):
+    import jax as _jax
+
+    return _jax.tree.map(lambda leaf: leaf[s], theta_batch)
+
+
+def tree_banded_fgh_jac(plan: StageJacobianPlan, fgh, w_batch: jnp.ndarray,
+                        theta_batch):
+    """Values + banded Jacobian rows for a scenario batch: ``fgh(w,
+    theta)`` is the branch-shared stacked residual, ``w_batch`` (S, n_w)
+    and ``theta_batch`` (scenario-stacked pytree) carry the per-branch
+    data. One compressed-cotangent seed matrix, S pullback batches."""
+    if w_batch.shape[0] == 1:
+        th0 = _theta_row(theta_batch, 0)
+        vals, gf, Jg, Jh = banded_fgh_jac(
+            plan, lambda w: fgh(w, th0), w_batch[0])
+        return vals[None], gf[None], Jg[None], Jh[None]
+    return jax.vmap(
+        lambda w, th: banded_fgh_jac(plan, lambda ww: fgh(ww, th), w)
+    )(w_batch, theta_batch)
+
+
+def tree_banded_lagrangian_hessian(plan: StageJacobianPlan, grad_fn,
+                                   w_batch: jnp.ndarray, theta_batch
+                                   ) -> jnp.ndarray:
+    """Compressed Lagrangian-Hessian columns per scenario branch:
+    ``grad_fn(w, theta)`` is the branch-shared Lagrangian gradient; the
+    flat plan's ``3·v_s`` forward seeds serve every branch."""
+    if w_batch.shape[0] == 1:
+        th0 = _theta_row(theta_batch, 0)
+        return banded_lagrangian_hessian(
+            plan, lambda w: grad_fn(w, th0), w_batch[0])[None]
+    return jax.vmap(
+        lambda w, th: banded_lagrangian_hessian(
+            plan, lambda ww: grad_fn(ww, th), w)
+    )(w_batch, theta_batch)
+
+
+def tree_assemble_kkt_banded(plan: StageJacobianPlan, CH_batch,
+                             Jg_batch, Jh_batch, sigma_batch,
+                             w_diag_batch, delta_c: float):
+    """Scenario-batched banded KKT assembly: (D, E) stacks with a
+    leading scenario axis, ready for
+    :func:`~agentlib_mpc_tpu.ops.stagewise.factor_kkt_scenarios_banded`
+    (single-scenario batches route through the flat assembly)."""
+    if CH_batch.shape[0] == 1:
+        D, E = assemble_kkt_banded(plan, CH_batch[0], Jg_batch[0],
+                                   Jh_batch[0], sigma_batch[0],
+                                   w_diag_batch[0], delta_c)
+        return D[None], E[None]
+    return jax.vmap(
+        lambda CH, Jg, Jh, sg, wd: assemble_kkt_banded(
+            plan, CH, Jg, Jh, sg, wd, delta_c)
+    )(CH_batch, Jg_batch, Jh_batch, sigma_batch, w_diag_batch)
+
+
+def tree_plan_from_certificate(nlp, theta, n_w: int, tree_partition,
+                               log=None, label: str = "scenario tree"
+                               ) -> "StageJacobianPlan | None":
+    """Routing authority for the tree-banded derivative pipeline: the
+    branches share one structure, so ONE flat certification answers for
+    the whole tree — run it against the tree partition's per-branch
+    :class:`~agentlib_mpc_tpu.ops.stagewise.StagePartition` and build
+    the (shared) plan only from a proved certificate. Refuted or
+    unknown structure returns None — every branch keeps the dense
+    pipeline, loudly, per the PR 5 authority pattern."""
+    base = getattr(tree_partition, "base", tree_partition)
+    return plan_from_certificate(nlp, theta, n_w, base, log=log,
+                                 label=label)

@@ -51,8 +51,12 @@ __all__ = [
     "StagePartition",
     "band_matvec_blocks",
     "build_stage_partition",
+    "factor_kkt_scenarios",
+    "factor_kkt_scenarios_banded",
     "factor_kkt_stage",
     "factor_kkt_stage_banded",
+    "resolve_kkt_scenarios",
+    "resolve_kkt_scenarios_banded",
     "resolve_kkt_stage",
     "resolve_kkt_stage_banded",
     "solve_kkt_stage",
@@ -356,6 +360,64 @@ def resolve_kkt_stage_banded(factor, rhs: jnp.ndarray,
         r = bp - band_matvec_blocks(Ds, Es, x)
         x = x + _solve_blocks(F, Es, r)
     return (x * scale).reshape(-1)[inv]
+
+
+# --------------------------------------------------------------------------
+# scenario-batched sweep: the third batched axis (ISSUE 12). A scenario
+# tree's KKT system is block-diagonal over scenario branches EXCEPT for
+# the non-anticipativity rows, so the scenario-separable part factors as
+# S independent stage sweeps — one vmap over the scenario axis. The
+# degenerate S=1 case routes through the flat entry points UNWRAPPED
+# (not a 1-lane vmap): the tree path can never silently diverge from
+# the proven flat sweep, bit for bit. The coupling rows live one layer
+# up (scenario/tree.py builds the non-anticipativity Schur complement
+# on top of these factors).
+# --------------------------------------------------------------------------
+
+def factor_kkt_scenarios(K_batch: jnp.ndarray, partition: StagePartition):
+    """Factor a scenario-batched KKT stack ``K_batch`` (S, M, M): each
+    scenario's matrix through the equilibrated block-tridiagonal sweep.
+    Returns an opaque factor for :func:`resolve_kkt_scenarios`."""
+    if K_batch.ndim != 3:
+        raise ValueError(
+            f"K_batch must be (n_scenarios, M, M), got {K_batch.shape}")
+    if K_batch.shape[0] == 1:
+        return ("flat", factor_kkt_stage(K_batch[0], partition))
+    return ("vmap", jax.vmap(
+        lambda K: factor_kkt_stage(K, partition))(K_batch))
+
+
+def resolve_kkt_scenarios(factor, rhs_batch: jnp.ndarray,
+                          partition: StagePartition,
+                          refine_steps: int = 2) -> jnp.ndarray:
+    """Solve ``rhs_batch`` (S, M) against a stored scenario-batched
+    factor; rows are in original KKT index order per scenario."""
+    kind, F = factor
+    if kind == "flat":
+        return resolve_kkt_stage(F, rhs_batch[0], partition,
+                                 refine_steps)[None]
+    return jax.vmap(lambda f, r: resolve_kkt_stage(
+        f, r, partition, refine_steps))(F, rhs_batch)
+
+
+def factor_kkt_scenarios_banded(D_batch: jnp.ndarray, E_batch: jnp.ndarray):
+    """Banded-input scenario batch: ``D_batch`` (S, n_stages, n_s, n_s),
+    ``E_batch`` (S, n_stages-1, n_s, n_s) — the stage-sparse assembly
+    path vmapped over scenario branches (same S=1 bitwise routing)."""
+    if D_batch.shape[0] == 1:
+        return ("flat", factor_kkt_stage_banded(D_batch[0], E_batch[0]))
+    return ("vmap", jax.vmap(factor_kkt_stage_banded)(D_batch, E_batch))
+
+
+def resolve_kkt_scenarios_banded(factor, rhs_batch: jnp.ndarray,
+                                 partition: StagePartition,
+                                 refine_steps: int = 2) -> jnp.ndarray:
+    kind, F = factor
+    if kind == "flat":
+        return resolve_kkt_stage_banded(F, rhs_batch[0], partition,
+                                        refine_steps)[None]
+    return jax.vmap(lambda f, r: resolve_kkt_stage_banded(
+        f, r, partition, refine_steps))(F, rhs_batch)
 
 
 # --------------------------------------------------------------------------

@@ -10,6 +10,7 @@ from agentlib_mpc_tpu.parallel.multihost import (
     host_local_batch,
     initialize_multihost,
     probe_mesh_devices,
+    scenario_mesh,
     serving_slot_multiple,
     shard_multiple,
     surviving_mesh,

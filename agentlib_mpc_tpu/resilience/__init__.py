@@ -49,6 +49,7 @@ from agentlib_mpc_tpu.resilience.chaos import (
     ServeStallRule,
     SolverRule,
     corrupt_checkpoint,
+    disturbance_model,
     install_chaos,
     install_serving_chaos,
 )
@@ -60,5 +61,5 @@ __all__ = [
     "AdmmDeathRule", "install_chaos",
     "ServeChaosConfig", "ServeNaNStormRule", "ServeStallRule",
     "ServeBuildFailRule", "ChaosBuildError", "install_serving_chaos",
-    "corrupt_checkpoint",
+    "corrupt_checkpoint", "disturbance_model",
 ]

@@ -134,6 +134,48 @@ def _rng(seed: int, scope: str) -> random.Random:
     return random.Random(f"chaos:{seed}:{scope}")
 
 
+def disturbance_model(seed: int, horizon: int, n_scenarios: int, *,
+                      n_channels: int = 1, scale: float = 1.0,
+                      kind: str = "gaussian",
+                      nominal_first: bool = True) -> np.ndarray:
+    """Seeded disturbance draws — the ONE deterministic source scenario
+    generation (``agentlib_mpc_tpu.scenario.generate``) and chaos
+    injection share, keyed by the same ``chaos:<seed>:<scope>`` stream
+    convention every injector above uses: equal ``(seed, horizon,
+    n_scenarios, ...)`` reproduce the exact same draws, in tests, in
+    ``bench.py --scenario-ab SEED`` and in a chaos replay.
+
+    Returns additive perturbation trajectories, shape ``(n_scenarios,
+    horizon, n_channels)``:
+
+    * ``kind="gaussian"`` — i.i.d. N(0, scale²) per step (sensor-noise
+      shaped);
+    * ``kind="walk"`` — a zero-start random walk with N(0, scale²)
+      increments (weather-drift shaped: forecast error grows with
+      lookahead, the right model for perturbing TRY predictions).
+
+    ``nominal_first`` keeps scenario 0 all-zero — the nominal branch a
+    forecast ensemble perturbs around."""
+    if n_scenarios < 1:
+        raise ValueError("n_scenarios must be >= 1")
+    if kind not in ("gaussian", "walk"):
+        raise ValueError(f"unknown disturbance kind {kind!r}")
+    # derive the numpy stream from the chaos string-stream convention so
+    # the sampler and the injectors can never drift onto different
+    # seeding schemes; the kind stays OUT of the scope — "walk" is the
+    # integral of the same seeded increments "gaussian" returns
+    scope = f"disturbance:{horizon}:{n_scenarios}:{n_channels}"
+    root = _rng(seed, scope).getrandbits(64)
+    gen = np.random.default_rng(root)
+    draws = gen.normal(0.0, float(scale),
+                       size=(n_scenarios, int(horizon), int(n_channels)))
+    if kind == "walk":
+        draws = np.cumsum(draws, axis=1)
+    if nominal_first:
+        draws[0] = 0.0
+    return draws
+
+
 class ChaosController:
     """Owns the installed injectors: event log, counters, uninstall."""
 

@@ -1,0 +1,77 @@
+"""Scenario-tree robust MPC: the third batched — and sharded — axis.
+
+The reference stack (CasADi + IPOPT, PAPER.md) handles robust
+multi-scenario MPC by solving a scenario tree one branch at a time;
+here *disturbance scenarios* are one more batched axis next to agents
+and horizon stages, riding the same machinery those axes already have:
+
+* :mod:`.tree` — static :class:`ScenarioTree` metadata (branch points,
+  per-stage branching, non-anticipativity node groups), the
+  :class:`TreePartition` extension of the PR 4 stage partition, and the
+  tree-structured KKT solve (scenario-separable stage sweeps + a
+  non-anticipativity Schur complement);
+* :mod:`.generate` — scenario generation from the chaos harness's
+  seeded disturbance sampler and the weather/TRY forecast-ensemble
+  hooks;
+* :mod:`.fleet` — :class:`ScenarioFleet`, the fused round over a 2-D
+  (agents × scenarios) mesh: vmapped scenario solves per agent, the
+  non-anticipativity projection as one ``psum`` family over the
+  ``"scenarios"`` axis, and build-time collective certification of the
+  two-family schedule.
+
+Degenerate-case contract: a single-scenario tree routes through the
+flat single-scenario paths bit for bit — the tree axis can never
+silently diverge from the proven flat machinery.
+"""
+
+from agentlib_mpc_tpu.scenario.fleet import (
+    ScenarioFleet,
+    ScenarioFleetOptions,
+    ScenarioState,
+    ScenarioStats,
+    solve_nlp_scenarios,
+)
+from agentlib_mpc_tpu.scenario.generate import (
+    ensemble_thetas,
+    scenario_thetas,
+)
+from agentlib_mpc_tpu.scenario.tree import (
+    ScenarioTree,
+    TreePartition,
+    TreeStructureCertificate,
+    branching_tree,
+    build_tree_partition,
+    certify_tree_structure,
+    factor_kkt_tree,
+    fan_tree,
+    resolve_kkt_tree,
+    single_scenario,
+    solve_kkt_tree,
+    synthetic_tree_kkt,
+    tree_method_available,
+    tree_partition_for_ocp,
+)
+
+__all__ = [
+    "ScenarioFleet",
+    "ScenarioFleetOptions",
+    "ScenarioState",
+    "ScenarioStats",
+    "ScenarioTree",
+    "TreePartition",
+    "TreeStructureCertificate",
+    "branching_tree",
+    "build_tree_partition",
+    "certify_tree_structure",
+    "ensemble_thetas",
+    "factor_kkt_tree",
+    "fan_tree",
+    "resolve_kkt_tree",
+    "scenario_thetas",
+    "single_scenario",
+    "solve_kkt_tree",
+    "solve_nlp_scenarios",
+    "synthetic_tree_kkt",
+    "tree_method_available",
+    "tree_partition_for_ocp",
+]

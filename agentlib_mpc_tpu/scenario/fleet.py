@@ -1,0 +1,668 @@
+"""ScenarioFleet: the fused robust-MPC round over (agents × scenarios).
+
+The reference can only solve a scenario tree branch by branch — one
+CasADi+IPOPT process call per branch per agent per iteration. Here the
+scenario axis is batched and sharded exactly like the agent axis
+(PR 9): each agent vmaps its interior-point solve over S disturbance
+branches inside the fused ADMM ``while_loop``, and the two couplings
+each lower to ONE ``lax.psum`` family per iteration on a 2-D mesh:
+
+* **agents** — the ADMM consensus/residual reductions of the PR 9
+  fleet, per scenario (``psum`` over the ``"agents"`` axis);
+* **scenarios** — the non-anticipativity projection: scenarios sharing
+  a tree node must apply the same robust-horizon controls, enforced as
+  consensus-ADMM onto the node-group mean (``psum`` over the
+  ``"scenarios"`` axis) with per-branch multipliers. The actuated
+  ``u0`` IS the projected group mean — identical across a group's
+  branches by construction, not by luck.
+
+Certification end-to-end (PR 11): mesh engines trace the fused round at
+build time and prove the two-family schedule with the per-axis
+replication lattice — the nested residual psums (agents, then
+scenarios) re-replicate the Boyd exit predicate, which the certifier
+now follows axis by axis. A refuted schedule refuses to dispatch on a
+multi-process mesh; the degenerate single-scenario engine (no
+non-anticipativity terms traced at all) certifies the same one-family
+shape as today's agent fleet.
+
+Scope: one structure group per fleet (heterogeneous robust fleets
+bucket one ScenarioFleet per structure, like the serving plane buckets
+FusedADMM engines); the shared-trace two-phase solve (cold budget at
+iteration 0, warm after) is the only solver wiring — per-phase option
+structures beyond budget/barrier belong to :class:`FusedADMM`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.ops.admm import AdmmResiduals, consensus_penalty
+from agentlib_mpc_tpu.ops.solver import (
+    NLPFunctions,
+    solve_nlp,
+)
+from agentlib_mpc_tpu.scenario.tree import ScenarioTree
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ScenarioFleet",
+    "ScenarioFleetOptions",
+    "ScenarioState",
+    "ScenarioStats",
+    "pad_scenarios",
+    "solve_nlp_scenarios",
+]
+
+
+def solve_nlp_scenarios(nlp, w0_batch, theta_batch, lb_batch, ub_batch,
+                        options, tree: "ScenarioTree | None" = None,
+                        y0_batch=None, z0_batch=None):
+    """S independent per-branch solves as one scenario-batched call
+    (leading axis S on every array / theta leaf). The degenerate S=1
+    batch routes through :func:`~agentlib_mpc_tpu.ops.solver.solve_nlp`
+    UNWRAPPED — not a 1-lane vmap — so its result is bit-identical to
+    the flat solver path (the ISSUE 12 degenerate-tree contract);
+    S > 1 is the plain vmap the fused fleet uses."""
+    S = int(w0_batch.shape[0])
+    if tree is not None and tree.n_scenarios != S:
+        raise ValueError(
+            f"w0_batch carries {S} scenarios, tree has "
+            f"{tree.n_scenarios}")
+    if S == 1:
+        row = lambda leaf: None if leaf is None else leaf[0]
+        res = solve_nlp(nlp, w0_batch[0],
+                        jax.tree.map(lambda l: l[0], theta_batch),
+                        lb_batch[0], ub_batch[0], options,
+                        y0=row(y0_batch), z0=row(z0_batch))
+        return jax.tree.map(lambda leaf: jnp.asarray(leaf)[None], res)
+    if y0_batch is None:
+        return jax.vmap(lambda w0, th, lb, ub: solve_nlp(
+            nlp, w0, th, lb, ub, options))(w0_batch, theta_batch,
+                                           lb_batch, ub_batch)
+    return jax.vmap(lambda w0, th, lb, ub, y0, z0: solve_nlp(
+        nlp, w0, th, lb, ub, options, y0=y0, z0=z0))(
+        w0_batch, theta_batch, lb_batch, ub_batch, y0_batch, z0_batch)
+
+
+class ScenarioFleetOptions(NamedTuple):
+    max_iterations: int = 20
+    #: consensus penalty for the agent couplings (one value for every
+    #: alias — per-alias adaptation stays with :class:`FusedADMM`)
+    rho: float = 10.0
+    #: non-anticipativity penalty over the scenario groups
+    rho_na: float = 10.0
+    #: Boyd relative-tolerance exit (same semantics as FusedADMMOptions)
+    abs_tol: float = 1e-3
+    rel_tol: float = 1e-2
+    use_relative_tolerances: bool = True
+    primal_tol: float = 1e-3
+    dual_tol: float = 1e-3
+    #: warm-phase inner interior-point budget (traced; iteration 0 runs
+    #: the group's full cold budget — the shared-trace two-phase scheme)
+    warm_budget: int = 6
+    #: warm-phase initial barrier
+    warm_mu: float = 1e-2
+
+
+class ScenarioState(NamedTuple):
+    """Carried between control steps (the robust warm-start memory).
+    Agent axes are shard-local under a mesh; the scenario axis likewise."""
+
+    zbar: dict          # alias -> (S, T) per-scenario consensus means
+    lam: dict           # alias -> (n_agents, S, T) multipliers
+    nu: jnp.ndarray     # (n_agents, S, R, n_u) non-anticipativity mult.
+    na_target: jnp.ndarray  # (n_agents, S, R, n_u) last group-mean proj.
+    w: jnp.ndarray      # (n_agents, S, n_w) primal warm starts
+    y: jnp.ndarray      # (n_agents, S, n_g)
+    z: jnp.ndarray      # (n_agents, S, n_h)
+
+
+class ScenarioStats(NamedTuple):
+    iterations: jnp.ndarray           # ()
+    primal_residuals: jnp.ndarray     # (max_iter,) NaN-padded
+    dual_residuals: jnp.ndarray
+    converged: jnp.ndarray            # () bool
+    local_solves_ok: jnp.ndarray      # () bool
+    #: final non-anticipativity primal residual — how far the branch
+    #: controls sit from their group projection (the ``scenario_spread``
+    #: telemetry histogram; exactly 0 when the tree has no coupling)
+    na_spread: jnp.ndarray            # ()
+
+
+class ScenarioFleet:
+    """Compiled robust-MPC round: one structure group × S disturbance
+    scenarios, batched (vmap) or sharded (2-D ``shard_map``) over both
+    axes. Build once per (group structure, tree); call :meth:`step`
+    once per control step with a (n_agents, S)-leading theta batch."""
+
+    def __init__(self, group, tree: ScenarioTree,
+                 options: ScenarioFleetOptions = ScenarioFleetOptions(),
+                 active=None, mesh=None,
+                 collective_certify: str = "auto"):
+        """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
+        AgentGroup` (couplings only; exchanges are not scenario-lifted).
+        ``tree``: the static scenario tree; ``tree.n_scenarios == 1``
+        builds the degenerate engine — no non-anticipativity terms are
+        traced, so the schedule is exactly today's one-family fleet.
+        ``mesh``: None (single device), a 1-D ``("agents",)`` mesh, or
+        a 2-D ``("agents", "scenarios")`` mesh
+        (:func:`~agentlib_mpc_tpu.parallel.multihost.scenario_mesh`).
+        ``collective_certify``: "auto" | "require" | "off", the
+        :class:`FusedADMM` policy verbatim."""
+        from agentlib_mpc_tpu.parallel.fused_admm import FusedADMM
+
+        if group.exchanges:
+            raise ValueError(
+                "ScenarioFleet lifts consensus couplings only; "
+                f"group {group.name!r} declares exchanges "
+                f"{sorted(group.exchanges)}")
+        self.group = FusedADMM._with_stage_partition(group)
+        self.tree = tree.validate(group.ocp.N)
+        self.options = options
+        self.T = group.ocp.N
+        self.n_u = len(group.ocp.control_names)
+        self.S = tree.n_scenarios
+        self.R = tree.robust_horizon if self.S > 1 else 0
+        self._aliases = sorted(group.couplings)
+        if active is None:
+            active = jnp.ones((group.n_agents,), bool)
+        self.active = jnp.asarray(active, bool)
+        if self.active.shape != (group.n_agents,):
+            raise ValueError(
+                f"active mask has shape {self.active.shape}, expected "
+                f"({group.n_agents},)")
+        if collective_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"collective_certify must be 'auto', 'require' or "
+                f"'off', got {collective_certify!r}")
+        self.collective_certify = collective_certify
+        self.collective_certificate = None
+        self.collective_schedule_digest = None
+        self.mesh = mesh
+        self._membership, self._counts = self._build_membership()
+        self._compile_step()
+        if telemetry.enabled():
+            telemetry.gauge(
+                "scenario_count",
+                "disturbance scenarios batched per agent in the "
+                "scenario fleet").set(float(self.S))
+
+    # -- static layout --------------------------------------------------------
+
+    def _build_membership(self):
+        """(S, R, G) one-hot node membership + (R, G) static group
+        sizes. The membership rides the step as a TRACED input sharded
+        over the scenario axis (a shard-local body only sees its own
+        scenario rows); the counts are global constants."""
+        R, S = self.R, self.S
+        if R == 0:
+            return (jnp.zeros((S, 0, 1)), np.ones((0, 1)))
+        G = max(len(self.tree.groups_at(t)) for t in range(R))
+        M = np.zeros((S, R, G))
+        counts = np.ones((R, G))
+        for t in range(R):
+            node_ids = sorted(set(self.tree.node_of[t]))
+            slot_of = {n: g for g, n in enumerate(node_ids)}
+            for s, node in enumerate(self.tree.node_of[t]):
+                M[s, t, slot_of[node]] = 1.0
+            for g, grp in enumerate(self.tree.groups_at(t)):
+                counts[t, g] = float(len(grp))
+        return jnp.asarray(M), counts
+
+    # -- state ----------------------------------------------------------------
+
+    def init_state(self, theta_batch) -> ScenarioState:
+        """Fresh state for an (n_agents, S)-leading theta batch."""
+        g = self.group
+        zbar = {a: jnp.zeros((self.S, self.T)) for a in self._aliases}
+        lam = {a: jnp.zeros((g.n_agents, self.S, self.T))
+               for a in self._aliases}
+        nu = jnp.zeros((g.n_agents, self.S, self.R, self.n_u))
+        fdtype = jnp.zeros(()).dtype
+        w = jax.vmap(jax.vmap(g.ocp.initial_guess))(theta_batch)
+        y = jnp.zeros((g.n_agents, self.S, g.ocp.n_g))
+        z = jnp.full((g.n_agents, self.S, g.ocp.n_h), 0.1, dtype=fdtype)
+        return ScenarioState(zbar=zbar, lam=lam, nu=nu,
+                             na_target=jnp.zeros_like(nu),
+                             w=w, y=y, z=z)
+
+    def shift_state(self, state: ScenarioState) -> ScenarioState:
+        """Shift-by-one warm start between control steps (trajectory
+        leaves only; multipliers and primal iterates carry over)."""
+        sh = lambda a: admm_ops.shift_one(a, self.T)
+        return state._replace(
+            zbar={k: sh(v) for k, v in state.zbar.items()},
+            lam={k: sh(v) for k, v in state.lam.items()})
+
+    # -- the fused iteration loop ---------------------------------------------
+
+    def _build_step(self, ax_a=None, ax_s=None):
+        g = self.group
+        ocp = g.ocp
+        opts = self.options
+        aliases = self._aliases
+        R, n_u = self.R, self.n_u
+        cols = {a: g.control_index(n)
+                for a, n in sorted(g.couplings.items())}
+        counts = jnp.asarray(self._counts)
+
+        def f_aug(w_flat, theta):
+            # scenario weight rides theta (probabilities are data);
+            # coupling penalties are dt-integrated like the base cost
+            # (the FusedADMM convention)
+            ocp_theta, weight, aug, na = theta
+            val = weight * ocp.nlp.f(w_flat, ocp_theta)
+            u = ocp.unflatten(w_flat)["u"]
+            for k, alias in enumerate(aliases):
+                zbar_s, lam_s, rho = aug[k]
+                val = val + ocp.dt * consensus_penalty(
+                    u[:, cols[alias]], zbar_s, lam_s, rho)
+            if na is not None:
+                target, nu_s, rho_na = na
+                val = val + ocp.dt * consensus_penalty(
+                    u[:R], target, nu_s, rho_na)
+            return val
+
+        nlp_aug = NLPFunctions(
+            f=f_aug,
+            g=lambda w, th: ocp.nlp.g(w, th[0]),
+            h=lambda w, th: ocp.nlp.h(w, th[0]),
+        )
+
+        # stage-sparse derivative plan on the AUGMENTED nlp (the tree
+        # branches share it — tree_plan_from_certificate's one-proof
+        # contract), attached through the shared gate+certify seam
+        from agentlib_mpc_tpu.ops import stagejac
+
+        theta0 = ocp.default_params()
+        aug0 = tuple((jnp.zeros((self.T,)), jnp.zeros((self.T,)),
+                      jnp.asarray(1.0)) for _ in aliases)
+        na0 = (jnp.zeros((R, n_u)), jnp.zeros((R, n_u)),
+               jnp.asarray(1.0)) if R else None
+        n_w = int(ocp.initial_guess(theta0).shape[0])
+        part = getattr(ocp, "stage_partition", None)
+        solver_opts = stagejac.attach_plan_if_worthwhile(
+            g.solver_options, part, nlp_aug,
+            (theta0, jnp.asarray(1.0), aug0, na0), n_w,
+            label=f"scenario group {g.name!r}")
+
+        def local_solves(state, theta_batch, scen_weight, mu0, budget,
+                         rho_na_t):
+            def one(w0, y0, z0, th, wgt, zbars, lams, target, nu_s):
+                aug = tuple(
+                    (zbars[k], lams[k], jnp.asarray(opts.rho))
+                    for k in range(len(aliases)))
+                na = (target, nu_s, rho_na_t) if R else None
+                lb, ub = ocp.bounds(th)
+                res = solve_nlp(nlp_aug, w0, (th, wgt, aug, na), lb, ub,
+                                solver_opts, y0=y0, z0=z0, mu0=mu0,
+                                max_iter=budget)
+                u = ocp.unflatten(res.w)["u"]
+                return res.w, res.y, res.z, u, res.stats.success
+
+            # inner vmap: scenarios; outer: agents. zbar is per
+            # scenario (replicated over agents), lam per (agent,
+            # scenario).
+            zbars = tuple(state.zbar[a] for a in aliases)
+            lams = tuple(state.lam[a] for a in aliases)
+            over_s = jax.vmap(
+                one, in_axes=(0, 0, 0, 0, 0, (0,) * len(aliases),
+                              (0,) * len(aliases), 0, 0))
+            over_as = jax.vmap(
+                over_s, in_axes=(0, 0, 0, 0, None,
+                                 (None,) * len(aliases),
+                                 (0,) * len(aliases), 0, 0))
+            return over_as(state.w, state.y, state.z, theta_batch,
+                           scen_weight, zbars, lams, state.na_target,
+                           state.nu)
+
+        def close_sum(v):
+            if ax_s is not None:
+                v = jax.lax.psum(v, ax_s)
+            return v
+
+        def close_res(res: AdmmResiduals) -> AdmmResiduals:
+            """Close per-scenario-shard partial residuals over the
+            scenario mesh axis (rss for norms, sum for counts)."""
+            if ax_s is None:
+                return res
+            rss = lambda v: jnp.sqrt(jax.lax.psum(v ** 2, ax_s))
+            return AdmmResiduals(
+                primal=rss(res.primal), dual=rss(res.dual),
+                scale_primal=rss(res.scale_primal),
+                scale_dual=rss(res.scale_dual),
+                n_primal=jax.lax.psum(res.n_primal, ax_s),
+                n_dual=jax.lax.psum(res.n_dual, ax_s))
+
+        def gnorm(arr):
+            sq = jnp.sum(arr.reshape(-1) ** 2)
+            if ax_a is not None:
+                sq = jax.lax.psum(sq, ax_a)
+            return jnp.sqrt(close_sum(sq))
+
+        def step_fn(state: ScenarioState, theta_batch, active,
+                    membership, scen_weight):
+            max_it = opts.max_iterations
+            act4 = active[:, None, None, None].astype(state.nu.dtype)
+
+            def na_project(u_na):
+                """Group-mean projection of the robust-horizon controls
+                across the scenario axis: the ONE scenarios-psum of the
+                non-anticipativity coupling."""
+                partial = jnp.einsum("astu,stg->atgu", u_na, membership,
+                                     precision=jax.lax.Precision.HIGHEST)
+                sums = partial
+                if ax_s is not None:
+                    sums = jax.lax.psum(sums, ax_s)
+                means = sums / counts[None, :, :, None]
+                return jnp.einsum("stg,atgu->astu", membership, means,
+                                  precision=jax.lax.Precision.HIGHEST)
+
+            def iteration(carry):
+                (state, it, _res, prim_h, dual_h, done, ok_hist,
+                 na_last) = carry
+                is_cold = it == 0
+                cold = g.solver_options
+                mu0 = jnp.where(is_cold, cold.mu_init, opts.warm_mu)
+                budget = jnp.where(is_cold, cold.max_iter,
+                                   opts.warm_budget)
+                # iteration 0 has no projection target yet — the NA
+                # penalty ramps in from the first computed group mean
+                rho_na_t = jnp.where(is_cold, 0.0,
+                                     jnp.asarray(opts.rho_na))
+                w_b, y_b, z_b, u_b, ok_b = local_solves(
+                    state, theta_batch, scen_weight, mu0, budget,
+                    rho_na_t)
+                n_failed = jnp.sum(
+                    ~(ok_b | ~active[:, None]), dtype=jnp.int32)
+                if ax_a is not None:
+                    n_failed = jax.lax.psum(n_failed, ax_a)
+                n_failed = close_sum(n_failed)
+                ok_all = n_failed == 0
+
+                residuals = []
+                zbar_new = dict(state.zbar)
+                lam_new = dict(state.lam)
+                for alias in aliases:
+                    locals_ = u_b[:, :, :, cols[alias]]  # (n_a, S, T)
+                    cstate = admm_ops.ConsensusState(
+                        zbar=state.zbar[alias], lam=state.lam[alias],
+                        rho=jnp.asarray(opts.rho))
+                    cnew, res = admm_ops.consensus_update(
+                        locals_, cstate, active=active, axis_name=ax_a)
+                    residuals.append(close_res(res))
+                    zbar_new[alias] = cnew.zbar
+                    lam_new[alias] = cnew.lam
+
+                if R:
+                    u_na = u_b[:, :, :R, :]            # (n_a, S, R, n_u)
+                    target = na_project(u_na)
+                    prim_per = (target - u_na) * act4
+                    nu_new = state.nu - opts.rho_na * prim_per
+                    na_res = AdmmResiduals(
+                        primal=gnorm(prim_per),
+                        dual=gnorm(opts.rho_na
+                                   * (target - state.na_target) * act4),
+                        scale_primal=jnp.maximum(gnorm(u_na * act4),
+                                                 gnorm(target * act4)),
+                        scale_dual=gnorm(nu_new * act4),
+                        # constraint elements: active agents x ALL
+                        # scenarios (static) x coupled coordinates —
+                        # no scenario psum needed for a static count
+                        n_primal=_active_count(active, ax_a)
+                        * float(self.S * R * n_u),
+                        n_dual=_active_count(active, ax_a)
+                        * float(self.S * R * n_u))
+                    residuals.append(na_res)
+                    na_last = na_res.primal
+                else:
+                    target, nu_new = state.na_target, state.nu
+
+                res_all = admm_ops.combine_residuals(*residuals) \
+                    if residuals else AdmmResiduals(
+                        *([jnp.asarray(0.0)] * 6))
+                is_conv = admm_ops.converged(
+                    res_all, abs_tol=opts.abs_tol, rel_tol=opts.rel_tol,
+                    use_relative=opts.use_relative_tolerances,
+                    primal_tol=opts.primal_tol, dual_tol=opts.dual_tol)
+                prim_h = prim_h.at[it].set(res_all.primal)
+                dual_h = dual_h.at[it].set(res_all.dual)
+                state = state._replace(
+                    zbar=zbar_new, lam=lam_new, nu=nu_new,
+                    na_target=target, w=w_b, y=y_b, z=z_b)
+                return (state, it + 1, res_all, prim_h, dual_h,
+                        is_conv, ok_hist & ok_all, na_last)
+
+            def cond(carry):
+                done, it = carry[5], carry[1]
+                return (~done) & (it < max_it)
+
+            nan_hist = jnp.full((max_it,), jnp.nan)
+            init_res = AdmmResiduals(*([jnp.asarray(jnp.inf)] * 2),
+                                     *([jnp.asarray(0.0)] * 4))
+            carry = (state, jnp.asarray(0), init_res, nan_hist,
+                     jnp.full((max_it,), jnp.nan), jnp.asarray(False),
+                     jnp.asarray(True), jnp.asarray(0.0))
+            (state, it, _res, prim_h, dual_h, done, ok_hist,
+             na_last) = jax.lax.while_loop(cond, iteration, carry)
+
+            stats = ScenarioStats(
+                iterations=it, primal_residuals=prim_h,
+                dual_residuals=dual_h, converged=done,
+                local_solves_ok=ok_hist, na_spread=na_last)
+            trajs = jax.vmap(jax.vmap(ocp.trajectories))(state.w,
+                                                         theta_batch)
+            return state, trajs, stats
+
+        return step_fn
+
+    def _compile_step(self) -> None:
+        self._scen_weight = jnp.asarray(
+            self.tree.probabilities) * float(self.S)
+        if self.mesh is None:
+            self._step = jax.jit(self._build_step())
+            return
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        names = tuple(mesh.axis_names)
+        if names not in (("agents",), ("agents", "scenarios")):
+            raise ValueError(
+                f"ScenarioFleet meshes are 1-D ('agents',) or 2-D "
+                f"('agents', 'scenarios'); got {names} "
+                f"(use multihost.scenario_mesh())")
+        ax_a = "agents"
+        ax_s = "scenarios" if len(names) == 2 else None
+        n_ash = int(mesh.shape["agents"])
+        n_ssh = int(mesh.shape["scenarios"]) if ax_s else 1
+        if self.group.n_agents % n_ash:
+            raise ValueError(
+                f"{self.group.n_agents} agents do not divide the "
+                f"{n_ash}-shard agent axis — pad the group first "
+                f"(parallel.fused_admm.pad_group_to_devices)")
+        if self.S % n_ssh:
+            raise ValueError(
+                f"{self.S} scenarios do not divide the {n_ssh}-shard "
+                f"scenario axis — pad the tree (or pick a divisible "
+                f"scenario count)")
+
+        sh_a = P(ax_a)
+        sh_as = P(ax_a, ax_s) if ax_s else P(ax_a)
+        sh_s = P(ax_s) if ax_s else P()
+        state_spec = ScenarioState(
+            zbar={a: sh_s for a in self._aliases},
+            lam={a: sh_as for a in self._aliases},
+            nu=sh_as, na_target=sh_as, w=sh_as, y=sh_as, z=sh_as)
+        stats_spec = ScenarioStats(*([P()] * 6))
+        step_fn = self._build_step(ax_a=ax_a, ax_s=ax_s)
+        # check_rep=False for the same reason FusedADMM sets it: the
+        # psum'ed loop outputs are replicated by construction, which
+        # the checker cannot see through while_loop carries — the
+        # build-time certificate below is the proof that claim rests on
+        sharded = shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(state_spec, sh_as, sh_a, sh_s, sh_s),
+            out_specs=(state_spec, sh_as, stats_spec),
+            check_rep=False)
+        self._step = jax.jit(sharded)
+        if self.collective_certify != "off":
+            self._certify(sharded, names)
+
+    def _certify(self, sharded, axis_names: tuple) -> None:
+        """Trace the sharded step on shape templates and certify the
+        collective schedule (the FusedADMM build-time pattern): exactly
+        one psum family per mesh axis per ADMM iteration, proved by the
+        per-axis replication lattice before the program can ever wedge
+        a pod behind a divergent collective."""
+        from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+            certify_collectives,
+        )
+
+        g = self.group
+
+        def sds(leaf):
+            arr = jnp.asarray(leaf)
+            return jax.ShapeDtypeStruct(
+                (g.n_agents, self.S) + arr.shape, arr.dtype)
+
+        theta_tmpl = jax.tree.map(sds, g.ocp.default_params())
+        state_tmpl = jax.eval_shape(self.init_state, theta_tmpl)
+        mask_tmpl = jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
+        memb_tmpl = jax.ShapeDtypeStruct(
+            tuple(self._membership.shape), self._membership.dtype)
+        wgt_tmpl = jax.ShapeDtypeStruct((self.S,),
+                                        self._scen_weight.dtype)
+        closed = jax.make_jaxpr(sharded)(
+            state_tmpl, theta_tmpl, mask_tmpl, memb_tmpl, wgt_tmpl)
+        cert = certify_collectives(closed, allowed_axes=axis_names)
+        self.collective_certificate = cert
+        self.collective_schedule_digest = cert.schedule_digest
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"scenario fleet's collective schedule REFUTED — "
+                   f"dispatching it on a multi-process mesh risks a "
+                   f"silent cross-host hang:\n  {detail}")
+            if self.collective_certify == "require" or \
+                    jax.process_count() > 1:
+                raise ValueError(msg)
+            logger.warning("%s\n(single-host mesh: proceeding)", msg)
+        elif cert.status == "unknown":
+            if self.collective_certify == "require":
+                raise ValueError(
+                    f"scenario fleet's collective schedule is "
+                    f"UNPROVABLE ({cert.describe()}) under "
+                    f"collective_certify='require'")
+            logger.info("scenario schedule not provable (%s)",
+                        cert.describe())
+        else:
+            logger.info("scenario schedule proved: %s (digest %s)",
+                        cert.describe(), cert.schedule_digest)
+
+    # -- public API -----------------------------------------------------------
+
+    def step(self, state: ScenarioState, theta_batch, active=None):
+        """One fused robust round. ``theta_batch``: OCPParams pytree
+        with (n_agents, S) leading axes (``scenario.generate`` builds
+        it). Returns (new_state, per-(agent, scenario) trajectory
+        pytree, :class:`ScenarioStats`)."""
+        mask = self.active if active is None else jnp.asarray(active,
+                                                              bool)
+        args = (state, theta_batch, mask, self._membership,
+                self._scen_weight)
+        if not telemetry.enabled():
+            return self._step(*args)
+        with telemetry.span("scenario.fused_step", group=self.group.name,
+                            scenarios=str(self.S)):
+            out = self._step(*args)
+        stats = out[2]
+        telemetry.gauge(
+            "scenario_count",
+            "disturbance scenarios batched per agent in the scenario "
+            "fleet").set(float(self.S))
+        telemetry.histogram(
+            "scenario_spread",
+            "final non-anticipativity primal residual per fused robust "
+            "round (distance of branch controls from their group "
+            "projection)").observe(float(stats.na_spread))
+        telemetry.counter(
+            "scenario_rounds_total",
+            "fused scenario-tree robust rounds run").inc(
+            group=self.group.name)
+        return out
+
+    def actuated_u0(self, state: ScenarioState) -> jnp.ndarray:
+        """The robust controls to actuate: the non-anticipativity
+        projection's first-interval rows, (n_agents, S, n_u) —
+        identical across every scenario of a root node group BY
+        CONSTRUCTION (one shared row for the common all-scenarios fan;
+        one row per group for deeper trees). Falls back to the raw
+        per-scenario trajectory heads for an uncoupled tree."""
+        if self.R:
+            return state.na_target[:, :, 0, :]
+        u = jax.vmap(jax.vmap(
+            lambda w: self.group.ocp.unflatten(w)["u"]))(state.w)
+        return u[:, :, 0, :]
+
+    def shard_args(self, mesh, state: ScenarioState, theta_batch):
+        """Place the (agents, scenarios)-batched leaves on ``mesh``
+        (sharded over both axes; per-scenario means over scenarios
+        only). The scenario sibling of ``FusedADMM.shard_args`` —
+        shapes must already divide the mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        names = tuple(mesh.axis_names)
+        ax_s = "scenarios" if "scenarios" in names else None
+        sh_as = NamedSharding(mesh, P("agents", ax_s))
+        sh_s = NamedSharding(mesh, P(ax_s))
+        put = lambda leaf, sh: jax.device_put(leaf, sh)
+        state = state._replace(
+            zbar={a: put(v, sh_s) for a, v in state.zbar.items()},
+            lam={a: put(v, sh_as) for a, v in state.lam.items()},
+            nu=put(state.nu, sh_as),
+            na_target=put(state.na_target, sh_as),
+            w=put(state.w, sh_as), y=put(state.y, sh_as),
+            z=put(state.z, sh_as))
+        theta_batch = jax.tree.map(lambda l: put(l, sh_as), theta_batch)
+        return state, theta_batch
+
+
+def _active_count(active, ax_a):
+    n = jnp.sum(active.astype(jnp.float32))
+    if ax_a is not None:
+        n = jax.lax.psum(n, ax_a)
+    return n
+
+
+def pad_scenarios(tree: ScenarioTree, theta_batch, n_shards: int):
+    """Pad the scenario axis to a multiple of the mesh's scenario
+    shards: padded branches replicate the LAST scenario's data with
+    probability 0 (dead weight in the expectation) and join no
+    non-anticipativity group beyond their clone's — the scenario-axis
+    sibling of
+    :func:`~agentlib_mpc_tpu.parallel.fused_admm.pad_group_to_devices`.
+    Returns ``(tree, theta_batch)`` grown to the padded count."""
+    S = tree.n_scenarios
+    n_pad = (-S) % n_shards
+    if n_pad == 0:
+        return tree, theta_batch
+    node_of = tuple(
+        nodes + tuple(1_000_000 + i for i in range(n_pad))
+        for nodes in tree.node_of)
+    probs = tuple(tree.probabilities) + (0.0,) * n_pad
+    padded_tree = ScenarioTree(
+        n_scenarios=S + n_pad, node_of=node_of, probabilities=probs)
+    theta_batch = jax.tree.map(
+        lambda leaf: jnp.concatenate(
+            [leaf, jnp.repeat(leaf[:, -1:], n_pad, axis=1)], axis=1),
+        theta_batch)
+    return padded_tree, theta_batch

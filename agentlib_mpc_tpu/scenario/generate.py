@@ -1,0 +1,101 @@
+"""Scenario generation: disturbance draws → scenario-stacked OCP data.
+
+Scenarios are DATA, never structure: every branch of a scenario tree
+evaluates the same transcribed OCP with a different exogenous-input
+trajectory (``OCPParams.d_traj``), so generating scenarios is stacking
+perturbed parameter pytrees along a new leading axis — the axis
+:class:`~agentlib_mpc_tpu.scenario.fleet.ScenarioFleet` vmaps and
+shards. Two seeded sources feed it:
+
+* the chaos harness's deterministic sampler
+  (:func:`agentlib_mpc_tpu.resilience.chaos.disturbance_model`) —
+  scenario generation and chaos injection share one seeded stream, so
+  a robust-MPC run and the chaos replay that attacks it can draw the
+  SAME disturbance realizations;
+* the weather/TRY forecast-ensemble hooks
+  (:meth:`~agentlib_mpc_tpu.modules.input_prediction.InputPredictor.
+  get_prediction_ensemble_at_time`,
+  :func:`agentlib_mpc_tpu.utils.try_format.try_forecast_ensemble`) —
+  nominal forecast + seeded random-walk perturbations per column.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ensemble_thetas",
+    "scenario_thetas",
+    "stack_scenario_params",
+]
+
+
+def stack_scenario_params(thetas):
+    """Stack per-scenario OCPParams into one batched pytree (scenario
+    axis 0) — the scenario-axis sibling of
+    :func:`agentlib_mpc_tpu.parallel.fused_admm.stack_params`."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *thetas)
+
+
+def scenario_thetas(theta, tree, draws, channels=None):
+    """Stack one agent's ``theta`` into an (S, ...) scenario batch with
+    ``d_traj`` perturbed per branch.
+
+    ``draws``: additive disturbances, shape ``(S, N, len(channels))``
+    (or ``(S, N)`` for one channel); ``channels`` indexes the exogenous
+    columns of ``d_traj`` they perturb (default: the leading columns).
+    Rows beyond the perturbed channels replicate the nominal data, so
+    a single-scenario tree returns an exact 1-stack of ``theta``."""
+    S = tree.n_scenarios
+    draws = np.asarray(draws, dtype=float)
+    if draws.ndim == 2:
+        draws = draws[:, :, None]
+    if draws.shape[0] != S:
+        raise ValueError(
+            f"draws carry {draws.shape[0]} scenarios, tree has {S}")
+    d = np.asarray(theta.d_traj, dtype=float)
+    if d.ndim != 2:
+        raise ValueError(f"theta.d_traj must be (N, n_d), got {d.shape}")
+    N, n_d = d.shape
+    if draws.shape[1] != N:
+        raise ValueError(
+            f"draws cover {draws.shape[1]} intervals, horizon has {N}")
+    channels = tuple(range(draws.shape[2])) if channels is None \
+        else tuple(int(c) for c in channels)
+    if len(channels) != draws.shape[2]:
+        raise ValueError(
+            f"{len(channels)} channel indices for "
+            f"{draws.shape[2]}-channel draws")
+    bad = [c for c in channels if not 0 <= c < n_d]
+    if bad:
+        raise ValueError(f"channel index(es) {bad} outside d_traj's "
+                         f"{n_d} columns")
+    d_batch = np.broadcast_to(d, (S, N, n_d)).copy()
+    for k, c in enumerate(channels):
+        d_batch[:, :, c] += draws[:, :, k]
+    batched = jax.tree.map(
+        lambda leaf: jnp.broadcast_to(
+            jnp.asarray(leaf), (S,) + tuple(np.shape(leaf))), theta)
+    return batched._replace(d_traj=jnp.asarray(d_batch))
+
+
+def ensemble_thetas(theta, tree, seed: int = 0, scale: float = 1.0,
+                    channels=(0,), kind: str = "walk"):
+    """Scenario batch straight from the chaos sampler: seeded
+    ``disturbance_model`` draws (scenario 0 nominal) added onto the
+    selected ``d_traj`` channels — the one-call path ``bench.py
+    --scenario-ab`` and the tests use. Deterministic in ``seed``.
+    Models without exogenous inputs (0-column ``d_traj``) stack the
+    nominal data S times unperturbed — the branches then differ only
+    through whatever the caller varies by hand."""
+    from agentlib_mpc_tpu.resilience.chaos import disturbance_model
+
+    N, n_d = (int(v) for v in np.shape(theta.d_traj))
+    channels = tuple(c for c in channels if c < n_d)
+    draws = disturbance_model(seed=seed, horizon=N,
+                              n_scenarios=tree.n_scenarios,
+                              n_channels=len(channels),
+                              scale=scale, kind=kind)
+    return scenario_thetas(theta, tree, draws, channels=channels)

@@ -1,0 +1,438 @@
+"""Scenario-tree metadata and the tree-structured KKT solve.
+
+A scenario tree for robust MPC (Lucia et al., multi-stage NMPC; the
+reference can only walk it branch by branch through serial CasADi
+solves) is, per agent, S copies of the same transcribed OCP — one per
+disturbance realization — coupled ONLY by non-anticipativity: scenarios
+that share a tree node up to stage ``t`` must apply the same control at
+``t`` (the controller cannot act on information it does not have yet).
+
+That coupling pattern is block-sparse in exactly the way the PR 4
+machinery exploits:
+
+* the scenario-separable part of the tree KKT matrix is block-diagonal
+  over branches, each block block-tridiagonal under the branch's
+  :class:`~agentlib_mpc_tpu.ops.stagewise.StagePartition` — it factors
+  as S independent stage sweeps, one ``vmap`` over the scenario axis
+  (:func:`~agentlib_mpc_tpu.ops.stagewise.factor_kkt_scenarios`);
+* the non-anticipativity rows are a THIN equality coupling (pairwise
+  control pins within each node group, ``(|group|-1) · n_u`` rows per
+  robust stage) whose Schur complement onto the coupling multipliers is
+  a small dense SPD system — factored once per tree factorization,
+  reused by every resolve.
+
+:class:`TreePartition` extends the stage partition with the tree
+metadata and the static coupling layout; the degenerate single-scenario
+partition routes through the flat sweep UNWRAPPED (bitwise identity
+with the proven flat path — the acceptance contract of ISSUE 12).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentlib_mpc_tpu.ops import kkt as kkt_ops
+from agentlib_mpc_tpu.ops.stagewise import (
+    StagePartition,
+    factor_kkt_scenarios,
+    resolve_kkt_scenarios,
+    synthetic_stage_kkt,
+)
+
+_HI = jax.lax.Precision.HIGHEST
+
+__all__ = [
+    "ScenarioTree",
+    "TreePartition",
+    "TreeStructureCertificate",
+    "branching_tree",
+    "build_tree_partition",
+    "certify_tree_structure",
+    "factor_kkt_tree",
+    "fan_tree",
+    "resolve_kkt_tree",
+    "single_scenario",
+    "solve_kkt_tree",
+    "synthetic_tree_kkt",
+    "tree_method_available",
+    "tree_partition_for_ocp",
+]
+
+
+class ScenarioTree(NamedTuple):
+    """Static scenario-tree metadata. Hashable (plain ints + nested int
+    tuples) so it can ride inside static jit arguments and engine
+    bucket keys exactly like the stage partition does.
+
+    ``node_of`` lists, per non-anticipative control interval ``t``
+    (outermost tuple, length = robust horizon), the tree-node id of
+    every scenario: scenarios sharing the node at ``t`` must apply the
+    same control ``u_t`` — the non-anticipativity groups. An empty
+    ``node_of`` means no coupling (independent scenarios).
+    ``probabilities`` weight each branch's objective (uniform by
+    default); they are data for the expectation, not structure."""
+
+    n_scenarios: int
+    node_of: tuple          # per robust stage: tuple(scenario -> node id)
+    probabilities: tuple
+
+    @property
+    def robust_horizon(self) -> int:
+        """Control intervals under non-anticipativity coupling."""
+        return len(self.node_of)
+
+    def groups_at(self, t: int) -> tuple:
+        """Non-anticipativity groups at robust stage ``t``: tuple of
+        scenario-index tuples, one per tree node, singletons included."""
+        nodes: dict = {}
+        for s, node in enumerate(self.node_of[t]):
+            nodes.setdefault(node, []).append(s)
+        return tuple(tuple(v) for _k, v in sorted(nodes.items()))
+
+    def validate(self, N: "int | None" = None) -> "ScenarioTree":
+        if self.n_scenarios < 1:
+            raise ValueError("a scenario tree needs >= 1 scenario")
+        if len(self.probabilities) != self.n_scenarios:
+            raise ValueError(
+                f"{len(self.probabilities)} probabilities for "
+                f"{self.n_scenarios} scenarios")
+        if abs(sum(self.probabilities) - 1.0) > 1e-9:
+            raise ValueError("scenario probabilities must sum to 1")
+        for t, nodes in enumerate(self.node_of):
+            if len(nodes) != self.n_scenarios:
+                raise ValueError(
+                    f"node_of[{t}] lists {len(nodes)} scenarios, tree "
+                    f"has {self.n_scenarios}")
+        if N is not None and self.robust_horizon > N:
+            raise ValueError(
+                f"robust horizon {self.robust_horizon} exceeds the "
+                f"{N}-interval control horizon")
+        return self
+
+
+def _uniform(n: int) -> tuple:
+    return tuple(1.0 / n for _ in range(n))
+
+
+def fan_tree(n_scenarios: int, robust_horizon: int = 1,
+             probabilities=None) -> ScenarioTree:
+    """All scenarios branch at the root: one non-anticipativity group
+    per robust stage (the classic S-fan — ``u_0..u_{R-1}`` identical
+    across every scenario, everything after free to recourse)."""
+    probs = tuple(probabilities) if probabilities is not None \
+        else _uniform(n_scenarios)
+    node_of = tuple((0,) * n_scenarios for _ in range(max(robust_horizon,
+                                                          0)))
+    return ScenarioTree(n_scenarios=int(n_scenarios), node_of=node_of,
+                        probabilities=probs).validate()
+
+
+def branching_tree(factors, probabilities=None) -> ScenarioTree:
+    """Multi-stage tree from per-stage branching factors: ``factors =
+    (3, 2)`` is 6 scenarios — every scenario shares the root control
+    ``u_0``, triples sharing the first branch share ``u_1``, and from
+    stage 2 each leaf recourses freely. Scenario ``s`` enumerates
+    branch choices lexicographically, so the stage-``t`` node id is the
+    ancestor index ``s // prod(factors[t:])``."""
+    factors = tuple(int(f) for f in factors)
+    if not factors or any(f < 1 for f in factors):
+        raise ValueError(f"branching factors must be >= 1, got {factors}")
+    n = int(np.prod(factors))
+    node_of = []
+    for t in range(len(factors)):
+        stride = int(np.prod(factors[t:], dtype=np.int64))
+        node_of.append(tuple(s // stride for s in range(n)))
+    probs = tuple(probabilities) if probabilities is not None \
+        else _uniform(n)
+    return ScenarioTree(n_scenarios=n, node_of=tuple(node_of),
+                        probabilities=probs).validate()
+
+
+def single_scenario() -> ScenarioTree:
+    """The degenerate tree: one branch, no coupling — the bitwise
+    flat-path routing case."""
+    return ScenarioTree(n_scenarios=1, node_of=(), probabilities=(1.0,))
+
+
+class TreePartition(NamedTuple):
+    """Static tree metadata of a scenario-batched KKT system: the
+    per-branch :class:`StagePartition` plus the tree and the primal
+    indices each robust stage's non-anticipativity coupling pins.
+    Hashable like its parts, so it rides static arguments unchanged.
+
+    ``na_indices`` lists, per robust stage ``t``, the tuple of
+    per-branch primal (w) indices holding ``u_t`` — the coordinates the
+    coupling rows difference across scenarios of a node group."""
+
+    base: StagePartition
+    tree: ScenarioTree
+    na_indices: tuple
+
+    @property
+    def n_scenarios(self) -> int:
+        return self.tree.n_scenarios
+
+    @property
+    def n_coupling_rows(self) -> int:
+        """Non-anticipativity equality rows of the coupled tree KKT:
+        per robust stage and node group, ``|group|-1`` pairwise pins
+        per coupled coordinate."""
+        rows = 0
+        for t in range(self.tree.robust_horizon):
+            for grp in self.tree.groups_at(t):
+                rows += (len(grp) - 1) * len(self.na_indices[t])
+        return rows
+
+
+def build_tree_partition(base: StagePartition, tree: ScenarioTree,
+                         na_indices) -> TreePartition:
+    """Validate + assemble a :class:`TreePartition`. ``na_indices``:
+    one tuple of primal indices per robust stage (must lie below
+    ``base.n_w``)."""
+    tree.validate()
+    na_indices = tuple(tuple(int(i) for i in idx) for idx in na_indices)
+    if len(na_indices) != tree.robust_horizon:
+        raise ValueError(
+            f"na_indices covers {len(na_indices)} stages, tree couples "
+            f"{tree.robust_horizon}")
+    for t, idx in enumerate(na_indices):
+        bad = [i for i in idx if not 0 <= i < base.n_w]
+        if bad:
+            raise ValueError(
+                f"na_indices[{t}] contains non-primal indices {bad} "
+                f"(n_w={base.n_w})")
+    return TreePartition(base=base, tree=tree, na_indices=na_indices)
+
+
+def tree_partition_for_ocp(ocp, tree: ScenarioTree) -> TreePartition:
+    """Tree partition for a transcribed OCP: the OCP's stage partition
+    per branch, with robust-stage controls located from the
+    transcription's decision layout (u blocks lead the flattened
+    pytree, ``ops/stagewise.build_stage_partition``)."""
+    if ocp.stage_partition is None:
+        raise ValueError(
+            f"OCP {ocp.model.__class__.__name__} carries no stage "
+            f"partition — transcribe() attaches one")
+    tree.validate(ocp.N)
+    n_u = len(ocp.control_names)
+    na_indices = tuple(
+        tuple(range(t * n_u, (t + 1) * n_u))
+        for t in range(tree.robust_horizon))
+    return build_tree_partition(ocp.stage_partition, tree, na_indices)
+
+
+# --------------------------------------------------------------------------
+# the non-anticipativity coupling layout (static numpy)
+# --------------------------------------------------------------------------
+
+def _coupling_layout(tp: TreePartition):
+    """Static rows of the coupling matrix A (m, S·M-sparse): per row a
+    (w-index, scenario, reference-scenario) pairwise pin. Returns
+    ``(idx, s_pos, s_ref)`` int arrays of length ``m`` (empty for
+    degenerate trees)."""
+    idx, s_pos, s_ref = [], [], []
+    for t in range(tp.tree.robust_horizon):
+        for grp in tp.tree.groups_at(t):
+            ref = grp[0]
+            for s in grp[1:]:
+                for i in tp.na_indices[t]:
+                    idx.append(i)
+                    s_pos.append(s)
+                    s_ref.append(ref)
+    return (np.asarray(idx, dtype=np.int64),
+            np.asarray(s_pos, dtype=np.int64),
+            np.asarray(s_ref, dtype=np.int64))
+
+
+def _apply_A(x_batch: jnp.ndarray, layout) -> jnp.ndarray:
+    """A @ x for the stacked per-scenario solution x (S, M): pairwise
+    differences at the coupled coordinates."""
+    idx, s_pos, s_ref = layout
+    return x_batch[s_pos, idx] - x_batch[s_ref, idx]
+
+
+def _apply_AT(nu: jnp.ndarray, layout, n_scenarios: int,
+              n_total: int) -> jnp.ndarray:
+    """Aᵀ @ ν scattered into a (S, M) right-hand-side stack."""
+    idx, s_pos, s_ref = layout
+    flat = jnp.zeros((n_scenarios * n_total,), nu.dtype)
+    flat = flat.at[s_pos * n_total + idx].add(nu)
+    flat = flat.at[s_ref * n_total + idx].add(-nu)
+    return flat.reshape(n_scenarios, n_total)
+
+
+# --------------------------------------------------------------------------
+# tree factor / resolve (mirrors factor_kkt_stage / resolve_kkt_stage)
+# --------------------------------------------------------------------------
+
+def factor_kkt_tree(K_batch: jnp.ndarray, tp: TreePartition,
+                    delta_c: float = 1e-8):
+    """Factor the non-anticipativity-coupled tree KKT system
+
+        [[blkdiag(K_s), Aᵀ], [A, -δ_c I]]
+
+    given the per-scenario stacks ``K_batch`` (S, M, M): S independent
+    stage sweeps (one vmap) plus the coupling Schur complement
+    ``S_c = A K⁻¹ Aᵀ + δ_c I`` — SPD because A touches primal
+    coordinates only and the primal block of a quasi-definite inverse
+    is positive definite — factored dense once (``m`` is the thin
+    coupling dimension, horizon- and scenario-local). Degenerate trees
+    (1 scenario, or no coupled stages) skip the Schur complement
+    entirely and the S=1 stack routes through the flat sweep bit for
+    bit."""
+    S = tp.n_scenarios
+    if K_batch.shape[0] != S:
+        raise ValueError(
+            f"K_batch has {K_batch.shape[0]} scenarios, partition "
+            f"describes {S}")
+    F = factor_kkt_scenarios(K_batch, tp.base)
+    layout = _coupling_layout(tp)
+    m = layout[0].shape[0]
+    if m == 0:
+        return (F, None, None)
+    # columns of K⁻¹ Aᵀ, via m coupled-unit-vector resolves against the
+    # scenario-separable factors (each resolve is itself refined)
+    def col(r):
+        rhs = _apply_AT(jnp.zeros((m,), K_batch.dtype).at[r].set(1.0),
+                        layout, S, tp.base.n_total)
+        return resolve_kkt_scenarios(F, rhs, tp.base)
+
+    KinvAT = jax.vmap(col)(jnp.arange(m))          # (m, S, M)
+    Sc = jax.vmap(lambda X: _apply_A(X, layout))(KinvAT)   # (m, m)
+    Sc = 0.5 * (Sc + Sc.T) + delta_c * jnp.eye(m, dtype=K_batch.dtype)
+    Fc = kkt_ops.ldl_factor(Sc)
+    return (F, Fc, KinvAT)
+
+
+def resolve_kkt_tree(factor, rhs_batch: jnp.ndarray, tp: TreePartition,
+                     refine_steps: int = 2) -> jnp.ndarray:
+    """Solve the coupled tree system for a new right-hand-side stack
+    (S, M) (coupling rows' rhs is 0 — the non-anticipativity target):
+    block elimination through the stored factors,
+
+        ν = S_c⁻¹ A K⁻¹ b,   x = K⁻¹ (b − Aᵀ ν).
+    """
+    F, Fc, _KinvAT = factor
+    x = resolve_kkt_scenarios(F, rhs_batch, tp.base, refine_steps)
+    if Fc is None:
+        return x
+    layout = _coupling_layout(tp)
+    nu = kkt_ops.ldl_solve(Fc, _apply_A(x, layout))
+    corr = _apply_AT(nu, layout, tp.n_scenarios, tp.base.n_total)
+    return x - resolve_kkt_scenarios(F, corr, tp.base, refine_steps)
+
+
+def solve_kkt_tree(K_batch: jnp.ndarray, rhs_batch: jnp.ndarray,
+                   tp: TreePartition, refine_steps: int = 2,
+                   delta_c: float = 1e-8) -> jnp.ndarray:
+    """Factor + resolve in one call — the tree analogue of
+    :func:`~agentlib_mpc_tpu.ops.stagewise.solve_kkt_stage`."""
+    return resolve_kkt_tree(factor_kkt_tree(K_batch, tp, delta_c),
+                            rhs_batch, tp, refine_steps)
+
+
+def synthetic_tree_kkt(tp: TreePartition, seed: int = 0, dtype=None):
+    """Per-scenario synthetic banded quasi-definite stacks (S, M, M) +
+    right-hand sides (S, M) — the probe/benchmark workload; each branch
+    draws its own seed so the batch is not a trivial broadcast."""
+    Ks, rhs = [], []
+    for s in range(tp.n_scenarios):
+        K_s, r_s = synthetic_stage_kkt(tp.base, seed=seed + s,
+                                       dtype=dtype)
+        Ks.append(K_s)
+        rhs.append(r_s)
+    return np.stack(Ks), np.stack(rhs)
+
+
+_TREE_PROBE: dict = {}
+
+
+def tree_method_available(tp: TreePartition) -> bool:
+    """Eager once-per-(backend, partition) probe of the coupled tree
+    solve at the production shape — the safety net
+    :func:`~agentlib_mpc_tpu.ops.stagewise.stage_method_available`
+    provides for the flat sweep, extended to the coupling Schur path.
+    Checks the residual of the FULL coupled system, non-anticipativity
+    rows included."""
+    key = (jax.default_backend(), tp)
+    if key in _TREE_PROBE:
+        return _TREE_PROBE[key]
+    try:
+        K, rhs = synthetic_tree_kkt(tp)
+        layout = _coupling_layout(tp)
+        # at the coupled coordinates the residual K x − b equals −Aᵀν
+        # by construction (the coupling force) — check the K-residual
+        # OFF them, and the constraint A x = 0 ON them
+        coupled = np.zeros(rhs.shape, dtype=bool)
+        if layout[0].shape[0]:
+            idx, s_pos, s_ref = layout
+            coupled[s_pos, idx] = True
+            coupled[s_ref, idx] = True
+
+        def _probe():
+            Kj = jnp.asarray(K)
+            rj = jnp.asarray(rhs)
+            x = solve_kkt_tree(Kj, rj, tp)
+            r = jnp.einsum("sij,sj->si", Kj, x, precision=_HI) - rj
+            res = jnp.max(jnp.abs(
+                jnp.where(jnp.asarray(coupled), 0.0, r)))
+            if layout[0].shape[0]:
+                res = jnp.maximum(res, jnp.max(jnp.abs(
+                    _apply_A(x, layout))))
+            return bool(jnp.isfinite(res) and res < 1e-3)  # lint: ignore[jit-host-sync]
+
+        ok = kkt_ops.run_probe_outside_trace(_probe)
+    except Exception:  # noqa: BLE001 — any compile/runtime failure
+        ok = False
+    _TREE_PROBE[key] = ok
+    return ok
+
+
+# --------------------------------------------------------------------------
+# extended structure certification (the PR 5 authority pattern)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TreeStructureCertificate:
+    """The stage-structure certificate extended to a scenario tree: the
+    branches share ONE traced structure (branch data is theta, not
+    structure), so one flat certification answers for every branch; the
+    tree fields record what that proof now covers. ``ok`` gates the
+    tree-banded derivative/KKT path exactly like the flat certificate
+    gates the flat one — refuted or unknown structure routes every
+    branch dense, loudly."""
+
+    base: "object"                 # lint.jaxpr.structure.StructureCertificate
+    n_scenarios: int
+    robust_horizon: int
+    n_coupling_rows: int
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.base.ok)
+
+    def describe(self) -> str:
+        return (f"{self.base.describe()} x {self.n_scenarios} "
+                f"scenario branch(es), {self.n_coupling_rows} "
+                f"non-anticipativity row(s) over "
+                f"{self.robust_horizon} robust stage(s)")
+
+
+def certify_tree_structure(nlp, theta, n_w: int,
+                           tp: TreePartition) -> TreeStructureCertificate:
+    """Prove the per-branch KKT structure once for the whole tree (the
+    branches share the traced functions; scenario data rides theta).
+    The coupling rows need no proof — their layout is constructed
+    static selector rows, banded by inspection."""
+    from agentlib_mpc_tpu.lint.jaxpr import certify_stage_structure
+
+    base = certify_stage_structure(nlp, theta, n_w, tp.base)
+    return TreeStructureCertificate(
+        base=base, n_scenarios=tp.n_scenarios,
+        robust_horizon=tp.tree.robust_horizon,
+        n_coupling_rows=tp.n_coupling_rows)

@@ -92,6 +92,40 @@ def read_try_file(path: str | Path):
     return pd.DataFrame(out, index=index)
 
 
+def try_forecast_ensemble(df, column: str, t0: float, horizon_steps: int,
+                          n_scenarios: int, seed: int = 0,
+                          spread: "float | None" = None,
+                          dt: float = _HOUR) -> np.ndarray:
+    """Batched forecast ensemble from a parsed TRY table: ``(S,
+    horizon_steps)`` trajectories of ``column`` starting at ``t0``
+    (seconds on the table's index) on a ``dt`` grid — row 0 the nominal
+    interpolated series, rows 1.. seeded random-walk perturbations from
+    the chaos harness's :func:`~agentlib_mpc_tpu.resilience.chaos.
+    disturbance_model` (one deterministic source for scenario
+    generation AND chaos replays; equal arguments reproduce the
+    identical ensemble). ``spread`` is the per-step walk sigma; None
+    defaults to 5% of the window's peak-to-peak range.
+
+    The rows plug straight into
+    :func:`agentlib_mpc_tpu.scenario.generate.scenario_thetas` as one
+    exogenous channel's per-scenario ``d_traj`` column."""
+    from agentlib_mpc_tpu.resilience.chaos import disturbance_model
+
+    if column not in df.columns:
+        raise KeyError(
+            f"column {column!r} not in the TRY table "
+            f"({sorted(df.columns)})")
+    grid = float(t0) + np.arange(int(horizon_steps)) * float(dt)
+    base = np.interp(grid, np.asarray(df.index, dtype=float),
+                     np.asarray(df[column], dtype=float))
+    sigma = float(spread) if spread is not None else \
+        0.05 * float(np.ptp(base)) if base.size else 0.0
+    draws = disturbance_model(
+        seed=seed + int(t0), horizon=base.shape[0],
+        n_scenarios=int(n_scenarios), scale=sigma, kind="walk")
+    return base[None, :] + draws[:, :, 0]
+
+
 def is_try_file(path) -> bool:
     """Cheap sniff: TRY files are ``.dat`` with a ``***`` header separator
     in their first ~60 lines."""

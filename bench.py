@@ -48,6 +48,13 @@ Modes:
                                 # 8-device mesh (virtual on CPU) —
                                 # per-zone step cost + consensus
                                 # identity; keys carry a d<n> qualifier
+    python bench.py --scenario-ab [S] [n]   # batched-S vs serial-S
+                                # scenario-tree robust A/B: one fused
+                                # ScenarioFleet round (vmapped scenario
+                                # axis, non-anticipativity on u0) vs S
+                                # branch-at-a-time rounds (the reference
+                                # pattern); identity-gated, keys carry
+                                # platform + d<n> qualifiers
     python bench.py --profile [dir] [n]   # XLA profiler trace of the
                                 # warm n-zone step (default 256;
                                 # --profile DIR 1024 = the sub-linearity
@@ -955,6 +962,168 @@ def run_mesh_ab(sizes=(256, 1024), device_counts=(1, 8)) -> list[dict]:
                   f"per-zone={row['per_zone_us']:7.1f}us  "
                   f"compile={compile_ms:.0f}ms", file=sys.stderr)
             del engine, state
+    return rows
+
+
+def run_scenario_ab(n_scenarios: int = 8, n_agents: int = 4,
+                    seed: int = 0) -> list[dict]:
+    """``--scenario-ab [S]``: batched-S-vs-serial-S robust scenario cost
+    scaling (ISSUE 12 acceptance row).
+
+    The SAME zone workload solves its S disturbance scenarios (seeded
+    load perturbations from the chaos sampler — scenario 0 nominal) two
+    ways: (a) **serial** — S single-scenario rounds back to back, the
+    reference's branch-at-a-time scenario handling; (b) **batched** —
+    one :class:`~agentlib_mpc_tpu.scenario.fleet.ScenarioFleet` round
+    with the scenario axis vmapped. Per-scenario warm cost is the
+    headline column. Identity gate: the UNCOUPLED batched run (fan tree
+    with robust horizon 0 — independent branches) must reproduce the
+    serial consensus trajectories to f32 reduction noise, so the A/B
+    can never publish a fast-but-wrong number. Both identity legs run
+    with the Boyd exit tolerances pinned to ZERO (fixed iteration
+    count): the batched round's residual exit aggregates over all
+    branches and would otherwise legitimately stop at a different
+    iteration than a lone serial branch — a false identity failure on
+    a correct run (the test-suite comparison pins the same way). The
+    ROBUST batched row (non-anticipativity on u0) runs the live
+    tolerances and additionally reports ``na_spread`` — the workload
+    class the reference cannot batch at all.
+
+    Metric keys carry platform and device count (``_d<n>``) per the
+    PR 6/9 honesty rules; no CPU-fallback number can enter a TPU
+    trajectory headline.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+    from agentlib_mpc_tpu.scenario import (
+        ScenarioFleet,
+        ScenarioFleetOptions,
+        ensemble_thetas,
+        fan_tree,
+        single_scenario,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    qual = f"{platform},d{n_dev}"
+    S = int(n_scenarios)
+    ocp = zone_ocp()
+    cold = SolverOptions(**SOLVER_BASE, mu_init=COLD_MU)
+    group = AgentGroup(name="zones", ocp=ocp, n_agents=n_agents,
+                       couplings={"mDotCoolAir": "mDot"},
+                       solver_options=cold)
+    fleet_opts = ScenarioFleetOptions(
+        max_iterations=ADMM_ITERS, rho=20.0, rho_na=20.0,
+        warm_budget=WARM_BUDGET, warm_mu=WARM_MU)
+    x0s, loads = fleet_inputs(n_agents)
+
+    def agent_thetas(tree):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *[
+            ensemble_thetas(
+                ocp.default_params(
+                    x0=jnp.array([x0s[i]]),
+                    d_traj=jnp.broadcast_to(
+                        jnp.array([loads[i], 290.15, 294.15]),
+                        (HORIZON, 3))),
+                tree, seed=seed + i, scale=0.15 * loads[i],
+                channels=(0,))
+            for i in range(n_agents)])
+
+    rows: list[dict] = []
+
+    def warm_trace(fleet, thetas):
+        st = fleet.init_state(thetas)
+        st, _t, _s = fleet.step(st, thetas)
+        jax.block_until_ready(st)
+
+    def one_round(fleet, thetas):
+        """One cold-state warm-trace round — the symmetric unit both
+        legs measure (the serial leg sums S of them)."""
+        st = fleet.init_state(thetas)
+        t0 = time.perf_counter()
+        st, _t, stats = fleet.step(st, thetas)
+        jax.block_until_ready(st)
+        return st, stats, 1e3 * (time.perf_counter() - t0)
+
+    # -- serial leg: S single-scenario rounds (the reference pattern) --
+    # fixed-iteration options for the two identity legs (docstring)
+    ab_opts = fleet_opts._replace(abs_tol=0.0, rel_tol=0.0,
+                                  primal_tol=0.0, dual_tol=0.0)
+    fleet1 = ScenarioFleet(group, single_scenario(), ab_opts)
+    fan = fan_tree(S, robust_horizon=1)
+    thetas_all = agent_thetas(fan)          # (n_agents, S, ...) data
+    slice_s = lambda s: jax.tree.map(lambda l: l[:, s:s + 1], thetas_all)
+    warm_trace(fleet1, slice_s(0))
+    serial_states = []
+    serial_ms = 0.0
+    for s in range(S):
+        st, _stats, ms = one_round(fleet1, slice_s(s))
+        serial_states.append(st)
+        serial_ms += ms
+    rows.append({
+        "metric": f"scenario_ab[{S},serial,{qual}]",
+        "n_scenarios": S, "n_agents": n_agents,
+        "total_ms": round(serial_ms, 2),
+        "per_scenario_ms": round(serial_ms / S, 3),
+        "platform": platform, "devices": n_dev,
+    })
+
+    # -- batched legs: uncoupled identity gate + robust row ------------
+    free = fan_tree(S, robust_horizon=0)    # independent branches
+    fleetF = ScenarioFleet(group, free, ab_opts)
+    warm_trace(fleetF, thetas_all)
+    stF, _statsF, free_ms = one_round(fleetF, thetas_all)
+    # identity: per-scenario consensus means of the uncoupled batch vs
+    # the serial runs (same data, same iteration budget)
+    diffs = [float(jnp.max(jnp.abs(
+        stF.zbar["mDotCoolAir"][s] - serial_states[s].zbar[
+            "mDotCoolAir"][0]))) for s in range(S)]
+    identity_diff = max(diffs)
+    identity_ok = identity_diff < 1e-3
+    if not identity_ok:
+        print(f"[bench] scenario-ab S={S}: batched consensus DIVERGES "
+              f"from serial branches (max |dzbar| = {identity_diff:.3e})"
+              f" — rows marked identity_ok=false", file=sys.stderr)
+    rows.append({
+        "metric": f"scenario_ab[{S},batched,{qual}]",
+        "n_scenarios": S, "n_agents": n_agents,
+        "total_ms": round(free_ms, 2),
+        "per_scenario_ms": round(free_ms / S, 3),
+        "serial_over_batched": round(serial_ms / max(free_ms, 1e-9), 2),
+        "zbar_max_abs_diff": identity_diff,
+        "identity_ok": identity_ok,
+        "platform": platform, "devices": n_dev,
+    })
+
+    fleetR = ScenarioFleet(group, fan, fleet_opts)
+    warm_trace(fleetR, thetas_all)
+    stR, statsR, robust_ms = one_round(fleetR, thetas_all)
+    u0 = np.asarray(fleetR.actuated_u0(stR))
+    rows.append({
+        "metric": f"scenario_ab[{S},robust,{qual}]",
+        "n_scenarios": S, "n_agents": n_agents,
+        "total_ms": round(robust_ms, 2),
+        "per_scenario_ms": round(robust_ms / S, 3),
+        "iterations": int(statsR.iterations),
+        "converged": bool(statsR.converged),
+        "na_spread": float(statsR.na_spread),
+        "u0_group_identical": bool(
+            np.all(u0 == u0[:, :1])),
+        "platform": platform, "devices": n_dev,
+    })
+    for row in rows:
+        print(json.dumps(row))
+        sys.stdout.flush()
+    print(f"[bench] scenario-ab S={S}: serial={serial_ms:.1f}ms "
+          f"batched={free_ms:.1f}ms robust={robust_ms:.1f}ms "
+          f"({qual})", file=sys.stderr)
     return rows
 
 
@@ -2448,6 +2617,19 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_serve(seed, n)
+        return
+
+    if "--scenario-ab" in sys.argv:
+        # scenario-tree robust A/B, in-process like --chaos (pin
+        # JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --scenario-ab [n_scenarios] [n_agents]
+        idx = sys.argv.index("--scenario-ab")
+        S, n = 8, 4
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            S = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_scenario_ab(S, n)
         return
 
     if "--chaos-mesh" in sys.argv:

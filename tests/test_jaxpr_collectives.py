@@ -124,6 +124,39 @@ class TestReplicationLattice:
         paths = [op.loop_path for op in cert.schedule]
         assert () in paths and ("while",) in paths
 
+    def test_nested_single_axis_psums_close_on_2d_mesh(self):
+        """The per-axis lattice (ISSUE 12): on a 2-D mesh the scenario
+        fleet closes its residuals with one psum PER AXIS —
+        psum@b(psum@a(x)) must prove re-replication (the scalar lattice
+        could not represent "varies only over b" and refuted this
+        shape), the two collectives landing in two distinct families.
+        An in-spec sharded over ONE axis must also seed as replicated
+        along the other: psum over just that axis then fully rejoins."""
+        devs = np.array(jax.devices("cpu")[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("a", "b"))
+
+        def body(x, y):
+            # x sharded over both axes; y over "a" only
+            r = lax.psum(lax.psum(jnp.sum(x), "a"), "b")
+            ry = lax.psum(jnp.sum(y), "a")   # rejoins: y repl. over b
+
+            def cond(c):
+                return c[0] < 10.0           # provably replicated
+
+            def step(c):
+                v, s = c
+                return v + 1.0, s + lax.psum(v, ("a", "b"))
+
+            return lax.while_loop(cond, step, (r + ry, 0.0))[1]
+
+        sm = shard_map(body, mesh=mesh, in_specs=(P("a", "b"), P("a")),
+                       out_specs=P(), check_rep=False)
+        cert = certify_collectives(sm, jnp.zeros((4, 4)),
+                                   jnp.zeros((4, 2)))
+        assert cert.proved, cert.refutations
+        fams = cert.families()
+        assert "0:psum@a" in fams and "0:psum@b" in fams
+
     def test_varying_cond_over_collective_refuted(self):
         mesh = _mesh()
 

@@ -1,0 +1,341 @@
+"""Scenario-tree subsystem (ISSUE 12): tree metadata, the coupled tree
+KKT solve, the scenario-batched ops paths, generation determinism.
+
+The load-bearing contract is the DEGENERATE case: a single-scenario
+tree must route through the flat single-scenario machinery bit for bit
+(factor, resolve, and full solve_nlp), so the tree axis can never
+silently diverge from the proven flat paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+from agentlib_mpc_tpu.ops.stagewise import (
+    build_stage_partition,
+    factor_kkt_scenarios,
+    factor_kkt_stage,
+    resolve_kkt_scenarios,
+    resolve_kkt_stage,
+)
+from agentlib_mpc_tpu.resilience.chaos import disturbance_model
+from agentlib_mpc_tpu.scenario import (
+    branching_tree,
+    build_tree_partition,
+    certify_tree_structure,
+    fan_tree,
+    single_scenario,
+    solve_kkt_tree,
+    solve_nlp_scenarios,
+    synthetic_tree_kkt,
+    tree_method_available,
+    tree_partition_for_ocp,
+)
+from agentlib_mpc_tpu.scenario.generate import (
+    ensemble_thetas,
+    scenario_thetas,
+)
+from agentlib_mpc_tpu.scenario.tree import _apply_A, _coupling_layout
+
+
+@pytest.fixture(scope="module")
+def partition():
+    return build_stage_partition(N=4, n_x=2, n_u=1, n_z=0, d=0,
+                                 method="multiple_shooting")
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+class TestScenarioTree:
+    def test_fan_tree_groups(self):
+        t = fan_tree(4, robust_horizon=2)
+        assert t.n_scenarios == 4 and t.robust_horizon == 2
+        assert t.groups_at(0) == ((0, 1, 2, 3),)
+        assert t.groups_at(1) == ((0, 1, 2, 3),)
+        assert sum(t.probabilities) == pytest.approx(1.0)
+
+    def test_branching_tree_nodes(self):
+        t = branching_tree((2, 2))
+        assert t.n_scenarios == 4 and t.robust_horizon == 2
+        # u_0 shared by all; u_1 shared within each first-branch pair
+        assert t.groups_at(0) == ((0, 1, 2, 3),)
+        assert t.groups_at(1) == ((0, 1), (2, 3))
+
+    def test_single_scenario_degenerate(self):
+        t = single_scenario()
+        assert t.n_scenarios == 1 and t.robust_horizon == 0
+
+    def test_validate_rejects_bad_probabilities(self):
+        with pytest.raises(ValueError, match="sum to 1"):
+            fan_tree(2, probabilities=(0.9, 0.9))
+
+    def test_validate_rejects_deep_robust_horizon(self, ocp):
+        with pytest.raises(ValueError, match="robust horizon"):
+            tree_partition_for_ocp(ocp, fan_tree(2, robust_horizon=99))
+
+
+class TestTreePartition:
+    def test_from_ocp(self, ocp):
+        tp = tree_partition_for_ocp(ocp, fan_tree(3, robust_horizon=2))
+        assert tp.n_scenarios == 3
+        # (3-1) scenarios pinned per stage x 1 control x 2 stages
+        assert tp.n_coupling_rows == 4
+        assert tp.na_indices == ((0,), (1,))
+
+    def test_rejects_non_primal_indices(self, partition):
+        with pytest.raises(ValueError, match="non-primal"):
+            build_tree_partition(partition, fan_tree(2),
+                                 ((partition.n_w + 1,),))
+
+    def test_hashable_static_metadata(self, ocp):
+        tp = tree_partition_for_ocp(ocp, fan_tree(2))
+        assert hash(tp) == hash(
+            tree_partition_for_ocp(ocp, fan_tree(2)))
+
+
+class TestTreeKKT:
+    def test_degenerate_routes_flat_bitwise(self, partition):
+        """factor + resolve of a 1-scenario tree == the flat stage
+        sweep, bit for bit (not a 1-lane vmap)."""
+        tp = build_tree_partition(partition, single_scenario(), ())
+        K, rhs = synthetic_tree_kkt(tp, seed=3)
+        x_tree = solve_kkt_tree(jnp.asarray(K), jnp.asarray(rhs), tp)
+        f = factor_kkt_stage(jnp.asarray(K[0]), partition)
+        x_flat = resolve_kkt_stage(f, jnp.asarray(rhs[0]), partition)
+        assert bool(jnp.all(x_tree[0] == x_flat))
+
+    def test_coupled_solve_matches_dense_reference(self, partition):
+        """The scenario-sweep + non-anticipativity-Schur factorization
+        equals a dense solve of the full coupled system."""
+        tree = fan_tree(3, robust_horizon=2)
+        tp = build_tree_partition(partition, tree, ((0,), (1,)))
+        K, rhs = synthetic_tree_kkt(tp, seed=5)
+        delta = 1e-10
+        x = np.asarray(solve_kkt_tree(jnp.asarray(K), jnp.asarray(rhs),
+                                      tp, delta_c=delta))
+        S, M = rhs.shape
+        idx, s_pos, s_ref = _coupling_layout(tp)
+        m = idx.shape[0]
+        A = np.zeros((m, S * M))
+        for r in range(m):
+            A[r, s_pos[r] * M + idx[r]] = 1.0
+            A[r, s_ref[r] * M + idx[r]] = -1.0
+        big = np.zeros((S * M + m, S * M + m))
+        for s in range(S):
+            big[s * M:(s + 1) * M, s * M:(s + 1) * M] = K[s]
+        big[S * M:, :S * M] = A
+        big[:S * M, S * M:] = A.T
+        big[S * M:, S * M:] = -delta * np.eye(m)
+        ref = np.linalg.solve(big, np.concatenate(
+            [rhs.reshape(-1), np.zeros(m)]))
+        np.testing.assert_allclose(x.reshape(-1), ref[:S * M],
+                                   rtol=1e-5, atol=1e-6)
+        # non-anticipativity holds on the solution itself
+        assert float(np.max(np.abs(np.asarray(
+            _apply_A(jnp.asarray(x), (idx, s_pos, s_ref)))))) < 1e-6
+
+    def test_probe_available(self, partition):
+        tp = build_tree_partition(partition, fan_tree(2), ((0,),))
+        assert tree_method_available(tp)
+
+
+class TestScenarioBatchedSweep:
+    def test_single_scenario_bitwise(self, partition):
+        tp = build_tree_partition(partition, single_scenario(), ())
+        K, rhs = synthetic_tree_kkt(tp, seed=11)
+        f_b = factor_kkt_scenarios(jnp.asarray(K), partition)
+        assert f_b[0] == "flat"
+        x_b = resolve_kkt_scenarios(f_b, jnp.asarray(rhs), partition)
+        f = factor_kkt_stage(jnp.asarray(K[0]), partition)
+        x = resolve_kkt_stage(f, jnp.asarray(rhs[0]), partition)
+        assert bool(jnp.all(x_b[0] == x))
+
+    def test_batch_matches_per_scenario_flat(self, partition):
+        tp = build_tree_partition(partition, fan_tree(3), ((0,),))
+        K, rhs = synthetic_tree_kkt(tp, seed=13)
+        f_b = factor_kkt_scenarios(jnp.asarray(K), partition)
+        x_b = resolve_kkt_scenarios(f_b, jnp.asarray(rhs), partition)
+        for s in range(3):
+            f = factor_kkt_stage(jnp.asarray(K[s]), partition)
+            x = resolve_kkt_stage(f, jnp.asarray(rhs[s]), partition)
+            np.testing.assert_allclose(np.asarray(x_b[s]), np.asarray(x),
+                                       rtol=1e-9, atol=1e-9)
+
+
+class TestTreeStructureCertificate:
+    def test_proved_for_transcribed_ocp(self, ocp):
+        tp = tree_partition_for_ocp(ocp, fan_tree(3, robust_horizon=1))
+        theta = ocp.default_params()
+        cert = certify_tree_structure(ocp.nlp, theta, ocp.n_w, tp)
+        assert cert.ok
+        assert cert.n_scenarios == 3
+        assert cert.n_coupling_rows == 2
+        assert "scenario branch" in cert.describe()
+
+    def test_tree_plan_shares_flat_seeds(self, ocp):
+        from agentlib_mpc_tpu.ops.stagejac import (
+            plan_from_certificate,
+            tree_plan_from_certificate,
+        )
+
+        tp = tree_partition_for_ocp(ocp, fan_tree(2, robust_horizon=1))
+        theta = ocp.default_params()
+        plan_tree = tree_plan_from_certificate(ocp.nlp, theta, ocp.n_w,
+                                               tp)
+        plan_flat = plan_from_certificate(ocp.nlp, theta, ocp.n_w,
+                                          tp.base)
+        assert plan_tree is not None
+        # one proof, one seed set: the memoized flat plan IS the tree's
+        assert plan_tree is plan_flat
+
+
+class TestSolveNlpScenarios:
+    def _problem(self, ocp, n_scenarios):
+        thetas = [ocp.default_params(p=jnp.array([float(s + 1)]))
+                  for s in range(n_scenarios)]
+        theta_b = jax.tree.map(lambda *xs: jnp.stack(xs), *thetas)
+        w0 = jnp.stack([ocp.initial_guess(t) for t in thetas])
+        lbub = [ocp.bounds(t) for t in thetas]
+        lb = jnp.stack([b[0] for b in lbub])
+        ub = jnp.stack([b[1] for b in lbub])
+        return theta_b, w0, lb, ub
+
+    def test_degenerate_bitwise_flat_solve(self, ocp):
+        theta_b, w0, lb, ub = self._problem(ocp, 1)
+        opts = SolverOptions(max_iter=25)
+        res_b = solve_nlp_scenarios(ocp.nlp, w0, theta_b, lb, ub, opts,
+                                    tree=single_scenario())
+        res = solve_nlp(ocp.nlp, w0[0],
+                        jax.tree.map(lambda l: l[0], theta_b),
+                        lb[0], ub[0], opts)
+        assert bool(jnp.all(res_b.w[0] == res.w))
+        assert bool(jnp.all(res_b.y[0] == res.y))
+        assert bool(jnp.all(res_b.z[0] == res.z))
+
+    def test_batched_matches_serial_solves(self, ocp):
+        """Acceptance: the S-scenario batched solve matches S
+        independent serial solves to solver tolerance."""
+        S = 3
+        theta_b, w0, lb, ub = self._problem(ocp, S)
+        opts = SolverOptions(max_iter=25)
+        res_b = solve_nlp_scenarios(ocp.nlp, w0, theta_b, lb, ub, opts,
+                                    tree=fan_tree(S, robust_horizon=0))
+        for s in range(S):
+            res = solve_nlp(ocp.nlp, w0[s],
+                            jax.tree.map(lambda l, s=s: l[s], theta_b),
+                            lb[s], ub[s], opts)
+            np.testing.assert_allclose(np.asarray(res_b.w[s]),
+                                       np.asarray(res.w),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_tree_size_mismatch_rejected(self, ocp):
+        theta_b, w0, lb, ub = self._problem(ocp, 2)
+        with pytest.raises(ValueError, match="scenarios"):
+            solve_nlp_scenarios(ocp.nlp, w0, theta_b, lb, ub,
+                                SolverOptions(), tree=fan_tree(3))
+
+
+class TestGenerationDeterminism:
+    def test_disturbance_model_deterministic(self):
+        a = disturbance_model(7, 10, 4, scale=0.5)
+        b = disturbance_model(7, 10, 4, scale=0.5)
+        np.testing.assert_array_equal(a, b)
+        c = disturbance_model(8, 10, 4, scale=0.5)
+        assert np.any(a != c)
+        assert a.shape == (4, 10, 1)
+        np.testing.assert_array_equal(a[0], 0.0)  # nominal row
+
+    def test_walk_kind_accumulates(self):
+        g = disturbance_model(1, 50, 2, scale=1.0, kind="gaussian",
+                              nominal_first=False)
+        w = disturbance_model(1, 50, 2, scale=1.0, kind="walk",
+                              nominal_first=False)
+        np.testing.assert_allclose(np.cumsum(g, axis=1), w)
+
+    def test_scenario_thetas_perturbs_channels(self, ocp):
+        theta = ocp.default_params()
+        tree = fan_tree(3)
+        batched = ensemble_thetas(theta, tree, seed=3, scale=1.0)
+        # tracker has no exogenous channels: pure broadcast stack
+        assert batched.p.shape == (3,) + tuple(theta.p.shape)
+        np.testing.assert_array_equal(np.asarray(batched.d_traj),
+                                      np.broadcast_to(
+                                          np.asarray(theta.d_traj),
+                                          batched.d_traj.shape))
+
+    def test_scenario_thetas_rejects_bad_channel(self, ocp):
+        theta = ocp.default_params()
+        draws = np.zeros((2, ocp.N, 1))
+        with pytest.raises(ValueError, match="outside d_traj"):
+            scenario_thetas(theta, fan_tree(2), draws, channels=(5,))
+
+    def test_predictor_ensemble_deterministic(self):
+        from agentlib_mpc_tpu.modules.input_prediction import (
+            InputPredictor,
+        )
+
+        class _Host:
+            """Minimal agent stand-in (the test_aux_modules pattern)."""
+
+            id = "weather"
+
+            class _Env:
+                now = 0.0
+
+            class _Broker:
+                def register_callback(self, *a, **k):
+                    pass
+
+                def send_variable(self, v):
+                    pass
+
+            env = _Env()
+            data_broker = _Broker()
+
+        table = {"T_amb": {float(t): 280.0 + t / 100.0
+                           for t in range(0, 7200, 600)}}
+        mod = InputPredictor({"module_id": "weather", "data": table,
+                              "t_sample": 600,
+                              "prediction_horizon": 1800,
+                              "prediction_sample": 600}, _Host())
+        a = mod.get_prediction_ensemble_at_time(1200.0, 4, seed=5)
+        b = mod.get_prediction_ensemble_at_time(1200.0, 4, seed=5)
+        assert a.keys() == b.keys() == {"T_amb"}
+        times_a, vals_a = a["T_amb"]
+        times_b, vals_b = b["T_amb"]
+        assert times_a == times_b
+        np.testing.assert_array_equal(vals_a, vals_b)
+        vals_a = np.asarray(vals_a)
+        assert vals_a.shape == (4, 4)
+        # row 0 is the nominal forecast
+        nominal = np.asarray(mod.get_prediction_at_time(1200.0)
+                             ["T_amb"][1])
+        np.testing.assert_allclose(vals_a[0], nominal)
+        # perturbed rows actually differ
+        assert np.any(vals_a[1:] != vals_a[0])
+
+    def test_try_forecast_ensemble_deterministic(self):
+        pd = pytest.importorskip("pandas")
+        from agentlib_mpc_tpu.utils.try_format import (
+            try_forecast_ensemble,
+        )
+
+        idx = np.arange(24) * 3600.0
+        df = pd.DataFrame({"T_oda": 273.15 + 10 * np.sin(idx / 7e3)},
+                          index=idx)
+        a = try_forecast_ensemble(df, "T_oda", 3600.0, 6, 3, seed=2)
+        b = try_forecast_ensemble(df, "T_oda", 3600.0, 6, 3, seed=2)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (3, 6)
+        np.testing.assert_allclose(
+            a[0], np.interp(3600.0 + np.arange(6) * 3600.0, idx,
+                            df["T_oda"].to_numpy()))
+        with pytest.raises(KeyError):
+            try_forecast_ensemble(df, "nope", 0.0, 4, 2)

@@ -1,0 +1,264 @@
+"""ScenarioFleet (ISSUE 12): the fused robust round over the 2-D
+(agents × scenarios) axis pair — correctness against serial branches,
+non-anticipativity, and the two-psum-family collective certification.
+
+Engine builds dominate the cost; everything reusable is module-scoped.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+    check_collective_budget,
+)
+from agentlib_mpc_tpu.lint.retrace_budget import load_budgets, tracker_ocp
+from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+from agentlib_mpc_tpu.parallel.multihost import fleet_mesh, scenario_mesh
+from agentlib_mpc_tpu.scenario import (
+    ScenarioFleet,
+    ScenarioFleetOptions,
+    fan_tree,
+    single_scenario,
+)
+
+N_AGENTS = 4
+N_SCEN = 4
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+@pytest.fixture(scope="module")
+def group(ocp):
+    return AgentGroup(name="scenario-test", ocp=ocp, n_agents=N_AGENTS,
+                      couplings={"shared_u": "u"},
+                      solver_options=SolverOptions(max_iter=30))
+
+
+def _thetas(ocp, n_agents=N_AGENTS, n_scen=N_SCEN, spread=0.5):
+    """(n_agents, S) tracker targets: agent base a_i = i+1, scenario s
+    offset by s*spread — genuinely different branch problems."""
+    rows = []
+    for i in range(n_agents):
+        rows.append(jax.tree.map(lambda *xs: jnp.stack(xs), *[
+            ocp.default_params(
+                p=jnp.array([float(i + 1) + spread * s]))
+            for s in range(n_scen)]))
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+
+OPTS = ScenarioFleetOptions(max_iterations=12, rho=2.0, rho_na=4.0)
+
+
+@pytest.fixture(scope="module")
+def coupled_fleet(group):
+    return ScenarioFleet(group, fan_tree(N_SCEN, robust_horizon=1), OPTS)
+
+
+class TestBatchedVsSerial:
+    def test_uncoupled_batch_matches_serial_branches(self, group, ocp):
+        """Acceptance: the S-scenario batched round equals S serial
+        single-scenario rounds of the per-branch problems (no
+        non-anticipativity — independent branches). Tolerances are
+        pinned to ZERO so both runs execute the identical fixed
+        iteration count — the batched round's residual exit aggregates
+        over all branches and would otherwise stop at a different
+        iteration than a lone branch."""
+        opts = OPTS._replace(abs_tol=0.0, rel_tol=0.0, primal_tol=0.0,
+                             dual_tol=0.0)
+        thetas = _thetas(ocp)
+        free = ScenarioFleet(group, fan_tree(N_SCEN, robust_horizon=0),
+                             opts)
+        st = free.init_state(thetas)
+        st, trajs, stats = free.step(st, thetas)
+        serial = ScenarioFleet(group, single_scenario(), opts)
+        for s in range(N_SCEN):
+            th_s = jax.tree.map(lambda l, s=s: l[:, s:s + 1], thetas)
+            st_s = serial.init_state(th_s)
+            st_s, trajs_s, _ = serial.step(st_s, th_s)
+            np.testing.assert_allclose(
+                np.asarray(st.zbar["shared_u"][s]),
+                np.asarray(st_s.zbar["shared_u"][0]),
+                rtol=1e-6, atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(st.w[:, s]), np.asarray(st_s.w[:, 0]),
+                rtol=1e-5, atol=1e-6)
+
+    def test_non_anticipativity_holds(self, ocp):
+        """Acceptance: the actuated u0 is identical across every
+        scenario of a group — exactly for the projection, and the raw
+        branch controls agree to ADMM tolerance."""
+        # no agent coupling: isolate the scenario coupling's physics
+        group = AgentGroup(name="na-test", ocp=ocp, n_agents=2,
+                           solver_options=SolverOptions(max_iter=30))
+        fleet = ScenarioFleet(
+            group, fan_tree(N_SCEN, robust_horizon=1),
+            ScenarioFleetOptions(max_iterations=25, rho_na=4.0,
+                                 abs_tol=1e-6, rel_tol=1e-5))
+        thetas = _thetas(ocp, n_agents=2)
+        st = fleet.init_state(thetas)
+        st, trajs, stats = fleet.step(st, thetas)
+        u0 = np.asarray(fleet.actuated_u0(st))    # (n_agents, S, n_u)
+        # the projection is group-identical BY CONSTRUCTION
+        np.testing.assert_array_equal(u0, np.broadcast_to(
+            u0[:, :1], u0.shape))
+        # ... and the raw branch controls actually converged onto it
+        u_raw = np.asarray(jax.vmap(jax.vmap(
+            lambda w: fleet.group.ocp.unflatten(w)["u"]))(st.w))
+        spread = np.max(np.abs(u_raw[:, :, 0, :] - u0))
+        assert spread < 1e-3
+        rel = spread / max(np.max(np.abs(u0)), 1e-12)
+        assert rel < 1e-3
+        # tracker analytics: every scenario wants u == a_s; the shared
+        # first interval lands on the scenario mean, later intervals
+        # recourse to their own target
+        a = np.asarray(thetas.p)[:, :, 0]
+        np.testing.assert_allclose(u0[:, 0, 0], a.mean(axis=1),
+                                   atol=1e-3)
+        np.testing.assert_allclose(u_raw[:, :, -1, 0], a, atol=1e-3)
+        assert float(stats.na_spread) < 1e-3
+
+    def test_spread_zero_for_identical_branches(self, coupled_fleet,
+                                                ocp):
+        thetas = _thetas(ocp, spread=0.0)
+        st = coupled_fleet.init_state(thetas)
+        st, _trajs, stats = coupled_fleet.step(st, thetas)
+        assert float(stats.na_spread) < 1e-9
+
+
+class TestMeshAndCertification:
+    @pytest.fixture(scope="class")
+    def mesh2d(self, eight_devices):
+        return scenario_mesh(2, devices=eight_devices)
+
+    @pytest.fixture(scope="class")
+    def mesh_fleet(self, group, mesh2d):
+        return ScenarioFleet(group, fan_tree(N_SCEN, robust_horizon=1),
+                             OPTS, mesh=mesh2d)
+
+    def test_two_psum_families_proved(self, mesh_fleet):
+        """Acceptance: the 2-D round's certificate proves EXACTLY two
+        per-iteration psum families — agents + scenarios."""
+        cert = mesh_fleet.collective_certificate
+        assert cert is not None and cert.proved
+        fams = cert.families()
+        assert sorted(fams) == ["1:psum@agents", "1:psum@scenarios"]
+        assert mesh_fleet.collective_schedule_digest \
+            == cert.schedule_digest is not None
+
+    def test_budget_pin_matches_checked_in_toml(self, mesh_fleet):
+        """Gate-as-test: the [jaxpr.collectives.scenario] pin holds for
+        the real engine (a budget drifting from the code fails here)."""
+        cfg = load_budgets().get("jaxpr", {}).get(
+            "collectives", {}).get("scenario", {})
+        assert cfg, "[jaxpr.collectives.scenario] missing from " \
+                    "lint_budgets.toml"
+        assert check_collective_budget(
+            mesh_fleet.collective_certificate, cfg) == []
+
+    def test_degenerate_engine_certifies_one_family(self, group,
+                                                    eight_devices):
+        """Acceptance: the single-scenario engine's schedule is the
+        one-family shape of today's agent fleet — no scenario
+        collectives are traced at all."""
+        fleet = ScenarioFleet(
+            group, single_scenario(), OPTS,
+            mesh=fleet_mesh(devices=eight_devices[:4]))
+        cert = fleet.collective_certificate
+        assert cert.proved
+        assert sorted(cert.families()) == ["1:psum@agents"]
+
+    def test_mesh_matches_single_device(self, mesh_fleet, coupled_fleet,
+                                        mesh2d, ocp):
+        thetas = _thetas(ocp)
+        st1 = coupled_fleet.init_state(thetas)
+        st1, _t, _s = coupled_fleet.step(st1, thetas)
+        stm = mesh_fleet.init_state(thetas)
+        stm, th_m = mesh_fleet.shard_args(mesh2d, stm, thetas)
+        stm, _tm, _sm = mesh_fleet.step(stm, th_m)
+        np.testing.assert_allclose(
+            np.asarray(stm.zbar["shared_u"]),
+            np.asarray(st1.zbar["shared_u"]), rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(mesh_fleet.actuated_u0(stm)),
+            np.broadcast_to(np.asarray(
+                mesh_fleet.actuated_u0(stm))[:, :1],
+                (N_AGENTS, N_SCEN, 1)))
+
+    def test_injected_third_family_fails_budget(self, group, mesh2d,
+                                                monkeypatch):
+        """Mutation gate over the new axis: a collective family slipped
+        into the round under a NEW axes combination must fail the
+        [jaxpr.collectives.scenario] check as an UNBUDGETED family,
+        naming the offending equation."""
+        real = admm_ops.consensus_update
+
+        def sabotaged(locals_, state, active=None, axis_name=None):
+            new_state, res = real(locals_, state, active=active,
+                                  axis_name=axis_name)
+            extra = lax.psum(jnp.sum(locals_ ** 3),
+                             ("agents", "scenarios"))
+            return new_state, res._replace(primal=res.primal
+                                           + 0.0 * extra)
+
+        monkeypatch.setattr(admm_ops, "consensus_update", sabotaged)
+        fleet = ScenarioFleet(group,
+                              fan_tree(N_SCEN, robust_horizon=1),
+                              OPTS, mesh=mesh2d)
+        cert = fleet.collective_certificate
+        assert cert.proved      # uniform control flow — the hazard is
+        # the schedule drift, which the per-family budget pin catches:
+        cfg = load_budgets().get("jaxpr", {}).get(
+            "collectives", {}).get("scenario", {})
+        violations = check_collective_budget(cert, cfg)
+        assert violations, "the injected psum family went unnoticed"
+        msg = " ".join(violations)
+        assert "UNBUDGETED" in msg and "agents,scenarios" in msg
+        assert "test_scenario_fleet" in msg
+
+
+class TestPadScenarios:
+    def test_pads_to_shard_multiple(self, ocp):
+        from agentlib_mpc_tpu.scenario.fleet import pad_scenarios
+
+        tree = fan_tree(3, robust_horizon=1)
+        thetas = _thetas(ocp, n_scen=3)
+        padded_tree, padded = pad_scenarios(tree, thetas, 2)
+        assert padded_tree.n_scenarios == 4
+        # pad branches weigh nothing and join no real group
+        assert padded_tree.probabilities[-1] == 0.0
+        assert padded_tree.groups_at(0)[:1] == ((0, 1, 2),)
+        np.testing.assert_array_equal(np.asarray(padded.p[:, 3]),
+                                      np.asarray(padded.p[:, 2]))
+        # already divisible: identity
+        same_tree, same = pad_scenarios(padded_tree, padded, 2)
+        assert same_tree is padded_tree and same is padded
+
+
+class TestTelemetry:
+    def test_scenario_metrics_recorded(self, coupled_fleet, ocp):
+        from agentlib_mpc_tpu import telemetry
+
+        was = telemetry.enabled()
+        telemetry.configure(enabled=True)
+        try:
+            thetas = _thetas(ocp)
+            st = coupled_fleet.init_state(thetas)
+            coupled_fleet.step(st, thetas)
+            reg = telemetry.metrics()
+            (count_sample,) = [
+                s for s in reg.gauge("scenario_count").samples()]
+            assert count_sample["value"] == N_SCEN
+            spread_samples = reg.histogram(
+                "scenario_spread").samples()
+            assert spread_samples
+        finally:
+            telemetry.configure(enabled=was)
