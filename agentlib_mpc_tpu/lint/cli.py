@@ -23,10 +23,17 @@ Modes:
   fresh process so the device count can be requested).
 * ``--scenario-budget`` — run the scenario-fleet gate (``[scenario]``):
   zero warm retraces of the 2-D (agents × scenarios) robust round
+* ``--memory-budget`` — run the static memory gate (``[jaxpr.memory]``):
+  every example OCP's certified peak must bound XLA's own
+  ``memory_analysis`` from above within the pinned ratio, and the
+  fused tracker fleet's per-device peak must hold the
+  peak-bytes-per-agent-lane pin (8 virtual devices, like the mesh
+  gates — run in a fresh process).
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
-  structure proof, dtype propagation, cost model) over the example-OCP
-  menu against the ``[jaxpr.expect]`` expectations in
-  ``lint_budgets.toml`` (imports jax, like the retrace gate).
+  structure proof, dtype propagation, cost model, memory
+  certification) over the example-OCP menu against the
+  ``[jaxpr.expect]`` expectations in ``lint_budgets.toml`` (imports
+  jax, like the retrace gate).
 """
 
 from __future__ import annotations
@@ -35,6 +42,41 @@ import argparse
 import json
 import os
 import sys
+
+
+def _print_memory_summary(mem: dict) -> int:
+    """Print one line per memory-gate row; returns the failure count."""
+    for entry in mem["examples"]:
+        worst = None
+        fails = []
+        for fname, row in entry["functions"].items():
+            if row["xla_ratio"] is not None and \
+                    (worst is None or row["xla_ratio"] > worst):
+                worst = row["xla_ratio"]
+            if row["failure"]:
+                fails.append(row["failure"])
+        status = "FAIL" if fails else "ok"
+        print(f"{entry['name']}: memory certified, worst "
+              f"static/XLA ratio {worst} [{status}]")
+        for f in fails:
+            print(f"  FAILED: {f}")
+        for e in entry.get("errors", ()):
+            print(f"  (cross-check error: {e})")
+    fleet = mem["fleet"]
+    if "skipped" in fleet:
+        print(f"{fleet['name']}: SKIPPED — {fleet['skipped']}")
+    elif "error" in fleet:
+        print(f"{fleet['name']}: memory certification ERROR [FAIL]"
+              f"\n  {fleet['error']}")
+    else:
+        status = "FAIL" if fleet["violations"] else "ok"
+        print(f"{fleet['name']}: peak {fleet['peak_bytes']}B/device "
+              f"({fleet['bytes_per_lane']}B/lane, "
+              f"{fleet['lanes_per_device']} lane(s)/device) "
+              f"xla-ratio={fleet['xla_ratio']} [{status}]")
+        for v in fleet["violations"]:
+            print(f"  FAILED: {v}")
+    return int(mem["failures"])
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -63,6 +105,11 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="run the scenario-fleet gate: zero warm "
                              "retraces of the 2-D (agents x scenarios) "
                              "fused robust round (8 virtual devices)")
+    parser.add_argument("--memory-budget", action="store_true",
+                        help="run the static memory gate: certified "
+                             "peaks bound XLA memory_analysis within "
+                             "the [jaxpr.memory] pins (8 virtual "
+                             "devices)")
     parser.add_argument("--jaxpr", action="store_true",
                         help="run the semantic jaxpr certification "
                              "passes over the example-OCP menu")
@@ -115,6 +162,35 @@ def main(argv: "list[str] | None" = None) -> int:
             if args.budgets else None
         report = retrace_budget.run_scenario_gate(budgets)
         return 1 if report["violations"] or report["failures"] else 0
+
+    if args.memory_budget:
+        # the mesh-gate env contract: 8 virtual devices, honored only
+        # before backend init (fresh process — the CLI and CI both)
+        from agentlib_mpc_tpu.utils.jax_setup import (
+            request_virtual_devices,
+        )
+
+        request_virtual_devices(8)
+
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            memory_gate_summary,
+        )
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        budgets = load_budgets(args.budgets) if args.budgets \
+            else load_budgets()
+        mem = memory_gate_summary(budgets)
+        failures = _print_memory_summary(mem)
+        if failures:
+            print(f"FAILED: {failures} memory certification "
+                  f"failure(s) (docs/static_analysis.md)",
+                  file=sys.stderr)
+            return 1
+        print(f"memory-budget: OK — certified peaks bound XLA on "
+              f"{len(mem['examples'])} example OCP(s) and the fused "
+              f"tracker fleet over {mem['devices']} device(s)",
+              file=sys.stderr)
+        return 0
 
     if args.jaxpr:
         from agentlib_mpc_tpu.lint.jaxpr.examples import (
@@ -172,8 +248,18 @@ def main(argv: "list[str] | None" = None) -> int:
                   f"[{status}]")
             for v in r["violations"]:
                 print(f"  FAILED: {v}")
+        # memory leg (ISSUE 13): certified peaks must bound XLA's own
+        # memory_analysis within the [jaxpr.memory] pins — a memory
+        # regression fails lint --jaxpr the way a retrace or an
+        # unbudgeted psum family does
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            memory_gate_summary,
+        )
+
+        mem = memory_gate_summary({"jaxpr": budgets})
+        mem_failures = _print_memory_summary(mem)
         total = summary["failures"] + growth["failures"] \
-            + coll["failures"]
+            + coll["failures"] + mem_failures
         if total:
             print(f"FAILED: {total} jaxpr certification "
                   f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
@@ -181,7 +267,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"jaxpr certification OK: {len(summary['examples'])} "
               f"example OCP(s) proved, eval+jac growth within "
               f"{growth['max_growth']}x, collective schedules proved "
-              f"over {coll['devices']} device(s)", file=sys.stderr)
+              f"over {coll['devices']} device(s), memory certificates "
+              f"bound XLA", file=sys.stderr)
         return 0
 
     if args.stats:

@@ -9,7 +9,7 @@ exactly one level down, in the jaxpr, where JAX's tracing design (Frostig
 et al.) gives a complete dataflow IR of the traced function: every
 primitive application, every constant, no Python control flow left.
 
-Five passes over one shared per-primitive interpreter (:mod:`.interp`):
+Six passes over one shared per-primitive interpreter (:mod:`.interp`):
 
 * :func:`certify_lq` (:mod:`.lq`) — a polynomial-degree lattice
   {const, affine, quadratic, nonpoly} propagated per element through
@@ -38,6 +38,16 @@ Five passes over one shared per-primitive interpreter (:mod:`.interp`):
   plane checkpoint and the degraded-mesh rebuild assert against. A
   shard-varying ``while`` predicate over a collective — the silent
   cross-host pod hang — is refuted at build time, naming the eqn.
+* :func:`certify_memory` (:mod:`.memory`) — a live-range walk over the
+  eqn schedule computing peak bytes-resident PER DEVICE
+  (donation-aware: donated invals alias matching outvals;
+  sharding-aware: ``shard_map`` operands divide by their spec'd mesh
+  axis sizes; loops at body-peak + carry, never × trips), anchored to
+  XLA's own ``memory_analysis`` by the ``[jaxpr.memory]`` gate. Both
+  fleet engines attach the certificate at build and refuse programs
+  whose certified peak exceeds the device's reported capacity;
+  :func:`plan_capacity` inverts the per-lane marginal into "how many
+  agents / scenarios / tenant slots fit on one device".
 
 Soundness boundary: primitives the interpreter cannot see through
 (``pure_callback``, custom AD rules, foreign calls) make a *tainted*
@@ -75,6 +85,18 @@ from agentlib_mpc_tpu.lint.jaxpr.fingerprint import (  # noqa: F401
 from agentlib_mpc_tpu.lint.jaxpr.lq import (  # noqa: F401
     LQCertificate,
     certify_lq,
+)
+from agentlib_mpc_tpu.lint.jaxpr.memory import (  # noqa: F401
+    CapacityPlan,
+    MemoryBudgetExceeded,
+    MemoryCertificate,
+    certify_memory,
+    check_memory_budget,
+    device_hbm_bytes,
+    engine_memory_certificate,
+    modeled_buffer_bytes,
+    plan_capacity,
+    xla_memory_analysis,
 )
 from agentlib_mpc_tpu.lint.jaxpr.structure import (  # noqa: F401
     StructureCertificate,

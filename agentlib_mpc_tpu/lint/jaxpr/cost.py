@@ -72,6 +72,16 @@ class CostEstimate:
     collective_bytes: int = 0
     per_primitive_collective_bytes: dict = dataclasses.field(
         default_factory=dict)
+    #: statically certified peak bytes-resident per device (the ISSUE 13
+    #: live-range pass, :mod:`.memory`) — the residency column next to
+    #: the FLOP and comm columns, so fusion-target picking can weigh
+    #: compute against both traffic AND footprint. 0 when the memory
+    #: walk could not run.
+    peak_bytes: int = 0
+    #: live bytes at the peak instant attributed to the defining
+    #: primitive (arguments/outputs under ``(arguments)``/``(outputs)``)
+    per_primitive_peak_bytes: dict = dataclasses.field(
+        default_factory=dict)
 
     def top(self, k: int = 5) -> "list[tuple[str, int]]":
         return Counter(self.per_primitive_flops).most_common(k)
@@ -81,11 +91,15 @@ class CostEstimate:
             "flops": self.flops,
             "bytes": self.bytes_accessed,
             "collective_bytes": self.collective_bytes,
+            "peak_bytes": self.peak_bytes,
             "per_primitive_flops": dict(sorted(
                 self.per_primitive_flops.items(),
                 key=lambda kv: -kv[1])),
             "per_primitive_collective_bytes": dict(sorted(
                 self.per_primitive_collective_bytes.items(),
+                key=lambda kv: -kv[1])),
+            "per_primitive_peak_bytes": dict(sorted(
+                self.per_primitive_peak_bytes.items(),
                 key=lambda kv: -kv[1])),
             "notes": list(self.notes),
         }
@@ -299,6 +313,14 @@ def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
     notes: "set[str]" = set()
     _charge(closed, flops, bytes_, notes, comm=comm,
             axis_sizes=axis_sizes, while_trips=while_trips)
+    # the residency column (ISSUE 13): the live-range peak of the same
+    # closed jaxpr, per device. Failure degrades to 0 + a note — the
+    # FLOP/comm columns must survive a memory-walk regression.
+    from agentlib_mpc_tpu.lint.jaxpr.memory import certify_memory
+
+    mem = certify_memory(closed)
+    if mem.status == "unknown":
+        notes.add("memory walk failed — peak_bytes not modeled")
     return CostEstimate(
         flops=int(sum(flops.values())),
         bytes_accessed=int(sum(bytes_.values())),
@@ -307,4 +329,6 @@ def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
         notes=tuple(sorted(notes)),
         collective_bytes=int(sum(comm.values())),
         per_primitive_collective_bytes=dict(comm),
+        peak_bytes=int(mem.peak_bytes),
+        per_primitive_peak_bytes=dict(mem.per_primitive_peak_bytes),
     )

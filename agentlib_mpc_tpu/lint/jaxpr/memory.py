@@ -1,0 +1,1075 @@
+"""Static memory certification: prove per-device peak HBM before dispatch.
+
+The reference stack discovers memory exhaustion at runtime — IPOPT and
+CasADi malloc until the OS objects — but on a TPU pod an OOM is a fatal,
+whole-mesh dispatch failure, and every capacity question the scale-out
+work asks (*how many agents / scenarios / tenant slots fit on one
+device?*) needs an answer BEFORE the program touches silicon.
+
+This is the sixth certifier pass on the PR 5 interpreter stack: a
+**live-range abstract interpretation over the closed jaxpr** that
+computes peak bytes-resident per device and emits a
+:class:`MemoryCertificate` —
+
+* per-buffer live intervals from one linear walk of the eqn schedule:
+  a value is resident from the eqn that defines it to its last use
+  (jaxpr outputs live to the end); the peak is the largest sum of
+  simultaneously-live buffers. Arguments are owned by the caller and
+  stay resident for the whole execution (exactly XLA's contract);
+* **donation-aware** — donated invars alias their dtype/shape-matching
+  outvals (XLA input-output aliasing), so ``donate_state=True``
+  provably saves one full :class:`~agentlib_mpc_tpu.parallel.
+  fused_admm.FusedState` copy and the certificate shows the exact
+  delta;
+* **sharding-aware** — inside a ``shard_map`` eqn the body avals are
+  already shard-local, and the eqn's operands/results divide by the
+  mesh axis sizes their in/out-specs shard over (the PR 11
+  ``in_names`` plumbing), so the certificate answers per-*device*, not
+  per-host;
+* control flow charged honestly: ``scan``/``while`` bodies at
+  body-peak + carry (NOT × trips — the loop reuses its body buffers),
+  ``cond`` at max-of-branches;
+* opaque primitives (``pure_callback`` & friends — never executed)
+  degrade the verdict to an honest ``"lower_bound"``: the reported
+  peak is still a floor, but no longer a proved ceiling.
+
+Calibration closes the loop: :func:`xla_memory_analysis` compiles the
+same program and reads XLA's own buffer-assignment numbers
+(``argument + output − alias + temp``); the certifier must bound XLA
+from above within the ``[jaxpr.memory]`` ``max_xla_ratio`` pin on the
+whole example menu (:func:`memory_gate_summary`, run by
+``python -m agentlib_mpc_tpu.lint --memory-budget`` and ``--jaxpr``),
+so the static proof is anchored to ground truth.
+
+On top of the certificate, :func:`plan_capacity` inverts the per-lane
+marginal cost into the three capacity answers the scale-out roadmap
+needs — max agents per device, max scenario branches per device, max
+serving-slot multiple — and the build seams consume it:
+``FusedADMM``/``ScenarioFleet`` attach the certificate and refuse
+(``memory_certify="auto"|"require"|"off"``) programs whose projected
+peak exceeds the backend device's reported capacity, and the
+``ServingPlane`` consults the projection before capacity growth so a
+join that would OOM a bucket is shed into the PR 2 guard ladder
+instead of killing the round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+
+import numpy as np
+
+from agentlib_mpc_tpu.lint.jaxpr.interp import CALLBACK_PRIMS
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CapacityPlan",
+    "MemoryBudgetExceeded",
+    "MemoryCertificate",
+    "certify_memory",
+    "check_memory_budget",
+    "device_hbm_bytes",
+    "engine_memory_certificate",
+    "memory_gate_summary",
+    "modeled_buffer_bytes",
+    "plan_capacity",
+    "xla_memory_analysis",
+]
+
+#: call-like primitives whose single sub-jaxpr is inlined transparently
+#: (the collectives walker's table — kept in sync by the shared tests)
+_CALL_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+    "remat2": "jaxpr",
+}
+
+#: how many top live buffers a certificate records for attribution
+_TOP_BUFFERS = 8
+
+#: per-buffer allocation granularity of the model: XLA's buffer
+#: assignment aligns every allocation (64 B on CPU/TPU), so a program
+#: of many small temps occupies far more than its logical bytes —
+#: without this the certifier UNDERCOUNTS exactly the programs whose
+#: footprint is allocation-dominated (measured on the fused tracker
+#: round: hundreds of scalar residual/penalty temps)
+_ALIGN = 64
+
+
+def modeled_buffer_bytes(shape, dtype) -> int:
+    """Bytes the model charges one buffer: logical size rounded up to
+    the :data:`_ALIGN` allocation granularity (public so identity tests
+    can compute e.g. the exact FusedState footprint the way the
+    certificate does)."""
+    n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    if n <= 0:
+        return 0
+    return -(-n // _ALIGN) * _ALIGN
+
+
+class MemoryBudgetExceeded(ValueError):
+    """A certified program's projected per-device peak exceeds the
+    available (or budgeted) device memory. Raised by the engine build
+    seams under ``memory_certify`` and consumed by the serving plane's
+    capacity-shed path."""
+
+
+def _source_of(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<unknown>"
+
+
+def _as_jaxpr(obj):
+    """(jaxpr, const_avals) from a ClosedJaxpr or an open Jaxpr."""
+    if hasattr(obj, "jaxpr"):                     # ClosedJaxpr
+        return obj.jaxpr, [np.asarray(c) for c in obj.consts]
+    return obj, []
+
+
+def _aval_bytes(aval) -> int:
+    if aval is None or not hasattr(aval, "shape") \
+            or not hasattr(aval, "dtype"):
+        return 0
+    try:
+        return modeled_buffer_bytes(aval.shape, aval.dtype)
+    except Exception:  # noqa: BLE001 — token/opaque avals
+        return 0
+
+
+def _var_bytes(v) -> int:
+    return _aval_bytes(getattr(v, "aval", None))
+
+
+def _spec_factor(names, mesh_sizes: dict) -> int:
+    """Division factor a shard_map in/out-spec buys: the product of the
+    mesh axis sizes the spec shards over (1 = replicated)."""
+    f = 1
+    vals = names.values() if hasattr(names, "values") else names
+    for axes in vals:
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        for a in axes:
+            f *= int(mesh_sizes.get(str(a), 1))
+    return max(int(f), 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class _SubResult:
+    """One sub-jaxpr's walk outcome, as its caller accounts for it.
+
+    ``interior_peak`` is the peak bytes of values INTERIOR to the
+    jaxpr — everything except its invars and outvars, which the caller
+    already counts as the call eqn's operands/results (that exclusion
+    is what lets call-like primitives inline without double counting).
+    """
+
+    interior_peak: int
+    in_factors: tuple          # per-invar sharding divisor
+    out_factors: tuple         # per-outvar sharding divisor
+    buffers: tuple             # (bytes, primitive, source) at the peak
+    per_prim: dict             # primitive -> live bytes at the peak
+
+
+_EMPTY_SUB = _SubResult(0, (), (), (), {})
+
+
+class _MemWalker:
+    def __init__(self):
+        self.opaque: list = []
+        self.notes: list = []
+        self.axis_sizes: dict = {}
+
+    def _note(self, msg: str) -> None:
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    # -- the walk -------------------------------------------------------------
+
+    def walk(self, obj, in_sizes: "list[int] | None" = None) -> _SubResult:
+        jaxpr, consts = _as_jaxpr(obj)
+        n_eqns = len(jaxpr.eqns)
+        if in_sizes is None:
+            in_sizes = [_var_bytes(v) for v in jaxpr.invars]
+
+        # -- pass 1: per-eqn extras, sub recursion, sharding factors ---
+        extra = [0] * n_eqns
+        extra_sub: "list[_SubResult | None]" = [None] * n_eqns
+        # candidate division factors per var; plain uses contribute 1 so
+        # a value consumed anywhere outside a sharded seam stays charged
+        # at full (conservative) size
+        use_factors: dict = {}
+        def_factors: dict = {}
+
+        def use(v, factor: int = 1):
+            if type(v).__name__ == "Literal":
+                return
+            use_factors.setdefault(v, []).append(int(factor))
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                sizes = {}
+                try:
+                    sizes = {str(k): int(s)
+                             for k, s in dict(mesh.shape).items()}
+                except Exception:  # noqa: BLE001 — AbstractMesh variants
+                    pass
+                self.axis_sizes.update(sizes)
+                body = eqn.params["jaxpr"]
+                sub = self.walk(body)          # body avals are shard-local
+                extra[i], extra_sub[i] = sub.interior_peak, sub
+                for v, names in zip(eqn.invars, eqn.params["in_names"]):
+                    use(v, _spec_factor(names, sizes))
+                for v, names in zip(eqn.outvars, eqn.params["out_names"]):
+                    def_factors[v] = _spec_factor(names, sizes)
+                continue
+            if name in _CALL_PRIMS:
+                sub_obj = eqn.params.get(_CALL_PRIMS[name])
+                sub_jaxpr, _ = _as_jaxpr(sub_obj) if sub_obj is not None \
+                    else (None, [])
+                if sub_jaxpr is not None and \
+                        len(sub_jaxpr.invars) == len(eqn.invars):
+                    sub = self.walk(sub_obj,
+                                    [_var_bytes(v) for v in eqn.invars])
+                    extra[i], extra_sub[i] = sub.interior_peak, sub
+                    for v, f in zip(eqn.invars, sub.in_factors):
+                        use(v, f)
+                    for v, f in zip(eqn.outvars, sub.out_factors):
+                        def_factors[v] = f
+                    continue
+                # arity mismatch (wrapper consts): fall through to the
+                # generic rule — operands/outputs still counted
+            elif name == "scan":
+                body = eqn.params["jaxpr"]
+                body_jaxpr, _ = _as_jaxpr(body)
+                sub = self.walk(body)
+                n_const = eqn.params["num_consts"]
+                # per-iteration xs slices and the in-flight body outputs
+                # (new carry + the ys slice being stacked) materialize
+                # beside the stacked operands; the body peak itself is
+                # NOT multiplied by the trip count — the loop reuses its
+                # body buffers
+                slices = sum(_var_bytes(v)
+                             for v in body_jaxpr.invars[n_const:])
+                in_flight = sum(_var_bytes(v)
+                                for v in body_jaxpr.outvars)
+                extra[i] = sub.interior_peak + slices + in_flight
+                extra_sub[i] = sub
+            elif name == "while":
+                sub_c = self.walk(eqn.params["cond_jaxpr"])
+                sub_b = self.walk(eqn.params["body_jaxpr"])
+                body_jaxpr, _ = _as_jaxpr(eqn.params["body_jaxpr"])
+                best = sub_b if sub_b.interior_peak >= sub_c.interior_peak \
+                    else sub_c
+                # XLA assigns the cond's and the body's temp arenas in
+                # one allocation, and the new carry is computed while
+                # the old one is live — charge all three
+                in_flight = sum(_var_bytes(v)
+                                for v in body_jaxpr.outvars)
+                extra[i] = (sub_c.interior_peak + sub_b.interior_peak
+                            + in_flight)
+                extra_sub[i] = best
+            elif name == "cond":
+                subs = [self.walk(br) for br in eqn.params["branches"]]
+                best = max(subs, key=lambda s: s.interior_peak,
+                           default=_EMPTY_SUB)
+                extra[i], extra_sub[i] = best.interior_peak, best
+            elif name in CALLBACK_PRIMS:
+                # never executed; whatever the host (or foreign call)
+                # allocates is outside the proof — the verdict degrades
+                # to "lower_bound"
+                self.opaque.append(name)
+            else:
+                # any other primitive's working set is its operands +
+                # outputs (both counted by the timeline); sub-jaxprs it
+                # hides (custom_linear_solve etc.) are charged as extra
+                for val in eqn.params.values():
+                    for s in (val if isinstance(val, (tuple, list))
+                              else (val,)):
+                        if hasattr(s, "eqns") or hasattr(s, "jaxpr"):
+                            sub = self.walk(s)
+                            if sub.interior_peak > extra[i]:
+                                extra[i], extra_sub[i] = \
+                                    sub.interior_peak, sub
+            for v in eqn.invars:
+                use(v)
+
+        # -- pass 2: per-value sizes (sharding divisors applied) -------
+        invar_set = set(jaxpr.invars)
+        out_vars = [v for v in jaxpr.outvars
+                    if type(v).__name__ != "Literal"]
+        outvar_set = set(out_vars)
+
+        def factor_of(v) -> int:
+            # the most conservative (smallest) divisor any consumer
+            # demands; a value with no uses (a jaxpr output) keeps the
+            # divisor its defining seam provides
+            fs = use_factors.get(v)
+            if fs:
+                return max(min(fs), 1)
+            return max(def_factors.get(v, 1), 1)
+
+        size: dict = {}
+        in_factors = []
+        for v, s in zip(jaxpr.invars, in_sizes):
+            f = factor_of(v)
+            in_factors.append(f)
+            size[v] = -(-int(s) // f)
+        const_base = sum(_aval_bytes(c) for c in consts)
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                size[v] = -(-_var_bytes(v) // factor_of(v))
+        out_factors = tuple(
+            1 if type(v).__name__ == "Literal" or v not in size
+            else factor_of(v) for v in jaxpr.outvars)
+
+        # -- pass 3: live-interval sweep over interior values ----------
+        defs: dict = {}
+        last: dict = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for v in eqn.invars:
+                if type(v).__name__ != "Literal":
+                    last[v] = i
+            for v in eqn.outvars:
+                defs[v] = i
+        for v in out_vars:
+            last[v] = n_eqns               # sentinel: live to the end
+
+        interior = [v for v in defs
+                    if v not in outvar_set and v not in invar_set]
+        delta = [0] * (n_eqns + 1)
+        for v in interior:
+            delta[defs[v]] += size[v]
+            end = last.get(v, defs[v])
+            if end + 1 <= n_eqns:
+                delta[min(end + 1, n_eqns)] -= size[v]
+        cur, peak, peak_t = 0, const_base, -1
+        for t in range(n_eqns):
+            cur += delta[t]
+            live = const_base + cur + extra[t]
+            if live > peak:
+                peak, peak_t = live, t
+        if n_eqns == 0:
+            return _SubResult(const_base, tuple(in_factors),
+                              out_factors, (), {})
+
+        # -- attribution at the peak instant ---------------------------
+        buffers: list = []
+        per_prim: dict = {}
+        if peak_t >= 0:
+            for v in interior:
+                if defs[v] <= peak_t <= last.get(v, defs[v]) and size[v]:
+                    eqn = jaxpr.eqns[defs[v]]
+                    buffers.append((size[v], eqn.primitive.name,
+                                    _source_of(eqn)))
+                    per_prim[eqn.primitive.name] = \
+                        per_prim.get(eqn.primitive.name, 0) + size[v]
+            sub = extra_sub[peak_t]
+            if sub is not None:
+                buffers.extend(sub.buffers)
+                for k, b in sub.per_prim.items():
+                    per_prim[k] = per_prim.get(k, 0) + b
+        buffers.sort(key=lambda b: -b[0])
+        return _SubResult(int(peak), tuple(in_factors), out_factors,
+                          tuple(buffers[:_TOP_BUFFERS]), per_prim)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryCertificate:
+    """Outcome of :func:`certify_memory`.
+
+    ``status``:
+
+    * ``"proved"`` — ``peak_bytes`` is a proved per-device upper bound
+      on bytes-resident (validated against XLA's own
+      ``memory_analysis`` by the ``[jaxpr.memory]`` gate);
+    * ``"lower_bound"`` — an opaque primitive (``pure_callback`` &
+      friends, never executed) hides allocations: ``peak_bytes`` is
+      still a floor, no longer a proved ceiling;
+    * ``"unknown"`` — the walk failed; no number is claimed.
+    """
+
+    status: str
+    peak_bytes: int = 0            # per-device, arguments included
+    argument_bytes: int = 0        # caller-owned, resident throughout
+    output_bytes: int = 0          # after donation aliasing
+    temp_peak_bytes: int = 0       # interior live-range peak
+    donated_aliased_bytes: int = 0
+    per_primitive_peak_bytes: dict = dataclasses.field(
+        default_factory=dict)
+    #: the largest live buffers at the peak instant:
+    #: (bytes, primitive, source) descending — what a budget violation
+    #: names
+    top_buffers: tuple = ()
+    opaque: tuple = ()
+    notes: tuple = ()
+    axis_sizes: "dict | None" = None   # mesh axis name -> size (sharded)
+
+    @property
+    def proved(self) -> bool:
+        return self.status == "proved"
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self.axis_sizes)
+
+    @property
+    def memory_digest(self) -> "str | None":
+        """Identity of the certified footprint — rides the engine-store
+        meta next to the collective-schedule digest so a restore into a
+        process whose fresh build would certify a DIFFERENT footprint
+        is visible. None unless proved."""
+        if self.status != "proved":
+            return None
+        ident = "|".join([
+            str(self.peak_bytes), str(self.argument_bytes),
+            str(self.output_bytes), str(self.temp_peak_bytes),
+            str(self.donated_aliased_bytes),
+            ";".join(f"{b}:{p}" for b, p, _s in self.top_buffers),
+        ])
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def per_lane_bytes(self, lanes: int) -> int:
+        """Average resident bytes per batched lane — the coarse
+        (base-inclusive) marginal; :func:`plan_capacity` computes the
+        true marginal from two certificates."""
+        return -(-self.peak_bytes // max(int(lanes), 1))
+
+    def describe(self) -> str:
+        mib = self.peak_bytes / 2**20
+        shard = ""
+        if self.axis_sizes:
+            shard = " per-device over " + "x".join(
+                f"{k}={v}" for k, v in sorted(self.axis_sizes.items()))
+        if self.status == "proved":
+            return (f"proved: peak {mib:.2f} MiB{shard} "
+                    f"(args {self.argument_bytes / 2**20:.2f} + outs "
+                    f"{self.output_bytes / 2**20:.2f} + temps "
+                    f"{self.temp_peak_bytes / 2**20:.2f} MiB)")
+        if self.status == "lower_bound":
+            return (f"lower bound: peak >= {mib:.2f} MiB{shard} — "
+                    f"opaque primitive(s) "
+                    f"{','.join(sorted(set(self.opaque)))} hide "
+                    f"allocations")
+        return "unknown: " + "; ".join(self.notes[:2])
+
+    def as_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "peak_bytes": self.peak_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_peak_bytes": self.temp_peak_bytes,
+            "donated_aliased_bytes": self.donated_aliased_bytes,
+            "per_primitive_peak_bytes": dict(sorted(
+                self.per_primitive_peak_bytes.items(),
+                key=lambda kv: -kv[1])),
+            "top_buffers": [
+                {"bytes": b, "primitive": p, "source": s}
+                for b, p, s in self.top_buffers],
+            "digest": self.memory_digest,
+            "opaque": sorted(set(self.opaque)),
+            "notes": list(self.notes),
+            "axis_sizes": dict(self.axis_sizes or {}),
+        }
+
+
+def _donated_mask(closed, donate_argnums, args) -> "tuple | None":
+    """Flat per-invar donation flags from jit-style ``donate_argnums``
+    (the flat order of ``make_jaxpr`` invars is the leaf order of the
+    positional args)."""
+    if not donate_argnums:
+        return None
+    import jax
+
+    donate = set(int(i) for i in donate_argnums)
+    flags: list = []
+    for i, a in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(a))
+        flags.extend([i in donate] * n)
+    if len(flags) != len(closed.jaxpr.invars):
+        return None
+    return tuple(flags)
+
+
+def certify_memory(fn_or_jaxpr, *args, donate_argnums=(),
+                   donated_invars=None) -> MemoryCertificate:
+    """Certify the per-device peak bytes-resident of a traced program.
+
+    ``fn_or_jaxpr``: a ``ClosedJaxpr`` (pass no ``args``) or a callable
+    traced as ``jax.make_jaxpr(fn)(*args)`` — shape templates suffice.
+    ``donate_argnums`` mirrors ``jax.jit``'s (positional args whose
+    buffers the caller donates); ``donated_invars`` is the already-flat
+    per-invar alternative for pre-closed jaxprs. Never executes user
+    code: callbacks degrade the verdict to ``"lower_bound"``."""
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+        if donated_invars is None:
+            donated_invars = _donated_mask(closed, donate_argnums, args)
+    walker = _MemWalker()
+    try:
+        res = walker.walk(closed)
+    except Exception as exc:  # noqa: BLE001 — certification must not
+        # kill an engine build; an uninterpretable program is "unknown"
+        return MemoryCertificate(
+            status="unknown", opaque=("interpreter-error",),
+            notes=(f"interpreter error: {exc!r}",))
+    jaxpr = closed.jaxpr
+
+    in_sizes = [-(-_var_bytes(v) // f)
+                for v, f in zip(jaxpr.invars, res.in_factors)]
+    argument_bytes = sum(in_sizes)
+    out_entries = []
+    for v, f in zip(jaxpr.outvars, res.out_factors):
+        if type(v).__name__ == "Literal":
+            continue
+        aval = getattr(v, "aval", None)
+        out_entries.append((tuple(getattr(aval, "shape", ())),
+                            str(getattr(aval, "dtype", "?")),
+                            -(-_var_bytes(v) // f)))
+    # donation: each donated invar's buffer can back one dtype/shape-
+    # matching output (XLA input-output aliasing) — that output then
+    # costs nothing beyond the argument already counted
+    pool: list = []
+    if donated_invars:
+        for v, flag, s in zip(jaxpr.invars, donated_invars, in_sizes):
+            if flag:
+                aval = getattr(v, "aval", None)
+                pool.append([tuple(getattr(aval, "shape", ())),
+                             str(getattr(aval, "dtype", "?")), s])
+    output_bytes = 0
+    donated_aliased = 0
+    for shape, dtype, s in out_entries:
+        hit = next((p for p in pool
+                    if p[0] == shape and p[1] == dtype and p[2] == s),
+                   None)
+        if hit is not None:
+            pool.remove(hit)
+            donated_aliased += s
+        else:
+            output_bytes += s
+    peak = argument_bytes + output_bytes + res.interior_peak
+    if donated_aliased:
+        # honesty marker: aliasing models XLA input-output donation,
+        # which backends without buffer-donation support (CPU) do NOT
+        # perform — there the true residency is peak + the aliased
+        # bytes. The accelerator answer is the certificate's job; the
+        # note keeps a CPU cross-check of a donated program from
+        # reading as an upper-bound violation of the model itself.
+        walker._note(
+            f"donation aliasing modeled ({donated_aliased} B): on "
+            f"backends without buffer donation (CPU) add "
+            f"donated_aliased_bytes to peak_bytes for the true "
+            f"residency")
+    per_prim = dict(res.per_prim)
+    if argument_bytes:
+        per_prim["(arguments)"] = argument_bytes
+    if output_bytes:
+        per_prim["(outputs)"] = output_bytes
+    status = "lower_bound" if walker.opaque else "proved"
+    return MemoryCertificate(
+        status=status,
+        peak_bytes=int(peak),
+        argument_bytes=int(argument_bytes),
+        output_bytes=int(output_bytes),
+        temp_peak_bytes=int(res.interior_peak),
+        donated_aliased_bytes=int(donated_aliased),
+        per_primitive_peak_bytes=per_prim,
+        top_buffers=res.buffers,
+        opaque=tuple(walker.opaque),
+        notes=tuple(walker.notes),
+        axis_sizes=dict(walker.axis_sizes) or None,
+    )
+
+
+# --------------------------------------------------------------------------
+# XLA cross-check (calibration to ground truth)
+# --------------------------------------------------------------------------
+
+def xla_memory_analysis(fn, *args, donate_argnums=()) -> "dict | None":
+    """Compile ``fn(*args)`` and read XLA's own buffer-assignment stats.
+
+    Returns ``{argument, output, temp, alias, total}`` bytes (per
+    device for SPMD programs — verified against the sharded exemplar),
+    where ``total = argument + output − alias + temp`` is the resident
+    footprint the static certificate must bound from above. None when
+    the backend reports no analysis."""
+    import jax
+
+    compiled = jax.jit(fn, donate_argnums=donate_argnums
+                       ).lower(*args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    temp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    return {"argument": arg, "output": out, "temp": temp, "alias": alias,
+            "total": arg + out - alias + temp}
+
+
+def crosscheck_ratio(cert: MemoryCertificate,
+                     xla: "dict | None") -> "float | None":
+    """static / XLA resident-bytes ratio (must be ≥ 1 for a sound upper
+    bound; the ``[jaxpr.memory]`` gate pins its ceiling)."""
+    if xla is None or not xla.get("total"):
+        return None
+    return cert.peak_bytes / float(xla["total"])
+
+
+# --------------------------------------------------------------------------
+# budgets
+# --------------------------------------------------------------------------
+
+def check_memory_budget(cert: MemoryCertificate, cfg: dict,
+                        lanes: "int | None" = None) -> "list[str]":
+    """Compare a certificate against the ``[jaxpr.memory]`` budget.
+
+    Keys (all optional):
+
+    * ``max_peak_bytes`` — absolute per-device ceiling;
+    * ``max_step_bytes_per_lane`` — ceiling on peak ÷ shard-local lane
+      count (requires ``lanes``): the fused round's per-agent-lane
+      footprint pin. A regression that parks a new full-horizon buffer
+      in the round breaches this and the violation NAMES the offending
+      equations (top live buffers with their source lines).
+
+    Returns violation strings (empty = within budget)."""
+    out: list = []
+    if cert.status == "unknown":
+        out.append(f"memory not certified: {cert.describe()}")
+        return out
+
+    def name_buffers() -> str:
+        rows = [f"{b / 2**20:.2f} MiB {p} at {s}"
+                for b, p, s in cert.top_buffers[:4]]
+        return "\n  ".join(rows) if rows else "(no interior buffers)"
+
+    cap = cfg.get("max_peak_bytes")
+    if cap is not None and cert.peak_bytes > int(cap):
+        out.append(
+            f"certified peak {cert.peak_bytes} B exceeds the "
+            f"max_peak_bytes budget {int(cap)} B. Largest live buffers:"
+            f"\n  {name_buffers()}")
+    per_lane_cap = cfg.get("max_step_bytes_per_lane")
+    if per_lane_cap is not None and lanes:
+        per_lane = cert.per_lane_bytes(lanes)
+        if per_lane > int(per_lane_cap):
+            out.append(
+                f"certified peak is {per_lane} B per agent lane "
+                f"({lanes} shard-local lane(s)), budget pins "
+                f"{int(per_lane_cap)} B/lane — a buffer was added to "
+                f"(or grew inside) the fused round. Largest live "
+                f"buffers:\n  {name_buffers()}")
+    return out
+
+
+def device_hbm_bytes(device=None) -> "int | None":
+    """The backend device's reported memory capacity, or None where the
+    backend does not report one (CPU returns no memory_stats)."""
+    try:
+        import jax
+
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats()
+    except Exception:  # noqa: BLE001 — absent backends, init races
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit") or stats.get(
+        "bytes_reservable_limit")
+    return int(limit) if limit else None
+
+
+# --------------------------------------------------------------------------
+# capacity planning
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """What fits on one device — :func:`plan_capacity`'s answer.
+
+    ``base_bytes`` is the lane-independent resident floor (replicated
+    means, schedules, the program's own temps at one lane);
+    ``per_lane_bytes`` the certified marginal cost of one more agent
+    lane on a device. ``max_agents_per_device`` inverts them against
+    the HBM budget; the mesh-level fields scale by the device count."""
+
+    hbm_bytes: int
+    base_bytes: int
+    per_lane_bytes: int
+    max_agents_per_device: int
+    max_agents: "int | None" = None           # with a mesh
+    max_slot_multiple: "int | None" = None    # serving-plane capacity
+    per_scenario_bytes: "int | None" = None
+    max_scenarios_per_device: "int | None" = None
+    notes: tuple = ()
+
+    def as_dict(self) -> dict:
+        return {k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in dataclasses.asdict(self).items()}
+
+    def describe(self) -> str:
+        out = (f"{self.max_agents_per_device} agent lane(s)/device "
+               f"(base {self.base_bytes / 2**20:.2f} MiB + "
+               f"{self.per_lane_bytes / 2**20:.2f} MiB/lane vs "
+               f"{self.hbm_bytes / 2**20:.0f} MiB HBM)")
+        if self.max_agents is not None:
+            out += (f"; {self.max_agents} agents / slot multiple "
+                    f"{self.max_slot_multiple} on the mesh")
+        if self.max_scenarios_per_device is not None:
+            out += (f"; {self.max_scenarios_per_device} scenario "
+                    f"branch(es)/device")
+        return out
+
+
+def engine_memory_certificate(engine) -> MemoryCertificate:
+    """Certify a built engine's step WITHOUT the build-time capacity
+    enforcement — the planner's seam (a probe larger than the current
+    device must still report its honest number, not raise) and a
+    debugging convenience for engines built with
+    ``memory_certify="off"``. Returns the engine's attached certificate
+    when one exists."""
+    if getattr(engine, "memory_certificate", None) is not None:
+        return engine.memory_certificate
+    import jax
+
+    tmpl = engine._step_templates()
+    closed = jax.make_jaxpr(engine._step_fn)(*tmpl)
+    donated = None
+    if getattr(engine, "donate_state", False):
+        n_state = len(jax.tree_util.tree_leaves(tmpl[0]))
+        donated = tuple(i < n_state
+                        for i in range(len(closed.jaxpr.invars)))
+    return certify_memory(closed, donated_invars=donated)
+
+
+def _fleet_certificate(ocp, options, n_agents: int, couplings: dict,
+                       solver_options=None, mesh=None,
+                       qp_routing: "list | None" = None
+                       ) -> MemoryCertificate:
+    """Certificate of a consensus-fleet probe build at ``n_agents``
+    lanes (both certifications off — the planner proves bytes, without
+    the build-time capacity enforcement). ``qp_routing`` is a 1-cell
+    mutable memo: the first probe resolves the group's QP routing,
+    later probes force it so repeat builds never re-certify."""
+    from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup, FusedADMM
+
+    kwargs = {} if solver_options is None else {
+        "solver_options": solver_options}
+    if qp_routing and qp_routing[0] is not None:
+        kwargs["qp_fast_path"] = qp_routing[0]
+    group = AgentGroup(name="plan-probe", ocp=ocp, n_agents=n_agents,
+                       couplings=dict(couplings), **kwargs)
+    engine = FusedADMM([group], options, memory_certify="off",
+                       collective_certify="off", mesh=mesh)
+    if qp_routing is not None and qp_routing[0] is None:
+        qp_routing[0] = "on" if engine.group_uses_qp[0] else "off"
+    return engine_memory_certificate(engine)
+
+
+def plan_capacity(ocp, options, hbm_bytes: int, mesh=None,
+                  couplings: "dict | None" = None,
+                  solver_options=None,
+                  scenario_tree=None, refine: bool = True,
+                  max_probe_builds: int = 8) -> CapacityPlan:
+    """Invert the certified per-lane marginal memory cost into device
+    capacity: max agents per device, max scenario branches per device,
+    and the largest serving-slot multiple that fits ``hbm_bytes``.
+
+    Two probe builds (2 and 4 agent lanes — every carried and history
+    buffer is lane-batched, so the footprint is near-linear in the lane
+    count) give the affine model; with ``refine=True`` the candidate is
+    then verified against REAL probe certificates — built on ``mesh``
+    when one is given, per-device otherwise — and walked until
+    ``peak(planned) ≤ hbm < peak(planned + 1 lane)`` holds by
+    construction (allocation-granularity stepping makes a pure affine
+    inversion over-promise by a lane or two). Runs anywhere: a laptop
+    can plan a pod, because the single-device certificate at the
+    shard-local lane count upper-bounds the sharded round's per-device
+    footprint. ``scenario_tree`` adds two
+    :class:`~agentlib_mpc_tpu.scenario.ScenarioFleet` probes for the
+    scenario-axis marginal."""
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+
+    if options is None:
+        options = FusedADMMOptions()
+    if couplings is None:
+        # default: consensus on the first control — structurally the
+        # worst case the serving plane hosts (every lane carries
+        # multipliers + histories for the alias)
+        name = ocp.control_names[0]
+        couplings = {f"__plan_{name}": name}
+    notes: list = []
+    qp_memo: list = [None]
+    n_dev = 1 if mesh is None else max(1, int(mesh.devices.size))
+
+    probes: dict = {}
+
+    def peak_at(lanes_per_device: int) -> int:
+        """Certified per-device peak at ``lanes_per_device`` — a mesh
+        probe when a mesh is given (the real sharded program), a
+        single-device fleet otherwise."""
+        if lanes_per_device not in probes:
+            cert = _fleet_certificate(
+                ocp, options, lanes_per_device * n_dev, couplings,
+                solver_options, mesh=mesh, qp_routing=qp_memo)
+            if not cert.proved:
+                notes.append(f"probe at {lanes_per_device} lane(s) not "
+                             f"proved ({cert.status})")
+            probes[lanes_per_device] = int(cert.peak_bytes)
+        return probes[lanes_per_device]
+
+    p2, p4 = peak_at(2), peak_at(4)
+    per_lane = max((p4 - p2) // 2, 1)
+    base = max(p2 - 2 * per_lane, 0)
+    hbm = int(hbm_bytes)
+    max_per_dev = max(int((hbm - base) // per_lane), 0)
+    if refine and max_per_dev >= 1:
+        budget = max(int(max_probe_builds) - len(probes), 1)
+        while budget > 0 and max_per_dev >= 1 \
+                and peak_at(max_per_dev) > hbm:
+            max_per_dev -= 1
+            budget -= 1
+        while budget > 0 and peak_at(max_per_dev + 1) <= hbm:
+            max_per_dev += 1
+            budget -= 1
+        if probes.get(max_per_dev, 0) > hbm or max_per_dev not in probes:
+            # the probe-build budget ran out mid-walk: the refined
+            # claim "peak(planned) <= hbm" must never be returned
+            # unverified — clamp to the largest probe that PROVABLY
+            # fits (the affine candidate was over-promising)
+            fitting = [k for k, v in probes.items() if v <= hbm]
+            max_per_dev = max(fitting, default=0)
+            notes.append(
+                f"probe-build budget exhausted refining the affine "
+                f"candidate — clamped to the largest VERIFIED fit "
+                f"(max_agents_per_device={max_per_dev}); raise "
+                f"max_probe_builds for a tighter answer")
+
+    max_agents = max_slot = None
+    if mesh is not None:
+        from agentlib_mpc_tpu.parallel.multihost import (
+            serving_slot_multiple,
+        )
+
+        max_agents = max_per_dev * n_dev
+        sm = serving_slot_multiple(mesh)
+        max_slot = (max_agents // sm) * sm
+
+    per_scen = max_scen = None
+    if scenario_tree is not None:
+        try:
+            from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+            from agentlib_mpc_tpu.scenario import ScenarioFleet
+            from agentlib_mpc_tpu.scenario.fleet import (
+                ScenarioFleetOptions,
+            )
+            from agentlib_mpc_tpu.scenario.tree import (
+                fan_tree,
+                single_scenario,
+            )
+
+            scen_opts = ScenarioFleetOptions(
+                max_iterations=options.max_iterations)
+            kwargs = {} if solver_options is None else {
+                "solver_options": solver_options}
+            group = AgentGroup(name="plan-scen", ocp=ocp, n_agents=1,
+                               couplings=dict(couplings), **kwargs)
+            certs = {}
+            for s in (1, 2):
+                tree = fan_tree(s, robust_horizon=1) if s > 1 \
+                    else single_scenario()
+                fleet = ScenarioFleet(group, tree, scen_opts,
+                                      memory_certify="off",
+                                      collective_certify="off")
+                certs[s] = engine_memory_certificate(fleet)
+            per_scen = max(
+                int(certs[2].peak_bytes - certs[1].peak_bytes), 1)
+            scen_base = max(int(certs[1].peak_bytes - per_scen), 0)
+            max_scen = max(int((hbm - scen_base) // per_scen), 0)
+        except Exception as exc:  # noqa: BLE001 — planning stays usable
+            notes.append(f"scenario probe failed: {exc!r}")
+    plan = CapacityPlan(
+        hbm_bytes=hbm, base_bytes=base, per_lane_bytes=per_lane,
+        max_agents_per_device=max_per_dev, max_agents=max_agents,
+        max_slot_multiple=max_slot, per_scenario_bytes=per_scen,
+        max_scenarios_per_device=max_scen, notes=tuple(notes))
+    logger.info("capacity plan: %s", plan.describe())
+    return plan
+
+
+# --------------------------------------------------------------------------
+# the CI gate
+# --------------------------------------------------------------------------
+
+def memory_gate_summary(budgets: "dict | None" = None) -> dict:
+    """The ``--memory-budget`` CLI gate (also a ``--jaxpr`` leg and the
+    ``memory_certificates`` section of ``bench.py --emit-metrics``):
+
+    1. **menu sweep** — certify f/g/h of every example OCP and
+       cross-check against XLA's ``memory_analysis``: the static peak
+       must bound XLA's resident total from above within the
+       ``[jaxpr.memory]`` ``max_xla_ratio`` pin — the proof stays
+       anchored to ground truth;
+    2. **fused tracker fleet** — the mesh gate fleet's step certified
+       per device, held to ``max_step_bytes_per_lane``, and
+       cross-checked against the compiled step's own XLA numbers.
+       Needs ≥ 2 devices (CI pins 8 virtual); skipped with a note
+       otherwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.lint.jaxpr.examples import EXAMPLE_OCPS
+    from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+    cfg = (budgets if budgets is not None else load_budgets()).get(
+        "jaxpr", {}).get("memory", {})
+    max_ratio = float(cfg.get("max_xla_ratio", 16.0))
+    # the ratio ceiling only signals slackness when XLA kept real
+    # buffers: a function XLA constant-folds to a handful of bytes
+    # makes any static estimate look "23x" while the absolute gap is a
+    # few hundred bytes — below the slack floor only the lower bound
+    # (static >= XLA) is enforced
+    ratio_slack = int(cfg.get("xla_ratio_slack_bytes", 4096))
+    rows: list = []
+    failures = 0
+
+    for ex in EXAMPLE_OCPS:
+        ocp = ex.build()
+        theta = ocp.default_params()
+        w0 = jnp.zeros((ocp.n_w,))
+        entry = {"name": ex.name, "functions": {}}
+        for fname, fn in (("f", ocp.nlp.f), ("g", ocp.nlp.g),
+                          ("h", ocp.nlp.h)):
+            cert = certify_memory(fn, w0, theta)
+            try:
+                xla = xla_memory_analysis(fn, w0, theta)
+            except Exception as exc:  # noqa: BLE001 — report, not crash
+                xla = None
+                entry.setdefault("errors", []).append(
+                    f"{fname}: {exc!r}")
+            ratio = crosscheck_ratio(cert, xla)
+            fail = None
+            if not cert.proved:
+                fail = f"{fname}: {cert.describe()}"
+            elif ratio is None:
+                # the gate's whole claim is the XLA anchor — a backend
+                # that stops reporting memory_analysis must FAIL the
+                # gate loudly, not pass it with zero comparisons made
+                fail = (f"{fname}: XLA cross-check unavailable "
+                        f"(memory_analysis returned nothing) — the "
+                        f"static bound is unanchored")
+            elif ratio < 1.0:
+                fail = (f"{fname}: certified peak {cert.peak_bytes} B "
+                        f"does NOT bound XLA's {xla['total']} B — the "
+                        f"certifier undercounts")
+            elif ratio > max_ratio and cert.peak_bytes > ratio_slack:
+                fail = (f"{fname}: certified peak is {ratio:.1f}x "
+                        f"XLA's {xla['total']} B (pin {max_ratio}x) — "
+                        f"the bound went slack")
+            entry["functions"][fname] = {
+                "peak_bytes": cert.peak_bytes,
+                "xla_total_bytes": None if xla is None else xla["total"],
+                "xla_ratio": None if ratio is None else round(ratio, 2),
+                "status": cert.status,
+                "failure": fail,
+            }
+            if fail:
+                failures += 1
+        rows.append(entry)
+
+    fleet_row: dict = {"name": "tracker-consensus-fleet"}
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        try:
+            from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+            from agentlib_mpc_tpu.ops.solver import SolverOptions
+            from agentlib_mpc_tpu.parallel import multihost
+            from agentlib_mpc_tpu.parallel.fused_admm import (
+                AgentGroup,
+                FusedADMM,
+                FusedADMMOptions,
+            )
+
+            ocp = tracker_ocp()
+            mesh = multihost.fleet_mesh()
+            group = AgentGroup(
+                name="memory-gate", ocp=ocp, n_agents=n_dev,
+                couplings={"shared_u": "u"},
+                solver_options=SolverOptions(max_iter=30))
+            engine = FusedADMM(
+                [group], FusedADMMOptions(max_iterations=8, rho=2.0),
+                mesh=mesh, memory_certify="require")
+            cert = engine.memory_certificate
+            lanes = max(n_dev // int(mesh.devices.size), 1)
+            violations = check_memory_budget(cert, cfg, lanes=lanes)
+            xla = None
+            try:
+                tmpl = engine._step_templates()
+                compiled = engine._step.lower(*tmpl).compile()
+                ma = compiled.memory_analysis()
+                if ma is not None:
+                    xla = {"argument": int(ma.argument_size_in_bytes),
+                           "output": int(ma.output_size_in_bytes),
+                           "temp": int(ma.temp_size_in_bytes),
+                           "alias": int(ma.alias_size_in_bytes)}
+                    xla["total"] = (xla["argument"] + xla["output"]
+                                    - xla["alias"] + xla["temp"])
+            except Exception as exc:  # noqa: BLE001 — AOT quirks
+                fleet_row["xla_error"] = repr(exc)
+            ratio = crosscheck_ratio(cert, xla)
+            if ratio is None:
+                violations.append(
+                    "fused-step XLA cross-check unavailable — the "
+                    "per-lane pin still holds, but the bound is "
+                    "unanchored: " + fleet_row.get("xla_error",
+                                                   "no memory_analysis"))
+            elif ratio < 1.0:
+                violations.append(
+                    f"fused-step certificate {cert.peak_bytes} B does "
+                    f"NOT bound XLA's {xla['total']} B per device")
+            elif ratio is not None and ratio > max_ratio \
+                    and cert.peak_bytes > ratio_slack:
+                violations.append(
+                    f"fused-step certificate is {ratio:.1f}x XLA's "
+                    f"{xla['total']} B (pin {max_ratio}x)")
+            failures += len(violations)
+            fleet_row.update({
+                "certificate": cert.as_dict(),
+                "peak_bytes": cert.peak_bytes,
+                "bytes_per_lane": cert.per_lane_bytes(lanes),
+                "lanes_per_device": lanes,
+                "xla": xla,
+                "xla_ratio": None if ratio is None else round(ratio, 2),
+                "violations": violations,
+            })
+        except Exception as exc:  # noqa: BLE001 — report, not crash
+            fleet_row["error"] = repr(exc)
+            failures += 1
+    else:
+        fleet_row["skipped"] = (
+            f"needs a multi-device mesh; {n_dev} device(s) visible — "
+            f"set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            f"like CI does")
+    return {"examples": rows, "fleet": fleet_row, "failures": failures,
+            "devices": n_dev, "budget": dict(cfg)}

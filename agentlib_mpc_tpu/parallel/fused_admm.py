@@ -106,6 +106,12 @@ _donation_warning_suppressed = False
 _COLLECTIVE_CERT_MEMO: dict = {}
 _COLLECTIVE_CERT_MEMO_MAX = 32
 
+#: memory certificates memoized the same way (ISSUE 13) — keyed by the
+#: engine structure PLUS the donation flag (donation changes the
+#: footprint, not the collective schedule). Values are ``(cert, ocps)``
+#: pinning the group OCPs like the collective memo.
+_MEMORY_CERT_MEMO: dict = {}
+
 
 def _suppress_unusable_donation_warning() -> None:
     """On backends without buffer donation (CPU) jax warns once per
@@ -258,7 +264,8 @@ class FusedADMM:
                  donate_state: bool = False,
                  mesh=None,
                  watchdog_timeout_s: "float | None" = None,
-                 collective_certify: str = "auto"):
+                 collective_certify: str = "auto",
+                 memory_certify: str = "auto"):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -315,7 +322,20 @@ class FusedADMM:
         the watchdog still bounds the damage there); ``"require"``
         refuses anything not proved; ``"off"`` skips (the engine-store
         revival path, which trusts the exported artifact's recorded
-        digest instead of re-tracing)."""
+        digest instead of re-tracing).
+        ``memory_certify``: statically certify the step's per-device
+        peak bytes-resident (:mod:`agentlib_mpc_tpu.lint.jaxpr.memory`
+        — a live-range walk of the traced step, donation- and
+        sharding-aware) and REFUSE a program whose certified peak
+        exceeds the backend device's reported memory capacity
+        (:class:`~agentlib_mpc_tpu.lint.jaxpr.memory.
+        MemoryBudgetExceeded` — the serving plane catches it and sheds
+        the join into the guard ladder instead of OOMing a pod
+        dispatch). ``"auto"`` certifies mesh engines (the trace is
+        already paid for the collective certificate) and, off-mesh,
+        only backends that report a capacity (CPU does not — no trace
+        is paid there); ``"require"`` always certifies and refuses
+        anything not proved; ``"off"`` skips."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -375,6 +395,18 @@ class FusedADMM:
                 f"collective_certify must be 'auto', 'require' or "
                 f"'off', got {collective_certify!r}")
         self.collective_certify = collective_certify
+        if memory_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"memory_certify must be 'auto', 'require' or 'off', "
+                f"got {memory_certify!r}")
+        self.memory_certify = memory_certify
+        #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.memory.
+        #: MemoryCertificate` of the fused step (None when
+        #: ``memory_certify`` skipped it)
+        self.memory_certificate = None
+        #: its digest — rides the engine-store meta next to the
+        #: collective-schedule digest
+        self.memory_digest = None
         #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.
         #: collectives.CollectiveCertificate` of the fused round (mesh
         #: engines only; None for single-device engines and
@@ -400,7 +432,11 @@ class FusedADMM:
         :meth:`shard_args`' padding rebuild reuses."""
         donate = (0,) if self.donate_state else ()
         if self.mesh is None:
-            self._step = jax.jit(self._build_step(), donate_argnums=donate)
+            step_fn = self._build_step()
+            self._step_fn = step_fn
+            self._step = jax.jit(step_fn, donate_argnums=donate)
+            if self._memory_certify_wanted():
+                self._certify_memory_step(None, None, 1)
             return
 
         from jax.experimental.shard_map import shard_map
@@ -451,6 +487,7 @@ class FusedADMM:
             in_specs=(state_spec, per_group_sh, per_group_sh),
             out_specs=(state_spec, per_group_sh, stats_spec),
             check_rep=False)
+        self._step_fn = sharded
         self._step = jax.jit(sharded, donate_argnums=donate)
         # static collective certification (ISSUE 11): prove every psum
         # of the fused round sits on shard-uniform control flow BEFORE
@@ -459,6 +496,8 @@ class FusedADMM:
         # rebuild and the cross-process restore assert against
         if self.collective_certify != "off":
             self._certify_collective_schedule(sharded, axis, n_dev)
+        elif self._memory_certify_wanted():
+            self._certify_memory_step(None, axis, n_dev)
         # consensus-shaped mesh-collective probe (the shared
         # multihost.collective_probe builder — compiled and warmed so
         # the per-round admm_collective_seconds timing never pays, or
@@ -508,26 +547,9 @@ class FusedADMM:
         key = self._collective_cert_key(axis, n_dev)
         hit = _COLLECTIVE_CERT_MEMO.get(key)
         cert = hit[0] if hit is not None else None
+        closed = None
         if cert is None:
-            import numpy as np
-
-            def sds(leaf, n):
-                arr = jnp.asarray(leaf) if not hasattr(leaf, "dtype") \
-                    else leaf
-                return jax.ShapeDtypeStruct((n,) + tuple(np.shape(arr)),
-                                            arr.dtype)
-
-            theta_tmpls = tuple(
-                jax.tree.map(lambda leaf, n=g.n_agents: sds(leaf, n),
-                             g.ocp.default_params())
-                for g in self.groups)
-            state_tmpl = jax.eval_shape(
-                lambda ths: self.init_state(ths), theta_tmpls)
-            masks_tmpl = tuple(
-                jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
-                for g in self.groups)
-            closed = jax.make_jaxpr(sharded)(state_tmpl, theta_tmpls,
-                                             masks_tmpl)
+            closed = jax.make_jaxpr(sharded)(*self._step_templates())
             cert = certify_collectives(closed, allowed_axes=(axis,))
             while len(_COLLECTIVE_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
                 _COLLECTIVE_CERT_MEMO.pop(
@@ -570,6 +592,122 @@ class FusedADMM:
                     "(certified schedule x axis size x ADMM iteration "
                     "budget)").set(float(cert.comm_bytes(
                         while_trips=self.options.max_iterations)))
+        # memory certification rides the same trace (ISSUE 13): the
+        # closed jaxpr is in hand (or one memo-covered re-trace away)
+        # and the live-range walk is milliseconds
+        if self._memory_certify_wanted():
+            self._certify_memory_step(closed, axis, n_dev)
+
+    def _step_templates(self) -> tuple:
+        """(state, thetas, masks) shape templates of the compiled step —
+        what the build-time certifier passes trace on, and what the
+        ``--memory-budget`` gate hands ``self._step.lower`` for the XLA
+        cross-check."""
+        import numpy as np
+
+        def sds(leaf, n):
+            arr = jnp.asarray(leaf) if not hasattr(leaf, "dtype") \
+                else leaf
+            return jax.ShapeDtypeStruct((n,) + tuple(np.shape(arr)),
+                                        arr.dtype)
+
+        theta_tmpls = tuple(
+            jax.tree.map(lambda leaf, n=g.n_agents: sds(leaf, n),
+                         g.ocp.default_params())
+            for g in self.groups)
+        state_tmpl = jax.eval_shape(
+            lambda ths: self.init_state(ths), theta_tmpls)
+        masks_tmpl = tuple(
+            jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
+            for g in self.groups)
+        return state_tmpl, theta_tmpls, masks_tmpl
+
+    def _memory_certify_wanted(self) -> bool:
+        """Whether to run the memory pass at this build: ``"require"``
+        always; ``"auto"`` when the trace is already paid (mesh engines
+        certifying collectives) or the backend reports a capacity worth
+        checking against; ``"off"`` never."""
+        if self.memory_certify == "off":
+            return False
+        if self.memory_certify == "require":
+            return True
+        if self.mesh is not None and self.collective_certify != "off":
+            return True
+        from agentlib_mpc_tpu.lint.jaxpr.memory import device_hbm_bytes
+
+        return device_hbm_bytes() is not None
+
+    def _certify_memory_step(self, closed, axis: "str | None",
+                             n_dev: int) -> None:
+        """Certify the step's per-device peak bytes-resident (ISSUE 13)
+        from ``closed`` (the collective certifier's trace when in hand;
+        re-traced on shape templates otherwise), memoized per engine
+        structure + donation flag, and enforce the capacity policy."""
+        from agentlib_mpc_tpu.lint.jaxpr.memory import certify_memory
+
+        key = (self._collective_cert_key(axis, n_dev),
+               self.donate_state)
+        hit = _MEMORY_CERT_MEMO.get(key)
+        cert = hit[0] if hit is not None else None
+        if cert is None:
+            tmpl = self._step_templates()
+            if closed is None:
+                closed = jax.make_jaxpr(self._step_fn)(*tmpl)
+            donated = None
+            if self.donate_state:
+                # jit donates arg 0 (the FusedState carry): its leaves
+                # are the leading flat invars of the traced step
+                n_state = len(jax.tree_util.tree_leaves(tmpl[0]))
+                donated = tuple(
+                    i < n_state
+                    for i in range(len(closed.jaxpr.invars)))
+            cert = certify_memory(closed, donated_invars=donated)
+            while len(_MEMORY_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
+                _MEMORY_CERT_MEMO.pop(next(iter(_MEMORY_CERT_MEMO)))
+            _MEMORY_CERT_MEMO[key] = (
+                cert, tuple(g.ocp for g in self.groups))
+        self.memory_certificate = cert
+        self.memory_digest = cert.memory_digest
+        self._enforce_memory_certificate(cert)
+
+    def _enforce_memory_certificate(self, cert) -> None:
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            MemoryBudgetExceeded,
+            device_hbm_bytes,
+        )
+
+        if telemetry.enabled():
+            telemetry.gauge(
+                "memory_certified_peak_bytes",
+                "statically certified per-device peak bytes-resident "
+                "of the fused step (lint/jaxpr/memory.py, set at "
+                "engine build)").set(
+                float(cert.peak_bytes),
+                fleet=",".join(g.name for g in self.groups))
+            telemetry.record_device_memory()
+        if cert.status != "proved":
+            if self.memory_certify == "require":
+                raise MemoryBudgetExceeded(
+                    f"fused step's memory footprint is not provable "
+                    f"({cert.describe()}) and memory_certify="
+                    f"'require' was set")
+            logger.info("memory footprint not provable (%s) — the "
+                        "runtime allocator is the only OOM defense",
+                        cert.describe())
+            if cert.status == "unknown":
+                return
+        hbm = device_hbm_bytes()
+        if hbm is not None and cert.peak_bytes > hbm:
+            raise MemoryBudgetExceeded(
+                f"fused step's certified per-device peak "
+                f"({cert.describe()}) exceeds the backend device's "
+                f"reported capacity ({hbm} B) — dispatching would OOM "
+                f"the mesh. Shrink the lane count / slot multiple "
+                f"(lint.jaxpr.memory.plan_capacity inverts the "
+                f"marginal cost), or build with memory_certify='off' "
+                f"to override")
+        logger.info("memory certificate: %s (digest %s)",
+                    cert.describe(), cert.memory_digest)
 
     @staticmethod
     def _with_stage_partition(g: AgentGroup) -> AgentGroup:
@@ -1381,6 +1519,9 @@ class FusedADMM:
             "admm_round_iterations", "ADMM iterations per fused round",
             buckets=telemetry.ITERATION_BUCKETS
             ).observe(float(n_it), fleet=fleet)
+        # measured residency next to the certified ceiling (a no-op on
+        # backends that report no memory stats, e.g. CPU)
+        telemetry.record_device_memory()
 
     def pad_state_rows(self, pads: "dict[int, int]",
                        state: "FusedState | None",
@@ -1426,6 +1567,31 @@ class FusedADMM:
             lam=lam, ex_diff=ex_diff)
         return state, theta_batches
 
+    def _per_lane_bytes_estimate(self, state: "FusedState | None",
+                                 theta_batches) -> tuple:
+        """(bytes, qualifier) of one agent lane's projected per-device
+        footprint: the certificate's per-lane share when the engine
+        carries one (qualifier ``"≈"``), else the lane's carried state
+        + parameter rows alone (qualifier ``"≥"`` — solver temporaries
+        and histories ride on top). Feeds the pad-path warnings so a
+        6→8 pad on a big horizon warns with a byte number, not a
+        ratio."""
+        cert = self.memory_certificate
+        if cert is not None and cert.status != "unknown":
+            lanes = sum(g.n_agents for g in self.groups)
+            if self.mesh is not None:
+                lanes //= max(int(self.mesh.devices.size), 1)
+            return cert.per_lane_bytes(max(lanes, 1)), "≈"
+        total_bytes, total_lanes = 0, 0
+        for gi, g in enumerate(self.groups):
+            rows = []
+            if state is not None:
+                rows += [state.w[gi], state.y[gi], state.z[gi]]
+            rows += list(jax.tree.leaves(theta_batches[gi]))
+            total_bytes += sum(jnp.asarray(leaf).nbytes for leaf in rows)
+            total_lanes += g.n_agents
+        return max(total_bytes // max(total_lanes, 1), 1), "≥"
+
     def _pad_for_mesh(self, n_dev: int, pads: "dict[int, int]",
                       state: FusedState,
                       theta_batches: Sequence[OCPParams]):
@@ -1437,13 +1603,18 @@ class FusedADMM:
         recompiled step) and returns the padded (state, thetas)."""
         total = sum(g.n_agents for g in self.groups)
         n_pad = sum(pads.values())
+        per_lane, qual = self._per_lane_bytes_estimate(
+            state, theta_batches)
+        pad_bytes = -(-n_pad * per_lane // n_dev)
         logger.warning(
             "fused fleet: group(s) %s do not divide the %d-device mesh; "
-            "padding %d masked lane(s) (%.1f%% compute overhead) instead "
+            "padding %d masked lane(s) (%.1f%% compute overhead, "
+            "%s%.2f MiB projected per-device byte overhead) instead "
             "of replicating — the step re-traces once for the padded "
             "shapes",
             [g.name for gi, g in enumerate(self.groups) if pads[gi]],
-            n_dev, n_pad, 100.0 * n_pad / max(total, 1))
+            n_dev, n_pad, 100.0 * n_pad / max(total, 1),
+            qual, pad_bytes / 2**20)
 
         state, theta_batches = self.pad_state_rows(pads, state,
                                                    theta_batches)
@@ -1592,4 +1763,28 @@ def pad_group_to_devices(group: AgentGroup, theta_batch: OCPParams,
             [leaf, jnp.repeat(leaf[-1:], n_pad, axis=0)], axis=0),
         theta_batch)
     new_group = dataclasses.replace(group, n_agents=n + n_pad)
+    logger.warning(
+        "group %r: padding %d → %d lanes for the %d-device mesh "
+        "(%.1f%% compute overhead, ≥%.2f MiB projected per-device byte "
+        "overhead from the padded parameter/solution rows — certify "
+        "the built engine for the exact number: "
+        "FusedADMM(memory_certify=...))",
+        group.name, n, n + n_pad, n_devices, 100.0 * n_pad / max(n, 1),
+        n_pad * _lane_row_bytes(group.ocp, theta_batch) / n_devices
+        / 2**20)
     return new_group, padded, mask
+
+
+def _lane_row_bytes(ocp, theta_batch) -> int:
+    """Bytes one padded lane adds from its carried solution rows
+    (w/y/z) and its parameter row — the floor the pad-path warnings
+    report when no certificate is in hand (solver temporaries and
+    history buffers ride on top)."""
+    theta_rows = sum(
+        jnp.asarray(leaf).nbytes // max(int(jnp.asarray(leaf).shape[0])
+                                        if jnp.asarray(leaf).ndim else 1,
+                                        1)
+        for leaf in jax.tree.leaves(theta_batch))
+    itemsize = jnp.zeros(()).dtype.itemsize
+    return int(theta_rows
+               + (ocp.n_w + ocp.n_g + ocp.n_h) * itemsize)

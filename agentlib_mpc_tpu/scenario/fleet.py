@@ -146,7 +146,8 @@ class ScenarioFleet:
     def __init__(self, group, tree: ScenarioTree,
                  options: ScenarioFleetOptions = ScenarioFleetOptions(),
                  active=None, mesh=None,
-                 collective_certify: str = "auto"):
+                 collective_certify: str = "auto",
+                 memory_certify: str = "auto"):
         """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
         AgentGroup` (couplings only; exchanges are not scenario-lifted).
         ``tree``: the static scenario tree; ``tree.n_scenarios == 1``
@@ -156,7 +157,12 @@ class ScenarioFleet:
         a 2-D ``("agents", "scenarios")`` mesh
         (:func:`~agentlib_mpc_tpu.parallel.multihost.scenario_mesh`).
         ``collective_certify``: "auto" | "require" | "off", the
-        :class:`FusedADMM` policy verbatim."""
+        :class:`FusedADMM` policy verbatim. ``memory_certify``: same
+        vocabulary for the static per-device peak-bytes certificate
+        (:mod:`agentlib_mpc_tpu.lint.jaxpr.memory`) — the scenario axis
+        multiplies every lane buffer by S, which is exactly the
+        projection the certificate prices before a robust fleet can
+        OOM a pod dispatch."""
         from agentlib_mpc_tpu.parallel.fused_admm import FusedADMM
 
         if group.exchanges:
@@ -186,6 +192,13 @@ class ScenarioFleet:
         self.collective_certify = collective_certify
         self.collective_certificate = None
         self.collective_schedule_digest = None
+        if memory_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"memory_certify must be 'auto', 'require' or 'off', "
+                f"got {memory_certify!r}")
+        self.memory_certify = memory_certify
+        self.memory_certificate = None
+        self.memory_digest = None
         self.mesh = mesh
         self._membership, self._counts = self._build_membership()
         self._compile_step()
@@ -468,7 +481,11 @@ class ScenarioFleet:
         self._scen_weight = jnp.asarray(
             self.tree.probabilities) * float(self.S)
         if self.mesh is None:
-            self._step = jax.jit(self._build_step())
+            step_fn = self._build_step()
+            self._step_fn = step_fn
+            self._step = jax.jit(step_fn)
+            if self._memory_certify_wanted():
+                self._certify_memory(None)
             return
 
         from jax.experimental.shard_map import shard_map
@@ -514,9 +531,12 @@ class ScenarioFleet:
             in_specs=(state_spec, sh_as, sh_a, sh_s, sh_s),
             out_specs=(state_spec, sh_as, stats_spec),
             check_rep=False)
+        self._step_fn = sharded
         self._step = jax.jit(sharded)
         if self.collective_certify != "off":
             self._certify(sharded, names)
+        elif self._memory_certify_wanted():
+            self._certify_memory(None)
 
     def _certify(self, sharded, axis_names: tuple) -> None:
         """Trace the sharded step on shape templates and certify the
@@ -528,23 +548,10 @@ class ScenarioFleet:
             certify_collectives,
         )
 
-        g = self.group
-
-        def sds(leaf):
-            arr = jnp.asarray(leaf)
-            return jax.ShapeDtypeStruct(
-                (g.n_agents, self.S) + arr.shape, arr.dtype)
-
-        theta_tmpl = jax.tree.map(sds, g.ocp.default_params())
-        state_tmpl = jax.eval_shape(self.init_state, theta_tmpl)
-        mask_tmpl = jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
-        memb_tmpl = jax.ShapeDtypeStruct(
-            tuple(self._membership.shape), self._membership.dtype)
-        wgt_tmpl = jax.ShapeDtypeStruct((self.S,),
-                                        self._scen_weight.dtype)
-        closed = jax.make_jaxpr(sharded)(
-            state_tmpl, theta_tmpl, mask_tmpl, memb_tmpl, wgt_tmpl)
+        closed = jax.make_jaxpr(sharded)(*self._step_templates())
         cert = certify_collectives(closed, allowed_axes=axis_names)
+        if self._memory_certify_wanted():
+            self._certify_memory(closed)
         self.collective_certificate = cert
         self.collective_schedule_digest = cert.schedule_digest
         if cert.status == "refuted":
@@ -567,6 +574,87 @@ class ScenarioFleet:
         else:
             logger.info("scenario schedule proved: %s (digest %s)",
                         cert.describe(), cert.schedule_digest)
+
+    def _step_templates(self) -> tuple:
+        """(state, theta, mask, membership, weight) shape templates of
+        the compiled step — shared by the collective and memory
+        certifier traces and the gate's XLA cross-check."""
+        g = self.group
+
+        def sds(leaf):
+            arr = jnp.asarray(leaf)
+            return jax.ShapeDtypeStruct(
+                (g.n_agents, self.S) + arr.shape, arr.dtype)
+
+        theta_tmpl = jax.tree.map(sds, g.ocp.default_params())
+        state_tmpl = jax.eval_shape(self.init_state, theta_tmpl)
+        mask_tmpl = jax.ShapeDtypeStruct((g.n_agents,), jnp.bool_)
+        memb_tmpl = jax.ShapeDtypeStruct(
+            tuple(self._membership.shape), self._membership.dtype)
+        wgt_tmpl = jax.ShapeDtypeStruct((self.S,),
+                                        self._scen_weight.dtype)
+        return state_tmpl, theta_tmpl, mask_tmpl, memb_tmpl, wgt_tmpl
+
+    def _memory_certify_wanted(self) -> bool:
+        """The :class:`FusedADMM` policy verbatim: ``"require"``
+        always, ``"auto"`` when the trace is already paid (mesh
+        engines) or the backend reports a capacity, ``"off"`` never."""
+        if self.memory_certify == "off":
+            return False
+        if self.memory_certify == "require":
+            return True
+        if self.mesh is not None and self.collective_certify != "off":
+            return True
+        from agentlib_mpc_tpu.lint.jaxpr.memory import device_hbm_bytes
+
+        return device_hbm_bytes() is not None
+
+    def _certify_memory(self, closed) -> None:
+        """Certify the robust round's per-device peak bytes (ISSUE 13)
+        and enforce the capacity policy — the scenario axis multiplies
+        every lane buffer by S, which is exactly what this prices."""
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            MemoryBudgetExceeded,
+            certify_memory,
+            device_hbm_bytes,
+        )
+
+        if closed is None:
+            closed = jax.make_jaxpr(self._step_fn)(
+                *self._step_templates())
+        cert = certify_memory(closed)
+        self.memory_certificate = cert
+        self.memory_digest = cert.memory_digest
+        if telemetry.enabled():
+            telemetry.gauge(
+                "memory_certified_peak_bytes",
+                "statically certified per-device peak bytes-resident "
+                "of the fused step (lint/jaxpr/memory.py, set at "
+                "engine build)").set(
+                float(cert.peak_bytes),
+                fleet=f"scenario:{self.group.name}")
+        if cert.status != "proved":
+            if self.memory_certify == "require":
+                raise MemoryBudgetExceeded(
+                    f"scenario round's memory footprint is not "
+                    f"provable ({cert.describe()}) and memory_certify="
+                    f"'require' was set")
+            logger.info("scenario memory footprint not provable (%s)",
+                        cert.describe())
+            if cert.status == "unknown":
+                return
+        hbm = device_hbm_bytes()
+        if hbm is not None and cert.peak_bytes > hbm:
+            raise MemoryBudgetExceeded(
+                f"scenario round's certified per-device peak "
+                f"({cert.describe()}) exceeds the backend device's "
+                f"reported capacity ({hbm} B) — dispatching would OOM "
+                f"the mesh. Fewer scenario branches per device "
+                f"(lint.jaxpr.memory.plan_capacity prices the "
+                f"scenario marginal), or memory_certify='off' to "
+                f"override")
+        logger.info("scenario memory certificate: %s (digest %s)",
+                    cert.describe(), cert.memory_digest)
 
     # -- public API -----------------------------------------------------------
 
@@ -598,6 +686,7 @@ class ScenarioFleet:
             "scenario_rounds_total",
             "fused scenario-tree robust rounds run").inc(
             group=self.group.name)
+        telemetry.record_device_memory()
         return out
 
     def actuated_u0(self, state: ScenarioState) -> jnp.ndarray:
@@ -655,6 +744,20 @@ def pad_scenarios(tree: ScenarioTree, theta_batch, n_shards: int):
     n_pad = (-S) % n_shards
     if n_pad == 0:
         return tree, theta_batch
+    branch_bytes = sum(
+        jnp.asarray(leaf).nbytes
+        // max(int(jnp.asarray(leaf).shape[1])
+               if jnp.asarray(leaf).ndim > 1 else 1, 1)
+        for leaf in jax.tree.leaves(theta_batch))
+    logger.warning(
+        "scenario tree: padding %d → %d branches for the %d-shard "
+        "scenario axis (%.1f%% compute overhead, ≈%.2f MiB projected "
+        "per-scenario-shard byte overhead from the padded parameter "
+        "branches — "
+        "the built fleet's memory certificate prices the exact total: "
+        "ScenarioFleet(memory_certify=...))",
+        S, S + n_pad, n_shards, 100.0 * n_pad / max(S, 1),
+        n_pad * branch_bytes / n_shards / 2**20)
     node_of = tuple(
         nodes + tuple(1_000_000 + i for i in range(n_pad))
         for nodes in tree.node_of)

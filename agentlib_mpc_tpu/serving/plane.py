@@ -76,6 +76,11 @@ logger = logging.getLogger(__name__)
 class JoinReceipt(NamedTuple):
     tenant_id: str
     bucket: str              # bucket digest (artifact/log key)
+    #: slot index, or -1 for a CAPACITY-SHED join: the bucket growth
+    #: (or initial build) that would have admitted this tenant was
+    #: refused by the memory certificate — the tenant is registered and
+    #: its submissions shed into its guard ladder until capacity frees
+    #: (``readmit_tenant``), exactly like a health eviction
     slot: int
     capacity: int
     #: the engine came out of the compile cache (a structurally
@@ -116,7 +121,9 @@ class ServingPlane:
                  max_engines: "int | None" = None,
                  cache: "CompileCache | None" = None,
                  mesh=None,
-                 engine_store=None):
+                 engine_store=None,
+                 memory_certify: str = "auto",
+                 hbm_bytes: "int | str | None" = "auto"):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -186,6 +193,28 @@ class ServingPlane:
             self.engine_store = EngineStore()
         else:
             self.engine_store = EngineStore(str(engine_store))
+        #: memory-capacity consult (ISSUE 13): bucket engines carry the
+        #: static per-device peak-bytes certificate
+        #: (``lint/jaxpr/memory.py``) and the plane projects it before
+        #: GROWING a bucket — a join whose grown engine would exceed
+        #: ``hbm_bytes`` is shed into the tenant's PR 2 guard ladder
+        #: (JoinReceipt.slot == -1) instead of OOMing the round.
+        #: ``hbm_bytes="auto"`` reads the backend device's reported
+        #: capacity (None on CPU → consult disabled); an int forces a
+        #: budget (tests, planned deployments below the physical HBM).
+        if memory_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"memory_certify must be 'auto', 'require' or 'off', "
+                f"got {memory_certify!r}")
+        self.memory_certify = memory_certify
+        if hbm_bytes == "auto":
+            from agentlib_mpc_tpu.lint.jaxpr.memory import (
+                device_hbm_bytes,
+            )
+
+            hbm_bytes = device_hbm_bytes() \
+                if memory_certify != "off" else None
+        self.hbm_bytes = int(hbm_bytes) if hbm_bytes else None
         self.dispatcher = PipelinedDispatcher(pipelined,
                                               timeout_s=watchdog_timeout_s)
         self.queue = AdmissionQueue(queue_limit, default_deadline_s)
@@ -223,19 +252,31 @@ class ServingPlane:
             raise ValueError(f"tenant {spec.tenant_id!r} already joined")
         t0 = time.perf_counter()
         key = bucket_key(spec)
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            MemoryBudgetExceeded,
+        )
+
         bucket = self._buckets.get(key)
         cached = True
-        if bucket is None:
-            bucket, cached = self._acquire_bucket(key, spec,
-                                                  n_needed=1)
-        elif bucket.free_slots == 0:
-            bucket, cached = self._acquire_bucket(
-                key, spec, n_needed=bucket.n_active + 1,
-                migrate_from=bucket)
-        else:
-            # joining a LIVE bucket: the compiled engine is reused
-            # without even a cache lookup — still a hit in the metric
-            self.cache.note_hit(label=key.digest)
+        try:
+            if bucket is None:
+                bucket, cached = self._acquire_bucket(key, spec,
+                                                      n_needed=1)
+            elif bucket.free_slots == 0:
+                bucket, cached = self._acquire_bucket(
+                    key, spec, n_needed=bucket.n_active + 1,
+                    migrate_from=bucket)
+            else:
+                # joining a LIVE bucket: the compiled engine is reused
+                # without even a cache lookup — still a hit in the metric
+                self.cache.note_hit(label=key.digest)
+        except MemoryBudgetExceeded as exc:
+            # the grown (or initial) engine would exceed the device's
+            # memory: shed the JOIN into the guard ladder — sitting
+            # tenants keep their round; this one degrades until
+            # capacity frees (readmit_tenant / the health re-admission
+            # window picks it back up)
+            return self._capacity_shed_join(spec, key, t0, exc)
         slot = bucket.admit(spec.tenant_id, spec.theta)
         self._register_tenant(spec.tenant_id, key, spec)
         if telemetry.enabled():
@@ -248,6 +289,28 @@ class ServingPlane:
             "cached engine" if cached else "cold build", 1e3 * latency)
         return JoinReceipt(spec.tenant_id, key.digest, slot,
                            bucket.capacity, cached, latency)
+
+    def _capacity_shed_join(self, spec: TenantSpec, key, t0: float,
+                            exc) -> JoinReceipt:
+        """A join the memory certificate refused: register the tenant
+        (spec + guard + ladder) WITHOUT a slot — the evicted-tenant
+        machinery then sheds every submission into its PR 2 guard
+        ladder, and :meth:`readmit_tenant` splices it in when capacity
+        frees. The sitting tenants' round is never touched."""
+        self._register_tenant(spec.tenant_id, key, spec)
+        self._evicted[spec.tenant_id] = key
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_capacity_shed_joins_total",
+                "joins refused by the bucket memory certificate "
+                "(growth would exceed the device's HBM) and shed into "
+                "the guard ladder").inc(bucket=key.digest)
+        logger.warning(
+            "tenant %s join shed into its guard ladder — bucket %s "
+            "cannot grow within the %s-byte device memory budget: %s",
+            spec.tenant_id, key.digest, self.hbm_bytes, exc)
+        return JoinReceipt(spec.tenant_id, key.digest, -1, 0, False,
+                           time.perf_counter() - t0)
 
     def leave(self, tenant_id: str) -> None:
         key = self._tenant_bucket.pop(tenant_id)
@@ -286,9 +349,40 @@ class ServingPlane:
                            * math.ceil(n_needed / self.slot_multiple))
         engine_key = (key, capacity, self._options_key(), self.donate,
                       self._mesh_key())
+        # consult the sitting engine's memory certificate BEFORE paying
+        # the grown build: its per-lane share projects the new capacity
+        # linearly (lane-batched buffers dominate), so a doomed growth
+        # sheds without tracing anything (the post-build certificate
+        # check below is the exact backstop)
+        if self.hbm_bytes is not None and migrate_from is not None \
+                and self.memory_certify != "off":
+            from agentlib_mpc_tpu.lint.jaxpr.memory import (
+                MemoryBudgetExceeded,
+            )
+
+            cert = getattr(migrate_from.engine, "memory_certificate",
+                           None)
+            if cert is not None and cert.status != "unknown":
+                projected = -(-cert.peak_bytes * int(capacity)
+                              // max(migrate_from.capacity, 1))
+                if projected > self.hbm_bytes:
+                    raise MemoryBudgetExceeded(
+                        f"growing bucket {key.digest} "
+                        f"{migrate_from.capacity} → {capacity} slots "
+                        f"projects ≈{projected} B peak per device "
+                        f"(certified {cert.peak_bytes} B at "
+                        f"{migrate_from.capacity}) against the "
+                        f"{self.hbm_bytes} B budget")
+
+        # a plane with a known memory budget needs certificates to
+        # consult — "auto" engines would skip the trace on CPU
+        engine_memory_certify = self.memory_certify
+        if self.hbm_bytes is not None and engine_memory_certify == "auto":
+            engine_memory_certify = "require"
 
         def make_engine(qp_fast_path: str,
-                        collective_certify: str = "auto"):
+                        collective_certify: str = "auto",
+                        memory_certify: "str | None" = None):
             group = AgentGroup(
                 name=f"bucket-{key.digest}",
                 ocp=spec.ocp, n_agents=capacity,
@@ -301,7 +395,10 @@ class ServingPlane:
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
                 donate_state=self.donate, mesh=self.mesh,
-                collective_certify=collective_certify)
+                collective_certify=collective_certify,
+                memory_certify=(engine_memory_certify
+                                if memory_certify is None
+                                else memory_certify))
 
         def warm_args(engine):
             # throwaway template inputs, mesh-placed for sharded
@@ -355,6 +452,12 @@ class ServingPlane:
                         # is the pod-hang class, ISSUE 11)
                         "collective_digest":
                             engine.collective_schedule_digest,
+                        # the certified memory footprint's identity —
+                        # a restore into a process whose fresh build
+                        # would certify a DIFFERENT footprint (other
+                        # dtypes, other capacity math) is visible the
+                        # same way a schedule drift is
+                        "memory_digest": engine.memory_digest,
                     })
                 except Exception:  # noqa: BLE001 - store is best-effort
                     logger.warning(
@@ -378,10 +481,14 @@ class ServingPlane:
                 # certified with at export; the engine carries that
                 # digest so checkpoint/supervisor identity checks keep
                 # working against revived engines.
+                # revival must stay trace-free: both certifications off;
+                # the artifact's recorded digests carry the identities
                 engine = make_engine(meta.get("qp_fast_path", "off"),
-                                     collective_certify="off")
+                                     collective_certify="off",
+                                     memory_certify="off")
                 engine.collective_schedule_digest = \
                     meta.get("collective_digest")
+                engine.memory_digest = meta.get("memory_digest")
                 install_exported_step(
                     engine, blob,
                     warm_args=warm_args(engine) if self.warm_on_build
@@ -405,6 +512,24 @@ class ServingPlane:
             restorer = restore_from_store
         engine, hit, _latency = self.cache.get_or_build(
             engine_key, build, label=key.digest, restorer=restorer)
+        if self.hbm_bytes is not None:
+            # exact backstop for FORCED budgets the device itself does
+            # not report (the engine's own build check covers reported
+            # capacities): refuse the certified-over-budget engine —
+            # it stays cached, so a later retry at freed capacity is
+            # still a hit
+            cert = getattr(engine, "memory_certificate", None)
+            if cert is not None and cert.status != "unknown" \
+                    and cert.peak_bytes > self.hbm_bytes:
+                from agentlib_mpc_tpu.lint.jaxpr.memory import (
+                    MemoryBudgetExceeded,
+                )
+
+                raise MemoryBudgetExceeded(
+                    f"bucket {key.digest} at capacity {capacity} "
+                    f"certifies {cert.peak_bytes} B peak per device "
+                    f"against the {self.hbm_bytes} B budget "
+                    f"({cert.describe()})")
         bucket = SlotPlane(engine, spec.ocp, spec.theta)
         if migrate_from is not None:
             self._stash_flush(key)       # deliver the old plane's round
@@ -784,5 +909,13 @@ class ServingPlane:
                       "shed_deadline": self.queue.shed_deadline},
             "watchdog": {"stalls": self.dispatcher.stalls,
                          "sync_fallback": self.dispatcher.sync_fallback},
+            "memory": {
+                "hbm_bytes": self.hbm_bytes,
+                "certified_peak_bytes": {
+                    key.digest: getattr(
+                        b.engine, "memory_certificate", None)
+                    and b.engine.memory_certificate.peak_bytes
+                    for key, b in self._buckets.items()},
+            },
             "rounds": self.rounds,
         }

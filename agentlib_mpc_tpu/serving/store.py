@@ -7,7 +7,11 @@ tracing (seconds each) were still paid on every crash restart. The
 store persists what those rungs produce: the engine's exported step
 (portable StableHLO, :mod:`agentlib_mpc_tpu.parallel.export`) plus a
 small metadata record (resolved qp routing, capacity, mesh identity,
-donate flag). A fresh process then *revives* the engine — constructs
+donate flag, and the two build-time proof digests — the certified
+collective-schedule digest and the certified memory-footprint digest,
+so a restore into a process whose fresh build would certify a
+DIFFERENT schedule or footprint is visible without re-tracing). A
+fresh process then *revives* the engine — constructs
 the cheap Python object with certification forced off, installs the
 deserialized step, and pays one persistent-cache-covered XLA compile —
 instead of rebuilding it.

@@ -53,7 +53,8 @@ __all__ = [
     "SpanRecord", "SpanRecorder", "NOOP_SPAN",
     "metrics", "recorder", "span", "current_span",
     "configure", "enabled", "counter", "gauge", "histogram",
-    "solver_metrics", "serving_metrics", "install_jax_hooks", "reset",
+    "solver_metrics", "serving_metrics", "install_jax_hooks",
+    "record_device_memory", "reset",
 ]
 
 
@@ -147,6 +148,52 @@ def serving_metrics(registry: "MetricsRegistry | None" = None) -> dict:
             "serving_round_seconds",
             "wall-clock seconds per serve_round call"),
     }
+
+
+def record_device_memory(registry: "MetricsRegistry | None" = None
+                         ) -> None:
+    """Sample ``device.memory_stats()`` of every local device into the
+    ``device_memory_bytes_in_use`` gauge (labelled ``device=<id>``).
+
+    Guarded: backends that report no memory stats (CPU returns None)
+    write nothing — the gauge simply stays absent there, which is how
+    dashboards distinguish "no accelerator" from "0 bytes". Called at
+    engine build and per recorded round next to the statically
+    certified ``memory_certified_peak_bytes`` gauge, so the proved
+    ceiling and the measured residency sit side by side."""
+    reg = registry or DEFAULT
+    if not reg.enabled:
+        return
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend init races / no jax
+        return
+    samples = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-device API variance
+            stats = None
+        if not stats:
+            continue
+        used = stats.get("bytes_in_use")
+        if used is not None:
+            samples.append((str(d.id), float(used)))
+    if not samples:
+        # declare nothing: the documented contract is that the FAMILY
+        # is absent on backends that report no memory — dashboards key
+        # "no accelerator" on absence, which an empty declared family
+        # in the exports would break
+        return
+    gauge = reg.gauge(
+        "device_memory_bytes_in_use",
+        "bytes currently allocated on each local accelerator device "
+        "(from device.memory_stats(); absent on backends that do not "
+        "report memory, e.g. CPU)")
+    for dev_id, used in samples:
+        gauge.set(used, device=dev_id)
 
 
 def install_jax_hooks(registry: "MetricsRegistry | None" = None

@@ -797,6 +797,31 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["collective_certificates"] = collectives_gate_summary()
     except Exception as exc:
         payload["collective_certificates"] = {"error": repr(exc)}
+    # memory certificates (ISSUE 13): per-fleet certified peak, the XLA
+    # memory_analysis cross-check ratio, and the capacity-planner table
+    # — "how many agents fit one device" recorded next to how fast the
+    # round ran. Planner HBM: the device's reported capacity, or a
+    # nominal 16 GiB when the backend reports none (CPU), noted.
+    try:
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            device_hbm_bytes,
+            memory_gate_summary,
+            plan_capacity,
+        )
+        from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+        from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+
+        mem = memory_gate_summary()
+        hbm = device_hbm_bytes()
+        plan = plan_capacity(
+            tracker_ocp(), FusedADMMOptions(max_iterations=8, rho=2.0),
+            hbm_bytes=hbm if hbm else 16 * 2**30, refine=False)
+        mem["capacity_plan"] = dict(
+            plan.as_dict(),
+            hbm_source="device" if hbm else "nominal-16GiB")
+        payload["memory_certificates"] = mem
+    except Exception as exc:
+        payload["memory_certificates"] = {"error": repr(exc)}
     # banded-vs-dense eval+jac cost comparison (lint/jaxpr cost model):
     # the analytical crossover evidence behind jacobian="auto", recorded
     # next to the measured phases (PERF.md round 8; the modeled dense

@@ -1,0 +1,438 @@
+"""Static memory certifier (ISSUE 13): live-range proofs, identity
+pins, the capacity planner, and the serving plane's capacity-shed path.
+
+Three layers:
+
+* **adversarial corpus** over :func:`certify_memory` — the rules the
+  tentpole names (scan-body peak NOT multiplied by trips, cond at
+  max-of-branches, opaque-callback lower-bound honesty, a deliberately
+  leaked long live range caught and named);
+* **degenerate-identity pins** on the fused engines — donation's
+  certificate delta equals the FusedState's modeled bytes exactly, the
+  S=1 scenario fleet matches the routing-matched flat engine, the
+  sharded per-device peak divides the unsharded one;
+* **ground truth + inversion** — XLA's own ``memory_analysis`` bounded
+  from above on menu entries, the capacity planner validated by
+  building fleets at the planned size and one lane beyond on the
+  8-virtual-device mesh, a budget violation naming an injected
+  full-horizon copy, and a join the certificate refuses shedding into
+  the guard ladder instead of killing the round.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.lint.jaxpr.memory import (
+    certify_memory,
+    check_memory_budget,
+    crosscheck_ratio,
+    engine_memory_certificate,
+    modeled_buffer_bytes,
+    plan_capacity,
+    xla_memory_analysis,
+)
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+)
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+@pytest.fixture(scope="module")
+def small_engine(ocp):
+    """One shared 2-lane certified engine — the XLA cross-check, the
+    mutation test and the digest pin all read it without re-building."""
+    return FusedADMM(
+        [AgentGroup(name="mem-test", ocp=ocp, n_agents=2,
+                    couplings={"shared_u": "u"},
+                    solver_options=SolverOptions(max_iter=30))],
+        FusedADMMOptions(max_iterations=8, rho=2.0),
+        memory_certify="require")
+
+
+def _tracker_group(ocp, n, **kw):
+    kw.setdefault("solver_options", SolverOptions(max_iter=30))
+    return AgentGroup(name="mem-test", ocp=ocp, n_agents=n,
+                      couplings={"shared_u": "u"}, **kw)
+
+
+# --------------------------------------------------------------------------
+# adversarial corpus: the walker's rules
+# --------------------------------------------------------------------------
+
+class TestWalkerRules:
+    def test_scan_body_peak_not_multiplied_by_trips(self):
+        trips = 64
+        big = 256 * 256 * 8            # the body temp, f64
+
+        def f(x):
+            def body(c, _):
+                t = jnp.outer(c, c)            # (256, 256) temp
+                return c + t.sum(axis=1) * 1e-9, ()
+            c, _ = jax.lax.scan(body, x, None, length=trips)
+            return c
+
+        cert = certify_memory(f, jnp.ones((256,)))
+        assert cert.proved
+        # one body-peak + in-flight copies, NOT trips x the body temp
+        assert big < cert.peak_bytes < 4 * big
+        assert cert.peak_bytes < trips * big / 4
+
+    def test_cond_charged_at_max_of_branches(self):
+        def heavy(x):
+            return jnp.outer(x, x).sum(axis=0)
+
+        def light(x):
+            return x * 2.0
+
+        def one(x, p):
+            return jax.lax.cond(p, heavy, light, x)
+
+        def both(x, p):
+            a = jax.lax.cond(p, heavy, light, x)
+            b = jax.lax.cond(p, heavy, light, x + 1.0)
+            return a + b
+
+        x = jnp.ones((256,))
+        c_one = certify_memory(one, x, jnp.asarray(True))
+        big = 256 * 256 * 8
+        # max-of-branches: the heavy branch's temp, once
+        assert big < c_one.peak_bytes < 2.5 * big
+        # two sequential conds do NOT sum to 2x (live ranges disjoint:
+        # the first branch temp is dead before the second runs)
+        c_two = certify_memory(both, x, jnp.asarray(True))
+        assert c_two.peak_bytes < 2 * big
+
+    def test_opaque_callback_is_honest_lower_bound(self):
+        def f(x):
+            y = jax.pure_callback(
+                lambda v: np.asarray(v),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y * 2.0
+
+        cert = certify_memory(f, jnp.ones((128,)))
+        assert cert.status == "lower_bound"
+        assert not cert.proved
+        assert "pure_callback" in cert.opaque
+        # the visible buffers are still a floor
+        assert cert.peak_bytes >= 2 * 128 * 8
+
+    def test_leaked_long_live_range_caught_and_named(self):
+        n = 512
+
+        def leaky(x):
+            hoard = jnp.outer(x, x) + 1.0      # lives to the very end
+            y = jnp.outer(x, 2.0 * x).sum(axis=0)
+            z = jnp.sin(y).sum()
+            return z + hoard[0, 0]             # late use pins the range
+
+        def frugal(x):
+            a = (jnp.outer(x, x) + 1.0)[0, 0]  # dies immediately
+            y = jnp.outer(x, 2.0 * x).sum(axis=0)
+            z = jnp.sin(y).sum()
+            return z + a
+
+        x = jnp.ones((n,))
+        big = n * n * 8
+        c_leak = certify_memory(leaky, x)
+        c_ok = certify_memory(frugal, x)
+        # the leak holds BOTH outer products live at once
+        assert c_leak.peak_bytes >= 2 * big
+        assert c_ok.peak_bytes < c_leak.peak_bytes
+        # ...and the certificate names it, source line included
+        top = c_leak.top_buffers[0]
+        assert top[0] >= big
+        assert "test_static_memory" in top[2]
+
+    def test_donation_aliases_matching_output(self):
+        def step(state, theta):
+            return state * 2.0 + theta, theta.sum()
+
+        s = jnp.ones((4096,))
+        plain = certify_memory(step, s, s)
+        donated = certify_memory(step, s, s, donate_argnums=(0,))
+        nbytes = modeled_buffer_bytes((4096,), s.dtype)
+        assert plain.peak_bytes - donated.peak_bytes == nbytes
+        assert donated.donated_aliased_bytes == nbytes
+        assert plain.memory_digest != donated.memory_digest
+
+    def test_shard_map_divides_sharded_operands(self, eight_devices):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(eight_devices), ("agents",))
+
+        def body(a):
+            t = a * 2.0
+            return t + jax.lax.psum(t.sum(), "agents")
+
+        sm = shard_map(body, mesh=mesh, in_specs=(P("agents"),),
+                       out_specs=P("agents"), check_rep=False)
+        x = jnp.ones((64, 128))
+        sharded = certify_memory(jax.make_jaxpr(jax.jit(sm))(x))
+        flat = certify_memory(lambda a: a * 2.0 + (a * 2.0).sum(), x)
+        assert sharded.axis_sizes == {"agents": 8}
+        # the sharded operands (and the body temps) divide by the mesh;
+        # only alignment + the scalar psum keep the ratio below exactly 8
+        assert flat.peak_bytes / sharded.peak_bytes > 6.0
+
+    def test_cost_estimate_carries_peak_bytes(self):
+        from agentlib_mpc_tpu.lint.jaxpr import op_cost
+
+        est = op_cost(lambda x: jnp.sin(x * 2.0).sum(), jnp.ones((64,)))
+        assert est.peak_bytes > 0
+        assert est.per_primitive_peak_bytes
+        assert est.as_dict()["peak_bytes"] == est.peak_bytes
+
+
+# --------------------------------------------------------------------------
+# calibration: the certificate bounds XLA's own numbers
+# --------------------------------------------------------------------------
+
+class TestXlaCrossCheck:
+    def test_simple_chain_bounds_xla(self):
+        def f(x):
+            return jnp.sin(x @ x.T).sum()
+
+        x = jnp.ones((32, 16))
+        cert = certify_memory(f, x)
+        xla = xla_memory_analysis(f, x)
+        ratio = crosscheck_ratio(cert, xla)
+        assert ratio is not None and ratio >= 1.0
+
+    @pytest.mark.parametrize("name", ["LinearRCZone/colloc-d1",
+                                      "OneRoom/shooting"])
+    def test_menu_entry_bounds_xla(self, name):
+        # the full 8-entry sweep is the --memory-budget CI gate; two
+        # structurally distinct entries pin the property in the tier
+        from agentlib_mpc_tpu.lint.jaxpr.examples import build_example
+
+        ocp = build_example(name)
+        theta = ocp.default_params()
+        w0 = jnp.zeros((ocp.n_w,))
+        for fn in (ocp.nlp.f, ocp.nlp.g, ocp.nlp.h):
+            cert = certify_memory(fn, w0, theta)
+            assert cert.proved
+            ratio = crosscheck_ratio(cert, xla_memory_analysis(
+                fn, w0, theta))
+            assert ratio is not None and ratio >= 1.0
+
+    def test_fused_step_bounds_xla(self, small_engine):
+        engine = small_engine
+        cert = engine.memory_certificate
+        assert cert is not None and cert.proved
+        tmpl = engine._step_templates()
+        ma = engine._step.lower(*tmpl).compile().memory_analysis()
+        xla_total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                     - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+        assert cert.peak_bytes >= xla_total
+
+
+# --------------------------------------------------------------------------
+# degenerate-identity pins on the engines
+# --------------------------------------------------------------------------
+
+class TestEngineIdentities:
+    def test_donation_saves_exactly_one_fused_state(self, ocp,
+                                                    small_engine):
+        opts = FusedADMMOptions(max_iterations=8, rho=2.0)
+        plain = small_engine
+        donated = FusedADMM([_tracker_group(ocp, 2)], opts,
+                            donate_state=True, memory_certify="require")
+        state_tmpl = plain._step_templates()[0]
+        state_bytes = sum(
+            modeled_buffer_bytes(leaf.shape, leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(state_tmpl))
+        delta = (plain.memory_certificate.peak_bytes
+                 - donated.memory_certificate.peak_bytes)
+        assert delta == state_bytes
+        assert donated.memory_certificate.donated_aliased_bytes \
+            == state_bytes
+
+    def test_s1_scenario_certificate_matches_flat(self, ocp):
+        from agentlib_mpc_tpu.scenario import ScenarioFleet
+        from agentlib_mpc_tpu.scenario.fleet import ScenarioFleetOptions
+        from agentlib_mpc_tpu.scenario.tree import single_scenario
+
+        # match the scenario fleet's routing exactly: it solves with
+        # solve_nlp (no QP fast path) and carries no quarantine
+        group = _tracker_group(ocp, 4, qp_fast_path="off")
+        flat = FusedADMM(
+            [group],
+            FusedADMMOptions(max_iterations=8, rho=2.0,
+                             quarantine=False),
+            memory_certify="require")
+        fleet = ScenarioFleet(
+            group, single_scenario(),
+            ScenarioFleetOptions(max_iterations=8, rho=2.0),
+            memory_certify="require", collective_certify="off")
+        a = flat.memory_certificate.peak_bytes
+        b = fleet.memory_certificate.peak_bytes
+        assert abs(a - b) / max(a, b) < 0.10
+
+    def test_sharded_peak_divides_unsharded(self, ocp, eight_devices):
+        from agentlib_mpc_tpu.parallel import fleet_mesh
+
+        opts = FusedADMMOptions(max_iterations=8, rho=2.0)
+        flat = FusedADMM([_tracker_group(ocp, 16)], opts,
+                         memory_certify="require")
+        mesh = fleet_mesh()
+        sharded = FusedADMM([_tracker_group(ocp, 16)], opts, mesh=mesh,
+                            memory_certify="require")
+        c_flat = flat.memory_certificate
+        c_mesh = sharded.memory_certificate
+        assert c_mesh.axis_sizes == {"agents": 8}
+        # 16 lanes sharded over 8 devices: the lane-batched buffers
+        # divide by 8; replicated means/schedules and alignment keep
+        # the ratio below exactly 8
+        assert c_flat.peak_bytes / c_mesh.peak_bytes > 2.5
+
+    def test_memory_digest_rides_engine(self, small_engine):
+        assert small_engine.memory_digest \
+            == small_engine.memory_certificate.memory_digest
+        assert small_engine.memory_digest is not None
+
+
+# --------------------------------------------------------------------------
+# budgets: the mutation direction
+# --------------------------------------------------------------------------
+
+class TestBudgetMutation:
+    def test_injected_full_horizon_copy_names_the_eqn(self, small_engine):
+        engine = small_engine
+        base = engine.memory_certificate
+        lanes = 2
+        # pin the budget just above the clean round's footprint...
+        cfg = {"max_step_bytes_per_lane":
+               int(base.per_lane_bytes(lanes) * 1.2)}
+        assert check_memory_budget(base, cfg, lanes=lanes) == []
+
+        # ...then park a gratuitous full-horizon buffer copy across the
+        # round (the leak held live past the step by its late use)
+        def mutated_step(state, thetas, masks):
+            gratuitous_copy = jnp.repeat(state.w[0], 2048, axis=0) + 0.0
+            out = engine._step_fn(state, thetas, masks)
+            stats = out[2]._replace(
+                primal_residuals=out[2].primal_residuals
+                + gratuitous_copy.sum() * 0.0)
+            return out[0], out[1], stats
+
+        closed = jax.make_jaxpr(mutated_step)(*engine._step_templates())
+        mutated = certify_memory(closed)
+        violations = check_memory_budget(mutated, cfg, lanes=lanes)
+        assert violations, "the injected copy must breach the pin"
+        # the violation names the offending eqn: bytes, primitive and
+        # the source line of the injected copy
+        assert "test_static_memory" in violations[0]
+        assert "mutated_step" in violations[0]
+
+    def test_unknown_certificate_fails_budget(self):
+        from agentlib_mpc_tpu.lint.jaxpr.memory import MemoryCertificate
+
+        cert = MemoryCertificate(status="unknown")
+        assert check_memory_budget(cert, {"max_peak_bytes": 1}) != []
+
+
+# --------------------------------------------------------------------------
+# the capacity planner, validated by real builds
+# --------------------------------------------------------------------------
+
+class TestCapacityPlanner:
+    def test_planned_size_fits_and_one_lane_beyond_does_not(
+            self, ocp, eight_devices, small_engine):
+        from agentlib_mpc_tpu.parallel import fleet_mesh
+
+        mesh = fleet_mesh()
+        n_dev = int(mesh.devices.size)
+        opts = FusedADMMOptions(max_iterations=8, rho=2.0)
+        # an HBM budget that admits a handful of lanes per device (the
+        # flat 2-lane certificate upper-bounds the mesh's per-device
+        # footprint at 2 lanes/device, so ~1.6x of it lands mid-range)
+        hbm = int(small_engine.memory_certificate.peak_bytes * 1.6)
+        plan = plan_capacity(ocp, opts, hbm, mesh=mesh,
+                             couplings={"shared_u": "u"},
+                             solver_options=SolverOptions(max_iter=30))
+        k = plan.max_agents_per_device
+        assert k >= 1
+        assert plan.max_agents == k * n_dev
+        assert plan.per_lane_bytes > 0
+
+        # the acceptance check: ACTUALLY build the fleet at the planned
+        # size and one lane per device beyond it on the 8-device mesh
+        def peak(n_agents):
+            e = FusedADMM([_tracker_group(ocp, n_agents)], opts,
+                          mesh=mesh, memory_certify="off",
+                          collective_certify="off")
+            return engine_memory_certificate(e).peak_bytes
+
+        assert peak(k * n_dev) <= hbm
+        assert peak((k + 1) * n_dev) > hbm
+
+    @pytest.mark.slow
+    def test_planner_runs_without_a_mesh(self, ocp):
+        opts = FusedADMMOptions(max_iterations=8, rho=2.0)
+        plan = plan_capacity(ocp, opts, hbm_bytes=10 * 2**20,
+                             couplings={"shared_u": "u"},
+                             solver_options=SolverOptions(max_iter=30),
+                             refine=False)
+        assert plan.max_agents_per_device >= 1
+        assert plan.max_agents is None
+        assert plan.base_bytes >= 0
+
+
+# --------------------------------------------------------------------------
+# the serving plane's capacity-shed path
+# --------------------------------------------------------------------------
+
+class TestServingCapacityShed:
+    def test_refused_growth_sheds_join_into_guard_ladder(self, ocp):
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            tracker_tenant_spec,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+
+        # budget fits exactly one slot: t0 joins under a generous
+        # budget, then the budget is tightened to the certified 1-slot
+        # peak + headroom so t1's growth refuses (saves a probe build —
+        # the plane's own capacity-1 engine IS the probe)
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=1,
+            pipelined=False, donate=False, hbm_bytes=1 << 40)
+        r0 = plane.join(tracker_tenant_spec(ocp, "t0", 1.0))
+        assert r0.slot == 0
+        stats = plane.stats()["memory"]["certified_peak_bytes"]
+        plane.hbm_bytes = int(next(iter(stats.values())) * 1.5)
+        r1 = plane.join(tracker_tenant_spec(ocp, "t1", 2.0))
+        assert r1.slot == -1                 # capacity-shed join
+        assert "t1" in plane.evicted_tenants
+
+        # t1's submissions walk its guard ladder; t0's round survives
+        decision = plane.submit("t1")
+        assert decision is not None
+        assert decision.action in ("replay", "hold", "fallback")
+        plane.submit("t0")
+        results = plane.serve_round()
+        results.update(plane.flush())
+        assert results["t0"].action == "actuate"
+
+        # capacity frees -> the shed tenant splices back in and its
+        # lane genuinely solves (the guard ladder stays in charge of
+        # the actuation verdict: the earlier sheds walked it to the
+        # fallback rung, and recovery hysteresis is the ladder's call)
+        plane.leave("t0")
+        assert plane.readmit_tenant("t1")
+        plane.submit("t1")
+        results = plane.serve_round()
+        results.update(plane.flush())
+        assert results["t1"].stats is not None
+        assert results["t1"].stats["success"]
