@@ -240,6 +240,26 @@ class CollectiveCertificate:
             for op in self.schedule)
         return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
+    @property
+    def family_digest(self) -> "str | None":
+        """Lane-count-independent identity of the collective SEQUENCE:
+        primitive, axis names, loop position and multiplicity per
+        entry, in program order — operand shapes and dtypes excluded.
+        A degraded-mesh rebuild that re-pads its lane rows legitimately
+        changes shard-local payload shapes (the ISSUE 14 agents-axis
+        case: the non-anticipativity psum carries local agent rows)
+        while issuing the exact same all-reduce sequence; this digest
+        is the identity that survives that, and it still changes the
+        moment a collective is added, dropped, reordered or moved to a
+        different axis or loop depth. None unless proved."""
+        if self.status != "proved":
+            return None
+        ident = "|".join(
+            f"{op.loop_path}:{op.primitive}@{op.axes}"
+            f":x{op.multiplicity}:{'b' if op.bounded else 'u'}"
+            for op in self.schedule)
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
     def families(self) -> "dict[str, list]":
         """Schedule grouped by :attr:`CollectiveOp.family`, order kept."""
         out: "dict[str, list]" = {}

@@ -516,7 +516,19 @@ def run_scenario_gate(budgets: "dict | None" = None,
     same warm steady state as every other fused path — batching a
     third axis must never reintroduce retrace churn. Like the mesh
     gate, the 8 virtual CPU devices must be requested before backend
-    init (fresh process: the CLI and CI both do)."""
+    init (fresh process: the CLI and CI both do).
+
+    A second measured leg (the ``[scenario.survive]`` budgets, ISSUE
+    14) scripts the 2-D survivability churn on a
+    :class:`~agentlib_mpc_tpu.parallel.survival.
+    ScenarioFleetSupervisor`: after a warmup cycle that builds BOTH
+    layouts (the full grid and the scenarios-axis-degraded one — the
+    one legitimate degraded rebuild), a repeat degrade → serve →
+    re-admit → serve cycle is held to ZERO traces/compiles — layouts
+    are cached per surviving rectangle, the scenario-column selection
+    / probability renormalization / multiplier re-centering are
+    shape-stable data movement, and re-admission reinstates the cached
+    full-grid engine."""
     from agentlib_mpc_tpu.utils.jax_setup import request_virtual_devices
 
     cfg = (budgets or load_budgets()).get("scenario", {})
@@ -530,6 +542,9 @@ def run_scenario_gate(budgets: "dict | None" = None,
     rounds = int(cfg.get("rounds", 3))
     per_entry = dict(cfg.get("budgets", {}) or {})
     default_budget = int(per_entry.pop("default", 0))
+    survive_cfg = dict(cfg.get("survive", {}) or {})
+    survive_budgets = dict(survive_cfg.get("budgets", {}) or {})
+    survive_default = int(survive_budgets.pop("default", 0))
 
     was_enabled = telemetry.enabled()
     telemetry.configure(enabled=True)
@@ -538,6 +553,7 @@ def run_scenario_gate(budgets: "dict | None" = None,
 
     failures: list = []
     before = after = {}
+    v_before = v_after = {}
     n_scenarios = 0
     try:
         import jax
@@ -593,6 +609,49 @@ def run_scenario_gate(budgets: "dict | None" = None,
             state, _trajs, _stats = fleet.step(state, thetas)
             state = fleet.shift_state(state)
         after = _compile_snapshot(reg)
+
+        # -- survive leg (ISSUE 14): 2-D degrade -> serve -> readmit --
+        from agentlib_mpc_tpu.parallel.survival import (
+            ScenarioFleetSupervisor,
+        )
+
+        sup = ScenarioFleetSupervisor(
+            group, tree,
+            ScenarioFleetOptions(max_iterations=8, rho=2.0,
+                                 rho_na=2.0),
+            mesh=mesh, watchdog_timeout_s=120.0,
+            readmit_after=1, probation_rounds=1)
+        # fresh (unplaced) theta: the supervisor places per layout
+        sv_thetas = jax.tree.map(lambda *xs: jnp.stack(xs), *[
+            ensemble_thetas(
+                ocp.default_params(p=jnp.array([float(i + 1)])),
+                tree, seed=i)
+            for i in range(n_agents)])
+        sv_state = sup.init_state(sv_thetas)
+        victim = int(sup.grid_ids[0, -1])
+        # warmup cycle: builds the full AND the scenarios-degraded
+        # layout (the one legitimate degraded rebuild) and exercises
+        # every selection/pad/re-center/placement shape the measured
+        # cycle repeats
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+        sup.force_degrade([victim], axis="scenarios")
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+        sup.force_readmit()
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+
+        v_before = _compile_snapshot(reg)
+        sup.force_degrade([victim], axis="scenarios")
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+        sup.force_readmit()
+        sv_state, _t, _s = sup.step(sv_state, sv_thetas)
+        v_after = _compile_snapshot(reg)
+        if sup.stats()["layouts_built"] != 2:
+            failures.append(
+                f"scenario survive leg built "
+                f"{sup.stats()['layouts_built']} layouts — the repeat "
+                f"degrade/readmit cycle must reuse the 2 warmed "
+                f"engines, not rebuild")
     except _MeshGateSkipped:
         pass
     finally:
@@ -606,11 +665,19 @@ def run_scenario_gate(budgets: "dict | None" = None,
         if delta > budget:
             violations.append({"entry_point": entry, "observed": delta,
                                "budget": budget})
+    survive_deltas = {k: v_after.get(k, 0) - v_before.get(k, 0)
+                      for k in set(v_before) | set(v_after)}
+    for entry, delta in sorted(survive_deltas.items()):
+        budget = int(survive_budgets.get(entry, survive_default))
+        if delta > budget:
+            violations.append({"entry_point": f"survive:{entry}",
+                               "observed": delta, "budget": budget})
     report = {
         "warmup_rounds": warmup,
         "rounds": rounds,
         "n_scenarios": n_scenarios,
         "deltas": dict(sorted(deltas.items())),
+        "survive_deltas": dict(sorted(survive_deltas.items())),
         "violations": violations,
         "failures": failures,
     }
@@ -624,7 +691,8 @@ def run_scenario_gate(budgets: "dict | None" = None,
         if not violations and not failures:
             print(f"scenario-budget: OK — zero excess compiles across "
                   f"{rounds} scenario-count-stable rounds "
-                  f"({n_scenarios} scenarios)")
+                  f"({n_scenarios} scenarios) and the 2-D degrade -> "
+                  f"serve -> re-admit survive cycle")
     return report
 
 

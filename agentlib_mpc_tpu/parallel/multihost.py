@@ -278,6 +278,31 @@ def surviving_mesh(mesh: Mesh, answered_ids) -> Mesh:
     return Mesh(np.array(devices), mesh.axis_names)
 
 
+def surviving_mesh_2d(mesh: Mesh, rows, cols) -> Mesh:
+    """The degraded 2-D mesh over surviving ROW and COLUMN indices of
+    an (agents × scenarios) grid, original order preserved on both
+    axes. A 2-D mesh must stay rectangular, so a single dead device
+    costs its whole row (agents-axis degrade) or its whole column
+    (scenarios-axis degrade) — the axis classification is the
+    supervisor's call (:class:`~agentlib_mpc_tpu.parallel.survival.
+    ScenarioFleetSupervisor`); this only builds the rectangle."""
+    import numpy as np
+
+    grid = np.asarray(mesh.devices)
+    if grid.ndim != 2:
+        raise ValueError(
+            f"surviving_mesh_2d needs a 2-D mesh, got axes "
+            f"{mesh.axis_names}")
+    rows = tuple(int(r) for r in rows)
+    cols = tuple(int(c) for c in cols)
+    if not rows or not cols:
+        raise ValueError(
+            "no surviving rows/columns to build a degraded 2-D mesh "
+            "from — the whole mesh is unreachable (escalate to "
+            "checkpoint restore)")
+    return Mesh(grid[np.ix_(rows, cols)], mesh.axis_names)
+
+
 def shard_multiple(mesh: "Mesh | None" = None) -> int:
     """Agent-axis granularity a sharded engine requires.
 

@@ -80,6 +80,50 @@ logger = logging.getLogger(__name__)
 MAX_TRANSIENT_RETRIES = 2
 
 
+def assert_schedule_identity(ref_engine, new_engine, what: str) -> None:
+    """The ISSUE 11 static gate both supervisors share: a degraded
+    rebuild that would issue a DIFFERENT collective sequence than its
+    surviving peers is exactly the cross-host hang a pod cannot
+    observe — refuse it here, before any round dispatches, not after a
+    watchdog fires. (The ``collective_schedule_digest`` is mesh-size-
+    independent, so a smaller mesh of the same program matches.)"""
+    ref_digest = ref_engine.collective_schedule_digest
+    new_digest = new_engine.collective_schedule_digest
+    if ref_digest is not None and new_digest is not None \
+            and new_digest != ref_digest:
+        # payload shapes ride the full digest, and a rebuild that
+        # re-pads its lane rows legitimately changes shard-local
+        # payload shapes (the 2-D fleet's non-anticipativity psum
+        # carries local agent rows) — the SEQUENCE identity is what a
+        # pod's peers must agree on, so fall back to the lane-count-
+        # independent family digest before refusing
+        ref_cert = getattr(ref_engine, "collective_certificate", None)
+        new_cert = getattr(new_engine, "collective_certificate", None)
+        fam_ref = ref_cert.family_digest if ref_cert is not None \
+            else None
+        fam_new = new_cert.family_digest if new_cert is not None \
+            else None
+        if fam_ref is not None and fam_ref == fam_new:
+            logger.info(
+                "%s re-certified with lane-count-shifted payload "
+                "shapes; the all-reduce sequence is identical "
+                "(family digest %s)", what, fam_ref)
+            return
+        raise RuntimeError(
+            f"{what} certifies a DIFFERENT collective schedule than "
+            f"the full engine (digest {new_digest} vs {ref_digest}) — "
+            f"its all-reduce sequence would diverge from the surviving "
+            f"peers'; refusing the rebuild (full schedule: "
+            f"{ref_engine.collective_certificate.describe()}; rebuilt: "
+            f"{new_engine.collective_certificate.describe()})")
+    if ref_digest is not None and new_digest is None:
+        logger.warning(
+            "%s carries no proved collective schedule (%s) — identity "
+            "vs the full engine cannot be asserted statically", what,
+            new_engine.collective_certificate.describe()
+            if new_engine.collective_certificate else "not certified")
+
+
 class _Layout(NamedTuple):
     """One mesh configuration's serving machinery."""
 
@@ -173,31 +217,9 @@ class FleetSupervisor:
         engine = FusedADMM(groups, self.options, mesh=mesh,
                            watchdog_timeout_s=self.watchdog_timeout_s)
         if self._layouts:
-            # static schedule-identity gate (ISSUE 11): a degraded
-            # rebuild that would issue a DIFFERENT collective sequence
-            # than its surviving full-mesh peers is exactly the
-            # cross-host hang a pod cannot observe — refuse it here,
-            # before any round dispatches, not after a watchdog fires
-            ref_digest = self._ref.collective_schedule_digest
-            new_digest = engine.collective_schedule_digest
-            if ref_digest is not None and new_digest is not None \
-                    and new_digest != ref_digest:
-                raise RuntimeError(
-                    f"degraded-mesh rebuild on {len(key)} device(s) "
-                    f"certifies a DIFFERENT collective schedule than "
-                    f"the full engine (digest {new_digest} vs "
-                    f"{ref_digest}) — its all-reduce sequence would "
-                    f"diverge from the surviving peers'; refusing the "
-                    f"rebuild (full schedule: "
-                    f"{self._ref.collective_certificate.describe()}; "
-                    f"rebuilt: {engine.collective_certificate.describe()})")
-            if ref_digest is not None and new_digest is None:
-                logger.warning(
-                    "degraded-mesh rebuild carries no proved collective "
-                    "schedule (%s) — identity vs the full engine cannot "
-                    "be asserted statically",
-                    engine.collective_certificate.describe()
-                    if engine.collective_certificate else "not certified")
+            assert_schedule_identity(
+                self._ref, engine,
+                f"degraded-mesh rebuild on {len(key)} device(s)")
         layout = _Layout(device_ids=key, mesh=mesh, engine=engine,
                          pads=pads)
         self._layouts[key] = layout
@@ -640,4 +662,932 @@ class FleetSupervisor:
             "probation_left": self._probation_left,
             "collective_schedule_digest":
                 self._current.engine.collective_schedule_digest,
+        }
+
+
+# --------------------------------------------------------------------------
+# survivability on the 2-D (agents × scenarios) mesh (ISSUE 14)
+# --------------------------------------------------------------------------
+
+
+class _ScenLayout(NamedTuple):
+    """One 2-D mesh configuration's serving machinery."""
+
+    rows: tuple          # surviving agent-axis row indices, FULL grid
+    cols: tuple          # surviving scenario-axis column indices
+    mesh: object         # the (possibly degraded) 2-D mesh
+    fleet: object        # ScenarioFleet
+    tree: object         # the layout's (reduced, RE-NORMALIZED) tree
+    scen_keep: tuple     # surviving BASE scenario indices, ascending
+    pad: int             # agent rows added over the base group size
+
+
+class ScenarioFleetSupervisor:
+    """Run a :class:`~agentlib_mpc_tpu.scenario.fleet.ScenarioFleet`
+    with shard-loss survival on BOTH mesh axes — the
+    :class:`FleetSupervisor` ladder lifted to the 2-D
+    (agents × scenarios) grid (ISSUE 14):
+
+    1. **Detect** — every robust round runs under the fleet's
+       collective watchdog (``ScenarioFleet(watchdog_timeout_s=...)``);
+       a blown budget condemns the mesh and surfaces the bounded
+       per-device probe.
+    2. **Classify** — a 2-D mesh must stay rectangular, so a dead
+       device costs its whole grid ROW or COLUMN. ``degrade_axis``
+       decides: ``"auto"`` prefers the **scenarios** axis whenever it
+       can shrink (dropping a column costs robustness *breadth* —
+       recoverable statistically through probability renormalization —
+       while dropping a row takes real agents' plants offline);
+       ``"agents"``/``"scenarios"`` force the call.
+    3. **Degrade** —
+       * **agents-axis loss** rides the flat pad path: the lanes the
+         dead rows hosted are masked at base granularity, the warm
+         state carries over row-aligned, and the agent-consensus
+         multipliers are re-centered over the survivors (the PR 10
+         conserved-λ-sum fix, per scenario column).
+       * **scenarios-axis loss** rebuilds on the reduced scenario mesh:
+         the lost branches leave their non-anticipativity node groups
+         and the surviving group probabilities are **re-normalized**
+         (:meth:`~agentlib_mpc_tpu.scenario.tree.ScenarioTree.subtree`)
+         so the projection stays a true probability-weighted mean — and
+         the non-anticipativity multipliers ``nu`` are re-centered per
+         surviving node group: the dual update conserves each group's
+         ``nu`` sum (the projection is the group mean), so dropping
+         members strands a stale sum with the survivors and the fleet
+         would converge — confidently, with tiny residuals — to an
+         actuated u0 biased by exactly ``mean_group(nu)/rho_na``,
+         forever. The 2-D analogue of the PR 10 fix.
+       Every degraded rebuild must certify the IDENTICAL per-axis
+       collective schedule as the full engine
+       (:func:`assert_schedule_identity` — the PR 11 gate) and carry a
+       memory certificate within capacity (the PR 13 gate fires inside
+       the ``ScenarioFleet`` build via ``memory_certify``).
+    4. **Serve degraded** — the condemned round retries from its input
+       state on the reduced grid; surviving agents (and branches) keep
+       actuating, with the lost branches' trajectory rows NaN-filled
+       (no data is honest; fabricated data is not).
+    5. **Re-admit** — hysteretic and PER AXIS: after enough healthy
+       degraded rounds the full grid is probed; when every device
+       answers, the full layout is reinstated (cached engine — zero new
+       compiles), lost lanes AND branches re-enter with fresh warm
+       starts, multipliers re-center, and a probation window opens —
+       a relapse inside it doubles the *failing axis's* healthy-round
+       requirement.
+
+    Degenerate contract: a single-scenario tree delegates UNWRAPPED to
+    a flat :class:`FleetSupervisor` (flat state/theta types, the flat
+    mesh) — the S=1 supervisor IS the flat supervisor, bitwise, the
+    same way the S=1 solver stack routes through the flat sweep.
+
+    API is layout-stable at BASE shapes: ``step`` takes and returns
+    state/theta/trajectories at (n_agents, S_base) regardless of the
+    grid currently serving — selection, padding and scatter-back are
+    internal."""
+
+    def __init__(self, group, tree,
+                 options=None, mesh=None, active=None,
+                 watchdog_timeout_s: float = 30.0,
+                 probe_timeout_s: float = multihost.MESH_PROBE_TIMEOUT_S,
+                 readmit_after: int = 2,
+                 probation_rounds: int = 2,
+                 warmup_budget_s: float = 600.0,
+                 degrade_axis: str = "auto",
+                 collective_certify: str = "auto",
+                 memory_certify: str = "auto"):
+        import numpy as _np
+
+        from agentlib_mpc_tpu.scenario.fleet import (
+            ScenarioFleet,
+            ScenarioFleetOptions,
+        )
+
+        if options is None:
+            options = ScenarioFleetOptions()
+        if degrade_axis not in ("auto", "agents", "scenarios"):
+            raise ValueError(
+                f"degrade_axis must be 'auto', 'agents' or "
+                f"'scenarios', got {degrade_axis!r}")
+        self._fleet_cls = ScenarioFleet
+        self.base_group = group
+        self.tree = tree.validate(group.ocp.N)
+        self.options = options
+        self.watchdog_timeout_s = float(watchdog_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.warmup_budget_s = float(warmup_budget_s)
+        self.readmit_after = max(1, int(readmit_after))
+        self.probation_rounds = max(0, int(probation_rounds))
+        self.degrade_axis = degrade_axis
+        self.collective_certify = collective_certify
+        self.memory_certify = memory_certify
+
+        # -- degenerate contract: S=1 routes UNWRAPPED through the flat
+        # supervisor (state types, mesh and all) — pinned bitwise in
+        # tests/test_scenario_fleet.py
+        self._flat: "FleetSupervisor | None" = None
+        if self.tree.n_scenarios == 1:
+            self.flat_options = FusedADMMOptions(
+                max_iterations=options.max_iterations,
+                rho=options.rho, abs_tol=options.abs_tol,
+                rel_tol=options.rel_tol,
+                use_relative_tolerances=options.use_relative_tolerances,
+                primal_tol=options.primal_tol,
+                dual_tol=options.dual_tol,
+                quarantine=options.quarantine,
+                quarantine_reset_after=options.quarantine_reset_after)
+            flat_mesh = self._flatten_degenerate_mesh(mesh)
+            self._flat = FleetSupervisor(
+                [group], self.flat_options, mesh=flat_mesh,
+                active=None if active is None else [active],
+                watchdog_timeout_s=watchdog_timeout_s,
+                probe_timeout_s=probe_timeout_s,
+                readmit_after=readmit_after,
+                probation_rounds=probation_rounds,
+                warmup_budget_s=warmup_budget_s)
+            return
+
+        if mesh is None:
+            mesh = multihost.scenario_mesh(1)
+        names = tuple(mesh.axis_names)
+        if names != ("agents", "scenarios"):
+            raise ValueError(
+                f"ScenarioFleetSupervisor needs a 2-D ('agents', "
+                f"'scenarios') mesh (multihost.scenario_mesh); got "
+                f"axes {names}")
+        self.full_mesh = mesh
+        self.grid = _np.asarray(mesh.devices)        # (A_sh, S_sh)
+        self.grid_ids = _np.vectorize(lambda d: d.id)(self.grid)
+        self._full_ids = tuple(d.id for d in self.grid.flat)
+        self.S = self.tree.n_scenarios
+        n_cols = self.grid.shape[1]
+        if self.S % n_cols:
+            raise ValueError(
+                f"{self.S} scenarios do not divide the {n_cols}-shard "
+                f"scenario axis — pad the tree first "
+                f"(scenario.fleet.pad_scenarios)")
+        #: scenarios hosted per grid column on the FULL mesh
+        self.spd = self.S // n_cols
+        if active is None:
+            active = jnp.ones((group.n_agents,), bool)
+        self.base_active = jnp.asarray(active, bool)
+        self._probe = lambda m: multihost.probe_mesh_devices(
+            m, self.probe_timeout_s)
+        self._layouts: dict = {}
+        #: base-layout agent lanes lost to dead rows
+        self.dead_lanes = np.zeros((group.n_agents,), bool)
+        #: base scenario indices lost to dead columns
+        self.dead_branches: set = set()
+        self.dead_devices: tuple = ()
+        self._full_key = (tuple(range(self.grid.shape[0])),
+                          tuple(range(self.grid.shape[1])))
+        self._current = self._layout_for(*self._full_key)
+        self._ref = self._current.fleet
+        # survivability bookkeeping (per-axis hysteresis)
+        self.degraded = False
+        self.degraded_axes: set = set()
+        self._healthy_degraded_rounds = 0
+        self._readmit_needed = {"agents": self.readmit_after,
+                                "scenarios": self.readmit_after}
+        self._probation_left = 0
+        self._reset_pending = False
+        #: axes whose membership changed at the LAST transition — the
+        #: re-centering debt consumed by the next _run_layout (a later
+        #: cascading loss on the other axis must not re-touch this one)
+        self._recenter_pending: set = set()
+        self.rounds = 0
+        self.degraded_rounds = 0
+        self.last_mttr_s: "float | None" = None
+        self.mttr_by_axis: dict = {"agents": None, "scenarios": None}
+        self._consensus_snapshot = None
+        self._verify_carry = False
+        self._export_gauges()
+
+    @staticmethod
+    def _flatten_degenerate_mesh(mesh):
+        """The 1-D agents mesh the S=1 delegate runs on: a 2-D mesh
+        whose scenario axis is width 1 flattens to its agent column; a
+        wider scenario axis has no single-scenario layout at all."""
+        if mesh is None:
+            return None
+        names = tuple(mesh.axis_names)
+        if names == ("agents",):
+            return mesh
+        if names == ("agents", "scenarios"):
+            import numpy as _np
+
+            grid = _np.asarray(mesh.devices)
+            if grid.shape[1] != 1:
+                raise ValueError(
+                    f"a single-scenario tree cannot shard over the "
+                    f"{grid.shape[1]}-column scenario axis — use "
+                    f"scenario_mesh(1) or a 1-D agents mesh")
+            return multihost.fleet_mesh(devices=list(grid[:, 0]))
+        raise ValueError(f"unsupported mesh axes {names}")
+
+    # -- layouts --------------------------------------------------------------
+
+    def _layout_for(self, rows, cols) -> _ScenLayout:
+        key = (tuple(rows), tuple(cols))
+        layout = self._layouts.get(key)
+        if layout is not None:
+            return layout
+        full = key == self._full_key
+        mesh = self.full_mesh if full else multihost.surviving_mesh_2d(
+            self.full_mesh, key[0], key[1])
+        scen_keep = tuple(s for c in key[1]
+                          for s in range(c * self.spd,
+                                         (c + 1) * self.spd))
+        # the reduced tree drops the lost branches from their node
+        # groups and RE-NORMALIZES the group probabilities — without
+        # this the projection is a sub-distribution-weighted mean and
+        # the actuated u0 carries a permanent stale-probability bias
+        tree = self.tree if full else self.tree.subtree(scen_keep)
+        n_rows = len(key[0])
+        pad = (-self.base_group.n_agents) % n_rows
+        group = self.base_group
+        if pad:
+            group = dataclasses.replace(
+                group, n_agents=group.n_agents + pad)
+        fleet = self._fleet_cls(
+            group, tree, self.options, mesh=mesh,
+            watchdog_timeout_s=self.watchdog_timeout_s,
+            collective_certify=self.collective_certify,
+            memory_certify=self.memory_certify)
+        if self._layouts:
+            # PR 11 + PR 13 wired into every degraded rebuild: the
+            # schedule must be IDENTICAL per axis (below), and the
+            # memory certificate was already enforced within capacity
+            # by the ScenarioFleet build we just paid (memory_certify)
+            assert_schedule_identity(
+                self._ref, fleet,
+                f"degraded 2-D rebuild on {len(key[0])}x{len(key[1])} "
+                f"devices")
+        layout = _ScenLayout(rows=key[0], cols=key[1], mesh=mesh,
+                             fleet=fleet, tree=tree,
+                             scen_keep=scen_keep, pad=pad)
+        self._layouts[key] = layout
+        return layout
+
+    @property
+    def engine(self):
+        """The fleet currently serving (full or degraded grid)."""
+        if self._flat is not None:
+            return self._flat.engine
+        return self._current.fleet
+
+    @property
+    def mesh_shape(self) -> tuple:
+        """(agent shards, scenario shards) currently serving."""
+        if self._flat is not None:
+            return (self._flat.mesh_devices, 1)
+        return (len(self._current.rows), len(self._current.cols))
+
+    @property
+    def scenarios_active(self) -> int:
+        if self._flat is not None:
+            return 1
+        return len(self._current.scen_keep)
+
+    # -- layout-stable state plumbing -----------------------------------------
+
+    def init_state(self, theta_batch):
+        """Fresh robust state in the BASE (n_agents, S) layout (the S=1
+        delegate takes the flat supervisor's per-group theta list)."""
+        if self._flat is not None:
+            return self._flat.init_state(theta_batch)
+        full = self._layouts[self._full_key]
+        if full.pad:
+            theta_batch = self._pad_theta(theta_batch, full.pad)
+        state = full.fleet.init_state(theta_batch)
+        if full.pad:
+            state = self._slice_agents(state, self.base_group.n_agents)
+        return state
+
+    def shift_state(self, state):
+        if self._flat is not None:
+            return self._flat.shift_state(state)
+        return self._ref.shift_state(state)
+
+    @staticmethod
+    def _pad_theta(theta_batch, pad: int):
+        return jax.tree.map(
+            lambda leaf: jnp.concatenate(
+                [leaf, jnp.repeat(leaf[-1:], pad, axis=0)]), theta_batch)
+
+    @staticmethod
+    def _pad_state_rows(state, pad: int):
+        """Grow the agent axis by ``pad`` repeated last rows (masked
+        dead weight — the ``pad_group_to_devices`` semantics on the
+        scenario state; ``zbar`` has no agent axis)."""
+        grow = lambda leaf: jnp.concatenate(
+            [leaf, jnp.repeat(leaf[-1:], pad, axis=0)])
+        return state._replace(
+            lam={a: grow(v) for a, v in state.lam.items()},
+            nu=grow(state.nu), na_target=grow(state.na_target),
+            w=grow(state.w), y=grow(state.y), z=grow(state.z))
+
+    @staticmethod
+    def _slice_agents(state, n: int):
+        sl = lambda leaf: leaf[:n]
+        return state._replace(
+            lam={a: sl(v) for a, v in state.lam.items()},
+            nu=sl(state.nu), na_target=sl(state.na_target),
+            w=sl(state.w), y=sl(state.y), z=sl(state.z))
+
+    @staticmethod
+    def _select_scenarios(state, scen_keep):
+        """Restrict the scenario axis to the surviving base indices —
+        the lost branches' columns stay behind in the caller's
+        base-layout state as dead weight."""
+        idx = jnp.asarray(scen_keep)
+        return state._replace(
+            zbar={a: v[idx] for a, v in state.zbar.items()},
+            lam={a: v[:, idx] for a, v in state.lam.items()},
+            nu=state.nu[:, idx], na_target=state.na_target[:, idx],
+            w=state.w[:, idx], y=state.y[:, idx], z=state.z[:, idx])
+
+    def _merge_state(self, base_state, lstate, layout) -> object:
+        """Scatter a layout's round output back into the BASE layout:
+        agent pads sliced off, surviving scenario columns updated, lost
+        columns left at their pre-loss values (dead weight until the
+        re-admission resets them)."""
+        n = self.base_group.n_agents
+        lstate = self._slice_agents(lstate, n)
+        if layout.scen_keep == tuple(range(self.S)):
+            return lstate
+        idx = jnp.asarray(layout.scen_keep)
+        put = lambda base, part: base.at[:, idx].set(part)
+        return base_state._replace(
+            zbar={a: base_state.zbar[a].at[idx].set(v)
+                  for a, v in lstate.zbar.items()},
+            lam={a: put(base_state.lam[a], v)
+                 for a, v in lstate.lam.items()},
+            nu=put(base_state.nu, lstate.nu),
+            na_target=put(base_state.na_target, lstate.na_target),
+            w=put(base_state.w, lstate.w),
+            y=put(base_state.y, lstate.y),
+            z=put(base_state.z, lstate.z))
+
+    @staticmethod
+    def _unplace(tree_):
+        """Pull a pytree off its mesh placement: the degraded layout's
+        outputs live on the reduced device set, the base-layout state
+        on the full one — a scatter across the two placements is
+        rejected by the runtime, so the merge happens unplaced (the
+        next round's ``shard_args`` re-places everything anyway)."""
+        return jax.tree.map(
+            lambda leaf: jnp.asarray(np.asarray(leaf)), tree_)
+
+    def _merge_outputs(self, base_state, out, layout):
+        lstate, ltrajs, lstats = out
+        n = self.base_group.n_agents
+        reduced = layout.scen_keep != tuple(range(self.S))
+        if reduced:
+            base_state = self._unplace(base_state)
+            lstate = self._unplace(lstate)
+            ltrajs = self._unplace(ltrajs)
+            lstats = self._unplace(lstats)
+        state = self._merge_state(base_state, lstate, layout)
+        if not reduced:
+            trajs = jax.tree.map(lambda leaf: leaf[:n], ltrajs) \
+                if layout.pad else ltrajs
+            stats = lstats
+            if layout.pad and lstats.lane_quarantined is not None:
+                stats = lstats._replace(
+                    lane_quarantined=lstats.lane_quarantined[:n])
+            return state, trajs, stats
+        idx = jnp.asarray(layout.scen_keep)
+
+        def scatter_traj(leaf):
+            leaf = leaf[:n]
+            base = jnp.full((n, self.S) + leaf.shape[2:], jnp.nan,
+                            leaf.dtype)
+            return base.at[:, idx].set(leaf)
+
+        trajs = jax.tree.map(scatter_traj, ltrajs)
+        stats = lstats
+        if lstats.lane_quarantined is not None:
+            q = jnp.zeros((n, self.S), jnp.int32).at[:, idx].set(
+                lstats.lane_quarantined[:n])
+            stats = lstats._replace(lane_quarantined=q)
+        return state, trajs, stats
+
+    def _consensus_host(self, state) -> dict:
+        return {alias: np.asarray(leaf)
+                for alias, leaf in state.zbar.items()}
+
+    # -- multiplier re-centering (the conserved-sum fixes) --------------------
+
+    def _recenter_consensus_multipliers(self, state, mask):
+        """PR 10's conserved-λ-sum fix per scenario column: the agent-
+        consensus dual update conserves the active lanes' multiplier
+        sum, so any agent-membership change strands a stale sum and
+        biases that scenario's consensus by mean(λ)/ρ forever."""
+        m = jnp.asarray(mask, bool)[:, None, None]
+        cnt = jnp.maximum(jnp.sum(jnp.asarray(mask, bool)), 1)
+        lam = {}
+        for a, leaf in state.lam.items():
+            mean = jnp.sum(jnp.where(m, leaf, 0.0), axis=0) / cnt
+            lam[a] = jnp.where(m, leaf - mean[None], leaf)
+        return state._replace(lam=lam)
+
+    def _recenter_na_multipliers(self, state, tree, scen_positions):
+        """The 2-D analogue of the conserved-sum fix: the NA dual
+        update ``nu -= rho_na * (target - u)`` sums to zero across a
+        node group (the target is the group mean), so each group's
+        ``nu`` sum is conserved — branch loss (or a re-admitted branch
+        with zeroed ``nu``) strands a stale sum and the converged
+        projection lands exactly ``mean_group(nu)/rho_na`` off the
+        survivors' true probability-weighted mean. Re-center per
+        (agent, group, stage)."""
+        nu = state.nu
+        for t in range(tree.robust_horizon):
+            for grp in tree.groups_at(t):
+                cols = jnp.asarray(
+                    [scen_positions[s] for s in grp])
+                mean = jnp.mean(nu[:, cols, t, :], axis=1,
+                                keepdims=True)
+                nu = nu.at[:, cols, t, :].add(-mean)
+        return state._replace(nu=nu)
+
+    def _reset_dead_starts(self, state, theta_batch):
+        """Fresh warm starts for everything a dead shard carried —
+        the recycled-slot contract on both axes: lost agent LANES and
+        lost scenario BRANCHES re-enter on the sanitized OCP initial
+        guess with zeroed multipliers, never their stale pre-failure
+        iterates."""
+        w_init = jax.vmap(jax.vmap(
+            self.base_group.ocp.initial_guess))(theta_batch)
+        w_init = jnp.where(jnp.isfinite(w_init), w_init, 0.0)
+        lanes = jnp.asarray(self.dead_lanes)
+        branches = jnp.zeros((self.S,), bool)
+        if self.dead_branches:
+            branches = branches.at[
+                jnp.asarray(sorted(self.dead_branches))].set(True)
+        fresh = lanes[:, None] | branches[None, :]       # (n, S)
+        if not bool(jnp.any(fresh)):
+            return state
+        f2 = fresh[:, :, None]
+        state = state._replace(
+            w=jnp.where(f2, w_init, state.w),
+            y=jnp.where(f2, 0.0, state.y),
+            z=jnp.where(f2, 0.1, state.z),
+            nu=jnp.where(fresh[:, :, None, None], 0.0, state.nu),
+            lam={a: jnp.where(f2, 0.0, v)
+                 for a, v in state.lam.items()},
+            zbar={a: jnp.where(branches[:, None], 0.0, v)
+                  for a, v in state.zbar.items()})
+        return state
+
+    # -- the survivable round -------------------------------------------------
+
+    def step(self, state, theta_batch, active=None):
+        """One fused robust round in the BASE layout, surviving shard
+        loss on either axis. Same signature and return contract as
+        :meth:`ScenarioFleet.step` (the S=1 delegate follows
+        :meth:`FleetSupervisor.step`'s flat contract instead)."""
+        if self._flat is not None:
+            # the 2-D contract hands ONE (n_agents,) mask; the flat
+            # supervisor takes a per-group sequence — wrap a bare mask
+            # so both conventions work on the degenerate supervisor
+            if active is not None and not isinstance(active,
+                                                     (list, tuple)):
+                active = [active]
+            return self._flat.step(state, theta_batch, active=active)
+        mask = (self.base_active if active is None
+                else jnp.asarray(active, bool))
+        self._maybe_readmit()
+        if self._reset_pending:
+            state = self._reset_dead_starts(state, theta_batch)
+            had_lanes = bool(np.any(self.dead_lanes))
+            had_branches = bool(self.dead_branches)
+            self.dead_lanes = np.zeros(
+                (self.base_group.n_agents,), bool)
+            self.dead_branches = set()
+            self._reset_pending = False
+            # the zeroed multipliers changed the conserved sums the
+            # dual updates preserve — re-center both families or the
+            # recovered fleet settles off the true consensus, forever
+            if had_lanes:
+                state = self._recenter_consensus_multipliers(state, mask)
+            if had_branches:
+                state = self._recenter_na_multipliers(
+                    state, self.tree, tuple(range(self.S)))
+        self._consensus_snapshot = self._consensus_host(state)
+        transient = 0
+        t_detect = None
+        detect_axis = None
+        while True:
+            layout = self._current
+            try:
+                out = self._run_layout(layout, state, theta_batch, mask)
+                break
+            except MeshRoundTimeout:
+                if t_detect is None:
+                    t_detect = time.perf_counter()
+                report = self._probe(layout.mesh)
+                if not report.answered:
+                    raise RuntimeError(
+                        "no device of the 2-D mesh answered the post-"
+                        "condemnation probe — the whole grid is "
+                        "unreachable; escalate to checkpoint restore "
+                        "(docs/robustness.md, 'Surviving loss on "
+                        "either axis')") from None
+                if set(report.dead) & set(self._current_ids()):
+                    detect_axis = self._degrade(report)
+                    continue
+                transient += 1
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "mesh_round_retries_total",
+                        "condemned rounds retried on the same mesh "
+                        "(every shard answered the probe)").inc(
+                        reason="transient")
+                if transient > MAX_TRANSIENT_RETRIES:
+                    raise RuntimeError(
+                        f"scenario round timed out {transient} times "
+                        f"while every shard answers the probe — the "
+                        f"collective is wedged without an attributable "
+                        f"dead device; raise watchdog_timeout_s or "
+                        f"escalate to checkpoint restore") from None
+                logger.warning(
+                    "condemned round retried on the same %dx%d grid "
+                    "(all shards answered the probe; attempt %d/%d)",
+                    len(layout.rows), len(layout.cols), transient,
+                    MAX_TRANSIENT_RETRIES)
+                layout.fleet.mesh_condemned = False
+        if t_detect is not None:
+            self.last_mttr_s = time.perf_counter() - t_detect
+            if detect_axis is not None:
+                self.mttr_by_axis[detect_axis] = self.last_mttr_s
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "mesh_shard_loss_recovery_seconds",
+                    "wall seconds from a condemned collective to the "
+                    "first completed (possibly degraded) round"
+                    ).observe(self.last_mttr_s,
+                              axis=detect_axis or "transient")
+        self.rounds += 1
+        if self.degraded:
+            self.degraded_rounds += 1
+            self._healthy_degraded_rounds += 1
+        if self._probation_left > 0:
+            self._probation_left -= 1
+            if self._probation_left == 0:
+                self._readmit_needed = {
+                    "agents": self.readmit_after,
+                    "scenarios": self.readmit_after}
+        state_out, trajs, stats = out
+        self._consensus_snapshot = self._consensus_host(state_out)
+        return state_out, trajs, stats
+
+    def _run_layout(self, layout: _ScenLayout, state, theta_batch,
+                    base_mask):
+        reduced = layout.scen_keep != tuple(range(self.S))
+        lstate = self._select_scenarios(state, layout.scen_keep) \
+            if reduced else state
+        ltheta = jax.tree.map(
+            lambda leaf: leaf[:, jnp.asarray(layout.scen_keep)],
+            theta_batch) if reduced else theta_batch
+        if self._verify_carry:
+            # the degraded carry-over must reproduce the pre-failure
+            # consensus iterate BITWISE on the surviving branches — a
+            # carry that cannot is corrupted and must not resume
+            for alias, ref in (self._consensus_snapshot or {}).items():
+                carried = np.asarray(lstate.zbar[alias])
+                expect = ref[np.asarray(layout.scen_keep)]
+                if not np.array_equal(carried, expect):
+                    raise RuntimeError(
+                        f"degraded-mesh carry-over drifted from the "
+                        f"pre-failure iterate at zbar[{alias}] — "
+                        f"refusing to resume from a corrupted carry")
+            self._verify_carry = False
+            # the just-departed members stranded their share of the
+            # conserved multiplier sums with the survivors — re-center
+            # exactly the family the failing axis disturbed, once
+            if "scenarios" in self._recenter_pending:
+                lstate = self._recenter_na_multipliers(
+                    lstate, layout.tree,
+                    tuple(range(len(layout.scen_keep))))
+            if "agents" in self._recenter_pending:
+                lstate = self._recenter_consensus_multipliers(
+                    lstate, np.asarray(base_mask)
+                    & ~np.asarray(self.dead_lanes))
+            self._recenter_pending = set()
+        mask = jnp.asarray(base_mask, bool) & jnp.asarray(
+            ~self.dead_lanes)
+        if layout.pad:
+            lstate = self._pad_state_rows(lstate, layout.pad)
+            ltheta = self._pad_theta(ltheta, layout.pad)
+            mask = jnp.concatenate(
+                [mask, jnp.zeros((layout.pad,), bool)])
+        lstate, ltheta = layout.fleet.shard_args(layout.mesh, lstate,
+                                                 ltheta)
+        fleet = layout.fleet
+        if not getattr(fleet, "_supervisor_warmed", False):
+            # first round of a fresh layout: trace+compile rides inside
+            # the bounded wait — the warmup allowance keeps a
+            # legitimate compile from reading as a collective stall
+            budget = fleet.watchdog_timeout_s
+            fleet.watchdog_timeout_s = budget + self.warmup_budget_s
+            try:
+                out = fleet.step(lstate, ltheta, active=mask)
+            finally:
+                fleet.watchdog_timeout_s = budget
+            fleet._supervisor_warmed = True
+        else:
+            out = fleet.step(lstate, ltheta, active=mask)
+        return self._merge_outputs(state, out, layout)
+
+    # -- degrade / re-admit ---------------------------------------------------
+
+    def _dead_positions(self, dead_ids) -> tuple:
+        """(row positions, col positions) of the dead devices within
+        the CURRENT layout's grid."""
+        layout = self._current
+        dead = set(dead_ids)
+        rows_hit, cols_hit = set(), set()
+        for i, r in enumerate(layout.rows):
+            for j, c in enumerate(layout.cols):
+                if self.grid_ids[r, c] in dead:
+                    rows_hit.add(i)
+                    cols_hit.add(j)
+        return tuple(sorted(rows_hit)), tuple(sorted(cols_hit))
+
+    def _classify_axis(self, rows_hit, cols_hit,
+                       forced: "str | None" = None) -> str:
+        """Which axis pays for the loss. ``"auto"`` prefers scenarios
+        whenever that axis can shrink: a dropped column costs
+        robustness breadth (recoverable — the surviving branches'
+        probabilities renormalize into an honest reduced-tree problem),
+        a dropped row takes real plants offline. A scenario degrade
+        that would leave a SINGLE surviving branch is off the table
+        either way: the degenerate tree traces no non-anticipativity
+        collectives at all — a different program class the
+        schedule-identity gate refuses — so "auto" falls back to the
+        agents axis there."""
+        layout = self._current
+        spd = len(layout.scen_keep) // len(layout.cols)
+        surviving_branches = (len(layout.cols) - len(cols_hit)) * spd
+        axis = forced or self.degrade_axis
+        if axis == "auto":
+            axis = ("scenarios"
+                    if len(layout.cols) - len(cols_hit) >= 1
+                    and len(layout.cols) > 1
+                    and surviving_branches > 1 else "agents")
+        if axis == "scenarios":
+            if len(layout.cols) - len(cols_hit) < 1:
+                raise RuntimeError(
+                    "every scenario column hosts a dead device — no "
+                    "reduced scenario mesh exists; escalate to "
+                    "checkpoint restore")
+            if surviving_branches <= 1:
+                raise RuntimeError(
+                    "a scenarios-axis degrade here would leave a "
+                    "single surviving branch — the degenerate tree "
+                    "traces no non-anticipativity collectives (a "
+                    "different program class the schedule-identity "
+                    "gate refuses); degrade the agents axis instead")
+        elif len(layout.rows) - len(rows_hit) < 1:
+            raise RuntimeError(
+                "every agent row hosts a dead device — no reduced "
+                "agent mesh exists; escalate to checkpoint restore")
+        return axis
+
+    def _mark_dead_lanes(self, rows_hit) -> None:
+        """Base agent lanes hosted by the dead rows, derived from the
+        CURRENT layout's contiguous row assignment (the cascading-loss
+        rule of the flat supervisor: padding rows mask nothing)."""
+        layout = self._current
+        n_rows = len(layout.rows)
+        n_base = self.base_group.n_agents
+        rpd = (n_base + layout.pad) // n_rows
+        for p in rows_hit:
+            lo, hi = p * rpd, (p + 1) * rpd
+            self.dead_lanes[lo:min(hi, n_base)] = True
+
+    def _mark_dead_branches(self, cols_hit) -> None:
+        """Base scenario branches hosted by the dead columns, via the
+        CURRENT layout's contiguous column assignment."""
+        layout = self._current
+        n_cols = len(layout.cols)
+        spd = len(layout.scen_keep) // n_cols
+        for p in cols_hit:
+            for s in layout.scen_keep[p * spd:(p + 1) * spd]:
+                self.dead_branches.add(int(s))
+
+    def _degrade(self, report, forced_axis: "str | None" = None) -> str:
+        """Shard loss: classify by axis, rebuild on the surviving
+        rectangle, carry the warm state over aligned."""
+        layout = self._current
+        dead_here = tuple(d for d in report.dead
+                          if d in set(self._current_ids()))
+        if not dead_here:
+            raise ValueError(
+                f"none of the dead devices {list(report.dead)} sit on "
+                f"the current {len(layout.rows)}x{len(layout.cols)} "
+                f"grid — nothing to degrade")
+        rows_hit, cols_hit = self._dead_positions(dead_here)
+        axis = self._classify_axis(rows_hit, cols_hit, forced_axis)
+        snap = self._consensus_snapshot
+        if snap is not None:
+            for alias, ref in snap.items():
+                if not np.all(np.isfinite(ref)):
+                    raise RuntimeError(
+                        f"pre-failure consensus iterate zbar[{alias}] "
+                        f"is non-finite — refusing to carry a "
+                        f"corrupted state onto the degraded mesh")
+        self.dead_devices = tuple(dict.fromkeys(
+            (*self.dead_devices, *dead_here)))
+        was = (len(layout.rows), len(layout.cols))
+        if axis == "scenarios":
+            self._mark_dead_branches(cols_hit)
+            new_rows = layout.rows
+            new_cols = tuple(c for j, c in enumerate(layout.cols)
+                             if j not in set(cols_hit))
+        else:
+            self._mark_dead_lanes(rows_hit)
+            new_rows = tuple(r for i, r in enumerate(layout.rows)
+                             if i not in set(rows_hit))
+            new_cols = layout.cols
+        t0 = time.perf_counter()
+        self._current = self._layout_for(new_rows, new_cols)
+        build_s = time.perf_counter() - t0
+        self.degraded = True
+        self.degraded_axes.add(axis)
+        self._verify_carry = True
+        self._recenter_pending.add(axis)
+        self._healthy_degraded_rounds = 0
+        if self._probation_left > 0:
+            # relapse during probation: hysteresis PER AXIS — the
+            # failing axis's next re-admission needs twice the proof
+            self._readmit_needed[axis] = max(
+                self._readmit_needed[axis] * 2, self.readmit_after)
+            self._probation_left = 0
+        if telemetry.enabled():
+            telemetry.counter(
+                "mesh_degrade_total",
+                "degraded-mesh fallbacks (shard loss absorbed)").inc(
+                axis=axis)
+        self._export_gauges()
+        logger.warning(
+            "scenario fleet degraded %dx%d -> %dx%d devices on the %s "
+            "axis (dead: %s; engine %s in %.2fs); %d lane(s) and %d "
+            "branch(es) masked until re-admission",
+            was[0], was[1], len(new_rows), len(new_cols), axis,
+            list(dead_here),
+            "reused" if build_s < 0.05 else "built", build_s,
+            int(self.dead_lanes.sum()), len(self.dead_branches))
+        return axis
+
+    def _maybe_readmit(self) -> None:
+        if not self.degraded:
+            return
+        needed = max(self._readmit_needed[ax]
+                     for ax in self.degraded_axes) \
+            if self.degraded_axes else self.readmit_after
+        if self._healthy_degraded_rounds < needed:
+            return
+        report = self._probe(self.full_mesh)
+        if not report.all_answered:
+            self._healthy_degraded_rounds = 0
+            logger.info(
+                "re-admission probe: %d device(s) still dead (%s) — "
+                "staying on the %dx%d grid; next probe after %d more "
+                "healthy rounds", len(report.dead), list(report.dead),
+                len(self._current.rows), len(self._current.cols),
+                needed)
+            return
+        full = self._layouts[self._full_key]
+        full.fleet.mesh_condemned = False
+        self._current = full
+        self.degraded = False
+        self.degraded_axes = set()
+        self._healthy_degraded_rounds = 0
+        self._reset_pending = True
+        self._probation_left = self.probation_rounds
+        self.dead_devices = ()
+        if telemetry.enabled():
+            telemetry.counter(
+                "mesh_readmit_total",
+                "full-mesh re-admissions after degraded service").inc()
+        self._export_gauges()
+        logger.warning(
+            "full %dx%d grid re-admitted on probation (%d rounds); "
+            "lost lanes and branches re-enter with fresh warm starts",
+            self.grid.shape[0], self.grid.shape[1],
+            self.probation_rounds)
+
+    # -- actuation ------------------------------------------------------------
+
+    def actuated_u0(self, state) -> jnp.ndarray:
+        """The robust controls to actuate, BASE layout (n_agents, S,
+        n_u): the non-anticipativity projection's first-interval rows.
+        Lost branches report their stage-0 node group's surviving
+        projection (group-identical by construction extends to the
+        members that are not being solved); a dead branch whose ENTIRE
+        stage-0 group was lost has no surviving projection and reports
+        NaN — no data is honest data, a stale pre-loss iterate is not
+        (the caller's guard ladder owns a NaN command)."""
+        if self._flat is not None:
+            raise NotImplementedError(
+                "the S=1 delegate has no non-anticipativity "
+                "projection — read u0 from the flat round's "
+                "trajectories, like FleetSupervisor")
+        if not self.tree.robust_horizon:
+            u = jax.vmap(jax.vmap(
+                lambda w: self.base_group.ocp.unflatten(w)["u"]))(
+                state.w)
+            return u[:, :, 0, :]
+        u0 = state.na_target[:, :, 0, :]
+        if not self.dead_branches:
+            return u0
+        u0 = np.asarray(u0).copy()
+        alive = [s for s in range(self.S)
+                 if s not in self.dead_branches]
+        groups0 = self.tree.groups_at(0)
+        for s in sorted(self.dead_branches):
+            grp = next((g for g in groups0 if s in g), None)
+            donor = next((m for m in (grp or ()) if m in alive), None)
+            u0[:, s] = u0[:, donor] if donor is not None else np.nan
+        return jnp.asarray(u0)
+
+    # -- operator / gate hooks ------------------------------------------------
+
+    def force_degrade(self, dead_device_ids,
+                      axis: "str | None" = None) -> str:
+        """Operator/gate entry: degrade as if ``dead_device_ids`` had
+        failed a probe. ``axis`` overrides the classification policy
+        for this call. Returns the degraded axis."""
+        if self._flat is not None:
+            self._flat.force_degrade(dead_device_ids)
+            return "agents"
+        alive = tuple(d for d in self._current_ids()
+                      if d not in set(dead_device_ids))
+        return self._degrade(multihost.ShardProbeReport(
+            answered=alive, dead=tuple(dead_device_ids),
+            latency_s={}), forced_axis=axis)
+
+    def _current_ids(self) -> tuple:
+        layout = self._current
+        return tuple(self.grid_ids[np.ix_(layout.rows,
+                                          layout.cols)].flat)
+
+    def force_readmit(self) -> None:
+        """Operator/gate entry: reshard back to the full grid now,
+        bypassing the hysteresis clock."""
+        if self._flat is not None:
+            self._flat.force_readmit()
+            return
+        needed = max(self._readmit_needed[ax]
+                     for ax in self.degraded_axes) \
+            if self.degraded_axes else self.readmit_after
+        self._healthy_degraded_rounds = needed
+        probe, self._probe = self._probe, lambda m: \
+            multihost.ShardProbeReport(
+                answered=tuple(d.id for d in m.devices.flat),
+                dead=(), latency_s={})
+        try:
+            self._maybe_readmit()
+        finally:
+            self._probe = probe
+
+    def _export_gauges(self) -> None:
+        if telemetry.enabled():
+            telemetry.gauge(
+                "mesh_devices_active",
+                "devices in the mesh currently serving the fleet").set(
+                float(len(self._current.rows)
+                      * len(self._current.cols)))
+            telemetry.gauge(
+                "scenario_branches_active",
+                "disturbance branches currently solved by the "
+                "scenario supervisor (base count minus dead "
+                "branches)").set(
+                float(self.S - len(self.dead_branches)))
+
+    def stats(self) -> dict:
+        if self._flat is not None:
+            out = self._flat.stats()
+            out["degraded_axes"] = []
+            out["scenarios_active"] = 1
+            return out
+        return {
+            "devices_full": len(self._full_ids),
+            "devices_active": len(self._current.rows)
+            * len(self._current.cols),
+            "mesh_shape": self.mesh_shape,
+            "degraded": self.degraded,
+            "degraded_axes": sorted(self.degraded_axes),
+            "dead_devices": list(self.dead_devices),
+            "dead_lanes": int(self.dead_lanes.sum()),
+            "dead_branches": sorted(self.dead_branches),
+            "scenarios_active": self.S - len(self.dead_branches),
+            "rounds": self.rounds,
+            "degraded_rounds": self.degraded_rounds,
+            "layouts_built": len(self._layouts),
+            "last_mttr_s": self.last_mttr_s,
+            "mttr_by_axis": dict(self.mttr_by_axis),
+            "probation_left": self._probation_left,
+            "collective_schedule_digest":
+                self._current.fleet.collective_schedule_digest,
         }

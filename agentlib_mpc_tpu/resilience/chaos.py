@@ -629,10 +629,15 @@ class MeshStallRule:
     watchdog's reader thread, so a long stall costs one leaked daemon
     thread exactly like a real wedged collective. With every shard
     still answering the probe, the supervisor retries the round on the
-    SAME mesh (the transient path)."""
+    SAME mesh (the transient path). ``axis`` tags the event (and the
+    ``--chaos-scenario`` schedule window it belongs to) on a 2-D
+    supervisor — a wedged collective stalls the WHOLE round regardless
+    of which axis's all-reduce hung, so the tag carries no targeting
+    semantics, only attribution."""
 
     round: int = 0
     duration_s: float = 60.0
+    axis: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -644,11 +649,23 @@ class MeshDeviceLossRule:
     degrades the fleet onto the survivors. ``revive_at_round`` brings
     the device back (it answers probes again; the supervisor's
     hysteretic re-admission reshards to the full mesh); None = stays
-    dead."""
+    dead.
+
+    **Axis targeting (ISSUE 14).** On a 2-D
+    :class:`~agentlib_mpc_tpu.parallel.survival.ScenarioFleetSupervisor`
+    grid the victim is addressed by grid coordinates: ``axis=
+    "scenarios"`` reads ``device_index`` along the scenario columns
+    (the victim is ``grid[cross_index, device_index]``), ``axis=
+    "agents"`` along the agent rows (``grid[device_index,
+    cross_index]``). ``axis=None`` keeps the flat 1-D addressing
+    (position in the supervisor's full device list) — the PR 10
+    behavior, unchanged."""
 
     device_index: int = 0        # position in the supervisor's FULL mesh
     die_at_round: int = 0
     revive_at_round: Optional[int] = None
+    axis: Optional[str] = None
+    cross_index: int = 0
 
     def dead(self, round_: int) -> bool:
         if round_ < self.die_at_round:
@@ -664,11 +681,20 @@ class MeshNaNStormRule:
     bad-sensor-feed failure at device granularity. The fused
     quarantine must contain it (substituted iterates, masked means):
     the OTHER shards' agents keep producing finite controls and the
-    consensus state stays finite."""
+    consensus state stays finite.
+
+    **Axis targeting (ISSUE 14).** On a 2-D scenario supervisor,
+    ``axis="scenarios"`` poisons the disturbance BRANCHES hosted by
+    scenario-shard column ``device_index`` (every agent's data for
+    those branches — the bad-forecast-ensemble failure), while
+    ``axis="agents"`` (or None) poisons the agent lanes hosted by
+    agent-shard row ``device_index`` across every branch (the
+    bad-sensor-feed failure, as on the 1-D mesh)."""
 
     device_index: int = 0
     start_round: int = 0
     n_rounds: Optional[int] = 1
+    axis: Optional[str] = None
 
     def triggered(self, round_: int) -> bool:
         if round_ < self.start_round:
@@ -711,7 +737,11 @@ class MeshChaosConfig:
 def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
                        seed: "int | None" = None) -> ChaosController:
     """Install the mesh-scope injectors on a
-    :class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor`.
+    :class:`~agentlib_mpc_tpu.parallel.survival.FleetSupervisor` or a
+    2-D :class:`~agentlib_mpc_tpu.parallel.survival.
+    ScenarioFleetSupervisor` (ISSUE 14 — the rules' ``axis`` fields
+    address the (agents × scenarios) grid; an S=1 scenario supervisor
+    delegates to its flat supervisor, and so does this installer).
 
     Two seams: the supervisor's per-round dispatch (stalls, device-loss
     hangs, shard-local theta poisoning — injected by wrapping each
@@ -727,17 +757,33 @@ def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
         config = MeshChaosConfig.from_dict(config)
     if seed is not None:
         config = dataclasses.replace(config, seed=int(seed))
+    if getattr(supervisor, "_flat", None) is not None:
+        # degenerate scenario supervisor: the flat machinery serves —
+        # chaos lands where the rounds actually run
+        return install_mesh_chaos(supervisor._flat, config)
     controller = ChaosController(ChaosConfig(seed=config.seed))
     counters = {"round": 0}
     fired_stalls: set = set()
     full_ids = supervisor._full_ids
+    grid_ids = getattr(supervisor, "grid_ids", None)
+    is_2d = grid_ids is not None
+
+    def rule_victim_id(rule):
+        """The device a rule targets: grid coordinates when an axis is
+        named on a 2-D supervisor, flat full-mesh position otherwise."""
+        axis = getattr(rule, "axis", None)
+        if is_2d and axis == "scenarios":
+            return int(grid_ids[rule.cross_index, rule.device_index])
+        if is_2d and axis == "agents":
+            return int(grid_ids[rule.device_index, rule.cross_index])
+        return full_ids[rule.device_index]
 
     def dead_ids_now() -> set:
         r = counters["round"]
         out = set()
         for rule in config.device_loss:
             if rule.dead(r):
-                out.add(full_ids[rule.device_index])
+                out.add(rule_victim_id(rule))
         return out
 
     orig_probe = supervisor._probe
@@ -764,36 +810,81 @@ def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
 
     orig_run = supervisor._run_layout
 
-    def run_layout(layout, state, theta_batches, base_masks):
+    def poison_flat(theta_batches, rule):
+        """Poison the base-layout agent rows hosted by the target shard
+        of a FLAT supervisor's full mesh."""
+        import jax as _jax
+
+        full = supervisor._layouts[full_ids]
+        n_dev = len(full_ids)
+        poisoned = []
+        for gi, g in enumerate(supervisor.base_groups):
+            n_full = g.n_agents + full.pads.get(gi, 0)
+            rpd = n_full // n_dev
+            lo = rule.device_index * rpd
+            hi = min((rule.device_index + 1) * rpd, g.n_agents)
+
+            def poison(leaf, lo=lo, hi=hi):
+                if hi <= lo:
+                    return leaf
+                arr = np.asarray(leaf, dtype=float).copy()
+                arr[lo:hi] = np.nan
+                return arr
+
+            poisoned.append(_jax.tree.map(poison, theta_batches[gi]))
+        return tuple(poisoned)
+
+    def poison_2d(theta_batch, rule):
+        """Poison the (n_agents, S)-batched theta of a 2-D supervisor:
+        branch columns for axis="scenarios", agent rows otherwise."""
+        import jax as _jax
+
+        if rule.axis == "scenarios":
+            spd = supervisor.spd
+            lo = rule.device_index * spd
+            hi = min((rule.device_index + 1) * spd, supervisor.S)
+
+            def poison(leaf, lo=lo, hi=hi):
+                if hi <= lo:
+                    return leaf
+                arr = np.asarray(leaf, dtype=float).copy()
+                arr[:, lo:hi] = np.nan
+                return arr
+        else:
+            full = supervisor._layouts[supervisor._full_key]
+            n_rows = supervisor.grid.shape[0]
+            n_base = supervisor.base_group.n_agents
+            rpd = (n_base + full.pad) // n_rows
+            lo = rule.device_index * rpd
+            hi = min((rule.device_index + 1) * rpd, n_base)
+
+            def poison(leaf, lo=lo, hi=hi):
+                if hi <= lo:
+                    return leaf
+                arr = np.asarray(leaf, dtype=float).copy()
+                arr[lo:hi] = np.nan
+                return arr
+
+        return _jax.tree.map(poison, theta_batch)
+
+    def layout_ids(layout) -> set:
+        if is_2d:
+            return {int(grid_ids[r, c])
+                    for r in layout.rows for c in layout.cols}
+        return set(layout.device_ids)
+
+    def run_layout(layout, state, theta, base_masks):
         r = counters["round"]
-        # shard-local NaN storm: poison the theta rows the target
-        # shard hosts (base-layout rows via the supervisor's own
-        # full-mesh row assignment)
+        # shard-local NaN storm: poison the data the target shard hosts
+        # (agent rows, or — axis="scenarios" on a 2-D grid — branches)
         for rule in config.nan_storm:
             if not rule.triggered(r):
                 continue
             controller.note("mesh_nan_theta",
-                            f"device{rule.device_index}:round{r}")
-            full = supervisor._layouts[full_ids]
-            n_dev = len(full_ids)
-            poisoned = []
-            for gi, g in enumerate(supervisor.base_groups):
-                n_full = g.n_agents + full.pads.get(gi, 0)
-                rpd = n_full // n_dev
-                lo = rule.device_index * rpd
-                hi = min((rule.device_index + 1) * rpd, g.n_agents)
-
-                def poison(leaf, lo=lo, hi=hi):
-                    if hi <= lo:
-                        return leaf
-                    arr = np.asarray(leaf, dtype=float).copy()
-                    arr[lo:hi] = np.nan
-                    return arr
-
-                import jax as _jax
-
-                poisoned.append(_jax.tree.map(poison, theta_batches[gi]))
-            theta_batches = tuple(poisoned)
+                            f"{rule.axis or 'device'}"
+                            f"{rule.device_index}:round{r}")
+            theta = poison_2d(theta, rule) if is_2d \
+                else poison_flat(theta, rule)
         # stall / device-loss hang: wrap THIS dispatch of the layout's
         # engine so the sleep lands inside the collective watchdog's
         # reader thread
@@ -804,17 +895,20 @@ def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
                       if x.round == r and i not in fired_stalls), None)
         if stall is not None:
             fired_stalls.add(stall)
-            hang_s = float(config.stall[stall].duration_s)
-            controller.note("mesh_stall", f"round{r}")
+            rule = config.stall[stall]
+            hang_s = float(rule.duration_s)
+            controller.note("mesh_stall",
+                            f"round{r}" + (f":{rule.axis}"
+                                           if rule.axis else ""))
         if hang_s is None:
             dead = dead_ids_now()
-            if dead & set(layout.device_ids):
+            if dead & layout_ids(layout):
                 hang_s = supervisor.watchdog_timeout_s * 10
                 controller.note("mesh_device_hang",
                                 f"round{r}:{sorted(dead)}")
+        engine = layout.fleet if is_2d else layout.engine
         if hang_s is None:
-            return orig_run(layout, state, theta_batches, base_masks)
-        engine = layout.engine
+            return orig_run(layout, state, theta, base_masks)
         orig_step = engine._step
 
         def slow_step(*args, _orig=orig_step, _s=hang_s):
@@ -823,7 +917,7 @@ def install_mesh_chaos(supervisor, config: "MeshChaosConfig | dict",
 
         engine._step = slow_step
         try:
-            return orig_run(layout, state, theta_batches, base_masks)
+            return orig_run(layout, state, theta, base_masks)
         finally:
             engine._step = orig_step
 

@@ -110,6 +110,16 @@ class ScenarioFleetOptions(NamedTuple):
     warm_budget: int = 6
     #: warm-phase initial barrier
     warm_mu: float = 1e-2
+    #: quarantine non-finite per-branch solutions inside the jitted
+    #: loop (the FusedADMM pattern at (agent, scenario) granularity): a
+    #: diverged branch is replaced by its previous iterate via
+    #: ``jnp.where`` — purely elementwise, so the certified collective
+    #: schedule (and the [jaxpr.collectives.scenario] psum pins) is
+    #: unchanged
+    quarantine: bool = True
+    #: consecutive quarantined iterations before a branch's warm start
+    #: is reset to the (sanitized) OCP initial guess
+    quarantine_reset_after: int = 3
 
 
 class ScenarioState(NamedTuple):
@@ -135,6 +145,14 @@ class ScenarioStats(NamedTuple):
     #: controls sit from their group projection (the ``scenario_spread``
     #: telemetry histogram; exactly 0 when the tree has no coupling)
     na_spread: jnp.ndarray            # ()
+    #: PER-BRANCH quarantine attribution: (n_agents, S) int32 — how
+    #: many of the round's iterations each (agent, scenario) lane spent
+    #: quarantined. The substitution keeps a sick branch's decoded
+    #: trajectory finite, so this column is the ONLY signal that a
+    #: branch diverged every iteration — the serving health ledger's
+    #: third sickness signal on robust tenants (ISSUE 14 satellite).
+    #: None when the fleet was built with ``quarantine=False``.
+    lane_quarantined: "jnp.ndarray | None" = None
 
 
 class ScenarioFleet:
@@ -147,7 +165,8 @@ class ScenarioFleet:
                  options: ScenarioFleetOptions = ScenarioFleetOptions(),
                  active=None, mesh=None,
                  collective_certify: str = "auto",
-                 memory_certify: str = "auto"):
+                 memory_certify: str = "auto",
+                 watchdog_timeout_s: "float | None" = None):
         """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
         AgentGroup` (couplings only; exchanges are not scenario-lifted).
         ``tree``: the static scenario tree; ``tree.n_scenarios == 1``
@@ -162,7 +181,14 @@ class ScenarioFleet:
         (:mod:`agentlib_mpc_tpu.lint.jaxpr.memory`) — the scenario axis
         multiplies every lane buffer by S, which is exactly the
         projection the certificate prices before a robust fleet can
-        OOM a pod dispatch."""
+        OOM a pod dispatch. ``watchdog_timeout_s``: arm the COLLECTIVE
+        watchdog — every 2-D round runs on a bounded reader (the
+        :class:`FusedADMM` pattern on both axes); a blown budget
+        condemns the mesh, records a bounded per-device probe on
+        ``self.shard_report`` and raises
+        :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
+        so :class:`~agentlib_mpc_tpu.parallel.survival.
+        ScenarioFleetSupervisor` can classify the loss by axis."""
         from agentlib_mpc_tpu.parallel.fused_admm import FusedADMM
 
         if group.exchanges:
@@ -199,6 +225,14 @@ class ScenarioFleet:
         self.memory_certify = memory_certify
         self.memory_certificate = None
         self.memory_digest = None
+        self.watchdog_timeout_s = (None if watchdog_timeout_s is None
+                                   else float(watchdog_timeout_s))
+        #: True once a round blew the collective-watchdog budget — the
+        #: supervisor resets it when it decides the mesh may serve again
+        self.mesh_condemned = False
+        #: the bounded per-device probe a condemned round leaves behind
+        self.shard_report = None
+        self._watchdog_reader = None
         self.mesh = mesh
         self._membership, self._counts = self._build_membership()
         self._compile_step()
@@ -361,6 +395,56 @@ class ScenarioFleet:
                 sq = jax.lax.psum(sq, ax_a)
             return jnp.sqrt(close_sum(sq))
 
+        quarantine = bool(opts.quarantine)
+        q_reset_after = max(int(opts.quarantine_reset_after), 1)
+
+        def lane_finite(arr):
+            """All-finite per (agent, scenario) lane — reduce every
+            trailing axis."""
+            return jnp.all(jnp.isfinite(arr),
+                           axis=tuple(range(2, arr.ndim)))
+
+        def apply_quarantine(state, theta_batch, streak,
+                             w_b, y_b, z_b, u_b, active):
+            """Quarantine diverged (agent, scenario) branches inside
+            the jit — the FusedADMM substitution at branch granularity:
+            a non-finite branch solution is replaced by that branch's
+            previous iterate via ``jnp.where`` (elementwise only — no
+            new collectives, so the certified two-family schedule and
+            its psum pins are untouched), branches quarantined
+            ``quarantine_reset_after`` iterations in a row restart from
+            the sanitized OCP initial guess, and the per-branch
+            attribution rides out on ``ScenarioStats.lane_quarantined``
+            (the substitution keeps the decoded trajectory finite, so
+            without this column a persistently-NaN branch looks healthy
+            forever)."""
+            bad = ~(lane_finite(w_b) & lane_finite(y_b)
+                    & lane_finite(z_b) & lane_finite(u_b))
+            u_prev = jax.vmap(jax.vmap(
+                lambda w: ocp.unflatten(w)["u"]))(state.w)
+            sub2 = bad[:, :, None]
+            w_b = jnp.where(sub2, state.w, w_b)
+            y_b = jnp.where(sub2, state.y, y_b)
+            z_b = jnp.where(sub2, state.z, z_b)
+            u_b = jnp.where(bad[:, :, None, None], u_prev, u_b)
+            streak = jnp.where(bad, streak + 1, 0)
+            resetting = streak >= q_reset_after
+            w_init = jax.vmap(jax.vmap(ocp.initial_guess))(theta_batch)
+            w_init = jnp.where(jnp.isfinite(w_init), w_init, 0.0)
+            w_b = jnp.where(resetting[:, :, None], w_init, w_b)
+            y_b = jnp.where(resetting[:, :, None], 0.0, y_b)
+            z_b = jnp.where(resetting[:, :, None], 0.1, z_b)
+            streak = jnp.where(resetting, 0, streak)
+            # last-resort elementwise sanitize: a poisoned carry must
+            # never write NaN into the group projection — an unmasked
+            # NaN mean bakes NaN into every member branch's multiplier
+            w_b = jnp.where(jnp.isfinite(w_b), w_b, 0.0)
+            y_b = jnp.where(jnp.isfinite(y_b), y_b, 0.0)
+            z_b = jnp.where(jnp.isfinite(z_b), z_b, 0.1)
+            u_b = jnp.where(jnp.isfinite(u_b), u_b, 0.0)
+            q_bad = bad & active[:, None]
+            return w_b, y_b, z_b, u_b, streak, q_bad
+
         def step_fn(state: ScenarioState, theta_batch, active,
                     membership, scen_weight):
             max_it = opts.max_iterations
@@ -381,7 +465,7 @@ class ScenarioFleet:
 
             def iteration(carry):
                 (state, it, _res, prim_h, dual_h, done, ok_hist,
-                 na_last) = carry
+                 na_last, q_streak, q_lane) = carry
                 is_cold = it == 0
                 cold = g.solver_options
                 mu0 = jnp.where(is_cold, cold.mu_init, opts.warm_mu)
@@ -394,6 +478,11 @@ class ScenarioFleet:
                 w_b, y_b, z_b, u_b, ok_b = local_solves(
                     state, theta_batch, scen_weight, mu0, budget,
                     rho_na_t)
+                if quarantine:
+                    w_b, y_b, z_b, u_b, q_streak, q_bad = \
+                        apply_quarantine(state, theta_batch, q_streak,
+                                         w_b, y_b, z_b, u_b, active)
+                    q_lane = q_lane + q_bad.astype(jnp.int32)
                 n_failed = jnp.sum(
                     ~(ok_b | ~active[:, None]), dtype=jnp.int32)
                 if ax_a is not None:
@@ -452,7 +541,8 @@ class ScenarioFleet:
                     zbar=zbar_new, lam=lam_new, nu=nu_new,
                     na_target=target, w=w_b, y=y_b, z=z_b)
                 return (state, it + 1, res_all, prim_h, dual_h,
-                        is_conv, ok_hist & ok_all, na_last)
+                        is_conv, ok_hist & ok_all, na_last, q_streak,
+                        q_lane)
 
             def cond(carry):
                 done, it = carry[5], carry[1]
@@ -461,16 +551,21 @@ class ScenarioFleet:
             nan_hist = jnp.full((max_it,), jnp.nan)
             init_res = AdmmResiduals(*([jnp.asarray(jnp.inf)] * 2),
                                      *([jnp.asarray(0.0)] * 4))
+            q_shape = (state.w.shape[0], state.w.shape[1])
             carry = (state, jnp.asarray(0), init_res, nan_hist,
                      jnp.full((max_it,), jnp.nan), jnp.asarray(False),
-                     jnp.asarray(True), jnp.asarray(0.0))
+                     jnp.asarray(True), jnp.asarray(0.0),
+                     jnp.zeros(q_shape, jnp.int32),
+                     jnp.zeros(q_shape, jnp.int32))
             (state, it, _res, prim_h, dual_h, done, ok_hist,
-             na_last) = jax.lax.while_loop(cond, iteration, carry)
+             na_last, _q_streak, q_lane) = jax.lax.while_loop(
+                cond, iteration, carry)
 
             stats = ScenarioStats(
                 iterations=it, primal_residuals=prim_h,
                 dual_residuals=dual_h, converged=done,
-                local_solves_ok=ok_hist, na_spread=na_last)
+                local_solves_ok=ok_hist, na_spread=na_last,
+                lane_quarantined=q_lane if quarantine else None)
             trajs = jax.vmap(jax.vmap(ocp.trajectories))(state.w,
                                                          theta_batch)
             return state, trajs, stats
@@ -520,7 +615,14 @@ class ScenarioFleet:
             zbar={a: sh_s for a in self._aliases},
             lam={a: sh_as for a in self._aliases},
             nu=sh_as, na_target=sh_as, w=sh_as, y=sh_as, z=sh_as)
-        stats_spec = ScenarioStats(*([P()] * 6))
+        # lane_quarantined is the ONE sharded stats out-spec: per-branch
+        # attribution keeps global (agents, scenarios) rows (with
+        # quarantine off the body returns None there, which a P() spec
+        # happily covers)
+        stats_spec = ScenarioStats(
+            *([P()] * 6),
+            lane_quarantined=(sh_as if self.options.quarantine
+                              else P()))
         step_fn = self._build_step(ax_a=ax_a, ax_s=ax_s)
         # check_rep=False for the same reason FusedADMM sets it: the
         # psum'ed loop outputs are replicated by construction, which
@@ -667,12 +769,89 @@ class ScenarioFleet:
                                                               bool)
         args = (state, theta_batch, mask, self._membership,
                 self._scen_weight)
+        if self.watchdog_timeout_s is not None:
+            return self._step_watchdogged(args)
         if not telemetry.enabled():
             return self._step(*args)
         with telemetry.span("scenario.fused_step", group=self.group.name,
                             scenarios=str(self.S)):
             out = self._step(*args)
-        stats = out[2]
+        self._record_round(out[2])
+        return out
+
+    def _step_watchdogged(self, args):
+        """One robust round under the collective watchdog: dispatch AND
+        sync run on a bounded daemon reader (the :class:`FusedADMM`
+        pattern over both mesh axes — a wedged 2-D collective cannot be
+        cancelled, only abandoned). On timeout the mesh is condemned, a
+        bounded per-device re-probe records which shards of the FULL
+        (agents × scenarios) grid answered, and
+        :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
+        carries the report out for the supervisor's axis
+        classification."""
+        from agentlib_mpc_tpu.parallel.multihost import (
+            MESH_PROBE_TIMEOUT_S,
+            MeshRoundTimeout,
+            probe_mesh_devices,
+        )
+
+        if self._watchdog_reader is None:
+            from agentlib_mpc_tpu.utils.watchdog import BoundedReader
+
+            self._watchdog_reader = BoundedReader(
+                name="scenario-round-reader")
+
+        def dispatch():
+            if telemetry.enabled():
+                with telemetry.span("scenario.fused_step",
+                                    group=self.group.name,
+                                    scenarios=str(self.S)):
+                    out = self._step(*args)
+            else:
+                out = self._step(*args)
+            jax.block_until_ready(out)
+            return out
+
+        kind, value = self._watchdog_reader.run(dispatch,
+                                                self.watchdog_timeout_s)
+        if kind == "err":
+            raise value
+        if kind in ("timeout", "saturated"):
+            self.mesh_condemned = True
+            if telemetry.enabled():
+                telemetry.counter(
+                    "mesh_watchdog_stalls_total",
+                    "mesh-dispatched fused rounds that blew the "
+                    "collective-watchdog budget").inc(outcome=kind)
+            probe = None
+            if self.mesh is not None:
+                probe = probe_mesh_devices(
+                    self.mesh, min(self.watchdog_timeout_s,
+                                   MESH_PROBE_TIMEOUT_S))
+                self.shard_report = probe
+                logger.error(
+                    "scenario round blew the %.1fs collective watchdog; "
+                    "2-D mesh condemned — per-device probe: %d/%d "
+                    "shards answered (dead: %s)",
+                    self.watchdog_timeout_s, len(probe.answered),
+                    len(probe.answered) + len(probe.dead),
+                    list(probe.dead) or "none")
+            else:
+                logger.error(
+                    "scenario round blew the %.1fs watchdog on a "
+                    "mesh-less fleet; no shards to probe",
+                    self.watchdog_timeout_s)
+            raise MeshRoundTimeout(
+                f"scenario round did not complete within the "
+                f"{self.watchdog_timeout_s:.1f}s collective-watchdog "
+                f"budget" + ("" if kind == "timeout" else
+                             " (watchdog reader leak cap reached — the "
+                             "mesh is already known-dead)"), probe=probe)
+        if telemetry.enabled():
+            self._record_round(value[2])
+        return value
+
+    def _record_round(self, stats: ScenarioStats) -> None:
         telemetry.gauge(
             "scenario_count",
             "disturbance scenarios batched per agent in the scenario "
@@ -686,8 +865,21 @@ class ScenarioFleet:
             "scenario_rounds_total",
             "fused scenario-tree robust rounds run").inc(
             group=self.group.name)
+        if stats.lane_quarantined is not None:
+            n_q = int(np.asarray(stats.lane_quarantined).sum())
+            if n_q:
+                # per-branch attribution rolled up: total (branch ×
+                # iteration) quarantine events this round — the robust
+                # tenants' third sickness signal, decodable per branch
+                # from the stats row itself
+                telemetry.counter(
+                    "scenario_quarantined_iters",
+                    "quarantined (branch, iteration) events inside "
+                    "fused scenario rounds — non-finite branch "
+                    "solutions substituted by the previous iterate"
+                    ).inc(n_q, group=self.group.name)
         telemetry.record_device_memory()
-        return out
+        return None
 
     def actuated_u0(self, state: ScenarioState) -> jnp.ndarray:
         """The robust controls to actuate: the non-anticipativity

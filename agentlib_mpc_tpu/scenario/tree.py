@@ -114,6 +114,42 @@ class ScenarioTree(NamedTuple):
                 f"{N}-interval control horizon")
         return self
 
+    def subtree(self, keep) -> "ScenarioTree":
+        """The tree restricted to the surviving scenario indices
+        ``keep`` (ascending order enforced so sliced state arrays stay
+        aligned), with the group probabilities RE-NORMALIZED to sum to
+        one again. This is the scenario-axis degrade contract (ISSUE
+        14): dropping branches without renormalizing leaves the
+        expectation weighted by a sub-distribution — every surviving
+        branch under-weighted against the consensus/NA penalties — and
+        the actuated group mean permanently biased vs a robust problem
+        honestly posed at the reduced branch count. Node groups shrink
+        with their members (``groups_at`` derives from ``node_of``), so
+        a lost branch leaves its non-anticipativity groups exactly.
+
+        An all-zero surviving mass (every kept branch was probability-0
+        padding) falls back to uniform — dead weight stays solvable."""
+        keep = tuple(int(s) for s in keep)
+        if not keep:
+            raise ValueError("subtree needs >= 1 surviving scenario")
+        if list(keep) != sorted(set(keep)):
+            raise ValueError(
+                f"surviving scenario indices must be strictly "
+                f"ascending, got {keep}")
+        bad = [s for s in keep if not 0 <= s < self.n_scenarios]
+        if bad:
+            raise ValueError(
+                f"surviving indices {bad} outside the "
+                f"{self.n_scenarios}-scenario tree")
+        probs = tuple(self.probabilities[s] for s in keep)
+        total = sum(probs)
+        probs = (tuple(p / total for p in probs) if total > 0
+                 else _uniform(len(keep)))
+        node_of = tuple(tuple(nodes[s] for s in keep)
+                        for nodes in self.node_of)
+        return ScenarioTree(n_scenarios=len(keep), node_of=node_of,
+                            probabilities=probs).validate()
+
 
 def _uniform(n: int) -> tuple:
     return tuple(1.0 / n for _ in range(n))

@@ -182,6 +182,11 @@ def save_plane(plane, path: str) -> str:
             # issue a different all-reduce sequence must be refused —
             # on a pod that drift is a silent cross-host hang
             "collective_digest": bucket.engine.collective_schedule_digest,
+            # robust buckets carry the scenario axis (ISSUE 14): their
+            # FusedState sibling is a ScenarioState with (capacity, S)
+            # leading axes — recorded for observability; the restore
+            # template comes from the re-acquired engine either way
+            "scenarios": int(getattr(bucket, "n_scenarios", 1)),
         })
         arrays.append({
             "state": bucket.state,
@@ -196,13 +201,21 @@ def save_plane(plane, path: str) -> str:
         # device topology the slot layouts were padded for: a restore
         # on a different mesh/slot-multiple would splice misaligned
         # lanes — restore_plane rejects the drift LOUDLY (ISSUE 10
-        # satellite; the old manifest ignored topology entirely)
+        # satellite; the old manifest ignored topology entirely).
+        # "mesh_shape" records the FULL shape — axis names AND sizes
+        # (ISSUE 14: a scalar size cannot tell a 4x2 agents×scenarios
+        # grid from an 8-device agents line, and the two compile
+        # different programs); the scalar fields stay for older
+        # readers
         "topology": {
             "slot_multiple": int(plane.slot_multiple),
             "mesh_devices": (None if plane.mesh is None
                              else int(plane.mesh.devices.size)),
             "mesh_axis": (None if plane.mesh is None
                           else str(plane.mesh.axis_names[0])),
+            "mesh_shape": (None if plane.mesh is None else [
+                [str(axis), int(size)] for axis, size in zip(
+                    plane.mesh.axis_names, plane.mesh.devices.shape)]),
             "backend_devices": len(jax.devices()),
         },
         "buckets": buckets,
@@ -283,8 +296,38 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
     else:
         want_mesh = None if plane.mesh is None \
             else int(plane.mesh.devices.size)
+        want_shape = None if plane.mesh is None else [
+            [str(axis), int(size)] for axis, size in zip(
+                plane.mesh.axis_names, plane.mesh.devices.shape)]
         saved_mesh = topo.get("mesh_devices")
         saved_mult = int(topo.get("slot_multiple", 0))
+        saved_shape = topo.get("mesh_shape")
+        if "mesh_shape" not in topo:
+            # legacy scalar stamp (pre-ISSUE 14): the size-only check
+            # still runs below, but a 2-D grid and a 1-D line of the
+            # same device count are indistinguishable to it — restore,
+            # and say so
+            logger.warning(
+                "plane checkpoint at %s carries a legacy scalar "
+                "topology stamp (mesh size only) — restoring with the "
+                "size-only check; a mesh SHAPE drift (e.g. a 4x2 "
+                "agents×scenarios grid vs an 8-device agents line) "
+                "cannot be detected on this checkpoint", src)
+        elif saved_shape != want_shape:
+            raise ValueError(
+                f"checkpoint topology mismatch: saved on mesh_shape="
+                f"{saved_shape}, restoring into {want_shape} — the "
+                f"two shapes compile different programs (axis names "
+                f"and sizes are baked into every sharded executable "
+                f"and slot layout). Either (a) restore into a plane "
+                f"built on the recorded shape (ServingPlane(mesh="
+                f"<{saved_shape} mesh>) / slot_multiple={saved_mult}),"
+                f" or (b) RESHARD: start an empty plane on the new "
+                f"mesh and re-join every tenant from its spec — "
+                f"capacities re-pad to serving_slot_multiple(mesh) "
+                f"and warm starts reset (the documented cost of "
+                f"changing topology; docs/serving.md 'Cross-process "
+                f"restore')")
         if saved_mesh != want_mesh or saved_mult != plane.slot_multiple:
             raise ValueError(
                 f"checkpoint topology mismatch: saved on "
@@ -304,6 +347,12 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
 
     if not isinstance(specs, dict):
         specs = {s.tenant_id: s for s in specs}
+    # the join-path door checks apply on restore too: an S=1 scenario
+    # tree normalizes into the flat spec (theta's branch axis
+    # squeezed), so the registered spec cannot drift from what join
+    # would have produced
+    specs = {tid: plane._normalize_robust_spec(s)
+             for tid, s in specs.items()}
     hits0, misses0 = plane.cache.hits, plane.cache.misses
     restores0 = plane.cache.persistent_restores
     per_tenant_s: dict = {}

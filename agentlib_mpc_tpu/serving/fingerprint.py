@@ -59,6 +59,21 @@ class TenantSpec:
     warm_solver_options: "SolverOptions | None" = None
     qp_fast_path: str = "auto"
     deadline_s: "float | None" = None
+    #: robust tenant (ISSUE 14): a hashable
+    #: :class:`~agentlib_mpc_tpu.scenario.tree.ScenarioTree` lifts this
+    #: tenant into a SCENARIO bucket — its lane solves S disturbance
+    #: branches per round on a :class:`~agentlib_mpc_tpu.scenario.
+    #: fleet.ScenarioFleet` engine, and ``theta`` must carry the
+    #: (S, ...)-leading per-branch parameter stack
+    #: (``scenario.generate`` builds it). Tree identity enters the
+    #: bucket key: different trees are different compiled programs.
+    #: The degenerate single-scenario tree normalizes into the FLAT
+    #: bucket (theta's branch axis squeezed at join) — the S=1 path
+    #: must never fork a second program for the same problem.
+    scenario_tree: "object | None" = None
+    #: robust-round knobs (a hashable ``ScenarioFleetOptions``); None =
+    #: the fleet defaults. Ignored without ``scenario_tree``.
+    scenario_options: "object | None" = None
 
 
 class BucketKey(NamedTuple):
@@ -73,6 +88,13 @@ class BucketKey(NamedTuple):
     solver_options: SolverOptions
     warm_solver_options: "SolverOptions | None"
     qp_fast_path: str
+    #: scenario-tree identity (ISSUE 14): a robust bucket's engine is
+    #: a ScenarioFleet compiled FOR this tree — branch count, node
+    #: groups and probabilities are all baked into the traced round,
+    #: so tenants bucket together exactly when their trees are equal.
+    #: None = flat bucket (including the normalized S=1 degenerate)
+    scenario_tree: "object | None" = None
+    scenario_options: "object | None" = None
 
     @property
     def digest(self) -> str:
@@ -109,6 +131,12 @@ def tenant_fingerprint(ocp):
 def bucket_key(spec: TenantSpec) -> BucketKey:
     """Bucket identity of one tenant spec (see module docstring)."""
     fp = tenant_fingerprint(spec.ocp)
+    tree = spec.scenario_tree
+    if tree is not None and tree.n_scenarios == 1:
+        # degenerate contract: the single-scenario tree IS the flat
+        # problem — it must land in the flat bucket, not fork a
+        # second compiled program for the same structure
+        tree = None
     return BucketKey(
         structure_digest=fp.digest,
         horizon=int(spec.ocp.N),
@@ -117,4 +145,7 @@ def bucket_key(spec: TenantSpec) -> BucketKey:
         solver_options=spec.solver_options,
         warm_solver_options=spec.warm_solver_options,
         qp_fast_path=spec.qp_fast_path,
+        scenario_tree=tree,
+        scenario_options=(spec.scenario_options if tree is not None
+                          else None),
     )

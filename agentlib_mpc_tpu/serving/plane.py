@@ -251,6 +251,7 @@ class ServingPlane:
         if spec.tenant_id in self._tenant_bucket:
             raise ValueError(f"tenant {spec.tenant_id!r} already joined")
         t0 = time.perf_counter()
+        spec = self._normalize_robust_spec(spec)
         key = bucket_key(spec)
         from agentlib_mpc_tpu.lint.jaxpr.memory import (
             MemoryBudgetExceeded,
@@ -289,6 +290,44 @@ class ServingPlane:
             "cached engine" if cached else "cold build", 1e3 * latency)
         return JoinReceipt(spec.tenant_id, key.digest, slot,
                            bucket.capacity, cached, latency)
+
+    @staticmethod
+    def _normalize_robust_spec(spec: TenantSpec) -> TenantSpec:
+        """Validate a robust tenant's spec at the door (ISSUE 14).
+
+        The degenerate single-scenario tree normalizes into a FLAT
+        tenant (theta's branch axis squeezed — the S=1 path must never
+        fork a second compiled program for the same structure); a real
+        tree requires an (S, ...)-leading theta stack and no exchange
+        couplings (``ScenarioFleet`` lifts consensus only)."""
+        import dataclasses as _dc
+
+        import jax
+        import numpy as _np
+
+        from agentlib_mpc_tpu.serving.slots import tree_row
+
+        tree = spec.scenario_tree
+        if tree is None:
+            return spec
+        if tree.n_scenarios == 1:
+            return _dc.replace(spec, theta=tree_row(spec.theta, 0),
+                               scenario_tree=None,
+                               scenario_options=None)
+        if spec.exchanges:
+            raise ValueError(
+                f"robust tenant {spec.tenant_id!r} declares exchange "
+                f"couplings {sorted(spec.exchanges)} — scenario "
+                f"buckets lift consensus couplings only")
+        lead = _np.shape(jax.tree.leaves(spec.theta)[0])[0] \
+            if jax.tree.leaves(spec.theta) else 0
+        if lead != tree.n_scenarios:
+            raise ValueError(
+                f"robust tenant {spec.tenant_id!r} carries a "
+                f"{lead}-branch theta stack for a "
+                f"{tree.n_scenarios}-scenario tree — build it with "
+                f"scenario.generate (scenario_thetas/ensemble_thetas)")
+        return spec
 
     def _capacity_shed_join(self, spec: TenantSpec, key, t0: float,
                             exc) -> JoinReceipt:
@@ -379,6 +418,7 @@ class ServingPlane:
         engine_memory_certify = self.memory_certify
         if self.hbm_bytes is not None and engine_memory_certify == "auto":
             engine_memory_certify = "require"
+        scen_tree = key.scenario_tree
 
         def make_engine(qp_fast_path: str,
                         collective_certify: str = "auto",
@@ -391,19 +431,44 @@ class ServingPlane:
                 solver_options=key.solver_options,
                 warm_solver_options=key.warm_solver_options,
                 qp_fast_path=qp_fast_path)
+            resolved_memory = (engine_memory_certify
+                               if memory_certify is None
+                               else memory_certify)
+            if scen_tree is not None:
+                # robust bucket (ISSUE 14): one ScenarioFleet per
+                # (structure, tree) — each lane solves the tenant's S
+                # disturbance branches inside the fused robust round
+                from agentlib_mpc_tpu.scenario.fleet import (
+                    ScenarioFleet,
+                    ScenarioFleetOptions,
+                )
+
+                return ScenarioFleet(
+                    group, scen_tree,
+                    (key.scenario_options
+                     if key.scenario_options is not None
+                     else ScenarioFleetOptions()),
+                    active=jnp.zeros((capacity,), bool),
+                    mesh=self.mesh,
+                    collective_certify=collective_certify,
+                    memory_certify=resolved_memory)
             return FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
                 donate_state=self.donate, mesh=self.mesh,
                 collective_certify=collective_certify,
-                memory_certify=(engine_memory_certify
-                                if memory_certify is None
-                                else memory_certify))
+                memory_certify=resolved_memory)
 
         def warm_args(engine):
             # throwaway template inputs, mesh-placed for sharded
             # engines so the warmed executable is the serving one
             theta_b = tree_repeat(spec.theta, capacity)
+            if scen_tree is not None:
+                state = engine.init_state(theta_b)
+                if self.mesh is not None:
+                    state, theta_b = engine.shard_args(
+                        self.mesh, state, theta_b)
+                return state, theta_b, jnp.zeros((capacity,), bool)
             state = engine.init_state([theta_b])
             if self.mesh is not None:
                 state, (theta_b,) = engine.shard_args(
@@ -412,14 +477,24 @@ class ServingPlane:
 
         def build():
             engine = make_engine(key.qp_fast_path)
-            if self.warm_on_build or self.engine_store is not None:
+            if self.warm_on_build or (self.engine_store is not None
+                                      and scen_tree is None):
                 # pay trace+compile NOW so the cold/cached join-latency
                 # split is honest and the first served round is warm.
                 # Throwaway state: with donation its buffers are
                 # consumed by this very step — nothing else holds them.
                 state, thetas, masks = warm_args(engine)
                 engine.step(state, thetas, active=masks)
-            if self.engine_store is not None:
+            if self.engine_store is not None and scen_tree is not None:
+                # the StableHLO export path is FusedADMM-shaped; robust
+                # buckets rebuild warm through the in-process cache and
+                # the persistent XLA cache instead (an accelerator, not
+                # a dependency — same contract as a failed export)
+                logger.info(
+                    "bucket %s is a scenario bucket — engine-store "
+                    "export skipped (persistent XLA cache still "
+                    "covers crash-restart compiles)", key.digest)
+            if self.engine_store is not None and scen_tree is None:
                 # persist the compiled step for cross-process revival;
                 # export failure must never fail a join (the store is
                 # an accelerator, not a dependency)
@@ -505,7 +580,7 @@ class ServingPlane:
 
         store_digest = None
         restorer = None
-        if self.engine_store is not None:
+        if self.engine_store is not None and scen_tree is None:
             from agentlib_mpc_tpu.serving.store import EngineStore
 
             store_digest = EngineStore.digest(engine_key)
@@ -530,7 +605,12 @@ class ServingPlane:
                     f"certifies {cert.peak_bytes} B peak per device "
                     f"against the {self.hbm_bytes} B budget "
                     f"({cert.describe()})")
-        bucket = SlotPlane(engine, spec.ocp, spec.theta)
+        if scen_tree is not None:
+            from agentlib_mpc_tpu.serving.slots import ScenarioSlotPlane
+
+            bucket = ScenarioSlotPlane(engine, spec.ocp, spec.theta)
+        else:
+            bucket = SlotPlane(engine, spec.ocp, spec.theta)
         if migrate_from is not None:
             self._stash_flush(key)       # deliver the old plane's round
             for tenant_id in migrate_from.tenants:

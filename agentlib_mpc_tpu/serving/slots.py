@@ -64,9 +64,75 @@ class RoundHandle(NamedTuple):
     #: (tenant_id, slot) snapshot at launch — results are decoded
     #: against THIS membership, not the one at materialize time
     served: tuple
+    #: robust rounds only (ISSUE 14): the non-anticipativity
+    #: projection's actuated controls, (capacity, S, n_u) on device —
+    #: group-identical across a node group's branches by construction
+    u0: object = None
 
 
-class SlotPlane:
+class _SlotBookkeeping:
+    """The occupancy surface BOTH slot planes share (ISSUE 14 review:
+    one definition — a slot-semantics fix must never apply to flat
+    buckets but miss robust ones, or vice versa). Subclasses own
+    ``capacity``, ``slots``, ``_slot_of`` and ``mask``."""
+
+    @property
+    def n_active(self) -> int:
+        return int(self.mask.sum())
+
+    @property
+    def free_slots(self) -> int:
+        return self.capacity - self.n_active
+
+    def slot_of(self, tenant_id: str) -> "int | None":
+        return self._slot_of.get(tenant_id)
+
+    @property
+    def tenants(self) -> tuple:
+        return tuple(t for t in self.slots if t is not None)
+
+    def _alloc_slot(self, tenant_id: str) -> int:
+        """Find a free slot for a new tenant (duplicate ids and full
+        planes raise — the plane grows capacity on full)."""
+        if tenant_id in self._slot_of:
+            raise ValueError(f"tenant {tenant_id!r} already admitted")
+        try:
+            return self.slots.index(None)
+        except ValueError:
+            raise ValueError(
+                f"no free slot (capacity {self.capacity})") from None
+
+    def _bind_slot(self, slot: int, tenant_id: str) -> None:
+        self.slots[slot] = tenant_id
+        self._slot_of[tenant_id] = slot
+        self.mask[slot] = True
+
+    def evict(self, tenant_id: str) -> int:
+        """Free a tenant's slot (mask off; the lane becomes padding,
+        keeping its last parameters — shapes never change)."""
+        slot = self._slot_of.pop(tenant_id)
+        self.slots[slot] = None
+        self.mask[slot] = False
+        return slot
+
+    def restore_occupancy(self, slots: "list[str | None]") -> None:
+        """Overwrite the occupancy bookkeeping wholesale — the
+        checkpoint-restore seam. A restored plane must reproduce the
+        SAVED slot layout (gaps included) because the per-lane state
+        arrays restored next to it are indexed by those exact slots;
+        sequential :meth:`admit` calls would compact the gaps away."""
+        if len(slots) != self.capacity:
+            raise ValueError(
+                f"occupancy snapshot has {len(slots)} slots for a "
+                f"capacity-{self.capacity} plane")
+        self.slots = list(slots)
+        self._slot_of = {t: s for s, t in enumerate(slots)
+                         if t is not None}
+        self.mask = np.asarray([t is not None for t in slots],
+                               dtype=bool)
+
+
+class SlotPlane(_SlotBookkeeping):
     """Slot bookkeeping + lane splicing for one bucket's fused engine.
 
     ``engine`` must be a single-group :class:`FusedADMM` (the serving
@@ -147,67 +213,19 @@ class SlotPlane:
                 engine.mesh, state, [self.theta_batch])
         self.state = state
 
-    # -- occupancy ------------------------------------------------------------
-
-    @property
-    def n_active(self) -> int:
-        return int(self.mask.sum())
-
-    @property
-    def free_slots(self) -> int:
-        return self.capacity - self.n_active
-
-    def slot_of(self, tenant_id: str) -> "int | None":
-        return self._slot_of.get(tenant_id)
-
-    @property
-    def tenants(self) -> tuple:
-        return tuple(t for t in self.slots if t is not None)
-
-    # -- membership -----------------------------------------------------------
+    # -- membership (occupancy surface shared via _SlotBookkeeping) -----------
 
     def admit(self, tenant_id: str, theta_row) -> int:
         """Place a tenant into a free slot; returns the slot index.
         Raises ``ValueError`` when full (the plane grows capacity) or on
         a duplicate id."""
-        if tenant_id in self._slot_of:
-            raise ValueError(f"tenant {tenant_id!r} already admitted")
-        try:
-            slot = self.slots.index(None)
-        except ValueError:
-            raise ValueError(
-                f"no free slot (capacity {self.capacity})") from None
+        slot = self._alloc_slot(tenant_id)
         lane = jnp.asarray(slot, jnp.int32)
         self.theta_batch = self._splice_theta(self.theta_batch, lane,
                                               theta_row)
         self.state = self._reset_lane(self.state, lane, theta_row)
-        self.slots[slot] = tenant_id
-        self._slot_of[tenant_id] = slot
-        self.mask[slot] = True
+        self._bind_slot(slot, tenant_id)
         return slot
-
-    def evict(self, tenant_id: str) -> int:
-        """Free a tenant's slot (mask off; the lane becomes padding,
-        keeping its last parameters — shapes never change)."""
-        slot = self._slot_of.pop(tenant_id)
-        self.slots[slot] = None
-        self.mask[slot] = False
-        return slot
-
-    def restore_occupancy(self, slots: "list[str | None]") -> None:
-        """Overwrite the occupancy bookkeeping wholesale — the
-        checkpoint-restore seam. A restored plane must reproduce the
-        SAVED slot layout (gaps included) because the per-lane state
-        arrays restored next to it are indexed by those exact slots;
-        sequential :meth:`admit` calls would compact the gaps away."""
-        if len(slots) != self.capacity:
-            raise ValueError(
-                f"occupancy snapshot has {len(slots)} slots for a "
-                f"capacity-{self.capacity} plane")
-        self.slots = list(slots)
-        self._slot_of = {t: s for s, t in enumerate(slots)
-                         if t is not None}
-        self.mask = np.asarray([t is not None for t in slots], dtype=bool)
 
     def update_theta(self, tenant_id: str, theta_row) -> None:
         """Splice a tenant's fresh parameters (its per-request state /
@@ -270,6 +288,161 @@ class SlotPlane:
                     "iterations": iterations,
                     "quarantined_iters": (int(lane_q[slot])
                                           if lane_q is not None else 0),
+                },
+            }
+        return out
+
+
+class ScenarioSlotPlane(_SlotBookkeeping):
+    """Padded tenant slots over one :class:`~agentlib_mpc_tpu.scenario.
+    fleet.ScenarioFleet` engine — the scenario-lifted sibling of
+    :class:`SlotPlane` (ISSUE 14: "scenario buckets get slots/health/
+    checkpoint").
+
+    Same contract, one axis wider: a lane is one ROBUST tenant whose
+    per-round data is an (S, ...)-leading per-branch parameter stack
+    (``scenario.generate`` builds it), solved as S disturbance branches
+    inside the fused robust round. Join/leave/update are the same
+    traced lane splices and mask flips — membership is data, never
+    structure, so churn on a scenario bucket is zero-retrace exactly
+    like the flat plane (the ``[scenario.survive]`` budget's serving
+    sibling is pinned by the ``[serving]`` gate family).
+
+    Decoded results: ``u0`` is the non-anticipativity projection's
+    first-interval command for branch 0 (the nominal-branch convention
+    of ``ensemble_thetas`` — for a fan tree every branch of the root
+    group carries the identical row by construction); ``traj`` carries
+    all S branch trajectories; ``stats.quarantined_iters`` is the
+    worst branch's per-lane quarantine attribution (one persistently
+    sick branch marks the tenant sick — the health ledger's third
+    sickness signal on robust tenants) with the full per-branch
+    breakdown in ``stats.branch_quarantined``."""
+
+    def __init__(self, engine, ocp, theta0, shift_between_rounds=True):
+        self.engine = engine
+        self.ocp = ocp
+        self.capacity = engine.group.n_agents
+        self.n_scenarios = engine.S
+        self.shift_between_rounds = bool(shift_between_rounds)
+        self.slots: list = [None] * self.capacity
+        self._slot_of: dict = {}
+        self.mask = np.zeros((self.capacity,), dtype=bool)
+        self.theta_batch = tree_repeat(theta0, self.capacity)
+        self.rounds_served = 0
+
+        helpers = engine.__dict__.get("_serving_helpers")
+        if helpers is None:
+            ocp_ = ocp
+
+            def reset_lane(state, lane, theta_row):
+                """Fresh warm start for a newly-admitted robust
+                tenant's lane: per-branch OCP initial guesses, zeroed
+                multipliers on BOTH coupling families — a recycled slot
+                must not leak the previous tenant's iterates on any
+                branch."""
+                w = state.w.at[lane].set(
+                    jax.vmap(ocp_.initial_guess)(theta_row))
+                y = state.y.at[lane].set(0.0)
+                z = state.z.at[lane].set(0.1)
+                nu = state.nu.at[lane].set(0.0)
+                na = state.na_target.at[lane].set(0.0)
+                lam = {a: leaf.at[lane].set(0.0)
+                       for a, leaf in state.lam.items()}
+                return state._replace(w=w, y=y, z=z, nu=nu,
+                                      na_target=na, lam=lam)
+
+            helpers = {
+                "splice_theta": jax.jit(
+                    lambda batch, lane, row: jax.tree.map(
+                        lambda b, r: b.at[lane].set(r), batch, row)),
+                "reset_lane": jax.jit(reset_lane),
+                "state_template": engine.init_state(self.theta_batch),
+            }
+            engine.__dict__["_serving_helpers"] = helpers
+        self._splice_theta = helpers["splice_theta"]
+        self._reset_lane = helpers["reset_lane"]
+        state = jax.tree.map(jnp.copy, helpers["state_template"])
+        if getattr(engine, "mesh", None) is not None:
+            state, self.theta_batch = engine.shard_args(
+                engine.mesh, state, self.theta_batch)
+        self.state = state
+
+    # -- membership (occupancy surface shared via _SlotBookkeeping) -----------
+
+    def _check_branch_stack(self, tenant_id: str, theta_row) -> None:
+        s_lead = int(jnp.asarray(
+            jax.tree.leaves(theta_row)[0]).shape[0])
+        if s_lead != self.n_scenarios:
+            raise ValueError(
+                f"robust tenant {tenant_id!r} submitted a "
+                f"{s_lead}-branch theta stack for a "
+                f"{self.n_scenarios}-scenario bucket — build it with "
+                f"scenario.generate for the bucket's tree")
+
+    def admit(self, tenant_id: str, theta_row) -> int:
+        self._check_branch_stack(tenant_id, theta_row)
+        slot = self._alloc_slot(tenant_id)
+        lane = jnp.asarray(slot, jnp.int32)
+        self.theta_batch = self._splice_theta(self.theta_batch, lane,
+                                              theta_row)
+        self.state = self._reset_lane(self.state, lane, theta_row)
+        self._bind_slot(slot, tenant_id)
+        return slot
+
+    def update_theta(self, tenant_id: str, theta_row) -> None:
+        slot = self._slot_of[tenant_id]
+        self._check_branch_stack(tenant_id, theta_row)
+        self.theta_batch = self._splice_theta(
+            self.theta_batch, jnp.asarray(slot, jnp.int32), theta_row)
+
+    # -- serving --------------------------------------------------------------
+
+    def launch_round(self) -> RoundHandle:
+        served = tuple((t, s) for s, t in enumerate(self.slots)
+                       if t is not None)
+        state, trajs, stats = self.engine.step(
+            self.state, self.theta_batch,
+            active=jnp.asarray(self.mask))
+        u0 = self.engine.actuated_u0(state)
+        self.state = self.engine.shift_state(state) \
+            if self.shift_between_rounds else state
+        self.rounds_served += 1
+        return RoundHandle(trajs=trajs, stats=stats, served=served,
+                           u0=u0)
+
+    def materialize(self, handle: RoundHandle) -> dict:
+        u = np.asarray(handle.trajs["u"])     # (capacity, S, N, n_u)
+        u0 = np.asarray(handle.u0)            # (capacity, S, n_u)
+        stats = handle.stats
+        converged = bool(stats.converged)
+        iterations = int(stats.iterations)
+        na_spread = float(stats.na_spread)
+        lane_q = None
+        if stats.lane_quarantined is not None:
+            lane_q = np.asarray(stats.lane_quarantined)  # (cap, S)
+        names = list(self.ocp.control_names)
+        out = {}
+        for tenant_id, slot in handle.served:
+            u_lane = u[slot]                  # (S, N, n_u)
+            u0_row = u0[slot, 0]              # nominal-branch command
+            branch_q = (lane_q[slot].tolist() if lane_q is not None
+                        else [0] * self.n_scenarios)
+            out[tenant_id] = {
+                "u0": {nm: float(u0_row[k])
+                       for k, nm in enumerate(names)},
+                "traj": {"u": u_lane},
+                "stats": {
+                    "success": bool(np.isfinite(u_lane).all()
+                                    and np.isfinite(u0_row).all()),
+                    "round_converged": converged,
+                    "iterations": iterations,
+                    "na_spread": na_spread,
+                    # worst branch: ONE persistently-quarantined
+                    # branch marks the robust tenant sick (the health
+                    # ladder's is_sick_result consumes this), with the
+                    # per-branch attribution alongside
+                    "quarantined_iters": int(max(branch_q)),
+                    "branch_quarantined": branch_q,
                 },
             }
         return out

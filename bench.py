@@ -108,6 +108,19 @@ Modes:
                                 # loss"); degraded rounds publish
                                 # _d<k>_degraded keys, never the
                                 # full-mesh headline
+    python bench.py --chaos-scenario SEED [S] [n]  # 2-D robust-fleet
+                                # survivability (ISSUE 14): n trackers
+                                # x S disturbance branches under a
+                                # ScenarioFleetSupervisor on the 4x2
+                                # virtual grid, seeded branch NaN
+                                # storm, stall, and device loss +
+                                # revival on EACH axis — availability,
+                                # per-axis shard-loss MTTR, degraded
+                                # rounds; degraded rounds publish
+                                # _d<A>x<S>_degraded at their reduced
+                                # shape, never the full-grid key
+                                # (docs/robustness.md "Surviving loss
+                                # on either axis")
 
 Headline JSON:
     {"metric": "admm256_step_ms", "value": <ms>, "unit": "ms",
@@ -1862,6 +1875,218 @@ def run_chaos_mesh(seed: int = 0, n_agents: int = 8,
     return out
 
 
+def run_chaos_scenario(seed: int = 0, n_scenarios: int = 4,
+                       n_agents: int = 4,
+                       rounds: "int | None" = None) -> dict:
+    """``--chaos-scenario SEED [S] [n]``: survivability benchmark of
+    the 2-D (agents × scenarios) robust fleet (ISSUE 14 — the
+    ``--chaos-mesh`` discipline on both axes). An ``n``-agent tracker
+    consensus fleet solving ``S`` disturbance branches per agent runs
+    under a :class:`ScenarioFleetSupervisor` on the 4×2
+    8-virtual-device grid while the seeded schedule injects,
+    deterministically:
+
+    1. a **scenario-shard NaN storm** (one column's branch data
+       poisoned for a window — the branch quarantine/solver guards
+       must contain it);
+    2. a **collective stall** (transient: every shard answers the
+       probe, the round retries on the same grid);
+    3. a **scenarios-axis device loss with revival** — the fleet drops
+       the dead column's branches, RE-NORMALIZES the surviving node-
+       group probabilities, and serves every agent at reduced
+       robustness breadth until re-admission;
+    4. an **agents-axis device loss with revival** — the dead row's
+       lanes mask out and the survivors re-pad (the supervisor's
+       classification policy is scripted to the agents axis for this
+       phase, so both axes' ladders land in one run).
+
+    Reported: agent-actuation availability % (finite actuated u0 ÷
+    expected, dead lanes unavailable — scenario-degraded rounds keep
+    EVERY agent available, which is the point of preferring that
+    axis), branch availability %, per-AXIS shard-loss MTTR, degraded-
+    round counts, and per-round step cost under the ``_d<A>x<S>``
+    qualifier rule: a degraded round publishes its reduced shape with
+    ``_degraded`` (e.g. ``_d4x1_degraded``), NEVER the full-mesh key,
+    and the rebuild-bearing round is the MTTR row, never a step
+    sample."""
+    import random as _random
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+    from agentlib_mpc_tpu.parallel.multihost import scenario_mesh
+    from agentlib_mpc_tpu.parallel.survival import (
+        ScenarioFleetSupervisor,
+    )
+    from agentlib_mpc_tpu.resilience.chaos import (
+        MeshChaosConfig,
+        MeshDeviceLossRule,
+        MeshNaNStormRule,
+        MeshStallRule,
+        install_mesh_chaos,
+    )
+    from agentlib_mpc_tpu.scenario import ScenarioFleetOptions, fan_tree
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        out = {
+            "metric": f"chaos_scenario_availability_pct_{platform}_d1",
+            "value": None, "unit": "%", "platform": platform,
+            "error": (f"chaos-scenario needs an even device count "
+                      f">= 4 for the 2-column scenario grid, got "
+                      f"{n_dev}; run in a fresh process (the "
+                      f"8-virtual-device request must precede backend "
+                      f"init) or on a multi-chip mesh"),
+        }
+        print(json.dumps(out))
+        return out
+    rng = _random.Random(f"bench-chaos-scenario:{seed}")
+
+    S = max(2, n_scenarios + (n_scenarios % 2))   # 2 columns divide S
+    mesh = scenario_mesh(2)
+    a_sh, s_sh = (int(v) for v in mesh.devices.shape)
+    ocp = tracker_ocp()
+    group = AgentGroup(name="chaos-scenario", ocp=ocp,
+                       n_agents=n_agents,
+                       couplings={"shared_u": "u"},
+                       solver_options=SolverOptions(max_iter=30))
+    tree = fan_tree(S, robust_horizon=1)
+    thetas = jax.tree.map(lambda *xs: jnp.stack(xs), *[
+        jax.tree.map(lambda *ys: jnp.stack(ys), *[
+            ocp.default_params(p=jnp.array([float(i + 1) + 0.3 * s]))
+            for s in range(S)])
+        for i in range(n_agents)])
+    sup = ScenarioFleetSupervisor(
+        group, tree, ScenarioFleetOptions(max_iterations=8, rho=2.0,
+                                          rho_na=2.0),
+        mesh=mesh, watchdog_timeout_s=10.0,
+        readmit_after=1, probation_rounds=1)
+
+    storm_round = rng.randrange(1, 3)
+    stall_round = storm_round + 1
+    die_scen = stall_round + rng.randrange(1, 3)
+    revive_scen = die_scen + rng.randrange(2, 4)
+    # the agents-axis phase starts after the scenario phase has fully
+    # re-admitted (readmit_after=1 + probation 1)
+    die_agents = revive_scen + 3
+    revive_agents = die_agents + rng.randrange(2, 4)
+    if rounds is None:
+        rounds = revive_agents + 3
+    scen_col = rng.randrange(0, s_sh)
+    agents_row = rng.randrange(1, a_sh)
+    # a scenarios-axis kill degrades scenarios only while MORE than
+    # one branch would survive — spd 1 grids (the S=2 smoke) fall back
+    # to the agents axis, honestly reported in the schedule below
+    chaos = install_mesh_chaos(sup, MeshChaosConfig(
+        nan_storm=(MeshNaNStormRule(device_index=scen_col,
+                                    axis="scenarios",
+                                    start_round=storm_round,
+                                    n_rounds=1),),
+        stall=(MeshStallRule(round=stall_round, duration_s=30.0,
+                             axis="scenarios"),),
+        device_loss=(
+            MeshDeviceLossRule(device_index=scen_col,
+                               axis="scenarios", cross_index=0,
+                               die_at_round=die_scen,
+                               revive_at_round=revive_scen),
+            MeshDeviceLossRule(device_index=agents_row,
+                               axis="agents", cross_index=0,
+                               die_at_round=die_agents,
+                               revive_at_round=revive_agents),
+        ),
+    ), seed=seed)
+
+    expected = available = 0
+    branch_expected = branch_available = 0
+    full_times: list = []
+    degraded_times: dict = {}          # mesh shape -> [dt]
+    was_degraded = False
+    state = sup.init_state(thetas)
+    for r in range(rounds):
+        if r == revive_scen + 1:
+            # phase 2 is the AGENTS-axis drill: script the
+            # classification so the second kill exercises the row
+            # ladder (the auto policy would keep trading robustness
+            # breadth instead — a deliberate choice, overridden here
+            # to land both axes' evidence in one run)
+            sup.degrade_axis = "agents"
+        t0 = time.perf_counter()
+        state, trajs, _stats = sup.step(state, thetas)
+        dt = time.perf_counter() - t0
+        just_degraded = sup.degraded and not was_degraded
+        was_degraded = sup.degraded
+        u0 = np.asarray(sup.actuated_u0(state))   # (n, S, n_u)
+        alive_lane = ~np.asarray(sup.dead_lanes)
+        expected += n_agents
+        available += int((np.isfinite(u0).all(axis=(1, 2))
+                          & alive_lane).sum())
+        branch_expected += S
+        branch_available += S - len(sup.dead_branches)
+        # honesty: the rebuild-bearing round is the MTTR row, never a
+        # step sample; degraded rounds land under their REDUCED shape
+        if just_degraded:
+            continue
+        if sup.degraded:
+            degraded_times.setdefault(sup.mesh_shape, []).append(dt)
+        else:
+            full_times.append(dt)
+    chaos.uninstall()
+
+    def q(base: str, shape: tuple, degraded: bool = False) -> str:
+        return _qualified_metric(base, platform, degraded=degraded,
+                                 mesh_shape=shape)
+
+    stats = sup.stats()
+    out = {
+        "metric": q("chaos_scenario_availability_pct", (a_sh, s_sh)),
+        "value": round(100.0 * available / max(expected, 1), 2),
+        "unit": "%",
+        "branch_availability_pct": round(
+            100.0 * branch_available / max(branch_expected, 1), 2),
+        "seed": seed,
+        "n_agents": n_agents,
+        "n_scenarios": S,
+        "rounds": rounds,
+        "mesh_shape": [a_sh, s_sh],
+        "schedule": {"storm_round": storm_round,
+                     "stall_round": stall_round,
+                     "die_scenarios": die_scen,
+                     "revive_scenarios": revive_scen,
+                     "die_agents": die_agents,
+                     "revive_agents": revive_agents,
+                     "victim_scenario_col": scen_col,
+                     "victim_agents_row": agents_row},
+        "degraded_rounds": stats["degraded_rounds"],
+        "layouts_built": stats["layouts_built"],
+        "shard_loss_mttr_ms_by_axis": {
+            axis: (None if v is None else round(1e3 * v, 2))
+            for axis, v in stats["mttr_by_axis"].items()},
+        q("chaos_scenario_step_ms", (a_sh, s_sh)): (
+            round(1e3 * float(np.median(full_times)), 2)
+            if full_times else None),
+        "chaos_events": {k: chaos.count(k) for k in (
+            "mesh_nan_theta", "mesh_stall", "mesh_device_hang",
+            "mesh_probe_dead")},
+        "platform": platform,
+    }
+    for shape, times in sorted(degraded_times.items()):
+        out[q("chaos_scenario_step_ms", shape, degraded=True)] = \
+            round(1e3 * float(np.median(times)), 2)
+    print(json.dumps(out))
+    return out
+
+
 def run_profile(trace_dir: str = "bench_trace",
                 n_agents: int = N_AGENTS) -> None:
     """Capture an XLA profiler trace of the warm ``n_agents``-zone step
@@ -2582,19 +2807,26 @@ def _measure_failsoft(mode_args: list, cpu_mode_args: "list | None" = None,
 
 
 def _qualified_metric(base: str, platform: str, n_devices: int = 1,
-                      degraded: bool = False) -> str:
+                      degraded: bool = False,
+                      mesh_shape: "tuple | None" = None) -> str:
     """The ONE metric-qualification rule (used by the headline and by
-    ``--chaos-mesh``): unqualified names are reserved for TPU; any
-    other platform gets a ``_<platform>`` suffix (ROADMAP item 2 —
-    BENCH_r04/r05 read as a 3.6× regression when they were a platform
-    change); a measurement that spanned a device mesh gains ``_d<n>``
-    (ISSUE 9 — mesh and single-device numbers are different
-    experiments); a round served on a DEGRADED mesh (shard loss
-    absorbed by the FleetSupervisor) gains ``_degraded`` (ISSUE 10 —
-    a 7-device fallback round must never read as the 8-device steady
-    state's regression, or its improvement)."""
+    ``--chaos-mesh``/``--chaos-scenario``): unqualified names are
+    reserved for TPU; any other platform gets a ``_<platform>`` suffix
+    (ROADMAP item 2 — BENCH_r04/r05 read as a 3.6× regression when
+    they were a platform change); a measurement that spanned a device
+    mesh gains ``_d<n>`` (ISSUE 9 — mesh and single-device numbers are
+    different experiments) — or, for a 2-D (agents × scenarios) grid,
+    the FULL shape ``_d<A>x<S>`` (ISSUE 14: a 4x2 grid and an
+    8-device line are different experiments too); a round served on a
+    DEGRADED mesh (shard loss absorbed by a supervisor) gains
+    ``_degraded`` (ISSUE 10/14 — a fallback round must never read as
+    the full-mesh steady state's regression, or its improvement; a
+    degraded 2-D round publishes ``_d<A>x<S>_degraded`` at its reduced
+    shape, never the full-mesh key)."""
     name = base if platform == "tpu" else f"{base}_{platform}"
-    if n_devices > 1:
+    if mesh_shape is not None:
+        name = f"{name}_d{'x'.join(str(int(s)) for s in mesh_shape)}"
+    elif n_devices > 1:
         name = f"{name}_d{n_devices}"
     return f"{name}_degraded" if degraded else name
 
@@ -2655,6 +2887,27 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_scenario_ab(S, n)
+        return
+
+    if "--chaos-scenario" in sys.argv:
+        # 2-D (agents x scenarios) survivability benchmark (ISSUE 14),
+        # in-process like --chaos-mesh; the 8-virtual-device grid must
+        # be requested BEFORE backend init (no-op on real multi-chip):
+        #   python bench.py --chaos-scenario SEED [n_scenarios] [n_agents]
+        from agentlib_mpc_tpu.utils.jax_setup import (
+            request_virtual_devices,
+        )
+
+        request_virtual_devices(8)
+        idx = sys.argv.index("--chaos-scenario")
+        seed, S, n = 0, 4, 4
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            S = int(sys.argv[idx + 2])
+        if len(sys.argv) > idx + 3 and not sys.argv[idx + 3].startswith("-"):
+            n = int(sys.argv[idx + 3])
+        run_chaos_scenario(seed, S, n)
         return
 
     if "--chaos-mesh" in sys.argv:

@@ -243,6 +243,99 @@ class TestPadScenarios:
         assert same_tree is padded_tree and same is padded
 
 
+class TestBranchQuarantine:
+    """ISSUE 14 satellite: per-(agent, scenario) quarantine
+    attribution. The substitution keeps a diverged branch's decoded
+    trajectory finite, so ``lane_quarantined`` is the only signal the
+    serving health ledger gets on a persistently sick branch."""
+
+    def test_poisoned_branch_is_quarantined_and_attributed(
+            self, coupled_fleet, ocp):
+        thetas = _thetas(ocp)
+        st = coupled_fleet.init_state(thetas)
+        # poison ONE branch's primal iterate: the warm start a crashed
+        # process / corrupted splice would hand the round
+        st = st._replace(w=st.w.at[1, 2].set(jnp.nan))
+        st, trajs, stats = coupled_fleet.step(st, thetas)
+        q = np.asarray(stats.lane_quarantined).copy()
+        assert q.shape == (N_AGENTS, N_SCEN)
+        assert q[1, 2] >= 1
+        # attribution is per branch: nobody else was quarantined
+        q[1, 2] = 0
+        assert (q == 0).all()
+        # ... and the substitution contained it: everything decoded
+        # finite, including the poisoned lane
+        assert np.isfinite(np.asarray(trajs["u"])).all()
+        assert np.isfinite(np.asarray(st.w)).all()
+
+    def test_quarantine_counter_recorded(self, coupled_fleet, ocp):
+        from agentlib_mpc_tpu import telemetry
+
+        was = telemetry.enabled()
+        telemetry.configure(enabled=True)
+        try:
+            thetas = _thetas(ocp)
+            st = coupled_fleet.init_state(thetas)
+            st = st._replace(w=st.w.at[0, 1].set(jnp.nan))
+            coupled_fleet.step(st, thetas)
+            count = telemetry.metrics().get(
+                "scenario_quarantined_iters", group="scenario-test")
+            assert count and count >= 1
+        finally:
+            telemetry.configure(enabled=was)
+
+
+class TestDegenerateSupervisor:
+    """ISSUE 14 satellite: the degenerate-contract EXTENSION — an S=1
+    ScenarioFleetSupervisor run (degrade → serve → readmit) is BITWISE
+    identical to the flat FleetSupervisor on the same group, because
+    the S=1 supervisor routes UNWRAPPED through the flat machinery
+    (state types, mesh and engines included)."""
+
+    def test_s1_supervisor_is_flat_supervisor_bitwise(
+            self, group, ocp, eight_devices):
+        from agentlib_mpc_tpu.parallel.fused_admm import stack_params
+        from agentlib_mpc_tpu.parallel.survival import (
+            FleetSupervisor,
+            ScenarioFleetSupervisor,
+        )
+
+        sup = ScenarioFleetSupervisor(
+            group, single_scenario(), OPTS, mesh=fleet_mesh(),
+            watchdog_timeout_s=60.0, readmit_after=1,
+            probation_rounds=1)
+        assert sup._flat is not None
+        ref = FleetSupervisor(
+            [group], sup.flat_options, mesh=fleet_mesh(),
+            watchdog_timeout_s=60.0, readmit_after=1,
+            probation_rounds=1)
+        thetas = [stack_params([
+            ocp.default_params(p=jnp.array([float(i + 1)]))
+            for i in range(N_AGENTS)])]
+        ss, rs = sup.init_state(thetas), ref.init_state(thetas)
+        dead = sup._flat.full_mesh.devices.flat[-1].id
+        ss, _t, _s = sup.step(ss, thetas)
+        rs, _t, _s = ref.step(rs, thetas)
+        sup.force_degrade([dead])
+        ref.force_degrade([dead])
+        assert sup.stats()["degraded"] and sup.scenarios_active == 1
+        ss, _t, _s = sup.step(ss, thetas)
+        rs, _t, _s = ref.step(rs, thetas)
+        sup.force_readmit()
+        ref.force_readmit()
+        ss, _t, _s = sup.step(ss, thetas)
+        rs, _t, _s = ref.step(rs, thetas)
+        # BITWISE: the degenerate supervisor IS the flat one
+        np.testing.assert_array_equal(
+            np.asarray(ss.zbar["shared_u"]),
+            np.asarray(rs.zbar["shared_u"]))
+        np.testing.assert_array_equal(np.asarray(ss.w[0]),
+                                      np.asarray(rs.w[0]))
+        for a in ss.lam:
+            np.testing.assert_array_equal(np.asarray(ss.lam[a][0]),
+                                          np.asarray(rs.lam[a][0]))
+
+
 class TestTelemetry:
     def test_scenario_metrics_recorded(self, coupled_fleet, ocp):
         from agentlib_mpc_tpu import telemetry

@@ -1,0 +1,488 @@
+"""Survivability on the 2-D (agents × scenarios) mesh (ISSUE 14).
+
+Pins the :class:`ScenarioFleetSupervisor` ladder on the 8-virtual-
+device 4×2 grid: axis-classified degrade (scenarios-axis loss drops
+the dead column's branches and RE-NORMALIZES the surviving node-group
+probabilities; agents-axis loss rides the pad path with dead lanes
+masked), the conserved-multiplier re-centering on both families,
+hysteretic re-admission restoring the full grid BITWISE, and the
+repeat degrade/readmit cycle at zero retraces. The scenario-lifted
+serving buckets (slots/health/checkpoint + the full-shape topology
+stamp) ride along in their own class.
+
+Engine builds dominate the cost (full 4×2 + the 4×1 and 3×2 degraded
+layouts), so the supervisor and its theta batch are ONE module
+fixture driven through both axes' acceptance rows in order.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentlib_mpc_tpu.lint.retrace_budget import (
+    load_budgets,
+    tracker_ocp,
+)
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+from agentlib_mpc_tpu.parallel.multihost import scenario_mesh
+from agentlib_mpc_tpu.parallel.survival import ScenarioFleetSupervisor
+from agentlib_mpc_tpu.scenario import (
+    ScenarioFleet,
+    ScenarioFleetOptions,
+    fan_tree,
+)
+
+N_AGENTS = 4
+N_SCEN = 4
+#: non-uniform branch probabilities: renormalization after a branch
+#: loss is OBSERVABLE (uniform weights renormalize to uniform weights)
+PROBS = (0.4, 0.3, 0.2, 0.1)
+#: tight tolerances + a real iteration budget: the degraded fleet must
+#: genuinely re-converge so the no-stale-bias comparison means something
+OPTS = ScenarioFleetOptions(max_iterations=25, rho=2.0, rho_na=4.0,
+                            abs_tol=1e-6, rel_tol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+@pytest.fixture(scope="module")
+def group(ocp):
+    return AgentGroup(name="surv2d", ocp=ocp, n_agents=N_AGENTS,
+                      couplings={"shared_u": "u"},
+                      solver_options=SolverOptions(max_iter=30))
+
+
+@pytest.fixture(scope="module")
+def tree():
+    return fan_tree(N_SCEN, robust_horizon=1, probabilities=PROBS)
+
+
+def _thetas(ocp, n_agents=N_AGENTS, n_scen=N_SCEN, spread=0.5):
+    rows = []
+    for i in range(n_agents):
+        rows.append(jax.tree.map(lambda *xs: jnp.stack(xs), *[
+            ocp.default_params(p=jnp.array([float(i + 1) + spread * s]))
+            for s in range(n_scen)]))
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+
+@pytest.fixture(scope="module")
+def rig(group, tree, ocp, eight_devices):
+    mesh = scenario_mesh(2, devices=eight_devices)
+    sup = ScenarioFleetSupervisor(group, tree, OPTS, mesh=mesh,
+                                  watchdog_timeout_s=60.0,
+                                  readmit_after=1, probation_rounds=1)
+    return sup, _thetas(ocp)
+
+
+class TestScenarioAxisAcceptance:
+    def test_kill_scenario_column_mid_run(self, rig, group, tree, ocp):
+        """The ISSUE 14 acceptance row, scenarios axis: kill one
+        scenarios-axis device mid-run on the 8-virtual-device 4×2
+        grid. Survivors stay finite, the degraded round completes with
+        RENORMALIZED node-group probabilities (actuated u0 still
+        group-identical, no stale-probability bias vs an independent
+        never-interrupted reference fleet built at the reduced
+        scenario count), revival re-admits, and post-recovery
+        consensus is BITWISE vs an uninterrupted 2-D engine."""
+        from agentlib_mpc_tpu.resilience.chaos import (
+            MeshChaosConfig,
+            MeshDeviceLossRule,
+            install_mesh_chaos,
+        )
+
+        sup, thetas = rig
+        # column 1 hosts base branches 2 and 3 (spd = 2)
+        chaos = install_mesh_chaos(sup, MeshChaosConfig(
+            device_loss=(MeshDeviceLossRule(
+                device_index=1, axis="scenarios", cross_index=0,
+                die_at_round=1, revive_at_round=4),),
+        ), seed=0)
+        state = sup.init_state(thetas)
+        state, _t, _s = sup.step(state, thetas)          # round 0
+        for lay in sup._layouts.values():
+            lay.fleet.watchdog_timeout_s = 3.0
+        sup.watchdog_timeout_s = 3.0
+        try:
+            state, trajs, stats = sup.step(state, thetas)  # loss hits
+            assert sup.degraded
+            assert sup.stats()["degraded_axes"] == ["scenarios"]
+            assert sup.mesh_shape == (4, 1)
+            assert sorted(sup.dead_branches) == [2, 3]
+            # the degraded layout's tree RENORMALIZED: (0.4, 0.3)
+            # survive as (4/7, 3/7), a true probability distribution
+            layout_tree = sup._current.tree
+            np.testing.assert_allclose(
+                layout_tree.probabilities,
+                (0.4 / 0.7, 0.3 / 0.7), rtol=1e-12)
+            # survivors finite, lost branches honestly NaN
+            u = np.asarray(trajs["u"])        # (4, 4, N, n_u)
+            assert u.shape[:2] == (N_AGENTS, N_SCEN)
+            assert np.isfinite(u[:, :2]).all()
+            assert np.isnan(u[:, 2:]).all()
+            # the transition re-centered ν and rescaled the branch
+            # weights — the degraded equilibrium takes more than one
+            # 25-iteration round to reach at 1e-6; the warm-started
+            # NEXT round closes it
+            state, trajs, stats = sup.step(state, thetas)  # round 2
+            assert bool(stats.converged)
+            u0 = np.asarray(sup.actuated_u0(state))
+            # group-identical by construction — lost branches
+            # report their group's surviving projection
+            np.testing.assert_array_equal(
+                u0, np.broadcast_to(u0[:, :1], u0.shape))
+            # no stale-probability bias: an INDEPENDENT reference
+            # fleet posed at the reduced scenario count (the honest
+            # 2-branch robust problem, never interrupted) converges
+            # to the same actuated u0 — a missing renormalization or
+            # a stranded non-anticipativity multiplier sum would park
+            # the degraded fleet a constant offset away, forever
+            ref = ScenarioFleet(group, tree.subtree((0, 1)), OPTS)
+            th_ref = jax.tree.map(lambda l: l[:, :2], thetas)
+            rstate = ref.init_state(th_ref)
+            for _ in range(3):
+                rstate, _rt, _rs = ref.step(rstate, th_ref)
+            ref_u0 = np.asarray(ref.actuated_u0(rstate))
+            np.testing.assert_allclose(u0[:, :2], ref_u0,
+                                       atol=2e-3)
+            # revival: device answers again at round 4 — hysteresis
+            # re-admits (readmit_after=1)
+            state, _t, _s = sup.step(state, thetas)      # round 3
+            state, _t, _s = sup.step(state, thetas)      # round 4
+            assert not sup.degraded and sup.mesh_shape == (4, 2)
+            assert not sup.dead_branches
+        finally:
+            for lay in sup._layouts.values():
+                lay.fleet.watchdog_timeout_s = 60.0
+            sup.watchdog_timeout_s = 60.0
+            chaos.uninstall()
+        # post-recovery BITWISE: an independent, never-interrupted
+        # full-grid engine stepping the same recovered state
+        # reproduces the consensus exactly — re-admission restored
+        # the full 2-D computation, not an approximation of it
+        state, _t, _s = sup.step(state, thetas)   # consume lane resets
+        uninterrupted = ScenarioFleet(group, tree, OPTS,
+                                      mesh=sup.full_mesh)
+        rs, _rt, _ = uninterrupted.step(
+            *uninterrupted.shard_args(sup.full_mesh, state, thetas))
+        ss, _st, _ = sup.step(state, thetas)
+        for alias in ss.zbar:
+            np.testing.assert_array_equal(
+                np.asarray(ss.zbar[alias]), np.asarray(rs.zbar[alias]))
+
+
+class TestAgentsAxisAcceptance:
+    def test_kill_agent_row_mid_run(self, rig, group, tree):
+        """Same test shape for an agents-axis kill: the dead row's
+        lanes mask out, survivors re-pad and stay finite, the
+        consensus multipliers re-center, and recovery is BITWISE."""
+        from agentlib_mpc_tpu.resilience.chaos import (
+            MeshChaosConfig,
+            MeshDeviceLossRule,
+            install_mesh_chaos,
+        )
+
+        sup, thetas = rig
+        sup.degrade_axis = "agents"
+        chaos = install_mesh_chaos(sup, MeshChaosConfig(
+            device_loss=(MeshDeviceLossRule(
+                device_index=2, axis="agents", cross_index=0,
+                die_at_round=1, revive_at_round=3),),
+        ), seed=0)
+        state = sup.init_state(thetas)
+        state, _t, _s = sup.step(state, thetas)          # round 0
+        for lay in sup._layouts.values():
+            lay.fleet.watchdog_timeout_s = 3.0
+        sup.watchdog_timeout_s = 3.0
+        try:
+            state, trajs, stats = sup.step(state, thetas)  # loss hits
+            assert sup.degraded
+            assert sup.stats()["degraded_axes"] == ["agents"]
+            assert sup.mesh_shape == (3, 2)
+            assert list(np.where(sup.dead_lanes)[0]) == [2]
+            u = np.asarray(trajs["u"])
+            survivors = [0, 1, 3]
+            assert np.isfinite(u[survivors]).all()
+            assert u.shape[0] == N_AGENTS       # base layout held
+            # every branch still served — an agents-axis degrade
+            # costs lanes, never robustness breadth
+            assert sup.scenarios_active == N_SCEN
+            # the transition re-centered λ — the warm-started next
+            # round closes the survivors' new equilibrium
+            state, _t, stats = sup.step(state, thetas)   # round 2
+            assert bool(stats.converged)
+            state, _t, _s = sup.step(state, thetas)      # revive->readmit
+            assert not sup.degraded and sup.mesh_shape == (4, 2)
+        finally:
+            for lay in sup._layouts.values():
+                lay.fleet.watchdog_timeout_s = 60.0
+            sup.watchdog_timeout_s = 60.0
+            chaos.uninstall()
+            sup.degrade_axis = "auto"
+        state, _t, _s = sup.step(state, thetas)   # consume lane resets
+        uninterrupted = ScenarioFleet(group, tree, OPTS,
+                                      mesh=sup.full_mesh)
+        rs, _rt, _ = uninterrupted.step(
+            *uninterrupted.shard_args(sup.full_mesh, state, thetas))
+        ss, _st, _ = sup.step(state, thetas)
+        np.testing.assert_array_equal(
+            np.asarray(ss.zbar["shared_u"]),
+            np.asarray(rs.zbar["shared_u"]))
+
+
+class TestZeroRetraceRepeat:
+    def test_repeat_degrade_readmit_zero_retraces(self, rig,
+                                                  compile_profiler):
+        """The [scenario.survive] contract as a test: with both
+        layouts already warmed by the acceptance rows above, a repeat
+        degrade → serve → re-admit → serve cycle on EITHER axis costs
+        zero traces and zero compiles — layouts are cached per
+        surviving rectangle, transitions are shape-stable data
+        movement."""
+        from agentlib_mpc_tpu.lint.retrace_budget import (
+            _compile_snapshot,
+        )
+
+        sup, thetas = rig
+        layouts_before = sup.stats()["layouts_built"]
+        state = sup.init_state(thetas)
+        state, _t, _s = sup.step(state, thetas)
+        before = _compile_snapshot(compile_profiler)
+        # scenarios-axis cycle (column 1 again — the cached 4x1)
+        sup.force_degrade([int(sup.grid_ids[0, 1])], axis="scenarios")
+        state, _t, _s = sup.step(state, thetas)
+        sup.force_readmit()
+        state, _t, _s = sup.step(state, thetas)
+        # agents-axis cycle (row 2 again — the cached 3x2)
+        sup.force_degrade([int(sup.grid_ids[2, 0])], axis="agents")
+        state, _t, _s = sup.step(state, thetas)
+        sup.force_readmit()
+        state, _t, _s = sup.step(state, thetas)
+        after = _compile_snapshot(compile_profiler)
+        deltas = {k: after.get(k, 0) - before.get(k, 0)
+                  for k in set(before) | set(after)
+                  if after.get(k, 0) != before.get(k, 0)}
+        assert not deltas, \
+            f"repeat degrade/readmit cycles retraced: {deltas}"
+        assert sup.stats()["layouts_built"] == layouts_before
+
+    def test_survive_budget_checked_in(self):
+        """Gate-as-test: the [scenario.survive] budget the CI gate
+        enforces exists and pins zero."""
+        cfg = load_budgets().get("scenario", {}).get("survive", {})
+        budgets = cfg.get("budgets", {})
+        assert budgets, "[scenario.survive.budgets] missing from " \
+                        "lint_budgets.toml"
+        assert int(budgets.get("default", 1)) == 0
+
+
+class TestScenarioServing:
+    """Scenario-lifted serving buckets (the tentpole's serving half):
+    TenantSpec.scenario_tree enters the bucket key, robust tenants get
+    slots/health/checkpoint, and the plane checkpoint's topology stamp
+    records the full mesh SHAPE."""
+
+    @pytest.fixture(scope="class")
+    def serving_rig(self, ocp):
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            FusedADMMOptions,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+        from agentlib_mpc_tpu.serving.health import HealthPolicy
+
+        tree = fan_tree(3, robust_horizon=1)
+        opts = ScenarioFleetOptions(max_iterations=8, rho=2.0,
+                                    rho_na=2.0)
+
+        def robust_spec(tid, a):
+            p = jnp.stack([jnp.array([a + 0.3 * s]) for s in range(3)])
+            theta = jax.tree.map(
+                lambda leaf: jnp.broadcast_to(
+                    jnp.asarray(leaf), (3,) + np.shape(leaf)),
+                ocp.default_params())._replace(p=p)
+            return TenantSpec(
+                tenant_id=tid, ocp=ocp, theta=theta,
+                couplings={"shared_u": "u"},
+                solver_options=SolverOptions(max_iter=30),
+                scenario_tree=tree, scenario_options=opts)
+
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=2,
+            pipelined=False, donate=False,
+            health_policy=HealthPolicy())
+        return plane, robust_spec, tree
+
+    def test_robust_tenants_bucket_and_serve(self, serving_rig):
+        plane, robust_spec, _tree = serving_rig
+        r0 = plane.join(robust_spec("r0", 1.0))
+        r1 = plane.join(robust_spec("r1", 2.0))
+        assert r0.bucket == r1.bucket        # same tree, same bucket
+        assert not r0.engine_cached and r1.engine_cached
+        plane.submit("r0")
+        plane.submit("r1")
+        results = plane.serve_round()
+        results.update(plane.flush())
+        for tid in ("r0", "r1"):
+            res = results[tid]
+            assert res.action == "actuate"
+            assert np.isfinite(list(res.controls.values())).all()
+            # per-branch attribution decoded into the stats row — the
+            # robust tenant's third sickness signal
+            assert res.stats["branch_quarantined"] == [0, 0, 0]
+            assert res.stats["quarantined_iters"] == 0
+            assert "na_spread" in res.stats
+
+    def test_degenerate_tree_lands_in_flat_bucket(self, serving_rig,
+                                                  ocp):
+        from agentlib_mpc_tpu.serving import TenantSpec
+        from agentlib_mpc_tpu.scenario import single_scenario
+
+        plane, _robust_spec, _tree = serving_rig
+        flat = plane.join(TenantSpec(
+            tenant_id="f0", ocp=ocp,
+            theta=ocp.default_params(p=jnp.array([3.0])),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30)))
+        s1 = plane.join(TenantSpec(
+            tenant_id="f1", ocp=ocp,
+            theta=jax.tree.map(lambda l: jnp.asarray(l)[None],
+                               ocp.default_params(p=jnp.array([4.0]))),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30),
+            scenario_tree=single_scenario()))
+        # the S=1 tree normalizes into the FLAT bucket — no second
+        # compiled program for the same structure
+        assert s1.bucket == flat.bucket
+        assert s1.bucket != plane._tenant_bucket["r0"].digest
+
+    def test_branch_theta_shape_enforced(self, serving_rig, ocp):
+        from agentlib_mpc_tpu.serving import TenantSpec
+
+        plane, robust_spec, tree = serving_rig
+        bad = TenantSpec(
+            tenant_id="bad", ocp=ocp,
+            theta=ocp.default_params(),     # no branch axis
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30),
+            scenario_tree=tree)
+        with pytest.raises(ValueError, match="scenario.generate"):
+            plane.join(bad)
+
+    def test_checkpoint_roundtrip_with_scenario_axis(self, serving_rig,
+                                                     ocp, tmp_path):
+        """Plane checkpoints carry the scenario axis: a robust
+        bucket's ScenarioState + (capacity, S) theta batch restore
+        through the compile cache, warm starts bitwise."""
+        plane, robust_spec, _tree = serving_rig
+        path = str(tmp_path / "plane")
+        plane.save_checkpoint(path)
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        scen_buckets = [b for b in manifest["buckets"]
+                        if b["scenarios"] > 1]
+        assert scen_buckets and scen_buckets[0]["scenarios"] == 3
+
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            FusedADMMOptions,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+        from agentlib_mpc_tpu.serving.health import HealthPolicy
+        from agentlib_mpc_tpu.scenario import single_scenario
+
+        specs = {"r0": robust_spec("r0", 1.0),
+                 "r1": robust_spec("r1", 2.0),
+                 "f0": TenantSpec(
+                     tenant_id="f0", ocp=ocp,
+                     theta=ocp.default_params(p=jnp.array([3.0])),
+                     couplings={"shared_u": "u"},
+                     solver_options=SolverOptions(max_iter=30)),
+                 "f1": TenantSpec(
+                     tenant_id="f1", ocp=ocp,
+                     theta=jax.tree.map(
+                         lambda l: jnp.asarray(l)[None],
+                         ocp.default_params(p=jnp.array([4.0]))),
+                     couplings={"shared_u": "u"},
+                     solver_options=SolverOptions(max_iter=30),
+                     scenario_tree=single_scenario())}
+        plane2 = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=2,
+            pipelined=False, donate=False,
+            health_policy=HealthPolicy(), cache=plane.cache)
+        report = plane2.restore_checkpoint(path, specs)
+        assert report.cold_builds == 0       # warm cache: splices only
+        # bitwise warm starts on the robust bucket
+        old_bucket = next(b for b in plane._buckets.values()
+                          if getattr(b, "n_scenarios", 1) == 3)
+        new_bucket = next(b for b in plane2._buckets.values()
+                          if getattr(b, "n_scenarios", 1) == 3)
+        np.testing.assert_array_equal(np.asarray(old_bucket.state.w),
+                                      np.asarray(new_bucket.state.w))
+        plane2.submit("r0")
+        results = plane2.serve_round()
+        results.update(plane2.flush())
+        assert results["r0"].action == "actuate"
+
+    def test_topology_stamp_records_full_shape(self, serving_rig,
+                                               tmp_path):
+        """Satellite 1: the stamp records axis names + sizes; a legacy
+        scalar stamp restores with a warning; a SHAPE drift is
+        rejected loudly with the reshard recipe."""
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            FusedADMMOptions,
+        )
+        from agentlib_mpc_tpu.serving import ServingPlane
+        from agentlib_mpc_tpu.serving.checkpoint import (
+            plane_checkpoint_topology,
+        )
+
+        plane, robust_spec, _tree = serving_rig
+        path = str(tmp_path / "shape-plane")
+        plane.save_checkpoint(path)
+        topo = plane_checkpoint_topology(path)
+        assert "mesh_shape" in topo          # the full-shape stamp
+        manifest_path = os.path.join(path, "manifest.json")
+        manifest = json.load(open(manifest_path))
+
+        def fresh_plane():
+            return ServingPlane(
+                FusedADMMOptions(max_iterations=6, rho=2.0),
+                slot_multiple=1, initial_capacity=2,
+                pipelined=False, donate=False, cache=plane.cache)
+
+        # (a) 2-D drift: stamp claims a 4x2 grid, restoring plane has
+        # none — rejected loudly, recipe included
+        manifest["topology"]["mesh_shape"] = [["agents", 4],
+                                              ["scenarios", 2]]
+        json.dump(manifest, open(manifest_path, "w"))
+        with pytest.raises(ValueError, match="RESHARD"):
+            fresh_plane().restore_checkpoint(path, {})
+        # (b) legacy scalar stamp (no mesh_shape key): restores with a
+        # warning, size-only check still applies
+        del manifest["topology"]["mesh_shape"]
+        json.dump(manifest, open(manifest_path, "w"))
+        specs = {"r0": robust_spec("r0", 1.0),
+                 "r1": robust_spec("r1", 2.0)}
+        for entry in manifest["buckets"]:
+            # every other tenant the class accumulated needs a spec:
+            # rebuild the flat ones the earlier tests joined
+            for tid, a in (("f0", 3.0), ("f1", 4.0)):
+                from agentlib_mpc_tpu.serving import TenantSpec
+
+                ocp = robust_spec("seed", 0.0).ocp
+                specs.setdefault(tid, TenantSpec(
+                    tenant_id=tid, ocp=ocp,
+                    theta=ocp.default_params(p=jnp.array([a])),
+                    couplings={"shared_u": "u"},
+                    solver_options=SolverOptions(max_iter=30)))
+        report = fresh_plane().restore_checkpoint(path, specs)
+        assert report.buckets >= 1
