@@ -23,6 +23,10 @@ Modes:
   fresh process so the device count can be requested).
 * ``--scenario-budget`` — run the scenario-fleet gate (``[scenario]``):
   zero warm retraces of the 2-D (agents × scenarios) robust round
+* ``--journal-budget`` — run the flight-recorder gate
+  (``[telemetry.journal]``): zero warm retraces with the event journal
+  ACTIVE and production-shaped events recorded per round — the proof
+  journaling never enters the jit graph (imports jax)
 * ``--memory-budget`` — run the static memory gate (``[jaxpr.memory]``):
   every example OCP's certified peak must bound XLA's own
   ``memory_analysis`` from above within the pinned ratio, and the
@@ -105,6 +109,10 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="run the scenario-fleet gate: zero warm "
                              "retraces of the 2-D (agents x scenarios) "
                              "fused robust round (8 virtual devices)")
+    parser.add_argument("--journal-budget", action="store_true",
+                        help="run the flight-recorder gate: zero warm "
+                             "retraces with journaling ACTIVE — "
+                             "journaling never enters the jit graph")
     parser.add_argument("--memory-budget", action="store_true",
                         help="run the static memory gate: certified "
                              "peaks bound XLA memory_analysis within "
@@ -161,6 +169,14 @@ def main(argv: "list[str] | None" = None) -> int:
         budgets = retrace_budget.load_budgets(args.budgets) \
             if args.budgets else None
         report = retrace_budget.run_scenario_gate(budgets)
+        return 1 if report["violations"] or report["failures"] else 0
+
+    if args.journal_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_journal_gate(budgets)
         return 1 if report["violations"] or report["failures"] else 0
 
     if args.memory_budget:

@@ -275,6 +275,107 @@ def run_gate(budgets: "dict | None" = None, verbose: bool = True) -> dict:
     return report
 
 
+def run_journal_gate(budgets: "dict | None" = None,
+                     verbose: bool = True) -> dict:
+    """``[telemetry.journal]`` budget gate (ISSUE 15): journaling never
+    enters the jit graph.
+
+    The flight recorder is pure host-side Python by construction, but
+    the construction is exactly what a careless emit site could break —
+    a journal write inside a traced function would either retrace every
+    round (the cost this gate pins at zero) or silently bake one
+    event's values into the executable. The gate runs the [retrace]
+    fleet with the journal ENABLED and production-shaped events
+    recorded around every round (set_round + a fleet.round record,
+    what the supervisors emit), and holds the per-entry-point
+    (traces + compiles) delta to the ``[telemetry.journal.budgets]``
+    allowance (default 0). It additionally asserts the journal really
+    recorded (no no-op A/A) and that round stamps landed."""
+    import os as _os
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.telemetry import journal as journal_mod
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    all_cfg = budgets or load_budgets()
+    cfg = (all_cfg.get("telemetry", {}) or {}).get("journal", {}) or {}
+    warmup = int(cfg.get("warmup_rounds", 2))
+    rounds = int(cfg.get("rounds", 3))
+    n_agents = int(cfg.get("n_agents", 4))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+    tmp = _tempfile.mkdtemp(prefix="journal-gate-")
+    path = _os.path.join(tmp, "journal.jsonl")
+    failures: list = []
+    try:
+        engine, state, thetas = build_bench_engine(n_agents)
+        for _ in range(max(warmup, 1)):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+
+        telemetry.enable_journal(path)
+        before = _compile_snapshot(reg)
+        for r in range(rounds):
+            telemetry.journal_set_round(r)
+            state, _trajs, _stats = engine.step(state, thetas)
+            telemetry.journal_event(
+                "fleet.round", degraded=False, devices=1,
+                quarantined=0)
+            state = engine.shift_state(state)
+        after = _compile_snapshot(reg)
+        telemetry.disable_journal()
+        events = journal_mod.read_events(path)
+        if len(events) < rounds:
+            failures.append(
+                f"journal recorded {len(events)} events across "
+                f"{rounds} journaled rounds — the gate measured a "
+                f"no-op, not journaling")
+        elif any(e.get("round") is None for e in events):
+            failures.append("journaled rounds carry no round stamp")
+    finally:
+        telemetry.disable_journal()
+        telemetry.configure(enabled=was_enabled)
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({"entry_point": entry,
+                               "observed": delta, "budget": budget})
+    report = {
+        "warmup_rounds": warmup,
+        "rounds": rounds,
+        "n_agents": n_agents,
+        "deltas": dict(sorted(deltas.items())),
+        "violations": violations,
+        "failures": failures,
+    }
+    if verbose:
+        for v in violations:
+            print(f"journal-budget: {v['entry_point']!r} compiled/"
+                  f"traced {v['observed']}x across {rounds} journaled "
+                  f"rounds (budget {v['budget']}) — journaling is "
+                  f"entering the jit graph")
+        for f in failures:
+            print(f"journal-budget: FAILED — {f}")
+        if not violations and not failures:
+            print(f"journal-budget: OK — journaling active, zero "
+                  f"excess compiles across {rounds} rounds "
+                  f"({n_agents} agents)")
+    return report
+
+
 class _MeshGateSkipped(Exception):
     """Internal control flow: the mesh gate's measurement legs were
     skipped (single-device backend — the failure is already recorded)."""

@@ -1412,12 +1412,24 @@ class FusedADMM:
                     "mesh-dispatched fused rounds that blew the "
                     "collective-watchdog budget").inc(
                     outcome=kind)
+            telemetry.journal_event(
+                "watchdog.condemned", scope="mesh", outcome=kind,
+                budget_s=self.watchdog_timeout_s,
+                groups=[g.name for g in self.groups],
+                mesh_devices=(None if self.mesh is None
+                              else int(self.mesh.devices.size)))
             probe = None
             if self.mesh is not None:
                 probe = probe_mesh_devices(
                     self.mesh, min(self.watchdog_timeout_s,
                                    MESH_PROBE_TIMEOUT_S))
                 self.shard_report = probe
+                telemetry.journal_event(
+                    "watchdog.probe", scope="mesh",
+                    answered=list(probe.answered),
+                    dead=list(probe.dead),
+                    latency_s={str(k): round(v, 4) for k, v
+                               in probe.latency_s.items()})
                 if telemetry.enabled():
                     telemetry.gauge(
                         "mesh_shards_answering",

@@ -80,6 +80,23 @@ logger = logging.getLogger(__name__)
 MAX_TRANSIENT_RETRIES = 2
 
 
+def _quarantine_count(stats) -> int:
+    """Total quarantined (lane, iteration) attributions of one round —
+    the flight recorder's symptom field for a contained NaN storm (a
+    storm the quarantine absorbs is invisible in every OTHER signal)."""
+    for field in ("lane_quarantined", "quarantined"):
+        q = getattr(stats, field, None)
+        if q is None:
+            continue
+        try:
+            if isinstance(q, (tuple, list)):
+                return int(sum(int(np.asarray(g).sum()) for g in q))
+            return int(np.asarray(q).sum())
+        except (TypeError, ValueError):
+            continue
+    return 0
+
+
 def assert_schedule_identity(ref_engine, new_engine, what: str) -> None:
     """The ISSUE 11 static gate both supervisors share: a degraded
     rebuild that would issue a DIFFERENT collective sequence than its
@@ -109,6 +126,10 @@ def assert_schedule_identity(ref_engine, new_engine, what: str) -> None:
                 "shapes; the all-reduce sequence is identical "
                 "(family digest %s)", what, fam_ref)
             return
+        telemetry.journal_event(
+            "certifier.refused", kind="collective_schedule",
+            what=what, collective_digest=ref_digest,
+            rebuilt_digest=new_digest)
         raise RuntimeError(
             f"{what} certifies a DIFFERENT collective schedule than "
             f"the full engine (digest {new_digest} vs {ref_digest}) — "
@@ -389,6 +410,7 @@ class FleetSupervisor:
         base_masks = (self.base_active if active is None
                       else tuple(jnp.asarray(a, bool) for a in active))
         theta_batches = tuple(theta_batches)
+        telemetry.journal_set_round(self.rounds)
         self._maybe_readmit()
         if self._reset_lanes_pending:
             state = self._reset_dead_lane_starts(state, theta_batches)
@@ -463,6 +485,15 @@ class FleetSupervisor:
                 # probation served: the full mesh proved itself
                 self._readmit_needed = self.readmit_after
         state_out, trajs, stats = out
+        if telemetry.journal_active() is not None:
+            # guarded: _quarantine_count is a device->host readback —
+            # a journal-off fleet must not pay it per round
+            telemetry.journal_event(
+                "fleet.round", round=self.rounds - 1,
+                degraded=self.degraded,
+                devices=len(self._current.device_ids),
+                dead_devices=list(self.dead_devices),
+                quarantined=_quarantine_count(stats))
         self._consensus_snapshot = self._consensus_host(state_out)
         return state_out, trajs, stats
 
@@ -571,6 +602,14 @@ class FleetSupervisor:
             telemetry.counter(
                 "mesh_degrade_total",
                 "degraded-mesh fallbacks (shard loss absorbed)").inc()
+        telemetry.journal_event(
+            "mesh.degrade", axis="agents", dead=list(dead),
+            devices_from=was, devices_to=len(alive),
+            dead_lanes=int(sum(int(d.sum())
+                               for d in self.dead_lanes)),
+            engine_reused=build_s < 0.05,
+            collective_digest=self._current.engine
+            .collective_schedule_digest)
         self._export_gauges()
         logger.warning(
             "fleet degraded %d -> %d devices (dead: %s; engine %s in "
@@ -610,6 +649,9 @@ class FleetSupervisor:
             telemetry.counter(
                 "mesh_readmit_total",
                 "full-mesh re-admissions after degraded service").inc()
+        telemetry.journal_event(
+            "mesh.readmit", devices=len(self._full_ids),
+            probation_rounds=self.probation_rounds)
         self._export_gauges()
         logger.warning(
             "full %d-device mesh re-admitted on probation (%d rounds); "
@@ -1155,6 +1197,7 @@ class ScenarioFleetSupervisor:
             return self._flat.step(state, theta_batch, active=active)
         mask = (self.base_active if active is None
                 else jnp.asarray(active, bool))
+        telemetry.journal_set_round(self.rounds)
         self._maybe_readmit()
         if self._reset_pending:
             state = self._reset_dead_starts(state, theta_batch)
@@ -1202,6 +1245,10 @@ class ScenarioFleetSupervisor:
                         "condemned rounds retried on the same mesh "
                         "(every shard answered the probe)").inc(
                         reason="transient")
+                telemetry.journal_event(
+                    "mesh.retry", attempt=transient,
+                    mesh_shape=[len(layout.rows), len(layout.cols)],
+                    answered=list(report.answered))
                 if transient > MAX_TRANSIENT_RETRIES:
                     raise RuntimeError(
                         f"scenario round timed out {transient} times "
@@ -1237,6 +1284,17 @@ class ScenarioFleetSupervisor:
                     "agents": self.readmit_after,
                     "scenarios": self.readmit_after}
         state_out, trajs, stats = out
+        if telemetry.journal_active() is not None:
+            # guarded: _quarantine_count is a device->host readback —
+            # a journal-off fleet must not pay it per round
+            telemetry.journal_event(
+                "fleet.round", round=self.rounds - 1,
+                degraded=self.degraded,
+                mesh_shape=[len(self._current.rows),
+                            len(self._current.cols)],
+                dead_devices=list(self.dead_devices),
+                dead_branches=sorted(self.dead_branches),
+                quarantined=_quarantine_count(stats))
         self._consensus_snapshot = self._consensus_host(state_out)
         return state_out, trajs, stats
 
@@ -1428,6 +1486,15 @@ class ScenarioFleetSupervisor:
                 "mesh_degrade_total",
                 "degraded-mesh fallbacks (shard loss absorbed)").inc(
                 axis=axis)
+        telemetry.journal_event(
+            "mesh.degrade", axis=axis, dead=list(dead_here),
+            shape_from=list(was),
+            shape_to=[len(new_rows), len(new_cols)],
+            dead_lanes=int(self.dead_lanes.sum()),
+            dead_branches=sorted(self.dead_branches),
+            engine_reused=build_s < 0.05,
+            collective_digest=self._current.fleet
+            .collective_schedule_digest)
         self._export_gauges()
         logger.warning(
             "scenario fleet degraded %dx%d -> %dx%d devices on the %s "
@@ -1470,6 +1537,11 @@ class ScenarioFleetSupervisor:
             telemetry.counter(
                 "mesh_readmit_total",
                 "full-mesh re-admissions after degraded service").inc()
+        telemetry.journal_event(
+            "mesh.readmit",
+            mesh_shape=[int(self.grid.shape[0]),
+                        int(self.grid.shape[1])],
+            probation_rounds=self.probation_rounds)
         self._export_gauges()
         logger.warning(
             "full %dx%d grid re-admitted on probation (%d rounds); "

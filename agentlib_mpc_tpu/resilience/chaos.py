@@ -191,6 +191,13 @@ class ChaosController:
             telemetry.counter(
                 "chaos_injections_total",
                 "faults injected by the chaos harness").inc(kind=kind)
+        # EVERY injection self-records through this one seam (ISSUE 15):
+        # the flight recorder's chaos.injected events carry rule, target
+        # and the round stamp, so injected fault ↔ observed symptom ↔
+        # recovery is a joinable chain — and the chaos benches assert
+        # the full schedule is reconstructible from the journal alone
+        telemetry.journal_event("chaos.injected", rule=kind,
+                                target=where, seed=self.config.seed)
         logger.debug("chaos: %s at %s", kind, where)
 
     def count(self, kind: str) -> int:

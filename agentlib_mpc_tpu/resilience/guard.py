@@ -209,9 +209,24 @@ class ActuationGuard:
             pre_ok, pre_reasons = precheck
             healthy = healthy and bool(pre_ok)
             reasons = tuple(dict.fromkeys((*reasons, *pre_reasons)))
+        level_before = self.level
         decision = self._healthy(result) if healthy \
             else self._unhealthy(reasons)
         self._export_level()
+        if self.level != level_before:
+            # ladder MOVES are journaled (not every assessment — the
+            # steady state must not flood the flight recorder). Labels
+            # are free-form caller data: merged with setdefault so a
+            # label named "level"/"reasons" can neither collide (a
+            # TypeError inside assess would crash the actuation path)
+            # nor overwrite the transition fields.
+            ev = {"level": _LEVEL_NAMES[self.level],
+                  "level_from": _LEVEL_NAMES[level_before],
+                  "reasons": list(decision.reasons)}
+            for k, v in self.labels.items():
+                if k not in ("etype", "seq", "t", "round"):
+                    ev.setdefault(k, v)
+            telemetry.journal_event("guard.transition", **ev)
         return decision
 
     def _healthy(self, result: dict) -> GuardDecision:

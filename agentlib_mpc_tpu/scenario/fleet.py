@@ -823,12 +823,24 @@ class ScenarioFleet:
                     "mesh_watchdog_stalls_total",
                     "mesh-dispatched fused rounds that blew the "
                     "collective-watchdog budget").inc(outcome=kind)
+            telemetry.journal_event(
+                "watchdog.condemned", scope="scenario", outcome=kind,
+                budget_s=self.watchdog_timeout_s,
+                groups=[self.group.name], scenarios=int(self.S),
+                mesh_shape=(None if self.mesh is None else
+                            [int(s) for s in self.mesh.devices.shape]))
             probe = None
             if self.mesh is not None:
                 probe = probe_mesh_devices(
                     self.mesh, min(self.watchdog_timeout_s,
                                    MESH_PROBE_TIMEOUT_S))
                 self.shard_report = probe
+                telemetry.journal_event(
+                    "watchdog.probe", scope="scenario",
+                    answered=list(probe.answered),
+                    dead=list(probe.dead),
+                    latency_s={str(k): round(v, 4) for k, v
+                               in probe.latency_s.items()})
                 logger.error(
                     "scenario round blew the %.1fs collective watchdog; "
                     "2-D mesh condemned — per-device probe: %d/%d "

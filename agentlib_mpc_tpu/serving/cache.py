@@ -68,6 +68,8 @@ class CompileCache:
         already serving. Same counter family as lookup hits: the metric
         is "compiled engines reused", however shallow the path."""
         self.hits += 1
+        telemetry.journal_event("cache.engine", outcome="hit",
+                                bucket=label or "?", live_bucket=True)
         if telemetry.enabled():
             telemetry.counter(
                 "serving_compile_cache_hits_total",
@@ -111,7 +113,15 @@ class CompileCache:
                         "engines revived from the on-disk export store "
                         "(no certify/trace paid)").inc(bucket=label or "?")
             else:
-                engine = builder()
+                try:
+                    engine = builder()
+                except Exception as exc:
+                    # a failed cold build (compile OOM, chaos) is a
+                    # first-class incident event, not just a stack trace
+                    telemetry.journal_event(
+                        "cache.engine", outcome="build_failed",
+                        bucket=label or "?", error=repr(exc)[:300])
+                    raise
                 self.misses += 1
             self._entries[key] = (engine, label)
             self._evict_over_bound()
@@ -120,6 +130,15 @@ class CompileCache:
             self._entries.move_to_end(key)       # LRU: a hit is a use
             self.hits += 1
         latency = time.perf_counter() - t0
+        telemetry.journal_event(
+            "cache.engine",
+            outcome=("restored" if restored else "hit" if hit
+                     else "miss"),
+            bucket=label or "?", latency_s=round(latency, 6),
+            collective_digest=getattr(engine,
+                                      "collective_schedule_digest",
+                                      None),
+            memory_digest=getattr(engine, "memory_digest", None))
         if telemetry.enabled():
             if not restored:
                 name = ("serving_compile_cache_hits_total" if hit

@@ -44,6 +44,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
+from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.utils.checkpoint import (
     _stale_siblings,
     load_pytree,
@@ -225,6 +226,9 @@ def save_plane(plane, path: str) -> str:
                    for tid, guard in plane._guards.items()},
         "health": (plane._health.snapshot()
                    if plane._health is not None else None),
+        # SLO/error-budget continuity (ISSUE 15): a restore that forgot
+        # the burn would report a fresh 100% budget mid-incident
+        "slo": plane.slo.snapshot(),
         "queue": plane.queue.snapshot(now),
     }
     if arrays:
@@ -243,6 +247,10 @@ def save_plane(plane, path: str) -> str:
         os.rename(tmp, path)
     for stale in _stale_siblings(path):
         shutil.rmtree(stale, ignore_errors=True)
+    telemetry.journal_event(
+        "checkpoint.saved", path=path,
+        tenants=len(plane._tenant_bucket), buckets=len(buckets),
+        queued=len(manifest["queue"]))
     logger.info("serving plane checkpointed to %s (%d tenants, %d "
                 "buckets, %d queued)", path,
                 len(plane._tenant_bucket), len(buckets),
@@ -273,6 +281,9 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
     src = _checkpoint_dir(path)
     if src is None:
         if os.path.isdir(path) or _stale_siblings(path):
+            telemetry.journal_event(
+                "checkpoint.rejected", path=path,
+                reason="incomplete_manifest")
             raise RuntimeError(
                 f"checkpoint at {path} exists but no complete manifest "
                 f"was found (save killed mid-write?) — refusing to "
@@ -302,6 +313,13 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
         saved_mesh = topo.get("mesh_devices")
         saved_mult = int(topo.get("slot_multiple", 0))
         saved_shape = topo.get("mesh_shape")
+        def _reject_topology(kind: str) -> None:
+            telemetry.journal_event(
+                "checkpoint.rejected", path=src, reason=kind,
+                saved_topology=topo,
+                want_mesh=want_mesh, want_shape=want_shape,
+                want_slot_multiple=plane.slot_multiple)
+
         if "mesh_shape" not in topo:
             # legacy scalar stamp (pre-ISSUE 14): the size-only check
             # still runs below, but a 2-D grid and a 1-D line of the
@@ -314,6 +332,7 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
                 "agents×scenarios grid vs an 8-device agents line) "
                 "cannot be detected on this checkpoint", src)
         elif saved_shape != want_shape:
+            _reject_topology("mesh_shape_drift")
             raise ValueError(
                 f"checkpoint topology mismatch: saved on mesh_shape="
                 f"{saved_shape}, restoring into {want_shape} — the "
@@ -329,6 +348,7 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
                 f"changing topology; docs/serving.md 'Cross-process "
                 f"restore')")
         if saved_mesh != want_mesh or saved_mult != plane.slot_multiple:
+            _reject_topology("topology_drift")
             raise ValueError(
                 f"checkpoint topology mismatch: saved on "
                 f"mesh_devices={saved_mesh} / "
@@ -385,6 +405,11 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
         live_sched = bucket.engine.collective_schedule_digest
         if saved_sched is not None and live_sched is not None \
                 and saved_sched != live_sched:
+            telemetry.journal_event(
+                "checkpoint.rejected", path=src,
+                reason="collective_schedule_drift",
+                bucket=entry["digest"], collective_digest=saved_sched,
+                live_digest=live_sched)
             raise ValueError(
                 f"bucket {entry['digest']}: the checkpoint was saved "
                 f"under collective schedule {saved_sched}, but this "
@@ -469,6 +494,17 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
                 deadline_s=entry.get("deadline_s"))):
             requeued += 1
     plane.rounds = int(manifest.get("rounds") or 0)
+    plane.slo.restore(manifest.get("slo"))
+    if manifest.get("slo") is not None:
+        plane.served_rounds = plane.slo.rounds
+    else:
+        # pre-ISSUE-15 checkpoint: no SLO ledger to resume from — fall
+        # back to the manifest's dispatch count (an upper bound on
+        # served rounds for multi-bucket planes) so the journal's round
+        # stamps stay monotonic instead of restarting at 0 on a tape
+        # that already carries this plane's history
+        plane.served_rounds = int(manifest.get("rounds") or 0)
+    telemetry.journal_set_round(plane.served_rounds)
     plane._export_active()
 
     cold = plane.cache.misses - misses0
@@ -482,6 +518,12 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
         total_s=time.perf_counter() - t0,
         persistent_restores=plane.cache.persistent_restores - restores0,
     )
+    telemetry.journal_event(
+        "checkpoint.restored", path=src,
+        tenants=len(report.tenants), buckets=report.buckets,
+        cold_builds=report.cold_builds, cache_hits=report.cache_hits,
+        persistent_restores=report.persistent_restores,
+        requeued=requeued, mttr_s=round(report.total_s, 4))
     logger.info(
         "serving plane restored from %s: %d tenants / %d buckets in "
         "%.1f ms (%d cold builds, %d cache hits, %d store revivals, "

@@ -157,6 +157,10 @@ class PipelinedDispatcher:
         if not waited:
             # the leak cap refused the read outright — the device is
             # already known-dead; a re-probe would just leak one more
+            telemetry.journal_event(
+                "serve.stall", bucket=label or "?", waited=False,
+                sync_fallback=True,
+                wedged_readers=self._reader.max_leaked)
             logger.error(
                 "serving round refused at the watchdog leak cap "
                 "(%d wedged readers, bucket %s); shedding its tenants "
@@ -172,6 +176,10 @@ class PipelinedDispatcher:
                 "serving_watchdog_probes_total",
                 "post-stall bounded device probes, by outcome").inc(
                 result=self.last_probe or "dead")
+        telemetry.journal_event(
+            "serve.stall", bucket=label or "?", waited=True,
+            budget_s=self.timeout_s, sync_fallback=True,
+            probe=self.last_probe or "dead")
         logger.error(
             "serving round stalled past the %.1fs watchdog (bucket %s); "
             "shedding its tenants, %sfalling back to sync dispatch "

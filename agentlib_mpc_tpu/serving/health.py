@@ -149,6 +149,16 @@ class HealthLedger:
                 "1=quarantined, 2=probation, 3=evicted)").set(
                 float(_STATE_LEVEL[row.state]), tenant=tenant_id)
 
+    def _journal_move(self, tenant_id: str, row: TenantHealth,
+                      state_from: str) -> None:
+        if row.state != state_from:
+            telemetry.journal_event(
+                "health.transition", tenant=tenant_id,
+                state=row.state, state_from=state_from,
+                sick_streak=row.sick_streak,
+                healthy_streak=row.healthy_streak,
+                evictions=row.evictions)
+
     def is_sick_result(self, healthy: bool, stats: "dict | None") -> bool:
         """Merge the guard verdict with the per-lane quarantine
         attribution into one sick/healthy bit for the ledger."""
@@ -170,6 +180,7 @@ class HealthLedger:
             # an evicted tenant has no served rounds; ignore strays
             # (e.g. a pipelined round launched before the eviction)
             return None
+        state_before = row.state
         transition = None
         if sick:
             row.healthy_streak = 0
@@ -206,6 +217,7 @@ class HealthLedger:
             row.evicted_rounds = 0
             row.evictions += 1
         self._export(tenant_id, row)
+        self._journal_move(tenant_id, row, state_before)
         return transition
 
     def force_evict(self, tenant_id: str) -> None:
@@ -215,12 +227,14 @@ class HealthLedger:
         row = self.row(tenant_id)
         if row.state == EVICTED:
             return
+        state_before = row.state
         row.state = EVICTED
         row.sick_streak = 0
         row.healthy_streak = 0
         row.evicted_rounds = 0
         row.evictions += 1
         self._export(tenant_id, row)
+        self._journal_move(tenant_id, row, state_before)
 
     def tick_evicted(self) -> "list[str]":
         """Advance every evicted tenant's clock by one served round;
@@ -236,11 +250,13 @@ class HealthLedger:
     def readmitted(self, tenant_id: str) -> None:
         """The plane re-admitted a tenant: start probation."""
         row = self.row(tenant_id)
+        state_before = row.state
         row.state = PROBATION
         row.sick_streak = 0
         row.healthy_streak = 0
         row.evicted_rounds = 0
         self._export(tenant_id, row)
+        self._journal_move(tenant_id, row, state_before)
 
     # -- checkpoint seam ------------------------------------------------------
 

@@ -69,6 +69,7 @@ from agentlib_mpc_tpu.serving.dispatch import PipelinedDispatcher, RoundTimeout
 from agentlib_mpc_tpu.serving.fingerprint import TenantSpec, bucket_key
 from agentlib_mpc_tpu.serving.health import HealthLedger, HealthPolicy
 from agentlib_mpc_tpu.serving.slots import SlotPlane, tree_repeat, tree_row
+from agentlib_mpc_tpu.telemetry.slo import SLOPolicy, SLOTracker
 
 logger = logging.getLogger(__name__)
 
@@ -123,7 +124,8 @@ class ServingPlane:
                  mesh=None,
                  engine_store=None,
                  memory_certify: str = "auto",
-                 hbm_bytes: "int | str | None" = "auto"):
+                 hbm_bytes: "int | str | None" = "auto",
+                 slo_policy: "SLOPolicy | None" = None):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -236,6 +238,20 @@ class ServingPlane:
         #: mask a persistently poisoned feed
         self._sick_marks: set = set()
         self.rounds = 0
+        #: serve_round() calls — the flight recorder's round stamp and
+        #: the SLO plane's window clock (``rounds`` above counts fused
+        #: dispatches, one per TOUCHED bucket)
+        self.served_rounds = 0
+        #: per-tenant SLO / error-budget accounting (ISSUE 15), fed
+        #: purely from the results this plane already produces; the
+        #: report is recomputable offline from the journal's
+        #: ``serve.round`` events (telemetry.slo.slo_from_events)
+        self.slo = SLOTracker(slo_policy if slo_policy is not None
+                              else SLOPolicy())
+        self._slo_policy_journaled = False
+        # events emitted between rounds (submissions, sheds, chaos
+        # injections at the submit seam) belong to the UPCOMING round
+        telemetry.journal_set_round(self.served_rounds)
 
     # -- membership -----------------------------------------------------------
 
@@ -338,6 +354,10 @@ class ServingPlane:
         frees. The sitting tenants' round is never touched."""
         self._register_tenant(spec.tenant_id, key, spec)
         self._evicted[spec.tenant_id] = key
+        telemetry.journal_event(
+            "certifier.refused", kind="memory", tenant=spec.tenant_id,
+            bucket=key.digest, hbm_bytes=self.hbm_bytes,
+            detail=str(exc)[:300])
         if telemetry.enabled():
             telemetry.counter(
                 "serving_capacity_shed_joins_total",
@@ -361,6 +381,11 @@ class ServingPlane:
         self._evicted.pop(tenant_id, None)
         self._specs.pop(tenant_id, None)
         self._guards.pop(tenant_id, None)
+        # the SLO ledger deliberately KEEPS the departed tenant's rows:
+        # error budgets are an accounting record, and dropping them
+        # would make the live report diverge from the offline recompute
+        # over the journal's serve.round events (the documented
+        # live == offline parity). Operators can slo.forget() explicitly.
         if self._health is not None:
             self._health.forget(tenant_id)
         if bucket is None:
@@ -669,6 +694,8 @@ class ServingPlane:
                                             reason=reason)
             telemetry.serving_metrics()["active"].set(
                 float(bucket.n_active), bucket=key.digest)
+        telemetry.journal_event("serve.eviction", tenant=tenant_id,
+                                bucket=key.digest, reason=reason)
         logger.warning("tenant %s evicted from bucket %s (%s); "
                        "submissions now shed into its guard ladder",
                        tenant_id, key.digest, reason)
@@ -702,6 +729,8 @@ class ServingPlane:
                 bucket=key.digest)
             telemetry.serving_metrics()["active"].set(
                 float(bucket.n_active), bucket=key.digest)
+        telemetry.journal_event("serve.readmission", tenant=tenant_id,
+                                bucket=key.digest, slot=slot)
         logger.info("tenant %s readmitted to bucket %s slot %d "
                     "(probation)", tenant_id, key.digest, slot)
         return True
@@ -783,8 +812,17 @@ class ServingPlane:
         guard = self._guards.get(tenant_id)
         if guard is None:
             return None
-        return guard.assess({"stats": {"success": True}},
-                            precheck=(False, (reason,)))
+        decision = guard.assess({"stats": {"success": True}},
+                                precheck=(False, (reason,)))
+        key = self._tenant_bucket.get(tenant_id)
+        telemetry.journal_event(
+            "admission.shed", tenant=tenant_id, reason=reason,
+            action=decision.action,
+            bucket=key.digest if key is not None else None)
+        self.slo.record_result(
+            tenant_id, decision.action,
+            deadline_missed=(reason == "shed_deadline"))
+        return decision
 
     def serve_round(self, now: "float | None" = None) -> dict:
         """Drain the queue and run one fused round per touched bucket.
@@ -796,6 +834,20 @@ class ServingPlane:
         falls back to synchronous dispatch."""
         t0 = time.perf_counter()
         now = time.monotonic() if now is None else now
+        # stamp every event this round emits (sheds, evictions, stalls,
+        # chaos injections …) with the serve-round clock
+        telemetry.journal_set_round(self.served_rounds)
+        if not self._slo_policy_journaled \
+                and telemetry.journal_active() is not None:
+            # stamp the plane's SLO policy onto the tape once, so the
+            # offline recompute audits against the SAME targets and
+            # windows the live report uses
+            telemetry.journal_event(
+                "slo.policy",
+                availability_target=self.slo.policy.availability_target,
+                deadline_target=self.slo.policy.deadline_target,
+                windows=list(self.slo.policy.windows))
+            self._slo_policy_journaled = True
         self._readmit_due()
         ready, expired = self.queue.drain(now)
         results: dict = {}
@@ -856,6 +908,17 @@ class ServingPlane:
         if m is not None:
             m["queue_depth"].set(float(len(self.queue)))
             m["round_seconds"].observe(time.perf_counter() - t0)
+        # close the SLO round and journal its tally: the serve.round
+        # event is what makes slo_report() recomputable offline from
+        # the flight recorder alone
+        tally = self.slo.tick_round(self.served_rounds)
+        telemetry.journal_event(
+            "serve.round", round=self.served_rounds, tally=tally,
+            buckets_touched=len(touched),
+            actions={tid: r.action for tid, r in results.items()})
+        self.served_rounds += 1
+        # between-round events (next round's submissions) stamp forward
+        telemetry.journal_set_round(self.served_rounds)
         return results
 
     def flush(self) -> dict:
@@ -911,6 +974,7 @@ class ServingPlane:
                 # labelled by guard action so availability (actuated /
                 # delivered) is computable from telemetry alone
                 m["solves"].inc(action=decision.action)
+            self.slo.record_result(tenant_id, decision.action)
             if self._health is not None:
                 sick = self._health.is_sick_result(decision.healthy,
                                                    stats)
@@ -969,6 +1033,16 @@ class ServingPlane:
         if self._health is None:
             return None
         return self._health.state(tenant_id)
+
+    def slo_report(self) -> dict:
+        """Per-tenant SLO / error-budget report
+        (:meth:`~agentlib_mpc_tpu.telemetry.slo.SLOTracker.report`):
+        availability and deadline objectives, multi-window burn rates,
+        a fleet roll-up. Fed purely from the per-round results, so the
+        identical report is recomputable offline from the journal
+        (``telemetry.slo.slo_from_events`` /
+        ``python -m agentlib_mpc_tpu.telemetry --slo <journal>``)."""
+        return self.slo.report()
 
     def stats(self) -> dict:
         return {

@@ -46,6 +46,7 @@ from agentlib_mpc_tpu.telemetry.spans import (
     current_span,
     span,
 )
+from agentlib_mpc_tpu.telemetry import journal as _journal_mod
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -55,6 +56,8 @@ __all__ = [
     "configure", "enabled", "counter", "gauge", "histogram",
     "solver_metrics", "serving_metrics", "install_jax_hooks",
     "record_device_memory", "reset",
+    "enable_journal", "disable_journal", "journal_active",
+    "journal_event", "journal_set_round", "serve_metrics",
 ]
 
 
@@ -208,9 +211,58 @@ def install_jax_hooks(registry: "MetricsRegistry | None" = None
 
 def reset() -> None:
     """Clear all recorded samples, spans and retrace scopes (declared
-    metric families survive). Test-isolation / between-runs helper."""
+    metric families survive; the flight-recorder journal does too — a
+    tape that ``reset()`` could wipe would not be a flight recorder).
+    Test-isolation / between-runs helper."""
     DEFAULT.reset()
     RECORDER.clear()
     from agentlib_mpc_tpu.telemetry import jax_events
 
     jax_events.reset_scopes()
+
+
+# -- flight recorder (ISSUE 15) ----------------------------------------------
+
+
+def enable_journal(path: str, **kwargs):
+    """Install the process-global flight-recorder journal at ``path``
+    (:mod:`agentlib_mpc_tpu.telemetry.journal` for the durability
+    contract). Every built-in fault/recovery seam starts recording."""
+    return _journal_mod.enable(path, **kwargs)
+
+
+def disable_journal() -> None:
+    """Close and uninstall the global journal (the file survives)."""
+    _journal_mod.disable()
+
+
+def journal_active():
+    """The global :class:`~agentlib_mpc_tpu.telemetry.journal.Journal`,
+    or None when journaling is off."""
+    return _journal_mod.active()
+
+
+def journal_event(etype: str, **fields) -> "int | None":
+    """Record one typed event into the global journal (no-op when
+    journaling is off) — the one call every emit site uses."""
+    if _journal_mod._GLOBAL is None:       # the disabled fast path
+        return None
+    return _journal_mod.record(etype, **fields)
+
+
+def journal_set_round(round_: "int | None") -> None:
+    """Stamp subsequent journal events with this control round."""
+    _journal_mod.set_round(round_)
+
+
+def serve_metrics(port: int = 0, registry: "MetricsRegistry | None" = None,
+                  host: str = "127.0.0.1"):
+    """Start the Prometheus scrape endpoint (``/metrics`` on a stdlib
+    http.server thread); returns a
+    :class:`~agentlib_mpc_tpu.telemetry.scrape.MetricsServer` —
+    ``.port`` for the bound port, ``.close()`` for clean shutdown."""
+    from agentlib_mpc_tpu.telemetry.scrape import (
+        serve_metrics as _serve,
+    )
+
+    return _serve(port=port, registry=registry, host=host)

@@ -1,0 +1,96 @@
+"""``python -m agentlib_mpc_tpu.telemetry`` — the flight-recorder CLI.
+
+Modes:
+
+* ``--incident JOURNAL [--around SEQ | --around round:N] [--window N]``
+  — reconstruct a causal incident report from a journal: markdown to
+  stdout, optionally a JSON bundle (``--json PATH``) with the windowed
+  events, injection→symptom→recovery chains and implicated correlation
+  keys. ``--metrics METRICS_JSONL`` embeds a metrics export next to the
+  timeline. Exit 1 when the journal holds no events (an empty incident
+  report is itself an incident).
+* ``--slo JOURNAL`` — recompute the per-tenant SLO report offline from
+  the journal's ``serve.round`` events (JSON to stdout): the auditor's
+  path to the same numbers ``ServingPlane.slo_report()`` serves live.
+
+No jax import in either mode — the CLI must run on a machine that has
+only the tape, not the fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m agentlib_mpc_tpu.telemetry",
+        description="flight-recorder incident / SLO tooling")
+    parser.add_argument("--incident", metavar="JOURNAL",
+                        help="build an incident report from a journal")
+    parser.add_argument("--slo", metavar="JOURNAL",
+                        help="recompute the SLO report offline from a "
+                             "journal's serve.round events")
+    parser.add_argument("--around", default=None,
+                        help="window anchor: a sequence number, or "
+                             "round:N (default: first fault event)")
+    parser.add_argument("--window", type=int, default=500,
+                        help="window half-width in sequence numbers "
+                             "(or rounds with --around round:N)")
+    parser.add_argument("--json", dest="json_out", default=None,
+                        help="also write the JSON incident bundle here")
+    parser.add_argument("--metrics", default=None,
+                        help="metrics JSONL export to embed in the "
+                             "bundle (bench.py --emit-metrics format)")
+    args = parser.parse_args(argv)
+
+    if args.slo:
+        from agentlib_mpc_tpu.telemetry.journal import read_events
+        from agentlib_mpc_tpu.telemetry.slo import slo_from_events
+
+        events = read_events(args.slo)
+        report = slo_from_events(events)
+        print(json.dumps(report, indent=1))
+        if not events:
+            print(f"no events in journal {args.slo}", file=sys.stderr)
+            return 1
+        return 0
+
+    if not args.incident:
+        parser.print_help()
+        return 2
+
+    from agentlib_mpc_tpu.telemetry.incident import (
+        build_incident,
+        render_markdown,
+        write_bundle,
+    )
+
+    metrics = None
+    if args.metrics:
+        # two formats in the wild: the registry's JSONL export (one
+        # family per line) and the indented single-document JSON the
+        # bench's --emit-metrics artifact is — accept both
+        with open(args.metrics, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            metrics = json.loads(text)
+        except ValueError:
+            metrics = [json.loads(line)
+                       for line in text.splitlines() if line.strip()]
+    report = build_incident(args.incident, around=args.around,
+                            window=args.window, metrics=metrics)
+    sys.stdout.write(render_markdown(report))
+    if args.json_out:
+        write_bundle(report, args.json_out)
+    if report["events_total"] == 0:
+        print(f"no events in journal {args.incident} — nothing to "
+              f"reconstruct", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

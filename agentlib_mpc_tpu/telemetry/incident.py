@@ -1,0 +1,326 @@
+"""Incident reconstruction: causal timelines from the flight recorder.
+
+``python -m agentlib_mpc_tpu.telemetry --incident <journal>`` turns a
+journal (:mod:`agentlib_mpc_tpu.telemetry.journal`) into the artifact an
+on-call engineer actually wants: a windowed event timeline (markdown +
+JSON bundle), the correlation keys implicated in it (tenants, buckets,
+devices, engine/schedule digests), and — when the journal carries chaos
+injections — the **injection → symptom → recovery chains** that join
+each injected fault to the failure it caused and the transition that
+healed it. Chaos runs thereby become a test of *observability*: the
+three ``bench.py --chaos-*`` benches assert their full injected
+schedule is reconstructible from the journal alone.
+
+Chain matching is typed, not fuzzy: each chaos rule kind names the
+event types that count as its symptom and its recovery
+(:data:`CHAIN_RULES`), and candidates must agree on the correlation
+keys both sides carry (same tenant, same device, same bucket). A chain
+with no observed symptom is reported ``contained`` when the rule is one
+the engine quarantine absorbs silently, ``incomplete`` otherwise —
+missing observability is a finding, not a formatting problem.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from agentlib_mpc_tpu.telemetry.journal import read_events
+
+#: event types that anchor an incident window when --around is omitted
+FAULT_EVENTS = (
+    "chaos.injected", "watchdog.condemned", "serve.stall",
+    "mesh.degrade", "serve.eviction", "checkpoint.rejected",
+    "certifier.refused",
+)
+
+#: chaos rule kind -> (symptom event types, recovery event types,
+#: containment). Symptom/recovery candidates must be correlation-
+#: compatible with the injection (shared tenant/device/bucket keys
+#: agree). ``contained=True`` marks rules the engine-level quarantine
+#: is EXPECTED to absorb without a fleet-visible symptom.
+CHAIN_RULES = {
+    "serve_nan_theta": (("admission.shed", "serve.eviction",
+                         "health.transition"),
+                        ("serve.readmission",), False),
+    "serve_nan_result": (("health.transition", "serve.eviction",
+                          "guard.transition"),
+                         ("serve.readmission", "guard.transition"),
+                         False),
+    "serve_stall": (("serve.stall",), ("serve.round",), False),
+    "serve_build_fail": (("cache.engine",), ("cache.engine",), False),
+    "mesh_stall": (("watchdog.condemned",), ("fleet.round",), False),
+    "mesh_device_hang": (("watchdog.condemned", "mesh.degrade"),
+                         ("mesh.readmit",), False),
+    "mesh_probe_dead": (("mesh.degrade",), ("mesh.readmit",), False),
+    "mesh_nan_theta": (("fleet.round",), ("fleet.round",), True),
+    "solver_fail": (("guard.transition",), ("guard.transition",), False),
+    "solver_nan": (("guard.transition",), ("guard.transition",), False),
+    "solver_huge": (("guard.transition",), ("guard.transition",), False),
+}
+
+#: correlation keys a symptom/recovery candidate must agree on with the
+#: injection WHEN both sides carry them
+_CORRELATION_KEYS = ("tenant", "bucket", "device", "axis")
+
+
+def _injection_keys(inj: dict) -> dict:
+    """Correlation keys of a ``chaos.injected`` event. The injector's
+    ``target`` string encodes them positionally (``tenant:roundN``,
+    ``deviceK:roundN``, ``devices[6, 7]``, ``roundN:[6]``) — parse, do
+    not guess. ``devices`` (device IDS, the space degrade/probe events
+    report their dead lists in) and ``device`` (a mesh POSITION from
+    NaN-storm targets — a different space, kept for scalar-key matches
+    only) are deliberately separate keys."""
+    out = {k: inj[k] for k in _CORRELATION_KEYS if k in inj}
+    target = str(inj.get("target") or "")
+    head = target.split(":", 1)[0]
+    rule = str(inj.get("rule") or "")
+    if rule.startswith("serve_nan") and head and "tenant" not in out:
+        out["tenant"] = head
+    m = re.fullmatch(r"(agents|scenarios|device)(\d+)", head)
+    if m:
+        out.setdefault("axis", m.group(1))
+        out.setdefault("device", int(m.group(2)))
+    # device-ID lists: "devices[6, 7]" (probe-dead notes) and
+    # "round4:[6]" (device-hang notes) carry the ACTUAL dead ids
+    m = re.search(r"\[([0-9,\s]+)\]", target)
+    if m:
+        out["devices"] = [int(x) for x in m.group(1).split(",")
+                          if x.strip()]
+    return out
+
+
+def _compatible(keys: dict, ev: dict) -> bool:
+    for k, v in keys.items():
+        if k == "devices":
+            # the injection names dead device IDS; a symptom/recovery
+            # that carries its own dead list must OVERLAP it — without
+            # this, two different devices' loss chains would claim each
+            # other's symptoms. Events with no device attribution (a
+            # condemned round is fleet-wide) stay compatible.
+            dead = ev.get("dead") or ev.get("dead_devices")
+            if isinstance(dead, (list, tuple)) and dead:
+                if not {str(d) for d in dead} & {str(d) for d in v}:
+                    return False
+            continue
+        if k in ev and str(ev[k]) != str(v):
+            return False
+    return True
+
+
+def _symptom_matches(rule: str, keys: dict, ev: dict) -> bool:
+    if not _compatible(keys, ev):
+        return False
+    if rule == "mesh_nan_theta":
+        # the quarantine containing the storm IS the symptom: a round
+        # that reports quarantined iterations
+        return bool(ev.get("quarantined"))
+    if ev.get("etype") == "health.transition":
+        return ev.get("state") in ("quarantined", "evicted")
+    if ev.get("etype") == "cache.engine" and rule == "serve_build_fail":
+        return ev.get("outcome") == "build_failed"
+    return True
+
+
+def _recovery_matches(rule: str, keys: dict, ev: dict,
+                      symptom: "dict | None") -> bool:
+    if not _compatible(keys, ev):
+        return False
+    et = ev.get("etype")
+    if et == "health.transition":
+        return ev.get("state") in ("probation", "healthy")
+    if et == "guard.transition":
+        return ev.get("level") == "mpc"
+    if et == "cache.engine":
+        return ev.get("outcome") in ("miss", "hit", "restored")
+    if et == "fleet.round":
+        # recovery = the first round COMPLETED after the symptom (for a
+        # contained storm: the first clean round after the poisoned one)
+        if rule == "mesh_nan_theta":
+            return not ev.get("quarantined")
+        return True
+    return True
+
+
+def build_chains(events: list) -> list:
+    """One chain record per ``chaos.injected`` event: the injection,
+    the first correlated symptom after it, the first correlated
+    recovery after the symptom, and a status (``complete`` /
+    ``contained`` / ``incomplete``)."""
+    chains = []
+    for inj in events:
+        if inj.get("etype") != "chaos.injected":
+            continue
+        rule = str(inj.get("rule") or "")
+        symptom_types, recovery_types, contained_ok = CHAIN_RULES.get(
+            rule, ((), (), False))
+        keys = _injection_keys(inj)
+        seq0 = int(inj.get("seq", 0))
+        symptom = next(
+            (e for e in events
+             if int(e.get("seq", 0)) > seq0
+             and e.get("etype") in symptom_types
+             and _symptom_matches(rule, keys, e)), None)
+        recovery = None
+        if symptom is not None:
+            seq1 = int(symptom.get("seq", 0))
+            recovery = next(
+                (e for e in events
+                 if int(e.get("seq", 0)) > seq1
+                 and e.get("etype") in recovery_types
+                 and _recovery_matches(rule, keys, e, symptom)), None)
+        status = ("complete" if symptom is not None
+                  and recovery is not None
+                  else "contained" if symptom is None and contained_ok
+                  else "incomplete")
+        chains.append({
+            "injection": inj,
+            "keys": keys,
+            "symptom": symptom,
+            "recovery": recovery,
+            "status": status,
+        })
+    return chains
+
+
+def _anchor_events(events: list, around: "str | int | None",
+                   window: int) -> list:
+    if not events:
+        return []
+    if around is None:
+        anchor = next((e for e in events
+                       if e.get("etype") in FAULT_EVENTS), events[0])
+        pivot = int(anchor.get("seq", 0))
+        by = "seq"
+    else:
+        text = str(around)
+        if text.startswith("round:"):
+            pivot, by = int(text.split(":", 1)[1]), "round"
+        else:
+            pivot, by = int(text), "seq"
+    if by == "round":
+        return [e for e in events
+                if e.get("round") is not None
+                and abs(int(e["round"]) - pivot) <= window]
+    return [e for e in events
+            if abs(int(e.get("seq", 0)) - pivot) <= window]
+
+
+def _implicated(events: list) -> dict:
+    """The correlation keys and certificate digests the window touches
+    — what an operator pivots on next."""
+    out: dict = {"tenants": set(), "buckets": set(), "devices": set(),
+                 "digests": set(), "chaos_seeds": set()}
+    for ev in events:
+        if "tenant" in ev:
+            out["tenants"].add(str(ev["tenant"]))
+        if "bucket" in ev:
+            out["buckets"].add(str(ev["bucket"]))
+        for key in ("dead", "dead_devices"):
+            val = ev.get(key)
+            if isinstance(val, (list, tuple)):
+                out["devices"].update(str(d) for d in val)
+        for key in ("collective_digest", "memory_digest", "digest"):
+            if ev.get(key):
+                out["digests"].add(str(ev[key]))
+        if ev.get("etype") == "chaos.injected" and "seed" in ev:
+            out["chaos_seeds"].add(int(ev["seed"]))
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def build_incident(journal_path_or_events,
+                   around: "str | int | None" = None,
+                   window: int = 500,
+                   metrics: "dict | None" = None) -> dict:
+    """The incident bundle: windowed timeline, chains, implicated keys,
+    journal-wide event counts, and (when supplied) a metrics snapshot.
+    ``journal_path_or_events`` is a journal path or a pre-read event
+    list; ``around`` anchors the window at a sequence number or
+    ``"round:N"`` (default: the first fault-class event)."""
+    if isinstance(journal_path_or_events, str):
+        events = read_events(journal_path_or_events)
+        source = journal_path_or_events
+    else:
+        events = list(journal_path_or_events)
+        source = None
+    windowed = _anchor_events(events, around, window)
+    counts: dict = {}
+    for ev in events:
+        et = str(ev.get("etype"))
+        counts[et] = counts.get(et, 0) + 1
+    chains = build_chains(events)
+    return {
+        "journal": source,
+        "events_total": len(events),
+        "events_by_type": dict(sorted(counts.items())),
+        "window": {"around": around, "size": window,
+                   "events": windowed},
+        "chains": chains,
+        "complete_chains": sum(1 for c in chains
+                               if c["status"] == "complete"),
+        "implicated": _implicated(windowed),
+        "metrics": metrics,
+    }
+
+
+def _fmt_event(ev: dict) -> str:
+    skip = {"seq", "t", "round", "etype"}
+    detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                       if k not in skip)
+    rnd = ev.get("round")
+    return (f"| {ev.get('seq', '?')} | "
+            f"{'-' if rnd is None else rnd} | "
+            f"`{ev.get('etype')}` | {detail or '—'} |")
+
+
+def render_markdown(report: dict) -> str:
+    """The human half of the bundle: a timeline table + one section per
+    causal chain — what the robustness runbooks now open with."""
+    lines = ["# Incident report", ""]
+    if report.get("journal"):
+        lines.append(f"Journal: `{report['journal']}` "
+                     f"({report['events_total']} events)")
+    lines += ["", "## Causal chains", ""]
+    chains = report.get("chains") or []
+    if not chains:
+        lines.append("No chaos injections recorded in this journal.")
+    for i, chain in enumerate(chains):
+        inj = chain["injection"]
+        lines.append(
+            f"### Chain {i + 1}: `{inj.get('rule')}` @ "
+            f"{inj.get('target')} (round {inj.get('round')}) — "
+            f"**{chain['status']}**")
+        lines.append(f"- injected: seq {inj.get('seq')} "
+                     f"(keys: {chain['keys'] or '—'})")
+        for role in ("symptom", "recovery"):
+            ev = chain.get(role)
+            if ev is None:
+                lines.append(f"- {role}: none observed")
+            else:
+                lines.append(
+                    f"- {role}: `{ev.get('etype')}` seq "
+                    f"{ev.get('seq')} round {ev.get('round')}")
+        lines.append("")
+    imp = report.get("implicated") or {}
+    lines += ["## Implicated", ""]
+    for key in ("tenants", "buckets", "devices", "digests",
+                "chaos_seeds"):
+        vals = imp.get(key) or []
+        if vals:
+            lines.append(f"- {key}: "
+                         + ", ".join(str(v) for v in vals))
+    lines += ["", "## Timeline", "",
+              "| seq | round | event | detail |",
+              "|---|---|---|---|"]
+    for ev in (report.get("window") or {}).get("events", []):
+        lines.append(_fmt_event(ev))
+    lines += ["", "## Event counts", ""]
+    for et, n in (report.get("events_by_type") or {}).items():
+        lines.append(f"- `{et}`: {n}")
+    return "\n".join(lines) + "\n"
+
+
+def write_bundle(report: dict, json_path: str) -> None:
+    with open(json_path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=1, default=str)

@@ -1,0 +1,91 @@
+"""Prometheus scrape endpoint: ``telemetry.serve_metrics(port)``.
+
+A stdlib ``http.server`` thread serving the registry's existing text
+exposition at ``/metrics`` (plus a ``/healthz`` liveness stub) — no new
+dependencies, clean shutdown, so the fleet benches and long-lived
+serving processes can run under a real scraper instead of exporting
+JSONL artifacts by hand. One thread, ThreadingHTTPServer semantics:
+each scrape renders a consistent snapshot under the registry lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsServer:
+    """Owns the listener thread; close() (or the context manager) shuts
+    it down cleanly. ``port=0`` binds an ephemeral port — read the real
+    one from ``.port``."""
+
+    def __init__(self, port: int = 0, registry=None,
+                 host: str = "127.0.0.1"):
+        if registry is None:
+            from agentlib_mpc_tpu.telemetry import registry as _reg
+
+            registry = _reg.DEFAULT
+        self.registry = registry
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = server.registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "3")
+                    self.end_headers()
+                    self.wfile.write(b"ok\n")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                logger.debug("metrics scrape: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="telemetry-metrics-server")
+        self._thread.start()
+        logger.info("serving /metrics on %s:%d", host, self.port)
+
+    @property
+    def port(self) -> int:
+        return int(self._httpd.server_address[1])
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/metrics"
+
+    def close(self) -> None:
+        """Stop the listener and join the thread (idempotent)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def serve_metrics(port: int = 0, registry=None,
+                  host: str = "127.0.0.1") -> MetricsServer:
+    """Start the scrape endpoint; returns the :class:`MetricsServer`
+    (``.port`` for the bound port, ``.close()`` for shutdown)."""
+    return MetricsServer(port=port, registry=registry, host=host)

@@ -124,6 +124,9 @@ class SpanRecorder:
         self._buf: list = [None] * capacity
         self._write = 0      # next slot
         self._count = 0      # total ever recorded
+        self._dropped = 0    # records evicted by the ring (ISSUE 15:
+        #                      the observability layer reports its own
+        #                      loss instead of overflowing silently)
         self._agg: dict[str, dict] = {}
         self._lock = threading.Lock()
 
@@ -135,17 +138,33 @@ class SpanRecorder:
     def total_recorded(self) -> int:
         return self._count
 
+    @property
+    def dropped(self) -> int:
+        """Individual span records lost to ring-buffer eviction (the
+        per-name aggregates are never dropped)."""
+        return self._dropped
+
     def record(self, rec: SpanRecord) -> None:
         with self._lock:
+            evicting = self._buf[self._write] is not None
             self._buf[self._write] = rec
             self._write = (self._write + 1) % self._capacity
             self._count += 1
+            if evicting:
+                self._dropped += 1
             agg = self._agg.setdefault(
                 rec.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
             agg["count"] += 1
             d = rec.duration or 0.0
             agg["total_s"] += d
             agg["max_s"] = max(agg["max_s"], d)
+        if evicting and _registry_mod.DEFAULT._enabled:
+            # outside the recorder lock (the registry has its own)
+            _registry_mod.DEFAULT.counter(
+                "telemetry_spans_dropped_total",
+                "span records evicted from the ring buffer before "
+                "export (aggregates survive; raise SpanRecorder "
+                "capacity if individual records matter)").inc()
 
     def spans(self) -> list[SpanRecord]:
         """Retained spans, oldest first (at most ``capacity``)."""
@@ -159,15 +178,23 @@ class SpanRecorder:
             self._buf = [None] * self._capacity
             self._write = 0
             self._count = 0
+            self._dropped = 0
             self._agg = {}
 
     def aggregate(self) -> dict:
         """name -> {count, total_s, max_s} over EVERY span ever recorded
         (running totals maintained at record time, immune to ring-buffer
         eviction) — the per-phase wall-clock breakdown
-        ``bench.py --emit-metrics`` emits."""
+        ``bench.py --emit-metrics`` emits. When ring eviction has
+        dropped individual records, a reserved ``"_dropped_spans"`` row
+        (same shape) reports the loss — the observability layer
+        accounts for its own blind spots."""
         with self._lock:
-            return {name: dict(agg) for name, agg in self._agg.items()}
+            out = {name: dict(agg) for name, agg in self._agg.items()}
+            if self._dropped:
+                out["_dropped_spans"] = {"count": self._dropped,
+                                         "total_s": 0.0, "max_s": 0.0}
+            return out
 
 
 #: the process-global recorder `span()` writes into

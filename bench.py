@@ -666,6 +666,30 @@ def _mesh_section() -> dict:
     return out
 
 
+def _emit_metrics_slo_report() -> dict:
+    """A real (tiny) serving plane's SLO report for the --emit-metrics
+    artifact: two tracker tenants, three served rounds — enough for the
+    availability/error-budget/burn-rate columns to carry live numbers
+    instead of a schema stub."""
+    from agentlib_mpc_tpu.lint.retrace_budget import (
+        serve_tenants,
+        tracker_ocp,
+        tracker_tenant_spec,
+    )
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+    from agentlib_mpc_tpu.serving import ServingPlane
+
+    ocp = tracker_ocp()
+    plane = ServingPlane(FusedADMMOptions(max_iterations=5, rho=2.0),
+                         slot_multiple=1, initial_capacity=2,
+                         pipelined=False, donate=False)
+    plane.join(tracker_tenant_spec(ocp, "slo-a", 1.0))
+    plane.join(tracker_tenant_spec(ocp, "slo-b", 2.0))
+    for _ in range(3):
+        serve_tenants(plane, "slo-a", "slo-b")
+    return plane.slo_report()
+
+
 def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     """``--emit-metrics PATH``: run the fused ADMM bench step with the
     full telemetry stack on (metrics registry + spans + JAX compile hooks)
@@ -697,6 +721,10 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
     telemetry.configure(enabled=True)
     telemetry.reset()
     enable_compile_profiling()
+    # flight recorder on for the run: the artifact embeds the journal's
+    # own volume accounting, and the journal file rides NEXT TO the
+    # metrics artifact (the incident CLI's input for this run)
+    telemetry.enable_journal(path + ".journal.jsonl")
 
     # the build (transcription, structure probes) compiles its own small
     # programs — give it its own span so those do not pollute the
@@ -859,6 +887,20 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
                 ocp.nlp, ocp.default_params(), ocp.n_w, plan)
     except Exception as exc:
         payload["eval_jac_cost"] = {"error": repr(exc)}
+    # SLO report (ISSUE 15): a tiny live serving plane's per-tenant
+    # availability/error-budget/burn-rate columns beside the
+    # certificate sections
+    try:
+        payload["slo_report"] = _emit_metrics_slo_report()
+    except Exception as exc:
+        payload["slo_report"] = {"error": repr(exc)}
+    # ... and the flight recorder's own volume accounting (events by
+    # type, bytes, rotations) — the observability layer reports itself
+    try:
+        j = telemetry.journal_active()
+        payload["journal"] = None if j is None else j.stats()
+    finally:
+        telemetry.disable_journal()
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1)
     summary = {
@@ -1405,6 +1447,81 @@ def run_serve(seed: int = 0, n_tenants: int = 8, rounds: int = 40) -> dict:
     return out
 
 
+def _bench_journal(tag: str):
+    """Arm the flight recorder for a chaos bench. ``CHAOS_JOURNAL``
+    names the file (kept afterwards — CI points the incident CLI at
+    it); otherwise a temp file is used and removed after the closing
+    assertion reads it back. Returns (path, tmp_dir_or_None)."""
+    import tempfile
+
+    from agentlib_mpc_tpu import telemetry
+
+    path = os.environ.get("CHAOS_JOURNAL")
+    tmp = None
+    if not path:
+        tmp = tempfile.mkdtemp(prefix=f"{tag}-journal-")
+        path = os.path.join(tmp, "journal.jsonl")
+    journal = telemetry.enable_journal(path)
+    # a pre-existing CHAOS_JOURNAL (a re-run onto the same tape —
+    # sequence numbers resume by design) must not leak the EARLIER
+    # run's injections into this run's closing assertion: remember
+    # where this run starts
+    return path, tmp, journal.stats()["last_seq"]
+
+
+def _bench_journal_close(path: str, tmp, chaos, base_seq: int = 0,
+                         min_complete_chains: int = 1):
+    """The chaos benches' CLOSING ASSERTION (ISSUE 15): chaos is a test
+    of observability, not just of survival. Asserts (a) the FULL
+    injected schedule is reconstructible from the journal alone —
+    every (rule, target) the controller injected appears as a
+    ``chaos.injected`` event with rule, target and round stamp — and
+    (b) the incident builder joins at least ``min_complete_chains``
+    injections to an observed symptom AND recovery. Returns
+    (journal_stats, incident_summary, events)."""
+    import shutil
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import journal as journal_mod
+    from agentlib_mpc_tpu.telemetry.incident import build_incident
+
+    active = telemetry.journal_active()
+    stats = active.stats() if active is not None else None
+    telemetry.disable_journal()
+    events = [e for e in journal_mod.read_events(path)
+              if int(e.get("seq", 0)) > int(base_seq)]
+    recorded = [e for e in events if e.get("etype") == "chaos.injected"]
+    injected = sorted((str(e.get("rule")), str(e.get("target")))
+                      for e in recorded)
+    ground = sorted((str(k), str(w)) for k, w in chaos.events)
+    assert injected == ground, (
+        f"injected chaos schedule is NOT reconstructible from the "
+        f"journal alone: journal={injected} controller={ground}")
+    for e in recorded:
+        assert e.get("rule") and e.get("target") is not None \
+            and e.get("round") is not None, (
+            f"chaos.injected event lacks rule/target/round: {e}")
+    incident = build_incident(events)
+    assert incident["complete_chains"] >= min_complete_chains, (
+        f"incident reconstruction joined only "
+        f"{incident['complete_chains']} injection→symptom→recovery "
+        f"chain(s), need >= {min_complete_chains}: "
+        f"{[(c['injection'].get('rule'), c['status']) for c in incident['chains']]}")
+    summary = {
+        "complete_chains": incident["complete_chains"],
+        "chains": [{"rule": c["injection"].get("rule"),
+                    "round": c["injection"].get("round"),
+                    "status": c["status"],
+                    "symptom": (c["symptom"] or {}).get("etype"),
+                    "recovery": (c["recovery"] or {}).get("etype")}
+                   for c in incident["chains"]],
+        "events_total": incident["events_total"],
+    }
+    if tmp:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return stats, summary, events
+
+
 def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
                     rounds: int = 24) -> dict:
     """``--chaos-serve SEED [n]``: survivability benchmark of the
@@ -1467,6 +1584,8 @@ def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
     telemetry.configure(enabled=True)
     telemetry.reset()
     enable_compile_profiling()
+    journal_path, journal_tmp, journal_base = _bench_journal(
+        "chaos-serve")
 
     import random as _random
 
@@ -1549,6 +1668,31 @@ def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
+    # closing assertions (ISSUE 15): the injected schedule must be
+    # reconstructible from the journal alone, the incident builder must
+    # join injection → symptom → recovery, and the SLO plane's
+    # availability must agree with the bench's own count — live AND
+    # recomputed offline from the journal — to within one round
+    journal_stats, incident, events = _bench_journal_close(
+        journal_path, journal_tmp, chaos, journal_base)
+    from agentlib_mpc_tpu.telemetry.slo import slo_from_events
+
+    availability = 100.0 * actuated / max(expected, 1)
+    slo_live = plane.slo_report()
+    slo_offline = slo_from_events(events)
+    live_avail = slo_live["fleet"]["availability_pct"]
+    off_avail = slo_offline["fleet"]["availability_pct"]
+    quantum = 100.0 * n_tenants / max(expected, 1)
+    assert live_avail is not None and \
+        abs(live_avail - availability) <= quantum + 1e-6, (
+        f"slo_report availability {live_avail}% disagrees with the "
+        f"bench's {availability:.3f}% beyond one round's quantization "
+        f"({quantum:.3f}%)")
+    assert off_avail is not None and \
+        abs(off_avail - live_avail) <= quantum + 1e-6, (
+        f"journal-recomputed availability {off_avail}% disagrees with "
+        f"the live report {live_avail}%")
+
     stats = plane.stats()
     platform = jax.devices()[0].platform
     metric = "serve_availability_pct" if platform == "tpu" \
@@ -1589,6 +1733,17 @@ def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
         "cache": stats["cache"],
         "chaos_events": {k: chaos.count(k)
                          for k in ("serve_nan_theta", "serve_stall")},
+        "slo": {
+            "availability_pct": live_avail,
+            "offline_availability_pct": off_avail,
+            "tenants_in_violation":
+                slo_live["fleet"]["tenants_in_violation"],
+            "victim_budget_remaining": (
+                slo_live["tenants"].get(victim) or
+                {}).get("error_budget_remaining"),
+        },
+        "journal": journal_stats,
+        "incident": incident,
         "platform": platform,
     }
     print(json.dumps(out))
@@ -1749,6 +1904,8 @@ def run_chaos_mesh(seed: int = 0, n_agents: int = 8,
         print(json.dumps(out))
         return out
     rng = _random.Random(f"bench-chaos-mesh:{seed}")
+    journal_path, journal_tmp, journal_base = _bench_journal(
+        "chaos-mesh")
 
     ocp = tracker_ocp()
     group = AgentGroup(name="chaos-mesh", ocp=ocp, n_agents=n_agents,
@@ -1806,6 +1963,11 @@ def run_chaos_mesh(seed: int = 0, n_agents: int = 8,
             continue
         (degraded_times if sup.degraded else full_times).append(dt)
     chaos.uninstall()
+    # closing assertion (ISSUE 15): schedule reconstructible from the
+    # journal alone + at least one injection→symptom→recovery chain
+    # (the device loss: hang → condemned/degrade → readmit)
+    journal_stats, incident, _events = _bench_journal_close(
+        journal_path, journal_tmp, chaos, journal_base)
 
     # cross-process restart MTTR: checkpoint a store-backed serving
     # plane here, restore it in a CHILD process (real process death —
@@ -1869,6 +2031,8 @@ def run_chaos_mesh(seed: int = 0, n_agents: int = 8,
         "chaos_events": {k: chaos.count(k) for k in (
             "mesh_nan_theta", "mesh_stall", "mesh_device_hang",
             "mesh_probe_dead")},
+        "journal": journal_stats,
+        "incident": incident,
         "platform": platform,
     }
     print(json.dumps(out))
@@ -1952,6 +2116,8 @@ def run_chaos_scenario(seed: int = 0, n_scenarios: int = 4,
         print(json.dumps(out))
         return out
     rng = _random.Random(f"bench-chaos-scenario:{seed}")
+    journal_path, journal_tmp, journal_base = _bench_journal(
+        "chaos-scenario")
 
     S = max(2, n_scenarios + (n_scenarios % 2))   # 2 columns divide S
     mesh = scenario_mesh(2)
@@ -2042,6 +2208,10 @@ def run_chaos_scenario(seed: int = 0, n_scenarios: int = 4,
         else:
             full_times.append(dt)
     chaos.uninstall()
+    # closing assertion (ISSUE 15): schedule reconstructible from the
+    # journal alone + the axis-classified loss chains joined
+    journal_stats, incident, _events = _bench_journal_close(
+        journal_path, journal_tmp, chaos, journal_base)
 
     def q(base: str, shape: tuple, degraded: bool = False) -> str:
         return _qualified_metric(base, platform, degraded=degraded,
@@ -2078,6 +2248,8 @@ def run_chaos_scenario(seed: int = 0, n_scenarios: int = 4,
         "chaos_events": {k: chaos.count(k) for k in (
             "mesh_nan_theta", "mesh_stall", "mesh_device_hang",
             "mesh_probe_dead")},
+        "journal": journal_stats,
+        "incident": incident,
         "platform": platform,
     }
     for shape, times in sorted(degraded_times.items()):

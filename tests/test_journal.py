@@ -1,0 +1,743 @@
+"""Flight recorder (ISSUE 15): journal durability, SLO accounting,
+incident reconstruction, and the scrape endpoint.
+
+The tentpole contracts:
+
+* the journal is append-only, crash-safe (a truncated tail line is
+  tolerated on replay, never fatal), rotates by size with no event
+  loss across the boundary, and its sequence numbers resume
+  monotonically across re-opens;
+* every chaos injection self-records with rule, target and round
+  stamp, and the incident builder joins injection → symptom →
+  recovery **from the journal alone** (no access to the chaos
+  schedule object);
+* ``ServingPlane.slo_report()`` equals the offline recompute from the
+  journal's ``serve.round`` events;
+* a seeded chaos-serve schedule journals identically on replay;
+* event ordering holds under the pipelined dispatcher.
+"""
+
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from agentlib_mpc_tpu import telemetry  # noqa: E402
+from agentlib_mpc_tpu.telemetry import journal as journal_mod  # noqa: E402
+from agentlib_mpc_tpu.telemetry.incident import (  # noqa: E402
+    build_chains,
+    build_incident,
+    render_markdown,
+)
+from agentlib_mpc_tpu.telemetry.slo import (  # noqa: E402
+    SLOPolicy,
+    SLOTracker,
+    slo_from_events,
+)
+
+
+@pytest.fixture(autouse=True)
+def _journal_isolation():
+    telemetry.disable_journal()
+    yield
+    telemetry.disable_journal()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+
+
+class TestJournalCore:
+    def test_sequence_round_stamps_and_stats(self, tmp_path):
+        j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+        j.set_round(7)
+        s1 = j.record("a.event", tenant="t1")
+        s2 = j.record("b.event", round=9)
+        s3 = j.record("a.event")
+        assert (s1, s2, s3) == (1, 2, 3)
+        events = j.read()
+        assert [e["seq"] for e in events] == [1, 2, 3]
+        assert events[0]["round"] == 7          # set_round stamp
+        assert events[1]["round"] == 9          # explicit override
+        assert events[0]["tenant"] == "t1"
+        assert all("t" in e for e in events)    # wall stamp
+        stats = j.stats()
+        assert stats["events"] == 3
+        assert stats["events_by_type"] == {"a.event": 2, "b.event": 1}
+        assert stats["rotations"] == 0
+        j.close()
+
+    def test_sequence_resumes_across_reopen(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path)
+        j.record("x")
+        j.record("x")
+        j.close()
+        j2 = journal_mod.Journal(path)          # a process restart
+        assert j2.record("y") == 3
+        assert [e["seq"] for e in journal_mod.read_events(path)] == \
+            [1, 2, 3]
+        j2.close()
+
+    def test_truncated_tail_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path)
+        for i in range(5):
+            j.record("ev", n=i)
+        j.close()
+        # crash mid-append: a torn, newline-less tail line
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"seq": 6, "etype": "torn')
+        events = journal_mod.read_events(path)
+        assert len(events) == 5                 # skipped, never fatal
+        assert [e["n"] for e in events] == list(range(5))
+        # ... and appending continues past it on reopen
+        j2 = journal_mod.Journal(path)
+        assert j2.record("ev", n=5) == 6
+        assert len(journal_mod.read_events(path)) == 6
+        j2.close()
+
+    def test_garbage_middle_line_skipped(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path)
+        j.record("keep", n=0)
+        j.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write("\x00\x01 not json at all\n")
+            fh.write(json.dumps({"seq": 2, "etype": "keep", "n": 1})
+                     + "\n")
+        assert [e["n"] for e in journal_mod.read_events(path)] == [0, 1]
+
+    def test_rotation_boundary_preserves_every_event(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path, max_bytes=1024)
+        # one "round" of events crossing several rotation boundaries
+        j.set_round(3)
+        for i in range(60):
+            j.record("round.event", n=i)
+        assert j.rotations >= 2
+        segs = journal_mod.journal_segments(path)
+        assert len(segs) == j.rotations + 1
+        events = journal_mod.read_events(path)
+        assert [e["n"] for e in events] == list(range(60))
+        assert [e["seq"] for e in events] == list(range(1, 61))
+        assert all(e["round"] == 3 for e in events)
+        j.close()
+
+    def test_restart_after_pruning_keeps_newest_segments(self, tmp_path):
+        """Rotation indices must resume past the MAX retained index —
+        resuming from the segment COUNT after pruning would hand out
+        indices below the retained ones, and the pruner would then
+        evict the NEWEST segments (the recent incident data) first."""
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path, max_bytes=1024, max_segments=2)
+        for i in range(200):
+            j.record("ev", n=i)
+        assert j.segments_dropped > 0        # low indices already gone
+        last_before = j.stats()["last_seq"]
+        j.close()
+        j2 = journal_mod.Journal(path, max_bytes=1024, max_segments=2)
+        for i in range(200, 400):
+            j2.record("ev", n=i)
+        assert j2.rotations > 0              # the restart rotated too
+        events = journal_mod.read_events(path)
+        seqs = [e["seq"] for e in events]
+        # the NEWEST events survive, contiguously up to the last seq —
+        # a count-based resume loses a recent window instead
+        assert seqs[-1] == last_before + 200
+        assert seqs == list(range(seqs[0], seqs[-1] + 1))
+        j2.close()
+
+    def test_write_failure_is_counted_never_raised(self, tmp_path):
+        """An emit site must not be able to crash the code path it
+        observes: a file closed under the journal (concurrent disable)
+        or a failing disk costs the event, not the serving round."""
+        j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+        j.record("ok")
+        j._fh.close()                        # simulate disable() racing
+        assert j.record("lost") > 0          # no exception
+        assert j.write_errors == 1
+        assert j.stats()["write_errors"] == 1
+
+    def test_max_segments_bounds_disk_and_counts_drops(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path, max_bytes=1024, max_segments=2)
+        for i in range(200):
+            j.record("ev", n=i)
+        assert j.segments_dropped > 0
+        rotated = [s for s in journal_mod.journal_segments(path)
+                   if s != path]
+        assert len(rotated) <= 2
+        # the SURVIVING tail is contiguous and ordered
+        events = journal_mod.read_events(path)
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == 200
+        assert j.stats()["segments_dropped"] == j.segments_dropped
+        j.close()
+
+    def test_global_record_is_noop_when_disabled(self):
+        assert telemetry.journal_event("nope") is None
+        assert telemetry.journal_active() is None
+
+    def test_global_enable_disable(self, tmp_path):
+        j = telemetry.enable_journal(str(tmp_path / "g.jsonl"))
+        assert telemetry.journal_active() is j
+        telemetry.journal_set_round(2)
+        assert telemetry.journal_event("hello") == 1
+        telemetry.disable_journal()
+        assert telemetry.journal_event("gone") is None
+        events = journal_mod.read_events(str(tmp_path / "g.jsonl"))
+        assert len(events) == 1 and events[0]["round"] == 2
+
+    def test_unserializable_field_stringified_not_fatal(self, tmp_path):
+        j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+        j.record("odd", payload=object())
+        events = j.read()
+        assert len(events) == 1
+        assert isinstance(events[0]["payload"], str)
+        j.close()
+
+    def test_reserved_stamps_cannot_be_overwritten(self, tmp_path):
+        """An emit site forwarding user labels must not be able to
+        corrupt the journal-owned seq/t stamps (replay sorts by seq)."""
+        j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+        j.record("ev", seq=999, t=-1.0, n=0)
+        j.record("ev", n=1)
+        events = j.read()
+        assert [e["seq"] for e in events] == [1, 2]
+        assert all(e["t"] > 0 for e in events)
+        j.close()
+
+    def test_guard_labels_cannot_crash_the_emit(self, tmp_path):
+        """ActuationGuard labels are free-form caller data: a label
+        colliding with the transition fields (or journal stamps) must
+        neither raise inside assess() nor overwrite them."""
+        from agentlib_mpc_tpu.resilience.guard import ActuationGuard
+
+        telemetry.enable_journal(str(tmp_path / "g.jsonl"))
+        guard = ActuationGuard(level="shadow", etype="shadow",
+                               tenant="t1")
+        bad = {"u0": {"u": float("nan")}, "stats": {"success": False}}
+        for _ in range(6):
+            guard.assess(bad)               # walks the whole ladder
+        telemetry.disable_journal()
+        events = journal_mod.read_events(str(tmp_path / "g.jsonl"))
+        moves = [e for e in events if e["etype"] == "guard.transition"]
+        assert moves, "ladder moves were not journaled"
+        # the transition field won, the colliding label did not
+        assert all(e["level"] != "shadow" for e in moves)
+        assert all(e["tenant"] == "t1" for e in moves)
+
+
+class TestSLOTracker:
+    def test_availability_and_error_budget(self):
+        t = SLOTracker(SLOPolicy(availability_target=0.9,
+                                 windows=(2, 4)))
+        for r in range(4):
+            t.record_result("a", "actuate")
+            t.record_result("b", "actuate" if r < 2 else "hold")
+            t.tick_round(r)
+        rep = t.report()
+        assert rep["tenants"]["a"]["availability_pct"] == 100.0
+        assert rep["tenants"]["a"]["slo_met"] is True
+        assert rep["tenants"]["a"]["error_budget_remaining"] == 1.0
+        b = rep["tenants"]["b"]
+        assert b["availability_pct"] == 50.0
+        assert b["slo_met"] is False
+        # budget: 4 delivered * 10% = 0.4 allowed, 2 consumed -> -4
+        assert b["error_budget_remaining"] == pytest.approx(-4.0)
+        # fast window (2 rounds): all misses -> burn 1/(0.1) = 10
+        assert b["windows"]["2"]["burn_rate"] == pytest.approx(10.0)
+        assert b["windows"]["2"]["availability_pct"] == 0.0
+        # slow window (4 rounds): half missed -> burn 5
+        assert b["windows"]["4"]["burn_rate"] == pytest.approx(5.0)
+        assert rep["fleet"]["tenants_in_violation"] == 1
+
+    def test_deadline_accounting(self):
+        t = SLOTracker()
+        t.record_result("a", "hold", deadline_missed=True)
+        t.record_result("a", "actuate")
+        t.tick_round(0)
+        rep = t.report()
+        assert rep["tenants"]["a"]["deadline_hit_pct"] == 50.0
+        assert rep["fleet"]["deadline_missed"] == 1
+
+    def test_offline_recompute_matches_online(self):
+        t = SLOTracker(SLOPolicy(windows=(2, 3)))
+        events = []
+        script = [
+            {"a": ("actuate",), "b": ("actuate", "hold")},
+            {"a": ("hold",)},
+            {},
+            {"a": ("actuate",), "b": ("fallback",)},
+        ]
+        for r, deliveries in enumerate(script):
+            for tid, actions in deliveries.items():
+                for action in actions:
+                    t.record_result(tid, action)
+            tally = t.tick_round(r)
+            events.append({"etype": "serve.round", "seq": r + 1,
+                           "round": r, "tally": tally})
+        online = t.report()
+        offline = slo_from_events(events, SLOPolicy(windows=(2, 3)))
+        assert offline == online
+
+    def test_offline_recompute_reads_policy_from_tape(self):
+        """The plane journals its SLO policy once; an auditor with only
+        the tape must recompute against the SAME targets and windows —
+        a hard-coded default would report different violations."""
+        events = [
+            {"etype": "slo.policy", "seq": 1, "round": 0,
+             "availability_target": 0.5, "deadline_target": 0.9,
+             "windows": [2]},
+            # 3/4 actuated: meets a 50% target, violates the default 99%
+            {"etype": "serve.round", "seq": 2, "round": 0,
+             "tally": {"a": [4, 3, 0]}},
+        ]
+        rep = slo_from_events(events)
+        assert rep["policy"]["availability_target"] == 0.5
+        assert rep["policy"]["windows"] == [2]
+        assert rep["tenants"]["a"]["slo_met"] is True
+        # the same tape WITHOUT the stamp falls back to the default
+        rep_default = slo_from_events([events[1]])
+        assert rep_default["tenants"]["a"]["slo_met"] is False
+        # an explicit policy still overrides the stamp
+        rep_forced = slo_from_events(events, SLOPolicy(
+            availability_target=0.9))
+        assert rep_forced["tenants"]["a"]["slo_met"] is False
+
+    def test_snapshot_restore_roundtrip(self):
+        t = SLOTracker(SLOPolicy(windows=(2,)))
+        t.record_result("a", "actuate")
+        t.record_result("a", "hold")
+        t.tick_round(0)
+        t2 = SLOTracker(SLOPolicy(windows=(2,)))
+        t2.restore(t.snapshot())
+        assert t2.report() == t.report()
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="targets"):
+            SLOPolicy(availability_target=1.5)
+        with pytest.raises(ValueError, match="windows"):
+            SLOPolicy(windows=())
+        with pytest.raises(ValueError, match="unknown slo"):
+            SLOPolicy.from_config({"nope": 1})
+
+
+def _ev(seq, etype, round_=0, **fields):
+    return dict({"seq": seq, "t": 0.0, "round": round_,
+                 "etype": etype}, **fields)
+
+
+class TestIncident:
+    def test_chain_joins_injection_symptom_recovery(self):
+        events = [
+            _ev(1, "serve.round", 0, tally={}),
+            _ev(2, "chaos.injected", 1, rule="serve_nan_theta",
+                target="t001:round1", seed=3),
+            _ev(3, "admission.shed", 1, tenant="t001",
+                reason="nonfinite_theta", action="replay"),
+            _ev(4, "admission.shed", 1, tenant="t999",
+                reason="shed_overload", action="hold"),
+            _ev(5, "serve.eviction", 2, tenant="t001",
+                bucket="b1", reason="health"),
+            _ev(6, "serve.readmission", 5, tenant="t001", bucket="b1"),
+        ]
+        chains = build_chains(events)
+        assert len(chains) == 1
+        chain = chains[0]
+        assert chain["status"] == "complete"
+        # the symptom is the VICTIM's shed, not another tenant's
+        assert chain["symptom"]["seq"] == 3
+        assert chain["recovery"]["seq"] == 6
+        assert chain["keys"]["tenant"] == "t001"
+
+    def test_chain_without_recovery_is_incomplete(self):
+        events = [
+            _ev(1, "chaos.injected", 0, rule="serve_nan_theta",
+                target="t1:round0"),
+            _ev(2, "admission.shed", 0, tenant="t1",
+                reason="nonfinite_theta"),
+        ]
+        assert build_chains(events)[0]["status"] == "incomplete"
+
+    def test_contained_storm_status(self):
+        # a NaN storm the quarantine absorbs never shows a symptom —
+        # reported "contained", which is itself an observability verdict
+        events = [_ev(1, "chaos.injected", 0, rule="mesh_nan_theta",
+                      target="device1:round0")]
+        assert build_chains(events)[0]["status"] == "contained"
+        # ... but quarantine attribution in a fleet round IS the
+        # symptom, and the first clean round after it the recovery
+        events += [
+            _ev(2, "fleet.round", 0, degraded=False, devices=8,
+                quarantined=12),
+            _ev(3, "fleet.round", 1, degraded=False, devices=8,
+                quarantined=0),
+        ]
+        chain = build_chains(events)[0]
+        assert chain["status"] == "complete"
+        assert chain["symptom"]["seq"] == 2
+        assert chain["recovery"]["seq"] == 3
+
+    def test_mesh_loss_chain(self):
+        events = [
+            _ev(1, "chaos.injected", 2, rule="mesh_device_hang",
+                target="round2:[6]"),
+            _ev(2, "watchdog.condemned", 2, scope="mesh",
+                outcome="timeout", budget_s=10.0),
+            _ev(3, "mesh.degrade", 2, axis="agents", dead=[6],
+                devices_from=8, devices_to=7),
+            _ev(4, "fleet.round", 2, degraded=True, devices=7),
+            _ev(5, "mesh.readmit", 5, devices=8),
+        ]
+        chain = build_chains(events)[0]
+        assert chain["status"] == "complete"
+        assert chain["symptom"]["etype"] == "watchdog.condemned"
+        assert chain["recovery"]["etype"] == "mesh.readmit"
+
+    def test_two_device_chains_do_not_cross_claim(self):
+        """Device correlation is real, not decorative: the chain for
+        device 6's loss must not claim device 3's degrade/readmit."""
+        events = [
+            _ev(1, "chaos.injected", 2, rule="mesh_probe_dead",
+                target="devices[6]"),
+            _ev(2, "mesh.degrade", 2, axis="agents", dead=[3],
+                devices_from=8, devices_to=7),
+            _ev(3, "mesh.readmit", 3, devices=8),
+            _ev(4, "mesh.degrade", 4, axis="agents", dead=[6],
+                devices_from=8, devices_to=7),
+            _ev(5, "mesh.readmit", 6, devices=8),
+        ]
+        chain = build_chains(events)[0]
+        assert chain["keys"]["devices"] == [6]
+        assert chain["status"] == "complete"
+        assert chain["symptom"]["seq"] == 4     # dead=[6], not dead=[3]
+        assert chain["recovery"]["seq"] == 5
+
+    def test_incident_window_and_anchor(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path)
+        for i in range(20):
+            j.record("noise", n=i, round=i)
+        j.record("serve.eviction", tenant="t1", bucket="b", round=20)
+        for i in range(5):
+            j.record("noise", n=100 + i, round=21 + i)
+        j.close()
+        rep = build_incident(path, window=3)
+        # anchored at the fault event without --around
+        seqs = [e["seq"] for e in rep["window"]["events"]]
+        assert 21 in seqs and len(seqs) == 7
+        assert rep["implicated"]["tenants"] == ["t1"]
+        rep2 = build_incident(path, around="round:2", window=1)
+        assert {e["round"] for e in rep2["window"]["events"]} == \
+            {1, 2, 3}
+
+    def test_markdown_render(self):
+        events = [
+            _ev(1, "chaos.injected", 0, rule="serve_nan_theta",
+                target="t1:round0"),
+            _ev(2, "admission.shed", 0, tenant="t1",
+                reason="nonfinite_theta"),
+            _ev(3, "serve.readmission", 4, tenant="t1", bucket="b"),
+        ]
+        md = render_markdown(build_incident(events))
+        assert "## Causal chains" in md
+        assert "`serve_nan_theta`" in md and "complete" in md
+        assert "| seq | round | event | detail |" in md
+
+    def test_cli_incident_and_slo(self, tmp_path, capsys):
+        from agentlib_mpc_tpu.telemetry.__main__ import main
+
+        path = str(tmp_path / "j.jsonl")
+        j = journal_mod.Journal(path)
+        j.record("chaos.injected", rule="serve_stall", target="call3",
+                 round=3)
+        j.record("serve.stall", bucket="b", round=3)
+        j.record("serve.round", round=4,
+                 tally={"t1": [1, 1, 0]})
+        j.close()
+        bundle = str(tmp_path / "bundle.json")
+        rc = main(["--incident", path, "--json", bundle])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "# Incident report" in out
+        with open(bundle) as fh:
+            rep = json.load(fh)
+        assert rep["complete_chains"] == 1
+        rc = main(["--slo", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out)["fleet"]["availability_pct"] == 100.0
+
+    def test_cli_empty_journal_is_nonzero(self, tmp_path, capsys):
+        from agentlib_mpc_tpu.telemetry.__main__ import main
+
+        path = str(tmp_path / "empty.jsonl")
+        open(path, "w").close()
+        assert main(["--incident", path]) == 1
+        capsys.readouterr()
+
+
+class TestScrapeEndpoint:
+    def test_serves_prometheus_text_and_shuts_down(self):
+        telemetry.counter("scrape_test_total",
+                          "endpoint test counter").inc(kind="x")
+        with telemetry.serve_metrics(port=0) as server:
+            assert server.port > 0
+            body = urllib.request.urlopen(server.url, timeout=5).read()
+            text = body.decode()
+            assert "# TYPE scrape_test_total counter" in text
+            assert 'scrape_test_total{kind="x"} 1' in text
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz",
+                timeout=5).read()
+            assert health == b"ok\n"
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5)
+        # clean shutdown: the port no longer answers
+        with pytest.raises(Exception):
+            urllib.request.urlopen(server.url, timeout=1)
+
+
+# -- serving-plane integration (jax; tracker workload) ------------------------
+
+
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp  # noqa: E402
+from agentlib_mpc_tpu.ops.solver import SolverOptions  # noqa: E402
+from agentlib_mpc_tpu.parallel.fused_admm import (  # noqa: E402
+    FusedADMMOptions,
+)
+from agentlib_mpc_tpu.serving import (  # noqa: E402
+    HealthPolicy,
+    ServingPlane,
+    TenantSpec,
+)
+
+ADMM_OPTS = FusedADMMOptions(max_iterations=4, rho=2.0)
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    """One compile cache for every plane in this module — each test's
+    plane acquisition is then a hit, not a 10 s cold build."""
+    from agentlib_mpc_tpu.serving.cache import CompileCache
+
+    return CompileCache()
+
+
+def make_spec(ocp, tid, a):
+    return TenantSpec(
+        tenant_id=tid, ocp=ocp,
+        theta=ocp.default_params(p=jnp.array([float(a)])),
+        couplings={"shared_u": "u"},
+        solver_options=SolverOptions(max_iter=25))
+
+
+def make_plane(ocp, cache, n=2, **kw):
+    kw.setdefault("pipelined", False)
+    kw.setdefault("donate", False)
+    return ServingPlane(ADMM_OPTS, slot_multiple=1,
+                        initial_capacity=n, cache=cache, **kw)
+
+
+class TestServingFlightRecorder:
+    def test_serve_rounds_journal_and_slo_parity(self, ocp,
+                                                 shared_cache,
+                                                 tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        telemetry.enable_journal(path)
+        plane = make_plane(ocp, shared_cache)
+        plane.join(make_spec(ocp, "a", 1.0))
+        plane.join(make_spec(ocp, "b", 2.0))
+        for _ in range(3):
+            plane.submit("a")
+            plane.submit("b")
+            plane.serve_round()
+        live = plane.slo_report()
+        telemetry.disable_journal()
+        events = journal_mod.read_events(path)
+        rounds = [e for e in events if e["etype"] == "serve.round"]
+        assert [e["round"] for e in rounds] == [0, 1, 2]
+        assert live["fleet"]["availability_pct"] == 100.0
+        assert live["tenants"]["a"]["slo_met"] is True
+        # the offline recompute from the journal IS the live report
+        assert slo_from_events(events) == live
+        # engine acquisition events landed with bucket digests
+        cache_evs = [e for e in events if e["etype"] == "cache.engine"]
+        assert cache_evs and all(e.get("bucket") for e in cache_evs)
+        # a departed tenant's SLO history is KEPT (error budgets are an
+        # accounting record), so live == offline survives churn
+        plane.leave("b")
+        after = plane.slo_report()
+        assert "b" in after["tenants"]
+        assert after["tenants"]["b"]["delivered"] == 3
+        assert slo_from_events(events)["fleet"] == after["fleet"]
+
+    def test_chaos_serve_chain_from_journal_alone(self, ocp,
+                                                  shared_cache,
+                                                  tmp_path):
+        """The ISSUE 15 acceptance shape at test scale: a seeded NaN
+        storm, then the chain asserted from the journal ALONE — the
+        chaos schedule object is used only to install the fault."""
+        from agentlib_mpc_tpu.resilience.chaos import (
+            ServeChaosConfig,
+            ServeNaNStormRule,
+            install_serving_chaos,
+        )
+
+        path = str(tmp_path / "chaos.jsonl")
+        telemetry.enable_journal(path)
+        plane = make_plane(
+            ocp, shared_cache,
+            health_policy=HealthPolicy(quarantine_after=1,
+                                       evict_after=1, readmit_after=2,
+                                       probation_rounds=1))
+        plane.join(make_spec(ocp, "a", 1.0))
+        plane.join(make_spec(ocp, "victim", 2.0))
+        chaos = install_serving_chaos(plane, ServeChaosConfig(
+            nan_storm=(ServeNaNStormRule(tenant="victim",
+                                         start_round=1, n_rounds=2),),
+        ), seed=11)
+        for _ in range(8):
+            plane.submit("a")
+            plane.submit("victim")
+            plane.serve_round()
+        chaos.uninstall()
+        telemetry.disable_journal()
+
+        # -- from here on: the journal alone -----------------------------
+        events = journal_mod.read_events(path)
+        injected = [e for e in events
+                    if e["etype"] == "chaos.injected"]
+        assert injected, "chaos did not self-record"
+        for e in injected:
+            assert e["rule"] == "serve_nan_theta"
+            assert str(e["target"]).startswith("victim")
+            assert e["round"] is not None
+        rep = build_incident(events)
+        complete = [c for c in rep["chains"]
+                    if c["status"] == "complete"]
+        assert complete, rep["chains"]
+        chain = complete[0]
+        assert chain["symptom"]["etype"] in ("admission.shed",
+                                             "serve.eviction",
+                                             "health.transition")
+        assert chain["symptom"].get("tenant") == "victim"
+        assert chain["recovery"]["etype"] == "serve.readmission"
+        assert chain["recovery"]["tenant"] == "victim"
+        # the eviction and readmission themselves are on the tape
+        etypes = {e["etype"] for e in events}
+        assert {"serve.eviction", "serve.readmission",
+                "health.transition"} <= etypes
+        # the victim's budget burned; the healthy peer's did not
+        offline = slo_from_events(events)
+        assert offline["tenants"]["victim"]["availability_pct"] < 100.0
+        assert offline["tenants"]["a"]["availability_pct"] == 100.0
+
+    def test_deterministic_replay_of_seeded_schedule(self, ocp,
+                                                     shared_cache,
+                                                     tmp_path):
+        """Same seed → the journal records the identical injected
+        schedule (rule, target, round), run to run — the chaos
+        reproducibility contract extended to the flight recorder."""
+        from agentlib_mpc_tpu.resilience.chaos import (
+            ServeChaosConfig,
+            ServeNaNStormRule,
+            ServeStallRule,
+            install_serving_chaos,
+        )
+        import random as _random
+
+        def run(tag: str, seed: int):
+            rng = _random.Random(f"bench-chaos-serve:{seed}")
+            start = rng.randrange(1, 3)
+            n = rng.randrange(2, 4)
+            path = str(tmp_path / f"{tag}.jsonl")
+            telemetry.enable_journal(path)
+            plane = make_plane(ocp, shared_cache,
+                               watchdog_timeout_s=5.0)
+            plane.join(make_spec(ocp, "a", 1.0))
+            plane.join(make_spec(ocp, "b", 2.0))
+            chaos = install_serving_chaos(plane, ServeChaosConfig(
+                nan_storm=(ServeNaNStormRule(tenant="b",
+                                             start_round=start,
+                                             n_rounds=n),),
+                stall=(ServeStallRule(call=start + n,
+                                      duration_s=8.0),),
+            ), seed=seed)
+            for _ in range(7):
+                plane.submit("a")
+                plane.submit("b")
+                plane.serve_round()
+            chaos.uninstall()
+            telemetry.disable_journal()
+            return [(e["rule"], e["target"], e["round"])
+                    for e in journal_mod.read_events(path)
+                    if e["etype"] == "chaos.injected"]
+
+        first = run("r1", seed=5)
+        second = run("r2", seed=5)
+        assert first and first == second
+
+    def test_event_ordering_under_pipelined_dispatcher(self, ocp,
+                                                       shared_cache,
+                                                       tmp_path):
+        path = str(tmp_path / "pipe.jsonl")
+        telemetry.enable_journal(path)
+        plane = make_plane(ocp, shared_cache, pipelined=True,
+                           donate=False)
+        plane.join(make_spec(ocp, "a", 1.0))
+        plane.join(make_spec(ocp, "b", 2.0))
+        for _ in range(4):
+            plane.submit("a")
+            plane.submit("b")
+            plane.serve_round()
+        plane.flush()
+        telemetry.disable_journal()
+        events = journal_mod.read_events(path)
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        rounds = [e for e in events if e["etype"] == "serve.round"]
+        # one serve.round per call, in order, even though the pipeline
+        # delivers round k's results during round k+1
+        assert [e["round"] for e in rounds] == [0, 1, 2, 3]
+        # pipelining defers delivery: round 0 closes with no results,
+        # and every delivered verdict still lands in exactly one tally
+        assert rounds[0]["tally"] == {}
+        delivered = sum(t[0] for e in rounds
+                        for t in (e["tally"] or {}).values())
+        assert delivered == 6    # 8 submitted, 2 still in tally of flush
+
+    def test_checkpoint_slo_continuity(self, ocp, shared_cache,
+                                       tmp_path):
+        """A crash/restore must not reset error budgets: the restored
+        plane's report continues the saved one (the bench's one-round
+        quantization bound comes from exactly this seam)."""
+        plane = make_plane(ocp, shared_cache)
+        plane.join(make_spec(ocp, "a", 1.0))
+        for _ in range(2):
+            plane.submit("a")
+            plane.serve_round()
+        before = plane.slo_report()
+        assert before["tenants"]["a"]["delivered"] == 2
+        ckpt = str(tmp_path / "plane-ckpt")
+        plane.save_checkpoint(ckpt)
+        plane2 = make_plane(ocp, shared_cache)
+        plane2.restore_checkpoint(ckpt, {"a": make_spec(ocp, "a", 1.0)})
+        after = plane2.slo_report()
+        assert after["tenants"]["a"]["delivered"] == 2
+        assert after["rounds"] == before["rounds"]
+        plane2.submit("a")
+        plane2.serve_round()
+        assert plane2.slo_report()["tenants"]["a"]["delivered"] == 3

@@ -115,12 +115,18 @@ class TestCollectiveWatchdog:
 
 
 class TestShardLossAcceptance:
-    def test_kill_one_shard_mid_run(self, rig):
+    def test_kill_one_shard_mid_run(self, rig, tmp_path):
         """The ISSUE 10 acceptance row: kill one shard of the
         8-virtual-device fused fleet mid-run. Surviving agents' controls
         stay finite and bounded, the fleet completes the round on the
         degraded mesh, and re-admission restores full-mesh consensus
-        BITWISE vs an uninterrupted engine stepping the same state."""
+        BITWISE vs an uninterrupted engine stepping the same state.
+
+        ISSUE 15 rides the same run: the flight recorder is on, and the
+        injection → condemnation/degrade → readmit chain is asserted
+        afterwards FROM THE JOURNAL ALONE (the chaos object is used
+        only to install the fault, never to assert)."""
+        from agentlib_mpc_tpu import telemetry
         from agentlib_mpc_tpu.resilience.chaos import (
             MeshChaosConfig,
             MeshDeviceLossRule,
@@ -129,6 +135,8 @@ class TestShardLossAcceptance:
 
         sup, _ref, thetas = rig
         victim = 6
+        journal_path = str(tmp_path / "mesh.jsonl")
+        telemetry.enable_journal(journal_path)
         chaos = install_mesh_chaos(sup, MeshChaosConfig(
             device_loss=(MeshDeviceLossRule(
                 device_index=victim, die_at_round=1, revive_at_round=3),),
@@ -161,6 +169,30 @@ class TestShardLossAcceptance:
                 layout.engine.watchdog_timeout_s = 60.0
             sup.watchdog_timeout_s = 60.0
             chaos.uninstall()
+            telemetry.disable_journal()
+        # -- flight-recorder leg: the journal ALONE ----------------------
+        from agentlib_mpc_tpu.telemetry import journal as journal_mod
+        from agentlib_mpc_tpu.telemetry.incident import build_incident
+
+        events = journal_mod.read_events(journal_path)
+        injected = [e for e in events
+                    if e["etype"] == "chaos.injected"]
+        assert injected, "chaos did not self-record into the journal"
+        assert all(e.get("rule") and e.get("target") is not None
+                   and e.get("round") is not None for e in injected)
+        assert {"watchdog.condemned", "mesh.degrade",
+                "mesh.readmit", "fleet.round"} <= \
+            {e["etype"] for e in events}
+        rep = build_incident(events)
+        loss_chains = [
+            c for c in rep["chains"]
+            if c["injection"]["rule"] in ("mesh_device_hang",
+                                          "mesh_probe_dead")
+            and c["status"] == "complete"]
+        assert loss_chains, rep["chains"]
+        assert loss_chains[0]["symptom"]["etype"] in (
+            "watchdog.condemned", "mesh.degrade")
+        assert loss_chains[0]["recovery"]["etype"] == "mesh.readmit"
         # bitwise: an INDEPENDENT, never-interrupted full-mesh engine
         # (same structure, same mesh => same deterministic executable)
         # stepping the same post-recovery state reproduces the

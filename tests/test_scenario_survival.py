@@ -85,7 +85,8 @@ def rig(group, tree, ocp, eight_devices):
 
 
 class TestScenarioAxisAcceptance:
-    def test_kill_scenario_column_mid_run(self, rig, group, tree, ocp):
+    def test_kill_scenario_column_mid_run(self, rig, group, tree, ocp,
+                                          tmp_path):
         """The ISSUE 14 acceptance row, scenarios axis: kill one
         scenarios-axis device mid-run on the 8-virtual-device 4×2
         grid. Survivors stay finite, the degraded round completes with
@@ -93,7 +94,12 @@ class TestScenarioAxisAcceptance:
         group-identical, no stale-probability bias vs an independent
         never-interrupted reference fleet built at the reduced
         scenario count), revival re-admits, and post-recovery
-        consensus is BITWISE vs an uninterrupted 2-D engine."""
+        consensus is BITWISE vs an uninterrupted 2-D engine.
+
+        ISSUE 15 rides along: the flight recorder is on, and the
+        scenarios-axis loss chain is asserted afterwards from the
+        journal ALONE (chaos is install-only)."""
+        from agentlib_mpc_tpu import telemetry
         from agentlib_mpc_tpu.resilience.chaos import (
             MeshChaosConfig,
             MeshDeviceLossRule,
@@ -101,6 +107,8 @@ class TestScenarioAxisAcceptance:
         )
 
         sup, thetas = rig
+        journal_path = str(tmp_path / "scen.jsonl")
+        telemetry.enable_journal(journal_path)
         # column 1 hosts base branches 2 and 3 (spd = 2)
         chaos = install_mesh_chaos(sup, MeshChaosConfig(
             device_loss=(MeshDeviceLossRule(
@@ -165,6 +173,29 @@ class TestScenarioAxisAcceptance:
                 lay.fleet.watchdog_timeout_s = 60.0
             sup.watchdog_timeout_s = 60.0
             chaos.uninstall()
+            telemetry.disable_journal()
+        # -- flight-recorder leg: the journal ALONE ----------------------
+        from agentlib_mpc_tpu.telemetry import journal as journal_mod
+        from agentlib_mpc_tpu.telemetry.incident import build_incident
+
+        events = journal_mod.read_events(journal_path)
+        injected = [e for e in events
+                    if e["etype"] == "chaos.injected"]
+        assert injected and all(
+            e.get("rule") and e.get("target") is not None
+            and e.get("round") is not None for e in injected)
+        degrades = [e for e in events if e["etype"] == "mesh.degrade"]
+        assert degrades and degrades[0]["axis"] == "scenarios"
+        assert degrades[0]["dead_branches"] == [2, 3]
+        assert degrades[0]["shape_to"] == [4, 1]
+        rep = build_incident(events)
+        loss_chains = [
+            c for c in rep["chains"]
+            if c["injection"]["rule"] in ("mesh_device_hang",
+                                          "mesh_probe_dead")
+            and c["status"] == "complete"]
+        assert loss_chains, rep["chains"]
+        assert loss_chains[0]["recovery"]["etype"] == "mesh.readmit"
         # post-recovery BITWISE: an independent, never-interrupted
         # full-grid engine stepping the same recovered state
         # reproduces the consensus exactly — re-admission restored

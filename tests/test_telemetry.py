@@ -185,6 +185,8 @@ class TestSpans:
 
     def test_ring_buffer_overflow(self):
         rec = SpanRecorder(capacity=4)
+        dropped0 = telemetry.metrics().counter(
+            "telemetry_spans_dropped_total").total()
         for i in range(10):
             with telemetry.span(f"s{i}") as sp:
                 pass
@@ -194,8 +196,25 @@ class TestSpans:
         assert [s.name for s in rec.spans()] == ["s6", "s7", "s8", "s9"]
         # ...but the running aggregates survive eviction
         agg = rec.aggregate()
-        assert set(agg) == {f"s{i}" for i in range(10)}
+        assert set(agg) == {f"s{i}" for i in range(10)} | {
+            "_dropped_spans"}
         assert agg["s0"]["count"] == 1 and agg["s9"]["count"] == 1
+        # the overflow is ACCOUNTED, not silent (ISSUE 15 satellite):
+        # 10 records through 4 slots evict 6, reported both on the
+        # recorder and in the metric the dashboards scrape
+        assert rec.dropped == 6
+        assert agg["_dropped_spans"]["count"] == 6
+        assert telemetry.metrics().counter(
+            "telemetry_spans_dropped_total").total() - dropped0 == 6
+
+    def test_ring_buffer_within_capacity_reports_no_drops(self):
+        rec = SpanRecorder(capacity=16)
+        for i in range(5):
+            with telemetry.span(f"k{i}") as sp:
+                pass
+            rec.record(sp)
+        assert rec.dropped == 0
+        assert "_dropped_spans" not in rec.aggregate()
 
     def test_disabled_spans_are_shared_noop(self):
         telemetry.configure(enabled=False)
