@@ -27,6 +27,11 @@ Modes:
   (``[telemetry.journal]``): zero warm retraces with the event journal
   ACTIVE and production-shaped events recorded per round — the proof
   journaling never enters the jit graph (imports jax)
+* ``--profiler-budget`` — run the performance-observatory gate
+  (``[telemetry.profiler]``): zero warm retraces with phase capture
+  ACTIVE (``jax.profiler.trace`` wrapped around warm rounds, device-op
+  events joined against named phases) — the proof the observatory
+  never perturbs what it measures (imports jax)
 * ``--memory-budget`` — run the static memory gate (``[jaxpr.memory]``):
   every example OCP's certified peak must bound XLA's own
   ``memory_analysis`` from above within the pinned ratio, and the
@@ -113,6 +118,11 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="run the flight-recorder gate: zero warm "
                              "retraces with journaling ACTIVE — "
                              "journaling never enters the jit graph")
+    parser.add_argument("--profiler-budget", action="store_true",
+                        help="run the performance-observatory gate: "
+                             "zero warm retraces with phase capture "
+                             "ACTIVE (jax.profiler.trace around warm "
+                             "rounds) and a live device-op join")
     parser.add_argument("--memory-budget", action="store_true",
                         help="run the static memory gate: certified "
                              "peaks bound XLA memory_analysis within "
@@ -177,6 +187,14 @@ def main(argv: "list[str] | None" = None) -> int:
         budgets = retrace_budget.load_budgets(args.budgets) \
             if args.budgets else None
         report = retrace_budget.run_journal_gate(budgets)
+        return 1 if report["violations"] or report["failures"] else 0
+
+    if args.profiler_budget:
+        from agentlib_mpc_tpu.lint import retrace_budget
+
+        budgets = retrace_budget.load_budgets(args.budgets) \
+            if args.budgets else None
+        report = retrace_budget.run_profiler_gate(budgets)
         return 1 if report["violations"] or report["failures"] else 0
 
     if args.memory_budget:

@@ -376,6 +376,117 @@ def run_journal_gate(budgets: "dict | None" = None,
     return report
 
 
+def run_profiler_gate(budgets: "dict | None" = None,
+                      verbose: bool = True) -> dict:
+    """``[telemetry.profiler]`` budget gate (ISSUE 16): phase capture
+    never enters the jit graph.
+
+    The performance observatory promises that ``phase_scope`` is
+    trace-time metadata (free at runtime) and that wrapping a warm round
+    in ``jax.profiler.trace`` costs no recompiles — a phase annotation
+    that closed over a traced value, or a capture path that rebuilt the
+    step, would turn the observatory into the perturbation it is meant
+    to measure. The gate warms the [retrace] fleet, extracts the step's
+    HLO once (the one sanctioned retrace, paid before the measured
+    window — exactly how ``bench.py``/``ServingPlane`` stage it), then
+    holds the per-entry-point (traces + compiles) delta across
+    ``rounds`` *captured* rounds to the ``[telemetry.profiler.budgets]``
+    allowance (default 0). It additionally asserts the capture really
+    recorded (device-op events joined against named phases — no no-op
+    A/A)."""
+    import jax
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import jax_events
+    from agentlib_mpc_tpu.telemetry import profiler as profiler_mod
+    from agentlib_mpc_tpu.utils.jax_setup import enable_compile_profiling
+
+    all_cfg = budgets or load_budgets()
+    cfg = (all_cfg.get("telemetry", {}) or {}).get("profiler", {}) or {}
+    warmup = int(cfg.get("warmup_rounds", 2))
+    rounds = int(cfg.get("rounds", 3))
+    n_agents = int(cfg.get("n_agents", 4))
+    min_coverage = float(cfg.get("min_coverage", 0.5))
+    per_entry = dict(cfg.get("budgets", {}) or {})
+    default_budget = int(per_entry.pop("default", 0))
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    reg = enable_compile_profiling()
+    jax_events.reset_scopes()
+    failures: list = []
+    prof = None
+    try:
+        engine, state, thetas = build_bench_engine(n_agents)
+        for _ in range(max(warmup, 1)):
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+
+        # the one sanctioned retrace: HLO text for the phase join,
+        # extracted BEFORE the measured window (never per capture)
+        hlo = profiler_mod.hlo_text_for(engine._step,
+                                        *engine._step_templates())
+
+        def run_round():
+            nonlocal state
+            state, _trajs, _stats = engine.step(state, thetas)
+            state = engine.shift_state(state)
+            jax.block_until_ready(state)
+
+        before = _compile_snapshot(reg)
+        prof = profiler_mod.capture_phase_profile(
+            run_round, rounds=rounds, hlo_text=hlo,
+            platform=jax.default_backend(), n_devices=1,
+            journal=False)
+        after = _compile_snapshot(reg)
+
+        n_events = sum(prof.op_events.values())
+        if n_events <= 0:
+            failures.append(
+                "capture joined zero device-op events — the gate "
+                "measured a no-op, not a phase capture")
+        elif prof.coverage < min_coverage:
+            failures.append(
+                f"phase coverage {prof.coverage:.3f} below the gate "
+                f"floor {min_coverage} — named scopes are not reaching "
+                f"the executed HLO")
+    finally:
+        telemetry.configure(enabled=was_enabled)
+
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(before) | set(after)}
+    violations = []
+    for entry, delta in sorted(deltas.items()):
+        budget = int(per_entry.get(entry, default_budget))
+        if delta > budget:
+            violations.append({"entry_point": entry,
+                               "observed": delta, "budget": budget})
+    report = {
+        "warmup_rounds": warmup,
+        "rounds": rounds,
+        "n_agents": n_agents,
+        "coverage": None if prof is None else prof.coverage,
+        "op_events": 0 if prof is None else sum(prof.op_events.values()),
+        "deltas": dict(sorted(deltas.items())),
+        "violations": violations,
+        "failures": failures,
+    }
+    if verbose:
+        for v in violations:
+            print(f"profiler-budget: {v['entry_point']!r} compiled/"
+                  f"traced {v['observed']}x across {rounds} captured "
+                  f"rounds (budget {v['budget']}) — phase capture is "
+                  f"entering the jit graph")
+        for f in failures:
+            print(f"profiler-budget: FAILED — {f}")
+        if not violations and not failures:
+            print(f"profiler-budget: OK — capture live (coverage "
+                  f"{report['coverage']:.3f}, {report['op_events']} "
+                  f"device-op events), zero excess compiles across "
+                  f"{rounds} captured rounds ({n_agents} agents)")
+    return report
+
+
 class _MeshGateSkipped(Exception):
     """Internal control flow: the mesh gate's measurement legs were
     skipped (single-device backend — the failure is already recorded)."""

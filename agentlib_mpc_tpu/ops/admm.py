@@ -44,11 +44,16 @@ from typing import NamedTuple
 from jax import lax
 import jax.numpy as jnp
 
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
+
 
 def _axis_sum(x, axis_name):
     """Close a shard-local partial sum over the mesh axis (identity when
     unsharded)."""
-    return x if axis_name is None else lax.psum(x, axis_name)
+    if axis_name is None:
+        return x
+    with phase_scope("collectives"):
+        return lax.psum(x, axis_name)
 
 
 def _axis_norm(arr, axis_name):
@@ -116,26 +121,28 @@ def consensus_update(locals_, state: ConsensusState, active=None,
     agent-axis norm reduce over the mesh via ``psum`` (identical on every
     device up to reduction order), while ``lam`` stays shard-local.
     """
-    zbar_new = _masked_mean(locals_, active, axis_name)
-    m = _active_mask(locals_, active)
-    mshape = (-1,) + (1,) * (locals_.ndim - 1)
-    w = m.reshape(mshape)
-    prim_per_agent = (zbar_new[None, ...] - locals_) * w
-    lam_new = state.lam - state.rho * prim_per_agent
-    # masked-out agents keep their multiplier
-    lam_new = jnp.where(w > 0, lam_new, state.lam)
-    res = AdmmResiduals(
-        primal=_axis_norm(prim_per_agent, axis_name),
-        dual=jnp.linalg.norm(
-            (state.rho * (zbar_new - state.zbar)).reshape(-1)),
-        scale_primal=jnp.maximum(
-            _axis_norm(locals_ * w, axis_name),
-            jnp.linalg.norm(zbar_new.reshape(-1))),
-        scale_dual=_axis_norm(lam_new * w, axis_name),
-        n_primal=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
-        n_dual=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
-    )
-    return ConsensusState(zbar=zbar_new, lam=lam_new, rho=state.rho), res
+    with phase_scope("consensus"):
+        zbar_new = _masked_mean(locals_, active, axis_name)
+        m = _active_mask(locals_, active)
+        mshape = (-1,) + (1,) * (locals_.ndim - 1)
+        w = m.reshape(mshape)
+        prim_per_agent = (zbar_new[None, ...] - locals_) * w
+        lam_new = state.lam - state.rho * prim_per_agent
+        # masked-out agents keep their multiplier
+        lam_new = jnp.where(w > 0, lam_new, state.lam)
+        res = AdmmResiduals(
+            primal=_axis_norm(prim_per_agent, axis_name),
+            dual=jnp.linalg.norm(
+                (state.rho * (zbar_new - state.zbar)).reshape(-1)),
+            scale_primal=jnp.maximum(
+                _axis_norm(locals_ * w, axis_name),
+                jnp.linalg.norm(zbar_new.reshape(-1))),
+            scale_dual=_axis_norm(lam_new * w, axis_name),
+            n_primal=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
+            n_dual=_axis_sum(jnp.sum(m), axis_name) * zbar_new.size,
+        )
+        return ConsensusState(zbar=zbar_new, lam=lam_new,
+                              rho=state.rho), res
 
 
 def exchange_update(locals_, state: ExchangeState, active=None,
@@ -151,23 +158,26 @@ def exchange_update(locals_, state: ExchangeState, active=None,
     multiplier update then runs on the psum'ed mean, replicated across
     devices, while ``diff`` stays shard-local.
     """
-    mean_new = _masked_mean(locals_, active, axis_name)
-    m = _active_mask(locals_, active)
-    w = m.reshape((-1,) + (1,) * (locals_.ndim - 1))
-    diff_new = jnp.where(w > 0, locals_ - mean_new[None, ...], state.diff)
-    lam_new = state.lam + state.rho * mean_new
-    res = AdmmResiduals(
-        primal=jnp.linalg.norm(mean_new.reshape(-1)),
-        dual=jnp.linalg.norm((state.rho * (mean_new - state.mean)).reshape(-1)),
-        scale_primal=jnp.maximum(
-            _axis_norm(locals_ * w, axis_name),
-            jnp.linalg.norm(mean_new.reshape(-1))),
-        scale_dual=jnp.linalg.norm(lam_new.reshape(-1)),
-        n_primal=jnp.asarray(mean_new.size, locals_.dtype),
-        n_dual=_axis_sum(jnp.sum(m), axis_name) * mean_new.size,
-    )
-    return ExchangeState(mean=mean_new, diff=diff_new, lam=lam_new,
-                         rho=state.rho), res
+    with phase_scope("consensus"):
+        mean_new = _masked_mean(locals_, active, axis_name)
+        m = _active_mask(locals_, active)
+        w = m.reshape((-1,) + (1,) * (locals_.ndim - 1))
+        diff_new = jnp.where(w > 0, locals_ - mean_new[None, ...],
+                             state.diff)
+        lam_new = state.lam + state.rho * mean_new
+        res = AdmmResiduals(
+            primal=jnp.linalg.norm(mean_new.reshape(-1)),
+            dual=jnp.linalg.norm(
+                (state.rho * (mean_new - state.mean)).reshape(-1)),
+            scale_primal=jnp.maximum(
+                _axis_norm(locals_ * w, axis_name),
+                jnp.linalg.norm(mean_new.reshape(-1))),
+            scale_dual=jnp.linalg.norm(lam_new.reshape(-1)),
+            n_primal=jnp.asarray(mean_new.size, locals_.dtype),
+            n_dual=_axis_sum(jnp.sum(m), axis_name) * mean_new.size,
+        )
+        return ExchangeState(mean=mean_new, diff=diff_new, lam=lam_new,
+                             rho=state.rho), res
 
 
 def combine_residuals(*results: AdmmResiduals) -> AdmmResiduals:
@@ -265,10 +275,11 @@ def vary_penalty(rho, res: AdmmResiduals, threshold: float = 10.0,
     disables adaptation (reference semantics)."""
     if threshold <= 1:
         return rho
-    grow = res.primal > threshold * res.dual
-    shrink = res.dual > threshold * res.primal
-    return jnp.where(grow, rho * factor,
-                     jnp.where(shrink, rho / factor, rho))
+    with phase_scope("consensus"):
+        grow = res.primal > threshold * res.dual
+        shrink = res.dual > threshold * res.primal
+        return jnp.where(grow, rho * factor,
+                         jnp.where(shrink, rho / factor, rho))
 
 
 def shift_one(traj, horizon: int):

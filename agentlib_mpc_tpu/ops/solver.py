@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 
 from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 from agentlib_mpc_tpu.ops import kkt as kkt_ops
 from agentlib_mpc_tpu.ops import stagejac as sjac
 from agentlib_mpc_tpu.ops import stagewise as stage_ops
@@ -783,65 +784,73 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         gf, Jg, Jh = st.gf, st.Jg, st.Jh
         gv, hv = st.gv, st.hv
 
-        r_h = hv - s
-        dL = jnp.maximum(w - lb, 1e-12)
-        dU = jnp.maximum(ub - w, 1e-12)
-        sigma_s = z / jnp.maximum(s, 1e-12) if m_h else s
-        sigma_L = zL / dL
-        sigma_U = zU / dU
+        with phase_scope("step_update"):
+            r_h = hv - s
+            dL = jnp.maximum(w - lb, 1e-12)
+            dU = jnp.maximum(ub - w, 1e-12)
+            sigma_s = z / jnp.maximum(s, 1e-12) if m_h else s
+            sigma_L = zL / dL
+            sigma_U = zU / dU
 
-        r_w = gf - zL + zU
-        if m_e:
-            r_w = r_w + jg_t_mv(Jg, y)
-        if m_h:
-            r_w = r_w - jh_t_mv(Jh, z)
+            r_w = gf - zL + zU
+            if m_e:
+                r_w = r_w + jg_t_mv(Jg, y)
+            if m_h:
+                r_w = r_w - jh_t_mv(Jh, z)
 
         if plan is not None:
             # compressed Hessian columns (3·v_s forward passes through
             # one linearization instead of n) assembled STRAIGHT into
             # the banded block-tridiagonal layout — the dense KKT matrix
             # never exists on this path
-            CH = sjac.banded_lagrangian_hessian(
-                plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w)
-            w_diag = delta + sigma_L + sigma_U
-            D, E = sjac.assemble_kkt_banded(
-                plan, CH, Jg, Jh, sigma_s if m_h else
-                jnp.zeros((0,), dtype), w_diag, opts.delta_c)
-            factor = ("stage_banded",
-                      (stage_ops.factor_kkt_stage_banded(D, E),
-                       plan.partition))
+            with phase_scope("eval_jac"):
+                CH = sjac.banded_lagrangian_hessian(
+                    plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w)
+            with phase_scope("assemble"):
+                w_diag = delta + sigma_L + sigma_U
+                D, E = sjac.assemble_kkt_banded(
+                    plan, CH, Jg, Jh, sigma_s if m_h else
+                    jnp.zeros((0,), dtype), w_diag, opts.delta_c)
+            with phase_scope("factor"):
+                factor = ("stage_banded",
+                          (stage_ops.factor_kkt_stage_banded(D, E),
+                           plan.partition))
         else:
-            H = hess_l(w, y, z)
-            W = H + (delta * jnp.ones((n,), dtype) + sigma_L + sigma_U) * \
-                jnp.eye(n, dtype=dtype)
-            if m_h:
-                W = W + Jh.T @ (sigma_s[:, None] * Jh)
+            with phase_scope("eval_jac"):
+                H = hess_l(w, y, z)
+            with phase_scope("assemble"):
+                W = H + (delta * jnp.ones((n,), dtype) + sigma_L
+                         + sigma_U) * jnp.eye(n, dtype=dtype)
+                if m_h:
+                    W = W + Jh.T @ (sigma_s[:, None] * Jh)
 
-            if m_e:
-                K = jnp.block([
-                    [W, Jg.T],
-                    [Jg, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
-                ])
-            else:
-                K = W
-            factor = _factor_kkt(K, kkt_path, opts.stage_partition)
+                if m_e:
+                    K = jnp.block([
+                        [W, Jg.T],
+                        [Jg, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
+                    ])
+                else:
+                    K = W
+            with phase_scope("factor"):
+                factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(rhs_w_k, mu_s, mu_L, mu_U):
             """Direction from the stored factor for (possibly per-entry)
             complementarity targets."""
-            if m_e:
-                sol = _resolve_kkt(factor,
-                                   jnp.concatenate([rhs_w_k, -gv]))
-                dw_k, dy_k = sol[:n], sol[n:]
-            else:
-                dw_k = _resolve_kkt(factor, rhs_w_k)
-                dy_k = jnp.zeros((0,), dtype)
-            ds_k = (jh_mv(Jh, dw_k) + r_h) if m_h else s
-            dz_k = (mu_s / jnp.maximum(s, 1e-12) - z - sigma_s * ds_k) \
-                if m_h else z
-            dzL_k = mu_L / dL - zL - sigma_L * dw_k
-            dzU_k = mu_U / dU - zU + sigma_U * dw_k
-            return dw_k, dy_k, ds_k, dz_k, dzL_k, dzU_k
+            with phase_scope("resolve"):
+                if m_e:
+                    sol = _resolve_kkt(factor,
+                                       jnp.concatenate([rhs_w_k, -gv]))
+                    dw_k, dy_k = sol[:n], sol[n:]
+                else:
+                    dw_k = _resolve_kkt(factor, rhs_w_k)
+                    dy_k = jnp.zeros((0,), dtype)
+                ds_k = (jh_mv(Jh, dw_k) + r_h) if m_h else s
+                dz_k = (mu_s / jnp.maximum(s, 1e-12) - z
+                        - sigma_s * ds_k) if m_h else z
+                dzL_k = mu_L / dL - zL - sigma_L * dw_k
+                dzU_k = mu_U / dU - zU + sigma_U * dw_k
+                return dw_k, dy_k, ds_k, dz_k, dzL_k, dzU_k
 
         def rhs_for(mu_s, mu_L, mu_U):
             """rhs with eliminated bound duals and slacks:
@@ -854,8 +863,9 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             return out
 
         # predictor: plain barrier target mu
-        dw, dy, ds, dz, dzL, dzU = newton_dir(rhs_for(mu, mu, mu),
-                                              mu, mu, mu)
+        with phase_scope("resolve"):
+            dw, dy, ds, dz, dzL, dzU = newton_dir(rhs_for(mu, mu, mu),
+                                                  mu, mu, mu)
 
         if opts.corrector:
             # Mehrotra second-order correction: the predictor's Δ∘Δ
@@ -864,112 +874,136 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             # re-solve against the SAME factorization (one cheap
             # back-substitution). Targets clipped to [0, 10 mu] (Gondzio
             # safeguard) so a wild predictor cannot poison the step.
-            mu_L = jnp.clip(mu - dw * dzL, 0.0, 10.0 * mu)
-            mu_U = jnp.clip(mu + dw * dzU, 0.0, 10.0 * mu)
-            mu_s = jnp.clip(mu - ds * dz, 0.0, 10.0 * mu) if m_h else mu
-            dw, dy, ds, dz, dzL, dzU = newton_dir(
-                rhs_for(mu_s, mu_L, mu_U), mu_s, mu_L, mu_U)
+            with phase_scope("resolve"):
+                mu_L = jnp.clip(mu - dw * dzL, 0.0, 10.0 * mu)
+                mu_U = jnp.clip(mu + dw * dzU, 0.0, 10.0 * mu)
+                mu_s = jnp.clip(mu - ds * dz, 0.0, 10.0 * mu) \
+                    if m_h else mu
+                dw, dy, ds, dz, dzL, dzU = newton_dir(
+                    rhs_for(mu_s, mu_L, mu_U), mu_s, mu_L, mu_U)
 
-        tau = jnp.maximum(opts.tau_min, 1.0 - mu)
-        alpha_p = jnp.minimum(_max_step(dL, dw, tau),
-                              _max_step(dU, -dw, tau))
-        if m_h:
-            alpha_p = jnp.minimum(alpha_p, _max_step(s, ds, tau))
-        alpha_d = jnp.minimum(_max_step(zL, dzL, tau),
-                              _max_step(zU, dzU, tau))
-        if m_h:
-            alpha_d = jnp.minimum(alpha_d, _max_step(z, dz, tau))
+        with phase_scope("line_search"):
+            tau = jnp.maximum(opts.tau_min, 1.0 - mu)
+            alpha_p = jnp.minimum(_max_step(dL, dw, tau),
+                                  _max_step(dU, -dw, tau))
+            if m_h:
+                alpha_p = jnp.minimum(alpha_p, _max_step(s, ds, tau))
+            alpha_d = jnp.minimum(_max_step(zL, dzL, tau),
+                                  _max_step(zU, dzU, tau))
+            if m_h:
+                alpha_d = jnp.minimum(alpha_d, _max_step(z, dz, tau))
 
         # ---- l1 merit, parallel backtracking --------------------------------
-        nu = 2.0 * jnp.maximum(1.0, jnp.maximum(_safe_max(jnp.abs(y + dy)),
-                                                _safe_max(jnp.abs(z + dz))))
+        with phase_scope("line_search"):
+            nu = 2.0 * jnp.maximum(
+                1.0, jnp.maximum(_safe_max(jnp.abs(y + dy)),
+                                 _safe_max(jnp.abs(z + dz))))
 
-        def merit_terms(ww, ss, fvv, gvv, hvv):
-            barrier = (jnp.sum(jnp.log(jnp.maximum(ww - lb, 1e-30)))
-                       + jnp.sum(jnp.log(jnp.maximum(ub - ww, 1e-30))))
-            infeas = jnp.sum(jnp.abs(gvv)) if m_e else 0.0
-            if m_h:
-                barrier = barrier + jnp.sum(jnp.log(jnp.maximum(ss, 1e-30)))
-                infeas = infeas + jnp.sum(jnp.abs(hvv - ss))
-            return fvv - mu * barrier + nu * infeas
+            def merit_terms(ww, ss, fvv, gvv, hvv):
+                barrier = (jnp.sum(jnp.log(jnp.maximum(ww - lb, 1e-30)))
+                           + jnp.sum(jnp.log(jnp.maximum(ub - ww,
+                                                         1e-30))))
+                infeas = jnp.sum(jnp.abs(gvv)) if m_e else 0.0
+                if m_h:
+                    barrier = barrier + jnp.sum(
+                        jnp.log(jnp.maximum(ss, 1e-30)))
+                    infeas = infeas + jnp.sum(jnp.abs(hvv - ss))
+                return fvv - mu * barrier + nu * infeas
 
-        phi0 = merit_terms(w, s, st.fv, gv, hv)
-        infeas0 = (jnp.sum(jnp.abs(gv)) if m_e else 0.0) + \
-            jnp.sum(jnp.abs(r_h))
-        dphi = (gf @ dw
-                - mu * (jnp.sum(dw / dL) - jnp.sum(dw / dU))
-                - (mu * jnp.sum(ds / jnp.maximum(s, 1e-12)) if m_h else 0.0)
-                - nu * infeas0)
-        noise = 10.0 * eps * (1.0 + jnp.abs(phi0))
+            phi0 = merit_terms(w, s, st.fv, gv, hv)
+            infeas0 = (jnp.sum(jnp.abs(gv)) if m_e else 0.0) + \
+                jnp.sum(jnp.abs(r_h))
+            dphi = (gf @ dw
+                    - mu * (jnp.sum(dw / dL) - jnp.sum(dw / dU))
+                    - (mu * jnp.sum(ds / jnp.maximum(s, 1e-12))
+                       if m_h else 0.0)
+                    - nu * infeas0)
+            noise = 10.0 * eps * (1.0 + jnp.abs(phi0))
 
-        # all candidate steps alpha_max * 0.5^k in ONE batched evaluation;
-        # the largest accepted candidate wins (same semantics as sequential
-        # backtracking, one model-eval of latency instead of k of them)
-        alphas = alpha_p * (0.5 ** jnp.arange(opts.ls_samples, dtype=dtype))
-        trial_w = w[None, :] + alphas[:, None] * dw[None, :]
-        trial_s = s[None, :] + alphas[:, None] * ds[None, :] \
-            if m_h else jnp.zeros((opts.ls_samples, 0), dtype)
-        if fused_ls:
-            trial_vals, trial_jacs = jax.vmap(fgh_and_jac)(trial_w)
-        else:
-            trial_vals = jax.vmap(fgh)(trial_w)
-        phis = jax.vmap(
-            lambda ww, ss, vv: merit_terms(ww, ss, vv[0], vv[1:1 + m_e],
-                                           vv[1 + m_e:])
-        )(trial_w, trial_s, trial_vals)
-        # finite-merit requirement: a singular/indefinite KKT solve (the
-        # pivot-free LDLᵀ can hit one before the Levenberg delta has grown)
-        # yields non-finite steps — those must reject so delta bumps
-        ok = (phis <= phi0 + opts.armijo_eta * alphas *
-              jnp.minimum(dphi, 0.0) + noise) & jnp.isfinite(phis)
-        accepted = jnp.any(ok)
-        first_ok = jnp.argmax(ok)     # alphas descend → first True = largest
-        alpha = jnp.where(accepted, alphas[first_ok], 0.0)
+            # all candidate steps alpha_max * 0.5^k in ONE batched
+            # evaluation; the largest accepted candidate wins (same
+            # semantics as sequential backtracking, one model-eval of
+            # latency instead of k of them)
+            alphas = alpha_p * (0.5 ** jnp.arange(opts.ls_samples,
+                                                  dtype=dtype))
+            trial_w = w[None, :] + alphas[:, None] * dw[None, :]
+            trial_s = s[None, :] + alphas[:, None] * ds[None, :] \
+                if m_h else jnp.zeros((opts.ls_samples, 0), dtype)
+            if fused_ls:
+                trial_vals, trial_jacs = jax.vmap(fgh_and_jac)(trial_w)
+            else:
+                trial_vals = jax.vmap(fgh)(trial_w)
+            phis = jax.vmap(
+                lambda ww, ss, vv: merit_terms(ww, ss, vv[0],
+                                               vv[1:1 + m_e],
+                                               vv[1 + m_e:])
+            )(trial_w, trial_s, trial_vals)
+            # finite-merit requirement: a singular/indefinite KKT solve
+            # (the pivot-free LDLᵀ can hit one before the Levenberg
+            # delta has grown) yields non-finite steps — those must
+            # reject so delta bumps
+            ok = (phis <= phi0 + opts.armijo_eta * alphas *
+                  jnp.minimum(dphi, 0.0) + noise) & jnp.isfinite(phis)
+            accepted = jnp.any(ok)
+            first_ok = jnp.argmax(ok)  # alphas descend → first True
+            alpha = jnp.where(accepted, alphas[first_ok], 0.0)
 
         # select (not multiply): 0 * nan would poison the rejected branch
         def take(v, dv, a):
             return jnp.where(accepted, v + a * dv, v)
 
-        w_n = take(w, dw, alpha)
-        s_n = take(s, ds, alpha)
-        y_n = take(y, dy, alpha)
-        z_n = take(z, dz, alpha_d)
-        zL_n = take(zL, dzL, alpha_d)
-        zU_n = take(zU, dzU, alpha_d)
-        # sigma-bound reset keeps duals near the central path (IPOPT eq. 16)
-        if m_h:
-            z_ctr = mu / jnp.maximum(s_n, 1e-12)
-            z_n = jnp.clip(z_n, z_ctr / opts.kappa_sigma,
-                           jnp.maximum(z_ctr * opts.kappa_sigma, 1e-30))
-        zL_ctr = mu / jnp.maximum(w_n - lb, 1e-12)
-        zL_n = jnp.clip(zL_n, zL_ctr / opts.kappa_sigma,
-                        jnp.maximum(zL_ctr * opts.kappa_sigma, 1e-30))
-        zU_ctr = mu / jnp.maximum(ub - w_n, 1e-12)
-        zU_n = jnp.clip(zU_n, zU_ctr / opts.kappa_sigma,
-                        jnp.maximum(zU_ctr * opts.kappa_sigma, 1e-30))
-        delta_n = jnp.where(accepted,
-                            jnp.maximum(opts.delta_init, delta / 3.0),
-                            jnp.minimum(delta * 10.0 + 1e-6, opts.delta_max))
+        with phase_scope("step_update"):
+            w_n = take(w, dw, alpha)
+            s_n = take(s, ds, alpha)
+            y_n = take(y, dy, alpha)
+            z_n = take(z, dz, alpha_d)
+            zL_n = take(zL, dzL, alpha_d)
+            zU_n = take(zU, dzU, alpha_d)
+            # sigma-bound reset keeps duals near the central path
+            # (IPOPT eq. 16)
+            if m_h:
+                z_ctr = mu / jnp.maximum(s_n, 1e-12)
+                z_n = jnp.clip(z_n, z_ctr / opts.kappa_sigma,
+                               jnp.maximum(z_ctr * opts.kappa_sigma,
+                                           1e-30))
+            zL_ctr = mu / jnp.maximum(w_n - lb, 1e-12)
+            zL_n = jnp.clip(zL_n, zL_ctr / opts.kappa_sigma,
+                            jnp.maximum(zL_ctr * opts.kappa_sigma,
+                                        1e-30))
+            zU_ctr = mu / jnp.maximum(ub - w_n, 1e-12)
+            zU_n = jnp.clip(zU_n, zU_ctr / opts.kappa_sigma,
+                            jnp.maximum(zU_ctr * opts.kappa_sigma,
+                                        1e-30))
+            delta_n = jnp.where(
+                accepted, jnp.maximum(opts.delta_init, delta / 3.0),
+                jnp.minimum(delta * 10.0 + 1e-6, opts.delta_max))
 
         # ---- refresh carried derivatives at the accepted point ---------------
         if fused_ls:
             # the accepted trial's values/Jacobian were already computed in
             # the batched line-search call — select instead of re-evaluating
             # (on rejection w_n == w: reuse the carried derivatives)
-            vals_prev = jnp.concatenate([st.fv[None], gv, hv])
-            jac_prev = jnp.concatenate([gf[None, :], Jg, Jh])
-            vals_n = jnp.where(accepted, trial_vals[first_ok], vals_prev)
-            jac_n = jnp.where(accepted, trial_jacs[first_ok], jac_prev)
+            with phase_scope("step_update"):
+                vals_prev = jnp.concatenate([st.fv[None], gv, hv])
+                jac_prev = jnp.concatenate([gf[None, :], Jg, Jh])
+                vals_n = jnp.where(accepted, trial_vals[first_ok],
+                                   vals_prev)
+                jac_n = jnp.where(accepted, trial_jacs[first_ok],
+                                  jac_prev)
         else:
             # (w_n == w on rejection; the evaluation is still exact then)
-            vals_n, jac_n = fgh_and_jac(w_n)
+            with phase_scope("eval_jac"):
+                vals_n, jac_n = fgh_and_jac(w_n)
         fv_n, gf_n, gv_n, Jg_n, hv_n, Jh_n = split(vals_n, jac_n)
 
         # ---- barrier update --------------------------------------------------
-        err_mu, viol_mu, dual_mu, compl_mu = kkt_error(
-            gf_n, Jg_n, Jh_n, gv_n, hv_n, s_n, y_n, z_n, zL_n, zU_n, w_n, mu)
-        err_0, viol_0, dual_0, compl_0 = kkt_error(
-            gf_n, Jg_n, Jh_n, gv_n, hv_n, s_n, y_n, z_n, zL_n, zU_n, w_n, 0.0)
+        with phase_scope("step_update"):
+            err_mu, viol_mu, dual_mu, compl_mu = kkt_error(
+                gf_n, Jg_n, Jh_n, gv_n, hv_n, s_n, y_n, z_n, zL_n,
+                zU_n, w_n, mu)
+            err_0, viol_0, dual_0, compl_0 = kkt_error(
+                gf_n, Jg_n, Jh_n, gv_n, hv_n, s_n, y_n, z_n, zL_n,
+                zU_n, w_n, 0.0)
         frozen_n = jnp.where(accepted, 0, st.frozen + 1)
         # normal Fiacco–McCormick test — plus two escape hatches: when
         # overall progress has stalled (typically the f32

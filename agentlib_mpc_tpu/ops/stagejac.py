@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agentlib_mpc_tpu.ops.stagewise import StagePartition, stage_of_index
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 
 __all__ = [
     "StageJacobianPlan",
@@ -466,9 +467,10 @@ def banded_lagrangian_hessian(plan: StageJacobianPlan, grad_fn,
     """Compressed Lagrangian-Hessian columns: ``3·v_s`` forward passes
     through one linearization of ``grad_fn`` (vs ``n_w`` for the dense
     ``jax.hessian``). ``CH[seed_of(col j), i] = H[i, j]``."""
-    _, jvp_fn = jax.linearize(grad_fn, w)
-    seeds = jnp.asarray(plan.hess_seeds, w.dtype)
-    return jax.vmap(jvp_fn)(seeds)
+    with phase_scope("eval_jac"):
+        _, jvp_fn = jax.linearize(grad_fn, w)
+        seeds = jnp.asarray(plan.hess_seeds, w.dtype)
+        return jax.vmap(jvp_fn)(seeds)
 
 
 def hessian_rows(plan: StageJacobianPlan, CH: jnp.ndarray) -> jnp.ndarray:
@@ -493,29 +495,31 @@ def assemble_kkt_banded(plan: StageJacobianPlan, CH: jnp.ndarray,
     the dense matrix is never materialized. All scatter targets are
     static; entries belonging to implicit-transpose blocks drop into a
     garbage slot."""
-    dtype = w_diag.dtype
-    de = jnp.asarray(plan.de_init, dtype)
-    H_rows = hessian_rows(plan, CH)
-    de = de.at[jnp.asarray(plan.hasm_dst)].add(H_rows.reshape(-1))
-    if plan.m_e:
-        gflat = Jg_rows.reshape(-1)
-        de = de.at[jnp.asarray(plan.gasm_dst1)].add(gflat)
-        de = de.at[jnp.asarray(plan.gasm_dst2)].add(gflat)
-        de = de.at[jnp.asarray(plan.eq_diag_dst)].add(
-            jnp.full((plan.m_e,), -delta_c, dtype))
-    if plan.m_h:
-        outer = (sigma_s[:, None, None]
-                 * Jh_rows[:, :, None] * Jh_rows[:, None, :])
-        de = de.at[jnp.asarray(plan.jh_dst)].add(outer.reshape(-1))
-    de = de.at[jnp.asarray(plan.var_diag_dst)].add(w_diag)
-    S, ns = plan._S, plan._ns
-    D = de[:plan._n_D].reshape(S, ns, ns)
-    E = de[plan._n_D:plan._n_D + plan._n_E].reshape(max(S - 1, 0), ns, ns)
-    # the two H orientations are gathered from different compressed
-    # columns (equal in exact arithmetic); symmetrize so the pivot-free
-    # quasi-definite sweep sees an exactly symmetric block
-    D = 0.5 * (D + jnp.swapaxes(D, 1, 2))
-    return D, E
+    with phase_scope("assemble"):
+        dtype = w_diag.dtype
+        de = jnp.asarray(plan.de_init, dtype)
+        H_rows = hessian_rows(plan, CH)
+        de = de.at[jnp.asarray(plan.hasm_dst)].add(H_rows.reshape(-1))
+        if plan.m_e:
+            gflat = Jg_rows.reshape(-1)
+            de = de.at[jnp.asarray(plan.gasm_dst1)].add(gflat)
+            de = de.at[jnp.asarray(plan.gasm_dst2)].add(gflat)
+            de = de.at[jnp.asarray(plan.eq_diag_dst)].add(
+                jnp.full((plan.m_e,), -delta_c, dtype))
+        if plan.m_h:
+            outer = (sigma_s[:, None, None]
+                     * Jh_rows[:, :, None] * Jh_rows[:, None, :])
+            de = de.at[jnp.asarray(plan.jh_dst)].add(outer.reshape(-1))
+        de = de.at[jnp.asarray(plan.var_diag_dst)].add(w_diag)
+        S, ns = plan._S, plan._ns
+        D = de[:plan._n_D].reshape(S, ns, ns)
+        E = de[plan._n_D:plan._n_D + plan._n_E].reshape(
+            max(S - 1, 0), ns, ns)
+        # the two H orientations are gathered from different compressed
+        # columns (equal in exact arithmetic); symmetrize so the
+        # pivot-free quasi-definite sweep sees an exactly symmetric block
+        D = 0.5 * (D + jnp.swapaxes(D, 1, 2))
+        return D, E
 
 
 # --------------------------------------------------------------------------

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agentlib_mpc_tpu.ops import kkt as kkt_ops
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -336,13 +337,14 @@ def factor_kkt_stage_banded(D: jnp.ndarray, E: jnp.ndarray):
     as :func:`factor_kkt_stage` — computed from the band, which on the
     certified path IS the whole matrix — and the same per-stage
     pivot-free quasi-definite LDLᵀ Schur sweep."""
-    rm = _band_row_max(D, E)
-    scale = 1.0 / jnp.sqrt(jnp.maximum(rm, 1e-12))
-    Ds = D * scale[:, :, None] * scale[:, None, :]
-    Es = E * scale[1:, :, None] * scale[:-1, None, :] if D.shape[0] > 1 \
-        else E
-    F = _factor_blocks(Ds, Es)
-    return (F, Es, Ds, scale)
+    with phase_scope("factor"):
+        rm = _band_row_max(D, E)
+        scale = 1.0 / jnp.sqrt(jnp.maximum(rm, 1e-12))
+        Ds = D * scale[:, :, None] * scale[:, None, :]
+        Es = E * scale[1:, :, None] * scale[:-1, None, :] \
+            if D.shape[0] > 1 else E
+        F = _factor_blocks(Ds, Es)
+        return (F, Es, Ds, scale)
 
 
 def resolve_kkt_stage_banded(factor, rhs: jnp.ndarray,
@@ -351,15 +353,17 @@ def resolve_kkt_stage_banded(factor, rhs: jnp.ndarray,
     """Solve with a stored banded stage factor + iterative refinement
     against the banded matvec (exact on the certified-sparse path).
     ``rhs`` is in ORIGINAL KKT index order, like :func:`resolve_kkt_stage`."""
-    F, Es, Ds, scale = factor
-    _, valid, safe, inv = _perm_arrays(partition)
-    bp = jnp.where(jnp.asarray(valid), rhs[safe], jnp.zeros((), rhs.dtype))
-    bp = bp.reshape(partition.n_stages, partition.block) * scale
-    x = _solve_blocks(F, Es, bp)
-    for _ in range(refine_steps):
-        r = bp - band_matvec_blocks(Ds, Es, x)
-        x = x + _solve_blocks(F, Es, r)
-    return (x * scale).reshape(-1)[inv]
+    with phase_scope("resolve"):
+        F, Es, Ds, scale = factor
+        _, valid, safe, inv = _perm_arrays(partition)
+        bp = jnp.where(jnp.asarray(valid), rhs[safe],
+                       jnp.zeros((), rhs.dtype))
+        bp = bp.reshape(partition.n_stages, partition.block) * scale
+        x = _solve_blocks(F, Es, bp)
+        for _ in range(refine_steps):
+            r = bp - band_matvec_blocks(Ds, Es, x)
+            x = x + _solve_blocks(F, Es, r)
+        return (x * scale).reshape(-1)[inv]
 
 
 # --------------------------------------------------------------------------

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 
 from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 from agentlib_mpc_tpu.ops.admm import (
     AdmmResiduals,
     combine_residuals,
@@ -1158,8 +1159,10 @@ class FusedADMM:
                     n_failed = n_failed + jnp.sum(
                         ~(ok_b | ~active[gi]), dtype=jnp.int32)
                 if axis_name is not None:
-                    n_quarantined = jax.lax.psum(n_quarantined, axis_name)
-                    n_failed = jax.lax.psum(n_failed, axis_name)
+                    with phase_scope("collectives"):
+                        n_quarantined = jax.lax.psum(
+                            n_quarantined, axis_name)
+                        n_failed = jax.lax.psum(n_failed, axis_name)
                 ok_all = n_failed == 0
 
                 residuals = []

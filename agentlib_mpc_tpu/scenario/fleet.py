@@ -49,6 +49,7 @@ from agentlib_mpc_tpu.ops.solver import (
     solve_nlp,
 )
 from agentlib_mpc_tpu.scenario.tree import ScenarioTree
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 
 logger = logging.getLogger(__name__)
 
@@ -454,14 +455,18 @@ class ScenarioFleet:
                 """Group-mean projection of the robust-horizon controls
                 across the scenario axis: the ONE scenarios-psum of the
                 non-anticipativity coupling."""
-                partial = jnp.einsum("astu,stg->atgu", u_na, membership,
-                                     precision=jax.lax.Precision.HIGHEST)
-                sums = partial
-                if ax_s is not None:
-                    sums = jax.lax.psum(sums, ax_s)
-                means = sums / counts[None, :, :, None]
-                return jnp.einsum("stg,atgu->astu", membership, means,
-                                  precision=jax.lax.Precision.HIGHEST)
+                with phase_scope("non_anticipativity"):
+                    partial = jnp.einsum(
+                        "astu,stg->atgu", u_na, membership,
+                        precision=jax.lax.Precision.HIGHEST)
+                    sums = partial
+                    if ax_s is not None:
+                        with phase_scope("collectives"):
+                            sums = jax.lax.psum(sums, ax_s)
+                    means = sums / counts[None, :, :, None]
+                    return jnp.einsum(
+                        "stg,atgu->astu", membership, means,
+                        precision=jax.lax.Precision.HIGHEST)
 
             def iteration(carry):
                 (state, it, _res, prim_h, dual_h, done, ok_hist,
@@ -486,7 +491,8 @@ class ScenarioFleet:
                 n_failed = jnp.sum(
                     ~(ok_b | ~active[:, None]), dtype=jnp.int32)
                 if ax_a is not None:
-                    n_failed = jax.lax.psum(n_failed, ax_a)
+                    with phase_scope("collectives"):
+                        n_failed = jax.lax.psum(n_failed, ax_a)
                 n_failed = close_sum(n_failed)
                 ok_all = n_failed == 0
 
@@ -505,24 +511,27 @@ class ScenarioFleet:
                     lam_new[alias] = cnew.lam
 
                 if R:
-                    u_na = u_b[:, :, :R, :]            # (n_a, S, R, n_u)
-                    target = na_project(u_na)
-                    prim_per = (target - u_na) * act4
-                    nu_new = state.nu - opts.rho_na * prim_per
-                    na_res = AdmmResiduals(
-                        primal=gnorm(prim_per),
-                        dual=gnorm(opts.rho_na
-                                   * (target - state.na_target) * act4),
-                        scale_primal=jnp.maximum(gnorm(u_na * act4),
-                                                 gnorm(target * act4)),
-                        scale_dual=gnorm(nu_new * act4),
-                        # constraint elements: active agents x ALL
-                        # scenarios (static) x coupled coordinates —
-                        # no scenario psum needed for a static count
-                        n_primal=_active_count(active, ax_a)
-                        * float(self.S * R * n_u),
-                        n_dual=_active_count(active, ax_a)
-                        * float(self.S * R * n_u))
+                    with phase_scope("non_anticipativity"):
+                        u_na = u_b[:, :, :R, :]        # (n_a, S, R, n_u)
+                        target = na_project(u_na)
+                        prim_per = (target - u_na) * act4
+                        nu_new = state.nu - opts.rho_na * prim_per
+                        na_res = AdmmResiduals(
+                            primal=gnorm(prim_per),
+                            dual=gnorm(opts.rho_na
+                                       * (target - state.na_target)
+                                       * act4),
+                            scale_primal=jnp.maximum(
+                                gnorm(u_na * act4),
+                                gnorm(target * act4)),
+                            scale_dual=gnorm(nu_new * act4),
+                            # constraint elements: active agents x ALL
+                            # scenarios (static) x coupled coordinates —
+                            # no scenario psum needed for a static count
+                            n_primal=_active_count(active, ax_a)
+                            * float(self.S * R * n_u),
+                            n_dual=_active_count(active, ax_a)
+                            * float(self.S * R * n_u))
                     residuals.append(na_res)
                     na_last = na_res.primal
                 else:

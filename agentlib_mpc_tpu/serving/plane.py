@@ -125,7 +125,8 @@ class ServingPlane:
                  engine_store=None,
                  memory_certify: str = "auto",
                  hbm_bytes: "int | str | None" = "auto",
-                 slo_policy: "SLOPolicy | None" = None):
+                 slo_policy: "SLOPolicy | None" = None,
+                 profile_every: "int | None" = None):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -249,6 +250,20 @@ class ServingPlane:
         self.slo = SLOTracker(slo_policy if slo_policy is not None
                               else SLOPolicy())
         self._slo_policy_journaled = False
+        #: periodic phase-profile capture (ISSUE 16): every K-th bucket
+        #: dispatch runs under ``jax.profiler.trace`` and lands its
+        #: per-phase device times in the ``phase_device_ms`` histogram
+        #: (scraped like every other family) plus a ``profile.captured``
+        #: journal event. The off-capture path is one modulo check; the
+        #: per-executable HLO join is cached after the first capture.
+        #: None (the default) disables the hook entirely.
+        from agentlib_mpc_tpu.telemetry.profiler import PeriodicCapture
+
+        n_dev = 1 if mesh is None else max(1, int(mesh.devices.size))
+        self.profiler = PeriodicCapture(
+            profile_every, rounds=1, n_devices=n_dev,
+            mesh_shape=None if mesh is None
+            else tuple(mesh.devices.shape))
         # events emitted between rounds (submissions, sheds, chaos
         # injections at the submit seam) belong to the UPCOMING round
         telemetry.journal_set_round(self.served_rounds)
@@ -882,7 +897,7 @@ class ServingPlane:
                 touched.append(key)
         m = telemetry.serving_metrics() if telemetry.enabled() else None
         for key in touched:
-            res = self.dispatcher.dispatch(key, self._buckets[key])
+            res = self._dispatch_profiled(key, self._buckets[key])
             self.rounds += 1
             if m is not None:
                 m["rounds"].inc(bucket=key.digest)
@@ -920,6 +935,37 @@ class ServingPlane:
         # between-round events (next round's submissions) stamp forward
         telemetry.journal_set_round(self.served_rounds)
         return results
+
+    def _dispatch_profiled(self, key, bucket):
+        """One bucket dispatch, routed through the periodic profiler.
+        The common path (``profile_every=None`` or a non-due round) is
+        the plain dispatch plus at most one integer modulo; a due round
+        runs the SAME dispatch inside ``jax.profiler.trace`` and
+        attributes its device time by named phase. Capture failures
+        never fail the round — serving traffic outranks observability."""
+        if self.profiler.every is None:
+            return self.dispatcher.dispatch(key, bucket)
+        hlo = None
+        if self.profiler.due():
+            eng = getattr(bucket, "engine", None)
+            if eng is not None:
+                try:
+                    hlo = self.profiler.hlo_for(
+                        key, eng._step, *eng._step_templates())
+                except Exception:  # noqa: BLE001 — join is best-effort
+                    hlo = None
+        holder = {}
+
+        def run_round():
+            holder["res"] = self.dispatcher.dispatch(key, bucket)
+
+        try:
+            self.profiler.tick(run_round, hlo_text=hlo,
+                               label=key.digest)
+        except Exception:  # noqa: BLE001 — capture must not shed a round
+            if "res" not in holder:
+                holder["res"] = self.dispatcher.dispatch(key, bucket)
+        return holder.get("res")
 
     def flush(self) -> dict:
         """Drain the dispatch pipeline: assess and return every

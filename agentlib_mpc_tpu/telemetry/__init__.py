@@ -20,6 +20,10 @@ Layout:
 - :mod:`.jax_events` — ``jax.monitoring`` listeners turning XLA
   compiles/retraces into metrics (installed via
   :func:`agentlib_mpc_tpu.utils.jax_setup.enable_compile_profiling`)
+- :mod:`.profiler` / :mod:`.calibration` / :mod:`.regression` — the
+  performance observatory (ISSUE 16): named-phase device profiles,
+  certificate-calibrated cost ledgers, per-phase regression baselines
+  (``bench.py --perf-gate``)
 
 Enablement is process-global and ON by default (counters are ~100 ns;
 spans a few µs). ``telemetry.configure(enabled=False)`` turns every write
@@ -58,7 +62,16 @@ __all__ = [
     "record_device_memory", "reset",
     "enable_journal", "disable_journal", "journal_active",
     "journal_event", "journal_set_round", "serve_metrics",
+    "PhaseProfile", "PeriodicCapture", "capture_phase_profile",
+    "phase_scope",
 ]
+
+from agentlib_mpc_tpu.telemetry.profiler import (  # noqa: E402
+    PeriodicCapture,
+    PhaseProfile,
+    capture_phase_profile,
+    phase_scope,
+)
 
 
 def metrics() -> MetricsRegistry:

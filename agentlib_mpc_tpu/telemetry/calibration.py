@@ -1,0 +1,254 @@
+"""Certificate-calibrated cost ledger: predicted vs measured, per phase.
+
+The middle layer of the performance observatory: join the *analytical*
+certificates (the PR 5 per-primitive FLOP/bytes model of
+``lint/jaxpr/cost.py``) against the *measured* per-phase device time
+(:mod:`.profiler`) — per-phase achieved FLOP/s and bytes/s, a
+predicted-vs-measured ratio, and a roofline placement that names the
+top fusion candidates analytically. This is exactly the input ROADMAP
+item 2 ("pick fusion targets analytically") was blocked behind: a
+memory-bound phase running far under the roofline is fusion fuel; a
+compute-bound phase at the roofline is done.
+
+:func:`phase_costs` is the certificate side: the same charging rules as
+:func:`~agentlib_mpc_tpu.lint.jaxpr.cost.op_cost` (dot = 2·M·N·K,
+transcendentals weighted, data movement 0 FLOPs/full bytes, scan bodies
+× trip count, while bodies × the caller's trip budget), but accumulated
+per ``phase.*`` component of each equation's ``name_stack`` instead of
+per primitive — the SAME ``jax.named_scope`` annotations drive both the
+measured and the modeled column, so they can never label different
+code. Equations outside every phase scope accumulate under
+``unattributed``, mirroring the profiler's residual row.
+
+The roofline peaks are a per-platform MODEL (``PLATFORM_PEAKS``,
+overridable per call) — their value is placement and ranking, not
+absolute truth; the report says which peaks it assumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from agentlib_mpc_tpu.telemetry.profiler import (
+    UNATTRIBUTED,
+    deepest_phase,
+)
+
+__all__ = ["CalibrationReport", "PLATFORM_PEAKS", "calibrate",
+           "phase_costs"]
+
+#: platform -> (peak FLOP/s, peak bytes/s): the roofline model.
+#: Deliberately round numbers — the report's value is *placement*
+#: (which side of the ridge, how far under the roof) and *ranking*
+#: (which phase to fuse first), not absolute efficiency claims. TPU
+#: row: f32 VPU+MXU order of magnitude per chip; CPU row: a few cores
+#: of AVX + dual-channel DRAM, the shared-CI-runner reality.
+PLATFORM_PEAKS = {
+    "cpu": (5.0e10, 2.0e10),
+    "tpu": (1.0e14, 1.2e12),
+    "gpu": (2.0e13, 1.0e12),
+}
+
+
+def phase_costs(fn_or_jaxpr, *args,
+                while_trips: "int | None" = None) -> dict:
+    """Modeled ``{phase: {"flops", "bytes"}}`` of ``fn(*args)`` (or an
+    already-closed jaxpr), keyed by the deepest ``phase.*`` name-stack
+    component of each equation — plus the ``unattributed`` row for
+    equations outside every phase scope and a ``"_notes"`` list
+    (while-trip budgets, exactly like ``op_cost``)."""
+    from agentlib_mpc_tpu.lint.jaxpr.cost import (
+        _FREE,
+        _TRANSCENDENTAL,
+        TRANSCENDENTAL_FLOPS,
+        WHILE_TRIP_GUESS,
+        _dot_flops,
+        _nbytes,
+        _out_size,
+    )
+
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+
+    acc: dict = {}
+    notes: "set[str]" = set()
+
+    def charge(phase, flops, bytes_):
+        row = acc.setdefault(phase, {"flops": 0, "bytes": 0})
+        row["flops"] += int(flops)
+        row["bytes"] += int(bytes_)
+
+    def walk(jaxpr, mult, inherited):
+        jaxpr = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+        for eqn in jaxpr.eqns:
+            phase = deepest_phase(
+                str(eqn.source_info.name_stack)) or inherited
+            name = eqn.primitive.name
+            if name == "pjit":
+                walk(eqn.params["jaxpr"], mult, phase)
+                continue
+            if name == "shard_map":
+                walk(eqn.params["jaxpr"], mult, phase)
+                continue
+            if name == "scan":
+                walk(eqn.params["jaxpr"],
+                     mult * int(eqn.params["length"]), phase)
+                continue
+            if name == "while":
+                if while_trips is not None:
+                    trips = int(while_trips)
+                    notes.add(f"while charged the caller's {trips}-trip "
+                              f"budget")
+                else:
+                    trips = WHILE_TRIP_GUESS
+                    notes.add(f'while trips="unbounded" — charged the '
+                              f"{WHILE_TRIP_GUESS}-trip guess; pass "
+                              f"while_trips=<budget> for a bounded "
+                              f"ledger")
+                walk(eqn.params["body_jaxpr"], mult * trips, phase)
+                walk(eqn.params["cond_jaxpr"], mult * trips, phase)
+                continue
+            if name == "cond":
+                for br in eqn.params["branches"]:
+                    walk(br, mult, phase)
+                continue
+            key = phase or UNATTRIBUTED
+            io_bytes = mult * (
+                sum(_nbytes(v) for v in eqn.invars
+                    if hasattr(v, "aval"))
+                + sum(_nbytes(v) for v in eqn.outvars))
+            if name in _FREE:
+                charge(key, 0, io_bytes)
+                continue
+            if name == "dot_general":
+                charge(key, mult * _dot_flops(eqn), io_bytes)
+            elif name in _TRANSCENDENTAL:
+                charge(key,
+                       mult * TRANSCENDENTAL_FLOPS * _out_size(eqn),
+                       io_bytes)
+            else:
+                charge(key, mult * _out_size(eqn), io_bytes)
+
+    walk(closed, 1, None)
+    out = {ph: dict(row) for ph, row in acc.items()}
+    out["_notes"] = sorted(notes)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CalibrationReport:
+    """The joined ledger: per phase, measured device ms next to modeled
+    FLOPs/bytes, achieved rates, roofline placement and the
+    predicted-vs-measured ratio; ``fusion_candidates`` ranks the
+    memory-bound under-roofline phases — the analytical fusion-target
+    list ROADMAP item 2 consumes."""
+
+    platform: str
+    metric_key: str
+    peak_flops_per_s: float
+    peak_bytes_per_s: float
+    phases: dict          # phase -> joined row (see calibrate())
+    fusion_candidates: tuple
+    coverage: float
+    notes: tuple = ()
+
+    def as_dict(self) -> dict:
+        return {
+            "metric_key": self.metric_key,
+            "platform": self.platform,
+            "peaks": {"flops_per_s": self.peak_flops_per_s,
+                      "bytes_per_s": self.peak_bytes_per_s},
+            "coverage": round(self.coverage, 4),
+            "phases": self.phases,
+            "fusion_candidates": list(self.fusion_candidates),
+            "notes": list(self.notes),
+        }
+
+    def table(self) -> str:
+        """Markdown calibration table (the --emit-metrics artifact)."""
+        lines = [
+            "| phase | ms | GFLOP/s | GB/s | intensity | bound | "
+            "measured/roofline |",
+            "|---|---|---|---|---|---|---|"]
+        for ph, row in sorted(self.phases.items(),
+                              key=lambda kv: -kv[1]["device_ms"]):
+            lines.append(
+                f"| {ph} | {row['device_ms']:.3f} | "
+                f"{row['achieved_gflops_per_s']:.2f} | "
+                f"{row['achieved_gbytes_per_s']:.2f} | "
+                f"{row['intensity']:.2f} | {row['bound']} | "
+                f"{row['measured_vs_roofline']:.1f}x |")
+        return "\n".join(lines)
+
+
+def calibrate(profile, costs: dict, *,
+              peaks: "tuple | None" = None) -> CalibrationReport:
+    """Join a measured :class:`~.profiler.PhaseProfile` against the
+    modeled :func:`phase_costs` ledger.
+
+    Per phase present in either side: measured device ms, modeled
+    FLOPs/bytes, achieved GFLOP/s and GB/s, arithmetic intensity,
+    roofline ``bound`` (compute vs memory vs the ridge point of the
+    platform peaks), the roofline-predicted ms and the
+    measured-vs-roofline ratio (>1 = slower than the model says this
+    phase could run). Fusion candidates: memory-bound phases ranked by
+    potential saving ``measured_ms − roofline_ms`` — the time fusing
+    away their memory traffic could reclaim."""
+    platform = profile.platform
+    peak_f, peak_b = peaks or PLATFORM_PEAKS.get(
+        platform, PLATFORM_PEAKS["cpu"])
+    ridge = peak_f / peak_b
+    notes = list(costs.get("_notes", ()))
+    if peaks is None and platform not in PLATFORM_PEAKS:
+        notes.append(f"no peak model for platform {platform!r} — "
+                     f"used the cpu row")
+    phases: dict = {}
+    for ph in sorted(set(profile.device_ms) | set(costs) - {"_notes"}):
+        ms = float(profile.device_ms.get(ph, 0.0))
+        row = costs.get(ph, {"flops": 0, "bytes": 0})
+        flops, bytes_ = int(row["flops"]), int(row["bytes"])
+        secs = ms / 1e3
+        intensity = flops / bytes_ if bytes_ else 0.0
+        roofline_s = max(flops / peak_f, bytes_ / peak_b)
+        phases[ph] = {
+            "device_ms": round(ms, 4),
+            "model_flops": flops,
+            "model_bytes": bytes_,
+            "achieved_gflops_per_s": round(
+                flops / secs / 1e9 if secs else 0.0, 3),
+            "achieved_gbytes_per_s": round(
+                bytes_ / secs / 1e9 if secs else 0.0, 3),
+            "intensity": round(intensity, 3),
+            "bound": ("compute" if intensity >= ridge else "memory")
+            if (flops or bytes_) else "unmodeled",
+            "roofline_ms": round(1e3 * roofline_s, 4),
+            "measured_vs_roofline": round(
+                secs / roofline_s if roofline_s > 0 else 0.0, 2),
+        }
+    candidates = []
+    for ph, row in phases.items():
+        if ph == UNATTRIBUTED or row["bound"] != "memory":
+            continue
+        saving = row["device_ms"] - row["roofline_ms"]
+        if saving <= 0:
+            continue
+        candidates.append((saving, ph, row))
+    candidates.sort(reverse=True)
+    fusion = tuple(
+        {"phase": ph,
+         "potential_saving_ms": round(saving, 4),
+         "reason": (f"memory-bound (intensity {row['intensity']:.2f} "
+                    f"< ridge {ridge:.1f} FLOP/B) at "
+                    f"{row['measured_vs_roofline']:.1f}x the roofline "
+                    f"— fusing its producers/consumers removes "
+                    f"round-trip traffic")}
+        for saving, ph, row in candidates[:3])
+    return CalibrationReport(
+        platform=platform, metric_key=profile.metric_key,
+        peak_flops_per_s=peak_f, peak_bytes_per_s=peak_b,
+        phases=phases, fusion_candidates=fusion,
+        coverage=profile.coverage, notes=tuple(notes))

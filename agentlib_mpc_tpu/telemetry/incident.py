@@ -31,7 +31,7 @@ from agentlib_mpc_tpu.telemetry.journal import read_events
 FAULT_EVENTS = (
     "chaos.injected", "watchdog.condemned", "serve.stall",
     "mesh.degrade", "serve.eviction", "checkpoint.rejected",
-    "certifier.refused",
+    "certifier.refused", "perf.regression",
 )
 
 #: chaos rule kind -> (symptom event types, recovery event types,
@@ -266,8 +266,16 @@ def build_incident(journal_path_or_events,
 
 def _fmt_event(ev: dict) -> str:
     skip = {"seq", "t", "round", "etype"}
-    detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev)
-                       if k not in skip)
+    if ev.get("etype") == "perf.regression":
+        # perf-gate violation: show the drift arithmetic, not raw kv
+        detail = (f"phase={ev.get('phase')} "
+                  f"{ev.get('measured_ms')} ms vs baseline "
+                  f"{ev.get('baseline_ms')}±{ev.get('band_ms')} ms "
+                  f"(+{ev.get('excess_ms')} ms over band, "
+                  f"key={ev.get('metric_key')})")
+    else:
+        detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                           if k not in skip)
     rnd = ev.get("round")
     return (f"| {ev.get('seq', '?')} | "
             f"{'-' if rnd is None else rnd} | "

@@ -1,0 +1,761 @@
+"""Named-phase device profiler: where a fused round's device time goes.
+
+The measurement half of the performance observatory (ISSUE 16; the
+analytical half is :mod:`.calibration`, the gate half :mod:`.regression`).
+Three pieces:
+
+* **Phase vocabulary** — every semantic phase of the solver hot path is
+  annotated with ``jax.named_scope("phase.<name>")`` via
+  :func:`phase_scope` (``ops/solver``, ``ops/stagewise``,
+  ``ops/stagejac``, ``ops/admm``, ``parallel/fused_admm``,
+  ``scenario/fleet``). ``named_scope`` is trace-time-only — it costs
+  nothing at runtime and never enters the jit graph (the
+  ``[telemetry.profiler]`` lint gate pins exactly that) — but XLA
+  carries it into every compiled instruction's ``op_name`` metadata.
+
+* **The HLO join** — XLA trace events name *instructions*
+  (``args.hlo_op = "dot.23"``), not scopes, so attribution needs the
+  compiled module text: :func:`phase_map_from_hlo` parses
+  ``metadata={op_name="jit(step)/.../phase.factor/..."}`` per
+  instruction into an instruction→phase map (a fusion inherits its root
+  op's scope; the deepest ``phase.*`` component wins when scopes nest).
+  Extracting the text (``fn.lower(...).compile().as_text()``) RETRACES,
+  so it is paid once at setup — :func:`hlo_text_for` — never inside a
+  measured window.
+
+* **Capture** — :func:`capture_phase_profile` wraps
+  ``jax.profiler.trace`` around N warm rounds, parses the emitted
+  ``*.trace.json.gz``, joins events against the phase map and returns a
+  :class:`PhaseProfile`: per-phase device ms per round (platform- and
+  mesh-qualified like every bench key), host-side remainder, and an
+  explicit ``unattributed`` row for device time outside any phase scope
+  — the coverage number is reported, never silently dropped.
+  Control-flow container instructions (``while``/``conditional``/
+  ``call``) span their body ops' events and are excluded from totals so
+  nothing is double-counted.
+
+:class:`PeriodicCapture` is the low-overhead serving hook behind
+``ServingPlane(profile_every=K)``: a modulo check per round, a capture
+every K-th, phase histograms onto the scrape endpoint and a
+``profile.captured`` event onto the flight recorder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+import warnings
+
+from agentlib_mpc_tpu.telemetry import journal as _journal_mod
+from agentlib_mpc_tpu.telemetry import registry as _registry_mod
+
+__all__ = [
+    "PHASES", "PHASE_PREFIX", "UNATTRIBUTED", "PhaseProfile",
+    "PeriodicCapture", "capture_phase_profile", "hlo_text_for",
+    "phase_map_from_hlo", "phase_scope",
+]
+
+#: the phase vocabulary — one name per semantic phase of the fused
+#: round. ``step_update`` is the glue (barrier/penalty updates,
+#: convergence bookkeeping, state carries) so the explicit phases plus
+#: glue reconstruct ≥90% of device time and ``unattributed`` stays an
+#: honest residual, not a dumping ground.
+PHASES = (
+    "eval_jac",            # constraint/objective eval + jacobian pullbacks
+    "assemble",            # banded Lagrangian Hessian + KKT assembly
+    "factor",              # KKT factorization (dense LU/LDL or stage sweep)
+    "resolve",             # back-substitution / Newton direction
+    "line_search",         # batched merit line search
+    "consensus",           # ADMM consensus/exchange + rho update
+    "non_anticipativity",  # scenario-tree group-mean projection
+    "collectives",         # cross-device psum traffic
+    "step_update",         # barrier/filter updates, carries, bookkeeping
+)
+PHASE_PREFIX = "phase."
+#: the reserved residual row: device time attributed to NO phase scope
+UNATTRIBUTED = "unattributed"
+
+#: instruction metadata: ``%name = ... metadata={op_name="..."}``
+_OPNAME_RE = re.compile(
+    r"%([A-Za-z0-9_.\-]+)\s*=[^\n]*?op_name=\"([^\"]*)\"")
+#: control-flow containers whose trace events SPAN their body ops
+_CONTAINER_RE = re.compile(
+    r"%([A-Za-z0-9_.\-]+)\s*=\s*\S+\s+(?:while|conditional|call)\(")
+_MODULE_RE = re.compile(r"HloModule\s+([^,\s]+)")
+
+
+def phase_scope(name: str):
+    """``with phase_scope("factor"): ...`` — the ONE annotation helper
+    every hot-path site uses, so the vocabulary cannot drift per file.
+    Thin over ``jax.named_scope(PHASE_PREFIX + name)``; trace-time only,
+    free at runtime."""
+    import jax
+
+    if name not in PHASES:
+        raise ValueError(
+            f"unknown phase {name!r} — the vocabulary is {PHASES}")
+    return jax.named_scope(PHASE_PREFIX + name)
+
+
+def deepest_phase(scope_path: str) -> "str | None":
+    """The innermost ``phase.*`` component of a scope path (nested
+    scopes: the most specific annotation wins)."""
+    found = None
+    for comp in str(scope_path).split("/"):
+        if comp.startswith(PHASE_PREFIX):
+            found = comp[len(PHASE_PREFIX):]
+    return found
+
+
+#: computation header: ``%name (params...) -> type {`` at column 0
+_COMP_HEAD_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)")
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=")
+_ONAME_RE = re.compile(r"op_name=\"([^\"]*)\"")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _parse_computations(hlo_text: str):
+    """Structural parse of ``compiled.as_text()``: computations, the
+    instructions they hold, per-instruction ``op_name`` metadata, and
+    which computations each instruction references (fusion ``calls=``,
+    while ``body=``/``condition=``, ``to_apply=`` …)."""
+    comps: dict = {}      # computation -> [instruction, ...]
+    comp_of: dict = {}    # instruction -> computation
+    own_path: dict = {}   # instruction -> op_name scope path
+    refs: dict = {}       # instruction -> referenced names
+    cur = None
+    for line in hlo_text.splitlines():
+        if line and not line[0].isspace():
+            if "{" in line and not line.startswith("HloModule"):
+                m = _COMP_HEAD_RE.match(line)
+                if m:
+                    cur = m.group(1)
+                    comps[cur] = []
+            continue
+        if cur is None:
+            continue
+        mi = _INSTR_RE.match(line)
+        if not mi:
+            continue
+        op = mi.group(1)
+        comps[cur].append(op)
+        comp_of[op] = cur
+        mo = _ONAME_RE.search(line)
+        if mo:
+            own_path[op] = mo.group(1)
+        names = set(_REF_RE.findall(line))
+        names.discard(op)
+        refs[op] = names
+    return comps, comp_of, own_path, refs
+
+
+def phase_map_from_hlo(hlo_text: str) -> dict:
+    """Instruction name → phase from a compiled module's text
+    (``compiled.as_text()``).
+
+    Direct attribution reads each instruction's
+    ``metadata={op_name=".../phase.<p>/..."}`` (deepest phase wins).
+    XLA's late loop transforms (linalg expanders like the Cholesky
+    ``InvertDiagBody``, widened/``sunk`` scan bodies) clone instructions
+    WITHOUT metadata, so a second, structural pass lets those inherit:
+    an instruction with no ``op_name`` takes its enclosing computation's
+    phase, where a computation's phase is the unanimous phase of its
+    metadata-carrying instructions, or — when it has none — the
+    unanimous phase of its call sites, walked transitively. Mixed-phase
+    computations (the solver's main while body, ENTRY) inherit nothing:
+    their anonymous glue stays honestly ``unattributed``."""
+    comps, comp_of, own_path, refs = _parse_computations(hlo_text)
+    own: dict = {}
+    for op, path in own_path.items():
+        ph = deepest_phase(path)
+        if ph is not None:
+            own[op] = ph
+    callers: dict = {}
+    for op, names in refs.items():
+        for n in names:
+            if n in comps and n != comp_of.get(op):
+                callers.setdefault(n, []).append(op)
+    comp_vote: dict = {}
+    for c, ops in comps.items():
+        ps = {own[o] for o in ops if o in own}
+        comp_vote[c] = next(iter(ps)) if len(ps) == 1 else None
+    memo: dict = {}
+
+    def inherited(c, stack):
+        if c in memo:
+            return memo[c]
+        p = comp_vote.get(c)
+        if p is None and c not in stack:
+            stack = stack | {c}
+            caller_ps = set()
+            for op in callers.get(c, ()):
+                q = own.get(op)
+                if q is None:
+                    q = inherited(comp_of[op], stack)
+                if q is not None:
+                    caller_ps.add(q)
+            if len(caller_ps) == 1:
+                p = next(iter(caller_ps))
+        memo[c] = p
+        return p
+
+    out = dict(own)
+    for op, c in comp_of.items():
+        if op not in out:
+            p = inherited(c, frozenset())
+            if p is not None:
+                out[op] = p
+    return out
+
+
+def container_ops_from_hlo(hlo_text: str) -> set:
+    """Instruction names of ``while``/``conditional``/``call`` ops —
+    their trace events span the body ops' events and must be excluded
+    from duration totals (measured: a 5-trip while event covers its 5×
+    per-iteration body events)."""
+    return {m.group(1) for m in _CONTAINER_RE.finditer(hlo_text)}
+
+
+def module_name_from_hlo(hlo_text: str) -> "str | None":
+    m = _MODULE_RE.search(hlo_text)
+    return m.group(1) if m else None
+
+
+def hlo_text_for(jitted, *args) -> str:
+    """Compiled-module text of ``jitted(*args)`` for the phase-map join.
+
+    ``.lower()`` RETRACES the function — call this once at setup (the
+    warm executable itself is untouched; the AOT compile rides the same
+    XLA caches), never inside a zero-retrace measured window. The
+    ``[telemetry.profiler]`` gate holds captures to zero extra traces
+    precisely because the map is extracted here, outside them."""
+    return jitted.lower(*args).compile().as_text()
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseProfile:
+    """Per-phase device-time attribution of N warm rounds.
+
+    ``device_ms`` maps phase → average device ms per round and always
+    carries the explicit :data:`UNATTRIBUTED` residual row (possibly
+    0.0). ``coverage`` = attributed ÷ total device time — the ≥0.9
+    acceptance bar of ISSUE 16. ``host_ms`` is the per-round wall-clock
+    remainder (wall − device): dispatch, transfers, Python. Keys are
+    honesty-qualified like every bench metric (``platform``,
+    ``n_devices``/``mesh_shape`` → ``metric_key``), so a CPU-fallback
+    profile can never masquerade as silicon."""
+
+    platform: str
+    rounds: int
+    device_ms: dict            # phase -> ms per round (+ UNATTRIBUTED)
+    op_events: dict            # phase -> device-op event count
+    total_device_ms: float     # per round, containers excluded
+    host_ms: float             # per round wall-clock minus device
+    wall_ms: float             # per round wall-clock of the capture
+    coverage: float            # attributed / total device time
+    metric_key: str            # qualified base key, e.g. phase_ms_cpu
+    n_devices: int = 1
+    mesh_shape: "tuple | None" = None
+    hlo_modules: tuple = ()    # module names seen in the joined events
+
+    def as_dict(self) -> dict:
+        return {
+            "metric_key": self.metric_key,
+            "platform": self.platform,
+            "rounds": self.rounds,
+            "n_devices": self.n_devices,
+            "mesh_shape": (None if self.mesh_shape is None
+                           else list(self.mesh_shape)),
+            "device_ms": {k: round(v, 4) for k, v in sorted(
+                self.device_ms.items(), key=lambda kv: -kv[1])},
+            "op_events": dict(self.op_events),
+            "total_device_ms": round(self.total_device_ms, 4),
+            "host_ms": round(self.host_ms, 4),
+            "wall_ms": round(self.wall_ms, 4),
+            "coverage": round(self.coverage, 4),
+            "hlo_modules": list(self.hlo_modules),
+        }
+
+    def table(self) -> str:
+        """Markdown per-phase table (the --emit-metrics / PERF.md row)."""
+        lines = ["| phase | device ms/round | share | events |",
+                 "|---|---|---|---|"]
+        tot = max(self.total_device_ms, 1e-12)
+        for ph, ms in sorted(self.device_ms.items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"| {ph} | {ms:.3f} | {100 * ms / tot:.1f}% | "
+                         f"{self.op_events.get(ph, 0)} |")
+        lines.append(f"| *total device* | {self.total_device_ms:.3f} | "
+                     f"100% | {sum(self.op_events.values())} |")
+        lines.append(f"| *host remainder* | {self.host_ms:.3f} | — | — |")
+        return "\n".join(lines)
+
+
+def min_profile(profiles: "list[PhaseProfile]") -> "PhaseProfile":
+    """Per-phase minimum over independent captures — the noise-robust
+    estimator the bench uses everywhere (min-of-N): a one-shot OS or
+    autotune spike inflates one capture but not all of them, so the
+    per-phase min removes it, while a persistent slowdown (the thing the
+    regression gate exists to catch) survives in EVERY capture and
+    stays visible. Coverage is recomputed from the combined rows;
+    qualifiers (platform, metric_key) are taken from the first capture
+    and must agree across all of them."""
+    if not profiles:
+        raise ValueError("min_profile needs at least one capture")
+    first = profiles[0]
+    if any(p.metric_key != first.metric_key for p in profiles):
+        raise ValueError("min_profile across mixed metric keys")
+    phases = set()
+    for p in profiles:
+        phases.update(p.device_ms)
+    device_ms = {ph: min(p.device_ms.get(ph, 0.0) for p in profiles)
+                 for ph in phases}
+    device_ms.setdefault(UNATTRIBUTED, 0.0)
+    total = sum(device_ms.values())
+    attributed = total - device_ms[UNATTRIBUTED]
+    return PhaseProfile(
+        platform=first.platform, rounds=first.rounds,
+        device_ms=device_ms,
+        op_events=dict(first.op_events),
+        total_device_ms=total,
+        host_ms=min(p.host_ms for p in profiles),
+        wall_ms=min(p.wall_ms for p in profiles),
+        coverage=(attributed / total) if total > 0 else 0.0,
+        metric_key=first.metric_key, n_devices=first.n_devices,
+        mesh_shape=first.mesh_shape, hlo_modules=first.hlo_modules)
+
+
+def _find_trace_file(trace_dir: str) -> "str | None":
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    return paths[-1] if paths else None
+
+
+def _find_xplane_file(trace_dir: str) -> "str | None":
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb")))
+    return paths[-1] if paths else None
+
+
+def _read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return val, i
+        shift += 7
+
+
+def _wire_fields(buf) -> dict:
+    """Decode one protobuf message's wire fields: field number →
+    [values] (varints as ints, length-delimited as bytes, fixed32/64 as
+    raw bytes). Enough of the wire format for the XSpace schema."""
+    i, n = 0, len(buf)
+    out: dict = {}
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(fnum, []).append(v)
+    return out
+
+
+def _xplane_device_events(path: str) -> list:
+    """Parse a ``*.xplane.pb`` profile into the normalized device-op
+    event dicts ``profile_from_events`` joins.
+
+    This is the UNCAPPED event source: the trace-viewer JSON exporter
+    truncates a session at ~1M events and SILENTLY drops the overflow —
+    measured on the n=64 fused fleet, ONE warm round overflows it and
+    the dropped tail swallowed the mutation self-test's injected ops.
+    The xplane protobuf carries every event, so the observatory reads
+    it directly (hand-decoded: the schema is 6 tiny messages — XSpace
+    planes=1; XPlane name=2/lines=3/event_metadata=4/stat_metadata=5;
+    XLine name=2/events=4; XEvent metadata_id=1/duration_ps=3/stats=4;
+    XStat metadata_id=1/str=5/ref=7; metadata maps key=1/value=2 with
+    id=1/name=2) rather than growing a tensorflow dependency."""
+    with open(path, "rb") as fh:
+        space = _wire_fields(fh.read())
+    events: list = []
+    for plane_buf in space.get(1, ()):
+        plane = _wire_fields(plane_buf)
+        # stat_metadata map: id -> name (values of ref-typed stats and
+        # the stat KEYS both resolve through it)
+        stat_names: dict = {}
+        for entry_buf in plane.get(5, ()):
+            entry = _wire_fields(entry_buf)
+            if 2 not in entry:
+                continue
+            md = _wire_fields(entry[2][0])
+            sid = md.get(1, [0])[0]
+            stat_names[sid] = md.get(2, [b""])[0].decode(
+                "utf-8", "replace")
+        op_key = [sid for sid, nm in stat_names.items()
+                  if nm == "hlo_op"]
+        mod_key = [sid for sid, nm in stat_names.items()
+                   if nm == "hlo_module"]
+        if not op_key:
+            continue
+        op_key_id, mod_key_id = op_key[0], (mod_key[0] if mod_key
+                                            else None)
+
+        def _resolve(ev_buf) -> "tuple | None":
+            """Full stat walk of ONE event — only on metadata-id cache
+            misses (below)."""
+            ev = _wire_fields(ev_buf)
+            op = module = None
+            for stat_buf in ev.get(4, ()):
+                stat = _wire_fields(stat_buf)
+                sid = stat.get(1, [0])[0]
+                if sid != op_key_id and sid != mod_key_id:
+                    continue
+                if 7 in stat:          # ref into stat_metadata
+                    val = stat_names.get(stat[7][0], "")
+                elif 5 in stat:        # inline string
+                    val = stat[5][0].decode("utf-8", "replace")
+                else:
+                    continue
+                if sid == op_key_id:
+                    op = val
+                else:
+                    module = val
+            return None if op is None else (op, module or "")
+
+        # hot loop: a warm fleet round emits MILLIONS of events, so the
+        # per-event work must be three varints + length skips. Events
+        # sharing an XEvent.metadata_id are executions of the same op —
+        # the (op, module) resolution is cached per metadata id, the
+        # stats of cache hits are skipped unparsed, and durations are
+        # aggregated per op in place (ONE normalized event per op,
+        # carrying its execution count as ``occurrences``) instead of
+        # materializing millions of per-execution dicts.
+        op_cache: dict = {}
+        agg_dur: dict = {}
+        agg_cnt: dict = {}
+        rv = _read_varint
+        for line_buf in plane.get(3, ()):
+            i, n = 0, len(line_buf)
+            while i < n:
+                tag, i = rv(line_buf, i)
+                fnum, wt = tag >> 3, tag & 7
+                if wt == 0:
+                    _, i = rv(line_buf, i)
+                    continue
+                if wt == 5:
+                    i += 4
+                    continue
+                if wt == 1:
+                    i += 8
+                    continue
+                ln, i = rv(line_buf, i)
+                if fnum != 4:              # not an XEvent
+                    i += ln
+                    continue
+                ev_buf = line_buf[i:i + ln]
+                i += ln
+                j, m = 0, ln
+                mid = 0
+                dur_ps = 0
+                while j < m:
+                    tag, j = rv(ev_buf, j)
+                    f, w = tag >> 3, tag & 7
+                    if w == 0:
+                        v, j = rv(ev_buf, j)
+                        if f == 1:
+                            mid = v
+                        elif f == 3:
+                            dur_ps = v
+                    elif w == 2:
+                        ln2, j = rv(ev_buf, j)
+                        j += ln2
+                    elif w == 5:
+                        j += 4
+                    else:
+                        j += 8
+                if mid not in op_cache:
+                    op_cache[mid] = _resolve(ev_buf)
+                    agg_dur[mid] = 0
+                    agg_cnt[mid] = 0
+                if op_cache[mid] is None:
+                    continue
+                agg_dur[mid] += dur_ps
+                agg_cnt[mid] += 1
+        for mid, resolved in op_cache.items():
+            if resolved is None or not agg_cnt[mid]:
+                continue
+            events.append({
+                "ph": "X",
+                "dur": agg_dur[mid] / 1e6,   # ps -> us
+                "args": {"hlo_op": resolved[0],
+                         "hlo_module": resolved[1],
+                         "occurrences": agg_cnt[mid]},
+            })
+    return events
+
+
+def _trace_events(trace_dir: str) -> list:
+    xplane = _find_xplane_file(trace_dir)
+    if xplane is not None:
+        try:
+            return _xplane_device_events(xplane)
+        except Exception as exc:  # schema drift on a future jax
+            warnings.warn(
+                "phase profiler: xplane parse failed "
+                f"({exc!r}) — falling back to the trace-viewer JSON "
+                "export, which CAPS a session at ~1M events and "
+                "silently drops the overflow; large-fleet captures "
+                "may under-attribute", RuntimeWarning, stacklevel=2)
+    path = _find_trace_file(trace_dir)
+    if path is None:
+        return []
+    with gzip.open(path, "rt", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("traceEvents") or [])
+
+
+def profile_from_events(events: list, phase_map: dict, *,
+                        rounds: int, platform: str, wall_ms: float,
+                        containers: "set | None" = None,
+                        modules: "tuple | None" = None,
+                        n_devices: int = 1,
+                        mesh_shape: "tuple | None" = None,
+                        base_key: str = "phase_ms") -> PhaseProfile:
+    """Join chrome-trace events against an instruction→phase map.
+
+    Device-op events are the ``ph=="X"`` events carrying
+    ``args.hlo_op`` (measured format of this jax's CPU and TPU
+    backends); ``modules`` (when given) filters to the profiled
+    executable so a stray dispatch in the window cannot pollute the
+    attribution. Container events (``while``/``cond``/``call``) span
+    their bodies and are dropped from totals."""
+    containers = containers or set()
+    device_us: dict = {ph: 0.0 for ph in PHASES}
+    device_us[UNATTRIBUTED] = 0.0
+    op_events: dict = {}
+    seen_modules: set = set()
+    total_us = 0.0
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue
+        mod = args.get("hlo_module", "")
+        if modules and mod not in modules:
+            continue
+        op = str(args["hlo_op"])
+        if op in containers or op.split(".")[0] in ("while",
+                                                    "conditional"):
+            continue
+        dur = float(ev.get("dur") or 0.0)
+        seen_modules.add(mod)
+        ph = phase_map.get(op, UNATTRIBUTED)
+        device_us[ph] = device_us.get(ph, 0.0) + dur
+        # xplane-sourced events are per-op aggregates carrying their
+        # execution count; chrome-trace events are one per execution
+        op_events[ph] = op_events.get(ph, 0) \
+            + int(args.get("occurrences", 1))
+        total_us += dur
+    rounds = max(int(rounds), 1)
+    device_ms = {ph: us / 1e3 / rounds for ph, us in device_us.items()
+                 if us > 0.0 or ph == UNATTRIBUTED}
+    total_ms = total_us / 1e3 / rounds
+    attributed = total_ms - device_ms.get(UNATTRIBUTED, 0.0)
+    from agentlib_mpc_tpu.telemetry.regression import qualified_metric
+
+    return PhaseProfile(
+        platform=platform, rounds=rounds, device_ms=device_ms,
+        op_events=op_events, total_device_ms=total_ms,
+        host_ms=max(wall_ms - total_ms, 0.0), wall_ms=wall_ms,
+        coverage=(attributed / total_ms) if total_ms > 0 else 0.0,
+        metric_key=qualified_metric(base_key, platform, n_devices,
+                                    mesh_shape=mesh_shape),
+        n_devices=n_devices, mesh_shape=mesh_shape,
+        hlo_modules=tuple(sorted(seen_modules)))
+
+
+def capture_phase_profile(run_round, *, rounds: int = 3,
+                          hlo_text: "str | None" = None,
+                          trace_dir: "str | None" = None,
+                          platform: "str | None" = None,
+                          n_devices: "int | None" = None,
+                          mesh_shape: "tuple | None" = None,
+                          base_key: str = "phase_ms",
+                          journal: bool = True) -> PhaseProfile:
+    """Capture ``rounds`` warm rounds under ``jax.profiler.trace`` and
+    attribute the device time by named phase.
+
+    ``run_round`` is a zero-argument callable executing ONE warm round
+    and blocking on the result — it must not retrace (the profiler
+    budget gate runs exactly this loop and pins the compile delta at
+    zero). ``hlo_text`` is the profiled executable's compiled text
+    (:func:`hlo_text_for`, extracted once at setup); without it every
+    device op lands in ``unattributed`` — the capture still reports,
+    with coverage 0, rather than failing. Emits a ``profile.captured``
+    event onto the flight recorder when a journal is active."""
+    import jax
+
+    platform = platform or jax.devices()[0].platform
+    if n_devices is None:
+        n_devices = 1
+    phase_map = phase_map_from_hlo(hlo_text) if hlo_text else {}
+    containers = container_ops_from_hlo(hlo_text) if hlo_text else set()
+    module = module_name_from_hlo(hlo_text) if hlo_text else None
+    own_dir = trace_dir is None
+
+    def _has_device_events(evs):
+        return any(ev.get("ph") == "X"
+                   and isinstance(ev.get("args"), dict)
+                   and "hlo_op" in ev["args"] for ev in evs)
+
+    def _trace_one_round():
+        """ONE round in its OWN profiler session. Each session must stay
+        under the trace exporter's ~1M-event cap: a multi-round session
+        on a real fleet step exceeds it and the exporter SILENTLY drops
+        the overflow device ops (measured: the mutation self-test's
+        injected dots vanished from a 3-round trace while a 1-round
+        trace showed all of them) — the one failure mode a performance
+        observatory cannot have."""
+        tmp = trace_dir or tempfile.mkdtemp(prefix="phase-profile-")
+        try:
+            with jax.profiler.trace(tmp):
+                # wall clock of the round only — trace start/stop is
+                # capture overhead, not the workload's host time
+                t0 = time.perf_counter()
+                run_round()
+                wall_s = time.perf_counter() - t0
+            return _trace_events(tmp), wall_s
+        finally:
+            if own_dir:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    events: list = []
+    wall_s_total = 0.0
+    for i in range(max(int(rounds), 1)):
+        round_events, wall_s = _trace_one_round()
+        # measured on this jax (0.4.x): the process's FIRST profiled
+        # session is flooded by once-per-process python-tracer events —
+        # the exporter's event cap drops every device op, so the join
+        # would read as a 0-event round. One retry (the tracer is dead
+        # by then) recovers it; a genuinely device-event-free workload
+        # just pays one extra capture. Explicit trace_dir: no retry (a
+        # second session would stack trace files in the caller's dir;
+        # per-round sessions already read the newest file each time,
+        # but the retry round's wall clock would double-count).
+        if i == 0 and own_dir and not _has_device_events(round_events):
+            round_events, wall_s = _trace_one_round()
+        events.extend(round_events)
+        wall_s_total += wall_s
+    wall_ms = 1e3 * wall_s_total / max(int(rounds), 1)
+    profile = profile_from_events(
+        events, phase_map, rounds=rounds, platform=platform,
+        wall_ms=wall_ms, containers=containers,
+        modules=(module,) if module else None,
+        n_devices=n_devices, mesh_shape=mesh_shape, base_key=base_key)
+    if journal and _journal_mod._GLOBAL is not None:
+        _journal_mod.record(
+            "profile.captured", metric_key=profile.metric_key,
+            rounds=profile.rounds, coverage=round(profile.coverage, 4),
+            total_device_ms=round(profile.total_device_ms, 4),
+            phases={k: round(v, 4)
+                    for k, v in profile.device_ms.items()})
+    return profile
+
+
+class PeriodicCapture:
+    """Every-K-rounds capture hook (``ServingPlane(profile_every=K)``).
+
+    The non-capture path is one integer modulo — the <5% telemetry
+    overhead budget applies to it (``tests/test_telemetry_overhead.py``
+    profiler leg) — and ``every=None`` disables the hook into a true
+    no-op (``tick()`` just calls through). A due round runs inside
+    ``jax.profiler.trace``; the resulting per-phase times land in the
+    ``phase_device_ms`` histogram (labelled ``phase``/``bucket``, so
+    the scrape endpoint serves the distribution) and as a
+    ``profile.captured`` journal event. The phase map per executable is
+    cached on first capture — the one-time ``.lower()`` retrace never
+    repeats."""
+
+    def __init__(self, every: "int | None", rounds: int = 1,
+                 base_key: str = "phase_ms", n_devices: int = 1,
+                 mesh_shape: "tuple | None" = None):
+        if every is not None and int(every) < 1:
+            raise ValueError(f"profile_every must be >= 1, got {every}")
+        self.every = None if every is None else int(every)
+        self.rounds = max(int(rounds), 1)
+        self.base_key = base_key
+        self.n_devices = max(int(n_devices), 1)
+        self.mesh_shape = mesh_shape
+        self.captures = 0
+        self.last_profile: "PhaseProfile | None" = None
+        self._calls = 0
+        self._hlo_cache: dict = {}   # cache key -> (text or None)
+
+    def due(self) -> bool:
+        """Is the NEXT tick a capture round? (modulo check only)"""
+        if self.every is None:
+            return False
+        return self._calls % self.every == 0
+
+    def hlo_for(self, cache_key, jitted, *args) -> "str | None":
+        """Cached compiled-text lookup: the ``.lower()`` retrace is paid
+        once per executable, at the first due round, never again."""
+        if cache_key not in self._hlo_cache:
+            try:
+                self._hlo_cache[cache_key] = hlo_text_for(jitted, *args)
+            except Exception:  # noqa: BLE001 — AOT text unavailable
+                self._hlo_cache[cache_key] = None
+        return self._hlo_cache[cache_key]
+
+    def tick(self, run_round, *, hlo_text: "str | None" = None,
+             label: str = "", platform: "str | None" = None):
+        """Run one round; capture it when due. Returns ``run_round()``'s
+        result on the fast path, the captured :class:`PhaseProfile` on
+        a capture round (the round still runs, inside the trace)."""
+        if self.every is None:
+            return run_round()
+        due = self._calls % self.every == 0
+        self._calls += 1
+        if not due:
+            return run_round()
+        profile = capture_phase_profile(
+            run_round, rounds=self.rounds, hlo_text=hlo_text,
+            platform=platform, n_devices=self.n_devices,
+            mesh_shape=self.mesh_shape, base_key=self.base_key)
+        self.captures += 1
+        self.last_profile = profile
+        reg = _registry_mod.DEFAULT
+        if reg.enabled:
+            hist = reg.histogram(
+                "phase_device_ms",
+                "per-phase device milliseconds per round from periodic "
+                "profile captures (profile_every=K)",
+                buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0,
+                         100.0, 500.0))
+            for ph, ms in profile.device_ms.items():
+                hist.observe(ms, phase=ph,
+                             bucket=label or "-")
+        return profile

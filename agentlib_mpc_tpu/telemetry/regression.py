@@ -1,0 +1,203 @@
+"""Per-phase performance baselines + the continuous regression gate.
+
+The third layer of the performance observatory: persist what
+:mod:`.profiler` measured, estimate how noisy the machine is, and fail
+``bench.py --perf-gate`` when a phase drifts out of band — the
+automated defense PERF.md's manual tables never were.
+
+Honesty rules carry over verbatim from the bench (ISSUE 2/9/14):
+baseline keys are qualified by :func:`qualified_metric` — unqualified
+names are reserved for TPU, everything else gets ``_<platform>``, a
+mesh run gets ``_d<n>`` or the full ``_d<A>x<S>`` 2-D shape, a degraded
+round ``_degraded`` — so a CPU-fallback baseline can never gate (or be
+gated by) a silicon run: they are different experiments under different
+keys, and a key with no baseline is a SKIP with a note, never a pass
+invented from the wrong platform's numbers.
+
+Noise bands come from repeated samples at baseline-update time: band =
+max(observed spread across update captures, ``rel_floor`` of the mean,
+``abs_floor_ms``) — a shared-CI-runner's scheduler jitter is absorbed
+by the floors, a real slowdown is not. The gate verdict is one-sided:
+only slower-than-band fails (an improvement is recorded as a note so a
+suspicious speedup is still visible in the report). Both outcomes land
+on the flight recorder: ``perf.gate`` (status pass/fail) always, plus
+one ``perf.regression`` event per offending phase — which the incident
+CLI renders in its timeline, so performance drift shows up next to the
+faults it often explains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from agentlib_mpc_tpu.telemetry import journal as _journal_mod
+from agentlib_mpc_tpu.telemetry.profiler import (
+    UNATTRIBUTED as _UNATTRIBUTED,
+)
+
+__all__ = [
+    "check_regression", "load_baselines", "qualified_metric",
+    "update_baseline",
+]
+
+#: default noise-band floors: relative to the phase mean, and absolute
+#: (sub-0.05 ms phases are pure scheduler noise on every platform)
+REL_FLOOR = 0.25
+ABS_FLOOR_MS = 0.05
+#: phases thinner than this never gate — a 20 µs row's "regression" is
+#: timer granularity, not performance
+MIN_GATE_MS = 0.02
+
+
+def qualified_metric(base: str, platform: str, n_devices: int = 1,
+                     degraded: bool = False,
+                     mesh_shape: "tuple | None" = None) -> str:
+    """The ONE metric-qualification rule (shared with ``bench.py``,
+    which delegates here): unqualified names are reserved for TPU; any
+    other platform gets a ``_<platform>`` suffix; a measurement spanning
+    a device mesh gains ``_d<n>`` — or the full ``_d<A>x<S>`` shape for
+    a 2-D grid — and a degraded round ``_degraded``. Two qualified keys
+    are comparable iff they are equal; the baseline store and the gate
+    both key on this."""
+    name = base if platform == "tpu" else f"{base}_{platform}"
+    if mesh_shape is not None:
+        name = f"{name}_d{'x'.join(str(int(s)) for s in mesh_shape)}"
+    elif n_devices > 1:
+        name = f"{name}_d{n_devices}"
+    return f"{name}_degraded" if degraded else name
+
+
+def load_baselines(path: str) -> dict:
+    """The committed baseline store: ``{metric_key: entry}`` with
+    ``entry = {"phases": {phase: {"mean_ms", "band_ms", "n"}},
+    "total_device_ms", "platform", "rounds"}``. Missing file → empty
+    store (every key skips with a note)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _band(samples: "list[float]", rel_floor: float,
+          abs_floor_ms: float) -> float:
+    mean = sum(samples) / max(len(samples), 1)
+    spread = (max(samples) - min(samples)) if len(samples) > 1 else 0.0
+    return max(spread, rel_floor * mean, abs_floor_ms)
+
+
+def update_baseline(path: str, profiles: list, *,
+                    rel_floor: float = REL_FLOOR,
+                    abs_floor_ms: float = ABS_FLOOR_MS) -> dict:
+    """Fold repeated :class:`~.profiler.PhaseProfile` samples (same
+    ``metric_key``) into the baseline store at ``path`` and write it
+    back. Multiple samples estimate the noise band per phase; a single
+    sample gets the floors. Other keys in the store are preserved —
+    a CPU update never touches a TPU row."""
+    if not profiles:
+        raise ValueError("update_baseline needs at least one profile")
+    keys = {p.metric_key for p in profiles}
+    if len(keys) != 1:
+        raise ValueError(
+            f"profiles span multiple metric keys {sorted(keys)} — "
+            f"baselines are per qualified key (different platforms/"
+            f"meshes are different experiments)")
+    key = profiles[0].metric_key
+    phases: dict = {}
+    names: set = set()
+    for p in profiles:
+        names |= set(p.device_ms)
+    for ph in sorted(names):
+        samples = [p.device_ms.get(ph, 0.0) for p in profiles]
+        phases[ph] = {
+            "mean_ms": round(sum(samples) / len(samples), 4),
+            "band_ms": round(_band(samples, rel_floor, abs_floor_ms), 4),
+            "n": len(samples),
+        }
+    store = load_baselines(path)
+    store[key] = {
+        "phases": phases,
+        "total_device_ms": round(
+            sum(p.total_device_ms for p in profiles) / len(profiles), 4),
+        "platform": profiles[0].platform,
+        "rounds": profiles[0].rounds,
+        "coverage": round(
+            sum(p.coverage for p in profiles) / len(profiles), 4),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(store, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return store[key]
+
+
+def check_regression(baselines: "dict | str", profile, *,
+                     journal: bool = True) -> dict:
+    """Gate one measured profile against its baseline row.
+
+    Returns ``{"status": "pass"|"fail"|"skip", "metric_key", ...,
+    "violations": [...], "improvements": [...], "notes": [...]}``.
+    ``skip`` (no baseline under this qualified key) is explicit — the
+    caller decides whether a missing baseline is an error (CI on the
+    pinned platform) or expected (first run on new silicon). Journals
+    ``perf.gate`` with the verdict and one ``perf.regression`` per
+    out-of-band phase."""
+    store = load_baselines(baselines) if isinstance(baselines, str) \
+        else baselines
+    key = profile.metric_key
+    entry = store.get(key)
+    report: dict = {"metric_key": key, "platform": profile.platform,
+                    "violations": [], "improvements": [], "notes": []}
+    if entry is None:
+        report["status"] = "skip"
+        report["notes"].append(
+            f"no baseline under key {key!r} (keys present: "
+            f"{sorted(store)}) — record one with --perf-gate --update")
+        if journal:
+            _journal_event("perf.gate", status="skip", metric_key=key)
+        return report
+    for ph, base in sorted(entry.get("phases", {}).items()):
+        measured = profile.device_ms.get(ph, 0.0)
+        mean, band = float(base["mean_ms"]), float(base["band_ms"])
+        if max(measured, mean) < MIN_GATE_MS:
+            continue
+        if ph == _UNATTRIBUTED and measured > mean + band:
+            # the residual row is attribution quality, not a workload
+            # phase — its excursions are surfaced, never CI-failing
+            # (its scale is noise-level: a few-µs excess would flake
+            # an otherwise-green A/A)
+            report["notes"].append(
+                f"unattributed residual above band "
+                f"({measured:.3f} ms vs {mean}±{band} ms) — "
+                f"attribution drift, check coverage")
+            continue
+        if measured > mean + band:
+            report["violations"].append({
+                "phase": ph, "measured_ms": round(measured, 4),
+                "baseline_ms": mean, "band_ms": band,
+                "excess_ms": round(measured - mean - band, 4)})
+        elif measured < mean - band:
+            report["improvements"].append({
+                "phase": ph, "measured_ms": round(measured, 4),
+                "baseline_ms": mean, "band_ms": band})
+    for ph in sorted(profile.device_ms):
+        if ph not in entry.get("phases", {}) \
+                and profile.device_ms[ph] >= MIN_GATE_MS:
+            report["notes"].append(
+                f"phase {ph!r} has no baseline row "
+                f"({profile.device_ms[ph]:.3f} ms measured) — "
+                f"re-record the baseline")
+    report["status"] = "fail" if report["violations"] else "pass"
+    if journal:
+        if report["violations"]:
+            for v in report["violations"]:
+                _journal_event("perf.regression", metric_key=key, **v)
+        _journal_event(
+            "perf.gate", status=report["status"], metric_key=key,
+            violations=len(report["violations"]),
+            improvements=len(report["improvements"]))
+    return report
+
+
+def _journal_event(etype: str, **fields) -> None:
+    if _journal_mod._GLOBAL is not None:
+        _journal_mod.record(etype, **fields)

@@ -117,6 +117,11 @@ class SpanRecorder:
     controllers keep exact count/total/max per span name even after the
     individual records have been overwritten."""
 
+    #: per-name duration samples retained for percentile estimation —
+    #: bounded so long-lived controllers don't grow without limit;
+    #: p50/p99 are over the most recent SAMPLE_WINDOW records per name.
+    SAMPLE_WINDOW = 1024
+
     def __init__(self, capacity: int = 4096):
         if capacity < 1:
             raise ValueError("SpanRecorder capacity must be >= 1")
@@ -128,6 +133,7 @@ class SpanRecorder:
         #                      the observability layer reports its own
         #                      loss instead of overflowing silently)
         self._agg: dict[str, dict] = {}
+        self._samples: dict[str, list] = {}
         self._lock = threading.Lock()
 
     @property
@@ -158,6 +164,10 @@ class SpanRecorder:
             d = rec.duration or 0.0
             agg["total_s"] += d
             agg["max_s"] = max(agg["max_s"], d)
+            samples = self._samples.setdefault(rec.name, [])
+            samples.append(d)
+            if len(samples) > self.SAMPLE_WINDOW:
+                del samples[: len(samples) - self.SAMPLE_WINDOW]
         if evicting and _registry_mod.DEFAULT._enabled:
             # outside the recorder lock (the registry has its own)
             _registry_mod.DEFAULT.counter(
@@ -180,20 +190,39 @@ class SpanRecorder:
             self._count = 0
             self._dropped = 0
             self._agg = {}
+            self._samples = {}
+
+    @staticmethod
+    def _quantile(sorted_samples: list, q: float) -> float:
+        """Nearest-rank quantile over a pre-sorted sample list."""
+        if not sorted_samples:
+            return 0.0
+        idx = min(len(sorted_samples) - 1,
+                  max(0, int(round(q * (len(sorted_samples) - 1)))))
+        return sorted_samples[idx]
 
     def aggregate(self) -> dict:
-        """name -> {count, total_s, max_s} over EVERY span ever recorded
-        (running totals maintained at record time, immune to ring-buffer
-        eviction) — the per-phase wall-clock breakdown
-        ``bench.py --emit-metrics`` emits. When ring eviction has
-        dropped individual records, a reserved ``"_dropped_spans"`` row
-        (same shape) reports the loss — the observability layer
-        accounts for its own blind spots."""
+        """name -> {count, total_s, max_s, p50_s, p99_s} over EVERY span
+        ever recorded (running totals maintained at record time, immune
+        to ring-buffer eviction) — the per-phase wall-clock breakdown
+        ``bench.py --emit-metrics`` emits. count/total_s/max_s cover the
+        full history; p50_s/p99_s are nearest-rank estimates over the
+        most recent ``SAMPLE_WINDOW`` durations per name. When ring
+        eviction has dropped individual records, a reserved
+        ``"_dropped_spans"`` row (same shape) reports the loss — the
+        observability layer accounts for its own blind spots."""
         with self._lock:
-            out = {name: dict(agg) for name, agg in self._agg.items()}
+            out = {}
+            for name, agg in self._agg.items():
+                row = dict(agg)
+                srt = sorted(self._samples.get(name, ()))
+                row["p50_s"] = self._quantile(srt, 0.50)
+                row["p99_s"] = self._quantile(srt, 0.99)
+                out[name] = row
             if self._dropped:
                 out["_dropped_spans"] = {"count": self._dropped,
-                                         "total_s": 0.0, "max_s": 0.0}
+                                         "total_s": 0.0, "max_s": 0.0,
+                                         "p50_s": 0.0, "p99_s": 0.0}
             return out
 
 

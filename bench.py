@@ -894,6 +894,17 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["slo_report"] = _emit_metrics_slo_report()
     except Exception as exc:
         payload["slo_report"] = {"error": repr(exc)}
+    # per-phase device attribution + the certificate-calibrated
+    # roofline (ISSUE 16): measured phase table and modeled FLOP/bytes
+    # ledger joined over the SAME named scopes, beside the span/compile
+    # sections — runs after the phase counters above were read, so its
+    # one-time HLO-join retrace never pollutes the compile economics
+    try:
+        payload["phase_profile"], payload["calibration"] = \
+            _emit_metrics_phase_section(step, args, carry)
+    except Exception as exc:
+        payload["phase_profile"] = {"error": repr(exc)}
+        payload["calibration"] = {"error": repr(exc)}
     # ... and the flight recorder's own volume accounting (events by
     # type, bytes, rotations) — the observability layer reports itself
     try:
@@ -911,10 +922,165 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         "compile_count": payload["phases"]["compile_count"],
         "compile_seconds_total": round(
             payload["phases"]["compile_seconds_total"], 2),
+        "phase_coverage": payload["phase_profile"].get("coverage"),
         "platform": payload["platform"],
     }
     print(json.dumps(summary))
     return payload
+
+
+def _emit_metrics_phase_section(step, args, carry):
+    """The --emit-metrics observatory section: capture a 3-round phase
+    profile of the warm (record_stats) step and join it against the
+    certificate cost model. Returns ``(phase_profile, calibration)``
+    dicts, each with its rendered markdown ``table``."""
+    import jax
+
+    from agentlib_mpc_tpu.telemetry import calibration
+    from agentlib_mpc_tpu.telemetry.profiler import (
+        capture_phase_profile,
+        hlo_text_for,
+    )
+
+    wargs = (args[0], args[1], *carry[:5], args[7])
+    hlo = hlo_text_for(step, *wargs)
+
+    def run_round():
+        jax.block_until_ready(step(*wargs))
+
+    prof = capture_phase_profile(run_round, rounds=3, hlo_text=hlo)
+    costs = calibration.phase_costs(step, *wargs)
+    report = calibration.calibrate(prof, costs)
+    return (dict(prof.as_dict(), table=prof.table()),
+            dict(report.as_dict(), table=report.table()))
+
+
+def _bench_phase_setup(n_agents: int, mutate: bool = False):
+    """Warm fused step + per-round runner + compiled text for the phase
+    profiler (ISSUE 16). ``mutate=True`` wraps the step with artificial
+    extra work INSIDE the ``phase.factor`` scope — the perf-gate's
+    self-test fault injection: the gate must fail this and pass A/A.
+    The extra work is data-dependent on the step's output and folded
+    back into it (×1e-30, numerically invisible) so XLA can neither
+    constant-fold nor dead-code-eliminate it."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.telemetry.profiler import (
+        hlo_text_for,
+        phase_scope,
+    )
+
+    step, args = build_step(n_agents)
+    if mutate:
+        inner = step
+
+        @jax.jit
+        def step(*a):  # noqa: F811 — deliberate mutated shadow
+            out = inner(*a)
+            with phase_scope("factor"):
+                # sized to land decisively OUTSIDE the factor noise
+                # band (25% of mean): ~8.6 GFLOP of serial dependent
+                # matmuls ≈ tens of ms on CPU vs a ~7 ms band
+                x = jnp.eye(512, dtype=jnp.float32) \
+                    + 1e-30 * out[0][0, 0]
+                for _ in range(32):
+                    x = (x @ x) * (1.0 / 512.0)
+                extra = jnp.sum(x) * 1e-30
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            leaves[0] = leaves[0] + extra.astype(leaves[0].dtype)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    out = step(*args)
+    jax.block_until_ready(out)
+    hlo = hlo_text_for(step, *args)
+
+    def run_round():
+        jax.block_until_ready(warm_step(step, args, out))
+
+    return run_round, hlo
+
+
+def run_phase_profile(n_agents: int = 64, rounds: int = 3,
+                      journal: bool = False) -> dict:
+    """Named-phase device attribution of the warm fused bench step (the
+    ``--evidence`` matrix's ``phase_profile`` section): where a warm
+    round's device time goes, per ``phase.*`` scope, with the explicit
+    ``unattributed`` residual and the coverage ratio."""
+    from agentlib_mpc_tpu.telemetry.profiler import capture_phase_profile
+
+    run_round, hlo = _bench_phase_setup(n_agents)
+    prof = capture_phase_profile(run_round, rounds=rounds,
+                                 hlo_text=hlo, journal=journal)
+    return {"n_agents": n_agents, **prof.as_dict()}
+
+
+def run_perf_gate(baseline_path: "str | None" = None, *,
+                  update: bool = False, mutate: bool = False,
+                  n_agents: int = 64, rounds: int = 3,
+                  samples: int = 2,
+                  journal_path: "str | None" = None) -> dict:
+    """``--perf-gate``: the per-phase performance regression gate
+    (ISSUE 16) — capture a phase profile of the warm fused step and
+    check it against the committed, platform-qualified baselines
+    (``perf_baselines.json``); out-of-band phases FAIL the gate (exit
+    1), improvements are noted, a missing key under this platform is an
+    explicit SKIP. ``--update`` records ``samples`` captures as the new
+    baseline (noise band = observed spread with rel/abs floors);
+    ``--mutate`` self-tests the gate by injecting extra ``factor``-phase
+    work that MUST trip it. Verdicts are journaled (``perf.gate`` +
+    per-phase ``perf.regression``) when ``--journal PATH`` is given or
+    a journal is already active."""
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.telemetry import regression
+    from agentlib_mpc_tpu.telemetry.profiler import capture_phase_profile
+
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "perf_baselines.json")
+    own_journal = journal_path is not None \
+        and telemetry.journal_active() is None
+    if own_journal:
+        telemetry.enable_journal(journal_path)
+    try:
+        run_round, hlo = _bench_phase_setup(n_agents, mutate=mutate)
+        if update:
+            profiles = [capture_phase_profile(run_round, rounds=rounds,
+                                              hlo_text=hlo)
+                        for _ in range(max(int(samples), 1))]
+            entry = regression.update_baseline(baseline_path, profiles)
+            row = {"metric": "perf_gate", "mode": "update",
+                   "metric_key": profiles[0].metric_key,
+                   "platform": profiles[0].platform,
+                   "n_agents": n_agents, "path": baseline_path,
+                   "coverage": entry["coverage"],
+                   "phases": entry["phases"]}
+            print(json.dumps(row))
+            return row
+        # check mode is min-of-`samples` captures per phase: a one-shot
+        # OS/autotune spike (CPU eval_jac is bimodal across processes)
+        # disappears under the min, while a persistent slowdown — the
+        # mutation self-test, a real regression — survives every
+        # capture and still trips the gate
+        from agentlib_mpc_tpu.telemetry.profiler import min_profile
+        profile = min_profile(
+            [capture_phase_profile(run_round, rounds=rounds,
+                                   hlo_text=hlo)
+             for _ in range(max(int(samples), 1))])
+        report = regression.check_regression(baseline_path, profile)
+        row = {"metric": "perf_gate",
+               "mode": "mutate" if mutate else "check",
+               "n_agents": n_agents,
+               "coverage": round(profile.coverage, 4),
+               "measured_ms": {k: round(v, 4)
+                               for k, v in profile.device_ms.items()},
+               **report}
+        print(json.dumps(row))
+        return row
+    finally:
+        if own_journal:
+            telemetry.disable_journal()
 
 
 def run_mesh_ab(sizes=(256, 1024), device_counts=(1, 8)) -> list[dict]:
@@ -2742,6 +2908,8 @@ def run_evidence() -> None:
     # one size keeps the matrix inside the worker watchdog; the full
     # 256-4096 table is the on-demand `--mesh-ab` run (PERF.md round 10)
     section("mesh_ab", lambda: run_mesh_ab(sizes=(256,)))
+    # where the warm round's device time goes, by named phase (ISSUE 16)
+    section("phase_profile", run_phase_profile)
 
 
 # --- fail-soft orchestration (round-3 lesson: a wedged TPU tunnel hangs
@@ -2994,13 +3162,17 @@ def _qualified_metric(base: str, platform: str, n_devices: int = 1,
     ``_degraded`` (ISSUE 10/14 — a fallback round must never read as
     the full-mesh steady state's regression, or its improvement; a
     degraded 2-D round publishes ``_d<A>x<S>_degraded`` at its reduced
-    shape, never the full-mesh key)."""
-    name = base if platform == "tpu" else f"{base}_{platform}"
-    if mesh_shape is not None:
-        name = f"{name}_d{'x'.join(str(int(s)) for s in mesh_shape)}"
-    elif n_devices > 1:
-        name = f"{name}_d{n_devices}"
-    return f"{name}_degraded" if degraded else name
+    shape, never the full-mesh key).
+
+    The rule itself lives in
+    :func:`agentlib_mpc_tpu.telemetry.regression.qualified_metric`
+    (ISSUE 16: the perf-gate baselines key on the same rule — a gate
+    keyed differently from the bench would compare different
+    experiments); this wrapper keeps the local name bench callers use."""
+    from agentlib_mpc_tpu.telemetry.regression import qualified_metric
+
+    return qualified_metric(base, platform, n_devices, degraded,
+                            mesh_shape)
 
 
 def _headline_metric(platform: str, n_devices: int = 1,
@@ -3126,6 +3298,28 @@ def main() -> None:
             n = int(sys.argv[idx + 2])
         run_chaos(seed, n)
         return
+
+    if "--perf-gate" in sys.argv:
+        # per-phase regression gate, in-process (pin JAX_PLATFORMS=cpu
+        # for a tunnel-free host run — baselines are platform-qualified
+        # so a CPU run gates only against CPU baselines):
+        #   python bench.py --perf-gate [BASELINE_PATH] [n_agents]
+        #       [--update] [--mutate] [--journal PATH]
+        idx = sys.argv.index("--perf-gate")
+        path, n = None, 64
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            path = sys.argv[idx + 1]
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        jpath = None
+        if "--journal" in sys.argv:
+            j = sys.argv.index("--journal")
+            if len(sys.argv) > j + 1:
+                jpath = sys.argv[j + 1]
+        row = run_perf_gate(path, update="--update" in sys.argv,
+                            mutate="--mutate" in sys.argv,
+                            n_agents=n, journal_path=jpath)
+        sys.exit(1 if row.get("status") == "fail" else 0)
 
     if "--emit-metrics" in sys.argv:
         # telemetry-instrumented run, in-process (initializes JAX here;

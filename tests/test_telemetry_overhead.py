@@ -73,7 +73,8 @@ def bench_step():
         jax.block_until_ready(carry)
         step_times.append(time.perf_counter() - t0)
     telemetry.configure(enabled=True)
-    return stats, min(step_times)
+    return {"stats": stats, "t_step": min(step_times), "step": step,
+            "args": args, "carry": carry}
 
 
 def test_instrumented_bench_step_overhead_under_5_percent(bench_step):
@@ -83,7 +84,7 @@ def test_instrumented_bench_step_overhead_under_5_percent(bench_step):
     step's own wall-clock — differencing two ~250 ms step timings would
     drown the ~1 ms telemetry cost in this VM's ±8% scheduler noise and
     flake either way."""
-    stats, t_step = bench_step
+    stats, t_step = bench_step["stats"], bench_step["t_step"]
 
     # worst-of-5 cost of EVERYTHING telemetry adds per instrumented step
     telemetry.configure(enabled=True)
@@ -113,7 +114,7 @@ def test_journal_enabled_leg_holds_the_same_budget(bench_step, tmp_path):
     full metric/span load must still fit the same <5% budget. Journal
     writes are a json.dumps + one buffered write + flush each; if this
     leg ever breaches, an emit site started doing real work per round."""
-    stats, t_step = bench_step
+    stats, t_step = bench_step["stats"], bench_step["t_step"]
 
     telemetry.configure(enabled=True)
     journal = telemetry.enable_journal(str(tmp_path / "overhead.jsonl"))
@@ -141,6 +142,88 @@ def test_journal_enabled_leg_holds_the_same_budget(bench_step, tmp_path):
         f"journal-enabled per-step telemetry work "
         f"{1e3 * t_journal:.2f} ms exceeds 5% of the "
         f"{1e3 * t_step:.1f} ms fused step")
+
+
+def test_periodic_profile_capture_overhead_under_budget(bench_step):
+    """ISSUE 16 CI satellite: ``ServingPlane(profile_every=K)`` budget.
+    A capture round runs the SAME warm step inside ``jax.profiler.
+    trace`` plus host-side trace parsing and the phase join; amortized
+    over the K-1 plain rounds between captures, that excess must stay
+    under the 5% budget. A capture round is genuinely expensive —
+    profiler session start/stop, the xplane write-out and the event
+    parse are each host-side seconds — so the budget pins the CADENCE
+    at which continuous profiling is honest (K in the thousands; at
+    K=25 no implementation could amortize a multi-second capture under
+    5% of a ~100 ms step, and a budget that pretended otherwise would
+    just be untested). As with the other legs, the honest measurement
+    is the capture round's standalone excess over the step's own
+    wall-clock — a 2K-round A/B difference would drown it in scheduler
+    noise."""
+    from agentlib_mpc_tpu.telemetry import profiler as profiler_mod
+
+    step, args = bench_step["step"], bench_step["args"]
+    t_step = bench_step["t_step"]
+    state = {"carry": bench_step["carry"]}
+
+    def run_round():
+        c, _s = step(args[0], args[1], *state["carry"][:5], args[7])
+        jax.block_until_ready(c)
+        state["carry"] = c
+
+    every = 2000
+    cap = profiler_mod.PeriodicCapture(every, rounds=1)
+    # setup, outside the measured budget: the one-time .lower() retrace
+    # for the phase join, and one throwaway capture to burn jax's
+    # once-per-process first-trace-session python-tracer flood
+    hlo = cap.hlo_for(
+        "bench", step, args[0], args[1], *state["carry"][:5], args[7])
+    assert hlo is not None
+    profiler_mod.capture_phase_profile(
+        run_round, rounds=1, hlo_text=hlo, journal=False)
+
+    # the non-capture path is one integer modulo, nothing else
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        cap.due()
+    assert time.perf_counter() - t0 < 0.05
+
+    # capture-round excess over a plain warm round, amortized over K
+    times = []
+    for _ in range(2):
+        cap._calls = 0                      # force a due round
+        t0 = time.perf_counter()
+        prof = cap.tick(run_round, hlo_text=hlo, label="overhead",
+                        platform=jax.default_backend())
+        times.append(time.perf_counter() - t0)
+    excess = max(min(times) - t_step, 0.0)
+
+    assert excess <= REL_BUDGET * every * t_step, (
+        f"capture-round excess {1e3 * excess:.1f} ms exceeds the "
+        f"amortized 5% budget over profile_every={every} rounds of the "
+        f"{1e3 * t_step:.1f} ms fused step")
+    # the captures really recorded (not a no-op A/A)
+    assert cap.captures == 2
+    assert prof is not None and sum(prof.op_events.values()) > 0
+    assert telemetry.metrics().get(
+        "phase_device_ms", phase="resolve", bucket="overhead") is not None
+
+
+def test_disabled_periodic_capture_is_a_true_noop():
+    """``profile_every=None`` (the default) must degrade the hook to a
+    call-through: no due rounds, no profiler session, no histogram —
+    the serving fast path stays byte-identical to the uninstrumented
+    one."""
+    from agentlib_mpc_tpu.telemetry.profiler import PeriodicCapture
+
+    cap = PeriodicCapture(None)
+    assert not cap.due()
+    calls = []
+    out = cap.tick(lambda: calls.append(1) or "result")
+    assert out == "result" and calls == [1]
+    assert cap.captures == 0 and cap.last_profile is None
+    assert cap._calls == 0          # not even the modulo counter moves
+    assert telemetry.metrics().get(
+        "phase_device_ms", phase="resolve", bucket="-") is None
 
 
 def test_disabled_fast_path_is_structurally_free():
