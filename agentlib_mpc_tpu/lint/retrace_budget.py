@@ -933,7 +933,20 @@ def run_serving_gate(budgets: "dict | None" = None,
        DATA — the per-entry-point (traces + compiles) delta across the
        health churn is held to the ``[serving.health.budgets]``
        allowance (default 0), so the survivability ladder can never
-       reintroduce retrace churn.
+       reintroduce retrace churn;
+    5. **autopilot ladder cycle** (the ``[serving.autopilot]`` budget,
+       ISSUE 17) — a fresh autopilot-armed plane joins ONE robust
+       (2-branch fan) tenant and force-walks the full quality ladder
+       down and back (L0 → L1 → L2 → L3 → L2 → L1 → L0, serving at
+       every rung) twice: the FIRST cycle pays each quality level's
+       cold build once (L1's warm-capped robust bucket, L3's
+       subtree-collapsed flat bucket), the SECOND — measured — cycle
+       must come entirely out of the compile cache, with the
+       per-entry-point (traces + compiles) delta held to the
+       ``[serving.autopilot.budgets]`` allowance (default 0): a
+       quality move is a re-bucket through the cache, never a
+       recompile, or the controller would pay a cold build at the
+       exact moment the plane is drowning.
     """
     from agentlib_mpc_tpu import telemetry
     from agentlib_mpc_tpu.telemetry import jax_events
@@ -1019,6 +1032,63 @@ def run_serving_gate(budgets: "dict | None" = None,
         h_after = _compile_snapshot(reg)
         plane.leave("h0")
         plane.leave("t1")
+
+        # -- autopilot ladder cycle (ISSUE 17): quality moves are ------
+        # -- re-buckets through the cache, never recompiles ------------
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from agentlib_mpc_tpu.scenario.tree import fan_tree
+        from agentlib_mpc_tpu.serving import AutopilotPolicy, TenantSpec
+
+        auto_cfg = dict(cfg.get("autopilot", {}) or {})
+        auto_budgets = dict(auto_cfg.get("budgets", {}) or {})
+        auto_default = int(auto_budgets.pop("default", 0))
+        plane2 = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=capacity,
+            pipelined=True, donate=True, autopilot=AutopilotPolicy())
+        # one ROBUST tenant (2-branch fan, skewed probabilities) so the
+        # cycle exercises every lever class: L1 re-buckets into the
+        # warm-capped robust bucket, L3 shrinks the tree to its
+        # highest-probability branch — which normalizes into a FLAT
+        # capped bucket — and the way back up restores both
+        tree = fan_tree(2, probabilities=(0.7, 0.3))
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+
+        theta = jax.tree.map(
+            lambda leaf: jnp.broadcast_to(jnp.asarray(leaf),
+                                          (2,) + np.shape(leaf)),
+            ocp.default_params())
+        theta = theta._replace(
+            p=jnp.stack([jnp.array([1.0]), jnp.array([2.0])]))
+        plane2.join(TenantSpec(
+            tenant_id="r0", ocp=ocp, theta=theta,
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30),
+            scenario_tree=tree))
+
+        def ladder_cycle():
+            for lvl in (1, 2, 3, 2, 1, 0):
+                if not plane2.autopilot.force_level(plane2, "r0", lvl):
+                    failures.append(
+                        f"autopilot force_level({lvl}) was refused "
+                        f"mid-cycle")
+                serve_tenants(plane2, "r0", rounds=serve_rounds)
+
+        ladder_cycle()                # warmup: pays each level's build
+        a_hits_before = plane2.cache.hits
+        a_before = _compile_snapshot(reg)
+        ladder_cycle()                # measured: cache hits only
+        a_after = _compile_snapshot(reg)
+        if plane2.cache.hits <= a_hits_before:
+            failures.append(
+                "autopilot ladder cycle did not advance the compile-"
+                "cache hit counter — the quality moves bypassed the "
+                "cache")
+        plane2.leave("r0")
     finally:
         telemetry.configure(enabled=was_enabled)
 
@@ -1037,15 +1107,25 @@ def run_serving_gate(budgets: "dict | None" = None,
         if delta > budget:
             violations.append({"entry_point": f"health:{entry}",
                                "observed": delta, "budget": budget})
+    autopilot_deltas = {k: a_after.get(k, 0) - a_before.get(k, 0)
+                        for k in set(a_before) | set(a_after)}
+    for entry, delta in sorted(autopilot_deltas.items()):
+        budget = int(auto_budgets.get(entry, auto_default))
+        if delta > budget:
+            violations.append({"entry_point": f"autopilot:{entry}",
+                               "observed": delta, "budget": budget})
     report = {
         "serve_rounds": serve_rounds,
         "capacity": capacity,
         "deltas": dict(sorted(deltas.items())),
         "health_deltas": dict(sorted(health_deltas.items())),
+        "autopilot_deltas": dict(sorted(autopilot_deltas.items())),
         "violations": violations,
         "failures": failures,
         "cache": {"hits": plane.cache.hits,
                   "misses": plane.cache.misses},
+        "autopilot_cache": {"hits": plane2.cache.hits,
+                            "misses": plane2.cache.misses},
     }
     if verbose:
         for v in violations:
@@ -1058,5 +1138,6 @@ def run_serving_gate(budgets: "dict | None" = None,
         if not violations and not failures:
             print("serving-budget: OK — zero excess compiles across "
                   "join/serve/leave/rejoin churn (evict/readmit "
-                  "included); rejoin was a compile-cache hit")
+                  "included) AND across the warm autopilot quality-"
+                  "ladder cycle; rejoin was a compile-cache hit")
     return report

@@ -392,6 +392,32 @@ class ServeNaNStormRule:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeOverloadRule:
+    """Overload storm (ISSUE 17): every matching submission inside the
+    window is forced to a tight ``deadline_s`` — the demand-spike /
+    latency-SLA-squeeze signature. Requests whose round takes longer
+    than the forced deadline expire at the drain (``shed_deadline`` →
+    burn), which is exactly the storm the SLO autopilot must counter:
+    cheaper rounds (L1/L3 cut the round cost under the deadline) or
+    relaxed admission (the L2 deadline factor applies to explicit
+    deadlines too). One ``chaos.injected`` note per storm round."""
+
+    tenant: str = "*"
+    start_round: int = 0
+    n_rounds: Optional[int] = None   # None = open-ended
+    deadline_s: float = 0.05
+
+    def matches(self, tenant_id: str) -> bool:
+        return self.tenant in ("*", tenant_id)
+
+    def triggered(self, round_: int) -> bool:
+        if round_ < self.start_round:
+            return False
+        return self.n_rounds is None or \
+            round_ < self.start_round + self.n_rounds
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeStallRule:
     """Hang one round's device readback for ``duration_s`` — the wedged
     TPU-tunnel signature (BENCH_r03) the dispatch watchdog must
@@ -419,10 +445,11 @@ class ServeChaosConfig:
     nan_storm: tuple = ()
     stall: tuple = ()
     build_fail: tuple = ()
+    overload: tuple = ()
 
     @classmethod
     def from_dict(cls, cfg: dict) -> "ServeChaosConfig":
-        known = {"seed", "nan_storm", "stall", "build_fail"}
+        known = {"seed", "nan_storm", "stall", "build_fail", "overload"}
         unknown = set(cfg) - known
         if unknown:
             raise ValueError(
@@ -441,6 +468,10 @@ class ServeChaosConfig:
                 r if isinstance(r, ServeBuildFailRule)
                 else ServeBuildFailRule(**r)
                 for r in cfg.get("build_fail", ())),
+            overload=tuple(
+                r if isinstance(r, ServeOverloadRule)
+                else ServeOverloadRule(**r)
+                for r in cfg.get("overload", ())),
         )
 
 
@@ -472,7 +503,8 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
                           seed: "int | None" = None) -> ChaosController:
     """Install the serving-scope injectors on a
     :class:`~agentlib_mpc_tpu.serving.plane.ServingPlane`. Three seams:
-    ``submit`` (NaN storms, windowed by served round), the dispatcher's
+    ``submit`` (NaN storms + overload deadline squeezes, windowed by
+    served round), the dispatcher's
     materialize (stalls + result-mode poison) and the compile cache's
     builder (engine-build failures — the resulting
     :class:`ChaosBuildError` propagates out of ``join``, never out of
@@ -486,9 +518,10 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
         ChaosConfig(seed=config.seed))
     counters = {"materialize": 0, "build": 0, "round": 0}
 
-    if config.nan_storm:
+    if config.nan_storm or config.overload:
         orig_submit = plane.submit
         orig_serve = plane.serve_round
+        overload_noted: set = set()
 
         def serve_round(*a, **kw):
             out = orig_serve(*a, **kw)
@@ -506,6 +539,17 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
                 base = theta if theta is not None \
                     else plane._specs[tenant_id].theta
                 theta = _nan_tree(base)
+            o_rule = next((x for x in config.overload
+                           if x.matches(tenant_id) and x.triggered(r)),
+                          None)
+            if o_rule is not None:
+                if r not in overload_noted:
+                    # one injection record per STORM ROUND, not per
+                    # submission — the journal-vs-schedule parity the
+                    # chaos benches assert counts rounds
+                    overload_noted.add(r)
+                    controller.note("serve_overload", f"round{r}")
+                kw["deadline_s"] = o_rule.deadline_s
             return orig_submit(tenant_id, theta, **kw)
 
         plane.submit = submit

@@ -28,6 +28,11 @@ population over the same fused data plane:
 * :mod:`.health` — :class:`HealthLedger`: the per-tenant
   quarantine → probation → evict ladder that keeps one sick tenant from
   degrading its bucket's batch indefinitely.
+* :mod:`.autopilot` — :class:`SLOAutopilot`: the hysteretic feedback
+  controller that spends the error budget deliberately — burn-rate-
+  driven quality-ladder moves (warm-iteration caps, deadline
+  relaxation, scenario-subtree shrink, mesh pre-degrade), every move a
+  journaled ``autopilot.move`` and a compile-cache hit after first use.
 * :mod:`.checkpoint` — durable plane snapshots; crash recovery restores
   buckets through the compile cache (cached-join splices, measured as
   MTTR), never a cold rebuild against a warm cache. The manifest stamps
@@ -52,6 +57,10 @@ from __future__ import annotations
 from agentlib_mpc_tpu.serving.admission import (  # noqa: F401
     AdmissionQueue,
     SolveRequest,
+)
+from agentlib_mpc_tpu.serving.autopilot import (  # noqa: F401
+    AutopilotPolicy,
+    SLOAutopilot,
 )
 from agentlib_mpc_tpu.serving.cache import CompileCache  # noqa: F401
 from agentlib_mpc_tpu.serving.checkpoint import (  # noqa: F401

@@ -1,0 +1,451 @@
+"""The SLO autopilot: a feedback controller that SPENDS the error budget.
+
+Everything below this module *measures* or *reacts*: the SLO tracker
+(ISSUE 15) knows each tenant's multi-window burn rate, the health
+ladder evicts sick tenants, the watchdog condemns hung rounds — but
+nothing trades quality for survival on purpose. Under a demand spike a
+tenant burns to SLO breach at full solution quality because no
+component is allowed to decide "a cheaper round that actuates beats a
+perfect round that misses its deadline". This controller is that
+component: wired as ``ServingPlane(autopilot=AutopilotPolicy(...))``,
+it reads the tracker's fast-window burn rate every ``serve_round`` and
+walks each tenant up and down a **quality ladder**:
+
+====  ==================  =================================================
+level lever               mechanism
+====  ==================  =================================================
+L1    ``warm_iters``      cap the warm interior-point iteration budget
+                          (``warm_solver_options`` — a bucket-key field,
+                          so the move re-buckets through the compile
+                          cache: a cache hit after first use, never a
+                          cold build per move)
+L2    ``deadline``        relax the tenant's admission deadline by
+                          ``l2_deadline_factor`` (host-side: deadlines
+                          never enter the bucket key) — wider coalescing,
+                          fewer deadline sheds
+L3    ``scenario_subtree``shrink a robust tenant's scenario tree to its
+                          highest-probability branches
+                          (``ScenarioTree.subtree`` + probability
+                          renormalization, the ISSUE 14 degrade applied
+                          by *choice*), theta rows sliced to match —
+                          again a re-bucket through the cache
+L4    ``mesh_predegrade`` pre-emptively degrade the device mesh to a
+                          smaller cached layout (``mesh_degrade_hook``,
+                          e.g. ``FleetSupervisor.force_degrade``) before
+                          the watchdog condemns it; latched fleet-wide
+====  ==================  =================================================
+
+and spends budget *back* — restores iteration budgets, deadlines,
+trees, the mesh — when burn recedes.
+
+Hysteresis is the health ladder's discipline (PR 8), not a new one:
+``degrade_after`` consecutive hot rounds (fast-window burn above
+``burn_threshold``) per down-move, ``restore_after`` consecutive cool
+rounds (burn at or below ``restore_threshold``) per up-move, a dead
+band between the two thresholds in which streaks reset, and
+``probation_rounds`` after every up-move during which ONE hot round
+re-degrades immediately — the controller can never flap a tenant
+between quality levels on alternating rounds.
+
+Every move journals as a typed ``autopilot.move`` event (level from/to,
+direction, lever, the trigger burn + window) so ``--incident`` reports
+render *policy* actions beside *fault* reactions; ladder positions and
+hysteresis counters ride the plane checkpoint (a crash restart resumes
+mid-incident at the same quality level, with the same effective specs,
+asserted by the restore digest check). Gauges/counters:
+``autopilot_level{tenant}``, ``autopilot_moves_total{direction,lever}``
+and ``error_budget_spent_by_policy`` (unavailable results delivered
+while the controller held the tenant at reduced quality — the budget it
+chose to spend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+
+from agentlib_mpc_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AutopilotPolicy", "SLOAutopilot", "LEVERS"]
+
+#: lever per ladder level — the journal/metric label vocabulary; a move
+#: between N-1 and N (either direction) is labelled with level N's lever
+LEVERS = {1: "warm_iters", 2: "deadline", 3: "scenario_subtree",
+          4: "mesh_predegrade"}
+
+
+@dataclasses.dataclass(frozen=True)
+class AutopilotPolicy:
+    """Knobs of the quality ladder (plane config key ``autopilot``)."""
+
+    #: fast-window burn rate above which a round counts HOT (1.0 =
+    #: consuming exactly the budgeted miss rate)
+    burn_threshold: float = 1.0
+    #: fast-window burn rate at or below which a round counts COOL;
+    #: the gap to ``burn_threshold`` is the hysteresis dead band
+    restore_threshold: float = 0.25
+    #: consecutive hot rounds per down-move
+    degrade_after: int = 2
+    #: consecutive cool rounds per up-move
+    restore_after: int = 4
+    #: rounds after an up-move during which ONE hot round re-degrades
+    #: immediately (the health ladder's probation discipline)
+    probation_rounds: int = 4
+    #: deepest ladder level the controller may reach (L4 additionally
+    #: requires a ``mesh_degrade_hook``)
+    max_level: int = 4
+    #: L1: warm interior-point iteration cap
+    l1_warm_max_iter: int = 2
+    #: L2: admission-deadline relaxation factor
+    l2_deadline_factor: float = 4.0
+    #: L3: fraction of scenario branches kept (highest-probability
+    #: first; at least one always survives)
+    l3_keep_fraction: float = 0.5
+
+    def __post_init__(self):
+        if self.burn_threshold <= 0:
+            raise ValueError(f"burn_threshold must be > 0, got "
+                             f"{self.burn_threshold}")
+        if not (0.0 <= self.restore_threshold < self.burn_threshold):
+            raise ValueError(
+                f"need 0 <= restore_threshold < burn_threshold "
+                f"(hysteresis dead band), got {self.restore_threshold} "
+                f"/ {self.burn_threshold}")
+        if min(self.degrade_after, self.restore_after,
+               self.probation_rounds) < 1:
+            raise ValueError("degrade_after, restore_after and "
+                             "probation_rounds must all be >= 1")
+        if not (1 <= int(self.max_level) <= 4):
+            raise ValueError(f"max_level must sit in [1, 4], got "
+                             f"{self.max_level}")
+        if self.l1_warm_max_iter < 1:
+            raise ValueError("l1_warm_max_iter must be >= 1")
+        if self.l2_deadline_factor < 1.0:
+            raise ValueError("l2_deadline_factor must be >= 1 (an "
+                             "autopilot that TIGHTENS deadlines under "
+                             "overload is an amplifier)")
+        if not (0.0 < self.l3_keep_fraction <= 1.0):
+            raise ValueError(f"l3_keep_fraction must sit in (0, 1], "
+                             f"got {self.l3_keep_fraction}")
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "AutopilotPolicy":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown autopilot option(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}")
+        return cls(**cfg)
+
+
+@dataclasses.dataclass
+class TenantLadder:
+    """One tenant's ladder row (checkpointed verbatim)."""
+
+    level: int = 0
+    hot_streak: int = 0
+    cool_streak: int = 0
+    #: probation rounds remaining after the latest up-move
+    probation: int = 0
+    moves: int = 0
+
+
+class SLOAutopilot:
+    """The per-plane controller; owns the decisions, the plane executes
+    them (``_rebucket_tenant``) — the health-ledger split, applied to
+    quality instead of sickness."""
+
+    def __init__(self, policy: AutopilotPolicy = AutopilotPolicy(),
+                 mesh_degrade_hook=None, mesh_restore_hook=None):
+        self.policy = policy
+        #: L4 levers: zero-arg callables (e.g. bound
+        #: ``FleetSupervisor.force_degrade(dead)`` / ``force_readmit``
+        #: partials). Without a degrade hook the effective ladder tops
+        #: out at L3 — the controller never pretends to pull a lever it
+        #: does not hold.
+        self.mesh_degrade_hook = mesh_degrade_hook
+        self.mesh_restore_hook = mesh_restore_hook
+        self._rows: "dict[str, TenantLadder]" = {}
+        #: join-normalized ORIGINAL specs of tenants at level > 0 —
+        #: every effective spec is derived from the original, never
+        #: incrementally, so level k's spec (and bucket digest) is
+        #: deterministic across live moves and checkpoint restores
+        self._originals: dict = {}
+        #: L4 is a fleet-wide latch: fired when the first tenant enters
+        #: L4, released when the last one leaves it
+        self._mesh_degraded = False
+
+    # -- introspection --------------------------------------------------------
+
+    def row(self, tenant_id: str) -> TenantLadder:
+        return self._rows.setdefault(tenant_id, TenantLadder())
+
+    def level(self, tenant_id: str) -> int:
+        row = self._rows.get(tenant_id)
+        return 0 if row is None else row.level
+
+    @property
+    def effective_max_level(self) -> int:
+        if self.mesh_degrade_hook is None:
+            return min(int(self.policy.max_level), 3)
+        return int(self.policy.max_level)
+
+    @property
+    def mesh_degraded(self) -> bool:
+        return self._mesh_degraded
+
+    def report(self) -> dict:
+        return {tid: dataclasses.asdict(row)
+                for tid, row in sorted(self._rows.items())}
+
+    # -- levers ---------------------------------------------------------------
+
+    def relaxed_deadline(self, tenant_id: str,
+                         deadline_s: "float | None") -> "float | None":
+        """The L2 lever, applied by ``ServingPlane.submit`` to BOTH
+        spec-default and explicitly supplied deadlines (an overload
+        storm forcing tight deadlines must be counterable)."""
+        if deadline_s is None or self.level(tenant_id) < 2:
+            return deadline_s
+        return float(deadline_s) * self.policy.l2_deadline_factor
+
+    def effective_spec(self, spec, level: int):
+        """The tenant spec at ladder ``level``, derived from the
+        ORIGINAL (join-normalized) ``spec``. L1+ caps the warm solver
+        budget; L3+ shrinks a robust tenant's tree to its
+        highest-probability branches and slices theta rows to match.
+        L2/L4 are host-side levers — no spec change. The caller
+        re-normalizes (``_normalize_robust_spec``) so an L3 subtree
+        that degenerates to one scenario squeezes into the flat
+        bucket exactly like a join would."""
+        if level <= 0:
+            return spec
+        changes: dict = {}
+        base_warm = spec.warm_solver_options
+        if base_warm is None:
+            # the engine's own warm default (fused_admm: warm budget =
+            # min(cold, 6)) — cap RELATIVE to what actually runs warm
+            base_warm = spec.solver_options._replace(
+                max_iter=min(spec.solver_options.max_iter, 6))
+        changes["warm_solver_options"] = base_warm._replace(
+            max_iter=min(base_warm.max_iter,
+                         int(self.policy.l1_warm_max_iter)))
+        tree = spec.scenario_tree
+        if level >= 3 and tree is not None and tree.n_scenarios > 1:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            s = tree.n_scenarios
+            n_keep = max(1, int(math.floor(
+                s * self.policy.l3_keep_fraction)))
+            if n_keep < s:
+                order = sorted(range(s),
+                               key=lambda i: (-tree.probabilities[i], i))
+                keep = tuple(sorted(order[:n_keep]))
+                idx = np.asarray(keep)
+                changes["scenario_tree"] = tree.subtree(keep)
+                changes["theta"] = jax.tree.map(
+                    lambda leaf: jnp.asarray(leaf)[idx], spec.theta)
+        return dataclasses.replace(spec, **changes)
+
+    # -- the control loop -----------------------------------------------------
+
+    def tick(self, plane, tally: "dict | None" = None) -> None:
+        """One controller step, called by ``serve_round`` right after
+        the SLO windows advance. Reads the FAST window's burn per
+        tenant; no-traffic rounds (burn None) are neutral — they move
+        neither streak."""
+        pol = self.policy
+        fast = min(int(w) for w in plane.slo.policy.windows)
+        burns = plane.slo.burn_rates()
+        for tid in list(plane._tenant_bucket):
+            row = self.row(tid)
+            burn = (burns.get(tid) or {}).get(fast)
+            if burn is None:
+                continue
+            if burn > pol.burn_threshold:
+                row.cool_streak = 0
+                row.hot_streak += 1
+                forced = row.probation > 0
+                if (forced or row.hot_streak >= pol.degrade_after) \
+                        and row.level < self.effective_max_level:
+                    if self._move(plane, tid, row, row.level + 1,
+                                  window=fast, burn=burn,
+                                  threshold=pol.burn_threshold,
+                                  probation_strike=forced):
+                        row.hot_streak = 0
+                        row.probation = 0
+            elif burn <= pol.restore_threshold:
+                row.hot_streak = 0
+                if row.probation > 0:
+                    row.probation -= 1
+                if row.level > 0:
+                    row.cool_streak += 1
+                    if row.cool_streak >= pol.restore_after:
+                        if self._move(plane, tid, row, row.level - 1,
+                                      window=fast, burn=burn,
+                                      threshold=pol.restore_threshold):
+                            row.cool_streak = 0
+                            row.probation = pol.probation_rounds
+            else:
+                # the dead band: neither hot nor cool — both streaks
+                # reset, which is exactly what forbids flapping on a
+                # burn rate oscillating around one threshold
+                row.hot_streak = 0
+                row.cool_streak = 0
+        if tally:
+            self._account_spend(tally)
+
+    def force_level(self, plane, tenant_id: str, level: int) -> bool:
+        """Walk a tenant to ``level`` one rung at a time, journaling
+        each move with ``trigger="forced"`` — operator intervention and
+        the ``[serving.autopilot]`` retrace gate."""
+        row = self.row(tenant_id)
+        level = max(0, min(int(level), self.effective_max_level))
+        while row.level != level:
+            step = row.level + (1 if level > row.level else -1)
+            if not self._move(plane, tenant_id, row, step, forced=True):
+                return False
+        return True
+
+    def _move(self, plane, tenant_id: str, row: TenantLadder,
+              new_level: int, window: "int | None" = None,
+              burn: "float | None" = None,
+              threshold: "float | None" = None, forced: bool = False,
+              probation_strike: bool = False) -> bool:
+        new_level = max(0, min(int(new_level), self.effective_max_level))
+        old_level = row.level
+        if new_level == old_level:
+            return True
+        direction = "down" if new_level > old_level else "up"
+        lever = LEVERS[max(new_level, old_level)]
+        orig = self._originals.get(tenant_id)
+        if orig is None:
+            orig = self._originals[tenant_id] = \
+                plane._specs[tenant_id]
+        if not plane._rebucket_tenant(
+                tenant_id, self.effective_spec(orig, new_level)):
+            # the memory certificate refused the target bucket — hold
+            # the current level (a quality move must never OOM a round)
+            logger.warning(
+                "autopilot: %s move for tenant %s (L%d -> L%d) refused "
+                "by the memory certificate — holding L%d", direction,
+                tenant_id, old_level, new_level, old_level)
+            return False
+        if new_level >= 4 and not self._mesh_degraded:
+            self._fire_mesh_hook(self.mesh_degrade_hook, "degrade")
+            self._mesh_degraded = True
+        elif old_level >= 4 > new_level and self._mesh_degraded \
+                and not any(r.level >= 4
+                            for t, r in self._rows.items()
+                            if t != tenant_id):
+            self._fire_mesh_hook(self.mesh_restore_hook, "restore")
+            self._mesh_degraded = False
+        row.level = new_level
+        row.moves += 1
+        if new_level == 0:
+            # back at full quality: the live spec IS the original again
+            self._originals.pop(tenant_id, None)
+        key = plane._tenant_bucket.get(tenant_id)
+        telemetry.journal_event(
+            "autopilot.move", tenant=tenant_id, level_from=old_level,
+            level_to=new_level, direction=direction, lever=lever,
+            trigger="forced" if forced else "burn",
+            window=window, burn=None if burn is None else round(burn, 3),
+            threshold=threshold, probation_strike=bool(probation_strike),
+            bucket=key.digest if key is not None else None)
+        if telemetry.enabled():
+            telemetry.counter(
+                "autopilot_moves_total",
+                "quality-ladder moves executed by the SLO autopilot"
+                ).inc(direction=direction, lever=lever)
+            telemetry.gauge(
+                "autopilot_level",
+                "per-tenant quality-ladder position (0 = full quality, "
+                "4 = mesh pre-degraded)").set(float(new_level),
+                                              tenant=tenant_id)
+        logger.log(
+            logging.WARNING if direction == "down" else logging.INFO,
+            "autopilot: tenant %s L%d -> L%d (%s, lever=%s%s)",
+            tenant_id, old_level, new_level, direction, lever,
+            "" if burn is None
+            else f", burn={burn:.2f} over {window}-round window")
+        return True
+
+    def _fire_mesh_hook(self, hook, kind: str) -> None:
+        if hook is None:
+            return
+        try:
+            hook()
+        except Exception:  # noqa: BLE001 — a failed lever must not
+            # fail the round; the watchdog path still backstops it
+            logger.warning("autopilot: mesh %s hook failed", kind,
+                           exc_info=True)
+
+    def _account_spend(self, tally: dict) -> None:
+        """Budget spent BY POLICY this round: unavailable results
+        delivered while the controller held the tenant below full
+        quality — the deliberate part of the burn."""
+        spent = 0
+        for tid, counts in tally.items():
+            row = self._rows.get(tid)
+            if row is None or row.level <= 0:
+                continue
+            spent += max(0, int(counts[0]) - int(counts[1]))
+        if spent and telemetry.enabled():
+            telemetry.counter(
+                "error_budget_spent_by_policy",
+                "unavailable results delivered while the autopilot "
+                "held the tenant at reduced quality (error budget "
+                "spent deliberately)").inc(float(spent))
+
+    # -- checkpoint seam ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able controller state for the plane checkpoint: ladder
+        positions AND hysteresis counters — a restore that forgot the
+        streaks would up-move (re-grow trees, re-trace nothing but
+        re-warm everything) on the first cool round mid-incident."""
+        return {
+            "mesh_degraded": bool(self._mesh_degraded),
+            "tenants": {tid: dataclasses.asdict(row)
+                        for tid, row in self._rows.items()},
+        }
+
+    def restore(self, snap: "dict | None") -> None:
+        """Counters only — spec transforms are
+        :meth:`transform_specs`'s job (restore_plane calls both). The
+        mesh latch restores as a FLAG: the hook is not re-fired (the
+        supervisor owns its own checkpoint; firing a degrade against
+        an already-degraded mesh would double-count)."""
+        if not snap:
+            return
+        self._mesh_degraded = bool(snap.get("mesh_degraded"))
+        for tid, row in (snap.get("tenants") or {}).items():
+            self._rows[tid] = TenantLadder(**row)
+            if telemetry.enabled():
+                telemetry.gauge(
+                    "autopilot_level",
+                    "per-tenant quality-ladder position (0 = full "
+                    "quality, 4 = mesh pre-degraded)").set(
+                    float(self._rows[tid].level), tenant=tid)
+
+    def transform_specs(self, plane, specs: dict) -> dict:
+        """Apply restored ladder levels to the caller's (normalized,
+        ORIGINAL) specs so the restore's digest matching sees the same
+        effective buckets the checkpoint recorded. Registers the
+        originals for later up-moves."""
+        out = dict(specs)
+        for tid, row in self._rows.items():
+            if row.level <= 0 or tid not in out:
+                continue
+            orig = out[tid]
+            self._originals[tid] = orig
+            out[tid] = plane._normalize_robust_spec(
+                self.effective_spec(orig, row.level))
+        return out

@@ -229,6 +229,12 @@ def save_plane(plane, path: str) -> str:
         # SLO/error-budget continuity (ISSUE 15): a restore that forgot
         # the burn would report a fresh 100% budget mid-incident
         "slo": plane.slo.snapshot(),
+        # autopilot ladder continuity (ISSUE 17): positions AND
+        # hysteresis counters — a crash restart resumes mid-incident at
+        # the same quality level instead of re-growing trees cold
+        "autopilot": (plane.autopilot.snapshot()
+                      if getattr(plane, "autopilot", None) is not None
+                      else None),
         "queue": plane.queue.snapshot(now),
     }
     if arrays:
@@ -373,6 +379,32 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
     # would have produced
     specs = {tid: plane._normalize_robust_spec(s)
              for tid, s in specs.items()}
+    # autopilot ladder state (ISSUE 17) applies BEFORE digest matching:
+    # a tenant saved at L3 sits in its SUBTREE bucket, so its recorded
+    # digest only matches the spec transformed through its restored
+    # level (effective specs are derived deterministically from the
+    # originals — same composition as the live move)
+    auto_snap = manifest.get("autopilot")
+    if auto_snap:
+        degraded = sorted(
+            tid for tid, row in (auto_snap.get("tenants") or {}).items()
+            if int((row or {}).get("level") or 0) > 0)
+        if degraded and getattr(plane, "autopilot", None) is None:
+            telemetry.journal_event(
+                "checkpoint.rejected", path=src,
+                reason="autopilot_state_without_controller",
+                tenants=degraded)
+            raise ValueError(
+                f"checkpoint carries autopilot ladder state (tenants "
+                f"at reduced quality: {degraded}) but this plane has "
+                f"no autopilot= configured — restoring would leave "
+                f"them degraded forever with nothing to spend the "
+                f"budget back; build the plane with "
+                f"ServingPlane(autopilot=...) matching the saved "
+                f"policy, or re-join the tenants fresh")
+        if getattr(plane, "autopilot", None) is not None:
+            plane.autopilot.restore(auto_snap)
+            specs = plane.autopilot.transform_specs(plane, specs)
     hits0, misses0 = plane.cache.hits, plane.cache.misses
     restores0 = plane.cache.persistent_restores
     per_tenant_s: dict = {}

@@ -126,7 +126,8 @@ class ServingPlane:
                  memory_certify: str = "auto",
                  hbm_bytes: "int | str | None" = "auto",
                  slo_policy: "SLOPolicy | None" = None,
-                 profile_every: "int | None" = None):
+                 profile_every: "int | None" = None,
+                 autopilot=None):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -250,6 +251,29 @@ class ServingPlane:
         self.slo = SLOTracker(slo_policy if slo_policy is not None
                               else SLOPolicy())
         self._slo_policy_journaled = False
+        #: SLO autopilot (ISSUE 17): a hysteretic feedback controller
+        #: that spends the error budget deliberately — reads the
+        #: tracker's fast-window burn each serve_round and walks
+        #: tenants up/down the quality ladder (warm-iteration caps,
+        #: deadline relaxation, scenario-subtree shrink, mesh
+        #: pre-degrade). Accepts an AutopilotPolicy or a pre-built
+        #: SLOAutopilot (the latter to attach mesh hooks); None
+        #: disables the controller entirely.
+        from agentlib_mpc_tpu.serving.autopilot import (
+            AutopilotPolicy,
+            SLOAutopilot,
+        )
+
+        if autopilot is None:
+            self.autopilot = None
+        elif isinstance(autopilot, SLOAutopilot):
+            self.autopilot = autopilot
+        elif isinstance(autopilot, AutopilotPolicy):
+            self.autopilot = SLOAutopilot(autopilot)
+        else:
+            raise TypeError(
+                f"autopilot must be an AutopilotPolicy, an SLOAutopilot "
+                f"or None, got {type(autopilot).__name__}")
         #: periodic phase-profile capture (ISSUE 16): every K-th bucket
         #: dispatch runs under ``jax.profiler.trace`` and lands its
         #: per-phase device times in the ``phase_device_ms`` histogram
@@ -757,6 +781,80 @@ class ServingPlane:
             if tenant_id in self._evicted:
                 self.readmit_tenant(tenant_id)
 
+    # -- quality ladder (ISSUE 17) --------------------------------------------
+
+    def _rebucket_tenant(self, tenant_id: str, spec: TenantSpec) -> bool:
+        """Move a registered tenant onto a new spec — the autopilot's
+        lever executor. When the new spec fingerprints into the SAME
+        bucket (an L2 move, or an L1 cap equal to the current warm
+        budget) this is pure bookkeeping; otherwise the tenant's lane
+        is evicted from its old bucket and spliced into the new one
+        through the ordinary ``_acquire_bucket``/compile-cache path —
+        a cache hit after first use (the ``[serving.autopilot]`` gate
+        pins the warm cycle at zero retraces). The splice resets the
+        tenant's warm start (the documented cost of every migration).
+        Guard/health/SLO rows are keyed by tenant id and ride along
+        untouched. Returns False — with nothing changed — when the
+        memory certificate refuses the target bucket."""
+        if tenant_id not in self._tenant_bucket:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        spec = self._normalize_robust_spec(spec)
+        old_key = self._tenant_bucket[tenant_id]
+        new_key = bucket_key(spec)
+        if new_key == old_key:
+            self._specs[tenant_id] = spec
+            return True
+        if tenant_id in self._evicted:
+            # no lane to move: re-key the registration so the eventual
+            # re-admission splices into the NEW bucket
+            self._tenant_bucket[tenant_id] = new_key
+            self._specs[tenant_id] = spec
+            self._evicted[tenant_id] = new_key
+            return True
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            MemoryBudgetExceeded,
+        )
+
+        target = self._buckets.get(new_key)
+        try:
+            if target is None:
+                target, _hit = self._acquire_bucket(new_key, spec,
+                                                    n_needed=1)
+            elif target.free_slots == 0:
+                target, _hit = self._acquire_bucket(
+                    new_key, spec, n_needed=target.n_active + 1,
+                    migrate_from=target)
+            else:
+                self.cache.note_hit(label=new_key.digest)
+        except MemoryBudgetExceeded as exc:
+            logger.warning(
+                "tenant %s re-bucket %s -> %s refused by the memory "
+                "certificate (%s) — keeping the current bucket",
+                tenant_id, old_key.digest, new_key.digest, exc)
+            return False
+        old_bucket = self._buckets.get(old_key)
+        if old_bucket is not None:
+            old_bucket.evict(tenant_id)
+        slot = target.admit(tenant_id, spec.theta)
+        self._tenant_bucket[tenant_id] = new_key
+        self._specs[tenant_id] = spec
+        if telemetry.enabled():
+            gauge = telemetry.serving_metrics()["active"]
+            gauge.set(float(target.n_active), bucket=new_key.digest)
+            if old_bucket is not None:
+                gauge.set(float(old_bucket.n_active),
+                          bucket=old_key.digest)
+        if old_bucket is not None and old_bucket.n_active == 0 \
+                and old_key not in self._evicted.values():
+            # retire the empty slot plane; the ENGINE stays cached, so
+            # the up-move back is a hit (the zero-cold-build contract)
+            self._stash_flush(old_key)
+            del self._buckets[old_key]
+        logger.info("tenant %s re-bucketed %s -> %s slot %d (fresh "
+                    "warm start)", tenant_id, old_key.digest,
+                    new_key.digest, slot)
+        return True
+
     # -- request path ---------------------------------------------------------
 
     @staticmethod
@@ -787,6 +885,12 @@ class ServingPlane:
             raise KeyError(f"unknown tenant {tenant_id!r}")
         if deadline_s is None:
             deadline_s = self._specs[tenant_id].deadline_s
+        if self.autopilot is not None:
+            # the L2 lever: relax the deadline — EXPLICIT deadlines
+            # included, so an overload storm forcing tight deadlines
+            # is counterable, not just the spec defaults
+            deadline_s = self.autopilot.relaxed_deadline(tenant_id,
+                                                         deadline_s)
         if telemetry.enabled():
             telemetry.serving_metrics()["requests"].inc()
         if tenant_id in self._evicted:
@@ -927,6 +1031,11 @@ class ServingPlane:
         # event is what makes slo_report() recomputable offline from
         # the flight recorder alone
         tally = self.slo.tick_round(self.served_rounds)
+        if self.autopilot is not None:
+            # controller step AFTER the windows advance (it reads this
+            # round's burn) and BEFORE the round stamp moves forward
+            # (its autopilot.move events belong to this round)
+            self.autopilot.tick(self, tally)
         telemetry.journal_event(
             "serve.round", round=self.served_rounds, tally=tally,
             buckets_touched=len(touched),

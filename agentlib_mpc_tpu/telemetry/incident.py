@@ -57,6 +57,12 @@ CHAIN_RULES = {
     "solver_fail": (("guard.transition",), ("guard.transition",), False),
     "solver_nan": (("guard.transition",), ("guard.transition",), False),
     "solver_huge": (("guard.transition",), ("guard.transition",), False),
+    # the autopilot chain (ISSUE 17): an injected overload storm burns
+    # the fast window -> the controller moves DOWN the quality ladder
+    # (symptom: a policy action, deliberately beside the fault
+    # reactions above) -> burn recedes -> the controller spends the
+    # budget back (recovery: the matching up-move)
+    "serve_overload": (("autopilot.move",), ("autopilot.move",), False),
 }
 
 #: correlation keys a symptom/recovery candidate must agree on with the
@@ -118,6 +124,9 @@ def _symptom_matches(rule: str, keys: dict, ev: dict) -> bool:
         return bool(ev.get("quarantined"))
     if ev.get("etype") == "health.transition":
         return ev.get("state") in ("quarantined", "evicted")
+    if ev.get("etype") == "autopilot.move":
+        # only a DEGRADE is a symptom of the injected overload
+        return ev.get("direction") == "down"
     if ev.get("etype") == "cache.engine" and rule == "serve_build_fail":
         return ev.get("outcome") == "build_failed"
     return True
@@ -130,6 +139,10 @@ def _recovery_matches(rule: str, keys: dict, ev: dict,
     et = ev.get("etype")
     if et == "health.transition":
         return ev.get("state") in ("probation", "healthy")
+    if et == "autopilot.move":
+        # recovery = the controller spending budget BACK (an up-move
+        # after the burn receded)
+        return ev.get("direction") == "up"
     if et == "guard.transition":
         return ev.get("level") == "mpc"
     if et == "cache.engine":
@@ -273,6 +286,15 @@ def _fmt_event(ev: dict) -> str:
                   f"{ev.get('baseline_ms')}±{ev.get('band_ms')} ms "
                   f"(+{ev.get('excess_ms')} ms over band, "
                   f"key={ev.get('metric_key')})")
+    elif ev.get("etype") == "autopilot.move":
+        # a policy move: render the ladder transition, not raw kv
+        trig = ("forced" if ev.get("trigger") == "forced"
+                else f"burn={ev.get('burn')} over "
+                     f"{ev.get('window')}-round window")
+        detail = (f"tenant={ev.get('tenant')} "
+                  f"L{ev.get('level_from')}→L{ev.get('level_to')} "
+                  f"({ev.get('direction')}, lever={ev.get('lever')}, "
+                  f"{trig})")
     else:
         detail = ", ".join(f"{k}={ev[k]}" for k in sorted(ev)
                            if k not in skip)
@@ -306,9 +328,15 @@ def render_markdown(report: dict) -> str:
             if ev is None:
                 lines.append(f"- {role}: none observed")
             else:
+                extra = ""
+                if ev.get("etype") == "autopilot.move":
+                    # the ladder level IS the story of a policy chain
+                    extra = (f" (L{ev.get('level_from')}→"
+                             f"L{ev.get('level_to')}, "
+                             f"lever={ev.get('lever')})")
                 lines.append(
                     f"- {role}: `{ev.get('etype')}` seq "
-                    f"{ev.get('seq')} round {ev.get('round')}")
+                    f"{ev.get('seq')} round {ev.get('round')}{extra}")
         lines.append("")
     imp = report.get("implicated") or {}
     lines += ["## Implicated", ""]

@@ -94,12 +94,23 @@ class SLOTracker:
         self.policy = policy
         self._max_window = max(int(w) for w in policy.windows)
         self._rows: dict = {}
+        #: tenants that left: tenant_id -> rounds of tombstone left.
+        #: ``forget`` no longer drops the ledger — a tenant that
+        #: leaves and rejoins inside ``max_window`` rounds resumes its
+        #: burn windows where it left them (a flapping tenant cannot
+        #: launder its burn by churning membership); after
+        #: ``max_window`` tombstoned rounds the windows have fully
+        #: aged out and the row really goes
+        self._tombstones: dict = {}
         self.rounds = 0
         #: the caller's round clock at the last tick (drift check
         #: against the journal's serve.round stamps)
         self.last_round_index: "int | None" = None
 
     def _row(self, tenant_id: str) -> _TenantLedger:
+        # any access revives a tombstoned row: the rejoining tenant
+        # resumes its windows (the whole point of the tombstone)
+        self._tombstones.pop(tenant_id, None)
         row = self._rows.get(tenant_id)
         if row is None:
             row = self._rows[tenant_id] = _TenantLedger(self._max_window)
@@ -121,7 +132,15 @@ class SLOTracker:
         row.cur[2] += int(bool(deadline_missed))
 
     def forget(self, tenant_id: str) -> None:
-        self._rows.pop(tenant_id, None)
+        """Tombstone a departed tenant's ledger for ``max_window``
+        rounds instead of dropping it: the row keeps aging through
+        the sliding windows (and keeps counting in the fleet roll-up —
+        budgets are an accounting record) but leaves the per-tenant
+        report; a rejoin inside the window resumes the burn exactly
+        where it stood. Dropping immediately let a flapping tenant
+        restart its windows from zero each rejoin — burn laundering."""
+        if tenant_id in self._rows:
+            self._tombstones[tenant_id] = self._max_window
 
     def tick_round(self, round_index: "int | None" = None) -> dict:
         """Close the current round: push each tenant's tally into the
@@ -142,6 +161,13 @@ class SLOTracker:
                 tally[tid] = list(row.cur)
             row.recent.append(tuple(row.cur))
             row.cur = [0, 0, 0]
+        # tombstoned rows age like every other idle tenant above; once
+        # the windows have fully cycled the ledger really goes
+        for tid in list(self._tombstones):
+            self._tombstones[tid] -= 1
+            if self._tombstones[tid] <= 0:
+                del self._tombstones[tid]
+                self._rows.pop(tid, None)
         self._export_gauges(tally.keys())
         return tally
 
@@ -167,6 +193,16 @@ class SLOTracker:
                                  else round(100.0 * avail, 3)),
             "burn_rate": None if burn is None else round(burn, 3),
         }
+
+    def burn_rates(self) -> dict:
+        """Per-tenant windowed burn rates, ``{tenant: {window: burn}}``
+        (``None`` for windows with no delivered traffic) — the SLO
+        autopilot's controller input, public so policy code never
+        reaches into the ledger rows."""
+        return {
+            tid: {int(w): self._window_stats(row, w)["burn_rate"]
+                  for w in self.policy.windows}
+            for tid, row in self._rows.items()}
 
     def _tenant_report(self, row: _TenantLedger) -> dict:
         avail = self._rate(row.actuated, row.delivered)
@@ -198,8 +234,12 @@ class SLOTracker:
         """The full SLO report: per-tenant objectives + a fleet roll-up
         (what ``ServingPlane.slo_report()`` returns and the chaos bench
         publishes)."""
+        # tombstoned (departed) tenants leave the per-tenant section
+        # but keep counting in the fleet sums: the roll-up is an
+        # accounting record, not a membership list
         tenants = {tid: self._tenant_report(row)
-                   for tid, row in sorted(self._rows.items())}
+                   for tid, row in sorted(self._rows.items())
+                   if tid not in self._tombstones}
         delivered = sum(r.delivered for r in self._rows.values())
         actuated = sum(r.actuated for r in self._rows.values())
         missed = sum(r.deadline_missed for r in self._rows.values())
@@ -268,6 +308,8 @@ class SLOTracker:
                       "deadline_missed": row.deadline_missed,
                       "recent": [list(r) for r in row.recent]}
                 for tid, row in self._rows.items()},
+            "tombstones": {tid: int(n)
+                           for tid, n in self._tombstones.items()},
         }
 
     def restore(self, snap: "dict | None") -> None:
@@ -282,6 +324,9 @@ class SLOTracker:
             row.recent.clear()
             for r in s.get("recent") or []:
                 row.recent.append(tuple(int(x) for x in r))
+        for tid, n in (snap.get("tombstones") or {}).items():
+            if tid in self._rows:
+                self._tombstones[tid] = int(n)
 
 
 def slo_from_events(events: Iterable,

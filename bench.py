@@ -94,6 +94,19 @@ Modes:
                                 # availability %, shed rate, eviction/
                                 # readmission counts, crash-restart MTTR
                                 # (docs/serving.md "Surviving failures")
+    python bench.py --chaos-autopilot SEED [n]  # SLO-autopilot A/B
+                                # (ISSUE 17): n (default 8) tenants
+                                # through the SAME seeded overload
+                                # storm twice — uncontrolled vs
+                                # autopilot-controlled; asserts the
+                                # controlled plane holds the
+                                # availability SLO the uncontrolled one
+                                # breaches, every quality-ladder move
+                                # is journaled, and the incident CLI
+                                # joins storm -> down-move -> up-move;
+                                # the controlled number publishes under
+                                # _q<level> (docs/serving.md
+                                # "SLO autopilot")
     python bench.py --chaos-mesh SEED [n]    # SHARDED-fleet
                                 # survivability: n (default 8) trackers
                                 # under a FleetSupervisor on the
@@ -1916,6 +1929,241 @@ def run_chaos_serve(seed: int = 0, n_tenants: int = 6,
     return out
 
 
+def run_chaos_autopilot(seed: int = 0, n_tenants: int = 8,
+                        rounds: int = 32) -> dict:
+    """``--chaos-autopilot SEED [n]``: the SLO autopilot's acceptance
+    bench (ISSUE 17) — a controlled-vs-uncontrolled A/B under ONE
+    seeded overload storm schedule.
+
+    Two sequential phases serve the same ``n_tenants`` tracker
+    population for ``rounds`` rounds against the same storm schedule
+    (two deadline-squeeze windows: a moderate SLA squeeze a full-
+    quality round cannot meet, then a brutal one even a cheap round
+    cannot meet without relaxed admission), on a shared compile cache:
+
+    * **uncontrolled** — no autopilot: every storm round expires at the
+      drain, tenants walk replay → hold → fallback, availability burns
+      far through the SLO target;
+    * **controlled** — ``ServingPlane(autopilot=AutopilotPolicy())``:
+      the controller reads the fast-window burn, caps warm iteration
+      budgets (L1, a re-bucket through the warm cache), relaxes
+      admission deadlines (L2, host-side), and spends the budget back
+      up the ladder when the burn recedes.
+
+    Time is a virtual clock: each round costs its MODELED device time
+    (base + per-tenant warm-iterations x scenario-branches), so the L1
+    lever genuinely cuts the round cost under the storm deadline and
+    the A/B is deterministic on any host.
+
+    Closing assertions: the controlled phase holds availability at or
+    above the SLO target while the uncontrolled phase breaches it
+    (delta > 0); every ladder move the controller reports is on the
+    journal as a typed ``autopilot.move``; the incident builder joins
+    at least one complete storm -> down-move -> up-move chain FROM THE
+    JOURNAL ALONE; and the controlled availability publishes under the
+    ``_q<level>`` qualified key, never the full-quality headline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu import telemetry
+    from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+    from agentlib_mpc_tpu.resilience.chaos import (
+        ServeChaosConfig,
+        ServeOverloadRule,
+        install_serving_chaos,
+    )
+    from agentlib_mpc_tpu.serving import (
+        AutopilotPolicy,
+        CompileCache,
+        ServingPlane,
+        TenantSpec,
+    )
+    from agentlib_mpc_tpu.telemetry.slo import SLOPolicy
+    from agentlib_mpc_tpu.utils.jax_setup import (
+        enable_compile_profiling,
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    enable_compile_profiling()
+
+    import random as _random
+
+    rng = _random.Random(f"bench-chaos-autopilot:{seed}")
+    ocp = tracker_ocp()
+    # ONE storm schedule for both phases: a moderate SLA squeeze a
+    # full-quality round (modeled 0.08 s) cannot meet but an L1-capped
+    # one (0.04 s) can, then — after a recovery gap long enough for the
+    # up-moves — a brutal squeeze only the L2 deadline relaxation
+    # (x4 -> 0.12 s admission window) survives
+    a_start = rng.randrange(3, 7)
+    a_len = 8
+    b_start = a_start + a_len + 6
+    b_len = 8
+    storm_a = ServeOverloadRule(start_round=a_start, n_rounds=a_len,
+                                deadline_s=0.06)
+    storm_b = ServeOverloadRule(start_round=b_start, n_rounds=b_len,
+                                deadline_s=0.03)
+    slo_policy = SLOPolicy(availability_target=0.8, windows=(4, 16))
+    cache = CompileCache()
+
+    def modeled_round_cost(plane, ids) -> float:
+        """The virtual clock's round cost: base + k per warm interior-
+        point iteration per scenario branch, from the LIVE effective
+        specs — an L1/L3 move changes next round's cost, which is the
+        entire point of the lever."""
+        total = 0
+        for tid in ids:
+            spec = plane._specs[tid]
+            warm = spec.warm_solver_options
+            iters = warm.max_iter if warm is not None \
+                else min(spec.solver_options.max_iter, 6)
+            tree = spec.scenario_tree
+            total += int(iters) * (tree.n_scenarios
+                                   if tree is not None else 1)
+        return 0.02 + 0.00125 * total
+
+    def run_phase(tag: str, prefix: str, controlled: bool) -> dict:
+        phase_rng = _random.Random(
+            f"bench-chaos-autopilot:{seed}:{tag}")
+        journal_path, journal_tmp, journal_base = _bench_journal(
+            "chaos-autopilot")
+        plane = ServingPlane(
+            FusedADMMOptions(max_iterations=5, rho=2.0),
+            slot_multiple=1, initial_capacity=n_tenants,
+            pipelined=False, donate=False, queue_limit=4 * n_tenants,
+            slo_policy=slo_policy, cache=cache,
+            autopilot=AutopilotPolicy() if controlled else None)
+        ids = [f"{prefix}{i:03d}" for i in range(n_tenants)]
+        join_cold = 0
+        for i, tid in enumerate(ids):
+            rec = plane.join(TenantSpec(
+                tenant_id=tid, ocp=ocp,
+                theta=ocp.default_params(
+                    p=jnp.array([float(i - n_tenants // 2)])),
+                couplings={},
+                solver_options=SolverOptions(max_iter=30)))
+            if not rec.engine_cached:
+                join_cold += 1
+        chaos = install_serving_chaos(plane, ServeChaosConfig(
+            overload=(storm_a, storm_b)), seed=seed)
+        expected = actuated = 0
+        vclock = 0.0
+        for _ in range(rounds):
+            for i, tid in enumerate(ids):
+                drift = phase_rng.uniform(-0.2, 0.2)
+                expected += 1
+                plane.submit(tid, theta=ocp.default_params(
+                    p=jnp.array([float(i - n_tenants // 2) + drift])),
+                    now=vclock)
+            vclock += modeled_round_cost(plane, ids)
+            res = plane.serve_round(now=vclock)
+            actuated += sum(1 for v in res.values()
+                            if v.action == "actuate")
+        chaos.uninstall()
+        journal_stats, incident, events = _bench_journal_close(
+            journal_path, journal_tmp, chaos, journal_base,
+            min_complete_chains=1 if controlled else 0)
+        moves = [e for e in events
+                 if e.get("etype") == "autopilot.move"]
+        out = {
+            "availability_pct": round(
+                100.0 * actuated / max(expected, 1), 2),
+            "expected": expected,
+            "join_cold_builds": join_cold,
+            "moves": len(moves),
+            "moves_down": sum(1 for e in moves
+                              if e.get("direction") == "down"),
+            "moves_up": sum(1 for e in moves
+                            if e.get("direction") == "up"),
+            "max_level": max((int(e.get("level_to", 0))
+                              for e in moves), default=0),
+            "incident": incident,
+            "journal": journal_stats,
+        }
+        if controlled:
+            ledger = plane.autopilot.report()
+            out["ladder"] = ledger
+            # EVERY move the controller counted is on the tape — the
+            # "every move journaled" acceptance criterion, asserted
+            # from the journal alone
+            counted = sum(int(r["moves"]) for r in ledger.values())
+            assert len(moves) == counted, (
+                f"controller counted {counted} ladder moves but the "
+                f"journal carries {len(moves)} autopilot.move events")
+            assert out["moves_down"] and out["moves_up"], (
+                f"expected moves in BOTH directions (spend and "
+                f"restore), got {out['moves_down']} down / "
+                f"{out['moves_up']} up")
+        else:
+            assert not moves, (
+                f"uncontrolled phase journaled {len(moves)} "
+                f"autopilot.move events — chaos leaked a controller")
+        return out
+
+    uncontrolled = run_phase("uncontrolled", "u", controlled=False)
+    controlled = run_phase("controlled", "c", controlled=True)
+
+    target_pct = 100.0 * slo_policy.availability_target
+    assert controlled["availability_pct"] >= target_pct, (
+        f"controlled plane breached the availability SLO through the "
+        f"storm: {controlled['availability_pct']}% < {target_pct}%")
+    assert uncontrolled["availability_pct"] < target_pct, (
+        f"uncontrolled plane survived the storm "
+        f"({uncontrolled['availability_pct']}% >= {target_pct}%) — "
+        f"the schedule no longer stresses the SLO, re-tune the storm")
+    delta = round(controlled["availability_pct"]
+                  - uncontrolled["availability_pct"], 2)
+    assert delta > 0, (
+        f"autopilot delta must be positive, got {delta}")
+    # the controlled phase re-joined the SAME structures through the
+    # shared cache: its joins must all be warm hits
+    assert controlled["join_cold_builds"] == 0, (
+        f"controlled phase paid {controlled['join_cold_builds']} cold "
+        f"builds joining structures the uncontrolled phase already "
+        f"compiled — the quality ladder broke the bucket key")
+
+    platform = jax.devices()[0].platform
+    out = {
+        # the headline is the CONTROLLED availability and it publishes
+        # under the _q<level> key: a quality-reduced number must never
+        # read as the full-quality headline
+        "metric": _qualified_metric(
+            "serve_availability_pct", platform,
+            quality_level=controlled["max_level"]),
+        "value": controlled["availability_pct"],
+        "unit": "%",
+        "seed": seed,
+        "n_tenants": n_tenants,
+        "rounds": rounds,
+        "storm_rounds": [[a_start, a_start + a_len],
+                         [b_start, b_start + b_len]],
+        "storm_deadlines_s": [storm_a.deadline_s, storm_b.deadline_s],
+        "slo_target_pct": target_pct,
+        "uncontrolled_availability_pct":
+            uncontrolled["availability_pct"],
+        "controlled_availability_pct": controlled["availability_pct"],
+        "autopilot_delta_pct": delta,
+        "moves": {"total": controlled["moves"],
+                  "down": controlled["moves_down"],
+                  "up": controlled["moves_up"],
+                  "max_level": controlled["max_level"]},
+        "ladder": controlled["ladder"],
+        "budget_spent_by_policy": int(telemetry.metrics().counter(
+            "error_budget_spent_by_policy").total()),
+        "incident": controlled["incident"],
+        "journal": controlled["journal"],
+        "platform": platform,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def _restore_bench_specs(n_tenants: int):
     """ONE deterministic TenantSpec construction shared by the
     --chaos-mesh parent (checkpoint save) and the --restore-mttr child
@@ -3148,7 +3396,8 @@ def _measure_failsoft(mode_args: list, cpu_mode_args: "list | None" = None,
 
 def _qualified_metric(base: str, platform: str, n_devices: int = 1,
                       degraded: bool = False,
-                      mesh_shape: "tuple | None" = None) -> str:
+                      mesh_shape: "tuple | None" = None,
+                      quality_level: int = 0) -> str:
     """The ONE metric-qualification rule (used by the headline and by
     ``--chaos-mesh``/``--chaos-scenario``): unqualified names are
     reserved for TPU; any other platform gets a ``_<platform>`` suffix
@@ -3162,7 +3411,10 @@ def _qualified_metric(base: str, platform: str, n_devices: int = 1,
     ``_degraded`` (ISSUE 10/14 — a fallback round must never read as
     the full-mesh steady state's regression, or its improvement; a
     degraded 2-D round publishes ``_d<A>x<S>_degraded`` at its reduced
-    shape, never the full-mesh key).
+    shape, never the full-mesh key); a run the SLO autopilot held at
+    reduced quality gains ``_q<level>`` — the deepest ladder level
+    reached (ISSUE 17: a quality-reduced availability number must never
+    read as a full-quality headline).
 
     The rule itself lives in
     :func:`agentlib_mpc_tpu.telemetry.regression.qualified_metric`
@@ -3172,7 +3424,7 @@ def _qualified_metric(base: str, platform: str, n_devices: int = 1,
     from agentlib_mpc_tpu.telemetry.regression import qualified_metric
 
     return qualified_metric(base, platform, n_devices, degraded,
-                            mesh_shape)
+                            mesh_shape, quality_level)
 
 
 def _headline_metric(platform: str, n_devices: int = 1,
@@ -3271,6 +3523,20 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_chaos_mesh(seed, n)
+        return
+
+    if "--chaos-autopilot" in sys.argv:
+        # SLO-autopilot A/B under a seeded overload storm, in-process
+        # like --chaos-serve (pin JAX_PLATFORMS=cpu for a tunnel-free
+        # host run):
+        #   python bench.py --chaos-autopilot SEED [n_tenants]
+        idx = sys.argv.index("--chaos-autopilot")
+        seed, n = 0, 8
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            seed = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            n = int(sys.argv[idx + 2])
+        run_chaos_autopilot(seed, n)
         return
 
     if "--chaos-serve" in sys.argv:

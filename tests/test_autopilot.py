@@ -1,0 +1,420 @@
+"""SLO autopilot: the feedback controller that spends the error budget.
+
+The tentpole contracts (ISSUE 17 / docs/serving.md "SLO autopilot"):
+
+* hysteresis: ``degrade_after`` hot rounds per down-move,
+  ``restore_after`` cool rounds per up-move, probation re-degrades on
+  ONE hot round — the controller never flaps on alternating rounds;
+* L1 caps the warm iteration budget by RE-BUCKETING through the
+  compile cache (cache hit after first use, deterministic digests);
+* L2 relaxes admission deadlines host-side — explicit deadlines too;
+* L3 shrinks a robust tenant's tree to its highest-probability
+  branches (flat-bucket squeeze at S=1) and restores it on the way up;
+* controller state (levels AND counters) rides the plane checkpoint —
+  a restore resumes mid-incident at the same quality level without
+  re-growing the tree, and restoring autopilot state into a plane
+  without a controller fails loudly;
+* ``SLOTracker.forget`` tombstones instead of dropping — membership
+  churn cannot launder a burn rate;
+* quality-reduced metrics publish under the ``_q<level>`` key;
+* the incident builder joins overload → down-move → up-move chains.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+from agentlib_mpc_tpu.scenario.tree import fan_tree
+from agentlib_mpc_tpu.serving import (
+    AutopilotPolicy,
+    CompileCache,
+    ServingPlane,
+    TenantSpec,
+)
+from agentlib_mpc_tpu.serving.autopilot import LEVERS, SLOAutopilot
+from agentlib_mpc_tpu.telemetry.slo import SLOPolicy, SLOTracker
+
+ADMM_OPTS = FusedADMMOptions(max_iterations=4, rho=2.0)
+SOLVER_OPTS = SolverOptions(max_iter=30)
+#: fast 2-round window + 80% availability target: one missed round in
+#: the window is burn 2.5 (hot), one clean window is burn 0 (cool)
+SLO = SLOPolicy(availability_target=0.8, windows=(2, 4))
+PILOT = AutopilotPolicy(degrade_after=2, restore_after=2,
+                        probation_rounds=2)
+
+
+@pytest.fixture(scope="module")
+def ocp():
+    return tracker_ocp()
+
+
+@pytest.fixture(scope="module")
+def cache():
+    """Shared across the module's planes: identical structures build
+    once (the bucket digests are content-addressed, tenant-id-free)."""
+    return CompileCache()
+
+
+def flat_spec(ocp, tid, a=1.0, **kw):
+    return TenantSpec(
+        tenant_id=tid, ocp=ocp,
+        theta=ocp.default_params(p=jnp.array([float(a)])),
+        couplings={"shared_u": "u"},
+        solver_options=SOLVER_OPTS, **kw)
+
+
+def robust_spec(ocp, tid):
+    """2-branch fan with skewed probabilities: L3 at keep_fraction 0.5
+    must keep exactly branch 0 (p=1.0), and the collapsed S=1 spec
+    must squeeze into the flat bucket."""
+    theta = jax.tree.map(
+        lambda leaf: jnp.broadcast_to(jnp.asarray(leaf),
+                                      (2,) + np.shape(leaf)),
+        ocp.default_params())
+    theta = theta._replace(
+        p=jnp.stack([jnp.array([1.0]), jnp.array([2.0])]))
+    return TenantSpec(
+        tenant_id=tid, ocp=ocp, theta=theta,
+        couplings={"shared_u": "u"}, solver_options=SOLVER_OPTS,
+        scenario_tree=fan_tree(2, probabilities=(0.7, 0.3)))
+
+
+def make_plane(cache, **kw):
+    kw.setdefault("slo_policy", SLO)
+    kw.setdefault("autopilot", PILOT)
+    return ServingPlane(ADMM_OPTS, slot_multiple=1, initial_capacity=2,
+                        pipelined=False, donate=False, cache=cache,
+                        **kw)
+
+
+class Clock:
+    """Virtual round clock: a bad round's request expires at the drain
+    (submitted with a deadline shorter than the round), a good round's
+    does not — burn is driven entirely by ``now`` arithmetic."""
+
+    def __init__(self, plane):
+        self.plane = plane
+        self.t = 0.0
+
+    def bad(self, *tids):
+        for tid in tids:
+            self.plane.submit(tid, deadline_s=0.1, now=self.t)
+        self.t += 1.0
+        out = self.plane.serve_round(now=self.t)
+        self.t += 1.0
+        return out
+
+    def good(self, *tids):
+        for tid in tids:
+            self.plane.submit(tid, now=self.t)
+        out = self.plane.serve_round(now=self.t)
+        self.t += 1.0
+        return out
+
+
+class TestPolicy:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="burn_threshold"):
+            AutopilotPolicy(burn_threshold=0.0)
+        with pytest.raises(ValueError, match="dead band"):
+            AutopilotPolicy(restore_threshold=1.5, burn_threshold=1.0)
+        with pytest.raises(ValueError, match="max_level"):
+            AutopilotPolicy(max_level=7)
+        with pytest.raises(ValueError, match="TIGHTENS"):
+            AutopilotPolicy(l2_deadline_factor=0.5)
+        with pytest.raises(ValueError, match="keep_fraction"):
+            AutopilotPolicy(l3_keep_fraction=0.0)
+        with pytest.raises(ValueError, match="unknown autopilot"):
+            AutopilotPolicy.from_config({"warp_factor": 9})
+
+    def test_plane_wiring(self, cache):
+        with pytest.raises(TypeError, match="autopilot"):
+            make_plane(cache, autopilot=object())
+        plane = make_plane(cache, autopilot=None)
+        assert plane.autopilot is None
+        plane = make_plane(cache)
+        assert isinstance(plane.autopilot, SLOAutopilot)
+        # no mesh hook: the effective ladder tops out at L3
+        assert plane.autopilot.effective_max_level == 3
+
+
+class TestHysteresis:
+    def test_burn_walks_the_ladder_both_ways(self, ocp, cache):
+        plane = make_plane(cache)
+        plane.join(flat_spec(ocp, "t0"))
+        auto = plane.autopilot
+        key0 = plane._tenant_bucket["t0"]
+        clk = Clock(plane)
+
+        # warm-up: one clean round stores an actuation plan, so the
+        # deadline storm below degrades through replay/hold instead of
+        # falling straight through to the fallback controller
+        assert clk.good("t0")["t0"].action == "actuate"
+        # ONE hot round does not move (degrade_after=2): no flapping
+        clk.bad("t0")
+        assert auto.level("t0") == 0
+        assert auto.row("t0").hot_streak == 1
+        # the second consecutive hot round buys the L1 down-move
+        clk.bad("t0")
+        assert auto.level("t0") == 1
+        spec = plane._specs["t0"]
+        assert spec.warm_solver_options is not None
+        assert spec.warm_solver_options.max_iter == \
+            PILOT.l1_warm_max_iter
+        key1 = plane._tenant_bucket["t0"]
+        assert key1 != key0, "L1 must re-bucket (warm budget is a key " \
+                             "field)"
+        # L1 does not touch deadlines
+        assert auto.relaxed_deadline("t0", 0.1) == 0.1
+        # two more hot rounds walk to L2 — which relaxes deadlines
+        clk.bad("t0")
+        assert auto.level("t0") == 1
+        clk.bad("t0")
+        assert auto.level("t0") == 2
+        assert auto.relaxed_deadline("t0", 0.1) == pytest.approx(
+            0.1 * PILOT.l2_deadline_factor)
+        # L2 is host-side: same bucket as L1
+        assert plane._tenant_bucket["t0"] == key1
+
+        # recovery is hysteretic: the fast window still carries the
+        # last miss on the first good round — no up-move until
+        # restore_after CLEAN windows
+        clk.good("t0")
+        assert auto.level("t0") == 2
+        clk.good("t0")
+        assert auto.level("t0") == 2
+        clk.good("t0")
+        assert auto.level("t0") == 1, "2 cool rounds buy ONE up-move"
+        assert auto.row("t0").probation == PILOT.probation_rounds
+        # probation: a SINGLE hot round re-degrades immediately
+        clk.bad("t0")
+        assert auto.level("t0") == 2
+        assert auto.row("t0").probation == 0
+
+    def test_idle_rounds_never_earn_restore(self, ocp, cache):
+        plane = make_plane(cache)
+        plane.join(flat_spec(ocp, "t0"))
+        auto = plane.autopilot
+        clk = Clock(plane)
+        clk.good("t0")
+        clk.bad("t0")
+        assert auto.row("t0").hot_streak == 1
+        # the window is ROUND-based: one idle round later the fast
+        # window still spans the miss, so the streak keeps building
+        # and buys the L1 move...
+        plane.serve_round(now=clk.t)
+        assert auto.level("t0") == 1
+        # ...but once the miss ages out, idle rounds read burn=None and
+        # are NEUTRAL: no cool credit, no restore — a silent tenant
+        # cannot buy its quality back without delivering clean traffic
+        for _ in range(6):
+            plane.serve_round(now=clk.t)
+        assert auto.level("t0") == 1
+        assert auto.row("t0").cool_streak == 0
+
+
+class TestLevers:
+    def test_l2_relaxes_explicit_deadline_at_submit(self, ocp, cache):
+        plane = make_plane(cache)
+        plane.join(flat_spec(ocp, "t0"))
+        assert plane.autopilot.force_level(plane, "t0", 2)
+        # deadline 0.5 would expire at now=1.0; the x4 relaxation
+        # (applied to the EXPLICIT deadline) keeps it admissible
+        plane.submit("t0", deadline_s=0.5, now=0.0)
+        res = plane.serve_round(now=1.0)
+        assert res["t0"].action == "actuate"
+
+    def test_l3_shrinks_tree_and_restores_it(self, ocp, cache):
+        plane = make_plane(cache)
+        plane.join(robust_spec(ocp, "r0"))
+        assert plane._specs["r0"].scenario_tree.n_scenarios == 2
+        assert plane.autopilot.force_level(plane, "r0", 3)
+        spec = plane._specs["r0"]
+        # keep_fraction 0.5 keeps the high-probability branch only —
+        # the S=1 degenerate squeezes into the FLAT bucket
+        assert spec.scenario_tree is None
+        assert spec.theta.p.shape == (1,)
+        assert float(spec.theta.p[0]) == pytest.approx(1.0)
+        assert plane.autopilot.force_level(plane, "r0", 0)
+        spec = plane._specs["r0"]
+        assert spec.scenario_tree is not None
+        assert spec.scenario_tree.n_scenarios == 2
+        assert spec.theta.p.shape == (2, 1)
+        assert spec.warm_solver_options is None
+
+    def test_ladder_cycle_is_cache_hit_after_first_use(self, ocp,
+                                                       cache):
+        plane = make_plane(cache)
+        plane.join(robust_spec(ocp, "r0"))
+        digests = {}
+
+        def cycle(record):
+            for lvl in (1, 2, 3, 2, 1, 0):
+                assert plane.autopilot.force_level(plane, "r0", lvl)
+                d = plane._tenant_bucket["r0"].digest
+                if record:
+                    digests[lvl] = d
+                else:
+                    assert digests[lvl] == d, \
+                        "effective bucket digests must be " \
+                        "deterministic across cycles"
+
+        cycle(record=True)          # pays each level's build once
+        misses = plane.cache.misses
+        hits = plane.cache.hits
+        cycle(record=False)         # every rung comes out of the cache
+        assert plane.cache.misses == misses, \
+            "repeat ladder cycle caused a cold engine build"
+        assert plane.cache.hits > hits
+
+
+class TestCheckpoint:
+    def test_mid_incident_restore_keeps_level_and_counters(
+            self, ocp, cache, tmp_path):
+        plane = make_plane(cache)
+        plane.join(robust_spec(ocp, "r0"))
+        assert plane.autopilot.force_level(plane, "r0", 3)
+        row = plane.autopilot.row("r0")
+        row.hot_streak = 1
+        row.cool_streak = 0
+        row.probation = 1
+        shrunk = plane._tenant_bucket["r0"].digest
+        path = plane.save_checkpoint(str(tmp_path / "plane"))
+
+        fresh = make_plane(cache)
+        misses = fresh.cache.misses
+        report = fresh.restore_checkpoint(path, {"r0": robust_spec(
+            ocp, "r0")})
+        # the restore resumes mid-incident: same level, same counters,
+        # same SHRUNK effective bucket — through the cache, not a build
+        assert report.cold_builds == 0
+        assert fresh.cache.misses == misses
+        assert fresh.autopilot.level("r0") == 3
+        restored = fresh.autopilot.row("r0")
+        assert (restored.hot_streak, restored.cool_streak,
+                restored.probation, restored.moves) == \
+            (row.hot_streak, row.cool_streak, row.probation, row.moves)
+        assert fresh._tenant_bucket["r0"].digest == shrunk
+        assert fresh._specs["r0"].scenario_tree is None
+        # the first post-restore round must NOT re-grow the tree (one
+        # cool round is still below restore_after)
+        fresh.submit("r0", now=0.0)
+        res = fresh.serve_round(now=0.0)
+        assert res["r0"].action == "actuate"
+        assert fresh.autopilot.level("r0") == 3
+        assert fresh._specs["r0"].scenario_tree is None
+        assert fresh.cache.misses == misses
+
+    def test_autopilot_state_without_controller_is_rejected(
+            self, ocp, cache, tmp_path):
+        plane = make_plane(cache)
+        plane.join(robust_spec(ocp, "r0"))
+        assert plane.autopilot.force_level(plane, "r0", 1)
+        path = plane.save_checkpoint(str(tmp_path / "plane"))
+        bare = make_plane(cache, autopilot=None)
+        with pytest.raises(ValueError,
+                           match="no autopilot= configured"):
+            bare.restore_checkpoint(path, {"r0": robust_spec(ocp,
+                                                             "r0")})
+
+
+class TestForgetTombstone:
+    def test_rejoin_resumes_burn_inside_window(self):
+        slo = SLOTracker(SLOPolicy(availability_target=0.8,
+                                   windows=(2, 4)))
+        for r in range(2):
+            slo.record_result("a", "hold")
+            slo.tick_round(r)
+        assert slo.burn_rates()["a"][2] == pytest.approx(5.0)
+        slo.forget("a")
+        # tombstoned: out of the report's tenant section...
+        assert "a" not in slo.report()["tenants"]
+        # ...but a rejoin INSIDE max_window resumes the old windows —
+        # a fresh row would read burn 0 here, laundering the burn
+        slo.record_result("a", "actuate")
+        slo.tick_round(2)
+        assert slo.burn_rates()["a"][2] == pytest.approx(2.5)
+        assert "a" in slo.report()["tenants"]
+
+    def test_row_really_goes_after_window_ages_out(self):
+        slo = SLOTracker(SLOPolicy(availability_target=0.8,
+                                   windows=(2, 4)))
+        slo.record_result("a", "hold")
+        slo.tick_round(0)
+        slo.forget("a")
+        snap = slo.snapshot()
+        assert snap["tombstones"] == {"a": 4}
+        # restore round-trips the tombstone
+        slo2 = SLOTracker(SLOPolicy(availability_target=0.8,
+                                    windows=(2, 4)))
+        slo2.restore(snap)
+        assert "a" not in slo2.report()["tenants"]
+        for r in range(1, 5):
+            slo2.tick_round(r)
+        assert "a" not in slo2.burn_rates()
+        assert "a" not in slo2.snapshot()["tenants"]
+
+
+class TestQualifiedMetric:
+    def test_quality_level_suffix(self):
+        from agentlib_mpc_tpu.telemetry.regression import (
+            qualified_metric,
+        )
+
+        assert qualified_metric("m", "tpu") == "m"
+        assert qualified_metric("m", "cpu", quality_level=3) == \
+            "m_cpu_q3"
+        assert qualified_metric("m", "tpu", quality_level=1) == "m_q1"
+        assert qualified_metric("m", "tpu", n_devices=4,
+                                quality_level=2) == "m_d4_q2"
+        assert qualified_metric("m", "cpu", degraded=True,
+                                quality_level=2) == "m_cpu_q2_degraded"
+        # level 0 = full quality = no suffix
+        assert qualified_metric("m", "cpu", quality_level=0) == "m_cpu"
+
+
+class TestIncidentChain:
+    EVENTS = [
+        {"seq": 1, "round": 4, "etype": "chaos.injected",
+         "rule": "serve_overload", "target": "round4", "seed": 0},
+        {"seq": 2, "round": 5, "etype": "autopilot.move",
+         "tenant": "t0", "level_from": 0, "level_to": 1,
+         "direction": "down", "lever": LEVERS[1], "trigger": "burn",
+         "burn": 2.5, "window": 2, "probation_strike": False},
+        {"seq": 3, "round": 9, "etype": "autopilot.move",
+         "tenant": "t0", "level_from": 1, "level_to": 0,
+         "direction": "up", "lever": LEVERS[1], "trigger": "burn",
+         "burn": 0.0, "window": 2, "probation_strike": False},
+    ]
+
+    def test_overload_chain_joins_down_then_up(self):
+        from agentlib_mpc_tpu.telemetry.incident import build_incident
+
+        report = build_incident(list(self.EVENTS))
+        assert report["complete_chains"] == 1
+        chain = report["chains"][0]
+        assert chain["symptom"]["direction"] == "down"
+        assert chain["recovery"]["direction"] == "up"
+
+    def test_down_move_alone_is_incomplete(self):
+        from agentlib_mpc_tpu.telemetry.incident import build_incident
+
+        report = build_incident(list(self.EVENTS[:2]))
+        assert report["complete_chains"] == 0
+        assert report["chains"][0]["status"] == "incomplete"
+
+    def test_markdown_renders_the_ladder_transition(self):
+        from agentlib_mpc_tpu.telemetry.incident import (
+            build_incident,
+            render_markdown,
+        )
+
+        md = render_markdown(build_incident(list(self.EVENTS)))
+        assert "autopilot.move" in md
+        assert "L0→L1" in md
+        assert "warm_iters" in md
+        assert "burn=2.5 over 2-round window" in md
