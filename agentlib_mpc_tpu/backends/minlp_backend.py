@@ -498,7 +498,10 @@ class BranchAndBoundBackend(MINLPBackend):
             pad = 2 * self._batch_pairs - n_real
             thetas += [thetas[0]] * pad
             theta_batch = jax.tree.map(lambda *xs: jnp.stack(xs), *thetas)
-            u_batch, stats = self._solve_nodes(
+            # sequential by construction: each B&B wave's nodes depend
+            # on the previous wave's bounds, and the wave itself is
+            # already one batched dispatch
+            u_batch, stats = self._solve_nodes(  # lint: ignore[jit-dispatch-in-loop]
                 theta_batch,
                 jnp.asarray(self.solver_options.mu_init,
                             dtype=ctx["dtype"]))

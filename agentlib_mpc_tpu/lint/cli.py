@@ -40,7 +40,8 @@ Modes:
   gates — run in a fresh process).
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
   structure proof, dtype propagation, cost model, memory
-  certification) over the example-OCP menu against the
+  certification, dispatch-schedule certification against the
+  ``[jaxpr.dispatch]`` pins) over the example-OCP menu against the
   ``[jaxpr.expect]`` expectations in ``lint_budgets.toml`` (imports
   jax, like the retrace gate).
 """
@@ -292,8 +293,33 @@ def main(argv: "list[str] | None" = None) -> int:
 
         mem = memory_gate_summary({"jaxpr": budgets})
         mem_failures = _print_memory_summary(mem)
+        # dispatch leg (ISSUE 18): the mesh fleets' warm rounds must
+        # certify to the exact [jaxpr.dispatch] pins — one dispatch
+        # per round, zero unplanned host syncs; an injected
+        # pure_callback or un-donated round-trip fails lint --jaxpr
+        # naming the eqn's source
+        from agentlib_mpc_tpu.lint.jaxpr.dispatch import (
+            dispatch_gate_summary,
+        )
+
+        disp = dispatch_gate_summary({"jaxpr": budgets})
+        for r in disp["fleets"]:
+            if "error" in r:
+                print(f"{r['name']}: dispatch certification ERROR "
+                      f"[FAIL]\n  {r['error']}")
+                continue
+            status = "FAIL" if r["violations"] else "ok"
+            cert = r["certificate"]
+            print(f"{r['name']}: dispatch {cert['status']} "
+                  f"dispatches={r['dispatches_per_round']}/round "
+                  f"host_syncs={cert['host_syncs']} "
+                  f"digest={r['digest']} "
+                  f"transfer={r['transfer_bytes_per_round']}B/round "
+                  f"[{status}]")
+            for v in r["violations"]:
+                print(f"  FAILED: {v}")
         total = summary["failures"] + growth["failures"] \
-            + coll["failures"] + mem_failures
+            + coll["failures"] + mem_failures + disp["failures"]
         if total:
             print(f"FAILED: {total} jaxpr certification "
                   f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
@@ -302,7 +328,7 @@ def main(argv: "list[str] | None" = None) -> int:
               f"example OCP(s) proved, eval+jac growth within "
               f"{growth['max_growth']}x, collective schedules proved "
               f"over {coll['devices']} device(s), memory certificates "
-              f"bound XLA", file=sys.stderr)
+              f"bound XLA, dispatch schedules pinned", file=sys.stderr)
         return 0
 
     if args.stats:

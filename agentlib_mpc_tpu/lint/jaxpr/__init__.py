@@ -9,7 +9,7 @@ exactly one level down, in the jaxpr, where JAX's tracing design (Frostig
 et al.) gives a complete dataflow IR of the traced function: every
 primitive application, every constant, no Python control flow left.
 
-Six passes over one shared per-primitive interpreter (:mod:`.interp`):
+Eight passes over one shared per-primitive interpreter (:mod:`.interp`):
 
 * :func:`certify_lq` (:mod:`.lq`) — a polynomial-degree lattice
   {const, affine, quadratic, nonpoly} propagated per element through
@@ -49,6 +49,20 @@ Six passes over one shared per-primitive interpreter (:mod:`.interp`):
   :func:`plan_capacity` inverts the per-lane marginal into "how many
   agents / scenarios / tenant slots fit on one device".
 
+* :func:`certify_dispatch` (:mod:`.dispatch`) — the warm round's
+  host↔device schedule proved static: ordered dispatch boundaries with
+  shard-divided, donation-aware transfer bytes, every
+  ``pure_callback``-class host sync located by source and charged ×
+  loop trips, an unplanned sync inside the round refuted by name, and
+  a mesh-size-independent ``dispatch_digest`` riding the engine-store
+  and checkpoint stamps next to the collective and memory digests.
+* :func:`plan_fusion` (:mod:`.fusion`) — the analytic fusion planner:
+  per-phase op-cost × collective-bytes × live-range peaks joined
+  across candidate stage merges, ranked by modeled dispatch-overhead
+  savings vs projected peak-HBM growth, over-capacity plans refused —
+  the :class:`FusionPlan` artifact behind ``SolverOptions.fusion`` and
+  ``bench.py --emit-metrics``.
+
 Soundness boundary: primitives the interpreter cannot see through
 (``pure_callback``, custom AD rules, foreign calls) make a *tainted*
 result opaque — :func:`certify_lq` then returns ``"unknown"`` instead of
@@ -76,7 +90,18 @@ from agentlib_mpc_tpu.lint.jaxpr.cost import (  # noqa: F401
     compare_eval_jac_cost,
     op_cost,
 )
+from agentlib_mpc_tpu.lint.jaxpr.dispatch import (  # noqa: F401
+    DispatchBoundary,
+    DispatchCertificate,
+    certify_dispatch,
+    check_dispatch_budget,
+)
 from agentlib_mpc_tpu.lint.jaxpr.dtypes import check_dtypes  # noqa: F401
+from agentlib_mpc_tpu.lint.jaxpr.fusion import (  # noqa: F401
+    FusionCandidate,
+    FusionPlan,
+    plan_fusion,
+)
 from agentlib_mpc_tpu.lint.jaxpr.fingerprint import (  # noqa: F401
     StructuralFingerprint,
     jaxpr_digest,

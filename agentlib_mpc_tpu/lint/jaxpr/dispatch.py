@@ -1,0 +1,612 @@
+"""Dispatch certifier: prove the warm round's host↔device schedule.
+
+The seventh pass on the shared :mod:`.interp` stack. The reference
+stack pays a host round-trip per CasADi/IPOPT callback *by
+construction* (IPOPT drives Python-level eval callbacks); a jax_graft
+round is one ``jax.jit`` dispatch — **if nothing inside the traced
+program yields back to the host**. This pass makes that property a
+certificate instead of a hope: walk the traced round and emit the
+ordered :class:`DispatchBoundary` schedule —
+
+* the **program boundary** (the jit entry itself): host↔device
+  transfer bytes from invar/outvar shapes × shard-spec division (an
+  arg consumed by the top-level ``shard_map`` under a spec that shards
+  it over the mesh transfers ``global_bytes / axis_size`` per device),
+  donation-aware (donated invars are buffer *reuse* — their bytes are
+  reported separately, never charged as fresh transfer);
+* every **host sync** — ``pure_callback`` / ``io_callback`` / the
+  other :data:`~agentlib_mpc_tpu.lint.jaxpr.interp.CALLBACK_PRIMS`
+  materialize points — located by source, with its loop position
+  (``loop_path``), static multiplicity (scan lengths on the path) and
+  boundedness (a ``while`` frame makes the issue count data-dependent;
+  :meth:`DispatchCertificate.dispatch_count` charges it × the caller's
+  trip budget, the same PR 11 ``while_trips`` plumbing
+  :meth:`~.collectives.CollectiveCertificate.comm_bytes` uses).
+
+An **unplanned** host sync inside the warm round refutes the
+certificate, naming the offending eqn's source line — the build seam
+(:class:`~agentlib_mpc_tpu.parallel.fused_admm.FusedADMM`) refuses the
+program before it can ever pay a silent per-iteration round-trip on a
+pod. A *planned* sync (``allowed_sync_prims``) is scheduled and
+charged instead; its **host-side** cost is honestly unknown (the
+callback is never executed — the soundness boundary row in
+``docs/static_analysis.md``).
+
+``dispatch_digest`` is the mesh-size-independent identity of the
+schedule (boundary kinds, primitives, loop positions, multiplicities —
+never payload bytes, which scale with lane count): it rides the
+engine-store meta and the plane-checkpoint stamps next to the
+collective and memory digests, so a revived or restored engine whose
+fresh build would dispatch *differently* is refused the same way a
+collective-schedule drift is.
+
+CLI: the ``--jaxpr`` dispatch leg (:func:`dispatch_gate_summary`)
+holds the tracker + LinearRCZone mesh fleets to the
+``[jaxpr.dispatch]`` pins. See ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from agentlib_mpc_tpu.lint.jaxpr.interp import CALLBACK_PRIMS
+
+__all__ = [
+    "DispatchBoundary",
+    "DispatchCertificate",
+    "certify_dispatch",
+    "check_dispatch_budget",
+    "dispatch_gate_summary",
+]
+
+#: call-like primitives whose single sub-jaxpr is inlined transparently
+_CALL_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+    "remat2": "jaxpr",
+}
+
+
+def _source_of(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<unknown>"
+
+
+def _as_jaxpr(obj):
+    if hasattr(obj, "jaxpr"):          # ClosedJaxpr
+        return obj.jaxpr, list(obj.consts)
+    return obj, []
+
+
+def _var_bytes(v) -> int:
+    aval = v.aval
+    size = 1
+    for d in getattr(aval, "shape", ()):
+        size *= int(d)
+    return size * getattr(getattr(aval, "dtype", None), "itemsize", 4)
+
+
+def _contains_callback(obj, _seen=None) -> bool:
+    """Syntactic scan: does this (Closed)Jaxpr bind any callback
+    primitive anywhere? Lets the walker skip an unknown higher-order
+    primitive's sub-jaxprs when they provably hide no host sync."""
+    jaxpr, _ = _as_jaxpr(obj)
+    _seen = set() if _seen is None else _seen
+    if id(jaxpr) in _seen:
+        return False
+    _seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in CALLBACK_PRIMS:
+            return True
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    if _contains_callback(sub, _seen):
+                        return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchBoundary:
+    """One host↔device crossing of the round's schedule.
+
+    ``kind == "program"`` is the jit entry: ``in_bytes`` is what the
+    host (or a previous round's non-donated buffers) must land on the
+    device, ``out_bytes`` what the device hands back, ``donated_bytes``
+    the carry buffers donation lets XLA reuse in place. ``kind ==
+    "host_sync"`` is a callback materialize point *inside* the device
+    program: ``out_bytes`` ships the operands device→host, ``in_bytes``
+    ships the results back — one full round-trip per issue. Bytes are
+    per-device (shard-spec divided at the program boundary;
+    shard-local by construction inside a ``shard_map`` body)."""
+
+    kind: str                # "program" | "host_sync"
+    primitive: str           # "jit" | the callback primitive's name
+    in_bytes: int            # host -> device, one issue
+    out_bytes: int           # device -> host, one issue
+    donated_bytes: int       # donated buffer reuse (program boundary)
+    loop_path: tuple         # nesting position, outermost first
+    multiplicity: int        # product of static scan lengths on path
+    bounded: bool            # False when a while frame is on the path
+    source: str = ""
+
+    def issues(self, while_trips: int = 1) -> int:
+        """How many times this boundary is crossed per round, with
+        every unbounded ``while`` frame charged ``while_trips``."""
+        n = self.multiplicity
+        if not self.bounded:
+            n_while = sum(1 for f in self.loop_path if f == "while")
+            n *= max(int(while_trips), 1) ** max(n_while, 1)
+        return int(n)
+
+    def describe(self) -> str:
+        loop = "/".join(self.loop_path) or "top"
+        io = (f"in={self.in_bytes}B out={self.out_bytes}B"
+              + (f" donated={self.donated_bytes}B"
+                 if self.donated_bytes else ""))
+        src = f" ({self.source})" if self.source else ""
+        return f"{self.kind}:{self.primitive} {io} [{loop}]{src}"
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchCertificate:
+    """Outcome of :func:`certify_dispatch`.
+
+    ``status``:
+
+    * ``"proved"`` — the ordered ``boundaries`` are the round's
+      complete dispatch schedule (planned syncs, if any, ride in
+      ``opaque`` with their host-side cost noted unknown);
+    * ``"refuted"`` — an unplanned host sync sits inside the warm
+      round; ``refutations`` name each offending eqn by source;
+    * ``"unknown"`` — the walker could not interpret the program.
+    """
+
+    status: str
+    boundaries: tuple = ()       # ordered DispatchBoundary entries
+    refutations: tuple = ()
+    opaque: tuple = ()
+    notes: tuple = ()
+    axis_sizes: "dict | None" = None
+
+    @property
+    def proved(self) -> bool:
+        return self.status == "proved"
+
+    @property
+    def host_syncs(self) -> tuple:
+        return tuple(b for b in self.boundaries
+                     if b.kind == "host_sync")
+
+    def dispatch_count(self, while_trips: int = 1) -> int:
+        """Device dispatches per round: the program entry plus one
+        resume per host-sync issue (every sync splits the device
+        program and costs a fresh dispatch), loop-carried syncs
+        charged × ``while_trips`` per unbounded frame."""
+        return sum(b.issues(while_trips) for b in self.boundaries)
+
+    def transfer_bytes(self, while_trips: int = 1) -> int:
+        """Modeled host↔device bytes per round (both directions,
+        donated reuse excluded)."""
+        return sum((b.in_bytes + b.out_bytes) * b.issues(while_trips)
+                   for b in self.boundaries)
+
+    @property
+    def dispatch_digest(self) -> "str | None":
+        """Mesh-size-independent identity of the dispatch schedule:
+        boundary kind, primitive, loop position, multiplicity and
+        boundedness per entry, in program order — payload bytes
+        excluded (they scale with lane count and mesh size). Two
+        engines with equal digests cross the host↔device boundary the
+        same way. None unless proved."""
+        if self.status != "proved":
+            return None
+        ident = "|".join(
+            f"{b.kind}:{b.primitive}:{b.loop_path}"
+            f":x{b.multiplicity}:{'b' if b.bounded else 'u'}"
+            for b in self.boundaries)
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        if self.status == "proved":
+            syncs = self.host_syncs
+            extra = (f", {len(syncs)} planned host sync(s)"
+                     if syncs else ", no host syncs")
+            return (f"proved: {self.dispatch_count()} dispatch(es) per "
+                    f"round{extra}, "
+                    f"{self.transfer_bytes()} B boundary transfer")
+        if self.status == "refuted":
+            head = "; ".join(self.refutations[:2])
+            more = (f" (+{len(self.refutations) - 2} more)"
+                    if len(self.refutations) > 2 else "")
+            return f"REFUTED: {head}{more}"
+        return (f"unknown: {'; '.join(self.notes) or 'uninterpretable'}")
+
+    def as_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "boundaries": [b.describe() for b in self.boundaries],
+            "dispatches_per_round": (self.dispatch_count()
+                                     if self.status == "proved"
+                                     else None),
+            "host_syncs": len(self.host_syncs),
+            "transfer_bytes_per_round": (self.transfer_bytes()
+                                         if self.status == "proved"
+                                         else None),
+            "digest": self.dispatch_digest,
+            "refutations": list(self.refutations),
+            "opaque": sorted(set(self.opaque)),
+            "notes": list(self.notes),
+            "axis_sizes": dict(self.axis_sizes or {}),
+        }
+
+
+class _DispatchWalker:
+    """Locate every host-sync materialize point with its loop position.
+
+    No lattice needed: the question is purely structural (which eqns
+    are callbacks, under which control-flow frames), so the walk
+    mirrors :mod:`.cost`'s recursion — scan bodies multiply the path's
+    multiplicity, while bodies mark it unbounded, call-like primitives
+    inline, ``shard_map`` records mesh axis sizes (its body avals are
+    already shard-local, so no re-division)."""
+
+    def __init__(self, allowed_sync_prims=()):
+        self.allowed = frozenset(allowed_sync_prims)
+        self.syncs: list = []
+        self.refutations: list = []
+        self.opaque: list = []
+        self.notes: list = []
+        self.axis_sizes: dict = {}
+
+    def _note(self, msg: str) -> None:
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def walk(self, obj, path: tuple, mult: int, bounded: bool) -> None:
+        jaxpr, _ = _as_jaxpr(obj)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in CALLBACK_PRIMS:
+                # a host sync: operands ship device->host, results
+                # host->device — one full round-trip per issue
+                sync = DispatchBoundary(
+                    kind="host_sync", primitive=name,
+                    in_bytes=sum(_var_bytes(v) for v in eqn.outvars),
+                    out_bytes=sum(_var_bytes(v) for v in eqn.invars
+                                  if hasattr(v, "aval")),
+                    donated_bytes=0, loop_path=path,
+                    multiplicity=mult, bounded=bounded,
+                    source=_source_of(eqn))
+                self.syncs.append(sync)
+                if name in self.allowed:
+                    self.opaque.append(name)
+                    self._note(
+                        f"planned host sync {name} scheduled — its "
+                        f"host-side cost is unknown (never executed)")
+                else:
+                    loop = "/".join(path) or "top"
+                    self.refutations.append(
+                        f"unplanned host sync ({name}) inside the warm "
+                        f"round at {_source_of(eqn)} [loop {loop}, "
+                        f"x{sync.issues()} issue(s)"
+                        + ("" if bounded else
+                           " per while trip") + "] — every issue is a "
+                        f"device-program split plus a full "
+                        f"host round-trip")
+                continue
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                try:
+                    self.axis_sizes.update(
+                        {str(k): int(s)
+                         for k, s in dict(mesh.shape).items()})
+                except Exception:  # noqa: BLE001 — AbstractMesh variants
+                    pass
+                self.walk(eqn.params["jaxpr"], path, mult, bounded)
+                continue
+            if name in _CALL_PRIMS:
+                sub = eqn.params.get(_CALL_PRIMS[name])
+                if sub is not None:
+                    self.walk(sub, path, mult, bounded)
+                continue
+            if name == "scan":
+                length = int(eqn.params.get("length", 1))
+                self.walk(eqn.params["jaxpr"],
+                          path + (f"scan[{length}]",),
+                          mult * max(length, 1), bounded)
+                continue
+            if name == "while":
+                self.walk(eqn.params["cond_jaxpr"], path + ("while",),
+                          mult, False)
+                self.walk(eqn.params["body_jaxpr"], path + ("while",),
+                          mult, False)
+                continue
+            if name == "cond":
+                for br in eqn.params["branches"]:
+                    self.walk(br, path, mult, bounded)
+                continue
+            # unknown higher-order primitive: descend only when a
+            # callback provably hides inside (the multiplicity of such
+            # a frame is opaque — note it)
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if (hasattr(sub, "eqns") or hasattr(sub, "jaxpr")) \
+                            and _contains_callback(sub):
+                        self._note(
+                            f"descended into opaque primitive "
+                            f"{name} (host sync inside; its repeat "
+                            f"count is not statically charged)")
+                        self.walk(sub, path + (name,), mult, bounded)
+
+
+def _invar_factors(obj, axis_sizes: dict) -> list:
+    """Per-invar shard division factor at the program boundary: an arg
+    consumed (possibly through call-like wrappers) by a top-level
+    ``shard_map`` under a sharding spec transfers ``bytes / factor``
+    per device."""
+    from agentlib_mpc_tpu.lint.jaxpr.memory import _spec_factor
+
+    jaxpr, _ = _as_jaxpr(obj)
+    fac = {id(v): 1 for v in jaxpr.invars}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            try:
+                sizes = {str(k): int(s)
+                         for k, s in dict(mesh.shape).items()}
+            except Exception:  # noqa: BLE001
+                sizes = dict(axis_sizes)
+            for v, names in zip(eqn.invars, eqn.params["in_names"]):
+                if id(v) in fac:
+                    fac[id(v)] = max(fac[id(v)],
+                                     _spec_factor(names, sizes))
+        elif name in _CALL_PRIMS:
+            sub = eqn.params.get(_CALL_PRIMS[name])
+            if sub is None:
+                continue
+            sub_jaxpr, _ = _as_jaxpr(sub)
+            if len(sub_jaxpr.invars) != len(eqn.invars):
+                continue
+            sub_fac = _invar_factors(sub, axis_sizes)
+            for v, f in zip(eqn.invars, sub_fac):
+                if id(v) in fac:
+                    fac[id(v)] = max(fac[id(v)], int(f))
+    return [fac[id(v)] for v in jaxpr.invars]
+
+
+def _outvar_factors(obj, axis_sizes: dict) -> list:
+    """Per-outvar shard division factor (the mirror of
+    :func:`_invar_factors` over ``out_names``)."""
+    from agentlib_mpc_tpu.lint.jaxpr.memory import _spec_factor
+
+    jaxpr, _ = _as_jaxpr(obj)
+    fac = {id(v): 1 for v in jaxpr.outvars}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            try:
+                sizes = {str(k): int(s)
+                         for k, s in dict(mesh.shape).items()}
+            except Exception:  # noqa: BLE001
+                sizes = dict(axis_sizes)
+            for v, names in zip(eqn.outvars, eqn.params["out_names"]):
+                if id(v) in fac:
+                    fac[id(v)] = max(fac[id(v)],
+                                     _spec_factor(names, sizes))
+        elif name in _CALL_PRIMS:
+            sub = eqn.params.get(_CALL_PRIMS[name])
+            if sub is None:
+                continue
+            sub_jaxpr, _ = _as_jaxpr(sub)
+            if len(sub_jaxpr.outvars) != len(eqn.outvars):
+                continue
+            sub_fac = _outvar_factors(sub, axis_sizes)
+            for v, f in zip(eqn.outvars, sub_fac):
+                if id(v) in fac:
+                    fac[id(v)] = max(fac[id(v)], int(f))
+    return [fac[id(v)] for v in jaxpr.outvars]
+
+
+def certify_dispatch(fn_or_jaxpr, *args, donated_invars=None,
+                     allowed_sync_prims=()) -> DispatchCertificate:
+    """Certify the dispatch schedule of a traced round.
+
+    ``fn_or_jaxpr``: a ``ClosedJaxpr`` (pass no ``args``) or a callable
+    traced as ``jax.make_jaxpr(fn)(*args)`` — typically the (possibly
+    shard-mapped) step of a fused engine on shape templates.
+    ``donated_invars``: per-flat-invar donation mask (the jit
+    ``donate_argnums`` expansion) — donated bytes are buffer reuse,
+    reported but never charged as transfer. ``allowed_sync_prims``:
+    callback primitives that are *planned* (scheduled and charged, the
+    verdict stays proved); any other callback inside the round refutes,
+    naming the eqn's source.
+
+    Never executes user code (the callbacks stay un-run — their
+    host-side cost is the pass's honest unknown)."""
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+    walker = _DispatchWalker(allowed_sync_prims=allowed_sync_prims)
+    try:
+        walker.walk(closed, (), 1, True)
+        invars = list(closed.jaxpr.invars)
+        outvars = list(closed.jaxpr.outvars)
+        in_fac = _invar_factors(closed, walker.axis_sizes)
+        out_fac = _outvar_factors(closed, walker.axis_sizes)
+    except Exception as exc:  # noqa: BLE001 — certification must not
+        # kill an engine build; an uninterpretable program is "unknown"
+        return DispatchCertificate(
+            status="unknown",
+            notes=(f"interpreter error: {exc!r}",))
+    donated = tuple(donated_invars or ())
+    if donated and len(donated) != len(invars):
+        walker._note(
+            f"donated_invars has {len(donated)} entries for "
+            f"{len(invars)} invars — donation mask ignored")
+        donated = ()
+    donated = donated or (False,) * len(invars)
+    in_bytes = sum(_var_bytes(v) // max(f, 1)
+                   for v, f, d in zip(invars, in_fac, donated) if not d)
+    donated_bytes = sum(_var_bytes(v) // max(f, 1)
+                        for v, f, d in zip(invars, in_fac, donated)
+                        if d)
+    out_bytes = sum(_var_bytes(v) // max(f, 1)
+                    for v, f in zip(outvars, out_fac)
+                    if hasattr(v, "aval"))
+    entry = DispatchBoundary(
+        kind="program", primitive="jit", in_bytes=int(in_bytes),
+        out_bytes=int(out_bytes), donated_bytes=int(donated_bytes),
+        loop_path=(), multiplicity=1, bounded=True)
+    status = "refuted" if walker.refutations else "proved"
+    return DispatchCertificate(
+        status=status,
+        boundaries=(entry, *walker.syncs),
+        refutations=tuple(walker.refutations),
+        opaque=tuple(walker.opaque),
+        notes=tuple(walker.notes),
+        axis_sizes=dict(walker.axis_sizes),
+    )
+
+
+def check_dispatch_budget(cert: DispatchCertificate,
+                          cfg: dict) -> "list[str]":
+    """Compare a certificate against the ``[jaxpr.dispatch]`` budget.
+
+    Keys (all optional):
+
+    * ``dispatches_per_round`` — exact pin on the warm round's device
+      dispatch count (syncs charged once, not × trips: the pin is the
+      schedule's shape, the trip charging is the cost model's job);
+    * ``max_host_syncs`` — ceiling on scheduled host-sync boundaries
+      (0 = the fused round never yields to the host);
+    * ``max_transfer_bytes_per_round`` — ceiling on modeled per-device
+      boundary transfer (donated reuse excluded).
+
+    Returns violation strings (empty = within budget)."""
+    out = []
+    if not cert.proved:
+        out.append(f"dispatch schedule not proved: {cert.describe()}")
+        return out
+    want = cfg.get("dispatches_per_round")
+    if want is not None and cert.dispatch_count() != int(want):
+        detail = "\n  ".join(b.describe() for b in cert.boundaries)
+        out.append(
+            f"the warm round makes {cert.dispatch_count()} "
+            f"dispatch(es), budget pins {want} — a boundary was added "
+            f"to (or dropped from) the round's schedule. "
+            f"Boundaries:\n  {detail}")
+    max_syncs = cfg.get("max_host_syncs")
+    if max_syncs is not None and len(cert.host_syncs) > int(max_syncs):
+        detail = "\n  ".join(b.describe() for b in cert.host_syncs)
+        out.append(
+            f"{len(cert.host_syncs)} host sync(s) scheduled inside "
+            f"the warm round (budget {max_syncs}):\n  {detail}")
+    max_bytes = cfg.get("max_transfer_bytes_per_round")
+    if max_bytes is not None \
+            and cert.transfer_bytes() > int(max_bytes):
+        out.append(
+            f"modeled boundary transfer {cert.transfer_bytes()} B per "
+            f"round exceeds the {int(max_bytes)} B budget — an "
+            f"un-donated round-trip grew the host↔device bill")
+    return out
+
+
+def dispatch_gate_summary(budgets: "dict | None" = None) -> dict:
+    """The ``--jaxpr`` CLI's dispatch leg: build the same mesh fleets
+    the collectives gate certifies, read each engine's build-time
+    dispatch certificate, and hold BOTH fleets to the
+    ``[jaxpr.dispatch]`` pins (exact dispatches-per-warm-round, zero
+    unplanned host syncs). CI runs it under the 8-virtual-device pin.
+    Also the ``dispatch_certificates`` section of
+    ``bench.py --emit-metrics``."""
+    import jax
+
+    from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+    cfg = (budgets if budgets is not None else load_budgets()).get(
+        "jaxpr", {}).get("dispatch", {})
+    n_dev = len(jax.devices())
+    rows = []
+    failures = 0
+
+    def one_fleet(name, build_engine):
+        nonlocal failures
+        try:
+            engine = build_engine()
+            cert = engine.dispatch_certificate
+            if cert is None:
+                raise RuntimeError("engine carries no dispatch "
+                                   "certificate")
+            violations = check_dispatch_budget(cert, cfg)
+        except Exception as exc:  # noqa: BLE001 — report, don't crash CI
+            rows.append({"name": name, "error": repr(exc)})
+            failures += 1
+            return
+        if violations:
+            failures += len(violations)
+        rows.append({
+            "name": name,
+            "certificate": cert.as_dict(),
+            "digest": cert.dispatch_digest,
+            "dispatches_per_round": cert.dispatch_count(),
+            "transfer_bytes_per_round": cert.transfer_bytes(),
+            "violations": violations,
+        })
+
+    def tracker_fleet():
+        from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel import multihost
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        ocp = tracker_ocp()
+        group = AgentGroup(
+            name="dispatch-gate", ocp=ocp, n_agents=max(n_dev, 2),
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30))
+        return FusedADMM([group],
+                         FusedADMMOptions(max_iterations=8, rho=2.0),
+                         mesh=multihost.fleet_mesh())
+
+    def menu_fleet():
+        from agentlib_mpc_tpu.lint.jaxpr.examples import build_example
+        from agentlib_mpc_tpu.parallel import multihost
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        ocp = build_example("LinearRCZone/colloc-d1")
+        group = AgentGroup(
+            name="menu-dispatch-fleet", ocp=ocp, n_agents=max(n_dev, 2),
+            couplings={"Q_shared": "Q"})
+        return FusedADMM([group],
+                         FusedADMMOptions(max_iterations=8, rho=2.0),
+                         mesh=multihost.fleet_mesh())
+
+    one_fleet("tracker-consensus-fleet", tracker_fleet)
+    one_fleet("LinearRCZone-consensus-fleet", menu_fleet)
+    return {"fleets": rows, "failures": failures, "devices": n_dev,
+            "budget": dict(cfg)}

@@ -1,0 +1,270 @@
+"""Analytic fusion planner: rank boundary merges before touching silicon.
+
+ROADMAP item 2's structural lever, made a *model* instead of a hunch:
+the reference design dispatches the IPM iteration's stages — eval+jac,
+banded assemble, stage factor, line search — as separate device
+programs (CasADi/IPOPT goes further and pays a host round-trip per
+callback). Each stage boundary costs a fixed dispatch overhead plus
+the HBM round-trip of its intermediates; fusing stages buys both back
+at the price of co-resident working sets. This planner joins the three
+certified models the ``lint/jaxpr`` stack already carries —
+
+* :func:`~agentlib_mpc_tpu.telemetry.calibration.phase_costs`
+  (the :func:`~.cost.op_cost` charging rules accumulated per
+  ``phase.*`` name-stack component) for per-phase FLOPs and bytes;
+* :meth:`~.collectives.CollectiveCertificate.comm_bytes` for the
+  round's cross-device traffic (a fused region must keep its psums —
+  fusion may never reorder the collective schedule);
+* the PR 13 live-range walk (:func:`~.memory.certify_memory`) for the
+  projected peak-HBM bound — the walk runs on the *fused* trace, where
+  every merged stage's buffers are already co-resident, so its peak
+  bounds every partial merge from above;
+
+— across every contiguous merge of the observed phase pipeline, ranks
+candidates by modeled dispatch-overhead savings (per round: saved
+boundaries × the while-trip budget × :data:`DISPATCH_OVERHEAD_US`)
+against projected peak-HBM growth, **refuses** any candidate whose
+projected peak the memory certifier proves over capacity, and emits
+the :class:`FusionPlan` artifact ``bench.py --emit-metrics`` embeds.
+
+The overhead constant is a MODEL (like
+:data:`~agentlib_mpc_tpu.telemetry.calibration.PLATFORM_PEAKS`): its
+value is *ranking* — which boundary to fuse first — not an absolute
+latency claim; the plan records what it assumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = [
+    "DISPATCH_OVERHEAD_US",
+    "FusionCandidate",
+    "FusionPlan",
+    "IPM_PIPELINE",
+    "plan_fusion",
+]
+
+#: modeled fixed cost of one device dispatch (host enqueue + launch),
+#: microseconds. Order-of-magnitude of a jax.jit dispatch on current
+#: runtimes; overridable per call. Ranking fuel, not a benchmark.
+DISPATCH_OVERHEAD_US = 70.0
+
+#: the IPM iteration's stage pipeline, in dataflow order — the phase
+#: vocabulary subset a solver round actually stages through
+#: (``telemetry.profiler.PHASES`` names; consensus/collectives phases
+#: are excluded: fusing across a psum would reorder the certified
+#: collective schedule)
+IPM_PIPELINE = ("eval_jac", "assemble", "factor", "resolve",
+                "line_search")
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionCandidate:
+    """One contiguous stage merge, scored.
+
+    ``savings_bytes`` models the HBM boundary traffic the merge keeps
+    on-chip per round: at each interior boundary the staged program
+    writes the producer's intermediates and reads them back — charged
+    as half the smaller neighbour's per-iteration byte volume (a
+    phase's ``bytes`` counts reads *and* writes, so one direction is
+    half), × the while-trip budget. ``projected_peak_bytes`` is the
+    live-range peak of the fused trace — co-residency of the merged
+    stages is exactly what that walk measures, so it bounds the merge
+    from above. ``refused`` marks a plan the memory certifier proves
+    over capacity."""
+
+    name: str
+    phases: tuple
+    dispatches_saved_per_iteration: int
+    dispatches_saved_per_round: int
+    savings_us: float
+    savings_bytes: int
+    projected_peak_bytes: int
+    refused: bool = False
+    reason: str = ""
+
+    def describe(self) -> str:
+        verdict = f"REFUSED ({self.reason})" if self.refused else \
+            (f"saves {self.dispatches_saved_per_round} dispatch(es) "
+             f"~{self.savings_us:.0f}us + {self.savings_bytes} B "
+             f"HBM round-trips per round")
+        return (f"{self.name}: {verdict}; projected peak "
+                f"{self.projected_peak_bytes} B")
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionPlan:
+    """Ranked fusion targets for one traced round.
+
+    ``status``: ``"planned"`` (at least one admissible candidate),
+    ``"refused"`` (every candidate over capacity), ``"empty"`` (the
+    program carries no staged phase annotations to merge), or
+    ``"unknown"`` (trace/cost failure — notes say why)."""
+
+    status: str
+    candidates: tuple = ()       # admissible first, ranked by savings
+    phase_costs: "dict | None" = None    # per-iteration {phase: costs}
+    certified_peak_bytes: int = 0
+    hbm_bytes: "int | None" = None
+    while_trips: int = 1
+    overhead_us: float = DISPATCH_OVERHEAD_US
+    notes: tuple = ()
+
+    @property
+    def top(self) -> "FusionCandidate | None":
+        for c in self.candidates:
+            if not c.refused:
+                return c
+        return None
+
+    @property
+    def savings_bytes(self) -> int:
+        """The top-ranked plan's modeled HBM savings per round — the
+        ``fusion_plan_savings_bytes`` gauge."""
+        c = self.top
+        return 0 if c is None else int(c.savings_bytes)
+
+    @property
+    def projected_peak_bytes(self) -> int:
+        """The bound the fused engine's memory certificate must land
+        within (acceptance seam: certificate peak ≤ plan projection)."""
+        c = self.top
+        return self.certified_peak_bytes if c is None \
+            else int(c.projected_peak_bytes)
+
+    def describe(self) -> str:
+        if self.status != "planned":
+            return f"{self.status}: {'; '.join(self.notes) or 'n/a'}"
+        c = self.top
+        return (f"planned: top merge {c.describe()} "
+                f"({len(self.candidates)} candidate(s), trips="
+                f"{self.while_trips}, overhead {self.overhead_us}us)")
+
+    def as_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "candidates": [dataclasses.asdict(c)
+                           for c in self.candidates],
+            "top": None if self.top is None else self.top.name,
+            "savings_bytes": self.savings_bytes,
+            "projected_peak_bytes": self.projected_peak_bytes,
+            "certified_peak_bytes": int(self.certified_peak_bytes),
+            "hbm_bytes": self.hbm_bytes,
+            "while_trips": int(self.while_trips),
+            "overhead_us": float(self.overhead_us),
+            "phase_costs": {k: dict(v) for k, v in
+                            (self.phase_costs or {}).items()
+                            if not k.startswith("_")},
+            "notes": list(self.notes),
+        }
+
+
+def plan_fusion(fn_or_jaxpr, *args, while_trips: "int | None" = None,
+                hbm_bytes: "int | None" = None,
+                donated_invars=None,
+                overhead_us: float = DISPATCH_OVERHEAD_US,
+                pipeline: tuple = IPM_PIPELINE) -> FusionPlan:
+    """Plan stage fusion for a traced round.
+
+    ``while_trips`` charges loop-carried boundaries (the inner solver
+    loop's iteration budget — the PR 11 plumbing); ``hbm_bytes``
+    overrides the refusal capacity (defaults to the backend device's
+    reported HBM; no capacity known → nothing can be refused, noted).
+    ``donated_invars`` flows to the memory certifier so the projected
+    peak is donation-aware like the build-time certificate."""
+    import jax
+
+    from agentlib_mpc_tpu.lint.jaxpr.cost import WHILE_TRIP_GUESS
+    from agentlib_mpc_tpu.lint.jaxpr.memory import (
+        certify_memory,
+        device_hbm_bytes,
+    )
+    from agentlib_mpc_tpu.telemetry.calibration import phase_costs
+
+    notes: list = []
+    try:
+        if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+            closed = fn_or_jaxpr
+        else:
+            closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+        # per-ITERATION costs: charge while bodies once — the trip
+        # budget multiplies boundary counts explicitly below
+        costs = phase_costs(closed, while_trips=1)
+        mem = certify_memory(closed, donated_invars=donated_invars)
+    except Exception as exc:  # noqa: BLE001 — planning must not kill
+        # a build; an unplannable program is an honest unknown
+        return FusionPlan(status="unknown",
+                          notes=(f"planner error: {exc!r}",))
+    if while_trips is None:
+        while_trips = WHILE_TRIP_GUESS
+        notes.append(f'trips="unbounded" — charged the '
+                     f"{WHILE_TRIP_GUESS}-trip guess; pass "
+                     f"while_trips=<iteration budget>")
+    trips = max(int(while_trips), 1)
+    if hbm_bytes is None:
+        hbm_bytes = device_hbm_bytes()
+        if hbm_bytes is None:
+            notes.append("backend reports no memory capacity — no "
+                         "candidate can be refused over capacity")
+    peak = int(mem.peak_bytes)
+    if mem.status != "proved":
+        notes.append(f"memory model degraded: {mem.describe()}")
+
+    present = [p for p in pipeline
+               if costs.get(p, {}).get("flops", 0)
+               or costs.get(p, {}).get("bytes", 0)]
+    if len(present) < 2:
+        return FusionPlan(
+            status="empty", phase_costs=costs,
+            certified_peak_bytes=peak, hbm_bytes=hbm_bytes,
+            while_trips=trips, overhead_us=float(overhead_us),
+            notes=tuple(notes + [
+                f"{len(present)} staged phase(s) observed — nothing "
+                f"to merge (annotate stages with phase_scope)"]))
+
+    def boundary_bytes(a: str, b: str) -> int:
+        # the staged program's HBM round-trip at the a->b boundary:
+        # half the smaller neighbour's byte volume (bytes counts both
+        # directions of every access)
+        return int(min(costs[a]["bytes"], costs[b]["bytes"]) // 2)
+
+    cands = []
+    for i in range(len(present)):
+        for j in range(i + 1, len(present)):
+            run = tuple(present[i:j + 1])
+            saved = len(run) - 1
+            sav_bytes = sum(boundary_bytes(run[k], run[k + 1])
+                            for k in range(saved)) * trips
+            cand = FusionCandidate(
+                name="+".join(run), phases=run,
+                dispatches_saved_per_iteration=saved,
+                dispatches_saved_per_round=saved * trips,
+                savings_us=float(saved * trips * overhead_us),
+                savings_bytes=sav_bytes,
+                projected_peak_bytes=peak)
+            if hbm_bytes is not None and peak > int(hbm_bytes):
+                cand = dataclasses.replace(
+                    cand, refused=True,
+                    reason=f"memory certifier proves the merged "
+                           f"region's projected peak {peak} B over "
+                           f"the {int(hbm_bytes)} B capacity")
+            cands.append(cand)
+    admissible = sorted(
+        [c for c in cands if not c.refused],
+        key=lambda c: (-c.savings_us, -c.savings_bytes, c.name))
+    refused = [c for c in cands if c.refused]
+    status = "planned" if admissible else "refused"
+    if status == "refused":
+        notes.append("every candidate merge is over capacity — the "
+                     "staged program is the only admissible schedule")
+    return FusionPlan(
+        status=status,
+        candidates=tuple(admissible + refused),
+        phase_costs=costs,
+        certified_peak_bytes=peak,
+        hbm_bytes=None if hbm_bytes is None else int(hbm_bytes),
+        while_trips=trips,
+        overhead_us=float(overhead_us),
+        notes=tuple(notes),
+    )

@@ -37,6 +37,15 @@ which fires only OUTSIDE them — see its docstring):
   ``state._replace(...)``. Weak-typed leaves make the second call's
   avals differ from the first's and the whole program retraces — the
   exact fused-ADMM ``init_state`` z/rho bug this rule exists to pin.
+* ``jit-dispatch-in-loop`` — the host-side dispatch-storm analogue of
+  ``jit-host-sync`` (ISSUE 18), fired only OUTSIDE jit-reachable code:
+  a Python ``for``/``while`` whose body calls a jitted callable (a name
+  bound via ``jax.jit(...)`` / ``partial(jax.jit, ...)`` or a
+  ``@jax.jit``-decorated def in the same module) or
+  ``.block_until_ready()`` pays one device dispatch (+ a full host
+  round-trip for the sync) PER ITERATION — the per-round cost the
+  dispatch certificate proves the fused program avoids. Hoist the loop
+  into the program (``lax.scan``/``lax.while_loop``) or batch the work.
 """
 
 from __future__ import annotations
@@ -229,14 +238,105 @@ def run(index: PackageIndex, scope_dirs: "tuple[str, ...] | None" = (
             continue
         jaxish = info.jax_names | {"jax", "jnp", "lax"}
         np_names = info.numpy_names | {"np", "numpy"}
+        jitted = _jitted_names(info, jaxish)
         for fn in info.functions:
             if id(fn) in reachable_ids:
                 findings.extend(_check_traced_function(
                     info, fn, jaxish, np_names))
             else:
                 findings.extend(_check_weak_type(info, fn, jaxish))
+                findings.extend(_check_dispatch_in_loop(
+                    info, fn, jitted))
         findings.extend(_check_static_args(info))
     return findings
+
+
+def _is_jit_expr(expr: ast.AST, jaxish) -> bool:
+    """``jax.jit`` (or a bare ``jit`` imported from jax) as an
+    expression."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "jit":
+        root = _func_root(expr)
+        return root is not None and root.id in jaxish
+    return isinstance(expr, ast.Name) and expr.id == "jit"
+
+
+def _is_jit_call(expr: ast.AST, jaxish) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)`` —
+    the right-hand sides that bind a jitted callable to a name."""
+    if not isinstance(expr, ast.Call):
+        return False
+    if _is_jit_expr(expr.func, jaxish):
+        return True
+    root = _func_root(expr.func)
+    if root is not None and root.id in ("partial", "functools") and \
+            expr.args:
+        return _is_jit_expr(expr.args[0], jaxish)
+    return False
+
+
+def _jitted_names(info, jaxish) -> "set[str]":
+    """Names this module binds to jitted callables: ``x = jax.jit(f)``
+    assignments (module level, function level, and ``self._step = ...``
+    attribute binds — matched by attribute name) plus ``@jax.jit`` /
+    ``@partial(jax.jit, ...)``-decorated defs."""
+    names: set[str] = set()
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Assign) and \
+                _is_jit_call(node.value, jaxish):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    names.add(tgt.attr)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec, jaxish) or _is_jit_call(dec, jaxish):
+                    names.add(node.name)
+    return names
+
+
+def _check_dispatch_in_loop(info, fn: FunctionInfo, jitted):
+    """``jit-dispatch-in-loop`` (host-side code only — inside a trace a
+    Python loop unrolls into ONE program, which is the opposite
+    problem): each iteration of a Python loop over a jitted call is a
+    separate device dispatch; ``.block_until_ready()`` adds a full
+    host round-trip per iteration. The static analogue of what the
+    dispatch certificate (lint/jaxpr/dispatch.py) prices dynamically."""
+    out = []
+
+    def emit(node, message):
+        out.append(Finding(
+            rule="jit-dispatch-in-loop", path=info.path,
+            line=node.lineno, qualname=fn.qualname, message=message,
+            snippet=_snippet(info, node)))
+
+    for node in _own_nodes(fn):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr == "block_until_ready":
+                emit(sub,
+                     "block_until_ready inside a host-side loop syncs "
+                     "host and device EVERY iteration — a dispatch "
+                     "storm (one program + one round-trip per pass); "
+                     "hoist the loop into the program (lax.scan/"
+                     "while_loop) or sync once after it")
+            elif (isinstance(func, ast.Name) and func.id in jitted) or \
+                    (isinstance(func, ast.Attribute) and
+                     func.attr in jitted):
+                name = func.id if isinstance(func, ast.Name) else \
+                    func.attr
+                emit(sub,
+                     f"Python loop over jitted {name!r} dispatches one "
+                     f"device program per iteration — the staged-"
+                     f"dispatch overhead the fused round exists to "
+                     f"avoid; fuse the loop into the program "
+                     f"(lax.scan/while_loop) or batch the calls")
+    return out
 
 
 def _check_traced_function(info, fn: FunctionInfo, jaxish, np_names):

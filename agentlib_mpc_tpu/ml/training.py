@@ -203,8 +203,11 @@ class ANNTrainerCore:
             perm = rng.permutation(n)
             for start in range(0, n - bs + 1, bs):
                 idx = perm[start:start + bs]
-                params, opt_state = train_step(params, opt_state,
-                                               Xj[idx], yj[idx])
+                # minibatch SGD is inherently one dispatch per step
+                # (each depends on the last); offline training, not a
+                # hot path
+                params, opt_state = train_step(  # lint: ignore[jit-dispatch-in-loop]
+                    params, opt_state, Xj[idx], yj[idx])
             if val is not None:
                 v = float(loss(params, *val))
                 if v < best_val - 1e-7:

@@ -168,6 +168,21 @@ class SolverOptions(NamedTuple):
     #: fleet attach it next to ``stage_partition``). Required by
     #: ``jacobian="sparse"``; consulted by ``"auto"``.
     stage_jacobian_plan: "sjac.StageJacobianPlan | None" = None
+    #: IPM iteration fusion (ISSUE 18): "auto" (default) lets XLA fuse
+    #: eval+jac → banded assemble → stage factor → line search into a
+    #: single dispatch per iteration — the mega-kernel ROADMAP item 2
+    #: names; "off" pins a materialization point
+    #: (:func:`~agentlib_mpc_tpu.ops.stagewise.stage_boundary`) between
+    #: the stages — the staged reference schedule, numerically the
+    #: identity (the ``--fusion-ab`` baseline and the mutation target
+    #: of the dispatch gate); "require" additionally makes the fused
+    #: engine REFUSE to build unless the fused program is certified
+    #: equivalent to the staged one (identical
+    #: ``collective_schedule_digest``, memory certificate within the
+    #: :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`'s
+    #: projected peak-HBM bound — enforced in
+    #: ``parallel/fused_admm.py``).
+    fusion: str = "auto"
 
 
 def attach_stage_partition(options: SolverOptions,
@@ -621,6 +636,18 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             f"fused_ls_jacobian must be 'auto', 'on' or 'off', got "
             f"{opts.fused_ls_jacobian!r} (booleans are not accepted: use "
             f"the strings)")
+    if opts.fusion not in ("auto", "off", "require"):
+        raise ValueError(
+            f"fusion must be 'auto', 'off' or 'require', got "
+            f"{opts.fusion!r} (booleans are not accepted: use the "
+            f"strings)")
+    # "off" threads the iteration's stage hand-offs through
+    # optimization_barrier materialization points — the staged reference
+    # schedule ("auto"/"require" are the same fused trace; "require"
+    # additionally makes the fused-fleet build prove certificate
+    # identity against this staged twin)
+    staged = opts.fusion == "off"
+    boundary = stage_ops.stage_boundary if staged else (lambda t: t)
     dtype = w0.dtype
     eps = jnp.finfo(dtype).eps
     n = w0.shape[0]
@@ -804,20 +831,21 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             # the banded block-tridiagonal layout — the dense KKT matrix
             # never exists on this path
             with phase_scope("eval_jac"):
-                CH = sjac.banded_lagrangian_hessian(
-                    plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w)
+                CH = boundary(sjac.banded_lagrangian_hessian(
+                    plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w))
             with phase_scope("assemble"):
                 w_diag = delta + sigma_L + sigma_U
-                D, E = sjac.assemble_kkt_banded(
+                D, E = boundary(sjac.assemble_kkt_banded(
                     plan, CH, Jg, Jh, sigma_s if m_h else
-                    jnp.zeros((0,), dtype), w_diag, opts.delta_c)
+                    jnp.zeros((0,), dtype), w_diag, opts.delta_c))
             with phase_scope("factor"):
-                factor = ("stage_banded",
-                          (stage_ops.factor_kkt_stage_banded(D, E),
-                           plan.partition))
+                factor = boundary(
+                    ("stage_banded",
+                     (stage_ops.factor_kkt_stage_banded(D, E),
+                      plan.partition)))
         else:
             with phase_scope("eval_jac"):
-                H = hess_l(w, y, z)
+                H = boundary(hess_l(w, y, z))
             with phase_scope("assemble"):
                 W = H + (delta * jnp.ones((n,), dtype) + sigma_L
                          + sigma_U) * jnp.eye(n, dtype=dtype)
@@ -831,8 +859,10 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                     ])
                 else:
                     K = W
+                K = boundary(K)
             with phase_scope("factor"):
-                factor = _factor_kkt(K, kkt_path, opts.stage_partition)
+                factor = boundary(
+                    _factor_kkt(K, kkt_path, opts.stage_partition))
 
         def newton_dir(rhs_w_k, mu_s, mu_L, mu_U):
             """Direction from the stored factor for (possibly per-entry)
@@ -850,7 +880,8 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                         - sigma_s * ds_k) if m_h else z
                 dzL_k = mu_L / dL - zL - sigma_L * dw_k
                 dzU_k = mu_U / dU - zU + sigma_U * dw_k
-                return dw_k, dy_k, ds_k, dz_k, dzL_k, dzU_k
+                return boundary((dw_k, dy_k, ds_k, dz_k, dzL_k,
+                                 dzU_k))
 
         def rhs_for(mu_s, mu_L, mu_U):
             """rhs with eliminated bound duals and slacks:

@@ -61,10 +61,63 @@ __all__ = [
     "resolve_kkt_stage",
     "resolve_kkt_stage_banded",
     "solve_kkt_stage",
+    "stage_boundary",
     "stage_method_available",
     "stage_of_index",
     "synthetic_stage_kkt",
 ]
+
+
+def _backfill_optimization_barrier_batching() -> None:
+    """jax 0.4.37 ships ``optimization_barrier`` without a batching
+    rule, and the staged solver runs under the fleet's agent-axis
+    ``vmap``. The rule is the trivial identity later jax versions
+    define (the barrier is element-wise identity per operand, so batch
+    dims pass through unchanged) — registered only when missing, so a
+    jax upgrade's own rule wins."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching
+
+        prim = _lax_internal.optimization_barrier_p
+        if prim in batching.primitive_batchers:
+            return
+
+        def _batcher(batched_args, batch_dims, **params):
+            return prim.bind(*batched_args, **params), batch_dims
+
+        batching.primitive_batchers[prim] = _batcher
+    except Exception:  # pragma: no cover — jax layout drift: the
+        # barrier then simply fails loudly under vmap instead of here
+        pass
+
+
+_backfill_optimization_barrier_batching()
+
+
+def stage_boundary(tree):
+    """Pin a stage boundary: an ``optimization_barrier`` over the array
+    leaves of ``tree`` (non-array leaves — partition objects, path
+    strings — pass through untouched, since a barrier is a value
+    operation and statics are not values).
+
+    Numerically the identity; structurally a materialization point XLA
+    may not fuse across. ``SolverOptions.fusion="off"`` threads the IPM
+    iteration's stage hand-offs (eval+jac → assemble → factor → resolve
+    → line search) through these, reconstructing the reference design's
+    staged dispatch schedule as a *certifiable program* — the baseline
+    the fused mega-kernel is proven equivalent to (same collective
+    schedule: a barrier is not a collective; same math: identity) and
+    A/B'd against (``bench.py --fusion-ab``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    is_arr = [isinstance(x, (jax.Array, jax.core.Tracer)) for x in leaves]
+    arrs = [x for x, a in zip(leaves, is_arr) if a]
+    if arrs:
+        arrs = list(jax.lax.optimization_barrier(tuple(arrs)))
+    out, it = [], iter(arrs)
+    for x, a in zip(leaves, is_arr):
+        out.append(next(it) if a else x)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class StagePartition(NamedTuple):

@@ -113,6 +113,11 @@ _COLLECTIVE_CERT_MEMO_MAX = 32
 #: pinning the group OCPs like the collective memo.
 _MEMORY_CERT_MEMO: dict = {}
 
+#: dispatch certificates memoized the same way (ISSUE 18) — same key as
+#: the memory memo (donation changes the transfer bill). Values are
+#: ``(cert, ocps)`` pinning the group OCPs like the other memos.
+_DISPATCH_CERT_MEMO: dict = {}
+
 
 def _suppress_unusable_donation_warning() -> None:
     """On backends without buffer donation (CPU) jax warns once per
@@ -266,7 +271,8 @@ class FusedADMM:
                  mesh=None,
                  watchdog_timeout_s: "float | None" = None,
                  collective_certify: str = "auto",
-                 memory_certify: str = "auto"):
+                 memory_certify: str = "auto",
+                 dispatch_certify: str = "auto"):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -336,7 +342,28 @@ class FusedADMM:
         already paid for the collective certificate) and, off-mesh,
         only backends that report a capacity (CPU does not — no trace
         is paid there); ``"require"`` always certifies and refuses
-        anything not proved; ``"off"`` skips."""
+        anything not proved; ``"off"`` skips.
+        ``dispatch_certify``: statically certify the warm round's
+        host↔device dispatch schedule (:mod:`agentlib_mpc_tpu.lint.
+        jaxpr.dispatch` — ordered boundaries with shard-divided,
+        donation-aware transfer bytes; an unplanned host sync —
+        ``pure_callback``-class primitive — inside the round is a
+        REFUTATION naming the eqn's source). ``"auto"`` certifies
+        whenever the build already pays a trace (mesh engines
+        certifying collectives, or any engine certifying memory);
+        ``"require"`` always certifies and refuses a refuted or
+        unprovable schedule; ``"off"`` skips. A refuted schedule under
+        ``"auto"`` raises on a multi-process mesh (a host sync inside a
+        pod round stalls every process behind one host) and warns
+        loudly otherwise. The proved ``dispatch_digest`` rides the
+        engine-store meta and plane-checkpoint stamps next to the
+        collective and memory digests. Additionally, when any group's
+        ``SolverOptions.fusion`` is ``"require"``, the build proves the
+        fused program equivalent to its staged twin
+        (``fusion="off"``): identical collective-schedule digest, and a
+        memory certificate within the
+        :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`'s
+        projected peak-HBM bound — REFUSING to build otherwise."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -401,6 +428,11 @@ class FusedADMM:
                 f"memory_certify must be 'auto', 'require' or 'off', "
                 f"got {memory_certify!r}")
         self.memory_certify = memory_certify
+        if dispatch_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"dispatch_certify must be 'auto', 'require' or 'off', "
+                f"got {dispatch_certify!r}")
+        self.dispatch_certify = dispatch_certify
         #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.memory.
         #: MemoryCertificate` of the fused step (None when
         #: ``memory_certify`` skipped it)
@@ -417,6 +449,17 @@ class FusedADMM:
         #: identity the engine store, the plane checkpoint and the
         #: degraded-mesh rebuild assert against
         self.collective_schedule_digest = None
+        #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.dispatch.
+        #: DispatchCertificate` of the warm round (None when
+        #: ``dispatch_certify`` skipped it)
+        self.dispatch_certificate = None
+        #: its mesh-size-independent digest — third stamp next to the
+        #: collective and memory digests
+        self.dispatch_digest = None
+        #: the :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`
+        #: proved at build when ``SolverOptions.fusion="require"``
+        #: (None otherwise; ``bench.py --emit-metrics`` plans its own)
+        self.fusion_plan = None
         #: True once a round blew the collective-watchdog budget — the
         #: engine's compiled step may be wedged behind a dead collective
         self.mesh_condemned = False
@@ -438,10 +481,11 @@ class FusedADMM:
             self._step = jax.jit(step_fn, donate_argnums=donate)
             if self._memory_certify_wanted():
                 self._certify_memory_step(None, None, 1)
+            if self._dispatch_certify_wanted():
+                self._certify_dispatch_step(None, None, 1)
+            if self._fusion_mode() == "require":
+                self._certify_fusion_equivalence(None, 1)
             return
-
-        from jax.experimental.shard_map import shard_map
-        from jax.sharding import PartitionSpec as P
 
         mesh = self.mesh
         if len(mesh.axis_names) != 1:
@@ -464,30 +508,8 @@ class FusedADMM:
                     f"(parallel.fused_admm.pad_group_to_devices; padded "
                     f"lanes ride the active mask)")
 
-        sh, rep = P(axis), P()
-        state_spec = FusedState(
-            zbar=rep, lam=sh, ex_mean=rep, ex_diff=sh, ex_lam=rep,
-            rho=rep, w=sh, y=sh, z=sh)
-        per_group_sh = tuple(sh for _ in self.groups)
-        stats_spec = IterationStats(
-            iterations=rep, primal_residuals=rep, dual_residuals=rep,
-            penalty=rep, converged=rep, local_solves_ok=rep,
-            coupling_locals=rep, exchange_locals=rep, quarantined=rep,
-            # the per-lane attribution is the ONE sharded stats leaf;
-            # with quarantine off the body returns None there, which a
-            # tuple-of-specs prefix cannot match — use a bare replicated
-            # spec so the empty subtree matches
-            lane_quarantined=(per_group_sh if self.options.quarantine
-                              else rep))
         step_fn = self._build_step(axis_name=axis, n_shards=n_dev)
-        # check_rep=False: the body's replicated outputs (psum'ed
-        # residuals, means, histories) are replicated by construction,
-        # but the checker cannot see that through while_loop carries
-        sharded = shard_map(
-            step_fn, mesh=mesh,
-            in_specs=(state_spec, per_group_sh, per_group_sh),
-            out_specs=(state_spec, per_group_sh, stats_spec),
-            check_rep=False)
+        sharded = self._mesh_sharded(step_fn, axis)
         self._step_fn = sharded
         self._step = jax.jit(sharded, donate_argnums=donate)
         # static collective certification (ISSUE 11): prove every psum
@@ -497,8 +519,13 @@ class FusedADMM:
         # rebuild and the cross-process restore assert against
         if self.collective_certify != "off":
             self._certify_collective_schedule(sharded, axis, n_dev)
-        elif self._memory_certify_wanted():
-            self._certify_memory_step(None, axis, n_dev)
+        else:
+            if self._memory_certify_wanted():
+                self._certify_memory_step(None, axis, n_dev)
+            if self._dispatch_certify_wanted():
+                self._certify_dispatch_step(None, axis, n_dev)
+        if self._fusion_mode() == "require":
+            self._certify_fusion_equivalence(axis, n_dev)
         # consensus-shaped mesh-collective probe (the shared
         # multihost.collective_probe builder — compiled and warmed so
         # the per-round admm_collective_seconds timing never pays, or
@@ -514,6 +541,38 @@ class FusedADMM:
                 "fleet_mesh_devices",
                 "devices in the fused fleet's agent-sharding mesh"
                 ).set(float(n_dev))
+
+    def _mesh_sharded(self, step_fn, axis: str):
+        """Wrap a built step body in the engine's ``shard_map`` — the
+        one spec construction, shared by :meth:`_compile_step` and the
+        ``fusion="require"`` staged-twin trace (identical specs, so the
+        two programs differ ONLY by the solver's stage boundaries)."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        sh, rep = P(axis), P()
+        state_spec = FusedState(
+            zbar=rep, lam=sh, ex_mean=rep, ex_diff=sh, ex_lam=rep,
+            rho=rep, w=sh, y=sh, z=sh)
+        per_group_sh = tuple(sh for _ in self.groups)
+        stats_spec = IterationStats(
+            iterations=rep, primal_residuals=rep, dual_residuals=rep,
+            penalty=rep, converged=rep, local_solves_ok=rep,
+            coupling_locals=rep, exchange_locals=rep, quarantined=rep,
+            # the per-lane attribution is the ONE sharded stats leaf;
+            # with quarantine off the body returns None there, which a
+            # tuple-of-specs prefix cannot match — use a bare replicated
+            # spec so the empty subtree matches
+            lane_quarantined=(per_group_sh if self.options.quarantine
+                              else rep))
+        # check_rep=False: the body's replicated outputs (psum'ed
+        # residuals, means, histories) are replicated by construction,
+        # but the checker cannot see that through while_loop carries
+        return shard_map(
+            step_fn, mesh=self.mesh,
+            in_specs=(state_spec, per_group_sh, per_group_sh),
+            out_specs=(state_spec, per_group_sh, stats_spec),
+            check_rep=False)
 
     def _collective_cert_key(self, axis: str, n_dev: int):
         """Structural identity of the traced mesh step — what the
@@ -593,11 +652,13 @@ class FusedADMM:
                     "(certified schedule x axis size x ADMM iteration "
                     "budget)").set(float(cert.comm_bytes(
                         while_trips=self.options.max_iterations)))
-        # memory certification rides the same trace (ISSUE 13): the
-        # closed jaxpr is in hand (or one memo-covered re-trace away)
-        # and the live-range walk is milliseconds
+        # memory + dispatch certification ride the same trace (ISSUE
+        # 13/18): the closed jaxpr is in hand (or one memo-covered
+        # re-trace away) and both walks are milliseconds
         if self._memory_certify_wanted():
             self._certify_memory_step(closed, axis, n_dev)
+        if self._dispatch_certify_wanted():
+            self._certify_dispatch_step(closed, axis, n_dev)
 
     def _step_templates(self) -> tuple:
         """(state, thetas, masks) shape templates of the compiled step —
@@ -654,15 +715,8 @@ class FusedADMM:
             tmpl = self._step_templates()
             if closed is None:
                 closed = jax.make_jaxpr(self._step_fn)(*tmpl)
-            donated = None
-            if self.donate_state:
-                # jit donates arg 0 (the FusedState carry): its leaves
-                # are the leading flat invars of the traced step
-                n_state = len(jax.tree_util.tree_leaves(tmpl[0]))
-                donated = tuple(
-                    i < n_state
-                    for i in range(len(closed.jaxpr.invars)))
-            cert = certify_memory(closed, donated_invars=donated)
+            cert = certify_memory(
+                closed, donated_invars=self._donated_mask(closed, tmpl))
             while len(_MEMORY_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
                 _MEMORY_CERT_MEMO.pop(next(iter(_MEMORY_CERT_MEMO)))
             _MEMORY_CERT_MEMO[key] = (
@@ -709,6 +763,212 @@ class FusedADMM:
                 f"to override")
         logger.info("memory certificate: %s (digest %s)",
                     cert.describe(), cert.memory_digest)
+
+    def _donated_mask(self, closed, tmpl):
+        """Flat-invar donation mask of the traced step (jit donates arg
+        0 — the FusedState carry, whose leaves are the leading flat
+        invars), or None when the engine does not donate."""
+        if not self.donate_state:
+            return None
+        n_state = len(jax.tree_util.tree_leaves(tmpl[0]))
+        return tuple(
+            i < n_state for i in range(len(closed.jaxpr.invars)))
+
+    def _dispatch_certify_wanted(self) -> bool:
+        """Whether to run the dispatch pass at this build: ``"require"``
+        always; ``"auto"`` whenever the build already pays a trace
+        (mesh engines certifying collectives, or any engine certifying
+        memory); ``"off"`` never."""
+        if self.dispatch_certify == "off":
+            return False
+        if self.dispatch_certify == "require":
+            return True
+        if self.mesh is not None and self.collective_certify != "off":
+            return True
+        return self._memory_certify_wanted()
+
+    def _certify_dispatch_step(self, closed, axis: "str | None",
+                               n_dev: int) -> None:
+        """Certify the warm round's dispatch schedule (ISSUE 18) from
+        ``closed`` (the collective certifier's trace when in hand;
+        re-traced on shape templates otherwise), memoized per engine
+        structure + donation flag, and enforce the host-sync policy:
+        an unplanned ``pure_callback``-class sync inside the round is
+        refused under ``dispatch_certify="require"`` or a multi-process
+        mesh (one host's Python stalls every process's round), warned
+        loudly otherwise."""
+        from agentlib_mpc_tpu.lint.jaxpr.dispatch import certify_dispatch
+
+        key = (self._collective_cert_key(axis, n_dev),
+               self.donate_state)
+        hit = _DISPATCH_CERT_MEMO.get(key)
+        cert = hit[0] if hit is not None else None
+        if cert is None:
+            tmpl = self._step_templates()
+            if closed is None:
+                closed = jax.make_jaxpr(self._step_fn)(*tmpl)
+            cert = certify_dispatch(
+                closed, donated_invars=self._donated_mask(closed, tmpl))
+            while len(_DISPATCH_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
+                _DISPATCH_CERT_MEMO.pop(next(iter(_DISPATCH_CERT_MEMO)))
+            _DISPATCH_CERT_MEMO[key] = (
+                cert, tuple(g.ocp for g in self.groups))
+        self.dispatch_certificate = cert
+        self.dispatch_digest = cert.dispatch_digest
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"fused round's dispatch schedule REFUTED — the warm "
+                   f"step is not one device program:\n  {detail}")
+            if self.dispatch_certify == "require" or \
+                    jax.process_count() > 1:
+                raise ValueError(
+                    msg + "\n(remove the host sync from the warm step, "
+                    "or build with dispatch_certify='off' on a single "
+                    "host to debug)")
+            logger.warning(
+                "%s\n(single-host: proceeding — every issue of that "
+                "sync splits the round and pays a host round-trip)",
+                msg)
+        elif cert.status == "unknown":
+            if self.dispatch_certify == "require":
+                raise ValueError(
+                    f"fused round's dispatch schedule is UNPROVABLE "
+                    f"({cert.describe()}) and dispatch_certify="
+                    f"'require' was set")
+            logger.info("dispatch schedule not provable (%s)",
+                        cert.describe())
+        else:
+            logger.info("dispatch schedule proved: %s (digest %s)",
+                        cert.describe(), cert.dispatch_digest)
+            if telemetry.enabled():
+                telemetry.gauge(
+                    "dispatch_count_per_round",
+                    "statically certified device dispatches per warm "
+                    "round (lint/jaxpr/dispatch.py, set at engine "
+                    "build; 1 = the fused mega-round)").set(
+                    float(cert.dispatch_count()),
+                    fleet=",".join(g.name for g in self.groups))
+
+    def _fusion_mode(self) -> str:
+        """The engine-level IPM fusion mode, joined over the groups'
+        solver options: any ``"require"`` wins (the build must prove
+        staged-twin equivalence), else any ``"off"`` (the staged
+        reference program), else ``"auto"``."""
+        modes = set()
+        for g in self.groups:
+            for o in (g.solver_options, g.warm_solver_options):
+                if o is not None:
+                    modes.add(getattr(o, "fusion", "auto"))
+        if "require" in modes:
+            return "require"
+        if "off" in modes:
+            return "off"
+        return "auto"
+
+    def _staged_twin_fn(self, axis: "str | None", n_dev: int):
+        """The fused step's staged twin: the identical engine structure
+        with every group's ``SolverOptions.fusion`` pinned ``"off"`` —
+        the program whose stage hand-offs go through
+        :func:`~agentlib_mpc_tpu.ops.stagewise.stage_boundary`
+        materialization points. Built through the same
+        :meth:`_build_step` / :meth:`_mesh_sharded` pathway so the two
+        traces differ ONLY by those boundaries."""
+        def off(o):
+            return None if o is None else o._replace(fusion="off")
+
+        staged_groups = tuple(
+            dataclasses.replace(
+                g, solver_options=off(g.solver_options),
+                warm_solver_options=off(g.warm_solver_options))
+            for g in self.groups)
+        orig = self.groups
+        try:
+            self.groups = staged_groups
+            if axis is None:
+                return self._build_step()
+            return self._mesh_sharded(
+                self._build_step(axis_name=axis, n_shards=n_dev), axis)
+        finally:
+            self.groups = orig
+
+    def _certify_fusion_equivalence(self, axis: "str | None",
+                                    n_dev: int) -> None:
+        """``SolverOptions.fusion="require"``: REFUSE to build unless
+        the fused program is certified equivalent to its staged twin —
+        identical ``collective_schedule_digest`` (a stage boundary is
+        not a collective, so fusion may never change the schedule) and
+        a memory certificate within the analytic
+        :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`'s
+        projected peak-HBM bound. The proved plan lands on
+        ``self.fusion_plan``."""
+        from agentlib_mpc_tpu.lint.jaxpr.collectives import (
+            certify_collectives,
+        )
+        from agentlib_mpc_tpu.lint.jaxpr.fusion import plan_fusion
+        from agentlib_mpc_tpu.lint.jaxpr.memory import (
+            MemoryBudgetExceeded,
+        )
+
+        tmpl = self._step_templates()
+        fused_closed = jax.make_jaxpr(self._step_fn)(*tmpl)
+        staged_closed = jax.make_jaxpr(
+            self._staged_twin_fn(axis, n_dev))(*tmpl)
+        if axis is not None:
+            fused_cert = self.collective_certificate
+            if fused_cert is None:
+                fused_cert = certify_collectives(fused_closed,
+                                                 allowed_axes=(axis,))
+            staged_cert = certify_collectives(staged_closed,
+                                              allowed_axes=(axis,))
+            fd = fused_cert.schedule_digest
+            sd = staged_cert.schedule_digest
+            if fd is None or sd is None:
+                raise ValueError(
+                    f"fusion='require': collective-schedule identity "
+                    f"unprovable (fused: {fused_cert.describe()}; "
+                    f"staged: {staged_cert.describe()})")
+            if fd != sd:
+                raise ValueError(
+                    f"fusion='require' REFUSED: the fused round's "
+                    f"collective schedule digest {fd} differs from the "
+                    f"staged reference program's {sd} — fusion changed "
+                    f"the cross-device semantics")
+        plan = plan_fusion(
+            fused_closed,
+            while_trips=self.options.max_iterations,
+            donated_invars=self._donated_mask(fused_closed, tmpl))
+        self.fusion_plan = plan
+        if plan.status == "unknown":
+            raise ValueError(
+                f"fusion='require': the fusion planner could not model "
+                f"the round ({plan.describe()})")
+        if plan.status == "refused":
+            raise MemoryBudgetExceeded(
+                f"fusion='require' REFUSED: {plan.describe()} — build "
+                f"with SolverOptions.fusion='off' (the staged "
+                f"schedule) instead")
+        mem = self.memory_certificate
+        if mem is None:
+            self._certify_memory_step(fused_closed, axis, n_dev)
+            mem = self.memory_certificate
+        if mem is not None and mem.status == "proved" and \
+                mem.peak_bytes > plan.projected_peak_bytes:
+            raise MemoryBudgetExceeded(
+                f"fusion='require' REFUSED: the fused step's certified "
+                f"peak ({mem.peak_bytes} B) exceeds the fusion plan's "
+                f"projected peak-HBM bound "
+                f"({plan.projected_peak_bytes} B)")
+        if telemetry.enabled():
+            telemetry.gauge(
+                "fusion_plan_savings_bytes",
+                "modeled HBM round-trip bytes the certified fusion "
+                "plan's top merge keeps on-chip per warm round "
+                "(lint/jaxpr/fusion.py, set at engine build under "
+                "SolverOptions.fusion='require')").set(
+                float(plan.savings_bytes),
+                fleet=",".join(g.name for g in self.groups))
+        logger.info("fusion equivalence certified: %s",
+                    plan.describe())
 
     @staticmethod
     def _with_stage_partition(g: AgentGroup) -> AgentGroup:

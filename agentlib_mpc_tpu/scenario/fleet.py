@@ -167,6 +167,7 @@ class ScenarioFleet:
                  active=None, mesh=None,
                  collective_certify: str = "auto",
                  memory_certify: str = "auto",
+                 dispatch_certify: str = "auto",
                  watchdog_timeout_s: "float | None" = None):
         """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
         AgentGroup` (couplings only; exchanges are not scenario-lifted).
@@ -226,6 +227,13 @@ class ScenarioFleet:
         self.memory_certify = memory_certify
         self.memory_certificate = None
         self.memory_digest = None
+        if dispatch_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"dispatch_certify must be 'auto', 'require' or 'off', "
+                f"got {dispatch_certify!r}")
+        self.dispatch_certify = dispatch_certify
+        self.dispatch_certificate = None
+        self.dispatch_digest = None
         self.watchdog_timeout_s = (None if watchdog_timeout_s is None
                                    else float(watchdog_timeout_s))
         #: True once a round blew the collective-watchdog budget — the
@@ -590,6 +598,8 @@ class ScenarioFleet:
             self._step = jax.jit(step_fn)
             if self._memory_certify_wanted():
                 self._certify_memory(None)
+            if self._dispatch_certify_wanted():
+                self._certify_dispatch(None)
             return
 
         from jax.experimental.shard_map import shard_map
@@ -646,8 +656,11 @@ class ScenarioFleet:
         self._step = jax.jit(sharded)
         if self.collective_certify != "off":
             self._certify(sharded, names)
-        elif self._memory_certify_wanted():
-            self._certify_memory(None)
+        else:
+            if self._memory_certify_wanted():
+                self._certify_memory(None)
+            if self._dispatch_certify_wanted():
+                self._certify_dispatch(None)
 
     def _certify(self, sharded, axis_names: tuple) -> None:
         """Trace the sharded step on shape templates and certify the
@@ -663,6 +676,8 @@ class ScenarioFleet:
         cert = certify_collectives(closed, allowed_axes=axis_names)
         if self._memory_certify_wanted():
             self._certify_memory(closed)
+        if self._dispatch_certify_wanted():
+            self._certify_dispatch(closed)
         self.collective_certificate = cert
         self.collective_schedule_digest = cert.schedule_digest
         if cert.status == "refuted":
@@ -766,6 +781,50 @@ class ScenarioFleet:
                 f"override")
         logger.info("scenario memory certificate: %s (digest %s)",
                     cert.describe(), cert.memory_digest)
+
+    def _dispatch_certify_wanted(self) -> bool:
+        """The :class:`FusedADMM` policy verbatim (ISSUE 18):
+        ``"require"`` always; ``"auto"`` whenever the build already
+        pays a trace; ``"off"`` never."""
+        if self.dispatch_certify == "off":
+            return False
+        if self.dispatch_certify == "require":
+            return True
+        if self.mesh is not None and self.collective_certify != "off":
+            return True
+        return self._memory_certify_wanted()
+
+    def _certify_dispatch(self, closed) -> None:
+        """Certify the robust round's dispatch schedule (ISSUE 18) and
+        enforce the host-sync policy — the FusedADMM pattern."""
+        from agentlib_mpc_tpu.lint.jaxpr.dispatch import certify_dispatch
+
+        if closed is None:
+            closed = jax.make_jaxpr(self._step_fn)(
+                *self._step_templates())
+        cert = certify_dispatch(closed)
+        self.dispatch_certificate = cert
+        self.dispatch_digest = cert.dispatch_digest
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"scenario round's dispatch schedule REFUTED — the "
+                   f"warm step is not one device program:\n  {detail}")
+            if self.dispatch_certify == "require" or \
+                    jax.process_count() > 1:
+                raise ValueError(msg)
+            logger.warning("%s\n(single-host: proceeding)", msg)
+        elif cert.status == "unknown":
+            if self.dispatch_certify == "require":
+                raise ValueError(
+                    f"scenario round's dispatch schedule is UNPROVABLE "
+                    f"({cert.describe()}) under dispatch_certify="
+                    f"'require'")
+            logger.info("scenario dispatch schedule not provable (%s)",
+                        cert.describe())
+        else:
+            logger.info("scenario dispatch schedule proved: %s "
+                        "(digest %s)", cert.describe(),
+                        cert.dispatch_digest)
 
     # -- public API -----------------------------------------------------------
 

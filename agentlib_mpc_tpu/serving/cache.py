@@ -138,7 +138,8 @@ class CompileCache:
             collective_digest=getattr(engine,
                                       "collective_schedule_digest",
                                       None),
-            memory_digest=getattr(engine, "memory_digest", None))
+            memory_digest=getattr(engine, "memory_digest", None),
+            dispatch_digest=getattr(engine, "dispatch_digest", None))
         if telemetry.enabled():
             if not restored:
                 name = ("serving_compile_cache_hits_total" if hit

@@ -183,6 +183,12 @@ def save_plane(plane, path: str) -> str:
             # issue a different all-reduce sequence must be refused —
             # on a pod that drift is a silent cross-host hang
             "collective_digest": bucket.engine.collective_schedule_digest,
+            # the dispatch schedule the bucket's engine certified
+            # (ISSUE 18): a restore whose rebuilt engine stages the
+            # round differently — extra boundaries, a host sync — is
+            # refused the same way a collective drift is
+            "dispatch_digest": getattr(bucket.engine, "dispatch_digest",
+                                       None),
             # robust buckets carry the scenario axis (ISSUE 14): their
             # FusedState sibling is a ScenarioState with (capacity, S)
             # leading axes — recorded for observability; the restore
@@ -451,6 +457,24 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
                 f"(on a multi-process mesh that is a silent cross-"
                 f"host hang). Restore with the matching code/mesh, or "
                 f"re-join tenants fresh")
+        saved_disp = entry.get("dispatch_digest")
+        live_disp = getattr(bucket.engine, "dispatch_digest", None)
+        if saved_disp is not None and live_disp is not None \
+                and saved_disp != live_disp:
+            telemetry.journal_event(
+                "checkpoint.rejected", path=src,
+                reason="dispatch_schedule_drift",
+                bucket=entry["digest"], dispatch_digest=saved_disp,
+                live_digest=live_disp)
+            raise ValueError(
+                f"bucket {entry['digest']}: the checkpoint was saved "
+                f"under dispatch schedule {saved_disp}, but this "
+                f"process's engine certifies {live_disp} — the "
+                f"restored plane would stage the warm round "
+                f"differently (extra dispatch boundaries or a host "
+                f"sync) than the one the checkpoint's peers ran. "
+                f"Restore with the matching code, or re-join tenants "
+                f"fresh")
         for tid in tenants:
             t_t = time.perf_counter()
             spec = specs.get(tid)

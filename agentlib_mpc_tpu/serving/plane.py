@@ -486,7 +486,8 @@ class ServingPlane:
 
         def make_engine(qp_fast_path: str,
                         collective_certify: str = "auto",
-                        memory_certify: "str | None" = None):
+                        memory_certify: "str | None" = None,
+                        dispatch_certify: str = "auto"):
             group = AgentGroup(
                 name=f"bucket-{key.digest}",
                 ocp=spec.ocp, n_agents=capacity,
@@ -515,13 +516,15 @@ class ServingPlane:
                     active=jnp.zeros((capacity,), bool),
                     mesh=self.mesh,
                     collective_certify=collective_certify,
-                    memory_certify=resolved_memory)
+                    memory_certify=resolved_memory,
+                    dispatch_certify=dispatch_certify)
             return FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
                 donate_state=self.donate, mesh=self.mesh,
                 collective_certify=collective_certify,
-                memory_certify=resolved_memory)
+                memory_certify=resolved_memory,
+                dispatch_certify=dispatch_certify)
 
         def warm_args(engine):
             # throwaway template inputs, mesh-placed for sharded
@@ -597,6 +600,12 @@ class ServingPlane:
                         # dtypes, other capacity math) is visible the
                         # same way a schedule drift is
                         "memory_digest": engine.memory_digest,
+                        # the certified dispatch schedule's identity
+                        # (ISSUE 18) — a revival whose fresh build
+                        # would stage the round differently (extra
+                        # boundaries, a host sync) is visible the
+                        # same way
+                        "dispatch_digest": engine.dispatch_digest,
                     })
                 except Exception:  # noqa: BLE001 - store is best-effort
                     logger.warning(
@@ -624,10 +633,12 @@ class ServingPlane:
                 # the artifact's recorded digests carry the identities
                 engine = make_engine(meta.get("qp_fast_path", "off"),
                                      collective_certify="off",
-                                     memory_certify="off")
+                                     memory_certify="off",
+                                     dispatch_certify="off")
                 engine.collective_schedule_digest = \
                     meta.get("collective_digest")
                 engine.memory_digest = meta.get("memory_digest")
+                engine.dispatch_digest = meta.get("dispatch_digest")
                 install_exported_step(
                     engine, blob,
                     warm_args=warm_args(engine) if self.warm_on_build

@@ -7,8 +7,8 @@ tracing (seconds each) were still paid on every crash restart. The
 store persists what those rungs produce: the engine's exported step
 (portable StableHLO, :mod:`agentlib_mpc_tpu.parallel.export`) plus a
 small metadata record (resolved qp routing, capacity, mesh identity,
-donate flag, and the two build-time proof digests — the certified
-collective-schedule digest and the certified memory-footprint digest,
+donate flag, and the three build-time proof digests — the certified
+collective-schedule, memory-footprint and dispatch-schedule digests,
 so a restore into a process whose fresh build would certify a
 DIFFERENT schedule or footprint is visible without re-tracing). A
 fresh process then *revives* the engine — constructs

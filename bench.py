@@ -55,6 +55,15 @@ Modes:
                                 # branch-at-a-time rounds (the reference
                                 # pattern); identity-gated, keys carry
                                 # platform + d<n> qualifiers
+    python bench.py --fusion-ab [n] [r]  # fused-vs-staged IPM dispatch
+                                # A/B (ISSUE 18): the same consensus
+                                # fleet with SolverOptions.fusion
+                                # "require" (one device program per
+                                # round, certified) vs "off" (stage
+                                # boundaries materialized) — warm round
+                                # cost + the analytic FusionPlan;
+                                # bitwise identity-gated, keys carry
+                                # platform + d<n> qualifiers
     python bench.py --profile [dir] [n]   # XLA profiler trace of the
                                 # warm n-zone step (default 256;
                                 # --profile DIR 1024 = the sub-linearity
@@ -876,6 +885,25 @@ def run_emit_metrics(path: str, n_agents: int = N_AGENTS) -> dict:
         payload["memory_certificates"] = mem
     except Exception as exc:
         payload["memory_certificates"] = {"error": repr(exc)}
+    # dispatch certificates + the analytic fusion plan (ISSUE 18): the
+    # proved host↔device schedule of the gate fleets (one device
+    # program per warm round, zero host syncs, mesh-independent digest)
+    # and the planner's ranked stage merges for THIS bench's warm step
+    # — what fusing the IPM pipeline is modeled to save, recorded next
+    # to the wall-clock it produced
+    try:
+        from agentlib_mpc_tpu.lint.jaxpr.dispatch import (
+            dispatch_gate_summary,
+        )
+        from agentlib_mpc_tpu.lint.jaxpr.fusion import plan_fusion
+
+        disp = dispatch_gate_summary()
+        wargs = (args[0], args[1], *carry[:5], args[7])
+        disp["fusion_plan"] = plan_fusion(
+            step, *wargs, while_trips=ADMM_ITERS).as_dict()
+        payload["dispatch_certificates"] = disp
+    except Exception as exc:
+        payload["dispatch_certificates"] = {"error": repr(exc)}
     # banded-vs-dense eval+jac cost comparison (lint/jaxpr cost model):
     # the analytical crossover evidence behind jacobian="auto", recorded
     # next to the measured phases (PERF.md round 8; the modeled dense
@@ -1383,6 +1411,140 @@ def run_scenario_ab(n_scenarios: int = 8, n_agents: int = 4,
     print(f"[bench] scenario-ab S={S}: serial={serial_ms:.1f}ms "
           f"batched={free_ms:.1f}ms robust={robust_ms:.1f}ms "
           f"({qual})", file=sys.stderr)
+    return rows
+
+
+def run_fusion_ab(n_agents: int = 4, rounds: int = 5) -> list[dict]:
+    """``--fusion-ab [n] [r]``: fused-vs-staged IPM dispatch A/B
+    (ISSUE 18 acceptance row).
+
+    The SAME zone consensus fleet runs its warm rounds two ways: (a)
+    **fused** — ``SolverOptions.fusion="require"``: eval+jac → banded
+    assemble → stage factor → line search live in ONE device program
+    per round, and the build carries the proof (staged-twin collective
+    digest identity, memory certificate within the analytic
+    :class:`FusionPlan`'s projected peak — the plan rides the row); (b)
+    **staged** — ``fusion="off"``: the reference-shaped program whose
+    stage hand-offs go through ``stage_boundary`` materialization
+    points. Warm per-round wall time is the headline column.
+
+    Identity gate: both legs run the Boyd exits pinned to ZERO (fixed
+    iteration count — the batched exit aggregation caveat from
+    ``--scenario-ab`` applies here too) and the staged leg must
+    reproduce the fused round's carried state and trajectories
+    **bitwise** (optimization barriers are scheduling hints, not math),
+    so the A/B can never publish a fast-but-wrong number. Metric keys
+    carry platform and device count per the PR 6/9 honesty rules.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions
+    from agentlib_mpc_tpu.parallel.fused_admm import (
+        AgentGroup,
+        FusedADMM,
+        FusedADMMOptions,
+        stack_params,
+    )
+    from agentlib_mpc_tpu.utils.jax_setup import enable_persistent_cache
+
+    enable_persistent_cache()
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    qual = f"{platform},d{n_dev}"
+    R = max(int(rounds), 1)
+    ocp = zone_ocp()
+    x0s, loads = fleet_inputs(n_agents)
+    thetas = stack_params([
+        ocp.default_params(
+            x0=jnp.array([x0s[i]]),
+            d_traj=jnp.broadcast_to(
+                jnp.array([loads[i], 290.15, 294.15]), (HORIZON, 3)))
+        for i in range(n_agents)])
+    # fixed-iteration rounds: zero Boyd exits so both legs execute the
+    # identical schedule and the identity gate compares like with like
+    opts = FusedADMMOptions(
+        max_iterations=ADMM_ITERS, rho=20.0, abs_tol=0.0, rel_tol=0.0,
+        primal_tol=0.0, dual_tol=0.0)
+
+    def build(fusion, **engine_kw):
+        group = AgentGroup(
+            name="zones", ocp=ocp, n_agents=n_agents,
+            couplings={"mDotCoolAir": "mDot"},
+            solver_options=SolverOptions(
+                **SOLVER_BASE, mu_init=COLD_MU, fusion=fusion))
+        return FusedADMM([group], opts, **engine_kw)
+
+    legs = {}
+    for fusion, label in (("require", "fused"), ("off", "staged")):
+        # the fused leg also certifies its dispatch schedule — the row
+        # carries digest + dispatches-per-round next to the wall-clock
+        engine = build(fusion, dispatch_certify="require"
+                       if label == "fused" else "auto")
+        state = engine.init_state([thetas])
+        state, _trajs, _stats = engine.step(state, [thetas])  # compile
+        jax.block_until_ready(state)
+        times, last = [], None
+        for _ in range(R):
+            t0 = time.perf_counter()
+            state, trajs, stats = engine.step(state, [thetas])
+            jax.block_until_ready(state)
+            times.append(1e3 * (time.perf_counter() - t0))
+            last = (state, trajs, stats)
+        legs[label] = {"engine": engine, "times": times, "last": last}
+
+    # -- identity gate: bitwise, every carried/returned leaf -----------
+    fused_leaves = jax.tree.leaves(legs["fused"]["last"])
+    staged_leaves = jax.tree.leaves(legs["staged"]["last"])
+    identity_ok = len(fused_leaves) == len(staged_leaves) and all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(fused_leaves, staged_leaves))
+    max_diff = max((float(np.max(np.abs(
+        np.asarray(a, np.float64) - np.asarray(b, np.float64))))
+        for a, b in zip(fused_leaves, staged_leaves)
+        if np.issubdtype(np.asarray(a).dtype, np.floating)),
+        default=0.0)
+    if not identity_ok:
+        print(f"[bench] fusion-ab: staged round DIVERGES from fused "
+              f"(max |diff| = {max_diff:.3e}) — rows marked "
+              f"identity_ok=false", file=sys.stderr)
+
+    rows: list[dict] = []
+    fused_engine = legs["fused"]["engine"]
+    plan = fused_engine.fusion_plan
+    cert = fused_engine.dispatch_certificate
+    for label in ("fused", "staged"):
+        times = legs[label]["times"]
+        row = {
+            "metric": f"fusion_ab[{label},{qual}]",
+            "n_agents": n_agents, "rounds": R,
+            "admm_iters": ADMM_ITERS,
+            "warm_round_ms": round(min(times), 3),
+            "mean_round_ms": round(sum(times) / len(times), 3),
+            "identity_ok": identity_ok,
+            "max_abs_diff": max_diff,
+            "platform": platform, "devices": n_dev,
+        }
+        if label == "fused":
+            row["fusion_plan"] = None if plan is None else plan.as_dict()
+            row["dispatch_digest"] = fused_engine.dispatch_digest
+            row["dispatches_per_round"] = (
+                None if cert is None or not cert.proved
+                else cert.dispatch_count())
+        else:
+            fused_best = min(legs["fused"]["times"])
+            row["staged_over_fused"] = round(
+                min(times) / max(fused_best, 1e-9), 3)
+        rows.append(row)
+    for row in rows:
+        print(json.dumps(row))
+        sys.stdout.flush()
+    print(f"[bench] fusion-ab n={n_agents}: "
+          f"fused={min(legs['fused']['times']):.1f}ms "
+          f"staged={min(legs['staged']['times']):.1f}ms per warm round "
+          f"({qual}, identity_ok={identity_ok})", file=sys.stderr)
     return rows
 
 
@@ -3483,6 +3645,19 @@ def main() -> None:
         if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
             n = int(sys.argv[idx + 2])
         run_scenario_ab(S, n)
+        return
+
+    if "--fusion-ab" in sys.argv:
+        # fused-vs-staged IPM dispatch A/B, in-process like --chaos
+        # (pin JAX_PLATFORMS=cpu for a tunnel-free host run):
+        #   python bench.py --fusion-ab [n_agents] [rounds]
+        idx = sys.argv.index("--fusion-ab")
+        n, r = 4, 5
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            n = int(sys.argv[idx + 1])
+        if len(sys.argv) > idx + 2 and not sys.argv[idx + 2].startswith("-"):
+            r = int(sys.argv[idx + 2])
+        run_fusion_ab(n, r)
         return
 
     if "--chaos-scenario" in sys.argv:

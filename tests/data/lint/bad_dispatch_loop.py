@@ -1,0 +1,50 @@
+"""Golden-file fixture: host-side dispatch storms (ISSUE 18).
+
+A Python ``for``/``while`` over a jitted call dispatches one device
+program per iteration, and a per-iteration ``.block_until_ready()``
+adds a full host round-trip on top — the ``jit-dispatch-in-loop`` rule
+must flag each occurrence, while the in-graph ``lax.scan`` loop (one
+dispatch total) and the single post-loop sync must stay silent.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x: x * 2.0)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def decorated_step(x, n):
+    return x + n
+
+
+def dispatch_storm(x):
+    for _ in range(100):
+        x = step(x)                          # one dispatch per pass
+    return x
+
+
+def sync_storm(x):
+    total = jnp.zeros(())
+    while float(total) < 4.0:
+        y = step(x)                          # dispatch per pass...
+        total = total + y.block_until_ready().sum()   # ...plus a sync
+    return total
+
+
+def decorated_storm(x):
+    for n in range(8):
+        x = decorated_step(x, n)             # dispatch per pass
+    return x
+
+
+def fused_ok(x):
+    # the loop lives IN the program: one dispatch covers every
+    # iteration, and the single sync after it is the idiomatic exit
+    def body(c, _):
+        return c * 2.0, None
+
+    y, _ = jax.lax.scan(body, x, None, length=100)
+    return y.block_until_ready()

@@ -1,0 +1,278 @@
+"""Analytic fusion planner + the fused-IPM knob (ISSUE 18).
+
+Three layers under test. (1) The planner: :func:`plan_fusion` must rank
+every contiguous merge of the observed stage pipeline by modeled
+dispatch-overhead savings, charge loop-carried boundaries by the trip
+budget, refuse candidates the memory certifier proves over capacity,
+and stay honest on unannotated/untraceable programs. (2) The solver
+knob: ``SolverOptions.fusion="off"`` materializes the staged reference
+program via ``stage_boundary`` — and the ISSUE acceptance row:
+fixed-iteration results are **bitwise identical** fused vs staged, for
+the tracker and the LinearRCZone menu QP, single-device and on the
+8-virtual-device mesh. (3) ``fusion="require"``: the engine refuses to
+build unless the fused program is certified equivalent to its staged
+twin (identical collective-schedule digest, memory certificate within
+the plan's projected peak-HBM bound), landing the proved
+:class:`FusionPlan` on the engine.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.lint.jaxpr import fusion as fusion_mod
+from agentlib_mpc_tpu.lint.jaxpr.fusion import (
+    DISPATCH_OVERHEAD_US,
+    FusionCandidate,
+    FusionPlan,
+    plan_fusion,
+)
+from agentlib_mpc_tpu.lint.jaxpr.memory import MemoryBudgetExceeded
+from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel import fleet_mesh
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+    stack_params,
+)
+from agentlib_mpc_tpu.telemetry import profiler
+
+from conftest import make_tracker_model  # noqa: E402
+
+
+def _staged_two_phase(a):
+    with profiler.phase_scope("factor"):
+        b = a @ a
+    with profiler.phase_scope("resolve"):
+        c = b @ a
+    return jnp.sum(c)
+
+
+class TestPlannerUnits:
+    def test_two_phase_merge_planned_and_charged_by_trips(self):
+        x = jnp.ones((32, 32))
+        plan = plan_fusion(_staged_two_phase, x, while_trips=4)
+        assert plan.status == "planned"
+        (cand,) = plan.candidates
+        assert cand.phases == ("factor", "resolve")
+        assert cand.dispatches_saved_per_iteration == 1
+        assert cand.dispatches_saved_per_round == 4
+        assert cand.savings_us == 4 * DISPATCH_OVERHEAD_US
+        # the boundary's HBM round-trip is kept on-chip every trip
+        assert cand.savings_bytes > 0
+        # the fused trace's live-range peak bounds the merge from above
+        assert plan.projected_peak_bytes == plan.certified_peak_bytes
+        assert plan.top is cand
+
+    def test_full_pipeline_merge_outranks_pairs(self):
+        def staged3(a):
+            with profiler.phase_scope("eval_jac"):
+                j = (a * 2.0) @ a
+            with profiler.phase_scope("factor"):
+                b = j @ a
+            with profiler.phase_scope("resolve"):
+                c = b @ a
+            return jnp.sum(c)
+
+        plan = plan_fusion(staged3, jnp.ones((32, 32)), while_trips=2)
+        assert plan.status == "planned"
+        # every contiguous run of the 3 observed stages is a candidate
+        assert len(plan.candidates) == 3
+        assert plan.top.phases == ("eval_jac", "factor", "resolve")
+        assert plan.top.dispatches_saved_per_round == 2 * 2
+
+    def test_missing_trip_budget_noted_and_guessed(self):
+        plan = plan_fusion(_staged_two_phase, jnp.ones((8, 8)))
+        assert plan.status == "planned"
+        assert any("unbounded" in n for n in plan.notes)
+        assert plan.while_trips >= 1
+
+    def test_unannotated_program_is_empty_not_planned(self):
+        plan = plan_fusion(lambda x: jnp.sum(x * 2.0), jnp.ones((4,)))
+        assert plan.status == "empty"
+        assert plan.top is None and plan.savings_bytes == 0
+        assert any("nothing to merge" in n for n in plan.notes)
+
+    def test_untraceable_program_is_unknown(self):
+        def broken(x):
+            raise RuntimeError("untraceable")
+
+        plan = plan_fusion(broken, jnp.ones((3,)))
+        assert plan.status == "unknown"
+        assert any("planner error" in n for n in plan.notes)
+
+    def test_over_capacity_candidates_refused(self):
+        plan = plan_fusion(_staged_two_phase, jnp.ones((32, 32)),
+                           while_trips=4, hbm_bytes=16)
+        assert plan.status == "refused"
+        assert plan.top is None
+        assert all(c.refused for c in plan.candidates)
+        assert all("over" in c.reason for c in plan.candidates)
+        assert any("over capacity" in n for n in plan.notes)
+        # nothing admissible: the bound falls back to the staged peak
+        assert plan.projected_peak_bytes == plan.certified_peak_bytes
+
+    def test_plan_artifact_is_json_serializable(self):
+        plan = plan_fusion(_staged_two_phase, jnp.ones((8, 8)),
+                           while_trips=2)
+        d = plan.as_dict()
+        assert d["status"] == "planned"
+        assert d["top"] == "factor+resolve"
+        assert d["while_trips"] == 2
+        json.dumps(d)      # the --emit-metrics embedding must not choke
+
+
+OPTS = FusedADMMOptions(max_iterations=8, rho=2.0)
+
+Tracker = make_tracker_model()
+
+
+def _tracker_ocp():
+    return transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                      method="multiple_shooting")
+
+
+def _menu_ocp():
+    from agentlib_mpc_tpu.lint.jaxpr.examples import build_example
+
+    return build_example("LinearRCZone/colloc-d1")
+
+
+def _engine(ocp, couplings, n_agents, mesh, fusion):
+    group = AgentGroup(
+        name="fusion-fleet", ocp=ocp, n_agents=n_agents,
+        couplings=couplings,
+        solver_options=SolverOptions(max_iter=25, fusion=fusion),
+        # solver routing is orthogonal to stage fusion — skip the LQ
+        # probe so the builds stay cheap
+        qp_fast_path="off")
+    thetas = stack_params([ocp.default_params()
+                           for _ in range(n_agents)])
+    return FusedADMM([group], OPTS, mesh=mesh), thetas
+
+
+def _assert_trees_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestSolverFusionKnob:
+    def test_bogus_mode_rejected_with_the_strings_hint(self):
+        ocp = _tracker_ocp()
+        theta = ocp.default_params()
+        lb, ub = ocp.bounds(theta)
+        with pytest.raises(ValueError, match="fusion must be"):
+            solve_nlp(ocp.nlp, ocp.initial_guess(theta), theta, lb, ub,
+                      SolverOptions(max_iter=5, fusion=True))
+
+    def test_staged_solve_is_bitwise_identical_to_fused(self):
+        """The solver-level half of the acceptance row: the staged
+        program differs from the fused one ONLY by optimization
+        barriers, so fixed-iteration results agree bit for bit."""
+        ocp = _tracker_ocp()
+        theta = ocp.default_params(p=jnp.array([2.0]))
+        lb, ub = ocp.bounds(theta)
+        w0 = ocp.initial_guess(theta)
+        res = {}
+        for mode in ("auto", "off"):
+            res[mode] = solve_nlp(
+                ocp.nlp, w0, theta, lb, ub,
+                SolverOptions(max_iter=25, fusion=mode))
+        _assert_trees_identical(res["auto"], res["off"])
+        assert int(res["auto"].stats.iterations) == \
+            int(res["off"].stats.iterations)
+
+
+class TestFusedUnfusedIdentity:
+    """The engine-level acceptance row: fixed-iteration rounds of the
+    fused engine and its staged twin are numerically identical — for
+    both gate workloads, single-device and on the virtual mesh."""
+
+    @pytest.mark.parametrize("workload", ["tracker", "menu"])
+    @pytest.mark.parametrize("on_mesh", [False, True],
+                             ids=["single-device", "mesh8"])
+    def test_two_rounds_identical(self, workload, on_mesh,
+                                  eight_devices):
+        if workload == "tracker":
+            ocp, couplings = _tracker_ocp(), {"shared_u": "u"}
+        else:
+            ocp, couplings = _menu_ocp(), {"Q_shared": "Q"}
+        mesh = fleet_mesh(devices=eight_devices) if on_mesh else None
+        n_agents = 8 if on_mesh else 2
+        outs = {}
+        for mode in ("auto", "off"):
+            engine, thetas = _engine(ocp, couplings, n_agents, mesh,
+                                     mode)
+            state = engine.init_state([thetas])
+            state, trajs1, stats1 = engine.step(state, [thetas])
+            state, trajs2, stats2 = engine.step(state, [thetas])
+            outs[mode] = (state, trajs1, stats1, trajs2, stats2)
+        _assert_trees_identical(outs["auto"], outs["off"])
+
+
+class TestRequireMode:
+    """``fusion="require"``: build-time staged-twin equivalence proof,
+    the proved plan on the engine, and both refusal seams."""
+
+    def test_mesh_build_proves_equivalence_and_lands_plan(
+            self, eight_devices):
+        engine, _ = _engine(_tracker_ocp(), {"shared_u": "u"}, 4,
+                            fleet_mesh(devices=eight_devices[:4]),
+                            "require")
+        plan = engine.fusion_plan
+        assert isinstance(plan, FusionPlan)
+        assert plan.status == "planned"
+        # the headline merge: the whole IPM stage pipeline, one program
+        assert plan.top is not None
+        assert len(plan.top.phases) >= 2
+        assert plan.savings_bytes > 0
+        assert plan.while_trips == OPTS.max_iterations
+        # the digest identity held (a mismatch would have raised) ...
+        assert engine.collective_schedule_digest is not None
+        # ... and the build-time memory certificate sits within the
+        # plan's projected peak-HBM bound
+        mem = engine.memory_certificate
+        assert mem is not None and mem.status == "proved"
+        assert mem.peak_bytes <= plan.projected_peak_bytes
+
+    def test_single_device_build_lands_plan_too(self):
+        engine, _ = _engine(_tracker_ocp(), {"shared_u": "u"}, 2, None,
+                            "require")
+        assert engine.fusion_plan is not None
+        assert engine.fusion_plan.status == "planned"
+
+    def test_unmodelable_round_refuses_the_build(self, monkeypatch):
+        monkeypatch.setattr(
+            fusion_mod, "plan_fusion",
+            lambda *a, **k: FusionPlan(status="unknown",
+                                       notes=("stubbed",)))
+        with pytest.raises(ValueError, match="could not model"):
+            _engine(_tracker_ocp(), {"shared_u": "u"}, 2, None,
+                    "require")
+
+    def test_peak_over_projected_bound_refuses_the_build(
+            self, monkeypatch):
+        """A fused step whose certified peak exceeds the plan's
+        projection must not build — the certificate, not the model,
+        has the last word."""
+        tiny = FusionCandidate(
+            name="stub", phases=("factor", "resolve"),
+            dispatches_saved_per_iteration=1,
+            dispatches_saved_per_round=8, savings_us=560.0,
+            savings_bytes=100, projected_peak_bytes=1)
+        monkeypatch.setattr(
+            fusion_mod, "plan_fusion",
+            lambda *a, **k: FusionPlan(status="planned",
+                                       candidates=(tiny,)))
+        with pytest.raises(MemoryBudgetExceeded,
+                           match="projected peak-HBM bound"):
+            _engine(_tracker_ocp(), {"shared_u": "u"}, 2, None,
+                    "require")

@@ -1,0 +1,342 @@
+"""Dispatch certifier (ISSUE 18): the adversarial corpus.
+
+The dispatch pass must prove that a warm fused round is ONE device
+program (the jit entry the only host↔device boundary), schedule and
+charge *planned* host syncs without ever executing them, refute any
+unplanned ``pure_callback``-class sync naming the offending eqn by
+source, multiply loop-carried syncs by scan lengths and while-trip
+budgets, divide program-boundary bytes by the shard spec, report
+donated carry buffers as reuse rather than transfer — and the engine
+seam must stamp the mesh-size-independent ``dispatch_digest`` at build
+and refuse the mutation direction: a host peek smuggled into the
+consensus update (the static analogue of PR 3's source-surgery tests)
+fails the build under ``dispatch_certify="require"`` and the checked-in
+``[jaxpr.dispatch]`` pin either way.
+
+Small programs trace in milliseconds; the engine-backed classes share
+module fixtures the way every mesh test module does.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentlib_mpc_tpu.lint.jaxpr.dispatch import (
+    DispatchCertificate,
+    certify_dispatch,
+    check_dispatch_budget,
+)
+from agentlib_mpc_tpu.ops import admm as admm_ops
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel import fleet_mesh
+from agentlib_mpc_tpu.parallel.fused_admm import (
+    AgentGroup,
+    FusedADMM,
+    FusedADMMOptions,
+)
+
+from conftest import make_tracker_model  # noqa: E402
+
+
+def _mesh(n=4, axis="a"):
+    return Mesh(np.array(jax.devices("cpu")[:n]), (axis,))
+
+
+def _never_run(*_a):
+    raise AssertionError("host callback executed during certification")
+
+
+def _scalar_cb(dtype):
+    """A pure_callback issuing a scalar host round-trip that must NEVER
+    actually run (certification is static)."""
+    return lambda v: jax.pure_callback(
+        _never_run, jax.ShapeDtypeStruct((), dtype), v)
+
+
+class TestCertifierCorpus:
+    """Hand-written programs: the schedule walk, byte model, loop
+    charging and refusal direction."""
+
+    def test_pure_device_program_is_one_dispatch(self):
+        def fn(x):
+            return jnp.sum(x * 2.0)
+
+        cert = certify_dispatch(fn, jnp.ones((8, 3), jnp.float32))
+        assert cert.proved
+        assert cert.dispatch_count() == 1
+        assert cert.host_syncs == ()
+        assert cert.dispatch_digest is not None
+        entry = cert.boundaries[0]
+        assert entry.kind == "program" and entry.primitive == "jit"
+        assert entry.in_bytes == 8 * 3 * 4      # f32 operand lands once
+        assert entry.out_bytes == 4             # scalar result back
+        assert cert.transfer_bytes() == 8 * 3 * 4 + 4
+
+    def test_unplanned_callback_refuted_naming_source(self):
+        def fn(x):
+            s = jnp.sum(x)
+            peek = _scalar_cb(x.dtype)(s)       # the smuggled host sync
+            return s + 0.0 * peek
+
+        cert = certify_dispatch(fn, jnp.ones((4,), jnp.float32))
+        assert cert.status == "refuted"
+        assert cert.dispatch_digest is None
+        msg = " ".join(cert.refutations)
+        assert "pure_callback" in msg
+        # the offending eqn is named by source position — and the
+        # callback body was never executed (it raises if run)
+        assert "test_jaxpr_dispatch" in msg
+
+    def test_planned_sync_scheduled_and_charged(self):
+        def fn(x):
+            s = jnp.sum(x)
+            peek = _scalar_cb(x.dtype)(s)
+            return s + 0.0 * peek
+
+        cert = certify_dispatch(fn, jnp.ones((4,), jnp.float32),
+                                allowed_sync_prims=("pure_callback",))
+        assert cert.proved
+        syncs = cert.host_syncs
+        assert len(syncs) == 1
+        # every sync splits the program: entry + one resume
+        assert cert.dispatch_count() == 2
+        assert "pure_callback" in cert.opaque
+        # honesty: the host-side cost is noted unknown, never measured
+        assert any("unknown" in n for n in cert.notes)
+        # the round-trip ships the scalar both ways (f32: 4 B each)
+        assert syncs[0].out_bytes == 4 and syncs[0].in_bytes == 4
+
+    def test_scan_multiplies_sync_issues(self):
+        def fn(x):
+            def body(c, _):
+                c = c + _scalar_cb(x.dtype)(c)
+                return c, None
+
+            out, _ = lax.scan(body, jnp.float32(0.0), None, length=5)
+            return out + jnp.sum(x)
+
+        cert = certify_dispatch(fn, jnp.ones((4,), jnp.float32),
+                                allowed_sync_prims=("pure_callback",))
+        assert cert.proved
+        (sync,) = cert.host_syncs
+        assert sync.loop_path == ("scan[5]",)
+        assert sync.multiplicity == 5 and sync.bounded
+        assert cert.dispatch_count() == 1 + 5
+
+    def test_while_sync_charged_per_trip_budget(self):
+        def fn(x):
+            def cond(c):
+                return c < 10.0
+
+            def body(c):
+                return c + 1.0 + _scalar_cb(x.dtype)(c)
+
+            return lax.while_loop(cond, body, jnp.sum(x))
+
+        cert = certify_dispatch(fn, jnp.ones((4,), jnp.float32),
+                                allowed_sync_prims=("pure_callback",))
+        assert cert.proved
+        (sync,) = cert.host_syncs
+        assert sync.loop_path == ("while",) and not sync.bounded
+        # data-dependent trip count: charged × the caller's budget
+        assert sync.issues(while_trips=8) == 8
+        assert cert.dispatch_count(while_trips=8) == 1 + 8
+        assert cert.dispatch_count() == 2       # 1-trip floor
+
+    def test_donated_carry_is_reuse_not_transfer(self):
+        def step(state, inc):
+            return state + inc, jnp.sum(inc)
+
+        closed = jax.make_jaxpr(step)(jnp.ones((16,), jnp.float32),
+                                      jnp.ones((16,), jnp.float32))
+        plain = certify_dispatch(closed)
+        donated = certify_dispatch(closed, donated_invars=(True, False))
+        ep, ed = plain.boundaries[0], donated.boundaries[0]
+        assert ep.donated_bytes == 0
+        assert ed.donated_bytes == 64           # the carry, reused
+        assert ed.in_bytes == ep.in_bytes - 64
+        assert donated.transfer_bytes() == plain.transfer_bytes() - 64
+        # donation changes payload accounting, never the schedule
+        assert donated.dispatch_digest == plain.dispatch_digest
+
+    def test_shard_spec_divides_bytes_digest_mesh_size_free(self):
+        def body(x):
+            return lax.psum(jnp.sum(x), "a")
+
+        certs = {}
+        for n in (2, 4):
+            sm = shard_map(body, mesh=_mesh(n), in_specs=P("a"),
+                           out_specs=P(), check_rep=False)
+            certs[n] = certify_dispatch(sm, jnp.ones((8, 4), jnp.float32))
+        for n, cert in certs.items():
+            assert cert.proved
+            # the sharded operand lands global_bytes / axis_size per dev
+            assert cert.boundaries[0].in_bytes == 8 * 4 * 4 // n
+        assert certs[4].axis_sizes == {"a": 4}
+        # payload scales with the mesh; the schedule identity must not
+        assert certs[2].dispatch_digest == certs[4].dispatch_digest
+
+    def test_budget_pins(self):
+        def fn(x):
+            s = jnp.sum(x)
+            return s + 0.0 * _scalar_cb(x.dtype)(s)
+
+        planned = certify_dispatch(fn, jnp.ones((4,), jnp.float32),
+                                   allowed_sync_prims=("pure_callback",))
+        v = check_dispatch_budget(
+            planned, {"dispatches_per_round": 1, "max_host_syncs": 0})
+        assert len(v) == 2
+        assert "budget pins 1" in v[0]
+        assert "host sync" in v[1]
+        refuted = certify_dispatch(fn, jnp.ones((4,), jnp.float32))
+        v = check_dispatch_budget(refuted, {"dispatches_per_round": 1})
+        assert len(v) == 1 and "not proved" in v[0]
+
+
+OPTS = FusedADMMOptions(max_iterations=8, rho=2.0)
+SOLVER = SolverOptions(max_iter=25)
+
+Tracker = make_tracker_model()
+
+
+def _tracker_group(n_agents):
+    ocp = transcribe(Tracker(), ["u"], N=4, dt=300.0,
+                     method="multiple_shooting")
+    return AgentGroup(name="fleet", ocp=ocp, n_agents=n_agents,
+                      couplings={"shared_u": "u"},
+                      solver_options=SOLVER,
+                      # solver-routing certification is irrelevant to
+                      # the dispatch schedule — keep builds cheap
+                      qp_fast_path="off")
+
+
+def _tracker_fleet(n_agents, mesh, **engine_kw):
+    return FusedADMM([_tracker_group(n_agents)], OPTS, mesh=mesh,
+                     **engine_kw)
+
+
+class TestFusedRoundDispatch:
+    """The engine seam: the warm round certifies as ONE dispatch at
+    build, the checked-in pin holds, and the digest is an identity of
+    the schedule, not of the mesh size."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, eight_devices):
+        return _tracker_fleet(8, fleet_mesh(devices=eight_devices))
+
+    def test_mesh_engine_certifies_at_build(self, fleet):
+        cert = fleet.dispatch_certificate
+        assert isinstance(cert, DispatchCertificate)
+        assert cert.proved, cert.refutations
+        # the ISSUE headline: eval+jac -> assemble -> factor -> line
+        # search all live inside ONE device program per round
+        assert cert.dispatch_count() == 1
+        assert cert.host_syncs == ()
+        assert fleet.dispatch_digest == cert.dispatch_digest
+        assert fleet.dispatch_digest is not None
+
+    def test_gate_matches_checked_in_budget(self, fleet):
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        cfg = load_budgets().get("jaxpr", {}).get("dispatch", {})
+        assert cfg, "[jaxpr.dispatch] missing from lint_budgets.toml"
+        assert check_dispatch_budget(fleet.dispatch_certificate,
+                                     cfg) == []
+
+    def test_digest_is_mesh_size_independent(self, fleet,
+                                             eight_devices):
+        """The same fleet structure on a half-size mesh: per-device
+        boundary payload doubles (two agents per lane), the schedule
+        digest must not move — it stamps the store meta across
+        degrades and topology changes."""
+        half = _tracker_fleet(8, fleet_mesh(devices=eight_devices[:4]))
+        assert half.dispatch_digest == fleet.dispatch_digest
+        b8 = fleet.dispatch_certificate.transfer_bytes()
+        b4 = half.dispatch_certificate.transfer_bytes()
+        assert b4 > b8
+
+
+class TestMutationDirection:
+    """PR 3's source-surgery pattern, static edition: sabotage the real
+    consensus update / the donation contract and the gate must refuse,
+    naming the injected eqn."""
+
+    def _sabotaged_consensus(self):
+        real = admm_ops.consensus_update
+
+        def sabotaged(locals_, state, active=None, axis_name=None):
+            new_state, res = real(locals_, state, active=active,
+                                  axis_name=axis_name)
+            # the regression: a host peek at the residual, folded back
+            # in so it cannot be DCE'd — one round-trip per ADMM trip
+            peek = jax.pure_callback(
+                _never_run,
+                jax.ShapeDtypeStruct((), res.primal.dtype), res.primal)
+            return new_state, res._replace(
+                primal=res.primal + 0.0 * peek)
+
+        return sabotaged
+
+    def test_injected_callback_refused_under_require(self, monkeypatch):
+        monkeypatch.setattr(admm_ops, "consensus_update",
+                            self._sabotaged_consensus())
+        with pytest.raises(ValueError) as ei:
+            FusedADMM([_tracker_group(2)], OPTS,
+                      dispatch_certify="require")
+        msg = str(ei.value)
+        assert "REFUTED" in msg and "pure_callback" in msg
+        # the refusal names the injected eqn's source — THIS file
+        assert "test_jaxpr_dispatch" in msg
+        assert "while" in msg        # and locates it in the ADMM loop
+
+    def test_injected_callback_warns_on_single_host_mesh(
+            self, eight_devices, monkeypatch, caplog):
+        """Single-host ``"auto"`` policy: warn loudly, proceed (debug
+        latitude) — but the certificate is refuted, the digest gone,
+        and the checked-in pin fails the tree in CI."""
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        monkeypatch.setattr(admm_ops, "consensus_update",
+                            self._sabotaged_consensus())
+        with caplog.at_level(
+                logging.WARNING,
+                logger="agentlib_mpc_tpu.parallel.fused_admm"):
+            engine = _tracker_fleet(8, fleet_mesh(devices=eight_devices))
+        cert = engine.dispatch_certificate
+        assert cert is not None and cert.status == "refuted"
+        assert engine.dispatch_digest is None
+        assert any("dispatch schedule REFUTED" in rec.message
+                   for rec in caplog.records)
+        cfg = load_budgets().get("jaxpr", {}).get("dispatch", {})
+        violations = check_dispatch_budget(cert, cfg)
+        assert violations and "not proved" in " ".join(violations)
+
+    def test_undonated_round_trip_fails_transfer_pin(self):
+        """The other mutation direction: dropping ``donate_state``
+        re-charges the carry as fresh host↔device transfer every round
+        — same schedule (digest equal), bigger bill, and a transfer pin
+        calibrated on the donated engine refutes it."""
+        donated = FusedADMM([_tracker_group(2)], OPTS,
+                            donate_state=True,
+                            dispatch_certify="require")
+        undonated = FusedADMM([_tracker_group(2)], OPTS,
+                              donate_state=False,
+                              dispatch_certify="require")
+        cd = donated.dispatch_certificate
+        cu = undonated.dispatch_certificate
+        assert cd.proved and cu.proved
+        assert cd.boundaries[0].donated_bytes > 0
+        assert cu.boundaries[0].donated_bytes == 0
+        assert cu.transfer_bytes() > cd.transfer_bytes()
+        assert cd.dispatch_digest == cu.dispatch_digest
+        cap = {"max_transfer_bytes_per_round": cd.transfer_bytes()}
+        assert check_dispatch_budget(cd, cap) == []
+        violations = check_dispatch_budget(cu, cap)
+        assert violations and "un-donated" in violations[0]

@@ -88,6 +88,23 @@ class TestGoldenFiles:
             "3316b72dbf22": "bad_static_names",   # dict default
         }
 
+    def test_dispatch_loop_fixture(self):
+        got = {f.fingerprint: f.rule for f in fixture_findings()
+               if f.path == "bad_dispatch_loop.py"}
+        assert got == {
+            "e784942a4366": "jit-dispatch-in-loop",  # for over jitted name
+            "50506a745d0e": "jit-dispatch-in-loop",  # while over jitted name
+            "166648bfca21": "jit-dispatch-in-loop",  # sync inside the while
+            "cbb92e817eab": "jit-dispatch-in-loop",  # @partial(jit) callee
+        }
+
+    def test_dispatch_loop_spares_in_graph_loop(self):
+        """``fused_ok`` loops via ``lax.scan`` and syncs ONCE after the
+        loop — the dispatch-storm rule must not fire on it."""
+        assert [f for f in fixture_findings()
+                if f.path == "bad_dispatch_loop.py"
+                and f.qualname == "fused_ok"] == []
+
     def test_clean_file_produces_no_findings(self):
         assert [f for f in fixture_findings() if f.path == "clean.py"] == []
 

@@ -150,6 +150,62 @@ class TestCapture:
         assert "unattributed" in prof.table()
 
 
+    def test_warm_round_observes_exactly_the_certified_dispatch_count(self):
+        """ISSUE 18 cross-check: the dispatch certificate's static
+        claim — the warm fused round is ONE device program — against
+        what the profiler actually measures. A captured warm round must
+        execute exactly ``dispatch_count()`` distinct device programs;
+        an extra module in the window means an uncertified dispatch
+        snuck into the hot path."""
+        from agentlib_mpc_tpu.lint.retrace_budget import tracker_ocp
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+            stack_params,
+        )
+
+        ocp = tracker_ocp()
+        group = AgentGroup(
+            name="dispatch-xcheck", ocp=ocp, n_agents=4,
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=30),
+            qp_fast_path="off")
+        engine = FusedADMM(
+            [group], FusedADMMOptions(max_iterations=8, rho=2.0),
+            dispatch_certify="require")
+        cert = engine.dispatch_certificate
+        assert cert is not None and cert.proved
+        assert cert.dispatch_count() == 1
+
+        thetas = [stack_params([
+            ocp.default_params(p=jnp.array([float(i + 1)]))
+            for i in range(4)])]
+        state = engine.init_state(thetas)
+        for _ in range(2):      # compile strictly outside the window
+            state, _trajs, _stats = engine.step(state, thetas)
+        jax.block_until_ready(state)
+        hlo = profiler.hlo_text_for(engine._step,
+                                    *engine._step_templates())
+
+        holder = {"state": state}
+
+        def run_round():
+            # ONLY the certified step runs inside the capture window
+            s, _trajs, _stats = engine.step(holder["state"], thetas)
+            holder["state"] = s
+            jax.block_until_ready(s)
+
+        prof = profiler.capture_phase_profile(
+            run_round, rounds=2, hlo_text=hlo, journal=False)
+        assert sum(prof.op_events.values()) > 0
+        # the observed program set IS the certified schedule: one
+        # module — the fused mega-round — and nothing else
+        assert len(prof.hlo_modules) == cert.dispatch_count(), \
+            prof.hlo_modules
+
+
 class TestRegressionPlane:
     PHASES_MS = {"factor": 10.0, "resolve": 40.0, "eval_jac": 20.0,
                  profiler.UNATTRIBUTED: 0.5}
