@@ -17,7 +17,11 @@ from typing import Any, Optional, Type
 
 from agentlib_mpc_tpu import telemetry
 from agentlib_mpc_tpu.models.model import Model
-from agentlib_mpc_tpu.ops.solver import jac_path_name, kkt_path_name
+from agentlib_mpc_tpu.ops.solver import (
+    init_point_source_name,
+    jac_path_name,
+    kkt_path_name,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -163,8 +167,9 @@ class OptimizationBackend:
         """One solve's ``stats_history`` row from a ``SolverStats`` — the
         single place the key schema lives (time, iterations, success,
         kkt_error, objective, constraint_violation, solve_wall_time,
-        kkt_path, jac_path), so the five backends cannot drift. ``extra``
-        appends or overrides (e.g. the MINLP two-phase iteration sum)."""
+        kkt_path, jac_path, init_point_source), so the five backends
+        cannot drift. ``extra`` appends or overrides (e.g. the MINLP
+        two-phase iteration sum)."""
         return {
             "time": float(now),
             "iterations": int(stats.iterations),
@@ -175,6 +180,10 @@ class OptimizationBackend:
             "solve_wall_time": wall,
             "kkt_path": kkt_path_name(getattr(stats, "kkt_path", -1)),
             "jac_path": jac_path_name(getattr(stats, "jac_path", -1)),
+            # initial-point provenance (ISSUE 19): legacy/unlabeled
+            # stats read as the plain start they are
+            "init_point_source": init_point_source_name(
+                getattr(stats, "init_point_source", -1)) or "plain",
             **extra,
         }
 

@@ -946,7 +946,17 @@ def run_serving_gate(budgets: "dict | None" = None,
        ``[serving.autopilot.budgets]`` allowance (default 0): a
        quality move is a re-bucket through the cache, never a
        recompile, or the controller would pay a cold build at the
-       exact moment the plane is drowning.
+       exact moment the plane is drowning;
+    6. **warm-start flip** (the ``[serving.warmstart]`` budget,
+       ISSUE 19) — a fresh plane with a learned warm-start predictor
+       installed runs join-with-predictor → serve → predictor-off →
+       join → serve → predictor-on → serve after a warmup that traces
+       both reset flavors: the predicted and plain cold starts share
+       ONE splice executable (the enable flag is traced data), so the
+       whole flip cycle is held to the ``[serving.warmstart.budgets]``
+       allowance (default 0). The gate also asserts at least one
+       admission ran the predictor and one took the plain path — no
+       no-op A/A.
     """
     from agentlib_mpc_tpu import telemetry
     from agentlib_mpc_tpu.telemetry import jax_events
@@ -1089,6 +1099,82 @@ def run_serving_gate(budgets: "dict | None" = None,
                 "cache hit counter — the quality moves bypassed the "
                 "cache")
         plane2.leave("r0")
+
+        # -- learned warm-start flip (ISSUE 19): the predicted and ----
+        # -- plain cold starts share ONE splice executable ------------
+        from agentlib_mpc_tpu.ml.training import fit_warmstart
+        from agentlib_mpc_tpu.ml.warmstart import theta_flat_size
+        from agentlib_mpc_tpu.serving.fingerprint import (
+            tenant_fingerprint,
+        )
+
+        ws_cfg = dict(cfg.get("warmstart", {}) or {})
+        ws_budgets = dict(ws_cfg.get("budgets", {}) or {})
+        ws_default = int(ws_budgets.pop("default", 0))
+        plane3 = ServingPlane(
+            FusedADMMOptions(max_iterations=6, rho=2.0),
+            slot_multiple=1, initial_capacity=capacity,
+            pipelined=True, donate=True)
+        # probe join: the live engine tells us the head widths the
+        # artifact must carry (the gate never hardcodes a transcription
+        # detail the workload owns)
+        plane3.join(spec("p0", 1.0))
+        (_k3, bucket3), = plane3._buckets.items()
+        eng3 = bucket3.engine
+        n_w = int(eng3.groups[0].ocp.n_w)
+        n_lam = len(eng3._aliases) * int(eng3.T)
+        plane3.leave("p0")
+        # untrained synthetic weights: the quality gate will REJECT the
+        # prediction — irrelevant here, the reset executable is shared
+        # and only its trace count is under test
+        rng = np.random.default_rng(0)
+        n_rows, n_theta = 8, theta_flat_size(ocp)
+        ds = {"theta": rng.normal(size=(n_rows, n_theta)),
+              "w": rng.normal(size=(n_rows, n_w)),
+              "lam": rng.normal(size=(n_rows, n_lam)),
+              "iterations": np.full(n_rows, 3)}
+        ws_model = fit_warmstart(
+            ds, fingerprint=tenant_fingerprint(ocp).digest,
+            aliases=list(eng3._aliases),
+            trainer_config={"hidden": (4,), "epochs": 2, "seed": 0})
+        plane3.install_warmstart(ws_model)
+
+        # warmup: both reset flavors (predictor on + off) trace once
+        plane3.join(spec("ws0", 1.0))
+        serve_tenants(plane3, "ws0", rounds=serve_rounds)
+        plane3.set_warmstart(False)
+        plane3.join(spec("ws1", 2.0))
+        serve_tenants(plane3, "ws0", "ws1", rounds=serve_rounds)
+        plane3.set_warmstart(True)
+        plane3.leave("ws0")
+        plane3.leave("ws1")
+
+        # measured flip: join-with-predictor -> serve -> predictor-off
+        # -> join -> serve -> back on -> serve, all at ZERO compiles —
+        # the enable flag is traced data, never structure
+        w_before = _compile_snapshot(reg)
+        plane3.join(spec("m0", 1.0))
+        serve_tenants(plane3, "m0", rounds=serve_rounds)
+        plane3.set_warmstart(False)
+        plane3.join(spec("m1", 2.0))
+        serve_tenants(plane3, "m0", "m1", rounds=serve_rounds)
+        plane3.set_warmstart(True)
+        serve_tenants(plane3, "m0", "m1", rounds=serve_rounds)
+        w_after = _compile_snapshot(reg)
+        ws_stats = plane3.stats()["warmstart"]["buckets"]
+        adm = next(iter(ws_stats.values()))["admissions"] if ws_stats \
+            else {}
+        if not (adm.get("predicted", 0) + adm.get("predicted_rejected",
+                                                  0)):
+            failures.append(
+                "warmstart leg: no admission ran the predictor — the "
+                "flip cycle measured plain starts twice")
+        if not adm.get("plain", 0):
+            failures.append(
+                "warmstart leg: predictor-off admission did not take "
+                "the plain path")
+        plane3.leave("m0")
+        plane3.leave("m1")
     finally:
         telemetry.configure(enabled=was_enabled)
 
@@ -1114,12 +1200,20 @@ def run_serving_gate(budgets: "dict | None" = None,
         if delta > budget:
             violations.append({"entry_point": f"autopilot:{entry}",
                                "observed": delta, "budget": budget})
+    warmstart_deltas = {k: w_after.get(k, 0) - w_before.get(k, 0)
+                        for k in set(w_before) | set(w_after)}
+    for entry, delta in sorted(warmstart_deltas.items()):
+        budget = int(ws_budgets.get(entry, ws_default))
+        if delta > budget:
+            violations.append({"entry_point": f"warmstart:{entry}",
+                               "observed": delta, "budget": budget})
     report = {
         "serve_rounds": serve_rounds,
         "capacity": capacity,
         "deltas": dict(sorted(deltas.items())),
         "health_deltas": dict(sorted(health_deltas.items())),
         "autopilot_deltas": dict(sorted(autopilot_deltas.items())),
+        "warmstart_deltas": dict(sorted(warmstart_deltas.items())),
         "violations": violations,
         "failures": failures,
         "cache": {"hits": plane.cache.hits,
@@ -1138,6 +1232,7 @@ def run_serving_gate(budgets: "dict | None" = None,
         if not violations and not failures:
             print("serving-budget: OK — zero excess compiles across "
                   "join/serve/leave/rejoin churn (evict/readmit "
-                  "included) AND across the warm autopilot quality-"
-                  "ladder cycle; rejoin was a compile-cache hit")
+                  "included), across the warm autopilot quality-"
+                  "ladder cycle AND across the warm-start predictor "
+                  "on/off flip; rejoin was a compile-cache hit")
     return report

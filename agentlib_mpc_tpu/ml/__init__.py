@@ -15,6 +15,7 @@ from agentlib_mpc_tpu.ml.serialized import (
     SerializedGPR,
     SerializedLinReg,
     SerializedMLModel,
+    SerializedWarmstart,
     column_order,
     load_serialized_model,
 )

@@ -259,6 +259,104 @@ class SerializedANN(SerializedMLModel):
                    weights=weights, biases=biases, activations=acts)
 
 
+#: canonical head order of the warm-start document's output vector —
+#: the trainer concatenates targets and the predictor slices outputs in
+#: exactly this order (heads a document omits are simply absent)
+WARMSTART_HEADS = ("w", "y", "z", "lam")
+
+
+@dataclasses.dataclass
+class SerializedWarmstart(SerializedMLModel):
+    """Learned solver warm start: a feed-forward net mapping one
+    flattened OCP parameter vector ``theta`` to a primal/dual initial
+    point (``w``/``y``/``z`` heads, plus an optional per-agent ADMM
+    ``lam`` head for fleet cold starts).
+
+    Unlike the plant surrogates this document predicts the *solver's*
+    own state, so it is stamped with the structural fingerprint digest
+    of the problem class it was trained for (the PR 7
+    ``lint.jaxpr.structural_fingerprint`` identity): reviving it against
+    a drifted structure must REFUSE — dimensions that happen to match
+    do not make two different problems share a learned initial point.
+    """
+
+    model_type: ClassVar[str] = "Warmstart"
+
+    #: structural-fingerprint digest of the problem class this predictor
+    #: was trained for (``serving.fingerprint.tenant_fingerprint(ocp)
+    #: .digest``); empty = unstamped (refused by the builder)
+    fingerprint: str = ""
+    #: flattened parameter-vector length (``ml.warmstart.flatten_theta``)
+    n_theta: int = 0
+    #: head name -> output length, canonical :data:`WARMSTART_HEADS`
+    #: order; the output vector is their concatenation
+    heads: dict = dataclasses.field(default_factory=dict)
+    #: consensus-alias order of the ``lam`` head (``lam`` is the
+    #: concatenation of one (T,) multiplier row per alias in this order)
+    aliases: list = dataclasses.field(default_factory=list)
+    weights: list = dataclasses.field(default_factory=list)
+    biases: list = dataclasses.field(default_factory=list)
+    activations: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not (len(self.weights) == len(self.biases)
+                == len(self.activations)):
+            raise ValueError("weights/biases/activations length mismatch")
+        for a in self.activations:
+            if a not in ACTIVATIONS:
+                raise ValueError(f"unknown activation {a!r}; known: "
+                                 f"{ACTIVATIONS}")
+        unknown = set(self.heads) - set(WARMSTART_HEADS)
+        if unknown:
+            raise ValueError(
+                f"unknown warm-start head(s) {sorted(unknown)}; known: "
+                f"{WARMSTART_HEADS}")
+        if self.biases:
+            n_out = int(np.asarray(self.biases[-1]).size)
+            n_heads = sum(int(v) for v in self.heads.values())
+            if n_heads != n_out:
+                raise ValueError(
+                    f"head lengths sum to {n_heads} but the net emits "
+                    f"{n_out} outputs")
+
+    # the input vector is one flattened theta, not lagged features —
+    # override the feature-derived layout
+    @property
+    def input_columns(self) -> list:
+        return [f"theta[{i}]" for i in range(int(self.n_theta))]
+
+    @property
+    def n_inputs(self) -> int:
+        return int(self.n_theta)
+
+    @property
+    def output_names(self) -> list:
+        return [h for h in WARMSTART_HEADS if h in self.heads]
+
+    def head_slices(self) -> "dict[str, tuple]":
+        """name -> (offset, length) into the output vector, canonical
+        :data:`WARMSTART_HEADS` order."""
+        out, off = {}, 0
+        for h in WARMSTART_HEADS:
+            if h in self.heads:
+                n = int(self.heads[h])
+                out[h] = (off, n)
+                off += n
+        return out
+
+    def _parameters_dict(self) -> dict:
+        return {
+            "fingerprint": str(self.fingerprint),
+            "n_theta": int(self.n_theta),
+            "heads": {k: int(v) for k, v in self.heads.items()},
+            "aliases": [str(a) for a in self.aliases],
+            "weights": [np.asarray(w).tolist() for w in self.weights],
+            "biases": [np.asarray(b).tolist() for b in self.biases],
+            "activations": list(self.activations),
+        }
+
+
 @dataclasses.dataclass
 class SerializedGPR(SerializedMLModel):
     """Exact GPR with the reference's kernel family — ConstantKernel × RBF

@@ -152,8 +152,19 @@ class ANNTrainerCore:
 
         X = np.asarray(X, dtype=float)
         y = np.atleast_2d(np.asarray(y, dtype=float).T).T
-        x_mean, x_std = X.mean(axis=0), X.std(axis=0) + 1e-9
-        y_mean, y_std = y.mean(axis=0), y.std(axis=0) + 1e-9
+
+        def _std(a, mean):
+            # near-constant columns get scale 1, not epsilon: the
+            # standardization is folded into the serialized weights below,
+            # and dividing by ~1e-9 would bake ~1e9-magnitude weights with
+            # huge compensating biases — exact in float64, catastrophic
+            # cancellation when the net is evaluated in float32 in-graph
+            s = a.std(axis=0)
+            return np.where(s < 1e-8 * (1.0 + np.abs(mean)), 1.0, s)
+
+        x_mean = X.mean(axis=0)
+        y_mean = y.mean(axis=0)
+        x_std, y_std = _std(X, x_mean), _std(y, y_mean)
         Xn = (X - x_mean) / x_std
         yn = (y - y_mean) / y_std
 
@@ -243,6 +254,121 @@ def fit_ann(X, y, X_val=None, y_val=None, dt: float = 1.0,
         None if y_val is None else np.asarray(y_val, dtype=float))
     return SerializedANN(
         dt=dt, inputs=inputs, output=output, trainer_config=trainer_config,
+        weights=[w.tolist() for w in weights],
+        biases=[b.tolist() for b in biases],
+        activations=acts)
+
+
+def load_warmstart_dataset(source) -> dict:
+    """Load a warm-start training set in exactly the format the dataset
+    CLI (``python -m agentlib_mpc_tpu.telemetry --dataset``) emits.
+
+    ``source``: an ``.npz``/``.csv`` path, or a dict of arrays passed
+    through. Returns ``{"theta": (n, n_theta), "w": (n, n_w),
+    "y": ..., "z": ..., "lam": ..., "iterations": (n,)}`` with absent
+    heads as zero-column arrays — the trainer consumes this and nothing
+    else, so tape -> CLI -> trainer is one documented contract."""
+    if isinstance(source, dict):
+        data = {k: np.asarray(v, dtype=float) for k, v in source.items()
+                if k in ("theta", "w", "y", "z", "lam", "iterations")}
+    else:
+        path = str(source)
+        if path.endswith(".npz"):
+            with np.load(path) as npz:
+                data = {k: np.asarray(npz[k], dtype=float)
+                        for k in npz.files
+                        if k in ("theta", "w", "y", "z", "lam",
+                                 "iterations")}
+        else:
+            import csv as _csv
+
+            with open(path, "r", encoding="utf-8", newline="") as fh:
+                reader = _csv.reader(fh)
+                header = next(reader)
+                rows = [[float(v) for v in row] for row in reader if row]
+            arr = np.asarray(rows, dtype=float).reshape(len(rows),
+                                                        len(header))
+            cols: dict = {}
+            for j, name in enumerate(header):
+                base = name.split("[", 1)[0]
+                cols.setdefault(base, []).append(j)
+            data = {base: arr[:, idx] for base, idx in cols.items()}
+            if "iterations" in data:
+                data["iterations"] = data["iterations"][:, 0]
+    if "theta" not in data or "w" not in data:
+        raise ValueError(
+            f"warm-start dataset needs at least 'theta' and 'w' arrays, "
+            f"got {sorted(data)}")
+    n = len(data["theta"])
+    for k in ("y", "z", "lam"):
+        data.setdefault(k, np.zeros((n, 0)))
+    data.setdefault("iterations", np.zeros((n,)))
+    return data
+
+
+def fit_warmstart(data, fingerprint: str, dt: float = 1.0,
+                  aliases: Sequence[str] = (),
+                  trainer: Optional[ANNTrainerCore] = None,
+                  val_share: float = 0.15, seed: int = 0,
+                  trainer_config: Optional[dict] = None):
+    """Train a learned warm-start predictor from a journal-tape replay.
+
+    ``data`` is whatever :func:`load_warmstart_dataset` accepts — the
+    dataset-CLI artifact, never a live hook into the serving loop. One
+    MLP maps the flattened parameter vector to the concatenation of the
+    accepted solution heads (``w`` | ``y`` | ``z`` | ``lam``, canonical
+    order); heads whose tape columns are empty are omitted from the
+    document. ``fingerprint`` stamps the artifact with the structural
+    fingerprint digest of the problem class the tape came from —
+    :func:`agentlib_mpc_tpu.ml.warmstart.build_warmstart` refuses any
+    other structure.
+    """
+    from agentlib_mpc_tpu.ml.serialized import (
+        WARMSTART_HEADS,
+        SerializedWarmstart,
+    )
+
+    if not fingerprint:
+        raise ValueError("fit_warmstart requires the problem-class "
+                         "fingerprint digest to stamp the artifact")
+    data = load_warmstart_dataset(data)
+    X = np.asarray(data["theta"], dtype=float)
+    heads = {}
+    targets = []
+    for h in WARMSTART_HEADS:
+        arr = np.asarray(data.get(h, np.zeros((len(X), 0))), dtype=float)
+        arr = arr.reshape(len(X), -1)
+        if arr.shape[1]:
+            heads[h] = int(arr.shape[1])
+            targets.append(arr)
+    if not targets:
+        raise ValueError("warm-start dataset carries no target columns")
+    Y = np.concatenate(targets, axis=1)
+    n = len(X)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_val = int(round(val_share * n))
+    i_val, i_tr = perm[:n_val], perm[n_val:]
+    if trainer is None:
+        # trainer_config keys that name ANNTrainerCore fields configure
+        # the trainer; the rest are free-form provenance metadata that
+        # ride in the artifact stamp below
+        known = {f.name for f in dataclasses.fields(ANNTrainerCore)}
+        trainer = ANNTrainerCore(**{
+            "seed": seed,
+            **{k: v for k, v in (trainer_config or {}).items()
+               if k in known}})
+    weights, biases, acts = trainer.fit(
+        X[i_tr], Y[i_tr],
+        X[i_val] if n_val else None, Y[i_val] if n_val else None)
+    cfg = dict(trainer_config or {})
+    cfg.setdefault("rows", int(n))
+    cfg.setdefault("mean_tape_iterations",
+                   float(np.mean(data["iterations"])) if n else 0.0)
+    return SerializedWarmstart(
+        dt=dt, trainer_config=cfg,
+        fingerprint=str(fingerprint), n_theta=int(X.shape[1]),
+        heads=heads, aliases=list(aliases),
         weights=[w.tolist() for w in weights],
         biases=[b.tolist() for b in biases],
         activations=acts)

@@ -1,0 +1,415 @@
+"""Learned solver warm starts: fingerprint-keyed initial-point prediction.
+
+PERF.md rounds 3-4 established that iteration count is the second big
+lever besides per-iteration cost. This module turns the ``ml/`` surrogate
+stack inward: instead of predicting the plant, a small jax-native MLP
+predicts the *solver's own* primal/dual initial point
+``theta -> (w0, y0, z0[, lam0])``, evaluated **inside** the jit graph, so
+cold starts (tenant joins, fleet boots, probation readmissions) begin
+near the solution instead of at the generic transcription guess.
+
+Three invariants make this safe enough for the serving plane:
+
+* **Fingerprint stamping** — a trained artifact records the structural
+  fingerprint digest (PR 7 ``lint.jaxpr.structural_fingerprint``) of the
+  problem class it was trained for. :func:`build_warmstart` REFUSES a
+  drifted digest (:class:`WarmstartDriftError`); the caller falls back
+  to the plain start. One artifact serves every tenant in a bucket —
+  the bucket key *is* the fingerprint.
+* **In-graph quality gate** — the predicted point's KKT-style residual
+  is compared against the plain cold start's at trace level; a worse
+  (or non-finite) prediction is ``jnp.where``-rejected in favor of the
+  plain start and counted
+  (``SolverStats.init_point_source = predicted_rejected``,
+  ``solver_warmstart_rejections_total``). A poisoned or stale model can
+  therefore degrade latency, never actuation.
+* **Data from the tape only** — training rows are a replay of the
+  flight-recorder journal (``warmstart.tape`` events extracted by
+  ``python -m agentlib_mpc_tpu.telemetry --dataset``), never a live
+  hook into the serving loop (``ml/training.fit_warmstart``).
+
+The predictor weights ride the traced argument list of whatever splice
+uses them (slot resets, fleet cold starts), so installing, poisoning or
+disabling a predictor is DATA — zero retraces, pinned by the
+``[serving.warmstart]`` budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+
+from agentlib_mpc_tpu.ml.serialized import (
+    WARMSTART_HEADS,
+    SerializedWarmstart,
+)
+
+#: default acceptance factor of the in-graph quality gate: the predicted
+#: point must not be worse than ``gate_factor`` x the plain start's
+#: KKT-style residual (1.0 = "at least as good as what we had")
+DEFAULT_GATE_FACTOR = 1.0
+
+#: the generic inequality-dual cold start the gate falls back to —
+#: matches the fleet/slot plain resets (``FusedADMM.init_state``)
+Z_COLD = 0.1
+
+
+class WarmstartDriftError(ValueError):
+    """A warm-start artifact was offered to a problem class whose
+    structural fingerprint differs from the one it was trained for.
+    Matching array dimensions do not make two problems interchangeable —
+    the caller must fall back to the plain start."""
+
+
+def flatten_theta(theta) -> "Any":
+    """One flat feature vector from an (unbatched) OCP parameter pytree.
+
+    Leaf order is ``jax.tree.leaves`` order — deterministic for a fixed
+    pytree structure, which the structural fingerprint already pins.
+    The same layout is used by the journal tape rows, the dataset CLI
+    and the predictor input, so the three can never disagree.
+
+    Non-finite entries are zeroed: parameter trees carry ±inf
+    unbounded-bound sentinels that are structural (identical for every
+    tenant of the class, so zero information) and would poison both
+    the trainer's standardization and the in-graph matmul
+    (``inf * 0 = nan`` would NaN the prediction and force the gate to
+    reject every point).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(theta)
+    flat = jnp.concatenate(
+        [jnp.ravel(jnp.asarray(leaf, dtype=float)) for leaf in leaves])
+    return jnp.where(jnp.isfinite(flat), flat, 0.0)
+
+
+def theta_flat_size(ocp) -> int:
+    """Flattened parameter-vector length of one agent of ``ocp``."""
+    import jax
+
+    theta = ocp.default_params()
+    # np.prod(()) == 1.0, so scalars count 1 and zero-size leaves 0
+    return sum(int(np.prod(np.shape(leaf)))
+               for leaf in jax.tree.leaves(theta))
+
+
+class WarmstartBundle(NamedTuple):
+    """A revived warm-start predictor, ready to sit inside a trace.
+
+    ``apply(params, theta_flat) -> (n_out,)`` is the pure MLP forward;
+    ``params`` is the swappable weight pytree (same shapes = no
+    recompile, the hot-swap/poison/restore seam); ``heads`` maps head
+    name -> (offset, length) into the output vector.
+    """
+
+    apply: Callable[[Any, Any], Any]
+    params: Any
+    heads: "dict[str, tuple]"
+    n_theta: int
+    fingerprint: str
+    aliases: tuple
+    model: SerializedWarmstart
+
+
+def build_warmstart(model: SerializedWarmstart,
+                    ocp=None,
+                    fingerprint: "str | None" = None) -> WarmstartBundle:
+    """Build the traced evaluator for a serialized warm-start document.
+
+    ``ocp`` (or an explicit ``fingerprint`` digest) identifies the
+    problem class the caller wants to warm-start; a mismatch against
+    the document's training stamp raises :class:`WarmstartDriftError`
+    (drift = refuse, fall back to plain). With ``ocp`` given the head
+    lengths are cross-checked against the transcription too.
+    """
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ml.predictors import _ACT
+
+    if not isinstance(model, SerializedWarmstart):
+        raise TypeError(f"expected SerializedWarmstart, got "
+                        f"{type(model).__name__}")
+    if not model.fingerprint:
+        raise WarmstartDriftError(
+            "warm-start document carries no fingerprint stamp — refusing "
+            "to serve an unstamped predictor")
+    want = fingerprint
+    if want is None and ocp is not None:
+        from agentlib_mpc_tpu.serving.fingerprint import tenant_fingerprint
+
+        want = tenant_fingerprint(ocp).digest
+    if want is not None and str(want) != str(model.fingerprint):
+        raise WarmstartDriftError(
+            f"warm-start artifact was trained for fingerprint "
+            f"{model.fingerprint} but the problem class here is {want} "
+            f"— structural drift, falling back to plain starts")
+    if ocp is not None:
+        expect = {"w": int(ocp.n_w), "y": int(ocp.n_g), "z": int(ocp.n_h)}
+        for head, (_off, n) in model.head_slices().items():
+            if head in expect and n != expect[head]:
+                raise WarmstartDriftError(
+                    f"warm-start head {head!r} has length {n}, problem "
+                    f"class needs {expect[head]}")
+        n_theta = theta_flat_size(ocp)
+        if int(model.n_theta) != n_theta:
+            raise WarmstartDriftError(
+                f"warm-start input length {model.n_theta} != flattened "
+                f"theta length {n_theta}")
+
+    params = {
+        "W": [jnp.asarray(np.asarray(w, dtype=float))
+              for w in model.weights],
+        "b": [jnp.asarray(np.asarray(b, dtype=float))
+              for b in model.biases],
+    }
+    acts = tuple(model.activations)
+
+    def apply(p, x):
+        h = x
+        for W, b, a in zip(p["W"], p["b"], acts):
+            h = _ACT[a](h @ W + b)
+        return jnp.atleast_1d(h)
+
+    return WarmstartBundle(
+        apply=apply, params=params, heads=model.head_slices(),
+        n_theta=int(model.n_theta), fingerprint=str(model.fingerprint),
+        aliases=tuple(model.aliases), model=model)
+
+
+def _kkt_merit(nlp, w, theta, y, z):
+    """Scalar KKT-style residual at an arbitrary point: relative
+    stationarity + primal infeasibility — cheap (one gradient, two
+    vjps) and monotone in 'how far from a KKT point is this'.
+
+    The stationarity norm is divided by the magnitude of the largest
+    term composing the Lagrangian gradient at that point,
+    ``max(1, |∇f|, |J_gᵀy|, |J_hᵀz|)``. The raw norm is useless for
+    comparing two arbitrary points: when the true multipliers are
+    large (badly scaled constraints — e.g. dynamics in Watts against
+    states in Kelvin), a start within 0.1%% of the exact duals still
+    carries a raw residual of thousands, while a primal point in a
+    flat region of the cost with zero duals scores near zero despite
+    being far from optimal. Normalizing by the constituent terms makes
+    the test invariant to constraint/dual scaling (the same reason
+    SNOPT tests relative KKT error); it also subsumes IPOPT's s_d
+    dual-magnitude scaling, so large predicted multipliers cannot win
+    the comparison by deflating their own stationarity norm."""
+    import jax
+    import jax.numpy as jnp
+
+    def _mx(a):
+        return jnp.max(jnp.abs(a)) if a.size else jnp.zeros(())
+
+    gf = jax.grad(nlp.f)(w, theta)
+    gv = nlp.g(w, theta)
+    hv = nlp.h(w, theta)
+    grad_l = gf
+    denom = jnp.maximum(1.0, _mx(gf))
+    if y.size:
+        _, vjp_g = jax.vjp(lambda ww: nlp.g(ww, theta), w)
+        jty = vjp_g(y)[0]
+        grad_l = grad_l + jty
+        denom = jnp.maximum(denom, _mx(jty))
+    if z.size:
+        _, vjp_h = jax.vjp(lambda ww: nlp.h(ww, theta), w)
+        jtz = vjp_h(z)[0]
+        grad_l = grad_l - jtz
+        denom = jnp.maximum(denom, _mx(jtz))
+    viol = jnp.zeros(())
+    if gv.size:
+        viol = jnp.maximum(viol, jnp.max(jnp.abs(gv)))
+    if hv.size:
+        viol = jnp.maximum(viol, jnp.max(jnp.maximum(-hv, 0.0)))
+    return _mx(grad_l) / denom + viol
+
+
+def make_gated_init(ocp, bundle: WarmstartBundle,
+                    gate_factor: float = DEFAULT_GATE_FACTOR):
+    """The in-graph gated initial point for one agent of ``ocp``.
+
+    Returns ``gated_init(params, enable, theta_row) ->
+    (w0, y0, z0, lam0, src)`` — a pure traced function:
+
+    * ``params`` — the bundle's weight pytree (traced, hot-swappable),
+    * ``enable`` — traced scalar bool; False = plain start, src=0
+      (flipping the predictor on/off is DATA, zero retraces),
+    * ``src`` — int32 :data:`~agentlib_mpc_tpu.ops.solver.
+      INIT_POINT_SOURCES` code (0 plain / 1 predicted / 2 rejected).
+
+    The quality gate compares the predicted point's KKT residual
+    against the plain start's (``initial_guess``, zero duals); worse or
+    non-finite => every output ``jnp.where``-falls back to the plain
+    start. ``lam0`` is the raw (n_aliases*T,) ADMM multiplier head
+    (zeros when absent or rejected) for fleet cold starts.
+    """
+    import jax.numpy as jnp
+
+    heads = bundle.heads
+    n_w, n_g, n_h = int(ocp.n_w), int(ocp.n_g), int(ocp.n_h)
+    n_lam = heads.get("lam", (0, 0))[1]
+    factor = float(gate_factor)
+
+    def _head(out, name, n):
+        if name in heads:
+            off, ln = heads[name]
+            return out[off:off + ln]
+        return jnp.zeros((n,))
+
+    def gated_init(params, enable, theta_row):
+        w_plain = ocp.initial_guess(theta_row)
+        out = bundle.apply(params, flatten_theta(theta_row))
+        w_pred = _head(out, "w", n_w)
+        y_pred = _head(out, "y", n_g)
+        z_pred = jnp.clip(_head(out, "z", n_h), 1e-6, 1e4) \
+            if ("z" in heads and n_h) else jnp.full((n_h,), Z_COLD)
+        lam_pred = _head(out, "lam", n_lam)
+        err_pred = _kkt_merit(ocp.nlp, w_pred, theta_row, y_pred, z_pred)
+        # score the fallback at the point it actually starts from:
+        # zero equality duals, Z_COLD bound duals (same as plain_init)
+        err_plain = _kkt_merit(ocp.nlp, w_plain, theta_row,
+                               jnp.zeros((n_g,)), jnp.full((n_h,), Z_COLD))
+        enabled = jnp.asarray(enable, bool)
+        # NaN err_pred compares False -> rejected; the <= keeps an
+        # equally-good prediction (its duals still help)
+        accept = enabled & (err_pred <= factor * err_plain)
+        w0 = jnp.where(accept, w_pred, w_plain)
+        y0 = jnp.where(accept, y_pred, jnp.zeros((n_g,)))
+        z0 = jnp.where(accept, z_pred, jnp.full((n_h,), Z_COLD))
+        lam0 = jnp.where(accept, lam_pred, jnp.zeros((n_lam,)))
+        src = jnp.where(enabled, jnp.where(accept, 1, 2), 0)
+        return w0, y0, z0, lam0, src.astype(jnp.int32)
+
+    return gated_init
+
+
+def plain_init(ocp):
+    """The generic fresh start as an ``initial_point_fn`` — the same
+    signature :func:`make_gated_init` produces, so predicted and plain
+    starts share ONE splice executable (``params`` is an empty pytree,
+    ``enable`` is ignored, src is always 0)."""
+    import jax.numpy as jnp
+
+    n_g, n_h = int(ocp.n_g), int(ocp.n_h)
+
+    def init(params, enable, theta_row):
+        del params, enable
+        w0 = ocp.initial_guess(theta_row)
+        return (w0, jnp.zeros((n_g,)), jnp.full((n_h,), Z_COLD),
+                jnp.zeros((0,)), jnp.zeros((), jnp.int32))
+
+    return init
+
+
+# -- artifact persistence beside the engine blob ------------------------------
+
+def warmstart_artifact_path(store, fingerprint: str) -> str:
+    """Path of the warm-start document for a problem-class fingerprint
+    under an :class:`~agentlib_mpc_tpu.serving.store.EngineStore` root.
+    Keyed by the FINGERPRINT digest (not the full engine key): one
+    trained artifact serves every capacity/options variant of the same
+    structure."""
+    import os
+
+    return os.path.join(store.root, f"{fingerprint}.warmstart.json")
+
+
+def save_warmstart(store, model: SerializedWarmstart) -> str:
+    """Persist a warm-start document beside the engine blobs (atomic
+    tmp+rename, like the store's own writes). Returns the path."""
+    import os
+
+    if not model.fingerprint:
+        raise WarmstartDriftError(
+            "refusing to store an unstamped warm-start document")
+    path = warmstart_artifact_path(store, model.fingerprint)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(model.to_json())
+    os.replace(tmp, path)
+    return path
+
+
+def load_warmstart(store, fingerprint: str) -> "SerializedWarmstart | None":
+    """Revive the warm-start document stamped for ``fingerprint``;
+    None when absent or unreadable (both mean 'plain starts')."""
+    import os
+
+    from agentlib_mpc_tpu.ml.serialized import SerializedMLModel
+
+    path = warmstart_artifact_path(store, fingerprint)
+    if not os.path.isfile(path):
+        return None
+    try:
+        model = SerializedMLModel.load(path)
+    except (OSError, ValueError, KeyError):
+        return None
+    if not isinstance(model, SerializedWarmstart):
+        return None
+    return model
+
+
+# -- provenance accounting ----------------------------------------------------
+
+def summarize_init_sources(sources) -> "dict[str, int]":
+    """Tally per-lane ``init_point_source`` codes (arrays / None mix) into
+    ``{"plain": n, "predicted": n, "predicted_rejected": n}``. None
+    entries (groups without a predictor) are not counted — the caller
+    knows those lanes are plain by construction."""
+    from agentlib_mpc_tpu.ops.solver import INIT_POINT_SOURCES
+
+    counts = {name: 0 for name in INIT_POINT_SOURCES}
+    for src in sources:
+        if src is None:
+            continue
+        flat = np.asarray(src).reshape(-1)
+        for code in flat:
+            code = int(code)
+            if 0 <= code < len(INIT_POINT_SOURCES):
+                counts[INIT_POINT_SOURCES[code]] += 1
+    return counts
+
+
+def record_init_sources(sources, scope: str, names=None) -> "dict[str, int]":
+    """Host-side bookkeeping for a cold-start prediction pass: increments
+    the warm-start counters and journals a ``warmstart.init`` event.
+    Never called from inside a jit trace."""
+    from agentlib_mpc_tpu import telemetry
+
+    counts = summarize_init_sources(sources)
+    src_counter = telemetry.counter(
+        "solver_warmstart_init_total",
+        "Cold-start initial points by provenance")
+    for name, n in counts.items():
+        if n:
+            src_counter.inc(n, scope=scope, init_point_source=name)
+    if counts["predicted_rejected"]:
+        telemetry.counter(
+            "solver_warmstart_rejections_total",
+            "Predicted initial points rejected by the in-graph "
+            "quality gate").inc(counts["predicted_rejected"], scope=scope)
+    telemetry.journal_event(
+        "warmstart.init", scope=scope,
+        groups=list(names) if names is not None else None, **counts)
+    return counts
+
+
+__all__ = [
+    "DEFAULT_GATE_FACTOR",
+    "WARMSTART_HEADS",
+    "WarmstartBundle",
+    "WarmstartDriftError",
+    "Z_COLD",
+    "build_warmstart",
+    "flatten_theta",
+    "load_warmstart",
+    "make_gated_init",
+    "plain_init",
+    "record_init_sources",
+    "save_warmstart",
+    "summarize_init_sources",
+    "theta_flat_size",
+    "warmstart_artifact_path",
+]

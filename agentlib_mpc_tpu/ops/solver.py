@@ -277,6 +277,21 @@ def jac_path_name(code) -> "str | None":
     return _path_name(code, JAC_PATHS)
 
 
+#: initial-point provenance codes carried in ``SolverStats.
+#: init_point_source``. Unlike the trace-time path codes these are
+#: **data-dependent** (the in-graph warm-start quality gate selects per
+#: solve), so every lane of a batched stats object may differ:
+#: 0 = plain cold start, 1 = learned prediction accepted, 2 = learned
+#: prediction REJECTED by the KKT-residual gate (plain start ran).
+INIT_POINT_SOURCES = ("plain", "predicted", "predicted_rejected")
+
+
+def init_point_source_name(code) -> "str | None":
+    """Human-readable provenance from one (scalar) ``init_point_source``
+    value; None for -1/legacy stats (callers label those "plain")."""
+    return _path_name(code, INIT_POINT_SOURCES)
+
+
 class SolverStats(NamedTuple):
     iterations: jnp.ndarray
     kkt_error: jnp.ndarray
@@ -290,6 +305,12 @@ class SolverStats(NamedTuple):
     #: index into :data:`JAC_PATHS` of the derivative pipeline that ran
     #: (trace-time constant; -1 = unknown/legacy constructor)
     jac_path: "jnp.ndarray | int" = -1
+    #: index into :data:`INIT_POINT_SOURCES` — where this solve's initial
+    #: point came from. Data-dependent (the warm-start gate's jnp.where
+    #: selects per solve), NOT a trace-time constant; -1 = unlabeled
+    #: (callers that never gate a prediction leave the default, which
+    #: telemetry records as "plain")
+    init_point_source: "jnp.ndarray | int" = -1
 
 
 class SolverResult(NamedTuple):
@@ -330,6 +351,19 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
         jac_counter = telemetry.counter(
             "solver_jacobian_path_solves_total",
             "solves by derivative pipeline (dense / sparse)")
+    # initial-point provenance is data-dependent per lane (the in-graph
+    # warm-start gate selects per solve), so it is decoded per index —
+    # not once per batch like the trace-time path codes
+    src_codes = np.atleast_1d(np.asarray(
+        getattr(stats, "init_point_source", -1))).reshape(-1)
+    src_counter = telemetry.counter(
+        "solver_init_point_source_solves_total",
+        "solves by initial-point provenance "
+        "(plain / predicted / predicted_rejected)")
+    rej_counter = telemetry.counter(
+        "solver_warmstart_rejections_total",
+        "learned warm-start predictions rejected by the in-graph "
+        "KKT-residual quality gate (plain start ran instead)")
     for i in range(iters.shape[0]):
         m["solves"].inc(**labels)
         m["iterations"].observe(float(iters[i]), **labels)
@@ -339,6 +373,12 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
             path_counter.inc(kkt_path=path, **labels)
         if jpath is not None:
             jac_counter.inc(jac_path=jpath, **labels)
+        src = init_point_source_name(
+            src_codes[i] if src_codes.size == iters.shape[0]
+            else src_codes[0]) or "plain"
+        src_counter.inc(init_point_source=src, **labels)
+        if src == "predicted_rejected":
+            rej_counter.inc(**labels)
     m["kkt_error"].set(float(np.max(kkt)), **labels)
 
 

@@ -272,7 +272,8 @@ class FusedADMM:
                  watchdog_timeout_s: "float | None" = None,
                  collective_certify: str = "auto",
                  memory_certify: str = "auto",
-                 dispatch_certify: str = "auto"):
+                 dispatch_certify: str = "auto",
+                 warmstart=None):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
         run the dense math but never influence consensus results. The
@@ -363,7 +364,22 @@ class FusedADMM:
         (``fusion="off"``): identical collective-schedule digest, and a
         memory certificate within the
         :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`'s
-        projected peak-HBM bound — REFUSING to build otherwise."""
+        projected peak-HBM bound — REFUSING to build otherwise.
+        ``warmstart``: an optional learned warm-start predictor — a
+        :class:`~agentlib_mpc_tpu.ml.serialized.SerializedWarmstart`
+        document or a prebuilt
+        :class:`~agentlib_mpc_tpu.ml.warmstart.WarmstartBundle`.
+        :meth:`init_state` then seeds the COLD start (primal ``w``,
+        duals ``y``/``z``, and the ADMM ``lam`` rows when the document
+        carries that head) from the in-graph gated prediction instead
+        of the generic transcription guess; per-lane acceptance rides
+        ``self.last_init_sources``. The document's fingerprint stamp
+        must match a group's structural fingerprint — non-matching
+        groups keep plain starts; no group matching raises
+        :class:`~agentlib_mpc_tpu.ml.warmstart.WarmstartDriftError`.
+        The warm step's trace is untouched (the predictor only ever
+        runs at cold starts), and the predictor can be disabled per
+        call (``init_state(..., warmstart_enabled=False)``) as DATA."""
         # the consensus/exchange augmentation is quadratic per stage, so a
         # group's KKT system keeps its OCP's stage-banded structure inside
         # ADMM — attach each group's TranscribedOCP.stage_partition to its
@@ -468,7 +484,42 @@ class FusedADMM:
         self.shard_report = None
         self._watchdog_reader = None
         self._collective_probe = None
+        #: the learned warm-start bundle (None = plain cold starts) and
+        #: its per-group gated-init closures; ``last_init_sources`` is
+        #: the most recent cold start's per-lane provenance (one int32
+        #: array per group, INIT_POINT_SOURCES codes, None for groups
+        #: without a predictor)
+        self.warmstart = None
+        self.warmstart_enabled = True
+        self.last_init_sources: "tuple | None" = None
+        self._warmstart_inits: "dict[int, Any]" = {}
+        if warmstart is not None:
+            self._install_warmstart(warmstart)
         self._compile_step()
+
+    def _install_warmstart(self, warmstart) -> None:
+        """Resolve a warm-start document/bundle against the groups;
+        fingerprint-matching groups get a gated-init closure."""
+        from agentlib_mpc_tpu.ml import warmstart as ws_mod
+        from agentlib_mpc_tpu.serving.fingerprint import tenant_fingerprint
+
+        bundle = warmstart
+        if not isinstance(bundle, ws_mod.WarmstartBundle):
+            bundle = ws_mod.build_warmstart(
+                bundle, fingerprint=warmstart.fingerprint)
+        for gi, g in enumerate(self.groups):
+            if tenant_fingerprint(g.ocp).digest != bundle.fingerprint:
+                continue
+            # re-validate head lengths against THIS transcription
+            checked = ws_mod.build_warmstart(bundle.model, ocp=g.ocp)
+            self._warmstart_inits[gi] = jax.jit(jax.vmap(
+                ws_mod.make_gated_init(g.ocp, checked),
+                in_axes=(None, None, 0)))
+        if not self._warmstart_inits:
+            raise ws_mod.WarmstartDriftError(
+                f"warm-start artifact (fingerprint {bundle.fingerprint}) "
+                f"matches none of this engine's group structures")
+        self.warmstart = bundle
 
     def _compile_step(self) -> None:
         """(Re)build the compiled step for the current groups — plain
@@ -988,10 +1039,18 @@ class FusedADMM:
 
     # -- state ----------------------------------------------------------------
 
-    def init_state(self, theta_batches: Sequence[OCPParams]) -> FusedState:
+    def init_state(self, theta_batches: Sequence[OCPParams],
+                   warmstart_enabled: "bool | None" = None) -> FusedState:
         """Fresh global state: means from the default control values, zero
         multipliers (the reference seeds means from initial guesses during
-        registration, ``admm_coordinator.py:528-654``)."""
+        registration, ``admm_coordinator.py:528-654``).
+
+        With a learned warm-start installed (engine ``warmstart=``), the
+        cold primal/dual starts — and the ADMM ``lam`` rows when the
+        document carries that head — come from the in-graph gated
+        prediction instead; rejected lanes keep the plain start.
+        ``warmstart_enabled`` overrides ``self.warmstart_enabled`` for
+        this call (a traced-data flip, never a retrace)."""
         zbar, lam = {}, {}
         ex_mean, ex_diff, ex_lam = {}, {}, {}
         for alias in self._aliases:
@@ -1016,6 +1075,25 @@ class FusedADMM:
         fdtype = jnp.zeros(()).dtype
         z = tuple(jnp.full((g.n_agents, g.ocp.n_h), 0.1, dtype=fdtype)
                   for g in self.groups)
+        lam_pred: dict = {}
+        if self._warmstart_inits:
+            enabled = (self.warmstart_enabled if warmstart_enabled is None
+                       else bool(warmstart_enabled))
+            w, y, z, lam_pred = self._predicted_cold_start(
+                theta_batches, w, y, z, enabled, fdtype)
+        else:
+            self.last_init_sources = None
+        if lam_pred:
+            # splice the gated lam rows into the per-alias tuples (slot
+            # order = participating-group order)
+            for alias in self._aliases:
+                rows = list(lam[alias])
+                for gi, _c, slot in self._group_participations(
+                        alias, "consensus"):
+                    row = lam_pred.get(gi, {}).get(alias)
+                    if row is not None:
+                        rows[slot] = row
+                lam[alias] = tuple(rows)
         rho_opt = self.options.rho
         if isinstance(rho_opt, dict):
             missing = {*self._aliases, *self._ex_aliases} - set(rho_opt)
@@ -1030,6 +1108,40 @@ class FusedADMM:
         return FusedState(zbar=zbar, lam=lam, ex_mean=ex_mean,
                           ex_diff=ex_diff, ex_lam=ex_lam,
                           rho=rho, w=w, y=y, z=z)
+
+    def _predicted_cold_start(self, theta_batches, w, y, z,
+                              enabled: bool, fdtype):
+        """Replace matching groups' plain cold starts with the in-graph
+        gated prediction; returns (w, y, z, lam_pred) and records the
+        per-lane provenance (``self.last_init_sources`` + telemetry)."""
+        from agentlib_mpc_tpu.ml import warmstart as ws_mod
+
+        w, y, z = list(w), list(y), list(z)
+        sources: list = []
+        lam_pred: dict = {}
+        aliases = self.warmstart.aliases
+        for gi, g in enumerate(self.groups):
+            init = self._warmstart_inits.get(gi)
+            if init is None:
+                sources.append(None)
+                continue
+            w_g, y_g, z_g, lam_g, src = init(
+                self.warmstart.params, enabled, theta_batches[gi])
+            w[gi] = w_g.astype(w[gi].dtype)
+            y[gi] = y_g.astype(fdtype)
+            z[gi] = z_g.astype(fdtype)
+            sources.append(src)
+            if aliases and lam_g.shape[-1]:
+                lam_rows = lam_g.reshape(g.n_agents, len(aliases), self.T)
+                lam_pred[gi] = {
+                    alias: lam_rows[:, ai, :].astype(fdtype)
+                    for ai, alias in enumerate(aliases)
+                    if alias in g.couplings}
+        self.last_init_sources = tuple(sources)
+        ws_mod.record_init_sources(
+            sources, scope="fused_admm",
+            names=[g.name for g in self.groups])
+        return tuple(w), tuple(y), tuple(z), lam_pred
 
     def shift_state(self, state: FusedState) -> FusedState:
         """Shift-by-one warm start between control steps

@@ -48,6 +48,7 @@ from agentlib_mpc_tpu.resilience.chaos import (
     ServeNaNStormRule,
     ServeStallRule,
     SolverRule,
+    WarmstartPoisonRule,
     corrupt_checkpoint,
     disturbance_model,
     install_chaos,
@@ -60,6 +61,7 @@ __all__ = [
     "ChaosConfig", "ChaosController", "BrokerRule", "SolverRule",
     "AdmmDeathRule", "install_chaos",
     "ServeChaosConfig", "ServeNaNStormRule", "ServeStallRule",
-    "ServeBuildFailRule", "ChaosBuildError", "install_serving_chaos",
+    "ServeBuildFailRule", "WarmstartPoisonRule", "ChaosBuildError",
+    "install_serving_chaos",
     "corrupt_checkpoint", "disturbance_model",
 ]

@@ -440,16 +440,44 @@ class ServeBuildFailRule:
 
 
 @dataclasses.dataclass(frozen=True)
+class WarmstartPoisonRule:
+    """Corrupt the learned warm-start predictor's weights (ISSUE 19):
+    every bucket carrying a predictor gets its host-side parameter
+    pytree swapped for an all-NaN copy of the same structure inside the
+    window — no retrace, the shapes and dtypes are identical. A NaN
+    prediction has infinite KKT merit, so the in-graph quality gate
+    must select the plain start for every admission in the window
+    (``init_point_source="predicted_rejected"``); a sick predictor
+    degrades latency, never actuation. The lift restores the weights
+    and re-arms any bucket the rejection-streak breaker disabled."""
+
+    tenant: str = "*"
+    start_round: int = 0
+    n_rounds: Optional[int] = None   # None = open-ended
+
+    def matches(self, tenant_id: str) -> bool:
+        return self.tenant in ("*", tenant_id)
+
+    def triggered(self, round_: int) -> bool:
+        if round_ < self.start_round:
+            return False
+        return self.n_rounds is None or \
+            round_ < self.start_round + self.n_rounds
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeChaosConfig:
     seed: int = 0
     nan_storm: tuple = ()
     stall: tuple = ()
     build_fail: tuple = ()
     overload: tuple = ()
+    warmstart_poison: tuple = ()
 
     @classmethod
     def from_dict(cls, cfg: dict) -> "ServeChaosConfig":
-        known = {"seed", "nan_storm", "stall", "build_fail", "overload"}
+        known = {"seed", "nan_storm", "stall", "build_fail", "overload",
+                 "warmstart_poison"}
         unknown = set(cfg) - known
         if unknown:
             raise ValueError(
@@ -472,6 +500,10 @@ class ServeChaosConfig:
                 r if isinstance(r, ServeOverloadRule)
                 else ServeOverloadRule(**r)
                 for r in cfg.get("overload", ())),
+            warmstart_poison=tuple(
+                r if isinstance(r, WarmstartPoisonRule)
+                else WarmstartPoisonRule(**r)
+                for r in cfg.get("warmstart_poison", ())),
         )
 
 
@@ -599,6 +631,72 @@ def install_serving_chaos(plane, config: "ServeChaosConfig | dict",
         controller._restores.append(
             lambda d=dispatcher, o=orig_mat: setattr(
                 d, "_materialize", o))
+
+    if config.warmstart_poison:
+        import jax
+        import jax.numpy as jnp
+
+        # bucket id -> (key, bucket, original params, enabled flag)
+        poisoned: dict = {}
+
+        def _sync_poison(r: int) -> None:
+            active = any(x.triggered(r)
+                         for x in config.warmstart_poison)
+            if active:
+                fresh = 0
+                for key, bucket in plane._buckets.items():
+                    if id(bucket) in poisoned or \
+                            getattr(bucket, "warmstart_bundle",
+                                    None) is None:
+                        continue
+                    poisoned[id(bucket)] = (
+                        key, bucket, bucket.ws_params,
+                        bool(bucket.warmstart_enabled))
+                    # same pytree structure / shapes / dtypes — the
+                    # swap never retraces, the gate does the rejecting
+                    bucket.ws_params = jax.tree.map(
+                        lambda leaf: jnp.full_like(leaf, jnp.nan),
+                        bucket.ws_params)
+                    fresh += 1
+                if fresh:
+                    controller.note("warmstart_poison", f"round{r}")
+            elif poisoned:
+                for key, bucket, params, enabled in poisoned.values():
+                    bucket.ws_params = params
+                    # re-arm a bucket the rejection-streak breaker
+                    # tripped during the window — the operator's
+                    # fix-artifact-and-re-enable move
+                    if enabled and not bucket.warmstart_enabled:
+                        bucket.warmstart_enabled = True
+                        eng = getattr(bucket, "engine", None)
+                        if eng is not None and \
+                                hasattr(eng, "warmstart_enabled"):
+                            eng.warmstart_enabled = True
+                    plane._ws_reject_streak.pop(key, None)
+                poisoned.clear()
+                controller.note("warmstart_poison_lifted", f"round{r}")
+
+        orig_ws_serve = plane.serve_round
+        owns_round_counter = not (config.nan_storm or config.overload)
+
+        def ws_serve_round(*a, **kw):
+            _sync_poison(counters["round"])
+            out = orig_ws_serve(*a, **kw)
+            if owns_round_counter:
+                counters["round"] += 1
+            _sync_poison(counters["round"])
+            return out
+
+        plane.serve_round = ws_serve_round
+        _sync_poison(counters["round"])
+
+        def _restore_ws():
+            plane.serve_round = orig_ws_serve
+            for _key, bucket, params, _en in poisoned.values():
+                bucket.ws_params = params
+            poisoned.clear()
+
+        controller._restores.append(_restore_ws)
 
     if config.build_fail:
         cache = plane.cache

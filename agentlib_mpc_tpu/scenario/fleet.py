@@ -168,7 +168,8 @@ class ScenarioFleet:
                  collective_certify: str = "auto",
                  memory_certify: str = "auto",
                  dispatch_certify: str = "auto",
-                 watchdog_timeout_s: "float | None" = None):
+                 watchdog_timeout_s: "float | None" = None,
+                 warmstart=None):
         """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
         AgentGroup` (couplings only; exchanges are not scenario-lifted).
         ``tree``: the static scenario tree; ``tree.n_scenarios == 1``
@@ -190,7 +191,13 @@ class ScenarioFleet:
         ``self.shard_report`` and raises
         :class:`~agentlib_mpc_tpu.parallel.multihost.MeshRoundTimeout`
         so :class:`~agentlib_mpc_tpu.parallel.survival.
-        ScenarioFleetSupervisor` can classify the loss by axis."""
+        ScenarioFleetSupervisor` can classify the loss by axis.
+        ``warmstart``: an optional learned warm-start document
+        (:class:`~agentlib_mpc_tpu.ml.serialized.SerializedWarmstart`)
+        or prebuilt bundle — cold starts in :meth:`init_state` come
+        from the in-graph gated prediction per (agent, scenario) lane;
+        a fingerprint mismatch with the group's structure raises
+        :class:`~agentlib_mpc_tpu.ml.warmstart.WarmstartDriftError`."""
         from agentlib_mpc_tpu.parallel.fused_admm import FusedADMM
 
         if group.exchanges:
@@ -243,6 +250,14 @@ class ScenarioFleet:
         self.shard_report = None
         self._watchdog_reader = None
         self.mesh = mesh
+        #: learned warm-start bundle + most recent cold start's per-lane
+        #: provenance ((n_agents, S) int32 of INIT_POINT_SOURCES codes)
+        self.warmstart = None
+        self.warmstart_enabled = True
+        self.last_init_sources = None
+        self._warmstart_init = None
+        if warmstart is not None:
+            self._install_warmstart(warmstart)
         self._membership, self._counts = self._build_membership()
         self._compile_step()
         if telemetry.enabled():
@@ -250,6 +265,27 @@ class ScenarioFleet:
                 "scenario_count",
                 "disturbance scenarios batched per agent in the "
                 "scenario fleet").set(float(self.S))
+
+    def _install_warmstart(self, warmstart) -> None:
+        """Resolve a warm-start document/bundle against the fleet's
+        group structure; drift (fingerprint mismatch) refuses."""
+        from agentlib_mpc_tpu.ml import warmstart as ws_mod
+        from agentlib_mpc_tpu.serving.fingerprint import tenant_fingerprint
+
+        bundle = warmstart
+        if not isinstance(bundle, ws_mod.WarmstartBundle):
+            bundle = ws_mod.build_warmstart(
+                bundle, fingerprint=warmstart.fingerprint)
+        if tenant_fingerprint(self.group.ocp).digest != bundle.fingerprint:
+            raise ws_mod.WarmstartDriftError(
+                f"warm-start artifact (fingerprint {bundle.fingerprint}) "
+                f"does not match scenario group {self.group.name!r}")
+        checked = ws_mod.build_warmstart(bundle.model, ocp=self.group.ocp)
+        # agents x scenarios, like init_state's double-vmapped guess
+        self._warmstart_init = jax.jit(jax.vmap(jax.vmap(
+            ws_mod.make_gated_init(self.group.ocp, checked),
+            in_axes=(None, None, 0)), in_axes=(None, None, 0)))
+        self.warmstart = bundle
 
     # -- static layout --------------------------------------------------------
 
@@ -275,8 +311,15 @@ class ScenarioFleet:
 
     # -- state ----------------------------------------------------------------
 
-    def init_state(self, theta_batch) -> ScenarioState:
-        """Fresh state for an (n_agents, S)-leading theta batch."""
+    def init_state(self, theta_batch,
+                   warmstart_enabled: "bool | None" = None) -> ScenarioState:
+        """Fresh state for an (n_agents, S)-leading theta batch.
+
+        With a learned warm-start installed, every (agent, scenario)
+        lane's primal/dual/``lam`` cold start comes from the in-graph
+        gated prediction (rejected lanes keep the plain start);
+        ``warmstart_enabled`` overrides ``self.warmstart_enabled`` for
+        this call as traced data (no retrace on flip)."""
         g = self.group
         zbar = {a: jnp.zeros((self.S, self.T)) for a in self._aliases}
         lam = {a: jnp.zeros((g.n_agents, self.S, self.T))
@@ -286,6 +329,28 @@ class ScenarioFleet:
         w = jax.vmap(jax.vmap(g.ocp.initial_guess))(theta_batch)
         y = jnp.zeros((g.n_agents, self.S, g.ocp.n_g))
         z = jnp.full((g.n_agents, self.S, g.ocp.n_h), 0.1, dtype=fdtype)
+        if self._warmstart_init is not None:
+            from agentlib_mpc_tpu.ml import warmstart as ws_mod
+
+            enabled = (self.warmstart_enabled if warmstart_enabled is None
+                       else bool(warmstart_enabled))
+            w_p, y_p, z_p, lam_p, src = self._warmstart_init(
+                self.warmstart.params, enabled, theta_batch)
+            w = w_p.astype(w.dtype)
+            y = y_p.astype(fdtype)
+            z = z_p.astype(fdtype)
+            aliases = self.warmstart.aliases
+            if aliases and lam_p.shape[-1]:
+                rows = lam_p.reshape(
+                    g.n_agents, self.S, len(aliases), self.T)
+                for ai, alias in enumerate(aliases):
+                    if alias in lam:
+                        lam[alias] = rows[:, :, ai, :].astype(fdtype)
+            self.last_init_sources = src
+            ws_mod.record_init_sources(
+                [src], scope="scenario_fleet", names=[g.name])
+        else:
+            self.last_init_sources = None
         return ScenarioState(zbar=zbar, lam=lam, nu=nu,
                              na_target=jnp.zeros_like(nu),
                              w=w, y=y, z=z)

@@ -52,8 +52,10 @@ import time
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.ops.solver import INIT_POINT_SOURCES
 from agentlib_mpc_tpu.parallel.fused_admm import (
     AgentGroup,
     FusedADMM,
@@ -127,7 +129,9 @@ class ServingPlane:
                  hbm_bytes: "int | str | None" = "auto",
                  slo_policy: "SLOPolicy | None" = None,
                  profile_every: "int | None" = None,
-                 autopilot=None):
+                 autopilot=None,
+                 warmstart: "bool | str" = "auto",
+                 warmstart_tape: bool = False):
         #: a 1-D agent mesh (``multihost.fleet_mesh``): every bucket
         #: engine is built sharded over it (``FusedADMM(mesh=...)``) and
         #: slot capacities are rounded to the mesh-aware
@@ -288,6 +292,24 @@ class ServingPlane:
             profile_every, rounds=1, n_devices=n_dev,
             mesh_shape=None if mesh is None
             else tuple(mesh.devices.shape))
+        #: learned warm starts (ISSUE 19): "auto"/True looks up a
+        #: fingerprint-stamped warm-start document beside the engine
+        #: blobs at bucket acquisition; False never does. Documents
+        #: installed directly via :meth:`install_warmstart` are used
+        #: either way. ``warmstart_tape=True`` journals a
+        #: ``warmstart.tape`` event per served tenant per round — the
+        #: offline training set (telemetry --dataset extracts it).
+        if warmstart not in (True, False, "auto"):
+            raise ValueError(
+                f"warmstart must be True, False or 'auto', "
+                f"got {warmstart!r}")
+        self._warmstart_lookup = warmstart in (True, "auto")
+        self.warmstart_tape = bool(warmstart_tape)
+        self._warmstarts: dict = {}       # fingerprint -> document
+        self._ws_reject_streak: dict = {} # BucketKey -> consecutive
+        #: consecutive rejected predicted admissions per bucket before
+        #: the plane turns the predictor off (journal: warmstart.disabled)
+        self.warmstart_disable_streak = 3
         # events emitted between rounds (submissions, sheds, chaos
         # injections at the submit seam) belong to the UPCOMING round
         telemetry.journal_set_round(self.served_rounds)
@@ -334,6 +356,7 @@ class ServingPlane:
             # window picks it back up)
             return self._capacity_shed_join(spec, key, t0, exc)
         slot = bucket.admit(spec.tenant_id, spec.theta)
+        self._note_warmstart_admission(key, bucket, spec.tenant_id, slot)
         self._register_tenant(spec.tenant_id, key, spec)
         if telemetry.enabled():
             telemetry.serving_metrics()["active"].set(
@@ -680,12 +703,14 @@ class ServingPlane:
                     f"certifies {cert.peak_bytes} B peak per device "
                     f"against the {self.hbm_bytes} B budget "
                     f"({cert.describe()})")
+        self._attach_warmstart(key, engine)
         if scen_tree is not None:
             from agentlib_mpc_tpu.serving.slots import ScenarioSlotPlane
 
             bucket = ScenarioSlotPlane(engine, spec.ocp, spec.theta)
         else:
             bucket = SlotPlane(engine, spec.ocp, spec.theta)
+        bucket.tape_enabled = self.warmstart_tape and scen_tree is None
         if migrate_from is not None:
             self._stash_flush(key)       # deliver the old plane's round
             for tenant_id in migrate_from.tenants:
@@ -698,6 +723,158 @@ class ServingPlane:
                 capacity, len(migrate_from.tenants))
         self._buckets[key] = bucket
         return bucket, hit
+
+    # -- learned warm starts (ISSUE 19) ---------------------------------------
+
+    def _warmstart_for(self, key):
+        """The warm-start document for a bucket's structure, if any:
+        explicitly installed documents first, then the content-addressed
+        artifact beside the engine blobs (``<fingerprint>.warmstart
+        .json`` in the engine store)."""
+        doc = self._warmstarts.get(key.structure_digest)
+        if doc is not None:
+            return doc
+        if self._warmstart_lookup and self.engine_store is not None:
+            from agentlib_mpc_tpu.ml.warmstart import load_warmstart
+
+            doc = load_warmstart(self.engine_store,
+                                 key.structure_digest)
+            if doc is not None:
+                # register the revived artifact so stats()["warmstart"]
+                # ["installed"] reports it and later acquisitions skip
+                # the store read
+                self._warmstarts[doc.fingerprint] = doc
+            return doc
+        return None
+
+    def _attach_warmstart(self, key, engine) -> None:
+        """Install the bucket's warm-start document on a (fresh or
+        cache-revived) engine. Drift — the stamp not matching the
+        engine's structure — journals a refusal and serves plain
+        starts; a sick artifact must degrade latency, never a join."""
+        doc = self._warmstart_for(key)
+        if doc is None or getattr(engine, "warmstart", None) is not None:
+            return
+        from agentlib_mpc_tpu.ml.warmstart import WarmstartDriftError
+
+        try:
+            engine._install_warmstart(doc)
+            telemetry.journal_event(
+                "warmstart.installed", bucket=key.digest,
+                fingerprint=doc.fingerprint)
+        except (WarmstartDriftError, ValueError) as exc:
+            telemetry.journal_event(
+                "warmstart.refused", bucket=key.digest,
+                fingerprint=doc.fingerprint, reason=str(exc))
+            logger.warning(
+                "warm-start artifact refused for bucket %s: %s",
+                key.digest, exc)
+
+    def install_warmstart(self, model) -> int:
+        """Register a trained warm-start document (keyed by its
+        fingerprint stamp) and attach it to every live bucket of that
+        structure; future bucket acquisitions pick it up too. Persists
+        it beside the engine blobs when the plane has a store. Returns
+        the number of live buckets it attached to."""
+        from agentlib_mpc_tpu.ml.warmstart import (
+            WarmstartDriftError,
+            save_warmstart,
+        )
+
+        if not model.fingerprint:
+            raise WarmstartDriftError(
+                "refusing to install an unstamped warm-start document")
+        self._warmstarts[model.fingerprint] = model
+        if self.engine_store is not None:
+            save_warmstart(self.engine_store, model)
+        attached = 0
+        for key, bucket in self._buckets.items():
+            if key.structure_digest != model.fingerprint:
+                continue
+            engine = bucket.engine
+            engine.warmstart = None          # allow re-install
+            self._attach_warmstart(key, engine)
+            if getattr(engine, "warmstart", None) is not None:
+                bucket.refresh_warmstart()
+                attached += 1
+        return attached
+
+    def set_warmstart(self, enabled: bool) -> None:
+        """Flip the learned predictor on/off for every bucket — traced
+        data at the next admission, never a retrace."""
+        enabled = bool(enabled)
+        for key, bucket in self._buckets.items():
+            if getattr(bucket, "warmstart_bundle", None) is None:
+                continue
+            bucket.warmstart_enabled = enabled
+            bucket.engine.warmstart_enabled = enabled
+        if enabled:
+            self._ws_reject_streak.clear()
+        telemetry.journal_event("warmstart.toggled", enabled=enabled)
+
+    def _note_warmstart_admission(self, key, bucket, tenant_id: str,
+                                  slot: int) -> None:
+        """Per-admission provenance bookkeeping: journal the source and
+        walk the rejection streak — a predictor whose points keep
+        failing the in-graph quality gate is turned OFF for the bucket
+        (``warmstart.disabled``), degrading cold-start latency back to
+        plain while actuation stays untouched."""
+        if getattr(bucket, "warmstart_bundle", None) is None:
+            return
+        src = int(bucket.init_sources[slot])
+        telemetry.journal_event(
+            "warmstart.admission", tenant=tenant_id, bucket=key.digest,
+            source=INIT_POINT_SOURCES[src])
+        if src == 2 and bucket.warmstart_enabled:
+            streak = self._ws_reject_streak.get(key, 0) + 1
+            self._ws_reject_streak[key] = streak
+            if streak >= self.warmstart_disable_streak:
+                bucket.warmstart_enabled = False
+                bucket.engine.warmstart_enabled = False
+                telemetry.journal_event(
+                    "warmstart.disabled", bucket=key.digest,
+                    tenant=tenant_id, streak=streak,
+                    reason="rejection_streak")
+                logger.warning(
+                    "bucket %s: %d consecutive predicted starts "
+                    "rejected by the quality gate — predictor disabled "
+                    "(plain starts)", key.digest, streak)
+        elif src == 1:
+            self._ws_reject_streak[key] = 0
+
+    def _emit_warmstart_tape(self, key, bucket) -> None:
+        """Journal one ``warmstart.tape`` row per tenant the bucket's
+        last round served: (theta, accepted solution, iterations) —
+        the offline training set (``python -m agentlib_mpc_tpu.
+        telemetry --dataset`` extracts it; ``ml.training.
+        fit_warmstart`` consumes it). Replay-only: training never
+        hooks the live path."""
+        tape = getattr(bucket, "last_round_tape", None)
+        if tape is None:
+            return
+        bucket.last_round_tape = None
+        from agentlib_mpc_tpu.ml.warmstart import flatten_theta
+
+        state, stats = tape["state"], tape["stats"]
+        iterations = int(np.asarray(stats.iterations))
+        converged = bool(np.asarray(stats.converged))
+        aliases = sorted(getattr(bucket.engine, "_aliases", ()))
+        w = np.asarray(state.w[0])
+        y = np.asarray(state.y[0])
+        z = np.asarray(state.z[0])
+        lam = {a: np.asarray(state.lam[a][0]) for a in aliases}
+        for tenant_id, slot in tape["served"]:
+            theta_row = tree_row(tape["theta"], slot)
+            lam_row = (np.concatenate([lam[a][slot] for a in aliases])
+                       if aliases else np.zeros((0,)))
+            telemetry.journal_event(
+                "warmstart.tape", tenant=tenant_id, bucket=key.digest,
+                fingerprint=key.structure_digest,
+                theta=np.asarray(flatten_theta(theta_row)).tolist(),
+                w=w[slot].tolist(), y=y[slot].tolist(),
+                z=z[slot].tolist(), lam=lam_row.tolist(),
+                aliases=aliases, iterations=iterations,
+                converged=converged)
 
     def _options_key(self):
         """Hashable identity of the engine-level options (rho may be a
@@ -769,6 +946,7 @@ class ServingPlane:
         if bucket.free_slots == 0:
             return False
         slot = bucket.admit(tenant_id, spec.theta)
+        self._note_warmstart_admission(key, bucket, tenant_id, slot)
         del self._evicted[tenant_id]
         if self._health is not None:
             self._health.readmitted(tenant_id)
@@ -1018,6 +1196,8 @@ class ServingPlane:
                 m["rounds"].inc(bucket=key.digest)
             if res is not None:
                 results.update(self._assess_bucket(res))
+            if self.warmstart_tape:
+                self._emit_warmstart_tape(key, self._buckets[key])
         # rounds condemned by a stall in another bucket: assess as
         # failures NOW (their tenants shed into their ladders) instead
         # of leaving stale results to surface out of order at a flush
@@ -1229,6 +1409,22 @@ class ServingPlane:
                       "shed_deadline": self.queue.shed_deadline},
             "watchdog": {"stalls": self.dispatcher.stalls,
                          "sync_fallback": self.dispatcher.sync_fallback},
+            "warmstart": {
+                "installed": sorted(self._warmstarts),
+                "buckets": {
+                    key.digest: {
+                        "enabled": bool(b.warmstart_enabled),
+                        "reject_streak":
+                            self._ws_reject_streak.get(key, 0),
+                        "admissions": {
+                            name: int((b.init_sources[
+                                np.asarray(b.mask)] == code).sum())
+                            for code, name in
+                            enumerate(INIT_POINT_SOURCES)},
+                    }
+                    for key, b in self._buckets.items()
+                    if getattr(b, "warmstart_bundle", None) is not None},
+            },
             "memory": {
                 "hbm_bytes": self.hbm_bytes,
                 "certified_peak_bytes": {

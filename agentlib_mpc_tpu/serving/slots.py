@@ -56,6 +56,79 @@ def tree_row(batch, i: int):
     return jax.tree.map(lambda leaf: leaf[i], batch)
 
 
+def _make_flat_reset(init_fn, aliases: tuple, T: int):
+    """The flat slot plane's traced lane reset around one injectable
+    initial-point function (``make_gated_init``/``plain_init``
+    signature). Module-level so :meth:`SlotPlane.refresh_warmstart`
+    can rebuild it when a bundle is installed on a live bucket."""
+
+    def reset_lane(state, lane, theta_row, ws_params, ws_enable):
+        """Fresh start for a newly-admitted tenant's lane via the
+        injectable initial-point function (gated prediction or plain
+        guess, selected by traced data) — a recycled slot must not
+        leak the previous tenant's iterate."""
+        w0, y0, z0, lam0, src = init_fn(ws_params, ws_enable, theta_row)
+        w = (state.w[0].at[lane].set(w0),)
+        y = (state.y[0].at[lane].set(y0),)
+        z = (state.z[0].at[lane].set(z0),)
+        lam_rows = (lam0.reshape(len(aliases), T)
+                    if aliases and lam0.shape[0] else None)
+        lam = {}
+        for a, pieces in state.lam.items():
+            row = (lam_rows[aliases.index(a)]
+                   if lam_rows is not None and a in aliases else 0.0)
+            lam[a] = (pieces[0].at[lane].set(row),)
+        ex_diff = {a: (pieces[0].at[lane].set(0.0),)
+                   for a, pieces in state.ex_diff.items()}
+        return state._replace(w=w, y=y, z=z, lam=lam,
+                              ex_diff=ex_diff), src
+
+    return reset_lane
+
+
+def _make_scenario_reset(init_fn, aliases: tuple, T: int):
+    """The robust sibling of :func:`_make_flat_reset`: the initial
+    point vmapped over the tenant's S branches, non-anticipativity
+    multipliers zeroed."""
+
+    def reset_lane(state, lane, theta_row, ws_params, ws_enable):
+        """Fresh start for a newly-admitted robust tenant's lane: the
+        injectable initial-point function per branch, zeroed
+        non-anticipativity multipliers — a recycled slot must not leak
+        the previous tenant's iterates on any branch."""
+        w0, y0, z0, lam0, src = jax.vmap(
+            init_fn, in_axes=(None, None, 0))(
+                ws_params, ws_enable, theta_row)
+        w = state.w.at[lane].set(w0)
+        y = state.y.at[lane].set(y0)
+        z = state.z.at[lane].set(z0)
+        nu = state.nu.at[lane].set(0.0)
+        na = state.na_target.at[lane].set(0.0)
+        lam_rows = (lam0.reshape(-1, len(aliases), T)
+                    if aliases and lam0.shape[-1] else None)
+        lam = {}
+        for a, leaf in state.lam.items():
+            row = (lam_rows[:, aliases.index(a), :]
+                   if lam_rows is not None and a in aliases else 0.0)
+            lam[a] = leaf.at[lane].set(row)
+        return state._replace(w=w, y=y, z=z, nu=nu,
+                              na_target=na, lam=lam), src
+
+    return reset_lane
+
+
+def _resolve_initial_point(ocp, bundle, initial_point_fn):
+    """Default the injectable initial point from the engine's bundle:
+    gated prediction when one is attached, the plain fresh start
+    otherwise — both share the same traced signature."""
+    from agentlib_mpc_tpu.ml import warmstart as ws_mod
+
+    if initial_point_fn is not None:
+        return initial_point_fn
+    return (ws_mod.make_gated_init(ocp, bundle) if bundle is not None
+            else ws_mod.plain_init(ocp))
+
+
 class RoundHandle(NamedTuple):
     """An in-flight (possibly not yet materialized) served round."""
 
@@ -140,7 +213,8 @@ class SlotPlane(_SlotBookkeeping):
     padding lanes' parameters.
     """
 
-    def __init__(self, engine, ocp, theta0, shift_between_rounds=True):
+    def __init__(self, engine, ocp, theta0, shift_between_rounds=True,
+                 initial_point_fn=None):
         if len(engine.groups) != 1:
             raise ValueError(
                 "SlotPlane serves single-group engines (one structure "
@@ -157,6 +231,23 @@ class SlotPlane(_SlotBookkeeping):
         # pad_group_to_devices recipe: uniform dense math, masked out)
         self.theta_batch = tree_repeat(theta0, self.capacity)
         self.rounds_served = 0
+        # learned warm start (engine-attached bundle or explicit fn):
+        # predicted and plain admissions share ONE splice executable —
+        # the initial point is a traced function of (params, enable,
+        # theta_row), so poisoning the params or flipping the predictor
+        # off is data, never a retrace
+        bundle = getattr(engine, "warmstart", None)
+        custom_fn = initial_point_fn is not None
+        initial_point_fn = _resolve_initial_point(ocp, bundle,
+                                                  initial_point_fn)
+        self.warmstart_bundle = bundle
+        self.ws_params = bundle.params if bundle is not None else None
+        self.warmstart_enabled = True
+        #: per-slot INIT_POINT_SOURCES code of the lane's LAST admission
+        self.init_sources = np.zeros((self.capacity,), dtype=np.int32)
+        #: opt-in training-tape capture (the serving plane flips it)
+        self.tape_enabled = False
+        self.last_round_tape: "dict | None" = None
 
         # jitted lane splices with a TRACED lane index: one trace serves
         # every slot, so admissions never retrace. The compiled helpers
@@ -164,39 +255,40 @@ class SlotPlane(_SlotBookkeeping):
         # comes back from the compile cache with its warm splice traces,
         # so a rejoin-after-retirement is trace-free end to end.
         helpers = engine.__dict__.get("_serving_helpers")
-        if helpers is None:
-            ocp_ = ocp
-
-            def reset_lane(state, lane, theta_row):
-                """Fresh warm start for a newly-admitted tenant's lane:
-                the OCP initial guess, zero equality duals, centered
-                inequality duals, zero multipliers — a recycled slot
-                must not leak the previous tenant's iterate."""
-                w = (state.w[0].at[lane].set(
-                    ocp_.initial_guess(theta_row)),)
-                y = (state.y[0].at[lane].set(0.0),)
-                z = (state.z[0].at[lane].set(0.1),)
-                lam = {a: (pieces[0].at[lane].set(0.0),)
-                       for a, pieces in state.lam.items()}
-                ex_diff = {a: (pieces[0].at[lane].set(0.0),)
-                           for a, pieces in state.ex_diff.items()}
-                return state._replace(w=w, y=y, z=z, lam=lam,
-                                      ex_diff=ex_diff)
-
-            helpers = {
-                "splice_theta": jax.jit(
-                    lambda batch, lane, row: jax.tree.map(
-                        lambda b, r: b.at[lane].set(r), batch, row)),
-                "reset_lane": jax.jit(reset_lane),
-                # the fresh-state TEMPLATE, built once per engine (the
-                # eager init_state cost is paid at the cold build, not
-                # per slot-plane). Later slot planes copy it: every
-                # admitted lane is re-spliced by reset_lane anyway, so
-                # the template's padding values are immaterial — it only
-                # has to be finite and shape-true.
-                "state_template": engine.init_state([self.theta_batch]),
-            }
-            engine.__dict__["_serving_helpers"] = helpers
+        if helpers is None or custom_fn \
+                or helpers.get("gated") != (bundle is not None):
+            aliases = tuple(bundle.aliases) if bundle is not None else ()
+            reset_lane = _make_flat_reset(initial_point_fn, aliases,
+                                          int(engine.T))
+            if helpers is None:
+                helpers = {
+                    "splice_theta": jax.jit(
+                        lambda batch, lane, row: jax.tree.map(
+                            lambda b, r: b.at[lane].set(r), batch, row)),
+                    "reset_lane": jax.jit(reset_lane),
+                    # the fresh-state TEMPLATE, built once per engine
+                    # (the eager init_state cost is paid at the cold
+                    # build, not per slot-plane). Later slot planes copy
+                    # it: every admitted lane is re-spliced by
+                    # reset_lane anyway, so the template's padding
+                    # values are immaterial — it only has to be finite
+                    # and shape-true. Built with the predictor disabled:
+                    # padding lanes never earn one.
+                    "state_template": engine.init_state(
+                        [self.theta_batch], warmstart_enabled=False),
+                    "gated": bundle is not None,
+                }
+                engine.__dict__["_serving_helpers"] = helpers
+            elif custom_fn:
+                # explicit initial_point_fn: keep the engine's cached
+                # template/splice, use this plane's own reset trace
+                helpers = {**helpers, "reset_lane": jax.jit(reset_lane)}
+            else:
+                # the engine grew/lost its warm-start bundle after the
+                # helpers were cached: refresh the shared reset trace
+                helpers = {**helpers, "reset_lane": jax.jit(reset_lane),
+                           "gated": bundle is not None}
+                engine.__dict__["_serving_helpers"] = helpers
         self._splice_theta = helpers["splice_theta"]
         self._reset_lane = helpers["reset_lane"]
         # per-plane COPY: with a donated engine the first step consumes
@@ -213,17 +305,42 @@ class SlotPlane(_SlotBookkeeping):
                 engine.mesh, state, [self.theta_batch])
         self.state = state
 
+    def refresh_warmstart(self) -> None:
+        """Re-derive the injectable initial point from the engine's
+        (possibly newly-installed or removed) warm-start bundle and
+        rebuild the shared reset trace — the live-bucket half of
+        :meth:`~agentlib_mpc_tpu.serving.plane.ServingPlane.
+        install_warmstart`. Sitting tenants keep their lanes; only
+        FUTURE admissions see the new initial point."""
+        bundle = getattr(self.engine, "warmstart", None)
+        self.warmstart_bundle = bundle
+        self.ws_params = bundle.params if bundle is not None else None
+        aliases = tuple(bundle.aliases) if bundle is not None else ()
+        reset_lane = _make_flat_reset(
+            _resolve_initial_point(self.ocp, bundle, None),
+            aliases, int(self.engine.T))
+        helpers = {**self.engine.__dict__["_serving_helpers"],
+                   "reset_lane": jax.jit(reset_lane),
+                   "gated": bundle is not None}
+        self.engine.__dict__["_serving_helpers"] = helpers
+        self._reset_lane = helpers["reset_lane"]
+
     # -- membership (occupancy surface shared via _SlotBookkeeping) -----------
 
     def admit(self, tenant_id: str, theta_row) -> int:
         """Place a tenant into a free slot; returns the slot index.
         Raises ``ValueError`` when full (the plane grows capacity) or on
-        a duplicate id."""
+        a duplicate id. The lane's initial point comes from the
+        injectable initial-point function — ``self.init_sources[slot]``
+        records its provenance code."""
         slot = self._alloc_slot(tenant_id)
         lane = jnp.asarray(slot, jnp.int32)
         self.theta_batch = self._splice_theta(self.theta_batch, lane,
                                               theta_row)
-        self.state = self._reset_lane(self.state, lane, theta_row)
+        self.state, src = self._reset_lane(
+            self.state, lane, theta_row, self.ws_params,
+            jnp.asarray(bool(self.warmstart_enabled)))
+        self.init_sources[slot] = int(np.asarray(src).max())
         self._bind_slot(slot, tenant_id)
         return slot
 
@@ -248,6 +365,15 @@ class SlotPlane(_SlotBookkeeping):
         state, trajs, stats = self.engine.step(
             self.state, [self.theta_batch],
             active=[jnp.asarray(self.mask)])
+        if self.tape_enabled:
+            # warm-start training tape: the PRE-shift solution paired
+            # with the theta it solved — the only place the two are
+            # guaranteed consistent under pipelining (one state copy of
+            # extra liveness, opt-in)
+            self.last_round_tape = {
+                "served": served, "state": state,
+                "theta": self.theta_batch, "stats": stats,
+            }
         self.state = self.engine.shift_state(state) \
             if self.shift_between_rounds else state
         self.rounds_served += 1
@@ -271,6 +397,7 @@ class SlotPlane(_SlotBookkeeping):
         if stats.lane_quarantined is not None:
             lane_q = np.asarray(stats.lane_quarantined[0])
         names = list(self.ocp.control_names)
+        from agentlib_mpc_tpu.ops.solver import INIT_POINT_SOURCES
         out = {}
         for tenant_id, slot in handle.served:
             u_row = u[slot]
@@ -288,6 +415,10 @@ class SlotPlane(_SlotBookkeeping):
                     "iterations": iterations,
                     "quarantined_iters": (int(lane_q[slot])
                                           if lane_q is not None else 0),
+                    # how this lane was LAST cold-started (admission
+                    # provenance; warm rounds shift from it)
+                    "init_point_source":
+                        INIT_POINT_SOURCES[int(self.init_sources[slot])],
                 },
             }
         return out
@@ -318,7 +449,8 @@ class ScenarioSlotPlane(_SlotBookkeeping):
     sickness signal on robust tenants) with the full per-branch
     breakdown in ``stats.branch_quarantined``."""
 
-    def __init__(self, engine, ocp, theta0, shift_between_rounds=True):
+    def __init__(self, engine, ocp, theta0, shift_between_rounds=True,
+                 initial_point_fn=None):
         self.engine = engine
         self.ocp = ocp
         self.capacity = engine.group.n_agents
@@ -329,36 +461,46 @@ class ScenarioSlotPlane(_SlotBookkeeping):
         self.mask = np.zeros((self.capacity,), dtype=bool)
         self.theta_batch = tree_repeat(theta0, self.capacity)
         self.rounds_served = 0
+        # injectable per-branch initial point (the SlotPlane seam, one
+        # axis wider: vmapped over the tenant's S branches)
+        bundle = getattr(engine, "warmstart", None)
+        custom_fn = initial_point_fn is not None
+        initial_point_fn = _resolve_initial_point(ocp, bundle,
+                                                  initial_point_fn)
+        self.warmstart_bundle = bundle
+        self.ws_params = bundle.params if bundle is not None else None
+        self.warmstart_enabled = True
+        #: per-slot worst-branch INIT_POINT_SOURCES code at admission
+        self.init_sources = np.zeros((self.capacity,), dtype=np.int32)
+        #: robust buckets don't emit the flat training tape (branch
+        #: stacks don't match the flat dataset schema) — attrs exist so
+        #: the plane can treat both slot-plane kinds uniformly
+        self.tape_enabled = False
+        self.last_round_tape: "dict | None" = None
 
         helpers = engine.__dict__.get("_serving_helpers")
-        if helpers is None:
-            ocp_ = ocp
-
-            def reset_lane(state, lane, theta_row):
-                """Fresh warm start for a newly-admitted robust
-                tenant's lane: per-branch OCP initial guesses, zeroed
-                multipliers on BOTH coupling families — a recycled slot
-                must not leak the previous tenant's iterates on any
-                branch."""
-                w = state.w.at[lane].set(
-                    jax.vmap(ocp_.initial_guess)(theta_row))
-                y = state.y.at[lane].set(0.0)
-                z = state.z.at[lane].set(0.1)
-                nu = state.nu.at[lane].set(0.0)
-                na = state.na_target.at[lane].set(0.0)
-                lam = {a: leaf.at[lane].set(0.0)
-                       for a, leaf in state.lam.items()}
-                return state._replace(w=w, y=y, z=z, nu=nu,
-                                      na_target=na, lam=lam)
-
-            helpers = {
-                "splice_theta": jax.jit(
-                    lambda batch, lane, row: jax.tree.map(
-                        lambda b, r: b.at[lane].set(r), batch, row)),
-                "reset_lane": jax.jit(reset_lane),
-                "state_template": engine.init_state(self.theta_batch),
-            }
-            engine.__dict__["_serving_helpers"] = helpers
+        if helpers is None or custom_fn \
+                or helpers.get("gated") != (bundle is not None):
+            aliases = tuple(bundle.aliases) if bundle is not None else ()
+            reset_lane = _make_scenario_reset(initial_point_fn, aliases,
+                                              int(engine.T))
+            if helpers is None:
+                helpers = {
+                    "splice_theta": jax.jit(
+                        lambda batch, lane, row: jax.tree.map(
+                            lambda b, r: b.at[lane].set(r), batch, row)),
+                    "reset_lane": jax.jit(reset_lane),
+                    "state_template": engine.init_state(
+                        self.theta_batch, warmstart_enabled=False),
+                    "gated": bundle is not None,
+                }
+                engine.__dict__["_serving_helpers"] = helpers
+            elif custom_fn:
+                helpers = {**helpers, "reset_lane": jax.jit(reset_lane)}
+            else:
+                helpers = {**helpers, "reset_lane": jax.jit(reset_lane),
+                           "gated": bundle is not None}
+                engine.__dict__["_serving_helpers"] = helpers
         self._splice_theta = helpers["splice_theta"]
         self._reset_lane = helpers["reset_lane"]
         state = jax.tree.map(jnp.copy, helpers["state_template"])
@@ -368,6 +510,21 @@ class ScenarioSlotPlane(_SlotBookkeeping):
         self.state = state
 
     # -- membership (occupancy surface shared via _SlotBookkeeping) -----------
+
+    def refresh_warmstart(self) -> None:
+        """Scenario sibling of :meth:`SlotPlane.refresh_warmstart`."""
+        bundle = getattr(self.engine, "warmstart", None)
+        self.warmstart_bundle = bundle
+        self.ws_params = bundle.params if bundle is not None else None
+        aliases = tuple(bundle.aliases) if bundle is not None else ()
+        reset_lane = _make_scenario_reset(
+            _resolve_initial_point(self.ocp, bundle, None),
+            aliases, int(self.engine.T))
+        helpers = {**self.engine.__dict__["_serving_helpers"],
+                   "reset_lane": jax.jit(reset_lane),
+                   "gated": bundle is not None}
+        self.engine.__dict__["_serving_helpers"] = helpers
+        self._reset_lane = helpers["reset_lane"]
 
     def _check_branch_stack(self, tenant_id: str, theta_row) -> None:
         s_lead = int(jnp.asarray(
@@ -385,7 +542,10 @@ class ScenarioSlotPlane(_SlotBookkeeping):
         lane = jnp.asarray(slot, jnp.int32)
         self.theta_batch = self._splice_theta(self.theta_batch, lane,
                                               theta_row)
-        self.state = self._reset_lane(self.state, lane, theta_row)
+        self.state, src = self._reset_lane(
+            self.state, lane, theta_row, self.ws_params,
+            jnp.asarray(bool(self.warmstart_enabled)))
+        self.init_sources[slot] = int(np.asarray(src).max())
         self._bind_slot(slot, tenant_id)
         return slot
 
@@ -421,6 +581,7 @@ class ScenarioSlotPlane(_SlotBookkeeping):
         if stats.lane_quarantined is not None:
             lane_q = np.asarray(stats.lane_quarantined)  # (cap, S)
         names = list(self.ocp.control_names)
+        from agentlib_mpc_tpu.ops.solver import INIT_POINT_SOURCES
         out = {}
         for tenant_id, slot in handle.served:
             u_lane = u[slot]                  # (S, N, n_u)
@@ -443,6 +604,8 @@ class ScenarioSlotPlane(_SlotBookkeeping):
                     # per-branch attribution alongside
                     "quarantined_iters": int(max(branch_q)),
                     "branch_quarantined": branch_q,
+                    "init_point_source":
+                        INIT_POINT_SOURCES[int(self.init_sources[slot])],
                 },
             }
         return out

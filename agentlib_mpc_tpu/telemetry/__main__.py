@@ -12,8 +12,19 @@ Modes:
 * ``--slo JOURNAL`` — recompute the per-tenant SLO report offline from
   the journal's ``serve.round`` events (JSON to stdout): the auditor's
   path to the same numbers ``ServingPlane.slo_report()`` serves live.
+* ``--dataset JOURNAL [--out PATH] [--fingerprint FP]`` — extract the
+  warm-start training set from the journal's ``warmstart.tape`` events
+  (ISSUE 19). Deterministic: rows ride in journal sequence order, only
+  CONVERGED solutions are kept (the tape carries the accepted solution
+  per served tenant per round), and the column schema is exactly what
+  ``ml.training.load_warmstart_dataset`` / ``fit_warmstart`` consume:
+  ``theta[i], w[i], y[i], z[i], lam[i], iterations`` (zero-width heads
+  omitted). ``--out`` picks the format by extension (``.csv`` or
+  ``.npz``); without it the CSV goes to stdout. A journal carrying
+  tape rows for more than one fingerprint requires ``--fingerprint``
+  (one artifact per problem class — mixing classes is a training bug).
 
-No jax import in either mode — the CLI must run on a machine that has
+No jax import in any mode — the CLI must run on a machine that has
 only the tape, not the fleet.
 """
 
@@ -22,6 +33,45 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+#: the tape heads, in the canonical (ml.serialized.WARMSTART_HEADS)
+#: concatenation order the trainer targets
+_TAPE_HEADS = ("w", "y", "z", "lam")
+
+
+def dataset_from_events(events, fingerprint: "str | None" = None):
+    """``warmstart.tape`` events → column dict (lists, no numpy): the
+    documented training-set schema. Raises ``ValueError`` on a
+    multi-fingerprint tape without an explicit selection."""
+    rows = [e for e in events if e.get("etype") == "warmstart.tape"]
+    if fingerprint is not None:
+        rows = [e for e in rows if e.get("fingerprint") == fingerprint]
+    fps = sorted({e.get("fingerprint") for e in rows})
+    if len(fps) > 1:
+        raise ValueError(
+            f"journal carries tape rows for {len(fps)} fingerprints "
+            f"({', '.join(map(str, fps))}) — pick one with --fingerprint")
+    rows = [e for e in rows if e.get("converged", True)]
+    data = {"theta": [e["theta"] for e in rows]}
+    for head in _TAPE_HEADS:
+        col = [e.get(head, []) for e in rows]
+        if any(len(c) for c in col):
+            data[head] = col
+    data["iterations"] = [int(e.get("iterations", 0)) for e in rows]
+    return data, (fps[0] if fps else None)
+
+
+def _dataset_csv(data, stream) -> None:
+    cols = [("theta", data["theta"])] + [
+        (h, data[h]) for h in _TAPE_HEADS if h in data]
+    header = [f"{name}[{i}]" for name, col in cols
+              for i in range(len(col[0]) if col else 0)]
+    header.append("iterations")
+    stream.write(",".join(header) + "\n")
+    for r in range(len(data["theta"])):
+        cells = ["%.17g" % v for _name, col in cols for v in col[r]]
+        cells.append(str(data["iterations"][r]))
+        stream.write(",".join(cells) + "\n")
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -33,6 +83,15 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument("--slo", metavar="JOURNAL",
                         help="recompute the SLO report offline from a "
                              "journal's serve.round events")
+    parser.add_argument("--dataset", metavar="JOURNAL",
+                        help="extract the warm-start training set from "
+                             "a journal's warmstart.tape events")
+    parser.add_argument("--out", default=None,
+                        help="dataset output path (.csv or .npz); "
+                             "default: CSV to stdout")
+    parser.add_argument("--fingerprint", default=None,
+                        help="problem-class fingerprint to extract "
+                             "(required on multi-class journals)")
     parser.add_argument("--around", default=None,
                         help="window anchor: a sequence number, or "
                              "round:N (default: first fault event)")
@@ -45,6 +104,33 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="metrics JSONL export to embed in the "
                              "bundle (bench.py --emit-metrics format)")
     args = parser.parse_args(argv)
+
+    if args.dataset:
+        from agentlib_mpc_tpu.telemetry.journal import read_events
+
+        events = read_events(args.dataset)
+        try:
+            data, fp = dataset_from_events(events, args.fingerprint)
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
+        if not data["theta"]:
+            print(f"no warmstart.tape rows in journal {args.dataset} "
+                  f"(serve with warmstart_tape=True)", file=sys.stderr)
+            return 1
+        if args.out and args.out.endswith(".npz"):
+            import numpy as np  # tape-only machines have numpy, not jax
+
+            np.savez(args.out, **{k: np.asarray(v)
+                                  for k, v in data.items()})
+        elif args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                _dataset_csv(data, fh)
+        else:
+            _dataset_csv(data, sys.stdout)
+        print(f"{len(data['theta'])} rows (fingerprint {fp})",
+              file=sys.stderr)
+        return 0
 
     if args.slo:
         from agentlib_mpc_tpu.telemetry.journal import read_events

@@ -385,7 +385,7 @@ class TestStatsHistoryBackCompat:
 
     EXPECTED_KEYS = {"time", "iterations", "success", "kkt_error",
                      "objective", "constraint_violation", "solve_wall_time",
-                     "kkt_path", "jac_path"}
+                     "kkt_path", "jac_path", "init_point_source"}
 
     @pytest.fixture(scope="class")
     def backend(self):
@@ -426,6 +426,8 @@ class TestStatsHistoryBackCompat:
         assert row["kkt_path"] in ("lu", "ldl", "stage")
         # derivative-pipeline attribution (dense: tiny OCP, no plan)
         assert row["jac_path"] in ("dense", "sparse")
+        # initial-point provenance (no predictor installed here)
+        assert row["init_point_source"] == "plain"
 
     def test_history_is_mutable_list(self, backend):
         hist = backend.stats_history

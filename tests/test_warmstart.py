@@ -1,0 +1,274 @@
+"""Learned warm starts (ml/warmstart.py): fingerprint-keyed initial-point
+prediction trained from the journal tape.
+
+Covers the PR's acceptance surface end to end on the CPU tracker model:
+
+- the serialized predictor round-trips through the EngineStore artifact
+  path bitwise (same prediction before and after revive);
+- structural-fingerprint drift REFUSES the artifact (plain starts, never
+  a mis-matched prediction);
+- the in-graph KKT gate selects the plain start bitwise when the
+  predictor is corrupted (NaN weights), and counts the rejection;
+- the chaos ``WarmstartPoisonRule`` degrades latency, never actuation:
+  zero failed actuations, and the injection -> rejection -> recovery
+  chain is reconstructible from the journal alone;
+- the dataset CLI is deterministic: two extractions of the same journal
+  are byte-identical.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+
+from conftest import make_tracker_model  # noqa: E402
+
+from agentlib_mpc_tpu import telemetry
+from agentlib_mpc_tpu.ml.training import fit_warmstart
+from agentlib_mpc_tpu.ml.warmstart import (
+    WarmstartDriftError,
+    build_warmstart,
+    flatten_theta,
+    load_warmstart,
+    make_gated_init,
+    plain_init,
+    save_warmstart,
+    theta_flat_size,
+)
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.ops.transcription import transcribe
+from agentlib_mpc_tpu.parallel.fused_admm import FusedADMMOptions
+from agentlib_mpc_tpu.resilience import install_serving_chaos
+from agentlib_mpc_tpu.serving import ServingPlane, TenantSpec
+from agentlib_mpc_tpu.serving.fingerprint import tenant_fingerprint
+from agentlib_mpc_tpu.serving.store import EngineStore
+from agentlib_mpc_tpu.telemetry.journal import read_events
+
+ADMM = FusedADMMOptions(max_iterations=6, rho=2.0)
+SOL = SolverOptions(max_iter=30)
+
+
+@pytest.fixture(scope="module")
+def tracker_ocp():
+    Tracker = make_tracker_model(lb=-5.0, ub=5.0)
+    return transcribe(Tracker(), ["u"], N=5, dt=300.0,
+                      method="multiple_shooting")
+
+
+def _spec(ocp, tid, a):
+    return TenantSpec(tenant_id=tid, ocp=ocp,
+                      theta=ocp.default_params(p=jnp.array([float(a)])),
+                      couplings={"shared_u": "u"}, solver_options=SOL)
+
+
+@pytest.fixture(scope="module")
+def tape(tracker_ocp, tmp_path_factory):
+    """One served tape: journal + EngineStore dir + a model trained from
+    the journal replay (never a live hook)."""
+    tmp = tmp_path_factory.mktemp("warmstart")
+    journal = str(tmp / "journal.jsonl")
+    store = str(tmp / "store")
+    telemetry.configure(enabled=True)
+    telemetry.enable_journal(journal)
+    try:
+        plane = ServingPlane(ADMM, slot_multiple=1, initial_capacity=4,
+                             engine_store=store, warmstart_tape=True)
+        for i, a in enumerate([0.5, 1.5, 2.5]):
+            plane.join(_spec(tracker_ocp, f"s{i}", a))
+        for _ in range(4):
+            for i in range(3):
+                plane.submit(f"s{i}")
+            plane.serve_round()
+    finally:
+        telemetry.disable_journal()
+    from agentlib_mpc_tpu.telemetry.__main__ import dataset_from_events
+
+    data, _meta = dataset_from_events(read_events(journal))
+    fp = tenant_fingerprint(tracker_ocp).digest
+    model = fit_warmstart(data, fingerprint=fp, aliases=["shared_u"],
+                          trainer_config={"hidden": (16,), "epochs": 150,
+                                          "seed": 0})
+    return {"journal": journal, "store": store, "model": model, "fp": fp}
+
+
+# -- serialization round-trip via EngineStore --------------------------------
+
+def test_roundtrip_bitwise_via_store(tracker_ocp, tape):
+    model = tape["model"]
+    store = EngineStore(tape["store"])
+    save_warmstart(store, model)
+    revived = load_warmstart(store, tape["fp"])
+    assert revived is not None
+    assert revived.fingerprint == model.fingerprint
+    assert revived.heads == model.heads
+
+    b0 = build_warmstart(model, ocp=tracker_ocp)
+    b1 = build_warmstart(revived, ocp=tracker_ocp)
+    theta = tracker_ocp.default_params(p=jnp.array([1.25]))
+    x = flatten_theta(theta)
+    out0 = np.asarray(b0.apply(b0.params, x))
+    out1 = np.asarray(b1.apply(b1.params, x))
+    # bitwise: the artifact is content-addressed, a revive must not
+    # perturb the prediction by even one ulp
+    assert out0.tobytes() == out1.tobytes()
+
+
+def test_load_warmstart_absent_is_plain(tape):
+    store = EngineStore(tape["store"])
+    assert load_warmstart(store, "no-such-fingerprint") is None
+
+
+# -- fingerprint drift = refuse ----------------------------------------------
+
+def test_fingerprint_drift_refused(tracker_ocp, tape):
+    import dataclasses
+
+    model = tape["model"]
+    drifted = dataclasses.replace(model, fingerprint="f" * 16)
+    with pytest.raises(WarmstartDriftError, match="drift"):
+        build_warmstart(drifted, ocp=tracker_ocp)
+    with pytest.raises(WarmstartDriftError):
+        build_warmstart(dataclasses.replace(model, fingerprint=""),
+                        ocp=tracker_ocp)
+    # matching digest passes
+    assert build_warmstart(model, fingerprint=tape["fp"]) is not None
+
+
+def test_trainer_config_configures_trainer(tracker_ocp, tape):
+    n_theta = theta_flat_size(tracker_ocp)
+    rng = np.random.default_rng(0)
+    data = {"theta": rng.normal(size=(6, n_theta)),
+            "w": rng.normal(size=(6, int(tracker_ocp.n_w))),
+            "iterations": np.full(6, 3)}
+    model = fit_warmstart(data, fingerprint=tape["fp"], val_share=0.0,
+                          trainer_config={"hidden": (4,), "epochs": 2,
+                                          "seed": 0})
+    # hidden=(4,) must actually shape the net, not just ride as metadata
+    assert np.asarray(model.weights[0]).shape == (n_theta, 4)
+
+
+# -- in-graph gate: corrupted predictor => plain start bitwise ---------------
+
+def test_gate_selects_plain_on_poisoned_weights(tracker_ocp, tape):
+    import jax
+
+    bundle = build_warmstart(tape["model"], ocp=tracker_ocp)
+    gated = make_gated_init(tracker_ocp, bundle)
+    plain = plain_init(tracker_ocp)
+    theta = tracker_ocp.default_params(p=jnp.array([1.0]))
+
+    poisoned = jax.tree.map(lambda leaf: jnp.full_like(leaf, jnp.nan),
+                            bundle.params)
+    w_g, y_g, z_g, lam_g, src = gated(poisoned, jnp.asarray(True), theta)
+    w_p, y_p, z_p, _lam, src_p = plain(None, jnp.asarray(False), theta)
+    assert int(src) == 2          # predicted_rejected
+    assert int(src_p) == 0        # plain
+    for got, want in ((w_g, w_p), (y_g, y_p), (z_g, z_p)):
+        assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+    assert np.all(np.isfinite(np.asarray(lam_g)))
+
+    # disabled predictor: src=plain even with healthy weights
+    _w, _y, _z, _l, src_off = gated(bundle.params, jnp.asarray(False),
+                                    theta)
+    assert int(src_off) == 0
+
+
+# -- chaos: poisoned predictor degrades to plain, never actuation ------------
+
+def test_chaos_poison_recovery_from_journal(tracker_ocp, tape,
+                                            tmp_path):
+    journal = str(tmp_path / "chaos.jsonl")
+    telemetry.configure(enabled=True)
+    telemetry.enable_journal(journal)
+    try:
+        plane = ServingPlane(ADMM, slot_multiple=1, initial_capacity=4,
+                             engine_store=tape["store"])
+        plane.join(_spec(tracker_ocp, "t0", 1.0))
+        plane.join(_spec(tracker_ocp, "t1", 2.0))
+        ctrl = install_serving_chaos(plane, {"warmstart_poison": [
+            {"start_round": 1, "n_rounds": 2}]})
+        bad = 0
+        for r in range(5):
+            for t in ("t0", "t1"):
+                plane.submit(t)
+            out = plane.serve_round()
+            # churn one tenant so cold joins keep exercising the gate
+            plane.leave("t1")
+            plane.join(_spec(tracker_ocp, "t1", 2.0 + 0.1 * r))
+            for res in (out or {}).values():
+                if res.action != "actuate" or not res.healthy:
+                    bad += 1
+        ctrl.uninstall()
+    finally:
+        telemetry.disable_journal()
+    assert bad == 0, "poisoned predictor must never cost an actuation"
+
+    # the full chain from the journal ALONE: injection -> in-window
+    # rejections -> lift -> accepted predictions again
+    evs = read_events(journal)
+    inj = [e for e in evs if e.get("etype") == "chaos.injected"
+           and "warmstart" in e.get("rule", "")]
+    adm = [e for e in evs if e.get("etype") == "warmstart.admission"]
+    rej = [e for e in adm if e.get("source") == "predicted_rejected"]
+    acc = [e for e in adm if e.get("source") == "predicted"]
+    assert any(e["rule"] == "warmstart_poison" for e in inj)
+    assert any(e["rule"] == "warmstart_poison_lifted" for e in inj)
+    assert rej and acc
+
+    seq = lambda e: e.get("seq", 0)  # noqa: E731
+    inj_seq = min(seq(e) for e in inj if e["rule"] == "warmstart_poison")
+    lift_seq = min(seq(e) for e in inj
+                   if e["rule"] == "warmstart_poison_lifted")
+    assert inj_seq < lift_seq
+    assert [e for e in rej if inj_seq < seq(e) < lift_seq], \
+        "no rejection between injection and lift"
+    assert [e for e in acc if seq(e) > lift_seq], \
+        "predictor did not recover after the rule lifted"
+
+
+# -- dataset CLI determinism -------------------------------------------------
+
+def test_dataset_cli_deterministic(tape, tmp_path):
+    from agentlib_mpc_tpu.telemetry.__main__ import main as tcli
+
+    outs = []
+    for tag in ("a", "b"):
+        csv = str(tmp_path / f"ds_{tag}.csv")
+        npz = str(tmp_path / f"ds_{tag}.npz")
+        tcli(["--dataset", tape["journal"], "--out", csv])
+        tcli(["--dataset", tape["journal"], "--out", npz])
+        outs.append((Path(csv).read_bytes(), Path(npz).read_bytes()))
+    assert outs[0][0] == outs[1][0], "CSV extraction not deterministic"
+    a = np.load(str(tmp_path / "ds_a.npz"))
+    b = np.load(str(tmp_path / "ds_b.npz"))
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        assert np.asarray(a[k]).tobytes() == np.asarray(b[k]).tobytes()
+
+
+def test_dataset_cli_no_jax():
+    """The extraction CLI stays jax-free: offline tooling replaying the
+    journal must not touch the accelerator stack (the package root may
+    import jax, the CLI module's own code must not)."""
+    import ast
+
+    import agentlib_mpc_tpu.telemetry.__main__ as tmod
+
+    tree = ast.parse(Path(tmod.__file__).read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            names = [node.module or ""]
+        else:
+            continue
+        for name in names:
+            assert not name.startswith("jax"), \
+                f"dataset CLI imports {name} at {node.lineno}"
