@@ -39,11 +39,14 @@ Modes:
   peak-bytes-per-agent-lane pin (8 virtual devices, like the mesh
   gates — run in a fresh process).
 * ``--jaxpr`` — run the semantic jaxpr passes (LQ certification, stage-
-  structure proof, dtype propagation, cost model, memory
-  certification, dispatch-schedule certification against the
-  ``[jaxpr.dispatch]`` pins) over the example-OCP menu against the
-  ``[jaxpr.expect]`` expectations in ``lint_budgets.toml`` (imports
-  jax, like the retrace gate).
+  structure proof, dtype propagation gated by the ``[jaxpr.dtypes]``
+  weak-leak pin, cost model, memory certification, dispatch-schedule
+  certification against the ``[jaxpr.dispatch]`` pins, and precision
+  certification — the error-propagation pass's per-phase
+  certified-dtype routing table held to the ``[jaxpr.precision]``
+  pins) over the example-OCP menu against the ``[jaxpr.expect]``
+  expectations in ``lint_budgets.toml`` (imports jax, like the
+  retrace gate).
 """
 
 from __future__ import annotations
@@ -318,8 +321,54 @@ def main(argv: "list[str] | None" = None) -> int:
                   f"[{status}]")
             for v in r["violations"]:
                 print(f"  FAILED: {v}")
+        # dtypes leg (ISSUE 20, promoting the PR 5 advisory pass to a
+        # gate): the per-example weak-type leak count — implicit
+        # Python-scalar promotions that change the compiled program
+        # under x64 — is pinned by [jaxpr.dtypes] (0 on the seed menu);
+        # x64-promotion/x64-constant findings stay advisory because the
+        # transcription deliberately traces flag-following
+        dtypes_cfg = budgets.get("dtypes", {})
+        max_weak = int(dtypes_cfg.get("max_weak_leaks", 0))
+        weak_total = 0
+        dtypes_failures = 0
+        for r in summary["examples"]:
+            weak = [f for f in r["dtype_findings"]
+                    if f["rule"] == "jaxpr-weak-leak"]
+            weak_total += len(weak)
+            status = "FAIL" if len(weak) > max_weak else "ok"
+            print(f"{r['name']}: dtypes weak-leaks={len(weak)} "
+                  f"advisories={len(r['dtype_findings']) - len(weak)} "
+                  f"(budget {max_weak}) [{status}]")
+            if len(weak) > max_weak:
+                dtypes_failures += len(weak) - max_weak
+                for f in weak:
+                    print(f"  FAILED: {f['where']}: {f['detail']}")
+        # precision leg (ISSUE 20): certify the traced solve of every
+        # example-menu entry with the error-propagation pass and hold
+        # the per-phase certified-dtype routing table to the
+        # [jaxpr.precision] pins — a phase drifting in EITHER direction
+        # (lost bf16 proof, or a suspicious new one) fails lint --jaxpr
+        from agentlib_mpc_tpu.lint.jaxpr.precision import (
+            precision_gate_summary,
+        )
+
+        prec = precision_gate_summary({"jaxpr": budgets})
+        for r in prec["examples"]:
+            if "error" in r:
+                print(f"{r['name']}: precision certification ERROR "
+                      f"[FAIL]\n  {r['error']}")
+                continue
+            status = "FAIL" if r["violations"] else "ok"
+            cert = r["certificate"]
+            table = ",".join(f"{ph}={dt}"
+                             for ph, dt in cert["phases"].items())
+            print(f"{r['name']}: precision {cert['status']} "
+                  f"[{table}] digest={r['digest']} [{status}]")
+            for v in r["violations"]:
+                print(f"  FAILED: {v}")
         total = summary["failures"] + growth["failures"] \
-            + coll["failures"] + mem_failures + disp["failures"]
+            + coll["failures"] + mem_failures + disp["failures"] \
+            + dtypes_failures + prec["failures"]
         if total:
             print(f"FAILED: {total} jaxpr certification "
                   f"failure(s) (docs/static_analysis.md)", file=sys.stderr)
@@ -328,7 +377,9 @@ def main(argv: "list[str] | None" = None) -> int:
               f"example OCP(s) proved, eval+jac growth within "
               f"{growth['max_growth']}x, collective schedules proved "
               f"over {coll['devices']} device(s), memory certificates "
-              f"bound XLA, dispatch schedules pinned", file=sys.stderr)
+              f"bound XLA, dispatch schedules pinned, "
+              f"{weak_total} weak-type leak(s), precision routing "
+              f"tables pinned", file=sys.stderr)
         return 0
 
     if args.stats:

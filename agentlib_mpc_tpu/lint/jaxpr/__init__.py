@@ -9,7 +9,7 @@ exactly one level down, in the jaxpr, where JAX's tracing design (Frostig
 et al.) gives a complete dataflow IR of the traced function: every
 primitive application, every constant, no Python control flow left.
 
-Eight passes over one shared per-primitive interpreter (:mod:`.interp`):
+Nine passes over one shared per-primitive interpreter (:mod:`.interp`):
 
 * :func:`certify_lq` (:mod:`.lq`) — a polynomial-degree lattice
   {const, affine, quadratic, nonpoly} propagated per element through
@@ -56,6 +56,18 @@ Eight passes over one shared per-primitive interpreter (:mod:`.interp`):
   loop trips, an unplanned sync inside the round refuted by name, and
   a mesh-size-independent ``dispatch_digest`` riding the engine-store
   and checkpoint stamps next to the collective and memory digests.
+* :func:`certify_precision` (:mod:`.precision`) — a forward
+  error-propagation lattice (per-value magnitude interval + accumulated
+  relative-error bound, condition-number-aware cancellation checks for
+  sub/sum, operand-rounding + log-depth accumulation charges for
+  contractions, loop fixpoints with honest widening) proving, per
+  ``phase_scope`` phase, the narrowest dtype regime whose error stays
+  under the phase tolerance — the :class:`PrecisionCertificate` behind
+  ``SolverOptions.precision`` ("mixed" routes certified phases to
+  bf16-input/f32-accumulate, "require" refuses an unproved build) and
+  the ``precision_digest`` on engine-store and checkpoint stamps. A
+  refuting phase names the dominating hazard by eqn source (the KKT
+  residual subtraction, a μ-floor division).
 * :func:`plan_fusion` (:mod:`.fusion`) — the analytic fusion planner:
   per-phase op-cost × collective-bytes × live-range peaks joined
   across candidate stage merges, ranked by modeled dispatch-overhead
@@ -106,6 +118,18 @@ from agentlib_mpc_tpu.lint.jaxpr.fingerprint import (  # noqa: F401
     StructuralFingerprint,
     jaxpr_digest,
     structural_fingerprint,
+)
+from agentlib_mpc_tpu.lint.jaxpr.precision import (  # noqa: F401
+    CANDIDATE_DTYPES,
+    MIXED_FULL_PHASES,
+    MIXED_NARROW_PHASES,
+    PHASE_TOLS,
+    PhaseVerdict,
+    PrecisionCertificate,
+    certify_precision,
+    certify_solver_precision,
+    check_precision_budget,
+    precision_gate_summary,
 )
 from agentlib_mpc_tpu.lint.jaxpr.lq import (  # noqa: F401
     LQCertificate,

@@ -105,11 +105,18 @@ class CostEstimate:
         }
 
 
-def _nbytes(var) -> int:
+def _nbytes(var, itemsize_override: "int | None" = None) -> int:
     aval = getattr(var, "aval", None)
     if aval is None or not hasattr(aval, "dtype"):
         return 0
-    return int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+    itemsize = aval.dtype.itemsize
+    if itemsize_override is not None and np.issubdtype(
+            aval.dtype, np.floating) and itemsize_override < itemsize:
+        # what-if width for the precision certificate's projected
+        # savings: floating traffic recosted at the narrow width;
+        # integer/bool/index traffic keeps its real width
+        itemsize = itemsize_override
+    return int(np.prod(aval.shape, dtype=np.int64)) * itemsize
 
 
 def _out_size(eqn) -> int:
@@ -128,7 +135,8 @@ def _dot_flops(eqn) -> int:
 def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
             mult: int = 1, comm: "Counter | None" = None,
             axis_sizes: "dict | None" = None,
-            while_trips: "int | None" = None) -> None:
+            while_trips: "int | None" = None,
+            itemsize_override: "int | None" = None) -> None:
     jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
     comm = Counter() if comm is None else comm
     for eqn in jaxpr.eqns:
@@ -151,7 +159,7 @@ def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
                 except Exception:  # noqa: BLE001 — AbstractMesh variants
                     sm_axes = None
             _charge(eqn.params["jaxpr"], flops, bytes_, notes, mult,
-                    comm, sm_axes, while_trips)
+                    comm, sm_axes, while_trips, itemsize_override)
             continue
         elif name == "scan":
             sub, m = eqn.params["jaxpr"], mult * int(eqn.params["length"])
@@ -171,18 +179,20 @@ def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
         elif name == "cond":
             for br in eqn.params["branches"]:
                 _charge(br, flops, bytes_, notes, mult, comm,
-                        axis_sizes, while_trips)
+                        axis_sizes, while_trips, itemsize_override)
             continue
         if sub is not None:
             _charge(sub, flops, bytes_, notes, m, comm, axis_sizes,
-                    while_trips)
+                    while_trips, itemsize_override)
             if name == "while":
                 _charge(eqn.params["cond_jaxpr"], flops, bytes_, notes,
-                        m, comm, axis_sizes, while_trips)
+                        m, comm, axis_sizes, while_trips,
+                        itemsize_override)
             continue
-        io_bytes = mult * (sum(_nbytes(v) for v in eqn.invars
-                               if hasattr(v, "aval"))
-                           + sum(_nbytes(v) for v in eqn.outvars))
+        io_bytes = mult * (
+            sum(_nbytes(v, itemsize_override) for v in eqn.invars
+                if hasattr(v, "aval"))
+            + sum(_nbytes(v, itemsize_override) for v in eqn.outvars))
         bytes_[name] += io_bytes
         if name in COLLECTIVE_PRIMS:
             # comm cost: bytes moved x axis size x loop trips (the
@@ -206,7 +216,8 @@ def _charge(closed, flops: Counter, bytes_: Counter, notes: "set[str]",
                 else:
                     factor *= int(size)
             payload = mult * factor * sum(
-                _nbytes(v) for v in eqn.invars if hasattr(v, "aval"))
+                _nbytes(v, itemsize_override) for v in eqn.invars
+                if hasattr(v, "aval"))
             comm[name] += payload
             continue
         if name in _FREE:
@@ -290,7 +301,8 @@ def compare_eval_jac_cost(nlp, theta, n_w: int, plan) -> dict:
 
 
 def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
-            while_trips: "int | None" = None) -> CostEstimate:
+            while_trips: "int | None" = None,
+            itemsize_override: "int | None" = None) -> CostEstimate:
     """Cost model of ``fn(*args)`` (or of an already-closed jaxpr when
     called with no ``args`` and a ``ClosedJaxpr`` first argument).
 
@@ -300,7 +312,16 @@ def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
     so in the notes instead of silently undercounting the dominant
     loop. ``axis_sizes`` (axis name → mesh size) scales the
     ``collective_bytes`` comm column; programs containing a
-    ``shard_map`` default to that eqn's own mesh shape."""
+    ``shard_map`` default to that eqn's own mesh shape.
+
+    ``itemsize_override``: what-if floating-point width in bytes (2 for
+    bf16). Floating operand/output traffic — HBM and collective alike —
+    is recosted at the narrow width while integer/index traffic keeps
+    its real width: the projected-savings column a
+    :class:`~agentlib_mpc_tpu.lint.jaxpr.precision.PrecisionCertificate`
+    turns into "what the certified-mixed program would move". FLOPs and
+    the live-range peak are NOT rescaled (the MXU charges the same
+    multiply count; residency is certified separately)."""
     if hasattr(fn_or_jaxpr, "jaxpr") and not args:
         closed = fn_or_jaxpr
     else:
@@ -312,7 +333,8 @@ def op_cost(fn_or_jaxpr, *args, axis_sizes: "dict | None" = None,
     comm: Counter = Counter()
     notes: "set[str]" = set()
     _charge(closed, flops, bytes_, notes, comm=comm,
-            axis_sizes=axis_sizes, while_trips=while_trips)
+            axis_sizes=axis_sizes, while_trips=while_trips,
+            itemsize_override=itemsize_override)
     # the residency column (ISSUE 13): the live-range peak of the same
     # closed jaxpr, per device. Failure degrades to 0 + a note — the
     # FLOP/comm columns must survive a memory-walk regression.

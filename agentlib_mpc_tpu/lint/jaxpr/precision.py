@@ -1,0 +1,1133 @@
+"""Precision certifier: prove which subgraphs survive bf16/f32.
+
+The ninth pass on the shared :mod:`.interp` stack. Every precision
+decision in this codebase used to be folklore patched after the fact —
+the f32 feasibility floor (PR 7), the pivot-free LDLᵀ conditioning
+ceiling (PR 4), the ~1e9-magnitude baked standardization weights
+cancelling catastrophically in f32 (PR 19). The ``check_dtypes`` pass
+sees dtype *leaks*; none of those were leaks — they were *error
+growth*. This pass propagates a forward error lattice over the traced
+program and emits a :class:`PrecisionCertificate`: per **phase** (the
+PR 16 ``phase_scope`` vocabulary, read straight from each equation's
+``name_stack``) the maximum certified-safe dtype, with the dominating
+hazard named by eqn source when a phase refutes bf16/f32.
+
+The lattice. Each value is summarized as ``(lo, hi, rel)``: a signed
+magnitude interval over all its elements plus an accumulated
+relative-error bound, evaluated once per candidate dtype (bf16 / f32 /
+f64, unit roundoffs 2⁻⁸ / 2⁻²⁴ / 2⁻⁵³). The per-primitive rules:
+
+* **add/sub** — interval arithmetic plus the *provable* condition
+  bound ``κ_min = (|a|+|b|) / max|out|``: when the intervals prove the
+  result small against its operands (the mutation test's
+  ``(x+1e8)−1e8``, a near-constant column minus its mean), every point
+  of the interval cancels and ``rel`` is amplified by ``κ_min``;
+  same-scale operands of unknown sign get ``κ_min ≈ 1`` — the
+  backward-error reading (error small relative to the *data*), which
+  is the model under which bf16 Jacobians + iterative refinement are
+  certified at all (Carson–Higham style);
+* **mul/div** — well-conditioned (``rel_a + rel_b + u``); a divisor
+  interval containing zero refutes outright, and a divisor provably
+  reaching below ``100·u`` of a *narrower-than-traced* candidate is
+  noise-dominated at that candidate (the barrier-parameter division
+  near the μ-floor: the floor constants were chosen for the traced
+  dtype, PR 7 — re-running them at bf16 is exactly where they break);
+* **matmul / reductions** — pairwise accumulation charged at the
+  *accumulate* dtype, which the mixed routing pins at ≥ f32
+  (``default_matmul_precision('bfloat16')`` = bf16 operands, f32
+  accumulation on the MXU) — the reason the MXU-dominant phases can
+  certify narrow at all;
+* **scan/while** — carry fixpoints with honest widening: a carry that
+  does not stabilize is widened to an unbounded interval and its
+  carried error reset to one fresh roundoff, under an explicit note —
+  per-iteration error compounding is the *compensator's* certified
+  contract (the 2-step iterative refinement in ops/stagewise), not the
+  lattice's;
+* **opaque primitives** (``lu``, ``triangular_solve``, callbacks, …) —
+  unknown, like every other pass: their outputs are fresh unbounded
+  values and the binding phase's verdict is ``"unknown"`` — which is
+  why ``factor``/``resolve`` stay at the traced (full) precision under
+  every routing.
+
+``status`` judges the **mixed routing** the certificate is cashed
+behind (``SolverOptions.precision``): ``"proved"`` iff every phase the
+mixed program would run narrow (:data:`MIXED_NARROW_PHASES` —
+eval_jac, assemble: the MXU-dominant work) certifies bf16;
+``"refuted"`` names the dominating hazard by source; ``"unknown"``
+when an opaque primitive contaminates a required phase. For a plain
+(un-phased) function the single ``unphased`` phase must certify at
+least f32 — the standardization-fold regression class (PR 19).
+
+``precision_digest`` is the identity of the verdict table (phase →
+certified dtype, never magnitudes): it rides the engine-store meta and
+the plane-checkpoint stamps beside the collective/memory/dispatch
+digests, so a restore whose fresh build would certify *differently* is
+refused. CLI: the ``--jaxpr`` precision leg
+(:func:`precision_gate_summary`) holds the example menu's solver
+traces to the ``[jaxpr.precision]`` pins. See
+``docs/static_analysis.md`` "Precision certificates" (incl. the
+soundness-boundary table: affine-fold correlations, control-flow
+predicates and host callbacks are *outside* the lattice — the
+``--precision-ab`` identity gate is the dynamic check for the model's
+residual risk).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+import re
+
+from agentlib_mpc_tpu.lint.jaxpr.interp import (
+    CALLBACK_PRIMS,
+    COLLECTIVE_PRIMS,
+    LINEAR_REDUCE,
+    NONLINEAR_EW,
+    NONSMOOTH_EW,
+    NONSMOOTH_REDUCE,
+    STRUCTURAL,
+)
+
+__all__ = [
+    "CANDIDATE_DTYPES",
+    "MIXED_FULL_PHASES",
+    "MIXED_NARROW_PHASES",
+    "PHASE_TOLS",
+    "PhaseVerdict",
+    "PrecisionCertificate",
+    "certify_precision",
+    "certify_solver_precision",
+    "check_precision_budget",
+    "precision_gate_summary",
+]
+
+#: candidate evaluation dtypes, narrowest first, with unit roundoffs
+CANDIDATE_DTYPES = ("bf16", "f32", "f64")
+_UNIT_ROUNDOFF = {"bf16": 2.0 ** -8, "f32": 2.0 ** -24, "f64": 2.0 ** -53}
+
+#: per-phase relative-error budgets. The narrow phases (eval_jac,
+#: assemble) run against the COMPENSATED budget: the 2-step iterative
+#: refinement in the resolve path contracts an O(1%) Jacobian/assembly
+#: error back to the f32 residual class (the certified compensator), so
+#: a phase is bf16-safe when its worst value stays within ~13 bf16
+#: roundoffs. The full-precision phases carry the solver's own
+#: f32-noise-floor budget (~1e3·eps_f32, the PR 7 feasibility floor).
+PHASE_TOLS: "dict[str, float]" = {
+    "eval_jac": 5e-2,
+    "assemble": 5e-2,
+    "factor": 1e-4,
+    "resolve": 1e-4,
+    "line_search": 1e-4,
+    "step_update": 1e-3,
+    "consensus": 1e-3,
+    "non_anticipativity": 1e-3,
+    "collectives": 1e-3,
+    "unphased": 1e-3,
+}
+
+#: phases the certificate-gated mixed routing runs at bf16 input /
+#: f32 accumulation — the MXU-dominant work of the IPM iteration
+MIXED_NARROW_PHASES = ("eval_jac", "assemble")
+#: phases the mixed routing keeps at the traced (full) precision, with
+#: the iterative refinement in ``resolve`` as the certified compensator
+MIXED_FULL_PHASES = ("factor", "resolve", "line_search")
+
+#: default seeded magnitude for invars without bounds, and the sentinel
+#: for provably-unbounded values (inf survives interval arithmetic)
+_DEFAULT_MAG = 1e4
+_INF = math.inf
+_TINY = 1e-300
+
+#: axis size charged for a collective whose mesh is not in the params
+_DEFAULT_AXIS_SIZE = 8
+
+#: fixpoint budget before a scan/while carry is widened
+_FIXPOINT_ITERS = 12
+_WIDEN_AFTER = 8
+
+_CALL_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+    "remat2": "jaxpr",
+}
+
+_PHASE_RE = re.compile(r"phase\.([A-Za-z0-9_]+)")
+
+
+def _source_of(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<unknown>"
+
+
+def _phase_of(eqn, default: str) -> str:
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:  # noqa: BLE001 — no name stack, keep enclosing
+        return default
+    hits = _PHASE_RE.findall(stack)
+    return hits[-1] if hits else default
+
+
+def _as_jaxpr(obj):
+    if hasattr(obj, "jaxpr"):            # ClosedJaxpr
+        return obj.jaxpr, list(obj.consts)
+    return obj, []
+
+
+@dataclasses.dataclass(frozen=True)
+class _Val:
+    """One value's lattice summary: signed magnitude interval over all
+    elements plus the accumulated relative-error bound at the walker's
+    candidate dtype."""
+
+    lo: float
+    hi: float
+    rel: float
+
+    @property
+    def mag(self) -> float:
+        return max(abs(self.lo), abs(self.hi))
+
+    @property
+    def minmag(self) -> float:
+        if self.lo <= 0.0 <= self.hi:
+            return 0.0
+        return min(abs(self.lo), abs(self.hi))
+
+
+_BOOL = _Val(0.0, 1.0, 0.0)
+_TOP = _Val(-_INF, _INF, 0.0)
+
+
+def _mul_bound(a: float, b: float) -> float:
+    # inf-safe product: 0 * inf is 0 here (an exactly-zero bound
+    # annihilates), never NaN
+    if a == 0.0 or b == 0.0:
+        return 0.0
+    return a * b
+
+
+def _interval_mul(a: _Val, b: _Val) -> "tuple[float, float]":
+    prods = [_mul_bound(x, y) for x in (a.lo, a.hi)
+             for y in (b.lo, b.hi)]
+    return min(prods), max(prods)
+
+
+def _hull(vals: "list[_Val]") -> _Val:
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return _TOP
+    return _Val(min(v.lo for v in vals), max(v.hi for v in vals),
+                max(v.rel for v in vals))
+
+
+def _log2(k: int) -> float:
+    return math.log2(max(int(k), 2))
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseVerdict:
+    """One phase's row of the certificate table.
+
+    ``certified_dtype`` is the narrowest candidate whose error bounds
+    stay within the phase budget — ``"none"`` when even f64 refutes,
+    ``"unknown"`` when an opaque primitive sits inside the phase.
+    ``hazard`` names the dominating hazard (by eqn source) of the
+    narrowest *refuted* candidate; ``hazards`` carries one line per
+    refuted candidate."""
+
+    phase: str
+    certified_dtype: str
+    hazard: "str | None" = None
+    hazards: tuple = ()
+    eqns: int = 0
+
+    def describe(self) -> str:
+        extra = f" — {self.hazard}" if self.hazard else ""
+        return f"{self.phase}: {self.certified_dtype}{extra}"
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionCertificate:
+    """Outcome of :func:`certify_precision`.
+
+    ``status`` judges the mixed routing (module doc): ``"proved"`` —
+    every :data:`MIXED_NARROW_PHASES` member present certifies bf16
+    (for an un-phased program: ``unphased`` certifies ≥ f32);
+    ``"refuted"`` — a required phase refutes, ``refutations`` name the
+    dominating hazards by source; ``"unknown"`` — an opaque primitive
+    contaminates a required phase. The per-phase table stands either
+    way."""
+
+    status: str
+    phases: "tuple[PhaseVerdict, ...]" = ()
+    refutations: tuple = ()
+    opaque: tuple = ()
+    notes: tuple = ()
+
+    @property
+    def proved(self) -> bool:
+        return self.status == "proved"
+
+    def verdict(self, phase: str) -> "PhaseVerdict | None":
+        for v in self.phases:
+            if v.phase == phase:
+                return v
+        return None
+
+    def certified_dtype(self, phase: str) -> str:
+        v = self.verdict(phase)
+        return v.certified_dtype if v is not None else "unknown"
+
+    @property
+    def precision_digest(self) -> "str | None":
+        """Identity of the verdict table (phase → certified dtype, in
+        program order — never magnitudes or error bounds, which move
+        with seeds and lane counts). None unless proved."""
+        if self.status != "proved":
+            return None
+        ident = "|".join(f"{v.phase}:{v.certified_dtype}"
+                         for v in self.phases)
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        table = ", ".join(f"{v.phase}={v.certified_dtype}"
+                          for v in self.phases)
+        if self.status == "proved":
+            return f"proved: {table}"
+        if self.status == "refuted":
+            head = "; ".join(self.refutations[:2])
+            more = (f" (+{len(self.refutations) - 2} more)"
+                    if len(self.refutations) > 2 else "")
+            return f"REFUTED: {head}{more} [{table}]"
+        return (f"unknown: "
+                f"{'; '.join(self.notes[:2]) or 'uninterpretable'}"
+                f" [{table}]")
+
+    def as_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "phases": {v.phase: v.certified_dtype for v in self.phases},
+            "hazards": {v.phase: list(v.hazards)
+                        for v in self.phases if v.hazards},
+            "digest": self.precision_digest,
+            "refutations": list(self.refutations),
+            "opaque": sorted(set(self.opaque)),
+            "notes": list(self.notes),
+        }
+
+
+class _DtypeWalker:
+    """One candidate dtype's forward error propagation.
+
+    The candidate models the regime the routing can actually PRODUCE,
+    not a wholesale recast: the ``bf16`` candidate is the MXU mixed
+    regime — contraction operands (and the stored Hessian) rounded to
+    bf16, f32 accumulation, elementwise arithmetic still at the traced
+    dtype (``default_matmul_precision("bfloat16")`` changes nothing
+    else). So ``u_ew`` charges elementwise ops, ``u_op`` charges each
+    contraction operand's storage rounding, ``u_acc`` the pairwise
+    accumulation. ``narrow_ew`` marks a candidate whose ELEMENTWISE
+    roundoff is coarser than the traced program's (f32 on an
+    x64-traced program): only there does the noise-floor division
+    hazard apply — the traced constants' floors (μ-floor = 100·eps,
+    clamp guards) were chosen for the traced dtype."""
+
+    def __init__(self, name: str, u_ew: float, u_op: float,
+                 u_acc: float, narrow_ew: bool,
+                 phase_tols: "dict[str, float]"):
+        self.name = name
+        self.u_ew = u_ew
+        self.u_op = u_op
+        self.u_acc = u_acc
+        self.narrow_ew = narrow_ew
+        self.tols = phase_tols
+        #: >0 while re-walking a loop body whose carries have not
+        #: settled — hazards there would blame unsettled intermediate
+        #: bounds; the fixpoint runs muted and one reporting pass runs
+        #: at the settled carries
+        self.mute = 0
+        self.env: "dict[int, _Val]" = {}
+        # phase -> (severity, detail) dominating hazard
+        self.hazards: "dict[str, tuple[float, str]]" = {}
+        self.phase_eqns: "dict[str, int]" = {}
+        self.opaque_phases: "dict[str, set]" = {}
+        self.notes: "list[str]" = []
+        self._seen_hazards: set = set()
+
+    # ---- environment -----------------------------------------------------
+    def read(self, v) -> _Val:
+        val = getattr(v, "val", None)
+        if val is not None:                     # Literal
+            return self._const(val)
+        return self.env.get(id(v), _TOP)
+
+    def write(self, v, val: _Val) -> None:
+        if type(v).__name__ == "DropVar":
+            return
+        self.env[id(v)] = val
+
+    def _const(self, arr) -> _Val:
+        import numpy as np
+
+        try:
+            a = np.asarray(arr)
+            if a.size == 0:
+                return _Val(0.0, 0.0, 0.0)
+            if not np.issubdtype(a.dtype, np.floating) and \
+                    not np.issubdtype(a.dtype, np.integer):
+                return _BOOL
+            lo = float(np.min(a))
+            hi = float(np.max(a))
+            if not (math.isfinite(lo) and math.isfinite(hi)):
+                return _TOP
+            # stored constants are exact at trace time; they pay one
+            # rounding when materialized at the candidate dtype
+            return _Val(lo, hi, self.u_ew)
+        except Exception:  # noqa: BLE001 — unreadable const
+            return _TOP
+
+    # ---- bookkeeping -----------------------------------------------------
+    def _note(self, msg: str) -> None:
+        if msg not in self.notes:
+            self.notes.append(msg)
+
+    def _count(self, phase: str) -> None:
+        self.phase_eqns[phase] = self.phase_eqns.get(phase, 0) + 1
+
+    def _opaque(self, phase: str, prim: str) -> None:
+        self.opaque_phases.setdefault(phase, set()).add(prim)
+
+    def _hazard(self, phase: str, severity: float, detail: str,
+                source: str) -> None:
+        if self.mute:
+            return
+        key = (phase, detail.split(" at ")[0], source)
+        if key in self._seen_hazards:
+            return
+        self._seen_hazards.add(key)
+        msg = f"{detail} at {source}"
+        prev = self.hazards.get(phase)
+        if prev is None or severity > prev[0]:
+            self.hazards[phase] = (severity, msg)
+
+    def _check(self, phase: str, out: _Val, eqn, what: str) -> None:
+        tol = self.tols.get(phase, self.tols["unphased"])
+        if out.rel > tol and math.isfinite(out.mag):
+            self._hazard(
+                phase, out.rel,
+                f"{what}: relative error bound {out.rel:.2e} exceeds "
+                f"the {phase} budget {tol:.0e} at {self.name}",
+                _source_of(eqn))
+
+    # ---- per-primitive rules --------------------------------------------
+    def _add_sub(self, a: _Val, b: _Val, sub: bool, eqn,
+                 phase: str) -> _Val:
+        if sub:
+            b = _Val(-b.hi, -b.lo, b.rel)
+        lo, hi = a.lo + b.lo, a.hi + b.hi
+        if math.isnan(lo) or math.isnan(hi):    # inf - inf
+            lo, hi = -_INF, _INF
+        out_mag = max(abs(lo), abs(hi))
+        in_mag = a.mag + b.mag
+        if in_mag == 0.0:
+            return _Val(lo, hi, self.u_ew)
+        if not math.isfinite(in_mag) or out_mag == 0.0:
+            kappa = 1.0      # nothing provable
+        else:
+            kappa = max(in_mag / max(out_mag, _TINY), 1.0)
+        # PROPAGATION is backward-sense (additive): the accumulated
+        # bound stays relative to the operand scale. κ-compounding a
+        # forward bound across chains of interval-CORRELATED
+        # subtractions (a collocation defect x_next − x_k − dt·f is
+        # small BECAUSE its operands nearly cancel by construction)
+        # would be vacuously refuting — interval arithmetic cannot see
+        # the correlation. κ instead drives the LOCAL catastrophic-
+        # cancellation check: one operation whose provable condition
+        # amplifies the accumulated bound past the phase budget is a
+        # hazard (the mutation test's (x+1e8)−1e8, a near-constant
+        # column minus its mean). This is the model's stated soundness
+        # boundary (docs/static_analysis.md).
+        rel = max(a.rel, b.rel) + self.u_ew
+        amplified = kappa * rel
+        tol = self.tols.get(phase, self.tols["unphased"])
+        if amplified > tol:
+            self._hazard(
+                phase, amplified,
+                f"ill-conditioned {'subtraction' if sub else 'sum'} "
+                f"(provable condition ≥ {kappa:.1e}) amplifies the "
+                f"accumulated error to {amplified:.2e} (> {tol:.0e}) "
+                f"at {self.name}",
+                _source_of(eqn))
+        return _Val(lo, hi, rel)
+
+    def _mul(self, a: _Val, b: _Val) -> _Val:
+        lo, hi = _interval_mul(a, b)
+        return _Val(lo, hi, a.rel + b.rel + self.u_ew)
+
+    def _div(self, a: _Val, b: _Val, eqn, phase: str) -> _Val:
+        if b.minmag == 0.0:
+            # an unguardable-looking division is almost always guarded
+            # by a predicate the lattice cannot see (fraction-to-
+            # boundary where-selects, sign-gated steps): unbounded
+            # output, finite error, soundness-boundary note — the
+            # dynamic --precision-ab identity gate covers the residual
+            # risk
+            self._note(
+                "division by a sign-indefinite interval treated as "
+                "predicate-guarded (unbounded value, finite error) — "
+                "outside the lattice's soundness boundary")
+            return _Val(-_INF, _INF, a.rel + b.rel + self.u_ew)
+        if self.narrow_ew and b.minmag < 100.0 * self.u_ew and \
+                math.isfinite(b.mag):
+            # the μ-floor class: the divisor's floor constant was
+            # chosen for the TRACED dtype (100·eps there); at this
+            # narrower candidate the same floor sits below the noise
+            self._hazard(
+                phase, 1.0 / max(b.minmag, _TINY),
+                f"division by values reaching {b.minmag:.1e} — below "
+                f"100·u({self.name}) = {100.0 * self.u_ew:.1e}, the "
+                f"candidate's noise floor (barrier-parameter / "
+                f"μ-floor class)", _source_of(eqn))
+        inv = _Val(1.0 / b.hi if b.hi > 0 else 1.0 / b.hi,
+                   1.0 / b.lo if b.lo != 0 else _INF, 0.0)
+        if b.lo > 0:
+            inv = _Val(1.0 / b.hi, 1.0 / b.lo, 0.0)
+        elif b.hi < 0:
+            inv = _Val(1.0 / b.hi, 1.0 / b.lo, 0.0)
+        lo, hi = _interval_mul(a, inv)
+        return _Val(lo, hi, a.rel + b.rel + self.u_ew)
+
+    _NL_UNIT = frozenset({"sin", "cos", "tanh", "erf", "logistic"})
+    _NL_POS = frozenset({"exp", "exp2", "expm1", "cosh"})
+
+    def _nonlinear(self, prim: str, args: "list[_Val]", eqn,
+                   phase: str) -> _Val:
+        a = args[0]
+        rel_in = max(v.rel for v in args)
+        if prim in self._NL_UNIT:
+            # bounded range, condition ≤ ~1 in the backward sense
+            return _Val(-1.0 if prim != "logistic" else 0.0, 1.0,
+                        rel_in + self.u_ew)
+        if prim in self._NL_POS:
+            hi = math.exp(min(a.hi, 700.0)) if math.isfinite(a.hi) \
+                else _INF
+            cond = min(a.mag, 1e12) if math.isfinite(a.mag) else 1.0
+            out = _Val(0.0 if prim != "expm1" else -1.0, hi,
+                       cond * rel_in + self.u_ew)
+            self._check(phase, out, eqn, f"exp-class growth ({prim})")
+            return out
+        if prim in ("sqrt", "cbrt"):
+            hi = math.sqrt(a.hi) if a.hi > 0 and math.isfinite(a.hi) \
+                else (a.hi if a.hi <= 0 else _INF)
+            return _Val(0.0, max(hi, 0.0), 0.5 * rel_in + self.u_ew)
+        if prim == "rsqrt":
+            if a.minmag == 0.0:
+                self._hazard(
+                    phase, _INF,
+                    f"rsqrt over an interval touching zero at "
+                    f"{self.name}", _source_of(eqn))
+                return _Val(0.0, _INF, rel_in + self.u_ew)
+            return _Val(0.0, 1.0 / math.sqrt(a.minmag),
+                        0.5 * rel_in + self.u_ew)
+        if prim in ("log", "log1p", "log2"):
+            # |log| is backward stable (log(x(1+δ)) = log x + O(δ)):
+            # the absolute error is one δ; judged backward like a
+            # same-scale subtraction
+            self._note(
+                f"{prim} judged in the backward-error sense (its "
+                f"relative condition is unbounded near roots)")
+            return _Val(-_INF, _INF, rel_in + self.u_ew)
+        # no condition rule: honest backward reading over an unbounded
+        # range (still a KNOWN elementwise primitive — not opaque)
+        self._note(
+            f"no condition rule for elementwise {prim}: judged in the "
+            f"backward-error sense over an unbounded range")
+        return _Val(-_INF, _INF, rel_in + self.u_ew)
+
+    def _nonsmooth(self, prim: str, args: "list[_Val]") -> _Val:
+        rel = max((v.rel for v in args), default=0.0)
+        if prim == "abs":
+            a = args[0]
+            return _Val(a.minmag, a.mag, a.rel)
+        if prim == "max":
+            a, b = args[0], args[-1]
+            return _Val(max(a.lo, b.lo), max(a.hi, b.hi), rel)
+        if prim == "min":
+            a, b = args[0], args[-1]
+            return _Val(min(a.lo, b.lo), min(a.hi, b.hi), rel)
+        if prim == "clamp":
+            lo_b, x, hi_b = args
+            return _Val(max(x.lo, lo_b.lo), min(x.hi, hi_b.hi), x.rel)
+        if prim in ("sign", "floor", "ceil", "round", "is_finite") or \
+                prim.startswith(("eq", "ne", "lt", "le", "gt", "ge",
+                                 "and", "or", "not", "xor")):
+            return _BOOL if prim not in ("floor", "ceil", "round") \
+                else _Val(args[0].lo - 1.0, args[0].hi + 1.0, 0.0)
+        return _Val(_hull(args).lo, _hull(args).hi, rel)
+
+    def _reduce_size(self, eqn) -> int:
+        try:
+            in_sz = 1
+            for d in eqn.invars[0].aval.shape:
+                in_sz *= int(d)
+            out_sz = 1
+            for d in eqn.outvars[0].aval.shape:
+                out_sz *= int(d)
+            return max(in_sz // max(out_sz, 1), 1)
+        except Exception:  # noqa: BLE001
+            return _DEFAULT_AXIS_SIZE
+
+    def _sum_like(self, a: _Val, k: int) -> _Val:
+        lo = _mul_bound(float(k), a.lo) if a.lo < 0 else a.lo
+        hi = _mul_bound(float(k), a.hi) if a.hi > 0 else a.hi
+        return _Val(lo, hi,
+                    a.rel + (_log2(k) + 1.0) * self.u_acc + self.u_ew)
+
+    def _dot(self, a: _Val, b: _Val, eqn) -> _Val:
+        try:
+            (lhs_c, _rhs_c), _ = eqn.params["dimension_numbers"]
+            k = 1
+            for d in lhs_c:
+                k *= int(eqn.invars[0].aval.shape[d])
+            k = max(k, 1)
+        except Exception:  # noqa: BLE001
+            k = _DEFAULT_AXIS_SIZE
+        lo, hi = _interval_mul(a, b)
+        mag = _mul_bound(float(k), max(abs(lo), abs(hi)))
+        if a.lo >= 0.0 and b.lo >= 0.0:
+            lo2, hi2 = _mul_bound(float(k), lo), mag
+        else:
+            lo2, hi2 = -mag, mag
+        return _Val(lo2, hi2, a.rel + b.rel + 2.0 * self.u_op
+                    + (_log2(k) + 1.0) * self.u_acc + self.u_ew)
+
+    # ---- the walk --------------------------------------------------------
+    def walk(self, obj, phase: str) -> None:
+        jaxpr, consts = _as_jaxpr(obj)
+        for cv, cval in zip(jaxpr.constvars, consts):
+            self.write(cv, self._const(cval))
+        for eqn in jaxpr.eqns:
+            self.eqn(eqn, _phase_of(eqn, phase))
+
+    def _inline(self, eqn, sub, phase: str) -> None:
+        sub_jaxpr, consts = _as_jaxpr(sub)
+        for iv, ov in zip(sub_jaxpr.invars, eqn.invars):
+            self.write(iv, self.read(ov))
+        self.walk(sub, phase)
+        for ov, sv in zip(eqn.outvars, sub_jaxpr.outvars):
+            self.write(ov, self.read(sv))
+
+    def _loop_body(self, eqn, body, carries_in, n_consts: int,
+                   phase: str, label: str) -> "list[_Val]":
+        """Carry fixpoint with honest widening (module doc). The
+        fixpoint iterations run MUTED — hazards blamed on unsettled
+        intermediate carries would be noise — then ONE reporting pass
+        at the settled carries records the real ones."""
+        body_jaxpr, _ = _as_jaxpr(body)
+        carry_vals = [self.read(v) for v in carries_in]
+        n_carry = len(carry_vals)
+        widened = False
+        self.mute += 1
+        try:
+            for it in range(_FIXPOINT_ITERS):
+                for iv, cval in zip(body_jaxpr.invars[n_consts:],
+                                    carry_vals):
+                    self.write(iv, cval)
+                self.walk(body, phase)
+                new_vals = [
+                    _hull([old, self.read(ov)])
+                    for old, ov in zip(
+                        carry_vals, body_jaxpr.outvars[:n_carry])]
+                if new_vals == carry_vals:
+                    break
+                carry_vals = new_vals
+                if it >= _WIDEN_AFTER:
+                    # the widened carry is PINNED: [-inf, inf] interval
+                    # with one fresh roundoff. Re-iterating would only
+                    # compound the per-iteration error budget — which
+                    # is exactly what the lattice does NOT certify for
+                    # a non-settling loop (an IPM iteration recomputes
+                    # its residuals from state each round; the
+                    # compensator, not accumulation, owns that error)
+                    carry_vals = [
+                        _Val(-_INF, _INF, self.u_ew)
+                        for _ in carry_vals]
+                    widened = True
+                    self._note(
+                        f"{label} fixpoint widened: carried intervals "
+                        f"unbounded, carried error reset to one fresh "
+                        f"roundoff — per-iteration compounding is the "
+                        f"compensator's contract, not the lattice's")
+                    break
+        finally:
+            self.mute -= 1
+        # one reporting pass at the settled (or pinned-widened)
+        # carries records the real hazards
+        for iv, cval in zip(body_jaxpr.invars[n_consts:], carry_vals):
+            self.write(iv, cval)
+        self.walk(body, phase)
+        if widened:
+            return carry_vals
+        return [_hull([old, self.read(ov)])
+                for old, ov in zip(carry_vals,
+                                   body_jaxpr.outvars[:n_carry])]
+
+    def eqn(self, eqn, phase: str) -> None:  # noqa: PLR0911,PLR0912
+        name = eqn.primitive.name
+        args = [self.read(v) for v in eqn.invars]
+
+        # -- control flow / calls (not counted as phase arithmetic) --
+        if name in _CALL_PRIMS:
+            sub = eqn.params.get(_CALL_PRIMS[name])
+            if sub is not None:
+                self._inline(eqn, sub, phase)
+                return
+        if name == "shard_map":
+            self._inline(eqn, eqn.params["jaxpr"], phase)
+            return
+        if name == "cond":
+            branch_outs = []
+            for br in eqn.params["branches"]:
+                br_jaxpr, _ = _as_jaxpr(br)
+                for iv, ov in zip(br_jaxpr.invars, eqn.invars[1:]):
+                    self.write(iv, self.read(ov))
+                self.walk(br, phase)
+                branch_outs.append([self.read(v)
+                                    for v in br_jaxpr.outvars])
+            for i, ov in enumerate(eqn.outvars):
+                self.write(ov, _hull([outs[i] for outs in branch_outs]))
+            return
+        if name == "scan":
+            n_consts = int(eqn.params["num_consts"])
+            n_carry = int(eqn.params["num_carry"])
+            body = eqn.params["jaxpr"]
+            body_jaxpr, _ = _as_jaxpr(body)
+            for iv, ov in zip(body_jaxpr.invars[:n_consts],
+                              eqn.invars[:n_consts]):
+                self.write(iv, self.read(ov))
+            for iv, ov in zip(body_jaxpr.invars[n_consts + n_carry:],
+                              eqn.invars[n_consts + n_carry:]):
+                self.write(iv, self.read(ov))
+            carry = self._loop_body(
+                eqn, body, eqn.invars[n_consts:n_consts + n_carry],
+                n_consts, phase, "scan")
+            for i, ov in enumerate(eqn.outvars):
+                if i < n_carry:
+                    self.write(ov, carry[i])
+                else:
+                    self.write(ov, self.read(
+                        body_jaxpr.outvars[i]))
+            return
+        if name == "while":
+            cn = int(eqn.params["cond_nconsts"])
+            bn = int(eqn.params["body_nconsts"])
+            body = eqn.params["body_jaxpr"]
+            body_jaxpr, _ = _as_jaxpr(body)
+            for iv, ov in zip(body_jaxpr.invars[:bn],
+                              eqn.invars[cn:cn + bn]):
+                self.write(iv, self.read(ov))
+            carry = self._loop_body(
+                eqn, body, eqn.invars[cn + bn:], bn, phase, "while")
+            for ov, cval in zip(eqn.outvars, carry):
+                self.write(ov, cval)
+            return
+
+        # -- data primitives -----------------------------------------
+        self._count(phase)
+        if name in CALLBACK_PRIMS:
+            self._opaque(phase, name)
+            for ov in eqn.outvars:
+                self.write(ov, _TOP)
+            return
+        if name in COLLECTIVE_PRIMS:
+            out = self._sum_like(_hull(args), _DEFAULT_AXIS_SIZE) \
+                if name in ("psum", "psum2") \
+                else _hull(args)
+            for ov in eqn.outvars:
+                self.write(ov, out)
+            return
+        if name in STRUCTURAL or name in (
+                "stop_gradient", "copy", "broadcast_in_dim", "squeeze",
+                "reshape", "transpose", "slice", "dynamic_slice",
+                "dynamic_update_slice", "concatenate", "pad", "gather",
+                "scatter", "scatter-add", "rev", "select_n",
+                "convert_element_type", "reduce_precision", "iota",
+                "real", "imag"):
+            if name == "iota":
+                try:
+                    n = int(eqn.outvars[0].aval.shape[
+                        int(eqn.params.get("dimension", 0))])
+                except Exception:  # noqa: BLE001
+                    n = _DEFAULT_AXIS_SIZE
+                self.write(eqn.outvars[0], _Val(0.0, float(n - 1), 0.0))
+                return
+            if name in ("convert_element_type", "reduce_precision"):
+                a = args[0]
+                self.write(eqn.outvars[0],
+                           _Val(a.lo, a.hi, a.rel + self.u_ew))
+                return
+            if name == "select_n":
+                out = _hull(args[1:])
+            elif name == "scatter-add":
+                out = self._sum_like(_hull(args), 2)
+            else:
+                data = args
+                spec = STRUCTURAL.get(name)
+                if isinstance(spec, tuple):
+                    data = [args[i] for i in spec if i < len(args)]
+                out = _hull(data)
+            for ov in eqn.outvars:
+                self.write(ov, out)
+            return
+        if name in ("add", "add_any", "sub"):
+            out = self._add_sub(args[0], args[1], name == "sub", eqn,
+                                phase)
+        elif name == "neg":
+            a = args[0]
+            out = _Val(-a.hi, -a.lo, a.rel)
+        elif name == "mul":
+            out = self._mul(args[0], args[1])
+            self._check(phase, out, eqn, "product")
+        elif name == "div":
+            out = self._div(args[0], args[1], eqn, phase)
+        elif name in ("integer_pow", "square"):
+            a = args[0]
+            y = abs(int(eqn.params.get("y", 2)))
+            lo, hi = a.lo, a.hi
+            mag = min(a.mag ** y, _INF) if math.isfinite(a.mag) \
+                else _INF
+            if y % 2 == 0:
+                lo2, hi2 = (0.0 if a.minmag == 0.0
+                            else min(a.minmag ** y, _INF)), mag
+            else:
+                lo2, hi2 = (-mag if lo < 0 else
+                            min(max(lo, 0.0) ** y, _INF)), mag
+            out = _Val(lo2, hi2, y * a.rel + self.u_ew)
+            self._check(phase, out, eqn, "power")
+        elif name == "dot_general":
+            out = self._dot(args[0], args[1], eqn)
+            self._check(phase, out, eqn, "contraction")
+        elif name in LINEAR_REDUCE:
+            out = self._sum_like(args[0], self._reduce_size(eqn))
+            self._check(phase, out, eqn, "reduction")
+        elif name in NONSMOOTH_REDUCE:
+            a = args[0]
+            out = _Val(a.lo, a.hi, a.rel)
+        elif name == "reduce_prod":
+            k = self._reduce_size(eqn)
+            a = args[0]
+            mag = min(a.mag ** k, _INF) if math.isfinite(a.mag) and \
+                a.mag > 1.0 else a.mag
+            out = _Val(-mag, mag, k * a.rel + _log2(k) * self.u_ew)
+            self._check(phase, out, eqn, "product reduction")
+        elif name in NONSMOOTH_EW:
+            out = self._nonsmooth(name, args)
+        elif name in NONLINEAR_EW or name in (
+                "pow", "atan2", "rem", "logistic", "erf", "erf_inv",
+                "erfc"):
+            out = self._nonlinear(name, args, eqn, phase)
+        else:
+            # opaque primitive: unknown, like every other pass — its
+            # outputs are fresh unbounded values and the phase cannot
+            # be certified at any dtype
+            self._opaque(phase, name)
+            for ov in eqn.outvars:
+                self.write(ov, _TOP)
+            return
+        for ov in eqn.outvars:
+            self.write(ov, out)
+
+
+def _seed_vals(jaxpr, seeds, u: float) -> "list[_Val]":
+    out = []
+    for i, _v in enumerate(jaxpr.invars):
+        lo, hi = -_DEFAULT_MAG, _DEFAULT_MAG
+        if seeds is not None and i in seeds:
+            lo, hi = seeds[i]
+            lo = float(lo) if math.isfinite(lo) else -_DEFAULT_MAG
+            hi = float(hi) if math.isfinite(hi) else _DEFAULT_MAG
+        out.append(_Val(float(lo), float(hi), u))
+    return out
+
+
+def _program_roundoff(jaxpr) -> float:
+    """The traced program's own unit roundoff: the widest float dtype
+    among its invars (f32 unless the program was traced under x64)."""
+    import numpy as np
+
+    u = _UNIT_ROUNDOFF["f32"]
+    for v in list(jaxpr.invars) + list(jaxpr.outvars):
+        dt = getattr(getattr(v, "aval", None), "dtype", None)
+        if dt is not None and np.issubdtype(dt, np.float64):
+            return _UNIT_ROUNDOFF["f64"]
+    return u
+
+
+def certify_precision(fn_or_jaxpr, *args, seeds=None,
+                      phase_tols=None) -> PrecisionCertificate:
+    """Certify the per-phase precision safety of a traced program.
+
+    ``fn_or_jaxpr``: a ``ClosedJaxpr`` (pass no ``args``) or a callable
+    traced as ``jax.make_jaxpr(fn)(*args)``. ``seeds``: optional
+    ``{flat_invar_index: (lo, hi)}`` magnitude intervals (variable
+    bounds, typically); unseeded invars get ±1e4. ``phase_tols``
+    overrides :data:`PHASE_TOLS` per phase.
+
+    Runs the error lattice once per candidate dtype (module doc) and
+    assembles the per-phase verdict table. Never executes user code."""
+    if hasattr(fn_or_jaxpr, "jaxpr") and not args:
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        closed = jax.make_jaxpr(fn_or_jaxpr)(*args)
+    tols = dict(PHASE_TOLS)
+    if phase_tols:
+        tols.update(phase_tols)
+    try:
+        u_prog = _program_roundoff(closed.jaxpr)
+        walkers = []
+        for cand in CANDIDATE_DTYPES:
+            u_c = _UNIT_ROUNDOFF[cand]
+            if cand == "bf16":
+                # the MXU mixed regime the routing actually produces:
+                # elementwise stays at the traced dtype, contraction
+                # operands round to bf16, accumulation at f32
+                u_ew = u_prog
+                u_op = u_c
+                u_acc = _UNIT_ROUNDOFF["f32"]
+            else:
+                u_ew = u_op = u_acc = u_c
+            w = _DtypeWalker(cand, u_ew, u_op, u_acc,
+                             narrow_ew=u_ew > u_prog, phase_tols=tols)
+            for iv, val in zip(closed.jaxpr.invars,
+                               _seed_vals(closed.jaxpr, seeds, u_ew)):
+                w.write(iv, val)
+            w.walk(closed, "unphased")
+            walkers.append(w)
+    except Exception as exc:  # noqa: BLE001 — certification must not
+        # kill a build; an uninterpretable program is "unknown"
+        return PrecisionCertificate(
+            status="unknown",
+            notes=(f"interpreter error: {exc!r}",))
+
+    phase_order: "list[str]" = []
+    for w in walkers:
+        for p in w.phase_eqns:
+            if p not in phase_order:
+                phase_order.append(p)
+    opaque: "set[str]" = set()
+    notes: "list[str]" = []
+    for w in walkers:
+        for prims in w.opaque_phases.values():
+            opaque.update(prims)
+    for n in walkers[0].notes:
+        notes.append(n)
+
+    verdicts = []
+    for p in phase_order:
+        cand_hazards = []
+        certified = "none"
+        dominating = None
+        if any(p in w.opaque_phases for w in walkers):
+            prims = sorted(set().union(
+                *(w.opaque_phases.get(p, set()) for w in walkers)))
+            verdicts.append(PhaseVerdict(
+                phase=p, certified_dtype="unknown",
+                hazard=f"opaque primitive(s) {', '.join(prims)} — "
+                       f"outside the lattice",
+                eqns=walkers[0].phase_eqns.get(p, 0)))
+            continue
+        for w in walkers:
+            hz = w.hazards.get(p)
+            if hz is None:
+                certified = w.name
+                break
+            cand_hazards.append(f"{w.name}: {hz[1]}")
+            dominating = hz[1]
+        verdicts.append(PhaseVerdict(
+            phase=p, certified_dtype=certified,
+            hazard=(cand_hazards[0].split(": ", 1)[1]
+                    if cand_hazards else None)
+            if certified != "none" else dominating,
+            hazards=tuple(cand_hazards),
+            eqns=walkers[0].phase_eqns.get(p, 0)))
+
+    by_phase = {v.phase: v for v in verdicts}
+    refutations: "list[str]" = []
+    unknown = False
+    if set(by_phase) <= {"unphased"}:
+        # a plain function: must survive its own (f32-class) budget
+        v = by_phase.get("unphased")
+        if v is not None:
+            if v.certified_dtype == "unknown":
+                unknown = True
+            elif v.certified_dtype not in ("bf16", "f32"):
+                f32_haz = next(
+                    (h for h in v.hazards if h.startswith("f32:")),
+                    v.hazard)
+                refutations.append(
+                    f"program refutes f32: {f32_haz}")
+    else:
+        for p in MIXED_NARROW_PHASES:
+            v = by_phase.get(p)
+            if v is None:
+                continue
+            if v.certified_dtype == "unknown":
+                unknown = True
+            elif v.certified_dtype != "bf16":
+                refutations.append(
+                    f"mixed routing needs {p} at bf16, certified "
+                    f"{v.certified_dtype}: {v.hazards[0] if v.hazards else v.hazard}")
+        for p in MIXED_FULL_PHASES:
+            v = by_phase.get(p)
+            if v is not None and v.certified_dtype == "none":
+                refutations.append(
+                    f"{p} refutes every candidate dtype: {v.hazard}")
+    if refutations:
+        status = "refuted"
+    elif unknown:
+        status = "unknown"
+        notes.append(
+            "an opaque primitive contaminates a phase the mixed "
+            "routing would run narrow")
+    else:
+        status = "proved"
+    return PrecisionCertificate(
+        status=status,
+        phases=tuple(verdicts),
+        refutations=tuple(refutations),
+        opaque=tuple(sorted(opaque)),
+        notes=tuple(notes),
+    )
+
+
+def certify_solver_precision(nlp, theta, n_w: int, w_lb=None, w_ub=None,
+                             options=None,
+                             solver: str = "ipm") -> PrecisionCertificate:
+    """Certify the traced interior-point solve of one NLP.
+
+    Traces ``solve_nlp`` (or ``solve_qp`` for ``solver="qp"``) on shape
+    templates — the phases come from the solver's own ``phase_scope``
+    annotations — and seeds the primal invar from the variable bounds.
+    ``theta`` is closed over, so its concrete values become exact
+    lattice constants. Never executes the solve."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentlib_mpc_tpu.ops.solver import SolverOptions, solve_nlp
+
+    opts = options or SolverOptions()
+    # certify the FULL-precision program: the certificate decides
+    # whether the mixed routing may be applied to it
+    if getattr(opts, "precision", "auto") != "f64":
+        opts = opts._replace(precision="f64")
+    lb = jnp.full((n_w,), -_DEFAULT_MAG) if w_lb is None \
+        else jnp.asarray(w_lb)
+    ub = jnp.full((n_w,), _DEFAULT_MAG) if w_ub is None \
+        else jnp.asarray(w_ub)
+    if solver == "qp":
+        from agentlib_mpc_tpu.ops.qp import solve_qp as _solve
+    else:
+        _solve = solve_nlp
+
+    def run(w0):
+        return _solve(nlp, w0, theta, lb, ub, opts)
+
+    import numpy as np
+
+    lo = float(np.nanmax([-_DEFAULT_MAG,
+                          float(np.min(np.asarray(lb)))]))
+    hi = float(np.nanmin([_DEFAULT_MAG,
+                          float(np.max(np.asarray(ub)))]))
+    if not math.isfinite(lo):
+        lo = -_DEFAULT_MAG
+    if not math.isfinite(hi):
+        hi = _DEFAULT_MAG
+    closed = jax.make_jaxpr(run)(jnp.zeros((n_w,)))
+    return certify_precision(closed, seeds={0: (lo, hi)})
+
+
+def check_precision_budget(cert: PrecisionCertificate,
+                           expect: str) -> "list[str]":
+    """Compare a certificate against one ``[jaxpr.precision.expect]``
+    pin: ``expect`` is ``"phase=dtype,phase=dtype,..."`` (a flat string
+    so the minimal built-in TOML parser can read it). A drift in EITHER
+    direction fails — a phase suddenly refusing bf16 is a lost
+    optimization, a phase suddenly certifying narrower than pinned is a
+    certifier regression about to mis-route production solves.
+
+    Returns violation strings (empty = within budget)."""
+    out = []
+    for part in expect.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            out.append(f"unparseable precision pin {part!r} "
+                       f"(want phase=dtype)")
+            continue
+        phase, want = (s.strip() for s in part.split("=", 1))
+        got = cert.certified_dtype(phase)
+        if got != want:
+            v = cert.verdict(phase)
+            detail = f" ({v.hazard})" if v is not None and v.hazard \
+                else ""
+            out.append(
+                f"phase {phase} certifies {got!r}, budget pins "
+                f"{want!r}{detail} — the certified routing table "
+                f"drifted")
+    return out
+
+
+def precision_gate_summary(budgets: "dict | None" = None) -> dict:
+    """The ``--jaxpr`` CLI's precision leg: certify the traced solve of
+    every example-menu entry and hold the per-phase certified-dtype
+    table to the ``[jaxpr.precision]`` pins. Also the
+    ``precision_certificates`` section of ``bench.py
+    --emit-metrics``."""
+    from agentlib_mpc_tpu.lint.jaxpr.examples import EXAMPLE_OCPS
+    from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+    cfg = (budgets if budgets is not None else load_budgets()).get(
+        "jaxpr", {}).get("precision", {})
+    expects = cfg.get("expect", {})
+    rows = []
+    failures = 0
+    for ex in EXAMPLE_OCPS:
+        try:
+            ocp = ex.build()
+            theta = ocp.default_params()
+            w_lb, w_ub = ocp.bounds(theta)
+            cert = certify_solver_precision(
+                ocp.nlp, theta, ocp.n_w, w_lb, w_ub)
+            violations = []
+            pin = expects.get(ex.name)
+            if pin:
+                violations = check_precision_budget(cert, pin)
+        except Exception as exc:  # noqa: BLE001 — report, don't crash CI
+            rows.append({"name": ex.name, "error": repr(exc)})
+            failures += 1
+            continue
+        if violations:
+            failures += len(violations)
+        rows.append({
+            "name": ex.name,
+            "certificate": cert.as_dict(),
+            "digest": cert.precision_digest,
+            "violations": violations,
+        })
+    return {"examples": rows, "failures": failures,
+            "budget": dict(cfg)}

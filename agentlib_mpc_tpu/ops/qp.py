@@ -37,6 +37,7 @@ to the wrong point — gate it behind :func:`is_lq` (the backends do).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -47,6 +48,7 @@ from agentlib_mpc_tpu.ops import stagewise as stage_ops
 from agentlib_mpc_tpu.ops.solver import (
     JAC_PATHS,
     KKT_PATHS,
+    PRECISION_PATHS,
     NLPFunctions,
     SolverOptions,
     SolverResult,
@@ -56,6 +58,7 @@ from agentlib_mpc_tpu.ops.solver import (
     _resolve_jacobian,
     _resolve_kkt,
     _resolve_method,
+    _resolve_precision,
     _row_scaling,
     _safe_max,
 )
@@ -255,6 +258,23 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
                                    opts.stage_partition, opts.stage_min_size)
     kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
     jac_path_code = jnp.asarray(JAC_PATHS.index(jac_path))
+    # precision routing (same contract as solve_nlp): the QP's only
+    # certified-narrow work is the one-time structure extraction — the
+    # three AD passes that contract the constant (H, A, C). The
+    # per-iteration factor/resolve stays under the entry point's
+    # "highest" context. No phase_scope names here: the fast path is a
+    # leaf the fleet engines embed whole, and naming its interior would
+    # splinter the enclosing step's phase attribution (the observatory
+    # attributes the embedded QP to the surrounding phase).
+    precision_path = _resolve_precision(opts)
+    precision_path_code = jnp.asarray(PRECISION_PATHS.index(precision_path))
+    if precision_path == "mixed":
+        mixed_mm = lambda: jax.default_matmul_precision("bfloat16")
+        narrow_store = lambda t: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16).astype(x.dtype), t)
+    else:
+        mixed_mm = lambda: contextlib.nullcontext()
+        narrow_store = lambda t: t
 
     # dtype-aware feasibility target, shared definition with solve_nlp:
     # the f32 noise floor of O(1)-scaled constraints sits near 1e3·eps,
@@ -281,48 +301,58 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
     ub = w_ub / d_w
 
     # ---- one-time structure extraction (3 AD passes, exact for LQ) ---------
+    # under the mixed routing this is the QP's certified-narrow region:
+    # the extraction matmuls run bf16-input/f32-accumulate and the
+    # constant Hessian is rounded through bf16 storage; the linear
+    # constraint rows (A, C) stay exact — feasibility is the
+    # compensator-free part of the residual
     wz = jnp.zeros((n,), dtype)
     f0 = f(wz)
-    if plan is not None:
-        # banded extraction: compressed pullbacks give (c, A, C) as row
-        # windows, compressed forward seeds give H as banded columns —
-        # O(N) storage and FLOPs for all four
-        def fgh_scaled(w):
-            return jnp.concatenate([f(w)[None], g(w), h(w)])
+    with mixed_mm():
+        if plan is not None:
+            # banded extraction: compressed pullbacks give (c, A, C) as
+            # row windows, compressed forward seeds give H as banded
+            # columns — O(N) storage and FLOPs for all four
+            def fgh_scaled(w):
+                return jnp.concatenate([f(w)[None], g(w), h(w)])
 
-        vals_z, c, A_rows, C_rows = sjac.banded_fgh_jac(plan, fgh_scaled,
-                                                        wz)
-        g0 = vals_z[1:1 + m_e]
-        h0 = vals_z[1 + m_e:]
-        CH = sjac.banded_lagrangian_hessian(plan, jax.grad(f), wz)
-        H_rows = sjac.hessian_rows(plan, CH)
-        h_mv = lambda x: sjac.band_matvec(H_rows, plan.hrow_cols_safe, x)
-        a_mv = lambda x: sjac.band_matvec(A_rows, plan.g_cols_safe, x)
-        a_t_mv = lambda v: sjac.band_rmatvec(A_rows, plan.g_cols_safe,
-                                             v, n)
-        c_mv = lambda x: sjac.band_matvec(C_rows, plan.h_cols_safe, x)
-        c_t_mv = lambda v: sjac.band_rmatvec(C_rows, plan.h_cols_safe,
-                                             v, n)
-    else:
-        c = jax.grad(f)(wz)                   # ∇f(0)
-        H = jax.hessian(f)(wz)                # constant
-        if m_e:
-            A = jax.jacrev(g)(wz)
-            g0 = g(wz)                        # g(w) = A w + g0
+            vals_z, c, A_rows, C_rows = sjac.banded_fgh_jac(
+                plan, fgh_scaled, wz)
+            g0 = vals_z[1:1 + m_e]
+            h0 = vals_z[1 + m_e:]
+            CH = narrow_store(
+                sjac.banded_lagrangian_hessian(plan, jax.grad(f), wz))
+            H_rows = sjac.hessian_rows(plan, CH)
+            h_mv = lambda x: sjac.band_matvec(H_rows,
+                                              plan.hrow_cols_safe, x)
+            a_mv = lambda x: sjac.band_matvec(A_rows, plan.g_cols_safe,
+                                              x)
+            a_t_mv = lambda v: sjac.band_rmatvec(A_rows,
+                                                 plan.g_cols_safe, v, n)
+            c_mv = lambda x: sjac.band_matvec(C_rows, plan.h_cols_safe,
+                                              x)
+            c_t_mv = lambda v: sjac.band_rmatvec(C_rows,
+                                                 plan.h_cols_safe, v, n)
         else:
-            A = jnp.zeros((0, n), dtype)
-            g0 = jnp.zeros((0,), dtype)
-        if m_h:
-            C = jax.jacrev(h)(wz)
-            h0 = h(wz)                        # h(w) = C w + h0
-        else:
-            C = jnp.zeros((0, n), dtype)
-            h0 = jnp.zeros((0,), dtype)
-        h_mv = lambda x: H @ x
-        a_mv = lambda x: A @ x
-        a_t_mv = lambda v: A.T @ v
-        c_mv = lambda x: C @ x
-        c_t_mv = lambda v: C.T @ v
+            c = jax.grad(f)(wz)                   # ∇f(0)
+            H = narrow_store(jax.hessian(f)(wz))  # constant
+            if m_e:
+                A = jax.jacrev(g)(wz)
+                g0 = g(wz)                        # g(w) = A w + g0
+            else:
+                A = jnp.zeros((0, n), dtype)
+                g0 = jnp.zeros((0,), dtype)
+            if m_h:
+                C = jax.jacrev(h)(wz)
+                h0 = h(wz)                        # h(w) = C w + h0
+            else:
+                C = jnp.zeros((0, n), dtype)
+                h0 = jnp.zeros((0,), dtype)
+            h_mv = lambda x: H @ x
+            a_mv = lambda x: A @ x
+            a_t_mv = lambda v: A.T @ v
+            c_mv = lambda x: C @ x
+            c_t_mv = lambda v: C.T @ v
 
     def f_val(w):
         return f0 + c @ w + 0.5 * w @ h_mv(w)
@@ -401,24 +431,26 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
         # so the converged solution is unperturbed
         reg = delta + sigma_L + sigma_U
         if plan is not None:
-            D, E = sjac.assemble_kkt_banded(
-                plan, CH, A_rows, C_rows,
-                sigma_s if m_h else jnp.zeros((0,), dtype), reg,
-                opts.delta_c)
+            with mixed_mm():
+                D, E = sjac.assemble_kkt_banded(
+                    plan, CH, A_rows, C_rows,
+                    sigma_s if m_h else jnp.zeros((0,), dtype), reg,
+                    opts.delta_c)
             factor = ("stage_banded",
                       (stage_ops.factor_kkt_stage_banded(D, E),
                        plan.partition))
         else:
-            W = H + reg * jnp.eye(n, dtype=dtype)
-            if m_h:
-                W = W + C.T @ (sigma_s[:, None] * C)
-            if m_e:
-                K = jnp.block([
-                    [W, A.T],
-                    [A, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
-                ])
-            else:
-                K = W
+            with mixed_mm():
+                W = H + reg * jnp.eye(n, dtype=dtype)
+                if m_h:
+                    W = W + C.T @ (sigma_s[:, None] * C)
+                if m_e:
+                    K = jnp.block([
+                        [W, A.T],
+                        [A, -opts.delta_c * jnp.eye(m_e, dtype=dtype)],
+                    ])
+                else:
+                    K = W
             factor = _factor_kkt(K, kkt_path, opts.stage_partition)
 
         def newton_dir(mu_s, mu_L, mu_U):
@@ -602,6 +634,7 @@ def _solve_qp_impl(nlp, w0, theta, w_lb, w_ub, opts, y0, z0, max_iter_arg):
         constraint_violation=viol_raw,
         kkt_path=kkt_path_code,
         jac_path=jac_path_code,
+        precision_path=precision_path_code,
     )
     return SolverResult(
         w=w * d_w,

@@ -54,6 +54,7 @@ mirroring the reference's ``Results.stats``
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable, NamedTuple
 
@@ -183,6 +184,24 @@ class SolverOptions(NamedTuple):
     #: projected peak-HBM bound — enforced in
     #: ``parallel/fused_admm.py``).
     fusion: str = "auto"
+    #: certificate-gated mixed precision (ISSUE 20). "f64" — every phase
+    #: at the traced dtype under matmul precision "highest" (the
+    #: historical behavior; the name means "full", matching the
+    #: certificate vocabulary, not literal float64). "mixed" — the
+    #: MXU-dominant phases the precision certificate can prove safe
+    #: (eval_jac: Hessian contraction; assemble: banded/dense KKT
+    #: assembly) run bf16-input / f32-accumulate
+    #: (``default_matmul_precision("bfloat16")`` + bf16 storage rounding
+    #: of the Lagrangian Hessian) while factor / resolve / line-search
+    #: stay at the traced precision with the resolve path's 2-step
+    #: iterative refinement as the certified compensator. "auto" — mixed
+    #: on TPU (where the MXU makes it a throughput win), full elsewhere.
+    #: "require" — mixed, AND every certificate-carrying build seam
+    #: (fused fleet, scenario fleet) REFUSES to build unless the
+    #: precision certificate proves the mixed routing
+    #: (``lint/jaxpr/precision.py``; refusal happens at engine build —
+    #: this traced function cannot run the certifier on itself).
+    precision: str = "auto"
 
 
 def attach_stage_partition(options: SolverOptions,
@@ -255,6 +274,31 @@ KKT_PATHS = ("lu", "ldl", "stage")
 JAC_PATHS = ("dense", "sparse")
 
 
+#: precision-routing codes carried in ``SolverStats.precision_path``
+#: (trace-time constant, like ``kkt_path``): "full" — every phase at the
+#: traced dtype; "mixed" — certified-safe phases at bf16-input /
+#: f32-accumulate (see ``SolverOptions.precision``)
+PRECISION_PATHS = ("full", "mixed")
+
+
+def _resolve_precision(opts: "SolverOptions") -> str:
+    """Trace-time resolution of ``options.precision`` to a
+    :data:`PRECISION_PATHS` member ("require" resolves to the mixed
+    program — the refusal it implies is enforced where certificates are
+    built, at the engine seams)."""
+    precision = getattr(opts, "precision", "auto")
+    if precision not in ("auto", "f64", "mixed", "require"):
+        raise ValueError(
+            f"precision must be 'auto', 'f64', 'mixed' or 'require', "
+            f"got {precision!r} (booleans/dtypes are not accepted: use "
+            f"the strings)")
+    if precision == "f64":
+        return "full"
+    if precision in ("mixed", "require"):
+        return "mixed"
+    return "mixed" if jax.default_backend() == "tpu" else "full"
+
+
 def _path_name(code, table) -> "str | None":
     """Decode a (possibly batched) per-trace-constant path code against
     ``table``; None when the stats predate the field or carry -1."""
@@ -275,6 +319,12 @@ def kkt_path_name(code) -> "str | None":
 def jac_path_name(code) -> "str | None":
     """Human-readable derivative path from ``SolverStats.jac_path``."""
     return _path_name(code, JAC_PATHS)
+
+
+def precision_path_name(code) -> "str | None":
+    """Human-readable precision routing from
+    ``SolverStats.precision_path``."""
+    return _path_name(code, PRECISION_PATHS)
 
 
 #: initial-point provenance codes carried in ``SolverStats.
@@ -311,6 +361,9 @@ class SolverStats(NamedTuple):
     #: (callers that never gate a prediction leave the default, which
     #: telemetry records as "plain")
     init_point_source: "jnp.ndarray | int" = -1
+    #: index into :data:`PRECISION_PATHS` of the precision routing this
+    #: trace runs (trace-time constant, like ``kkt_path``; -1 = legacy)
+    precision_path: "jnp.ndarray | int" = -1
 
 
 class SolverResult(NamedTuple):
@@ -351,6 +404,12 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
         jac_counter = telemetry.counter(
             "solver_jacobian_path_solves_total",
             "solves by derivative pipeline (dense / sparse)")
+    ppath = precision_path_name(getattr(stats, "precision_path", -1))
+    if ppath is not None:
+        prec_counter = telemetry.counter(
+            "solver_precision_path_solves_total",
+            "solves by precision routing (full / mixed) — mixed = "
+            "certified phases at bf16-input/f32-accumulate")
     # initial-point provenance is data-dependent per lane (the in-graph
     # warm-start gate selects per solve), so it is decoded per index —
     # not once per batch like the trace-time path codes
@@ -373,6 +432,8 @@ def record_solver_stats(stats: SolverStats, **labels) -> None:
             path_counter.inc(kkt_path=path, **labels)
         if jpath is not None:
             jac_counter.inc(jac_path=jpath, **labels)
+        if ppath is not None:
+            prec_counter.inc(precision=ppath, **labels)
         src = init_point_source_name(
             src_codes[i] if src_codes.size == iters.shape[0]
             else src_codes[0]) or "plain"
@@ -713,6 +774,25 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                                    opts.stage_partition, opts.stage_min_size)
     kkt_path_code = jnp.asarray(KKT_PATHS.index(kkt_path))
     jac_path_code = jnp.asarray(JAC_PATHS.index(jac_path))
+    # precision routing is a trace-time constant like the paths above.
+    # ``mixed_mm`` wraps ONLY the certified-narrow phases (eval_jac,
+    # assemble — the certificate's MIXED_NARROW_PHASES) in bf16-input /
+    # f32-accumulate matmul precision; ``narrow_store`` rounds the
+    # Lagrangian Hessian through bf16 storage so the routing's numerics
+    # are honestly those of a bf16-resident operand (the --precision-ab
+    # identity gate measures exactly this program). Everything else
+    # stays under the entry point's ``default_matmul_precision
+    # ("highest")`` — the inner context overrides it just for the
+    # narrow blocks.
+    precision_path = _resolve_precision(opts)
+    precision_path_code = jnp.asarray(PRECISION_PATHS.index(precision_path))
+    if precision_path == "mixed":
+        mixed_mm = lambda: jax.default_matmul_precision("bfloat16")
+        narrow_store = lambda t: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16).astype(x.dtype), t)
+    else:
+        mixed_mm = lambda: contextlib.nullcontext()
+        narrow_store = lambda t: t
     # the fused line search carries per-candidate DENSE Jacobians — a
     # TPU-latency trade the sparse pipeline replaces wholesale
     fused_ls = jac_path == "dense" and (
@@ -870,10 +950,12 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
             # one linearization instead of n) assembled STRAIGHT into
             # the banded block-tridiagonal layout — the dense KKT matrix
             # never exists on this path
-            with phase_scope("eval_jac"):
-                CH = boundary(sjac.banded_lagrangian_hessian(
-                    plan, lambda ww: jax.grad(lagrangian)(ww, y, z), w))
-            with phase_scope("assemble"):
+            with phase_scope("eval_jac"), mixed_mm():
+                CH = boundary(narrow_store(
+                    sjac.banded_lagrangian_hessian(
+                        plan, lambda ww: jax.grad(lagrangian)(ww, y, z),
+                        w)))
+            with phase_scope("assemble"), mixed_mm():
                 w_diag = delta + sigma_L + sigma_U
                 D, E = boundary(sjac.assemble_kkt_banded(
                     plan, CH, Jg, Jh, sigma_s if m_h else
@@ -884,9 +966,9 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
                      (stage_ops.factor_kkt_stage_banded(D, E),
                       plan.partition)))
         else:
-            with phase_scope("eval_jac"):
-                H = boundary(hess_l(w, y, z))
-            with phase_scope("assemble"):
+            with phase_scope("eval_jac"), mixed_mm():
+                H = boundary(narrow_store(hess_l(w, y, z)))
+            with phase_scope("assemble"), mixed_mm():
                 W = H + (delta * jnp.ones((n,), dtype) + sigma_L
                          + sigma_U) * jnp.eye(n, dtype=dtype)
                 if m_h:
@@ -1177,6 +1259,7 @@ def _solve_nlp_impl(nlp, w0, theta, w_lb, w_ub, options, y0, z0,
         constraint_violation=viol_raw,
         kkt_path=kkt_path_code,
         jac_path=jac_path_code,
+        precision_path=precision_path_code,
     )
     return SolverResult(
         w=w_out, y=y_out, z=z_out,

@@ -48,7 +48,18 @@ from agentlib_mpc_tpu.telemetry.profiler import phase_scope
 
 _HI = jax.lax.Precision.HIGHEST
 
+#: refinement steps every stored-factor resolve runs (dense LU, stage
+#: sweep, banded stage sweep, scenario variants — one shared constant so
+#: the precision certifier's compensator contract and the resolves can
+#: never disagree): 2 steps of iterative refinement against the full
+#: residual is the certified compensator the mixed-precision routing
+#: (``SolverOptions.precision``) leans on — it contracts an O(1%)
+#: certified-narrow Jacobian/assembly error back into the f32 residual
+#: class (Carson-Higham three-precision refinement, PAPER.md refs).
+ITERATIVE_REFINEMENT_STEPS = 2
+
 __all__ = [
+    "ITERATIVE_REFINEMENT_STEPS",
     "StagePartition",
     "band_matvec_blocks",
     "build_stage_partition",
@@ -330,7 +341,7 @@ def factor_kkt_stage(K: jnp.ndarray, partition: StagePartition):
 
 
 def resolve_kkt_stage(factor, rhs: jnp.ndarray, partition: StagePartition,
-                      refine_steps: int = 2) -> jnp.ndarray:
+                      refine_steps: int = ITERATIVE_REFINEMENT_STEPS) -> jnp.ndarray:
     """Solve with a stored stage factor + iterative refinement (f32-safe;
     the residual matmul runs against the FULL scaled matrix, so dropped
     out-of-band noise would surface here rather than pass silently)."""
@@ -345,7 +356,7 @@ def resolve_kkt_stage(factor, rhs: jnp.ndarray, partition: StagePartition,
 
 def solve_kkt_stage(K: jnp.ndarray, rhs: jnp.ndarray,
                     partition: StagePartition,
-                    refine_steps: int = 2) -> jnp.ndarray:
+                    refine_steps: int = ITERATIVE_REFINEMENT_STEPS) -> jnp.ndarray:
     """Equilibrated block-tridiagonal solve with iterative refinement —
     drop-in for :func:`kkt.solve_kkt_ldl` when a stage partition exists."""
     return resolve_kkt_stage(factor_kkt_stage(K, partition), rhs,
@@ -402,7 +413,7 @@ def factor_kkt_stage_banded(D: jnp.ndarray, E: jnp.ndarray):
 
 def resolve_kkt_stage_banded(factor, rhs: jnp.ndarray,
                              partition: StagePartition,
-                             refine_steps: int = 2) -> jnp.ndarray:
+                             refine_steps: int = ITERATIVE_REFINEMENT_STEPS) -> jnp.ndarray:
     """Solve with a stored banded stage factor + iterative refinement
     against the banded matvec (exact on the certified-sparse path).
     ``rhs`` is in ORIGINAL KKT index order, like :func:`resolve_kkt_stage`."""
@@ -446,7 +457,7 @@ def factor_kkt_scenarios(K_batch: jnp.ndarray, partition: StagePartition):
 
 def resolve_kkt_scenarios(factor, rhs_batch: jnp.ndarray,
                           partition: StagePartition,
-                          refine_steps: int = 2) -> jnp.ndarray:
+                          refine_steps: int = ITERATIVE_REFINEMENT_STEPS) -> jnp.ndarray:
     """Solve ``rhs_batch`` (S, M) against a stored scenario-batched
     factor; rows are in original KKT index order per scenario."""
     kind, F = factor
@@ -468,7 +479,7 @@ def factor_kkt_scenarios_banded(D_batch: jnp.ndarray, E_batch: jnp.ndarray):
 
 def resolve_kkt_scenarios_banded(factor, rhs_batch: jnp.ndarray,
                                  partition: StagePartition,
-                                 refine_steps: int = 2) -> jnp.ndarray:
+                                 refine_steps: int = ITERATIVE_REFINEMENT_STEPS) -> jnp.ndarray:
     kind, F = factor
     if kind == "flat":
         return resolve_kkt_stage_banded(F, rhs_batch[0], partition,

@@ -118,6 +118,11 @@ _MEMORY_CERT_MEMO: dict = {}
 #: ``(cert, ocps)`` pinning the group OCPs like the other memos.
 _DISPATCH_CERT_MEMO: dict = {}
 
+#: precision certificates memoized the same way (ISSUE 20) — same key
+#: as the memory memo. Values are ``(cert, ocps)`` pinning the group
+#: OCPs like the other memos.
+_PRECISION_CERT_MEMO: dict = {}
+
 
 def _suppress_unusable_donation_warning() -> None:
     """On backends without buffer donation (CPU) jax warns once per
@@ -273,6 +278,7 @@ class FusedADMM:
                  collective_certify: str = "auto",
                  memory_certify: str = "auto",
                  dispatch_certify: str = "auto",
+                 precision_certify: str = "auto",
                  warmstart=None):
         """``active``: optional per-group boolean masks (n_agents,) —
         False lanes are padding (see :func:`pad_group_to_devices`): they
@@ -365,6 +371,21 @@ class FusedADMM:
         memory certificate within the
         :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`'s
         projected peak-HBM bound — REFUSING to build otherwise.
+        ``precision_certify``: statically certify the fused step's
+        error growth (:mod:`agentlib_mpc_tpu.lint.jaxpr.precision` —
+        the per-phase maximum certified-safe dtype behind
+        ``SolverOptions.precision``). ``"auto"`` certifies whenever the
+        build already pays a trace (same gating as
+        ``dispatch_certify``); ``"require"`` always certifies and
+        refuses a refuted or unprovable certificate; ``"off"`` skips.
+        Under ``"auto"``, a REFUTED certificate raises only when some
+        group's ``SolverOptions.precision`` is ``"require"`` (that
+        group demanded a proof it cannot have) and warns loudly
+        otherwise — groups routed ``"mixed"`` keep running, with the
+        refutation's hazard named in the log. The proved
+        ``precision_digest`` rides the engine-store meta and
+        plane-checkpoint stamps next to the collective, memory and
+        dispatch digests (drift = refused restore).
         ``warmstart``: an optional learned warm-start predictor — a
         :class:`~agentlib_mpc_tpu.ml.serialized.SerializedWarmstart`
         document or a prebuilt
@@ -449,6 +470,11 @@ class FusedADMM:
                 f"dispatch_certify must be 'auto', 'require' or 'off', "
                 f"got {dispatch_certify!r}")
         self.dispatch_certify = dispatch_certify
+        if precision_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"precision_certify must be 'auto', 'require' or "
+                f"'off', got {precision_certify!r}")
+        self.precision_certify = precision_certify
         #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.memory.
         #: MemoryCertificate` of the fused step (None when
         #: ``memory_certify`` skipped it)
@@ -472,6 +498,13 @@ class FusedADMM:
         #: its mesh-size-independent digest — third stamp next to the
         #: collective and memory digests
         self.dispatch_digest = None
+        #: the build-time :class:`~agentlib_mpc_tpu.lint.jaxpr.
+        #: precision.PrecisionCertificate` of the fused step (None when
+        #: ``precision_certify`` skipped it)
+        self.precision_certificate = None
+        #: its phase→dtype digest — fourth stamp next to the
+        #: collective, memory and dispatch digests (None unless proved)
+        self.precision_digest = None
         #: the :class:`~agentlib_mpc_tpu.lint.jaxpr.fusion.FusionPlan`
         #: proved at build when ``SolverOptions.fusion="require"``
         #: (None otherwise; ``bench.py --emit-metrics`` plans its own)
@@ -534,6 +567,8 @@ class FusedADMM:
                 self._certify_memory_step(None, None, 1)
             if self._dispatch_certify_wanted():
                 self._certify_dispatch_step(None, None, 1)
+            if self._precision_certify_wanted():
+                self._certify_precision_step(None, None, 1)
             if self._fusion_mode() == "require":
                 self._certify_fusion_equivalence(None, 1)
             return
@@ -575,6 +610,8 @@ class FusedADMM:
                 self._certify_memory_step(None, axis, n_dev)
             if self._dispatch_certify_wanted():
                 self._certify_dispatch_step(None, axis, n_dev)
+            if self._precision_certify_wanted():
+                self._certify_precision_step(None, axis, n_dev)
         if self._fusion_mode() == "require":
             self._certify_fusion_equivalence(axis, n_dev)
         # consensus-shaped mesh-collective probe (the shared
@@ -710,6 +747,8 @@ class FusedADMM:
             self._certify_memory_step(closed, axis, n_dev)
         if self._dispatch_certify_wanted():
             self._certify_dispatch_step(closed, axis, n_dev)
+        if self._precision_certify_wanted():
+            self._certify_precision_step(closed, axis, n_dev)
 
     def _step_templates(self) -> tuple:
         """(state, thetas, masks) shape templates of the compiled step —
@@ -899,6 +938,109 @@ class FusedADMM:
                     "build; 1 = the fused mega-round)").set(
                     float(cert.dispatch_count()),
                     fleet=",".join(g.name for g in self.groups))
+
+    def _precision_certify_wanted(self) -> bool:
+        """Whether to run the precision pass at this build: ``"require"``
+        always; any group's ``SolverOptions.precision="require"``
+        always (that routing is only legal under a proof); ``"auto"``
+        when some group actually RESOLVES to the mixed path on this
+        backend (``"auto"`` routes mixed on TPU only — a CPU build has
+        no narrow routing to prove and skips the walk); ``"off"``
+        never."""
+        if self.precision_certify == "off":
+            return False
+        if self.precision_certify == "require":
+            return True
+        if self._precision_required_by_groups():
+            return True
+        return self._precision_routed_mixed()
+
+    def _precision_required_by_groups(self) -> bool:
+        for g in self.groups:
+            for o in (g.solver_options, g.warm_solver_options):
+                if getattr(o, "precision", None) == "require":
+                    return True
+        return False
+
+    def _precision_routed_mixed(self) -> bool:
+        from agentlib_mpc_tpu.ops.solver import (
+            SolverOptions,
+            _resolve_precision,
+        )
+
+        for g in self.groups:
+            for o in (g.solver_options, g.warm_solver_options):
+                if _resolve_precision(o if o is not None
+                                      else SolverOptions()) == "mixed":
+                    return True
+        return False
+
+    def _certify_precision_step(self, closed, axis: "str | None",
+                                n_dev: int) -> None:
+        """Certify the fused step's per-phase error growth (ISSUE 20)
+        from ``closed`` (the collective certifier's trace when in hand;
+        re-traced on shape templates otherwise), memoized per engine
+        structure + donation flag, and enforce the proof policy: a
+        refuted certificate is an error when a group demanded
+        ``precision="require"`` (or the engine was built
+        ``precision_certify="require"``), a loud warning otherwise —
+        the hazard and its eqn source named either way."""
+        from agentlib_mpc_tpu.lint.jaxpr.precision import certify_precision
+
+        key = (self._collective_cert_key(axis, n_dev),
+               self.donate_state)
+        hit = _PRECISION_CERT_MEMO.get(key)
+        cert = hit[0] if hit is not None else None
+        if cert is None:
+            if closed is None:
+                tmpl = self._step_templates()
+                closed = jax.make_jaxpr(self._step_fn)(*tmpl)
+            cert = certify_precision(closed)
+            while len(_PRECISION_CERT_MEMO) >= _COLLECTIVE_CERT_MEMO_MAX:
+                _PRECISION_CERT_MEMO.pop(
+                    next(iter(_PRECISION_CERT_MEMO)))
+            _PRECISION_CERT_MEMO[key] = (
+                cert, tuple(g.ocp for g in self.groups))
+        self.precision_certificate = cert
+        self.precision_digest = cert.precision_digest
+        hard = (self.precision_certify == "require"
+                or self._precision_required_by_groups())
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"fused step's mixed-precision routing REFUTED — a "
+                   f"narrow phase cannot carry its certified error "
+                   f"budget:\n  {detail}")
+            if hard:
+                raise ValueError(
+                    msg + "\n(route the group precision='f64', or "
+                    "build with precision_certify='off' to debug)")
+            logger.warning(
+                "%s\n(proceeding — groups routed 'mixed' run the "
+                "narrow phases UNCERTIFIED; the refined-residual "
+                "compensator and the solver's own convergence checks "
+                "are the only defense)", msg)
+        elif cert.status != "proved":
+            if hard:
+                raise ValueError(
+                    f"fused step's precision certificate is UNPROVABLE "
+                    f"({cert.describe()}) and a proof was required "
+                    f"(precision_certify='require' or a group's "
+                    f"SolverOptions.precision='require')")
+            logger.info("precision not provable (%s)", cert.describe())
+        else:
+            logger.info("precision certificate proved: %s (digest %s)",
+                        cert.describe(), cert.precision_digest)
+            if telemetry.enabled():
+                gauge = telemetry.gauge(
+                    "precision_certified_phase",
+                    "info gauge: 1 per (phase, dtype) the build-time "
+                    "precision certificate proved safe "
+                    "(lint/jaxpr/precision.py)")
+                for verdict in cert.phases:
+                    gauge.set(1.0, phase=verdict.phase,
+                              dtype=verdict.certified_dtype,
+                              fleet=",".join(g.name
+                                             for g in self.groups))
 
     def _fusion_mode(self) -> str:
         """The engine-level IPM fusion mode, joined over the groups'

@@ -168,6 +168,7 @@ class ScenarioFleet:
                  collective_certify: str = "auto",
                  memory_certify: str = "auto",
                  dispatch_certify: str = "auto",
+                 precision_certify: str = "auto",
                  watchdog_timeout_s: "float | None" = None,
                  warmstart=None):
         """``group``: an :class:`~agentlib_mpc_tpu.parallel.fused_admm.
@@ -184,7 +185,13 @@ class ScenarioFleet:
         (:mod:`agentlib_mpc_tpu.lint.jaxpr.memory`) — the scenario axis
         multiplies every lane buffer by S, which is exactly the
         projection the certificate prices before a robust fleet can
-        OOM a pod dispatch. ``watchdog_timeout_s``: arm the COLLECTIVE
+        OOM a pod dispatch. ``precision_certify``: same vocabulary for
+        the per-phase error-growth certificate
+        (:mod:`agentlib_mpc_tpu.lint.jaxpr.precision`) behind
+        ``SolverOptions.precision`` — certified under ``"auto"`` only
+        when the group actually resolves to the mixed path; a refuted
+        or unprovable certificate raises when the group demanded
+        ``precision="require"``. ``watchdog_timeout_s``: arm the COLLECTIVE
         watchdog — every 2-D round runs on a bounded reader (the
         :class:`FusedADMM` pattern on both axes); a blown budget
         condemns the mesh, records a bounded per-device probe on
@@ -241,6 +248,13 @@ class ScenarioFleet:
         self.dispatch_certify = dispatch_certify
         self.dispatch_certificate = None
         self.dispatch_digest = None
+        if precision_certify not in ("auto", "require", "off"):
+            raise ValueError(
+                f"precision_certify must be 'auto', 'require' or "
+                f"'off', got {precision_certify!r}")
+        self.precision_certify = precision_certify
+        self.precision_certificate = None
+        self.precision_digest = None
         self.watchdog_timeout_s = (None if watchdog_timeout_s is None
                                    else float(watchdog_timeout_s))
         #: True once a round blew the collective-watchdog budget — the
@@ -665,6 +679,8 @@ class ScenarioFleet:
                 self._certify_memory(None)
             if self._dispatch_certify_wanted():
                 self._certify_dispatch(None)
+            if self._precision_certify_wanted():
+                self._certify_precision(None)
             return
 
         from jax.experimental.shard_map import shard_map
@@ -726,6 +742,8 @@ class ScenarioFleet:
                 self._certify_memory(None)
             if self._dispatch_certify_wanted():
                 self._certify_dispatch(None)
+            if self._precision_certify_wanted():
+                self._certify_precision(None)
 
     def _certify(self, sharded, axis_names: tuple) -> None:
         """Trace the sharded step on shape templates and certify the
@@ -743,6 +761,8 @@ class ScenarioFleet:
             self._certify_memory(closed)
         if self._dispatch_certify_wanted():
             self._certify_dispatch(closed)
+        if self._precision_certify_wanted():
+            self._certify_precision(closed)
         self.collective_certificate = cert
         self.collective_schedule_digest = cert.schedule_digest
         if cert.status == "refuted":
@@ -890,6 +910,74 @@ class ScenarioFleet:
             logger.info("scenario dispatch schedule proved: %s "
                         "(digest %s)", cert.describe(),
                         cert.dispatch_digest)
+
+    def _precision_certify_wanted(self) -> bool:
+        """The :class:`FusedADMM` policy verbatim (ISSUE 20):
+        ``"require"`` always; the group demanding
+        ``SolverOptions.precision="require"`` always; ``"auto"`` when
+        the group actually resolves to the mixed path on this backend;
+        ``"off"`` never."""
+        if self.precision_certify == "off":
+            return False
+        if self.precision_certify == "require":
+            return True
+        from agentlib_mpc_tpu.ops.solver import (
+            SolverOptions,
+            _resolve_precision,
+        )
+
+        opts = []
+        for o in (self.group.solver_options,
+                  self.group.warm_solver_options):
+            opts.append(o if o is not None else SolverOptions())
+        if any(getattr(o, "precision", None) == "require"
+               for o in opts):
+            return True
+        return any(_resolve_precision(o) == "mixed" for o in opts)
+
+    def _certify_precision(self, closed) -> None:
+        """Certify the robust round's per-phase error growth (ISSUE
+        20) and enforce the proof policy — the FusedADMM pattern: a
+        refuted or unprovable certificate raises when a proof was
+        demanded (``precision_certify="require"`` or the group's
+        ``SolverOptions.precision="require"``), warns loudly
+        otherwise."""
+        from agentlib_mpc_tpu.lint.jaxpr.precision import certify_precision
+        from agentlib_mpc_tpu.ops.solver import SolverOptions
+
+        if closed is None:
+            closed = jax.make_jaxpr(self._step_fn)(
+                *self._step_templates())
+        cert = certify_precision(closed)
+        self.precision_certificate = cert
+        self.precision_digest = cert.precision_digest
+        hard = self.precision_certify == "require" or any(
+            getattr(o if o is not None else SolverOptions(),
+                    "precision", None) == "require"
+            for o in (self.group.solver_options,
+                      self.group.warm_solver_options))
+        if cert.status == "refuted":
+            detail = "\n  ".join(cert.refutations)
+            msg = (f"scenario round's mixed-precision routing REFUTED "
+                   f"— a narrow phase cannot carry its certified "
+                   f"error budget:\n  {detail}")
+            if hard:
+                raise ValueError(msg)
+            logger.warning(
+                "%s\n(proceeding — 'mixed' groups run the narrow "
+                "phases UNCERTIFIED)", msg)
+        elif cert.status != "proved":
+            if hard:
+                raise ValueError(
+                    f"scenario round's precision certificate is "
+                    f"UNPROVABLE ({cert.describe()}) and a proof was "
+                    f"required")
+            logger.info("scenario precision not provable (%s)",
+                        cert.describe())
+        else:
+            logger.info("scenario precision certificate proved: %s "
+                        "(digest %s)", cert.describe(),
+                        cert.precision_digest)
 
     # -- public API -----------------------------------------------------------
 

@@ -189,6 +189,13 @@ def save_plane(plane, path: str) -> str:
             # refused the same way a collective drift is
             "dispatch_digest": getattr(bucket.engine, "dispatch_digest",
                                        None),
+            # the phase→dtype routing table the bucket's engine
+            # certified (ISSUE 20): a restore whose rebuilt engine
+            # proves different precision routing — another phase
+            # certified narrow, a phase losing its proof — is refused
+            # the same way
+            "precision_digest": getattr(bucket.engine,
+                                        "precision_digest", None),
             # robust buckets carry the scenario axis (ISSUE 14): their
             # FusedState sibling is a ScenarioState with (capacity, S)
             # leading axes — recorded for observability; the restore
@@ -475,6 +482,23 @@ def restore_plane(plane, path: str, specs) -> RestoreReport:
                 f"sync) than the one the checkpoint's peers ran. "
                 f"Restore with the matching code, or re-join tenants "
                 f"fresh")
+        saved_prec = entry.get("precision_digest")
+        live_prec = getattr(bucket.engine, "precision_digest", None)
+        if saved_prec is not None and live_prec is not None \
+                and saved_prec != live_prec:
+            telemetry.journal_event(
+                "checkpoint.rejected", path=src,
+                reason="precision_routing_drift",
+                bucket=entry["digest"], precision_digest=saved_prec,
+                live_digest=live_prec)
+            raise ValueError(
+                f"bucket {entry['digest']}: the checkpoint was saved "
+                f"under certified precision routing {saved_prec}, but "
+                f"this process's engine certifies {live_prec} — the "
+                f"restored plane would run different phases at "
+                f"narrow precision than the ones the checkpoint's "
+                f"iterates were produced under. Restore with the "
+                f"matching code, or re-join tenants fresh")
         for tid in tenants:
             t_t = time.perf_counter()
             spec = specs.get(tid)

@@ -510,7 +510,8 @@ class ServingPlane:
         def make_engine(qp_fast_path: str,
                         collective_certify: str = "auto",
                         memory_certify: "str | None" = None,
-                        dispatch_certify: str = "auto"):
+                        dispatch_certify: str = "auto",
+                        precision_certify: str = "auto"):
             group = AgentGroup(
                 name=f"bucket-{key.digest}",
                 ocp=spec.ocp, n_agents=capacity,
@@ -540,14 +541,16 @@ class ServingPlane:
                     mesh=self.mesh,
                     collective_certify=collective_certify,
                     memory_certify=resolved_memory,
-                    dispatch_certify=dispatch_certify)
+                    dispatch_certify=dispatch_certify,
+                    precision_certify=precision_certify)
             return FusedADMM(
                 [group], self.admm_options,
                 active=[jnp.zeros((capacity,), bool)],
                 donate_state=self.donate, mesh=self.mesh,
                 collective_certify=collective_certify,
                 memory_certify=resolved_memory,
-                dispatch_certify=dispatch_certify)
+                dispatch_certify=dispatch_certify,
+                precision_certify=precision_certify)
 
         def warm_args(engine):
             # throwaway template inputs, mesh-placed for sharded
@@ -629,6 +632,12 @@ class ServingPlane:
                         # boundaries, a host sync) is visible the
                         # same way
                         "dispatch_digest": engine.dispatch_digest,
+                        # the certified phase→dtype routing table's
+                        # identity (ISSUE 20) — a revival whose fresh
+                        # build would prove DIFFERENT precision
+                        # routing (other phases certified narrow) is
+                        # visible the same way
+                        "precision_digest": engine.precision_digest,
                     })
                 except Exception:  # noqa: BLE001 - store is best-effort
                     logger.warning(
@@ -657,11 +666,13 @@ class ServingPlane:
                 engine = make_engine(meta.get("qp_fast_path", "off"),
                                      collective_certify="off",
                                      memory_certify="off",
-                                     dispatch_certify="off")
+                                     dispatch_certify="off",
+                                     precision_certify="off")
                 engine.collective_schedule_digest = \
                     meta.get("collective_digest")
                 engine.memory_digest = meta.get("memory_digest")
                 engine.dispatch_digest = meta.get("dispatch_digest")
+                engine.precision_digest = meta.get("precision_digest")
                 install_exported_step(
                     engine, blob,
                     warm_args=warm_args(engine) if self.warm_on_build

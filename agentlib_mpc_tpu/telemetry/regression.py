@@ -53,17 +53,20 @@ MIN_GATE_MS = 0.02
 def qualified_metric(base: str, platform: str, n_devices: int = 1,
                      degraded: bool = False,
                      mesh_shape: "tuple | None" = None,
-                     quality_level: int = 0) -> str:
+                     quality_level: int = 0,
+                     precision: str = "full") -> str:
     """The ONE metric-qualification rule (shared with ``bench.py``,
     which delegates here): unqualified names are reserved for TPU; any
     other platform gets a ``_<platform>`` suffix; a measurement spanning
     a device mesh gains ``_d<n>`` — or the full ``_d<A>x<S>`` shape for
     a 2-D grid — a run the SLO autopilot held at reduced quality gains
     ``_q<level>`` (the deepest ladder level reached, ISSUE 17: a
-    quality-reduced round must never read as a full-quality headline)
-    and a degraded round ``_degraded``. Two qualified keys are
-    comparable iff they are equal; the baseline store and the gate both
-    key on this."""
+    quality-reduced round must never read as a full-quality headline),
+    a run on a non-full precision path gains ``_<precision>``
+    (``_mixed``/``_bf16`` — ISSUE 20: a mixed-precision solve must
+    never publish under a full-precision headline key) and a degraded
+    round ``_degraded``. Two qualified keys are comparable iff they
+    are equal; the baseline store and the gate both key on this."""
     name = base if platform == "tpu" else f"{base}_{platform}"
     if mesh_shape is not None:
         name = f"{name}_d{'x'.join(str(int(s)) for s in mesh_shape)}"
@@ -71,6 +74,8 @@ def qualified_metric(base: str, platform: str, n_devices: int = 1,
         name = f"{name}_d{n_devices}"
     if quality_level:
         name = f"{name}_q{int(quality_level)}"
+    if precision not in ("", "full", None):
+        name = f"{name}_{precision}"
     return f"{name}_degraded" if degraded else name
 
 

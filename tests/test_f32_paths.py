@@ -213,6 +213,107 @@ class TestRobustnessCorpusF32:
         assert float(res.stats.constraint_violation) > 0.5
 
 
+class TestMixedPrecisionParity:
+    """ISSUE 20: the certificate-gated mixed routing held to the f32
+    tier's own bar. ``precision="mixed"`` rounds the eval_jac/assemble
+    stores through bf16 (f32 accumulation, the MXU regime) and leans on
+    the refined-residual compensator + the certified-full factor — on
+    the corpus shapes above it must keep the f32 class's honest
+    verdicts: solvable programs land f32-class answers, infeasible ones
+    still honestly fail, and the stats label names the routing."""
+
+    @pytest.fixture(params=["ipm", "qp"])
+    def solver(self, request):
+        from agentlib_mpc_tpu.ops.qp import solve_qp
+
+        return solve_nlp if request.param == "ipm" else solve_qp
+
+    def _opts(self, **kw):
+        kw.setdefault("tol", 1e-8)
+        kw.setdefault("max_iter", 120)
+        return SolverOptions(precision="mixed", **kw)
+
+    def test_stats_label_names_the_mixed_routing(self, f32, solver):
+        from test_solver_robustness import _qp_nlp
+
+        from agentlib_mpc_tpu.ops.solver import precision_path_name
+
+        nlp = _qp_nlp(np.eye(3), -np.ones(3))
+        res = solver(nlp, jnp.zeros(3), None, jnp.full(3, -10.0),
+                     jnp.full(3, 10.0), self._opts())
+        assert precision_path_name(res.stats.precision_path) == "mixed"
+        assert bool(res.stats.success)
+        np.testing.assert_allclose(np.asarray(res.w), np.ones(3),
+                                   atol=1e-2)
+
+    def test_hs071_mixed_matches_f32_class(self, f32):
+        """The nonconvex benchmark through the mixed IPM: bf16-rounded
+        derivative stores may cost iterations, not the answer class."""
+        nlp = NLPFunctions(
+            f=lambda w, t: w[0] * w[3] * (w[0] + w[1] + w[2]) + w[2],
+            g=lambda w, t: jnp.array([jnp.sum(w**2) - 40.0]),
+            h=lambda w, t: jnp.array([w[0] * w[1] * w[2] * w[3] - 25.0]),
+        )
+        res = solve_nlp(nlp, jnp.array([1.0, 5.0, 5.0, 1.0]), None,
+                        jnp.ones(4), 5.0 * jnp.ones(4),
+                        self._opts(tol=1e-4))
+        assert bool(res.stats.success)
+        np.testing.assert_allclose(
+            np.asarray(res.w), [1.0, 4.743, 3.8211, 1.3794], atol=1e-2)
+
+    def test_licq_failure_duplicated_constraints_mixed(self, f32,
+                                                       solver):
+        from test_solver_robustness import _qp_nlp
+
+        n = 6
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(n, n))
+        Q = M @ M.T + n * np.eye(n)
+        c = rng.normal(size=n)
+        a = rng.normal(size=(1, n))
+        nlp = _qp_nlp(Q, c, np.vstack([a, a, a]), np.array([1.0] * 3))
+        res = solver(nlp, jnp.zeros(n), None, jnp.full(n, -10.0),
+                     jnp.full(n, 10.0), self._opts())
+        assert res.w.dtype == jnp.float32
+        assert bool(res.stats.success)
+        assert abs(float((a @ np.asarray(res.w))[0]) - 1.0) < 1e-3
+
+    def test_contradictory_equalities_still_honest_mixed(self, f32,
+                                                         solver):
+        """The routing must not buy speed with a silent wrong answer:
+        the infeasible program still reports failure."""
+        from test_solver_robustness import _qp_nlp
+
+        Aeq = np.array([[1.0, 1.0], [1.0, 1.0]])
+        nlp = _qp_nlp(np.eye(2), np.zeros(2), Aeq, np.array([0.0, 1.0]))
+        res = solver(nlp, jnp.zeros(2), None, jnp.full(2, -5.0),
+                     jnp.full(2, 5.0), self._opts())
+        assert not bool(res.stats.success)
+        assert float(res.stats.constraint_violation) > 0.05
+
+    def test_mixed_vs_full_objective_parity_ocp(self, f32):
+        """The benchmark-shaped OCP: the mixed solve's optimal cost
+        matches the full-f32 solve's to well under a percent — the
+        projected-HBM-halving claim rides on this parity."""
+        from agentlib_mpc_tpu.models.zoo import LinearRCZone
+
+        ocp = transcribe(LinearRCZone(), ["Q"], N=6, dt=300.0,
+                         method="collocation", collocation_degree=2)
+        theta = ocp.default_params()
+        lb, ub = ocp.bounds(theta)
+        w0 = ocp.initial_guess(theta)
+        res_full = solve_nlp(ocp.nlp, w0, theta, lb, ub,
+                             SolverOptions(max_iter=80))
+        res_mixed = solve_nlp(ocp.nlp, w0, theta, lb, ub,
+                              SolverOptions(max_iter=80,
+                                            precision="mixed"))
+        assert bool(res_full.stats.success)
+        assert bool(res_mixed.stats.success)
+        obj_full = float(res_full.stats.objective)
+        obj_mixed = float(res_mixed.stats.objective)
+        assert obj_mixed == pytest.approx(obj_full, rel=5e-3)
+
+
 class TestF32ClosedLoopBudget:
     """The VERDICT r5 #4 repro, pinned: the f32 linear closed loop
     (LinearRCZone, 13 warm-chained solves, default tolerances) through

@@ -1,0 +1,307 @@
+"""Precision certifier (ISSUE 20): the forward error-propagation pass
+that proves which subgraphs survive bf16/f32, and the certificate-gated
+mixed-precision routing it cashes.
+
+Three layers. (1) The handcrafted corpus: provable catastrophic
+cancellation (the ``(x+1e8)-1e8`` mutation shape and the PR 19
+epsilon-std 1e9-weight fold) must refute, benign arithmetic must prove,
+opaque primitives must come back an honest "unknown" — never a fake
+proof. (2) The solver seam: ``certify_solver_precision`` on the example
+menu reproduces the checked-in ``[jaxpr.precision]`` pins. (3) The
+engine seam + PR 3-style mutation: a FusedADMM build under
+``precision="require"`` carries a proved certificate and digest; an
+ill-conditioned subtraction injected into the transcribed objective
+(evaluated inside the certified-bf16 eval_jac phase) makes the
+certifier refute naming THIS file as the injected eqn's source, and
+the ``"require"`` build refuses.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from agentlib_mpc_tpu.lint.jaxpr import (
+    MIXED_NARROW_PHASES,
+    PrecisionCertificate,
+    certify_precision,
+    certify_solver_precision,
+    check_precision_budget,
+)
+from agentlib_mpc_tpu.ops.solver import SolverOptions
+from agentlib_mpc_tpu.telemetry.profiler import phase_scope
+
+
+@pytest.fixture
+def f32():
+    """The production regime. The lattice charges elementwise roundoff
+    at the TRACED dtype, so the cancellation hazards these tests pin
+    are live under an f32 trace (the CLI / TPU default: ``(x+1e8)-1e8``
+    amplifies 2⁻²⁴ by κ ≈ 1e8 to ~6, refuting every narrow candidate)
+    and neutered by the test suite's x64 conftest (the same κ amplifies
+    2⁻⁵³ to ~2e-8, under every budget). Certify in f32 like the gate
+    does."""
+    from jax.experimental import enable_x64
+
+    with enable_x64(False):
+        yield
+
+
+# --------------------------------------------------------------------------
+# the handcrafted corpus
+# --------------------------------------------------------------------------
+
+
+class TestCorpus:
+    def test_benign_affine_proves(self):
+        cert = certify_precision(lambda x: 0.5 * x + 1.0,
+                                 jnp.zeros((4,)))
+        assert isinstance(cert, PrecisionCertificate)
+        assert cert.proved
+        assert cert.certified_dtype("unphased") in ("bf16", "f32")
+        assert cert.precision_digest is not None
+
+    def test_catastrophic_cancellation_refuted(self, f32):
+        """The mutation shape: shifting through 1e8 and back makes every
+        point of the seeded interval cancel — κ ≈ 2e8 amplifies the f32
+        roundoff past any budget, and the hazard names THIS file."""
+
+        def f(x):
+            return (x + 1e8) - 1e8
+
+        cert = certify_precision(f, jnp.zeros((4,)),
+                                 seeds={0: (-1.0, 1.0)})
+        assert cert.status == "refuted"
+        assert cert.certified_dtype("unphased") == "f64"
+        assert cert.precision_digest is None
+        assert any("test_jaxpr_precision" in r for r in cert.refutations)
+
+    def test_epsilon_std_fold_refused(self, f32):
+        """The PR 19 hazard the pass exists to catch: an epsilon-std
+        column folded into the weights bakes w=1e9 with a compensating
+        1e9 bias — exact in f64, catastrophic cancellation in f32. The
+        certifier must refuse it for every narrow dtype."""
+
+        def folded(x):           # (x - mean) / std with std = 1e-9
+            return x * 1e9 - 1e9
+
+        cert = certify_precision(
+            folded, jnp.zeros((4,)),
+            seeds={0: (1.0 - 1e-9, 1.0 + 1e-9)})   # near-constant column
+        assert cert.status == "refuted"
+        assert cert.certified_dtype("unphased") == "f64"
+
+    def test_sign_definite_sum_proves_narrow(self):
+        """Same-sign accumulation has κ ≈ 1 (backward-error reading):
+        a softplus-positive sum certifies below f64."""
+        cert = certify_precision(
+            lambda x: jnp.sum(jax.nn.softplus(x)), jnp.zeros((8,)),
+            seeds={0: (-2.0, 2.0)})
+        assert cert.proved
+
+    def test_phase_scopes_partition_the_verdict(self, f32):
+        """phase_scope annotations split the table: the cancellation
+        sits in eval_jac only, so eval_jac refutes bf16 while the clean
+        phase keeps its narrow verdict."""
+
+        def f(x):
+            with phase_scope("eval_jac"):
+                a = (x + 1e8) - 1e8
+            with phase_scope("line_search"):
+                b = 0.5 * x + 1.0
+            return a + b
+
+        cert = certify_precision(f, jnp.zeros((4,)),
+                                 seeds={0: (-1.0, 1.0)})
+        assert cert.status == "refuted"          # eval_jac is required
+        assert cert.certified_dtype("eval_jac") == "f64"
+        assert cert.certified_dtype("line_search") in ("bf16", "f32")
+        v = cert.verdict("eval_jac")
+        assert v is not None and v.hazard
+
+    def test_opaque_prim_is_unknown_not_proved(self):
+        """Soundness boundary: an LU/triangular-solve has no
+        per-primitive rule — the containing phase must come back
+        "unknown", never silently certified."""
+
+        def f(A, b):
+            with phase_scope("eval_jac"):
+                return jnp.linalg.solve(A, b)
+
+        cert = certify_precision(f, jnp.eye(3), jnp.ones((3,)))
+        assert cert.certified_dtype("eval_jac") == "unknown"
+        assert cert.status == "unknown"
+        assert cert.opaque
+
+    def test_while_fixpoint_terminates_with_honest_widening(self):
+        """A contractive while-loop carry reaches a fixpoint (or widens
+        honestly) instead of diverging the walker."""
+
+        def f(x):
+            def body(c):
+                i, v = c
+                return i + 1, v * 0.5 + 1.0
+
+            def cond(c):
+                return c[0] < 50
+
+            return jax.lax.while_loop(cond, body, (0, x))[1]
+
+        cert = certify_precision(f, jnp.zeros((4,)),
+                                 seeds={0: (-1.0, 1.0)})
+        assert cert.status in ("proved", "refuted")
+        assert cert.certified_dtype("unphased") != "unknown"
+
+
+class TestBudgetRoundTrip:
+    def _cert(self):
+        def f(x):
+            with phase_scope("line_search"):
+                return 0.5 * x + 1.0
+
+        return certify_precision(f, jnp.zeros((4,)),
+                                 seeds={0: (-1.0, 1.0)})
+
+    def test_matching_pin_is_clean(self):
+        cert = self._cert()
+        pin = ",".join(f"{v.phase}={v.certified_dtype}"
+                       for v in cert.phases)
+        assert check_precision_budget(cert, pin) == []
+
+    def test_drift_in_either_direction_fails(self):
+        cert = self._cert()
+        v = cert.verdict("line_search")
+        wrong = "f64" if v.certified_dtype != "f64" else "bf16"
+        out = check_precision_budget(cert, f"line_search={wrong}")
+        assert len(out) == 1 and "drifted" in out[0]
+
+    def test_unparseable_pin_reported(self):
+        out = check_precision_budget(self._cert(), "garbage")
+        assert out and "unparseable" in out[0]
+
+
+# --------------------------------------------------------------------------
+# the solver seam: the example menu reproduces the checked-in pins
+# --------------------------------------------------------------------------
+
+
+class TestSolverMenu:
+    def _certify(self, name):
+        from agentlib_mpc_tpu.lint.jaxpr.examples import EXAMPLE_OCPS
+
+        ex = next(e for e in EXAMPLE_OCPS if e.name == name)
+        ocp = ex.build()
+        theta = ocp.default_params()
+        lb, ub = ocp.bounds(theta)
+        return certify_solver_precision(ocp.nlp, theta, ocp.n_w, lb, ub)
+
+    def test_linear_menu_entry_proves_mixed(self, f32):
+        """The headline routing: the linear zone's IPM proves bf16 for
+        the MXU phases, keeps factor/resolve honestly unknown (opaque
+        LU), and the digest matches the lint gate's."""
+        cert = self._certify("LinearRCZone/colloc-d1")
+        assert cert.proved, cert.describe()
+        for ph in MIXED_NARROW_PHASES:
+            assert cert.certified_dtype(ph) == "bf16", cert.describe()
+        assert cert.certified_dtype("factor") == "unknown"
+        assert cert.precision_digest is not None
+        from agentlib_mpc_tpu.lint.retrace_budget import load_budgets
+
+        pin = load_budgets().get("jaxpr", {}).get(
+            "precision", {}).get("expect", {}).get(
+            "LinearRCZone/colloc-d1")
+        assert pin, "[jaxpr.precision.expect] missing the menu pin"
+        assert check_precision_budget(cert, pin) == []
+
+    @pytest.mark.slow
+    def test_oneroom_shooting_refuses_bf16_eval_jac(self, f32):
+        """The one menu entry the router must NOT narrow: the
+        exp-saturated shooting dynamics put a cancellation-prone sum in
+        eval_jac — certified f32, status refuted, pinned in the budget
+        file so the refusal itself is regression-gated."""
+        cert = self._certify("OneRoom/shooting")
+        assert cert.status == "refuted"
+        assert cert.certified_dtype("eval_jac") == "f32"
+        assert cert.refutations
+
+
+# --------------------------------------------------------------------------
+# the engine seam + the mutation direction
+# --------------------------------------------------------------------------
+
+
+def _tracker_group(n_agents, **solver_kw):
+    from conftest import make_tracker_model
+
+    from agentlib_mpc_tpu.ops.transcription import transcribe
+    from agentlib_mpc_tpu.parallel.fused_admm import AgentGroup
+
+    ocp = transcribe(make_tracker_model()(), ["u"], N=4, dt=300.0,
+                     method="multiple_shooting")
+    return AgentGroup(
+        name="fleet", ocp=ocp, n_agents=n_agents,
+        couplings={"shared_u": "u"},
+        solver_options=SolverOptions(max_iter=25, **solver_kw),
+        qp_fast_path="off")
+
+
+class TestEngineSeam:
+    def test_require_build_carries_proof_and_digest(self):
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        engine = FusedADMM(
+            [_tracker_group(2)],
+            FusedADMMOptions(max_iterations=8, rho=2.0),
+            precision_certify="require")
+        cert = engine.precision_certificate
+        assert cert is not None and cert.proved, cert.describe()
+        assert engine.precision_digest == cert.precision_digest
+        assert engine.precision_digest is not None
+
+    def test_injected_cancellation_refused_under_require(self, f32):
+        """PR 3's source-surgery pattern: wrap the transcribed NLP's
+        objective with a bounded term shifted through 1e8 and back —
+        exact algebra, but tanh's [-1, 1] output interval makes the
+        cancellation's κ ≈ 1e8 PROVABLE at every seed point. The primal
+        objective evaluates under ``phase_scope("eval_jac")`` inside
+        the fused step, so the certifier must refute the narrow routing
+        naming the injected eqn's source (THIS file), and
+        ``precision="require"`` must refuse the build."""
+        import dataclasses
+
+        from conftest import make_tracker_model
+
+        from agentlib_mpc_tpu.ops.transcription import transcribe
+        from agentlib_mpc_tpu.parallel.fused_admm import (
+            AgentGroup,
+            FusedADMM,
+            FusedADMMOptions,
+        )
+
+        ocp = transcribe(make_tracker_model()(), ["u"], N=4, dt=300.0,
+                         method="multiple_shooting")
+        real_f = ocp.nlp.f
+
+        def sabotaged_f(w, theta):
+            # the regression: a bounded quantity shifted through 1e8
+            # and back — exact in f64, catastrophic for every narrow
+            # candidate
+            return real_f(w, theta) + ((jnp.tanh(w[0]) + 1e8) - 1e8)
+
+        ocp = dataclasses.replace(
+            ocp, nlp=ocp.nlp._replace(f=sabotaged_f))
+        group = AgentGroup(
+            name="fleet", ocp=ocp, n_agents=2,
+            couplings={"shared_u": "u"},
+            solver_options=SolverOptions(max_iter=25,
+                                         precision="require"),
+            qp_fast_path="off")
+        with pytest.raises(ValueError) as ei:
+            FusedADMM([group],
+                      FusedADMMOptions(max_iterations=8, rho=2.0))
+        msg = str(ei.value)
+        assert "REFUTED" in msg
+        assert "test_jaxpr_precision" in msg    # the injected eqn
+        assert "eval_jac" in msg

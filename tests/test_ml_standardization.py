@@ -1,0 +1,116 @@
+"""Standardization folding under finite precision (ISSUE 20 satellite).
+
+``ANNTrainerCore.fit`` folds input/target standardization into the
+first/last layer weights so the serialized net consumes raw features
+(``ml/training.py``). That algebra is exact in f64 — the hazard is its
+f32 evaluation in-graph: a near-constant column standardized by an
+epsilon std would bake ~1e9-magnitude weights with huge compensating
+biases, catastrophic cancellation at evaluation time (the PR 19
+incident class). Three pins: the fold round-trips through f32 with
+bounded error across column scales 1e-12..1e12, the epsilon-std guard
+keeps folded weights O(1), and the UNGUARDED fold is exactly the shape
+the precision certifier (``lint/jaxpr/precision.py``) must refuse.
+"""
+
+import numpy as np
+import pytest
+
+from agentlib_mpc_tpu.ml.training import ANNTrainerCore
+
+#: the property sweep: column scales spanning 24 decades
+SCALES = (1e-12, 1e-6, 1.0, 1e6, 1e12)
+
+
+def _fit_tiny(X, y, **kw):
+    core = ANNTrainerCore(hidden=(4,), epochs=2, seed=0, **kw)
+    return core.fit(X, y)
+
+
+def _forward(weights, biases, acts, x, dtype):
+    from agentlib_mpc_tpu.ml.predictors import _ACT
+
+    h = np.asarray(x, dtype=dtype)
+    for W, b, a in zip(weights, biases, acts):
+        W = np.asarray(W, dtype=dtype)
+        b = np.asarray(b, dtype=dtype)
+        h = np.asarray(_ACT[a](h @ W + b), dtype=dtype)
+    return h
+
+
+class TestFoldingRoundTrip:
+    def test_f32_error_bounded_across_column_scales(self):
+        """The folded net evaluated in f32 on raw features must agree
+        with its own f64 evaluation to f32-class relative error, for
+        every column scale in the sweep — the fold may not manufacture
+        precision hazards the standardized net didn't have."""
+        rng = np.random.default_rng(0)
+        base = rng.uniform(-1.0, 1.0, size=(40, len(SCALES)))
+        X = base * np.asarray(SCALES)
+        y = base.sum(axis=1)
+        weights, biases, acts = _fit_tiny(X, y)
+
+        for x in X[:10]:
+            y64 = _forward(weights, biases, acts, x, np.float64)
+            y32 = _forward(weights, biases, acts, x, np.float32)
+            assert np.all(np.isfinite(y32))
+            rel = np.max(np.abs(y64 - y32)) / (1.0 + np.max(np.abs(y64)))
+            assert rel < 1e-4, \
+                f"f32 round-trip error {rel:.2e} at x scale sweep"
+
+    def test_folded_first_layer_consumes_raw_features(self):
+        """The fold's defining identity, at a benign scale: the folded
+        net on raw x equals the unfolded net on (x-mean)/std (here
+        verified via the training data's own standardization moments)."""
+        rng = np.random.default_rng(1)
+        X = rng.uniform(280.0, 300.0, size=(30, 2))      # Kelvin-ish
+        y = X @ np.array([0.1, -0.2])
+        weights, biases, acts = _fit_tiny(X, y)
+        # a constant input must map to a constant output regardless of
+        # the (large) feature offset the fold absorbed
+        out = _forward(weights, biases, acts, X[0], np.float64)
+        out32 = _forward(weights, biases, acts, X[0], np.float32)
+        np.testing.assert_allclose(out32, out, rtol=1e-4, atol=1e-4)
+
+
+class TestEpsilonStdGuard:
+    def test_near_constant_column_keeps_weights_bounded(self):
+        """The guard (``_std``: scale 1 for near-constant columns) is
+        what stands between the fold and 1e9-magnitude weights: with an
+        exactly-constant and an epsilon-noise column present, every
+        folded weight/bias stays O(1)."""
+        rng = np.random.default_rng(2)
+        X = np.column_stack([
+            np.full(40, 5.0),                            # exactly constant
+            5.0 + 1e-9 * rng.standard_normal(40),        # epsilon std
+            rng.uniform(-1.0, 1.0, 40),                  # honest column
+        ])
+        y = X[:, 2]
+        weights, biases, acts = _fit_tiny(X, y)
+        assert np.max(np.abs(weights[0])) < 1e3
+        assert np.max(np.abs(biases[0])) < 1e3
+
+    def test_unguarded_fold_is_the_precision_pass_must_refuse(self):
+        """The counterfactual, pinned as the precision certifier's
+        must-refuse shape: folding a 1e-9 std the way the guard
+        prevents bakes w=1e9 with a compensating 1e9·mean bias — exact
+        in f64, refuted for every narrow dtype by the error lattice."""
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        from agentlib_mpc_tpu.lint.jaxpr import certify_precision
+
+        def unguarded(x):         # (x - 5.0) / 1e-9, folded
+            return x * 1e9 - 5e9
+
+        def honest(x):            # an honest column's fold: std O(1)
+            return (x - 5.0) / 0.577
+
+        with enable_x64(False):   # the production (f32-trace) regime
+            cert = certify_precision(
+                unguarded, jnp.zeros((4,)),
+                seeds={0: (5.0 - 1e-9, 5.0 + 1e-9)})
+            cert_ok = certify_precision(
+                honest, jnp.zeros((4,)), seeds={0: (4.0, 6.0)})
+        assert cert.status == "refuted"
+        assert cert.certified_dtype("unphased") == "f64"
+        assert cert_ok.proved
